@@ -1,0 +1,35 @@
+"""protocol_tpu — a TPU-native decentralized compute-orchestration framework.
+
+A ground-up rebuild of the capabilities of PrimeIntellect-ai/protocol
+(reference mounted at /root/reference, a Rust workspace of 7 crates:
+p2p / shared / discovery / orchestrator / validator / worker / dev-utils),
+re-designed TPU-first:
+
+- The orchestrator's job<->worker matching hot loop
+  (reference: crates/orchestrator/src/scheduler/mod.rs:26-74, an O(tasks)
+  greedy matcher run per worker heartbeat) is lifted into batched JAX
+  assignment kernels (vectorized first-fit-decreasing, Sinkhorn optimal
+  transport, Bertsekas auction) over a provider x task cost tensor,
+  sharded provider-wise across a TPU mesh via shard_map.
+- The control plane (discovery registry, pool orchestrator, worker agent,
+  validator, signed-HTTP security, heartbeat health FSM, node groups /
+  gang scheduling) preserves the reference's behavior and API surface in
+  asyncio Python services.
+- The economic substrate (the reference's Ethereum contracts, absent as an
+  empty submodule there) is provided as an in-process ledger implementing
+  the same operation surface as the reference's contract wrappers
+  (crates/shared/src/web3/contracts/).
+
+Subpackages:
+  models    - Node/ComputeSpecs/ComputeRequirements/Task/... data model (L0)
+  ops       - JAX assignment kernels + feature encoding (L3)
+  parallel  - mesh construction and sharded kernel variants
+  sched     - Scheduler interface, CPU parity backend, TPU backend, plugins
+  store     - redis-semantics in-process state store + domain stores (L1)
+  security  - wallet, request signing, signature-validation middleware
+  services  - discovery / orchestrator / worker / validator services
+  chain     - in-process ledger (contract-wrapper-surface equivalent)
+  utils     - storage providers, misc helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,51 @@
+"""L0 data models: nodes, capability algebra, tasks, heartbeats, metrics.
+
+Semantics mirror the reference's shared models
+(/root/reference/crates/shared/src/models/) so that every control-plane
+behavior (capability gating, scheduling, grouping, validation) can be
+parity-tested against the reference's documented edge cases.
+"""
+
+from protocol_tpu.models.node import (
+    ComputeRequirements,
+    ComputeSpecs,
+    CpuSpecs,
+    DiscoveryNode,
+    GpuRequirements,
+    GpuSpecs,
+    Node,
+    NodeLocation,
+)
+from protocol_tpu.models.task import (
+    SchedulingConfig,
+    StorageConfig,
+    Task,
+    TaskRequest,
+    TaskState,
+    VolumeMount,
+)
+from protocol_tpu.models.heartbeat import HeartbeatRequest, TaskDetails
+from protocol_tpu.models.metric import MetricEntry, MetricKey
+from protocol_tpu.models.api import ApiResponse
+
+__all__ = [
+    "ApiResponse",
+    "ComputeRequirements",
+    "ComputeSpecs",
+    "CpuSpecs",
+    "DiscoveryNode",
+    "GpuRequirements",
+    "GpuSpecs",
+    "HeartbeatRequest",
+    "MetricEntry",
+    "MetricKey",
+    "Node",
+    "NodeLocation",
+    "SchedulingConfig",
+    "StorageConfig",
+    "Task",
+    "TaskDetails",
+    "TaskRequest",
+    "TaskState",
+    "VolumeMount",
+]

@@ -1,0 +1,54 @@
+"""API envelope models (reference: crates/shared/src/models/api.rs, storage.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass
+class ApiResponse(Generic[T]):
+    success: bool
+    data: T
+
+    def to_dict(self) -> dict:
+        data = self.data
+        if hasattr(data, "to_dict"):
+            data = data.to_dict()
+        elif isinstance(data, list):
+            data = [x.to_dict() if hasattr(x, "to_dict") else x for x in data]
+        return {"success": self.success, "data": data}
+
+
+@dataclass
+class RequestUploadRequest:
+    """Signed-URL upload request (storage.rs)."""
+
+    file_name: str
+    file_size: int
+    file_type: str
+    sha256: str
+    task_id: Optional[str] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "file_name": self.file_name,
+            "file_size": self.file_size,
+            "file_type": self.file_type,
+            "sha256": self.sha256,
+        }
+        if self.task_id is not None:
+            d["task_id"] = self.task_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RequestUploadRequest":
+        return cls(
+            file_name=d["file_name"],
+            file_size=int(d["file_size"]),
+            file_type=d["file_type"],
+            sha256=d["sha256"],
+            task_id=d.get("task_id"),
+        )

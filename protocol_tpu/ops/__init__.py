@@ -1,0 +1,36 @@
+"""TPU-side assignment kernels and the numeric feature encoding feeding them.
+
+Modules:
+  encoding  - host-side interning + fixed-width numeric encode of the L0
+              capability algebra; device-side vectorized ``meets()`` mask.
+  cost      - provider x task cost tensor construction (price, load,
+              proximity, staleness terms; +inf on incompatibility).
+  assign    - assignment kernels: greedy first-fit(-decreasing) scan,
+              Sinkhorn entropic OT with feasible rounding, Bertsekas
+              auction with deterministic tie-breaking.
+"""
+
+from protocol_tpu.ops.encoding import (
+    EncodedProviders,
+    EncodedRequirements,
+    FeatureEncoder,
+    compat_mask,
+)
+from protocol_tpu.ops.cost import CostWeights, cost_matrix
+from protocol_tpu.ops.assign import (
+    assign_auction,
+    assign_greedy,
+    assign_sinkhorn,
+)
+
+__all__ = [
+    "CostWeights",
+    "EncodedProviders",
+    "EncodedRequirements",
+    "FeatureEncoder",
+    "assign_auction",
+    "assign_greedy",
+    "assign_sinkhorn",
+    "compat_mask",
+    "cost_matrix",
+]

@@ -1,0 +1,392 @@
+"""Numeric encoding of the capability algebra for TPU kernels.
+
+The reference evaluates ``ComputeSpecs::meets()`` (crates/shared/src/models/
+node.rs:377-527) one node at a time on the CPU, with stringly-typed GPU-model
+fuzzy matching in the inner loop. That shape cannot batch. Here the split is:
+
+- **Host side** (this module's ``FeatureEncoder``): intern GPU model strings
+  into a vocabulary of class ids once per distinct string; resolve each
+  requirement's fuzzy model CSV against the vocabulary into a *bitmask over
+  classes*. All string work happens exactly once per distinct string, not per
+  (provider, task) pair.
+- **Device side** (``compat_mask``): pure int32 comparisons over fixed-width
+  arrays — `[P]` provider features vs `[T, K]` requirement options (K padded
+  GPU OR-alternatives) — producing the `[P, T]` compatibility mask in one
+  fused XLA computation. Absent fields use a ``-1`` sentinel; "no constraint"
+  passes, "constraint on an absent spec" fails, matching the reference's
+  Option semantics exactly (parity-tested against the Python ``meets()``).
+
+Static shapes everywhere: K (max GPU alternatives) and W (model-bitmask words)
+are fixed at encode time, so jit caches one executable per (P, T, K, W)
+bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from protocol_tpu.models.node import (
+    ComputeRequirements,
+    ComputeSpecs,
+    NodeLocation,
+    _models_fuzzy_match,
+)
+
+# Number of padded GPU OR-alternatives per requirement set. The reference DSL
+# rarely exceeds 2-3 alternatives; overflowing options raise at encode time.
+DEFAULT_MAX_GPU_OPTIONS = 4
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class EncodedProviders:
+    """Fixed-width provider features, shape [P] each. -1 = absent."""
+
+    gpu_count: jax.Array  # i32
+    gpu_mem_mb: jax.Array  # i32, per card
+    gpu_model_id: jax.Array  # i32, index into the model vocabulary; -1 = none
+    has_gpu: jax.Array  # bool
+    has_cpu: jax.Array  # bool, node reports a CPU spec at all
+    cpu_cores: jax.Array  # i32
+    ram_mb: jax.Array  # i32
+    storage_gb: jax.Array  # i32
+    lat: jax.Array  # f32, radians
+    lon: jax.Array  # f32, radians
+    has_location: jax.Array  # bool (an explicit flag: (0,0) is a valid coord)
+    price: jax.Array  # f32, arbitrary cost units
+    load: jax.Array  # f32, 0..1 current utilization
+    valid: jax.Array  # bool, padding rows are False
+
+    @property
+    def num(self) -> int:
+        return int(self.gpu_count.shape[0])
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class EncodedRequirements:
+    """Fixed-width requirement features. Scalars are [T]; GPU OR-options are
+    [T, K]; the model-class bitmask is [T, K, W] uint32."""
+
+    cpu_required: jax.Array  # bool [T], requirement carries a CPU block at all
+    cpu_cores: jax.Array  # i32 [T], -1 = unconstrained
+    ram_mb: jax.Array  # i32 [T]
+    storage_gb: jax.Array  # i32 [T]
+    gpu_opt_valid: jax.Array  # bool [T, K]
+    gpu_count: jax.Array  # i32 [T, K], -1 = unconstrained
+    gpu_mem_min: jax.Array  # i32 [T, K]  (covers memory_mb and memory_mb_min)
+    gpu_mem_max: jax.Array  # i32 [T, K]
+    gpu_total_mem_min: jax.Array  # i32 [T, K]
+    gpu_total_mem_max: jax.Array  # i32 [T, K]
+    gpu_model_mask: jax.Array  # u32 [T, K, W]; all-ones = unconstrained
+    gpu_model_constrained: jax.Array  # bool [T, K]
+    lat: jax.Array  # f32 [T], radians (task origin; 0 if none)
+    lon: jax.Array  # f32 [T]
+    has_location: jax.Array  # bool [T]
+    priority: jax.Array  # f32 [T] (newest-first ordering weight)
+    valid: jax.Array  # bool [T], padding rows are False
+
+    @property
+    def num(self) -> int:
+        return int(self.cpu_cores.shape[0])
+
+    @property
+    def max_gpu_options(self) -> int:
+        return int(self.gpu_opt_valid.shape[1])
+
+
+class FeatureEncoder:
+    """Host-side interning + batch encoding.
+
+    The encoder owns the GPU-model vocabulary. It is incremental: new model
+    strings get fresh class ids, and requirement bitmasks are resolved against
+    the vocabulary *at encode time* (so encode requirements after the
+    providers they will be matched with, or re-encode on vocab growth —
+    ``vocab_version`` tracks this).
+    """
+
+    def __init__(self, model_words: int = 8, max_gpu_options: int = DEFAULT_MAX_GPU_OPTIONS):
+        # W words of 32 bits each => capacity model_words*32 distinct models
+        self._vocab: dict[str, int] = {}
+        self._vocab_list: list[str] = []
+        self.model_words = model_words
+        self.max_gpu_options = max_gpu_options
+        self.vocab_version = 0
+
+    # ---------------- vocabulary ----------------
+
+    def intern_model(self, model: Optional[str]) -> int:
+        if model is None:
+            return -1
+        key = model.strip()
+        mid = self._vocab.get(key)
+        if mid is None:
+            mid = len(self._vocab_list)
+            if mid >= self.model_words * 32:
+                raise ValueError(
+                    f"GPU model vocabulary overflow (> {self.model_words * 32}); "
+                    "construct the FeatureEncoder with more model_words"
+                )
+            self._vocab[key] = mid
+            self._vocab_list.append(key)
+            self.vocab_version += 1
+        return mid
+
+    def _model_csv_to_mask(self, csv: Optional[str]) -> tuple[np.ndarray, bool]:
+        """Resolve a requirement's model CSV into a bitmask over vocab classes
+        using the reference's fuzzy-match rule. Returns (mask[W] u32,
+        constrained)."""
+        mask = np.zeros(self.model_words, dtype=np.uint32)
+        if csv is None:
+            return mask, False
+        for mid, spec_model in enumerate(self._vocab_list):
+            if _models_fuzzy_match(spec_model, csv):
+                mask[mid >> 5] |= np.uint32(1) << np.uint32(mid & 31)
+        return mask, True
+
+    # ---------------- providers ----------------
+
+    def encode_providers(
+        self,
+        specs: Sequence[Optional[ComputeSpecs]],
+        locations: Optional[Sequence[Optional[NodeLocation]]] = None,
+        prices: Optional[Sequence[float]] = None,
+        loads: Optional[Sequence[float]] = None,
+        pad_to: Optional[int] = None,
+    ) -> EncodedProviders:
+        n = len(specs)
+        p = pad_to if pad_to is not None else n
+        if p < n:
+            raise ValueError("pad_to smaller than provider count")
+
+        gpu_count = np.full(p, -1, np.int32)
+        gpu_mem = np.full(p, -1, np.int32)
+        gpu_model = np.full(p, -1, np.int32)
+        has_gpu = np.zeros(p, bool)
+        has_cpu = np.zeros(p, bool)
+        cpu_cores = np.full(p, -1, np.int32)
+        ram = np.full(p, -1, np.int32)
+        storage = np.full(p, -1, np.int32)
+        lat = np.zeros(p, np.float32)
+        lon = np.zeros(p, np.float32)
+        has_loc = np.zeros(p, bool)
+        price = np.zeros(p, np.float32)
+        load = np.zeros(p, np.float32)
+        valid = np.zeros(p, bool)
+
+        for i, s in enumerate(specs):
+            valid[i] = True
+            if s is None:
+                continue
+            if s.gpu is not None:
+                has_gpu[i] = True
+                if s.gpu.count is not None:
+                    gpu_count[i] = s.gpu.count
+                if s.gpu.memory_mb is not None:
+                    gpu_mem[i] = s.gpu.memory_mb
+                gpu_model[i] = self.intern_model(s.gpu.model)
+            if s.cpu is not None:
+                has_cpu[i] = True
+                if s.cpu.cores is not None:
+                    cpu_cores[i] = s.cpu.cores
+            if s.ram_mb is not None:
+                ram[i] = s.ram_mb
+            if s.storage_gb is not None:
+                storage[i] = s.storage_gb
+        if locations is not None:
+            for i, lc in enumerate(locations):
+                if lc is not None:
+                    lat[i] = np.radians(lc.latitude)
+                    lon[i] = np.radians(lc.longitude)
+                    has_loc[i] = True
+        if prices is not None:
+            price[: len(prices)] = np.asarray(prices, np.float32)
+        if loads is not None:
+            load[: len(loads)] = np.asarray(loads, np.float32)
+
+        return EncodedProviders(
+            gpu_count=jnp.asarray(gpu_count),
+            gpu_mem_mb=jnp.asarray(gpu_mem),
+            gpu_model_id=jnp.asarray(gpu_model),
+            has_gpu=jnp.asarray(has_gpu),
+            has_cpu=jnp.asarray(has_cpu),
+            cpu_cores=jnp.asarray(cpu_cores),
+            ram_mb=jnp.asarray(ram),
+            storage_gb=jnp.asarray(storage),
+            lat=jnp.asarray(lat),
+            lon=jnp.asarray(lon),
+            has_location=jnp.asarray(has_loc),
+            price=jnp.asarray(price),
+            load=jnp.asarray(load),
+            valid=jnp.asarray(valid),
+        )
+
+    # ---------------- requirements ----------------
+
+    def encode_requirements(
+        self,
+        reqs: Sequence[ComputeRequirements],
+        locations: Optional[Sequence[Optional[NodeLocation]]] = None,
+        priorities: Optional[Sequence[float]] = None,
+        pad_to: Optional[int] = None,
+    ) -> EncodedRequirements:
+        n = len(reqs)
+        t = pad_to if pad_to is not None else n
+        if t < n:
+            raise ValueError("pad_to smaller than requirement count")
+        k, w = self.max_gpu_options, self.model_words
+
+        cpu_required = np.zeros(t, bool)
+        cpu_cores = np.full(t, -1, np.int32)
+        ram = np.full(t, -1, np.int32)
+        storage = np.full(t, -1, np.int32)
+        opt_valid = np.zeros((t, k), bool)
+        gcount = np.full((t, k), -1, np.int32)
+        gmem_min = np.full((t, k), -1, np.int32)
+        gmem_max = np.full((t, k), -1, np.int32)
+        gtot_min = np.full((t, k), -1, np.int32)
+        gtot_max = np.full((t, k), -1, np.int32)
+        gmask = np.zeros((t, k, w), np.uint32)
+        gconstrained = np.zeros((t, k), bool)
+        lat = np.zeros(t, np.float32)
+        lon = np.zeros(t, np.float32)
+        has_loc = np.zeros(t, bool)
+        prio = np.zeros(t, np.float32)
+        valid = np.zeros(t, bool)
+
+        for i, r in enumerate(reqs):
+            valid[i] = True
+            if r.cpu is not None:
+                cpu_required[i] = True
+                if r.cpu.cores is not None:
+                    cpu_cores[i] = r.cpu.cores
+            if r.ram_mb is not None:
+                ram[i] = r.ram_mb
+            if r.storage_gb is not None:
+                storage[i] = r.storage_gb
+            if len(r.gpu) > k:
+                raise ValueError(
+                    f"requirement has {len(r.gpu)} GPU alternatives > max {k}"
+                )
+            for j, g in enumerate(r.gpu):
+                opt_valid[i, j] = True
+                if g.count is not None:
+                    gcount[i, j] = g.count
+                # memory_mb is itself a lower bound (node.rs:480-500); when a
+                # dict-deserialized requirement carries both (the DSL parser
+                # rejects the combination but the wire path does not), the
+                # effective bound is the stricter of the two.
+                bounds = [b for b in (g.memory_mb, g.memory_mb_min) if b is not None]
+                if bounds:
+                    gmem_min[i, j] = max(bounds)
+                if g.memory_mb_max is not None:
+                    gmem_max[i, j] = g.memory_mb_max
+                if g.total_memory_min is not None:
+                    gtot_min[i, j] = g.total_memory_min
+                if g.total_memory_max is not None:
+                    gtot_max[i, j] = g.total_memory_max
+                gmask[i, j], gconstrained[i, j] = self._model_csv_to_mask(g.model)
+        if locations is not None:
+            for i, lc in enumerate(locations):
+                if lc is not None:
+                    lat[i] = np.radians(lc.latitude)
+                    lon[i] = np.radians(lc.longitude)
+                    has_loc[i] = True
+        if priorities is not None:
+            prio[: len(priorities)] = np.asarray(priorities, np.float32)
+
+        return EncodedRequirements(
+            cpu_required=jnp.asarray(cpu_required),
+            cpu_cores=jnp.asarray(cpu_cores),
+            ram_mb=jnp.asarray(ram),
+            storage_gb=jnp.asarray(storage),
+            gpu_opt_valid=jnp.asarray(opt_valid),
+            gpu_count=jnp.asarray(gcount),
+            gpu_mem_min=jnp.asarray(gmem_min),
+            gpu_mem_max=jnp.asarray(gmem_max),
+            gpu_total_mem_min=jnp.asarray(gtot_min),
+            gpu_total_mem_max=jnp.asarray(gtot_max),
+            gpu_model_mask=jnp.asarray(gmask),
+            gpu_model_constrained=jnp.asarray(gconstrained),
+            lat=jnp.asarray(lat),
+            lon=jnp.asarray(lon),
+            has_location=jnp.asarray(has_loc),
+            priority=jnp.asarray(prio),
+            valid=jnp.asarray(valid),
+        )
+
+
+def _ge_min(spec: jax.Array, req: jax.Array) -> jax.Array:
+    """'spec >= req' with Option semantics: no constraint passes; constraint
+    on an absent spec fails (node.rs `is_none_or(|s| s < req)`)."""
+    return (req < 0) | ((spec >= 0) & (spec >= req))
+
+
+def _le_max(spec: jax.Array, req: jax.Array) -> jax.Array:
+    return (req < 0) | ((spec >= 0) & (spec <= req))
+
+
+def compat_mask(p: EncodedProviders, r: EncodedRequirements) -> jax.Array:
+    """Vectorized ``ComputeSpecs.meets()``: bool [P, T].
+
+    Pure elementwise int32 logic — XLA fuses this into a handful of VPU ops;
+    no gathers except the [W]-word model-bitmask lookup, which is indexed by
+    provider only.
+    """
+    P = p.gpu_count.shape[0]
+    T = r.cpu_cores.shape[0]
+
+    # ----- scalar AND constraints: [P, 1] vs [1, T] -> [P, T]
+    # A requirement carrying any CPU block (even without a cores bound)
+    # demands the node report a CPU spec (node.rs:379-390).
+    ok = ~r.cpu_required[None, :] | (
+        p.has_cpu[:, None] & _ge_min(p.cpu_cores[:, None], r.cpu_cores[None, :])
+    )
+    ok &= _ge_min(p.ram_mb[:, None], r.ram_mb[None, :])
+    ok &= _ge_min(p.storage_gb[:, None], r.storage_gb[None, :])
+
+    # ----- GPU OR alternatives: broadcast [P,1,1] vs [1,T,K] -> [P,T,K]
+    pc = p.gpu_count[:, None, None]
+    pm = p.gpu_mem_mb[:, None, None]
+    rc = r.gpu_count[None, :, :]
+
+    # exact count: None spec passes only req_count==0 (node.rs:445-459)
+    count_ok = (rc < 0) | jnp.where(pc < 0, rc == 0, pc == rc)
+    mem_ok = _ge_min(pm, r.gpu_mem_min[None, :, :]) & _le_max(pm, r.gpu_mem_max[None, :, :])
+
+    # total memory binds only when the provider reports count AND memory
+    total = pc * pm
+    have_total = (pc >= 0) & (pm >= 0)
+    tot_ok = (
+        ((r.gpu_total_mem_min[None, :, :] < 0) | ~have_total | (total >= r.gpu_total_mem_min[None, :, :]))
+        & ((r.gpu_total_mem_max[None, :, :] < 0) | ~have_total | (total <= r.gpu_total_mem_max[None, :, :]))
+    )
+
+    # model bitmask: provider class id -> (word, bit); gather the word column
+    word = jnp.maximum(p.gpu_model_id, 0) >> 5  # [P]
+    bit = jnp.maximum(p.gpu_model_id, 0) & 31  # [P]
+    # r.gpu_model_mask: [T, K, W] -> select per-provider word -> [P, T, K]
+    words = jnp.take(r.gpu_model_mask, word, axis=2)  # [T, K, P]
+    words = jnp.moveaxis(words, 2, 0)  # [P, T, K]
+    model_hit = ((words >> bit[:, None, None].astype(jnp.uint32)) & 1).astype(bool)
+    has_model = (p.gpu_model_id >= 0)[:, None, None]
+    model_ok = ~r.gpu_model_constrained[None, :, :] | (has_model & model_hit)
+
+    opt_ok = count_ok & mem_ok & tot_ok & model_ok
+    opt_ok &= r.gpu_opt_valid[None, :, :]
+
+    any_opt = jnp.any(r.gpu_opt_valid, axis=1)  # [T] requirement has GPU options
+    gpu_ok = jnp.where(
+        any_opt[None, :],
+        p.has_gpu[:, None] & jnp.any(opt_ok, axis=2),
+        True,
+    )
+    ok &= gpu_ok
+    ok &= p.valid[:, None] & r.valid[None, :]
+    return ok

@@ -1,0 +1,14 @@
+"""Device-mesh construction and sharded assignment kernels.
+
+The reference scales its control plane with tokio fan-out concurrency
+(SURVEY.md §2.9); the O(providers x tasks) matching itself never scales.
+Here the matching is SPMD over a 1-D provider mesh: each device owns a
+contiguous shard of providers (cost rows), and the auction's combine step
+rides ICI collectives (all_gather of per-shard top-2 candidates, max-combine
+of replicated state).
+"""
+
+from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
+from protocol_tpu.parallel.auction import assign_auction_sharded
+
+__all__ = ["assign_auction_sharded", "make_mesh", "pad_to_multiple"]

@@ -1,0 +1,34 @@
+"""Mesh helpers for the provider-sharded scheduler kernels."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+PROVIDER_AXIS = "p"
+
+
+def make_mesh(num_devices: Optional[int] = None, axis: str = PROVIDER_AXIS) -> Mesh:
+    """1-D mesh over the first ``num_devices`` devices (default: all).
+
+    The provider axis is the only sharded axis in the scheduler: providers
+    outnumber everything else and the per-provider state (prices, owners,
+    feature rows) is embarrassingly shardable, while per-task state is small
+    and replicated.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple

@@ -1,0 +1,18 @@
+"""Scheduling layer.
+
+``Scheduler`` reproduces the reference's per-heartbeat matcher surface
+(crates/orchestrator/src/scheduler/mod.rs): fetch tasks -> plugin filter
+chain -> pick -> expand variables. Two interchangeable backends:
+
+  greedy  - the reference's behavior exactly (first task after filters);
+            the parity oracle and fallback path.
+  tpu     - batch matcher: encodes the whole marketplace, solves one
+            assignment problem on the accelerator (auction kernel), serves
+            per-node lookups from the cached batch solution, re-solving when
+            the node/task population changes.
+"""
+
+from protocol_tpu.sched.scheduler import Scheduler, expand_task_for_node
+from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+
+__all__ = ["Scheduler", "TpuBatchMatcher", "expand_task_for_node"]

@@ -1,0 +1,100 @@
+"""Scheduler: the per-heartbeat task-for-node resolution.
+
+Reference: crates/orchestrator/src/scheduler/mod.rs —
+``get_task_for_node`` (:26-74) fetches ALL tasks, runs the plugin filter
+chain, picks the first surviving task, and expands ``${TASK_ID}`` /
+``${NODE_ADDRESS}`` into env vars, cmd, and volume mounts. The default chain
+holds the newest-task plugin (:16-18).
+
+This implementation keeps that exact surface (it is the parity oracle the
+TPU batch matcher is tested against) but the backend is pluggable: when a
+``TpuBatchMatcher`` is attached, per-node resolution is served from the
+latest batch assignment computed on the accelerator, falling back to the
+greedy chain for nodes the batch didn't cover.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional, Protocol, TYPE_CHECKING
+
+from protocol_tpu.models.task import Task
+from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.domains.node_store import OrchestratorNode
+
+if TYPE_CHECKING:
+    from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+
+
+class SchedulerPlugin(Protocol):
+    """Filter-chain plugin (reference plugins/mod.rs:61-78 enum dispatch)."""
+
+    def filter_tasks(
+        self, tasks: list[Task], node: OrchestratorNode
+    ) -> list[Task]: ...
+
+
+class NewestTaskPlugin:
+    """Sort newest-first, pass everything through
+    (reference plugins/newest_task/mod.rs)."""
+
+    def filter_tasks(self, tasks: list[Task], node: OrchestratorNode) -> list[Task]:
+        return sorted(tasks, key=lambda t: t.created_at, reverse=True)
+
+
+def expand_task_for_node(task: Task, node_address: str) -> Task:
+    """${TASK_ID} / ${NODE_ADDRESS} expansion into env/cmd/volumes
+    (scheduler/mod.rs:40-70, task.rs replace_labels)."""
+    t = copy.deepcopy(task)
+
+    def sub(s: str) -> str:
+        return s.replace("${TASK_ID}", t.id).replace("${NODE_ADDRESS}", node_address)
+
+    if t.env_vars:
+        t.env_vars = {k: sub(v) for k, v in t.env_vars.items()}
+    if t.cmd:
+        t.cmd = [sub(c) for c in t.cmd]
+    if t.entrypoint:
+        t.entrypoint = [sub(c) for c in t.entrypoint]
+    if t.volume_mounts:
+        t.volume_mounts = [vm.replace_labels(t.id, node_address) for vm in t.volume_mounts]
+    return t
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: StoreContext,
+        plugins: Optional[list[SchedulerPlugin]] = None,
+        batch_matcher: Optional["TpuBatchMatcher"] = None,
+    ):
+        self.store = store
+        self.plugins: list[SchedulerPlugin] = (
+            plugins if plugins is not None else [NewestTaskPlugin()]
+        )
+        self.batch_matcher = batch_matcher
+
+    def get_task_for_node(self, node_address: str) -> Optional[Task]:
+        node = self.store.node_store.get_node(node_address)
+        if node is None:
+            return None
+
+        if self.batch_matcher is not None:
+            task, covered = self.batch_matcher.lookup(node)
+            if covered:
+                # The batch solve considered this node. Its verdict is final:
+                # an unassigned-but-covered node stays idle (requirements or
+                # replica bounds excluded it) rather than falling through to
+                # the ungated greedy chain.
+                if task is None:
+                    return None
+                return expand_task_for_node(task, node_address)
+            # fall through to the greedy chain only for nodes the batch
+            # didn't consider (e.g. not in a schedulable status at solve time)
+
+        tasks = self.store.task_store.get_all_tasks()
+        for plugin in self.plugins:
+            tasks = plugin.filter_tasks(tasks, node)
+            if not tasks:
+                return None
+        return expand_task_for_node(tasks[0], node_address)

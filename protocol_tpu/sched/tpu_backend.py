@@ -1,0 +1,244 @@
+"""TPU batch matcher: the ``scheduler_backend=tpu`` hot path.
+
+Replaces the reference's per-heartbeat O(tasks) greedy walk
+(crates/orchestrator/src/scheduler/mod.rs:26-74) with one batched solve per
+population change: encode every schedulable node and every task once,
+build the cost tensor on-device, and resolve contention with the auction
+kernel. Per-heartbeat lookups then hit a host-side dict.
+
+Task semantics: the reference's matcher hands the *same* (newest) task to
+every node — tasks are unbounded swarms. This framework generalizes with a
+``replicas`` bound read from the task's scheduling config
+(``plugins["tpu_scheduler"]["replicas"] = ["<N>"]``; absent = unbounded,
+matching the reference). Requirements come from
+``plugins["tpu_scheduler"]["compute_requirements"] = ["<DSL>"]`` in the same
+requirements DSL the pools use (shared/src/models/node.rs:180-374).
+
+Solve structure:
+  - bounded tasks are unit-expanded into replica slots -> auction over
+    [nodes x slots] (contended, price-mediated);
+  - unassigned nodes then take their cheapest compatible unbounded task
+    (row argmin — contention-free, exactly the swarm semantics).
+
+Shapes are padded to power-of-two buckets so jit re-traces only on bucket
+growth, not on every membership change.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from protocol_tpu.models.node import ComputeRequirements
+from protocol_tpu.models.task import Task
+from protocol_tpu.ops.assign import assign_auction
+from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.encoding import FeatureEncoder
+from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
+
+SCHEDULABLE = (NodeStatus.HEALTHY, NodeStatus.WAITING_FOR_HEARTBEAT)
+
+
+def _pow2_bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def task_replicas(task: Task) -> Optional[int]:
+    cfg = task.scheduling_config
+    if cfg and cfg.plugins:
+        vals = cfg.plugins.get("tpu_scheduler", {}).get("replicas")
+        if vals:
+            r = int(vals[0])
+            if r <= 0:
+                raise ValueError(f"replicas must be positive, got {r}")
+            return r
+    return None
+
+
+def task_requirements(task: Task) -> ComputeRequirements:
+    cfg = task.scheduling_config
+    if cfg and cfg.plugins:
+        vals = cfg.plugins.get("tpu_scheduler", {}).get("compute_requirements")
+        if vals:
+            return ComputeRequirements.parse(vals[0])
+    return ComputeRequirements()
+
+
+def validate_tpu_scheduler_config(task: Task) -> None:
+    """Reject malformed tpu_scheduler plugin config at task-creation time so
+    user input can never break the batch solve (raises ValueError)."""
+    try:
+        task_replicas(task)
+        task_requirements(task)
+    except Exception as e:
+        raise ValueError(f"invalid tpu_scheduler config: {e}") from e
+
+
+@jax.jit
+def _solve_bounded(ep, er, weights) -> jax.Array:
+    cost, _ = cost_matrix(ep, er, weights)
+    return assign_auction(cost, eps=0.05, max_iters=300).task_for_provider
+
+
+@jax.jit
+def _solve_unbounded(ep, er, weights) -> tuple[jax.Array, jax.Array]:
+    cost, _ = cost_matrix(ep, er, weights)
+    best = jnp.argmin(cost, axis=1).astype(jnp.int32)  # [P]
+    feas = jnp.take_along_axis(cost, best[:, None], axis=1)[:, 0] < INFEASIBLE * 0.5
+    return jnp.where(feas, best, -1), feas
+
+
+class TpuBatchMatcher:
+    def __init__(
+        self,
+        store: StoreContext,
+        weights: Optional[CostWeights] = None,
+        min_solve_interval: float = 1.0,
+        max_replica_slots: int = 4096,
+        time_fn=time.monotonic,
+    ):
+        self.store = store
+        self.weights = weights or CostWeights(priority=jnp.float32(1.0))
+        self.min_solve_interval = min_solve_interval
+        self.max_replica_slots = max_replica_slots
+        self._time = time_fn
+        self._dirty = True
+        self._last_solve = float("-inf")
+        self._assignment: dict[str, str] = {}  # node address -> task id
+        self._covered: set[str] = set()  # addresses the last solve considered
+        self.encoder = FeatureEncoder()
+        self.last_solve_stats: dict = {}
+
+    # ----- invalidation hooks (wire to TaskStore observers + node changes)
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def attach_observers(self) -> None:
+        self.store.task_store.subscribe_created(lambda t: self.mark_dirty())
+        self.store.task_store.subscribe_deleted(lambda t: self.mark_dirty())
+
+    # ----- lookup
+
+    def lookup(self, node: OrchestratorNode) -> tuple[Optional[Task], bool]:
+        """Returns (task, covered). ``covered`` means the last batch solve
+        considered this node, so an empty assignment is a deliberate verdict
+        (infeasible or capacity-excluded), not a gap to paper over."""
+        self._ensure_fresh()
+        covered = node.address in self._covered
+        tid = self._assignment.get(node.address)
+        task = self.store.task_store.get_task(tid) if tid else None
+        return task, covered
+
+    def task_for_node(self, node: OrchestratorNode) -> Optional[Task]:
+        return self.lookup(node)[0]
+
+    def _ensure_fresh(self) -> None:
+        # Re-solve only when something changed, and never more often than
+        # min_solve_interval — population churn must not turn back into a
+        # per-heartbeat O(solve) cost.
+        if self._dirty and self._time() - self._last_solve >= self.min_solve_interval:
+            self.refresh()
+
+    # ----- batch solve
+
+    def refresh(self) -> None:
+        t_start = time.perf_counter()
+        nodes = [
+            n for n in self.store.node_store.get_nodes() if n.status in SCHEDULABLE
+        ]
+        tasks = self.store.task_store.get_all_tasks()
+        # Drop tasks with malformed plugin config (validated at creation via
+        # validate_tpu_scheduler_config; this guards direct store writes).
+        ok_tasks = []
+        for t in tasks:
+            try:
+                task_replicas(t)
+                task_requirements(t)
+            except Exception:
+                continue
+            ok_tasks.append(t)
+        tasks = ok_tasks
+        self._dirty = False
+        self._last_solve = self._time()
+        self._assignment = {}
+        self._covered = {n.address for n in nodes}
+        if not nodes or not tasks:
+            self.last_solve_stats = {"nodes": len(nodes), "tasks": len(tasks)}
+            return
+
+        # newest-first priority, matching NewestTaskPlugin ordering:
+        # normalize created_at to [0, 1] so the priority cost term dominates
+        # ties in the same direction as the reference's sort.
+        created = np.asarray([t.created_at for t in tasks], np.float64)
+        span = max(created.max() - created.min(), 1.0)
+        prio = ((created - created.min()) / span).astype(np.float32)
+
+        bounded: list[tuple[int, int]] = []  # (task idx, replicas)
+        unbounded: list[int] = []
+        for i, t in enumerate(tasks):
+            r = task_replicas(t)
+            if r is None:
+                unbounded.append(i)
+            else:
+                bounded.append((i, r))
+
+        specs = [n.compute_specs for n in nodes]
+        locs = [n.location for n in nodes]
+        P = len(nodes)
+        p_bucket = _pow2_bucket(P)
+        ep = self.encoder.encode_providers(specs, locations=locs, pad_to=p_bucket)
+
+        assigned = np.zeros(P, bool)
+
+        # ---- phase 1: bounded tasks -> replica slots -> auction
+        if bounded:
+            req_by_task = {i: task_requirements(tasks[i]) for i, _ in bounded}
+            slot_task: list[int] = []
+            for i, r in bounded:
+                for _ in range(min(r, P)):
+                    if len(slot_task) >= self.max_replica_slots:
+                        break
+                    slot_task.append(i)
+            reqs = [req_by_task[i] for i in slot_task]
+            prios = [prio[i] for i in slot_task]
+            s_bucket = _pow2_bucket(len(slot_task))
+            er = self.encoder.encode_requirements(
+                reqs, priorities=prios, pad_to=s_bucket
+            )
+            t4p = np.asarray(_solve_bounded(ep, er, self.weights))[:P]
+            for p_idx, s_idx in enumerate(t4p):
+                if s_idx >= 0 and s_idx < len(slot_task):
+                    self._assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
+                    assigned[p_idx] = True
+
+        # ---- phase 2: remaining nodes -> cheapest compatible unbounded task
+        if unbounded and not assigned.all():
+            reqs = [task_requirements(tasks[i]) for i in unbounded]
+            prios = [prio[i] for i in unbounded]
+            t_bucket = _pow2_bucket(len(unbounded))
+            er = self.encoder.encode_requirements(
+                reqs, priorities=prios, pad_to=t_bucket
+            )
+            best, feas = _solve_unbounded(ep, er, self.weights)
+            best = np.asarray(best)[:P]
+            for p_idx in range(P):
+                if not assigned[p_idx] and best[p_idx] >= 0 and best[p_idx] < len(unbounded):
+                    self._assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id
+
+        self.last_solve_stats = {
+            "nodes": P,
+            "tasks": len(tasks),
+            "bounded_tasks": len(bounded),
+            "assigned": len(self._assignment),
+            "solve_ms": (time.perf_counter() - t_start) * 1e3,
+        }

@@ -1,0 +1,34 @@
+"""State store layer.
+
+The reference keeps every service's state in Redis (SURVEY.md §2.3-2.6):
+node hashes + index sets, task lists, heartbeat keys with TTL, group keys,
+metric hashes, nonce replay caches. This package provides:
+
+  kv            - an in-process KV store implementing the Redis-semantics
+                  subset the framework uses (strings with TTL + SET NX,
+                  hashes, sets, sorted sets, lists, atomic pipelines).
+                  Hermetic per-test instances replace the reference's
+                  embedded redis-server fixture.
+  domains       - domain stores over the KV schema: nodes, tasks (+observer
+                  hooks), heartbeats (TTL + unhealthy counters), metrics,
+                  node groups.
+  context       - StoreContext bundling the domain stores per service.
+"""
+
+from protocol_tpu.store.kv import KVStore
+from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.domains.node_store import NodeStore, OrchestratorNode, NodeStatus
+from protocol_tpu.store.domains.task_store import TaskStore
+from protocol_tpu.store.domains.heartbeat_store import HeartbeatStore
+from protocol_tpu.store.domains.metrics_store import MetricsStore
+
+__all__ = [
+    "HeartbeatStore",
+    "KVStore",
+    "MetricsStore",
+    "NodeStatus",
+    "NodeStore",
+    "OrchestratorNode",
+    "StoreContext",
+    "TaskStore",
+]

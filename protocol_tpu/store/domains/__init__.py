@@ -1,0 +1,1 @@
+"""Domain stores over the KV schema (reference: orchestrator/src/store/domains/)."""

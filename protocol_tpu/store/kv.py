@@ -1,0 +1,329 @@
+"""In-process KV store with Redis semantics.
+
+The reference talks to a real Redis from every service and spawns an embedded
+redis-server per test (orchestrator/src/store/core/redis.rs:38-72). This
+framework's state fits one coordinating process per pool (as the reference's
+one-orchestrator-per-pool deployment does), so the store is in-process:
+a thread-safe dict engine implementing exactly the Redis subset the control
+plane uses —
+
+  strings   get / set (NX, EX) / mget / incr / delete / exists / expire
+  hashes    hset / hget / hgetall / hdel / hincrby
+  sets      sadd / srem / smembers / sismember / scard
+  zsets     zadd / zscore / zrem / zrangebyscore / zremrangebyscore / zcard
+  lists     rpush / lpush / lrange / lrem / llen
+  pipeline  atomic multi-op batch under one lock (the reference's pipelines
+            and SET-NX races map onto this)
+
+Lazy TTL expiry against a monotonic clock; a ``time_fn`` hook makes expiry
+deterministic in tests. Keys are strings, values are strings (callers do
+their own JSON), mirroring the wire-level Redis model so a networked Redis
+backend could be slotted in behind the same interface later.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+
+class KVStore:
+    def __init__(self, time_fn: Callable[[], float] = time.monotonic):
+        self._lock = threading.RLock()
+        self._data: dict[str, object] = {}
+        self._expiry: dict[str, float] = {}
+        self._time = time_fn
+
+    # ------------- internals -------------
+
+    def _expired(self, key: str) -> bool:
+        exp = self._expiry.get(key)
+        if exp is not None and self._time() >= exp:
+            self._data.pop(key, None)
+            self._expiry.pop(key, None)
+            return True
+        return False
+
+    def _get_typed(self, key: str, typ: type, create: bool = False):
+        if self._expired(key):
+            val = None
+        else:
+            val = self._data.get(key)
+        if val is None:
+            if not create:
+                return None
+            val = typ()
+            self._data[key] = val
+            self._expiry.pop(key, None)
+        if not isinstance(val, typ):
+            raise TypeError(f"WRONGTYPE key {key!r} holds {type(val).__name__}")
+        return val
+
+    # ------------- strings -------------
+
+    def set(
+        self,
+        key: str,
+        value: str,
+        nx: bool = False,
+        ex: Optional[float] = None,
+    ) -> bool:
+        with self._lock:
+            self._expired(key)
+            if nx and key in self._data:
+                return False
+            self._data[key] = str(value)
+            if ex is not None:
+                self._expiry[key] = self._time() + ex
+            else:
+                self._expiry.pop(key, None)
+            return True
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            v = self._get_typed(key, str)
+            return v
+
+    def mget(self, keys: Iterable[str]) -> list[Optional[str]]:
+        with self._lock:
+            return [self._get_typed(k, str) for k in keys]
+
+    def incr(self, key: str, amount: int = 1) -> int:
+        with self._lock:
+            cur = self._get_typed(key, str)
+            val = int(cur) + amount if cur is not None else amount
+            self._data[key] = str(val)
+            return val
+
+    def delete(self, *keys: str) -> int:
+        with self._lock:
+            n = 0
+            for key in keys:
+                self._expired(key)
+                if key in self._data:
+                    del self._data[key]
+                    self._expiry.pop(key, None)
+                    n += 1
+            return n
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            self._expired(key)
+            return key in self._data
+
+    def expire(self, key: str, seconds: float) -> bool:
+        with self._lock:
+            self._expired(key)
+            if key not in self._data:
+                return False
+            self._expiry[key] = self._time() + seconds
+            return True
+
+    def ttl(self, key: str) -> Optional[float]:
+        """Remaining TTL; None if no key or no expiry."""
+        with self._lock:
+            self._expired(key)
+            if key not in self._data:
+                return None
+            exp = self._expiry.get(key)
+            return None if exp is None else max(0.0, exp - self._time())
+
+    def keys(self, pattern: str = "*") -> list[str]:
+        with self._lock:
+            return [k for k in list(self._data) if not self._expired(k) and fnmatch.fnmatch(k, pattern)]
+
+    def flushall(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._expiry.clear()
+
+    # ------------- hashes -------------
+
+    def hset(self, key: str, field: str, value: str) -> int:
+        with self._lock:
+            h = self._get_typed(key, dict, create=True)
+            is_new = field not in h
+            h[field] = str(value)
+            return int(is_new)
+
+    def hset_mapping(self, key: str, mapping: dict[str, str]) -> int:
+        with self._lock:
+            h = self._get_typed(key, dict, create=True)
+            n = sum(1 for f in mapping if f not in h)
+            h.update({f: str(v) for f, v in mapping.items()})
+            return n
+
+    def hget(self, key: str, field: str) -> Optional[str]:
+        with self._lock:
+            h = self._get_typed(key, dict)
+            return None if h is None else h.get(field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        with self._lock:
+            h = self._get_typed(key, dict)
+            return dict(h) if h else {}
+
+    def hdel(self, key: str, *fields: str) -> int:
+        with self._lock:
+            h = self._get_typed(key, dict)
+            if not h:
+                return 0
+            n = 0
+            for f in fields:
+                if f in h:
+                    del h[f]
+                    n += 1
+            if not h:
+                self.delete(key)
+            return n
+
+    def hincrby(self, key: str, field: str, amount: int = 1) -> int:
+        with self._lock:
+            h = self._get_typed(key, dict, create=True)
+            val = int(h.get(field, "0")) + amount
+            h[field] = str(val)
+            return val
+
+    # ------------- sets -------------
+
+    def sadd(self, key: str, *members: str) -> int:
+        with self._lock:
+            s = self._get_typed(key, set, create=True)
+            n = len(members) - len(s.intersection(members))
+            s.update(str(m) for m in members)
+            return n
+
+    def srem(self, key: str, *members: str) -> int:
+        with self._lock:
+            s = self._get_typed(key, set)
+            if not s:
+                return 0
+            n = len(s.intersection(members))
+            s.difference_update(members)
+            if not s:
+                self.delete(key)
+            return n
+
+    def smembers(self, key: str) -> set[str]:
+        with self._lock:
+            s = self._get_typed(key, set)
+            return set(s) if s else set()
+
+    def sismember(self, key: str, member: str) -> bool:
+        with self._lock:
+            s = self._get_typed(key, set)
+            return bool(s) and member in s
+
+    def scard(self, key: str) -> int:
+        with self._lock:
+            s = self._get_typed(key, set)
+            return len(s) if s else 0
+
+    # ------------- sorted sets -------------
+
+    def zadd(self, key: str, mapping: dict[str, float]) -> int:
+        with self._lock:
+            z = self._get_typed(key, dict, create=True)
+            n = sum(1 for m in mapping if m not in z)
+            z.update({str(m): float(s) for m, s in mapping.items()})
+            return n
+
+    def zscore(self, key: str, member: str) -> Optional[float]:
+        with self._lock:
+            z = self._get_typed(key, dict)
+            return None if z is None else z.get(member)
+
+    def zrem(self, key: str, *members: str) -> int:
+        with self._lock:
+            z = self._get_typed(key, dict)
+            if not z:
+                return 0
+            n = 0
+            for m in members:
+                if m in z:
+                    del z[m]
+                    n += 1
+            if not z:
+                self.delete(key)
+            return n
+
+    def zrangebyscore(
+        self, key: str, min_score: float = float("-inf"), max_score: float = float("inf")
+    ) -> list[tuple[str, float]]:
+        with self._lock:
+            z = self._get_typed(key, dict)
+            if not z:
+                return []
+            out = [(m, s) for m, s in z.items() if min_score <= s <= max_score]
+            out.sort(key=lambda ms: (ms[1], ms[0]))
+            return out
+
+    def zremrangebyscore(self, key: str, min_score: float, max_score: float) -> int:
+        with self._lock:
+            victims = [m for m, _ in self.zrangebyscore(key, min_score, max_score)]
+            return self.zrem(key, *victims) if victims else 0
+
+    def zcard(self, key: str) -> int:
+        with self._lock:
+            z = self._get_typed(key, dict)
+            return len(z) if z else 0
+
+    # ------------- lists -------------
+
+    def rpush(self, key: str, *values: str) -> int:
+        with self._lock:
+            lst = self._get_typed(key, list, create=True)
+            lst.extend(str(v) for v in values)
+            return len(lst)
+
+    def lpush(self, key: str, *values: str) -> int:
+        with self._lock:
+            lst = self._get_typed(key, list, create=True)
+            for v in values:
+                lst.insert(0, str(v))
+            return len(lst)
+
+    def lrange(self, key: str, start: int = 0, stop: int = -1) -> list[str]:
+        with self._lock:
+            lst = self._get_typed(key, list)
+            if not lst:
+                return []
+            if stop == -1:
+                return list(lst[start:])
+            return list(lst[start : stop + 1])
+
+    def lrem(self, key: str, count: int, value: str) -> int:
+        """Redis LREM semantics for count >= 0 (remove first `count`
+        occurrences; 0 = all)."""
+        with self._lock:
+            lst = self._get_typed(key, list)
+            if not lst:
+                return 0
+            removed = 0
+            out = []
+            for v in lst:
+                if v == value and (count == 0 or removed < count):
+                    removed += 1
+                    continue
+                out.append(v)
+            if out:
+                self._data[key] = out
+            else:
+                self.delete(key)
+            return removed
+
+    def llen(self, key: str) -> int:
+        with self._lock:
+            lst = self._get_typed(key, list)
+            return len(lst) if lst else 0
+
+    # ------------- atomic batches -------------
+
+    def atomic(self):
+        """Context manager holding the store lock across a multi-op batch —
+        the moral equivalent of the reference's Redis pipelines and Lua
+        scripts for group create/dissolve/merge atomicity
+        (node_groups/mod.rs:298-322)."""
+        return self._lock

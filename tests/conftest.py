@@ -1,0 +1,26 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding logic
+(mesh construction, shard_map kernels, collective layouts) is exercised
+hermetically without TPU hardware.
+
+The ambient environment registers a remote-TPU PJRT plugin via
+sitecustomize and forces ``jax_platforms="axon,cpu"`` through
+jax.config.update (which takes precedence over the JAX_PLATFORMS env var),
+so we must override the config value after importing jax — env vars alone
+are not enough.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,167 @@
+"""Assignment-kernel tests: feasibility invariants, greedy parity vs a numpy
+oracle, and solution quality vs scipy's optimal linear_sum_assignment."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+import jax.numpy as jnp
+
+from protocol_tpu.ops.assign import (
+    AssignResult,
+    assign_auction,
+    assign_auction_scaled,
+    assign_greedy,
+    assign_sinkhorn,
+    ffd_order,
+)
+from protocol_tpu.ops.cost import INFEASIBLE
+
+
+def random_cost(rng, P, T, p_infeasible=0.2):
+    cost = rng.uniform(0.0, 10.0, size=(P, T)).astype(np.float32)
+    infeas = rng.random(size=(P, T)) < p_infeasible
+    cost[infeas] = float(INFEASIBLE)
+    return cost
+
+
+def check_feasible(res: AssignResult, cost: np.ndarray):
+    p4t = np.asarray(res.provider_for_task)
+    t4p = np.asarray(res.task_for_provider)
+    P, T = cost.shape
+    used = set()
+    for t, p in enumerate(p4t):
+        if p >= 0:
+            assert cost[p, t] < INFEASIBLE * 0.5, f"infeasible pair t={t} p={p}"
+            assert p not in used, f"provider {p} double-booked"
+            used.add(p)
+            assert t4p[p] == t
+    for p, t in enumerate(t4p):
+        if t >= 0:
+            assert p4t[t] == p
+    return p4t
+
+
+def greedy_oracle(cost: np.ndarray, order=None):
+    """Host-side reference: each task (in order) takes the cheapest free
+    compatible provider, ties to lowest provider index."""
+    P, T = cost.shape
+    avail = np.ones(P, bool)
+    out = np.full(T, -1, np.int64)
+    order = range(T) if order is None else order
+    for t in order:
+        col = np.where(avail, cost[:, t], INFEASIBLE)
+        p = int(np.argmin(col))
+        if col[p] < INFEASIBLE * 0.5:
+            out[t] = p
+            avail[p] = False
+    return out
+
+
+def matching_cost(cost, p4t):
+    return sum(cost[p, t] for t, p in enumerate(p4t) if p >= 0)
+
+
+class TestGreedy:
+    @pytest.mark.parametrize("seed,P,T", [(0, 16, 16), (1, 64, 256), (2, 256, 64)])
+    def test_parity_with_oracle(self, seed, P, T):
+        rng = np.random.default_rng(seed)
+        cost = random_cost(rng, P, T)
+        res = assign_greedy(jnp.asarray(cost))
+        p4t = check_feasible(res, cost)
+        np.testing.assert_array_equal(p4t, greedy_oracle(cost))
+
+    def test_custom_order_parity(self):
+        rng = np.random.default_rng(3)
+        cost = random_cost(rng, 32, 48)
+        order = rng.permutation(48).astype(np.int32)
+        res = assign_greedy(jnp.asarray(cost), task_order=jnp.asarray(order))
+        p4t = check_feasible(res, cost)
+        np.testing.assert_array_equal(p4t, greedy_oracle(cost, order=list(order)))
+
+    def test_ffd_order(self):
+        demand = jnp.asarray([1.0, 5.0, 3.0, 5.0])
+        order = np.asarray(ffd_order(demand))
+        np.testing.assert_array_equal(order, [1, 3, 2, 0])
+
+    def test_all_infeasible(self):
+        cost = np.full((4, 4), float(INFEASIBLE), np.float32)
+        res = assign_greedy(jnp.asarray(cost))
+        assert (np.asarray(res.provider_for_task) == -1).all()
+
+
+class TestAuction:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_near_optimal_square(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 48
+        cost = rng.uniform(0.0, 10.0, size=(n, n)).astype(np.float32)
+        res = assign_auction(jnp.asarray(cost), eps=0.01, max_iters=5000)
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).all(), "feasible square problem must fully match"
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        got = matching_cost(cost, p4t)
+        assert got <= opt + n * 0.011, f"auction {got} vs optimal {opt}"
+
+    def test_with_infeasibility(self):
+        rng = np.random.default_rng(7)
+        cost = random_cost(rng, 40, 30, p_infeasible=0.3)
+        res = assign_auction(jnp.asarray(cost), eps=0.05, max_iters=5000)
+        check_feasible(res, cost)
+        # every task with at least one feasible provider should be assigned
+        # (more providers than tasks, so no contention shortage)
+        p4t = np.asarray(res.provider_for_task)
+        feasible_tasks = (cost < INFEASIBLE * 0.5).any(axis=0)
+        assert (p4t[feasible_tasks] >= 0).all()
+
+    def test_more_tasks_than_providers(self):
+        rng = np.random.default_rng(11)
+        cost = random_cost(rng, 8, 32, p_infeasible=0.0)
+        res = assign_auction(jnp.asarray(cost), eps=0.05, max_iters=200)
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).sum() == 8  # all providers consumed
+
+    def test_eps_scaled(self):
+        rng = np.random.default_rng(5)
+        n = 32
+        cost = rng.uniform(0.0, 10.0, size=(n, n)).astype(np.float32)
+        res = assign_auction_scaled(jnp.asarray(cost), eps_start=1.0, eps_end=0.01)
+        p4t = check_feasible(res, cost)
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        assert matching_cost(cost, p4t) <= opt + n * 0.011
+
+
+class TestSinkhorn:
+    def test_identity_structure(self):
+        # strongly diagonal cost => sinkhorn must recover the diagonal
+        n = 16
+        cost = np.full((n, n), 5.0, np.float32)
+        np.fill_diagonal(cost, 0.1)
+        res = assign_sinkhorn(jnp.asarray(cost), eps=0.05, num_iters=300)
+        p4t = check_feasible(res, cost)
+        np.testing.assert_array_equal(p4t, np.arange(n))
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_quality_vs_optimal(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 32
+        cost = rng.uniform(0.0, 10.0, size=(n, n)).astype(np.float32)
+        res = assign_sinkhorn(jnp.asarray(cost), eps=0.02, num_iters=500)
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).all()
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        got = matching_cost(cost, p4t)
+        # entropic + rounding: allow 15% slack over optimal
+        assert got <= opt * 1.15 + 1.0, f"sinkhorn {got} vs optimal {opt}"
+
+    def test_rectangular_with_infeasibility(self):
+        rng = np.random.default_rng(9)
+        cost = random_cost(rng, 48, 24, p_infeasible=0.2)
+        res = assign_sinkhorn(jnp.asarray(cost), eps=0.05, num_iters=300)
+        check_feasible(res, cost)
+        p4t = np.asarray(res.provider_for_task)
+        feasible_tasks = (cost < INFEASIBLE * 0.5).any(axis=0)
+        assert (p4t[feasible_tasks] >= 0).all()

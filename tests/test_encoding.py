@@ -1,0 +1,138 @@
+"""Parity: vectorized compat_mask vs the Python ComputeSpecs.meets() oracle
+over randomized specs/requirements covering every branch of the algebra."""
+
+import random
+
+import numpy as np
+import pytest
+
+from protocol_tpu.models import (
+    ComputeRequirements,
+    ComputeSpecs,
+    CpuSpecs,
+    GpuRequirements,
+    GpuSpecs,
+    NodeLocation,
+)
+from protocol_tpu.ops.encoding import FeatureEncoder, compat_mask
+
+MODELS = [
+    "NVIDIA H100 80GB HBM3",
+    "NVIDIA A100-SXM4-80GB",
+    "NVIDIA GeForce RTX 4090",
+    "NVIDIA GeForce RTX 3090",
+    "H200",
+    "Tesla V100-SXM2-16GB",
+]
+REQ_MODELS = ["H100", "A100", "RTX 4090", "H100, A100", "rtx_3090", "V100", "B200"]
+
+
+def random_specs(rng: random.Random) -> ComputeSpecs:
+    gpu = None
+    if rng.random() < 0.8:
+        gpu = GpuSpecs(
+            count=rng.choice([None, 1, 2, 4, 8]),
+            model=rng.choice([None] + MODELS),
+            memory_mb=rng.choice([None, 16000, 24000, 40000, 80000]),
+        )
+    cpu = CpuSpecs(cores=rng.choice([None, 4, 16, 64])) if rng.random() < 0.8 else None
+    return ComputeSpecs(
+        gpu=gpu,
+        cpu=cpu,
+        ram_mb=rng.choice([None, 8192, 65536, 262144]),
+        storage_gb=rng.choice([None, 100, 1000, 4000]),
+    )
+
+
+def random_gpu_req(rng: random.Random) -> GpuRequirements:
+    g = GpuRequirements()
+    g.count = rng.choice([None, 0, 1, 2, 4, 8])
+    g.model = rng.choice([None] + REQ_MODELS)
+    if rng.random() < 0.5:
+        g.memory_mb = rng.choice([None, 16000, 40000, 80000])
+    else:
+        g.memory_mb_min = rng.choice([None, 16000, 40000])
+        g.memory_mb_max = rng.choice([None, 80000, 100000])
+        if (
+            g.memory_mb_min is not None
+            and g.memory_mb_max is not None
+            and g.memory_mb_min > g.memory_mb_max
+        ):
+            g.memory_mb_max = None
+    g.total_memory_min = rng.choice([None, 100000, 600000])
+    g.total_memory_max = rng.choice([None, 700000])
+    if (
+        g.total_memory_min is not None
+        and g.total_memory_max is not None
+        and g.total_memory_min > g.total_memory_max
+    ):
+        g.total_memory_max = None
+    return g
+
+
+def random_requirements(rng: random.Random) -> ComputeRequirements:
+    n_gpu = rng.choice([0, 1, 1, 2, 3])
+    return ComputeRequirements(
+        gpu=[random_gpu_req(rng) for _ in range(n_gpu)],
+        cpu=CpuSpecs(cores=rng.choice([None, 2, 8, 32])) if rng.random() < 0.5 else None,
+        ram_mb=rng.choice([None, 4096, 65536]),
+        storage_gb=rng.choice([None, 50, 2000]),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_compat_mask_parity(seed):
+    rng = random.Random(seed)
+    P, T = 40, 60
+    specs = [random_specs(rng) for _ in range(P)]
+    reqs = [random_requirements(rng) for _ in range(T)]
+
+    enc = FeatureEncoder()
+    ep = enc.encode_providers(specs)
+    er = enc.encode_requirements(reqs)
+    mask = np.asarray(compat_mask(ep, er))
+
+    for i in range(P):
+        for j in range(T):
+            expected = specs[i].meets(reqs[j])
+            assert mask[i, j] == expected, (
+                f"mismatch p={i} t={j}: kernel={mask[i, j]} oracle={expected}\n"
+                f"specs={specs[i]}\nreqs={reqs[j]}"
+            )
+
+
+def test_compat_mask_none_specs():
+    enc = FeatureEncoder()
+    ep = enc.encode_providers([None, ComputeSpecs()])
+    er = enc.encode_requirements([ComputeRequirements(), ComputeRequirements.parse("ram_mb=1")])
+    mask = np.asarray(compat_mask(ep, er))
+    # empty requirements pass for anyone; ram req fails for spec-less nodes
+    assert mask[:, 0].all()
+    assert not mask[:, 1].any()
+
+
+def test_padding_rows_invalid():
+    enc = FeatureEncoder()
+    ep = enc.encode_providers([ComputeSpecs()], pad_to=4)
+    er = enc.encode_requirements([ComputeRequirements()], pad_to=6)
+    mask = np.asarray(compat_mask(ep, er))
+    assert mask[0, 0]
+    assert not mask[1:, :].any()
+    assert not mask[:, 1:].any()
+
+
+def test_vocab_growth_and_overflow():
+    enc = FeatureEncoder(model_words=1)  # capacity 32
+    for i in range(32):
+        enc.intern_model(f"model-{i}")
+    with pytest.raises(ValueError):
+        enc.intern_model("one-too-many")
+
+
+def test_locations_encoded_in_radians():
+    enc = FeatureEncoder()
+    ep = enc.encode_providers(
+        [ComputeSpecs()], locations=[NodeLocation(latitude=90.0, longitude=180.0)]
+    )
+    assert np.isclose(float(ep.lat[0]), np.pi / 2)
+    assert np.isclose(float(ep.lon[0]), np.pi)

@@ -1,0 +1,215 @@
+"""L0 model tests: requirements DSL, capability matching, task model.
+
+Edge cases mirror the reference's in-crate tests for
+crates/shared/src/models/node.rs and task.rs.
+"""
+
+import pytest
+
+from protocol_tpu.models import (
+    ComputeRequirements,
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    Node,
+    Task,
+    TaskRequest,
+    TaskState,
+    VolumeMount,
+    StorageConfig,
+)
+from protocol_tpu.models.node import RequirementsParseError
+
+
+def specs(gpu_count=None, gpu_model=None, gpu_mem=None, cores=None, ram=None, storage=None):
+    gpu = None
+    if gpu_count is not None or gpu_model is not None or gpu_mem is not None:
+        gpu = GpuSpecs(count=gpu_count, model=gpu_model, memory_mb=gpu_mem)
+    cpu = CpuSpecs(cores=cores) if cores is not None else None
+    return ComputeSpecs(gpu=gpu, cpu=cpu, ram_mb=ram, storage_gb=storage)
+
+
+class TestRequirementsDSL:
+    def test_basic_parse(self):
+        r = ComputeRequirements.parse(
+            "gpu:count=8;gpu:model=H100;gpu:memory_mb=80000;cpu:cores=32;ram_mb=65536;storage_gb=1000"
+        )
+        assert len(r.gpu) == 1
+        assert r.gpu[0].count == 8
+        assert r.gpu[0].model == "H100"
+        assert r.gpu[0].memory_mb == 80000
+        assert r.cpu.cores == 32
+        assert r.ram_mb == 65536
+        assert r.storage_gb == 1000
+
+    def test_or_alternatives(self):
+        r = ComputeRequirements.parse(
+            "gpu:count=8;gpu:model=H100;gpu:count=4;gpu:model=A100"
+        )
+        assert len(r.gpu) == 2
+        assert r.gpu[0].model == "H100"
+        assert r.gpu[1].count == 4
+
+    def test_empty_string(self):
+        r = ComputeRequirements.parse("")
+        assert r.gpu == [] and r.cpu is None
+
+    def test_whitespace_and_empty_parts(self):
+        r = ComputeRequirements.parse(" gpu:count=2 ; ; ram_mb=1024 ")
+        assert r.gpu[0].count == 2
+        assert r.ram_mb == 1024
+
+    def test_exact_and_range_memory_conflict(self):
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:memory_mb=100;gpu:memory_mb_min=50")
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:memory_mb_max=100;gpu:memory_mb=50")
+
+    def test_min_greater_than_max_rejected(self):
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:memory_mb_max=100;gpu:memory_mb_min=200")
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:total_memory_max=10;gpu:total_memory_min=20")
+
+    def test_unknown_key(self):
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("bogus=1")
+
+    def test_invalid_pair(self):
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:count")
+
+    def test_invalid_int(self):
+        with pytest.raises(RequirementsParseError):
+            ComputeRequirements.parse("gpu:count=abc")
+
+    def test_roundtrip_dict(self):
+        r = ComputeRequirements.parse("gpu:count=8;gpu:model=H100;ram_mb=1")
+        r2 = ComputeRequirements.from_dict(r.to_dict())
+        assert r2 == r
+
+
+class TestMeets:
+    def test_simple_pass(self):
+        s = specs(gpu_count=8, gpu_model="NVIDIA H100 80GB HBM3", gpu_mem=81000,
+                  cores=64, ram=131072, storage=2000)
+        r = ComputeRequirements.parse(
+            "gpu:count=8;gpu:model=H100;gpu:memory_mb=80000;cpu:cores=32;ram_mb=65536"
+        )
+        assert s.meets(r)
+
+    def test_gpu_count_exact(self):
+        s = specs(gpu_count=4)
+        assert not s.meets(ComputeRequirements.parse("gpu:count=8"))
+        assert s.meets(ComputeRequirements.parse("gpu:count=4"))
+        # more GPUs than required still fails: exact-count semantics
+        assert not specs(gpu_count=16).meets(ComputeRequirements.parse("gpu:count=8"))
+
+    def test_gpu_or_logic(self):
+        s = specs(gpu_count=4, gpu_model="A100")
+        r = ComputeRequirements.parse("gpu:count=8;gpu:model=H100;gpu:count=4;gpu:model=A100")
+        assert s.meets(r)
+        s2 = specs(gpu_count=2, gpu_model="A100")
+        assert not s2.meets(r)
+
+    def test_no_gpu_but_required(self):
+        assert not specs(cores=8).meets(ComputeRequirements.parse("gpu:count=1"))
+
+    def test_gpu_not_required(self):
+        assert specs(cores=8).meets(ComputeRequirements.parse("cpu:cores=4"))
+
+    def test_model_fuzzy_match(self):
+        s = specs(gpu_count=1, gpu_model="NVIDIA GeForce RTX 4090")
+        assert s.meets(ComputeRequirements.parse("gpu:count=1;gpu:model=RTX 4090"))
+        assert s.meets(ComputeRequirements.parse("gpu:count=1;gpu:model=rtx_4090"))
+        assert not s.meets(ComputeRequirements.parse("gpu:count=1;gpu:model=H100"))
+
+    def test_model_csv_alternatives(self):
+        s = specs(gpu_count=1, gpu_model="A100-SXM4-80GB")
+        assert s.meets(ComputeRequirements.parse("gpu:count=1;gpu:model=H100, A100"))
+
+    def test_memory_ranges(self):
+        s = specs(gpu_count=1, gpu_mem=24000)
+        assert s.meets(ComputeRequirements.parse("gpu:count=1;gpu:memory_mb_min=20000"))
+        assert not s.meets(ComputeRequirements.parse("gpu:count=1;gpu:memory_mb_min=30000"))
+        assert s.meets(ComputeRequirements.parse("gpu:count=1;gpu:memory_mb_max=30000"))
+        assert not s.meets(ComputeRequirements.parse("gpu:count=1;gpu:memory_mb_max=20000"))
+
+    def test_total_memory(self):
+        s = specs(gpu_count=8, gpu_mem=80000)
+        assert s.meets(ComputeRequirements.parse("gpu:count=8;gpu:total_memory_min=600000"))
+        assert not s.meets(ComputeRequirements.parse("gpu:count=8;gpu:total_memory_min=700000"))
+        assert not s.meets(ComputeRequirements.parse("gpu:count=8;gpu:total_memory_max=600000"))
+
+    def test_total_memory_skipped_without_count(self):
+        # total-memory constraints only bind when count AND memory present
+        s = ComputeSpecs(gpu=GpuSpecs(memory_mb=80000))
+        assert s.meets(ComputeRequirements.parse("gpu:total_memory_min=700000"))
+
+    def test_ram_storage(self):
+        s = specs(ram=1024, storage=10)
+        assert s.meets(ComputeRequirements.parse("ram_mb=1024;storage_gb=10"))
+        assert not s.meets(ComputeRequirements.parse("ram_mb=2048"))
+        assert not s.meets(ComputeRequirements.parse("storage_gb=20"))
+        assert not ComputeSpecs().meets(ComputeRequirements.parse("ram_mb=1"))
+
+    def test_cpu_missing(self):
+        assert not ComputeSpecs().meets(ComputeRequirements.parse("cpu:cores=1"))
+
+
+class TestTask:
+    def test_state_parse(self):
+        assert TaskState.parse("RUNNING") is TaskState.RUNNING
+        assert TaskState.parse("garbage") is TaskState.UNKNOWN
+
+    def test_from_request(self):
+        t = Task.from_request(TaskRequest(image="img", name="n"))
+        assert t.state is TaskState.PENDING
+        assert t.created_at > 0
+        assert t.id
+
+    def test_volume_mount_validation(self):
+        VolumeMount("/data/${TASK_ID}", "/mnt").validate()
+        with pytest.raises(ValueError):
+            VolumeMount("/data/${BAD_VAR}", "/mnt").validate()
+        with pytest.raises(ValueError):
+            VolumeMount("", "/mnt").validate()
+
+    def test_volume_mount_expansion(self):
+        vm = VolumeMount("/d/${TASK_ID}/${NODE_ADDRESS}", "/m/${TASK_ID}")
+        out = vm.replace_labels("tid", "0xabc")
+        assert out.host_path == "/d/tid/0xabc"
+        assert out.container_path == "/m/tid"
+
+    def test_storage_config_validation(self):
+        StorageConfig("${ORIGINAL_NAME}-${NODE_GROUP_INDEX}").validate()
+        with pytest.raises(ValueError):
+            StorageConfig("${NOPE}").validate()
+
+    def test_config_hash_stability(self):
+        t1 = Task(image="i", env_vars={"a": "1", "b": "2"})
+        t2 = Task(image="i", env_vars={"b": "2", "a": "1"})
+        assert t1.generate_config_hash() == t2.generate_config_hash()
+        t3 = Task(image="i", env_vars={"a": "1", "b": "3"})
+        assert t1.generate_config_hash() != t3.generate_config_hash()
+
+    def test_json_roundtrip(self):
+        t = Task.from_request(
+            TaskRequest(
+                image="img", name="n", env_vars={"K": "V"}, cmd=["run"],
+                volume_mounts=[VolumeMount("/h", "/c")],
+            )
+        )
+        t2 = Task.from_json(t.to_json())
+        assert t2.to_dict() == t.to_dict()
+
+
+class TestNode:
+    def test_json_roundtrip(self):
+        n = Node(
+            id="0x1", provider_address="0x2", ip_address="1.2.3.4", port=8091,
+            compute_pool_id=0,
+            compute_specs=specs(gpu_count=2, gpu_model="H100", gpu_mem=80000, cores=8, ram=1024),
+        )
+        n2 = Node.from_json(n.to_json())
+        assert n2.to_dict() == n.to_dict()

@@ -1,0 +1,163 @@
+"""Regression tests for review findings: fallback gating, config validation,
+solve throttling, wire-path memory bounds, (0,0) locations."""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.models import (
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    NodeLocation,
+    SchedulingConfig,
+    Task,
+    TaskRequest,
+)
+from protocol_tpu.models.node import ComputeRequirements, GpuRequirements
+from protocol_tpu.ops.encoding import FeatureEncoder, compat_mask
+from protocol_tpu.sched import Scheduler, TpuBatchMatcher
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+from tests.test_scheduler import mk_node, mk_task
+
+
+def test_fallback_does_not_bypass_requirements():
+    """A node the batch solve covered but left unassigned stays idle instead
+    of receiving a requirement-gated task via the greedy fallback."""
+    ctx = StoreContext.new_test()
+    ctx.node_store.add_node(mk_node("0xa100", gpu_model="A100", gpu_count=8))
+    gated = mk_task(
+        "h100-only",
+        created_at=100,
+        sched_plugins={"tpu_scheduler": {"compute_requirements": ["gpu:model=H100"]}},
+    )
+    ctx.task_store.add_task(gated)
+    sched = Scheduler(ctx, batch_matcher=TpuBatchMatcher(ctx))
+    assert sched.get_task_for_node("0xa100") is None
+
+
+def test_fallback_respects_replica_bound():
+    ctx = StoreContext.new_test()
+    for i in range(5):
+        ctx.node_store.add_node(mk_node(f"0x{i}", gpu_model="H100", gpu_count=8))
+    bounded = mk_task(
+        "bounded", created_at=100, sched_plugins={"tpu_scheduler": {"replicas": ["2"]}}
+    )
+    ctx.task_store.add_task(bounded)
+    sched = Scheduler(ctx, batch_matcher=TpuBatchMatcher(ctx))
+    got = [sched.get_task_for_node(f"0x{i}") for i in range(5)]
+    assert sum(1 for t in got if t is not None) == 2
+
+
+def test_uncovered_node_still_falls_back():
+    """Nodes the batch never considered (e.g. added after the solve, below
+    the dirty threshold) fall through to the greedy chain."""
+    ctx = StoreContext.new_test()
+    ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
+    ctx.task_store.add_task(mk_task("t", created_at=100))
+    matcher = TpuBatchMatcher(ctx, min_solve_interval=3600)
+    sched = Scheduler(ctx, batch_matcher=matcher)
+    assert sched.get_task_for_node("0xa").name == "t"
+    # new node arrives; matcher throttled -> not covered -> greedy fallback
+    ctx.node_store.add_node(mk_node("0xlate", gpu_model="H100", gpu_count=8))
+    assert sched.get_task_for_node("0xlate").name == "t"
+
+
+def test_malformed_plugin_config_rejected_at_creation():
+    with pytest.raises(ValueError):
+        Task.from_request(
+            TaskRequest(
+                image="x",
+                name="bad-reqs",
+                scheduling_config=SchedulingConfig(
+                    plugins={"tpu_scheduler": {"compute_requirements": ["gpu:count=abc"]}}
+                ),
+            )
+        )
+    with pytest.raises(ValueError):
+        Task.from_request(
+            TaskRequest(
+                image="x",
+                name="bad-replicas",
+                scheduling_config=SchedulingConfig(
+                    plugins={"tpu_scheduler": {"replicas": ["two"]}}
+                ),
+            )
+        )
+    with pytest.raises(ValueError):
+        Task.from_request(
+            TaskRequest(
+                image="x",
+                name="zero-replicas",
+                scheduling_config=SchedulingConfig(
+                    plugins={"tpu_scheduler": {"replicas": ["0"]}}
+                ),
+            )
+        )
+
+
+def test_malformed_config_in_store_skipped_not_crashing():
+    """Direct store writes bypassing from_request must not break refresh()."""
+    ctx = StoreContext.new_test()
+    ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
+    bad = mk_task(
+        "bad", created_at=200,
+        sched_plugins={"tpu_scheduler": {"compute_requirements": ["gpu:count=abc"]}},
+    )
+    good = mk_task("good", created_at=100)
+    ctx.task_store.add_task(bad)
+    ctx.task_store.add_task(good)
+    matcher = TpuBatchMatcher(ctx)
+    matcher.refresh()  # must not raise
+    node = ctx.node_store.get_node("0xa")
+    assert matcher.task_for_node(node).name == "good"
+
+
+def test_solve_throttle_bounds_refresh_rate():
+    ctx = StoreContext.new_test()
+    ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
+    clock = [1000.0]
+    matcher = TpuBatchMatcher(ctx, min_solve_interval=10.0, time_fn=lambda: clock[0])
+    matcher.attach_observers()
+    sched = Scheduler(ctx, batch_matcher=matcher)
+
+    solves = []
+    orig = matcher.refresh
+
+    def counting_refresh():
+        solves.append(clock[0])
+        orig()
+
+    matcher.refresh = counting_refresh
+    for i in range(5):
+        ctx.task_store.add_task(mk_task(f"t{i}", created_at=i))
+        clock[0] += 0.01
+        sched.get_task_for_node("0xa")
+    assert len(solves) == 1  # throttled: one solve despite 5 dirty events
+    clock[0] += 11
+    sched.get_task_for_node("0xa")
+    assert len(solves) == 2  # dirty + interval elapsed -> re-solve
+
+
+def test_wire_path_memory_bounds_parity():
+    """memory_mb and memory_mb_min both set via from_dict: the stricter bound
+    wins on device, matching host meets()."""
+    req = ComputeRequirements(
+        gpu=[GpuRequirements.from_dict({"count": 1, "memory_mb": 16000, "memory_mb_min": 24000})]
+    )
+    spec = ComputeSpecs(gpu=GpuSpecs(count=1, memory_mb=20000))
+    assert spec.meets(req) is False
+    enc = FeatureEncoder()
+    ep = enc.encode_providers([spec])
+    er = enc.encode_requirements([req])
+    assert not bool(np.asarray(compat_mask(ep, er))[0, 0])
+
+
+def test_zero_zero_location_is_real():
+    enc = FeatureEncoder()
+    ep = enc.encode_providers(
+        [ComputeSpecs(), ComputeSpecs()],
+        locations=[NodeLocation(latitude=0.0, longitude=0.0), None],
+    )
+    assert bool(np.asarray(ep.has_location)[0])
+    assert not bool(np.asarray(ep.has_location)[1])

@@ -1,0 +1,158 @@
+"""Scheduler tests: greedy-chain parity with the reference's semantics and
+TPU batch-matcher behavior (bounded replicas + unbounded swarm tasks)."""
+
+import numpy as np
+
+from protocol_tpu.models import (
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    SchedulingConfig,
+    Task,
+    TaskState,
+    VolumeMount,
+)
+from protocol_tpu.sched import Scheduler, TpuBatchMatcher, expand_task_for_node
+from protocol_tpu.sched.scheduler import NewestTaskPlugin
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+
+def mk_node(addr, status=NodeStatus.HEALTHY, gpu_model=None, gpu_count=None):
+    specs = None
+    if gpu_model is not None:
+        specs = ComputeSpecs(
+            gpu=GpuSpecs(count=gpu_count, model=gpu_model, memory_mb=80000),
+            cpu=CpuSpecs(cores=32),
+            ram_mb=65536,
+            storage_gb=1000,
+        )
+    return OrchestratorNode(address=addr, status=status, compute_specs=specs)
+
+
+def mk_task(name, created_at, sched_plugins=None):
+    return Task(
+        name=name,
+        image="img",
+        created_at=created_at,
+        state=TaskState.PENDING,
+        scheduling_config=SchedulingConfig(plugins=sched_plugins) if sched_plugins else None,
+    )
+
+
+class TestGreedyChain:
+    def test_newest_task_wins(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xa"))
+        old = mk_task("old", created_at=100)
+        new = mk_task("new", created_at=200)
+        ctx.task_store.add_task(old)
+        ctx.task_store.add_task(new)
+        sched = Scheduler(ctx)
+        got = sched.get_task_for_node("0xa")
+        assert got.name == "new"
+
+    def test_no_tasks(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xa"))
+        assert Scheduler(ctx).get_task_for_node("0xa") is None
+
+    def test_unknown_node(self):
+        ctx = StoreContext.new_test()
+        assert Scheduler(ctx).get_task_for_node("0xmissing") is None
+
+    def test_env_cmd_volume_expansion(self):
+        t = Task(
+            name="t",
+            image="img",
+            env_vars={"OUT": "/data/${TASK_ID}/${NODE_ADDRESS}"},
+            cmd=["run", "--id=${TASK_ID}"],
+            volume_mounts=[VolumeMount("/h/${TASK_ID}", "/c")],
+        )
+        out = expand_task_for_node(t, "0xabc")
+        assert out.env_vars["OUT"] == f"/data/{t.id}/0xabc"
+        assert out.cmd[1] == f"--id={t.id}"
+        assert out.volume_mounts[0].host_path == f"/h/{t.id}"
+        # original untouched
+        assert "${TASK_ID}" in t.env_vars["OUT"]
+
+
+class TestTpuBatchMatcher:
+    def test_unbounded_newest_parity(self):
+        """With default weights (priority-dominant) the batch matcher gives
+        every node the newest compatible task — the reference's behavior."""
+        ctx = StoreContext.new_test()
+        for i in range(4):
+            ctx.node_store.add_node(mk_node(f"0x{i}", gpu_model="H100", gpu_count=8))
+        ctx.task_store.add_task(mk_task("old", created_at=100))
+        newest = mk_task("new", created_at=200)
+        ctx.task_store.add_task(newest)
+
+        matcher = TpuBatchMatcher(ctx)
+        sched = Scheduler(ctx, batch_matcher=matcher)
+        for i in range(4):
+            got = sched.get_task_for_node(f"0x{i}")
+            assert got is not None and got.name == "new"
+
+    def test_compute_requirements_gate(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xh", gpu_model="H100", gpu_count=8))
+        ctx.node_store.add_node(mk_node("0xa", gpu_model="A100", gpu_count=8))
+        h100_task = mk_task(
+            "h100-only",
+            created_at=300,
+            sched_plugins={
+                "tpu_scheduler": {"compute_requirements": ["gpu:count=8;gpu:model=H100"]}
+            },
+        )
+        any_task = mk_task("any", created_at=100)
+        ctx.task_store.add_task(any_task)
+        ctx.task_store.add_task(h100_task)
+
+        matcher = TpuBatchMatcher(ctx)
+        sched = Scheduler(ctx, batch_matcher=matcher)
+        assert sched.get_task_for_node("0xh").name == "h100-only"
+        assert sched.get_task_for_node("0xa").name == "any"
+
+    def test_bounded_replicas(self):
+        """A 2-replica task absorbs exactly 2 nodes; the rest fall to the
+        unbounded task."""
+        ctx = StoreContext.new_test()
+        for i in range(5):
+            ctx.node_store.add_node(mk_node(f"0x{i}", gpu_model="H100", gpu_count=8))
+        bounded = mk_task(
+            "bounded",
+            created_at=300,
+            sched_plugins={"tpu_scheduler": {"replicas": ["2"]}},
+        )
+        swarm = mk_task("swarm", created_at=100)
+        ctx.task_store.add_task(swarm)
+        ctx.task_store.add_task(bounded)
+
+        matcher = TpuBatchMatcher(ctx)
+        matcher.refresh()
+        names = []
+        for i in range(5):
+            node = ctx.node_store.get_node(f"0x{i}")
+            names.append(matcher.task_for_node(node).name)
+        assert names.count("bounded") == 2
+        assert names.count("swarm") == 3
+
+    def test_dirty_on_task_change(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
+        matcher = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        matcher.attach_observers()
+        sched = Scheduler(ctx, batch_matcher=matcher)
+        assert sched.get_task_for_node("0xa") is None
+        t = mk_task("late", created_at=100)
+        ctx.task_store.add_task(t)
+        got = sched.get_task_for_node("0xa")
+        assert got is not None and got.name == "late"
+
+    def test_no_schedulable_nodes(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xa", status=NodeStatus.DEAD))
+        ctx.task_store.add_task(mk_task("t", created_at=1))
+        matcher = TpuBatchMatcher(ctx)
+        matcher.refresh()
+        assert matcher.last_solve_stats["nodes"] == 0

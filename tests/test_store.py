@@ -1,0 +1,212 @@
+"""KV engine + domain store tests (hermetic per-test stores, mirroring the
+reference's embedded-redis fixtures)."""
+
+import json
+
+from protocol_tpu.models import HeartbeatRequest, MetricEntry, MetricKey, Task, TaskState
+from protocol_tpu.store import (
+    KVStore,
+    NodeStatus,
+    OrchestratorNode,
+    StoreContext,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestKV:
+    def test_set_get_delete(self):
+        kv = KVStore()
+        assert kv.set("a", "1")
+        assert kv.get("a") == "1"
+        assert kv.delete("a") == 1
+        assert kv.get("a") is None
+
+    def test_set_nx(self):
+        kv = KVStore()
+        assert kv.set("k", "1", nx=True)
+        assert not kv.set("k", "2", nx=True)
+        assert kv.get("k") == "1"
+
+    def test_ttl_expiry(self):
+        clock = FakeClock()
+        kv = KVStore(time_fn=clock)
+        kv.set("k", "v", ex=60)
+        assert kv.get("k") == "v"
+        clock.advance(61)
+        assert kv.get("k") is None
+        assert not kv.exists("k")
+
+    def test_set_clears_ttl(self):
+        clock = FakeClock()
+        kv = KVStore(time_fn=clock)
+        kv.set("k", "v", ex=10)
+        kv.set("k", "v2")
+        clock.advance(100)
+        assert kv.get("k") == "v2"
+
+    def test_incr(self):
+        kv = KVStore()
+        assert kv.incr("c") == 1
+        assert kv.incr("c") == 2
+
+    def test_hash_ops(self):
+        kv = KVStore()
+        kv.hset("h", "f1", "a")
+        kv.hset_mapping("h", {"f2": "b", "f3": "c"})
+        assert kv.hget("h", "f2") == "b"
+        assert kv.hgetall("h") == {"f1": "a", "f2": "b", "f3": "c"}
+        assert kv.hdel("h", "f1", "nope") == 1
+        assert kv.hincrby("h", "n", 5) == 5
+
+    def test_set_ops(self):
+        kv = KVStore()
+        assert kv.sadd("s", "a", "b") == 2
+        assert kv.sadd("s", "b", "c") == 1
+        assert kv.smembers("s") == {"a", "b", "c"}
+        assert kv.sismember("s", "a")
+        assert kv.srem("s", "a") == 1
+        assert kv.scard("s") == 2
+
+    def test_zset_ops(self):
+        kv = KVStore()
+        kv.zadd("z", {"a": 3.0, "b": 1.0, "c": 2.0})
+        assert kv.zrangebyscore("z", 1.5, 3.5) == [("c", 2.0), ("a", 3.0)]
+        assert kv.zscore("z", "b") == 1.0
+        assert kv.zremrangebyscore("z", 0, 2.0) == 2
+        assert kv.zcard("z") == 1
+
+    def test_list_ops(self):
+        kv = KVStore()
+        kv.rpush("l", "a", "b")
+        kv.lpush("l", "z")
+        assert kv.lrange("l") == ["z", "a", "b"]
+        assert kv.lrange("l", 0, 1) == ["z", "a"]
+        assert kv.lrem("l", 0, "a") == 1
+        assert kv.llen("l") == 2
+
+    def test_wrongtype(self):
+        kv = KVStore()
+        kv.set("k", "v")
+        import pytest
+
+        with pytest.raises(TypeError):
+            kv.hset("k", "f", "v")
+
+    def test_keys_pattern(self):
+        kv = KVStore()
+        kv.set("node:1", "a")
+        kv.set("node:2", "b")
+        kv.set("task:1", "c")
+        assert sorted(kv.keys("node:*")) == ["node:1", "node:2"]
+
+
+class TestNodeStore:
+    def test_add_get_roundtrip(self):
+        ctx = StoreContext.new_test()
+        n = OrchestratorNode(address="0xa", ip_address="1.1.1.1", port=80)
+        ctx.node_store.add_node(n)
+        got = ctx.node_store.get_node("0xa")
+        assert got.address == "0xa"
+        assert got.status == NodeStatus.DISCOVERED
+        assert len(ctx.node_store.get_nodes()) == 1
+
+    def test_status_transition_stamps_time(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(OrchestratorNode(address="0xa"))
+        ctx.node_store.update_node_status("0xa", NodeStatus.HEALTHY)
+        got = ctx.node_store.get_node("0xa")
+        assert got.status == NodeStatus.HEALTHY
+        assert got.last_status_change is not None
+
+    def test_uninvited(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(OrchestratorNode(address="0xa"))
+        ctx.node_store.add_node(
+            OrchestratorNode(address="0xb", status=NodeStatus.HEALTHY)
+        )
+        assert [n.address for n in ctx.node_store.get_uninvited_nodes()] == ["0xa"]
+
+    def test_remove(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(OrchestratorNode(address="0xa"))
+        ctx.node_store.remove_node("0xa")
+        assert ctx.node_store.get_node("0xa") is None
+        assert ctx.node_store.get_nodes() == []
+
+
+class TestTaskStore:
+    def test_crud_and_observers(self):
+        ctx = StoreContext.new_test()
+        created, deleted = [], []
+        ctx.task_store.subscribe_created(lambda t: created.append(t.id))
+        ctx.task_store.subscribe_deleted(lambda t: deleted.append(t.id))
+
+        t = Task(name="t1", image="img")
+        ctx.task_store.add_task(t)
+        assert created == [t.id]
+        assert ctx.task_store.name_exists("t1")
+        assert ctx.task_store.get_task(t.id).name == "t1"
+        assert len(ctx.task_store.get_all_tasks()) == 1
+
+        ctx.task_store.delete_task(t.id)
+        assert deleted == [t.id]
+        assert ctx.task_store.get_task(t.id) is None
+        assert not ctx.task_store.name_exists("t1")
+
+    def test_ordering_preserved(self):
+        ctx = StoreContext.new_test()
+        ids = []
+        for i in range(5):
+            t = Task(name=f"t{i}", image="img", created_at=i)
+            ctx.task_store.add_task(t)
+            ids.append(t.id)
+        assert [t.id for t in ctx.task_store.get_all_tasks()] == ids
+
+
+class TestHeartbeatStore:
+    def test_beat_ttl(self):
+        clock = FakeClock()
+        kv = KVStore(time_fn=clock)
+        ctx = StoreContext(kv)
+        hb = HeartbeatRequest(address="0xa", task_state="RUNNING")
+        ctx.heartbeat_store.beat(hb)
+        assert ctx.heartbeat_store.get_heartbeat("0xa").task_state == "RUNNING"
+        clock.advance(91)
+        assert ctx.heartbeat_store.get_heartbeat("0xa") is None
+
+    def test_unhealthy_counter(self):
+        ctx = StoreContext.new_test()
+        assert ctx.heartbeat_store.increment_unhealthy_counter("0xa") == 1
+        assert ctx.heartbeat_store.increment_unhealthy_counter("0xa") == 2
+        assert ctx.heartbeat_store.get_unhealthy_counter("0xa") == 2
+        ctx.heartbeat_store.clear_unhealthy_counter("0xa")
+        assert ctx.heartbeat_store.get_unhealthy_counter("0xa") == 0
+
+
+class TestMetricsStore:
+    def test_store_and_fetch(self):
+        ctx = StoreContext.new_test()
+        e = MetricEntry(key=MetricKey(task_id="t1", label="loss"), value=0.5)
+        ctx.metrics_store.store_metrics([e], "0xa")
+        got = ctx.metrics_store.get_metrics_for_task("t1")
+        assert got == {"loss": {"0xa": 0.5}}
+
+    def test_delete_for_node(self):
+        ctx = StoreContext.new_test()
+        e = MetricEntry(key=MetricKey(task_id="t1", label="loss"), value=0.5)
+        ctx.metrics_store.store_metrics([e], "0xa")
+        ctx.metrics_store.store_metrics([e], "0xb")
+        ctx.metrics_store.delete_metrics_for_node("0xa")
+        assert ctx.metrics_store.get_metrics_for_task("t1") == {"loss": {"0xb": 0.5}}
+        ctx.metrics_store.delete_metrics_for_node("0xb")
+        assert ctx.metrics_store.get_all_metrics() == {}
