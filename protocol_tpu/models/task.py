@@ -143,6 +143,8 @@ def _validate_tpu_scheduler_plugin(cfg: "SchedulingConfig") -> None:
         return
     reps = plug.get("replicas")
     if reps:
+        if not isinstance(reps[0], (str, int)):
+            raise ValueError(f"invalid tpu_scheduler replicas: {reps[0]!r}")
         try:
             r = int(reps[0])
         except ValueError:
@@ -151,6 +153,10 @@ def _validate_tpu_scheduler_plugin(cfg: "SchedulingConfig") -> None:
             raise ValueError(f"tpu_scheduler replicas must be positive, got {r}")
     reqs = plug.get("compute_requirements")
     if reqs:
+        if not isinstance(reqs[0], str):
+            raise ValueError(
+                f"invalid tpu_scheduler compute_requirements: {reqs[0]!r}"
+            )
         from protocol_tpu.models.node import ComputeRequirements
 
         ComputeRequirements.parse(reqs[0])

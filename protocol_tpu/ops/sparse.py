@@ -1,0 +1,267 @@
+"""Sparse (top-K candidate) assignment: the 1M-scale architecture.
+
+A dense [P, T] cost tensor at 1M x 1M is ~4 TB — unrepresentable. But the
+matching only ever uses each task's few best compatible providers, so the
+pipeline splits:
+
+  candidates_topk   one streaming pass over the cost tensor in task tiles
+                    (lax.scan; [P, tile] per step, never materializing
+                    [P, T]) emitting each task's K cheapest compatible
+                    providers -> cand_provider/cand_cost [T, K].
+  assign_auction_sparse
+                    Bertsekas auction restricted to the candidate graph:
+                    per-round work is O(T*K) gathers + scatter-max winner
+                    resolution over the price vector [P] — independent of
+                    P*T. Deterministic ties (lowest provider / lowest task).
+
+With K ~ 32-128 the restricted matching is near-always optimal for
+marketplace-shaped costs (many similar providers), while per-iteration HBM
+traffic drops from O(P*T) to O(T*K): the difference between 2 s and
+milliseconds at 8k x 8k, and the only viable shape at 1M x 1M.
+
+Replaces: the reference's O(tasks)-per-heartbeat greedy walk
+(crates/orchestrator/src/scheduler/mod.rs:26-74), at the scale ladder of
+BASELINE.md configs #3-#5.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from protocol_tpu.ops.assign import AssignResult, _invert
+from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
+
+_NEG = jnp.float32(-1e18)
+
+
+def _slice_requirements(r: EncodedRequirements, start: int, size: int) -> EncodedRequirements:
+    """Static-size tile of the requirements pytree along the task axis."""
+    return jax.tree.map(
+        lambda leaf: lax.dynamic_slice_in_dim(leaf, start, size, axis=0), r
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "tile"))
+def candidates_topk(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    weights: CostWeights | None = None,
+    k: int = 64,
+    tile: int = 1024,
+) -> tuple[jax.Array, jax.Array]:
+    """Each task's top-k cheapest compatible providers.
+
+    Streams the cost tensor in [P, tile] blocks inside a lax.scan — peak
+    memory O(P * tile), suitable for P up to ~1M with tile sized to fit.
+    Returns (cand_provider i32 [T, k] with -1 padding, cand_cost f32 [T, k]).
+    T must be divisible by tile (pad the requirements first).
+    """
+    if weights is None:
+        weights = CostWeights()
+    T = er.cpu_cores.shape[0]
+    if T % tile != 0:
+        raise ValueError(f"T={T} not divisible by tile={tile}; pad requirements")
+    n_tiles = T // tile
+
+    def step(carry, t0):
+        r_tile = _slice_requirements(er, t0, tile)
+        cost, _mask = cost_matrix(ep, r_tile, weights)  # [P, tile]
+        neg, idx = lax.top_k(-cost.T, k)  # [tile, k] best (lowest cost) first
+        cost_k = -neg
+        provider = jnp.where(cost_k < INFEASIBLE * 0.5, idx.astype(jnp.int32), -1)
+        return carry, (provider, cost_k)
+
+    _, (cand_p, cand_c) = lax.scan(
+        step, None, jnp.arange(n_tiles, dtype=jnp.int32) * tile
+    )
+    return cand_p.reshape(T, k), cand_c.reshape(T, k)
+
+
+@partial(jax.jit, static_argnames=("num_providers", "max_iters", "frontier", "retire"))
+def assign_auction_sparse(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    eps: float | jax.Array = 0.01,
+    max_iters: int = 10000,
+    frontier: int = 4096,
+    retire: bool = True,
+) -> AssignResult:
+    """Auction on the candidate graph, Gauss-Seidel style.
+
+    The naive Jacobi round re-gathers prices for ALL tasks' candidates every
+    iteration — a [T, K] dynamic gather that dominates wall-clock on TPU
+    (~17 ms at 32k x 64; gathers can't be hoisted because prices change).
+    Instead each round processes a fixed-size *frontier* of up to
+    ``frontier`` unassigned tasks: total gather traffic scales with the
+    number of bid events (~O(T) for marketplace costs), not rounds x T.
+    Bertsekas auction is correct for any nonempty subset of unassigned
+    bidders per round, so this changes which eps-optimal matching is found
+    (tie outcomes), not feasibility or quality. Set ``frontier >= T`` to
+    recover the dense-parity Jacobi schedule.
+
+    ``retire=True`` stops tasks whose best achievable value has been bid
+    below -(2*max_cost + 10): economically "not worth it", and the
+    termination guard against infinite eviction cycles when demand exceeds
+    the candidate graph's capacity.
+
+    For contended problems prefer :func:`assign_auction_sparse_scaled`:
+    with a single small eps, every over-demanded provider's price must climb
+    to the give-up level in eps-sized steps (millions of bid events at 32k);
+    eps-scaling covers the same price range geometrically.
+    """
+    state = _sparse_auction_phase(
+        cand_provider, cand_cost, num_providers, None,
+        eps=eps, max_iters=max_iters, frontier=frontier, retire=retire,
+    )
+    p4t = state[3]
+    return AssignResult(p4t, _invert(p4t, num_providers))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_providers", "max_iters", "frontier", "retire"),
+)
+def _sparse_auction_phase(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    state: tuple | None,
+    eps: float | jax.Array = 0.01,
+    max_iters: int = 10000,
+    frontier: int = 4096,
+    retire: bool = True,
+):
+    """One eps phase of the frontier auction; ``state`` carries
+    (it, price, owner, p4t, retired) across phases for warm starts."""
+    T, K = cand_cost.shape
+    P = num_providers
+    B = min(frontier, T)
+
+    cand_valid = cand_provider >= 0
+    value_base = jnp.where(cand_valid, -cand_cost, _NEG)  # [T, K]
+    task_feasible = jnp.any(cand_valid, axis=1)
+    cand_safe = jnp.where(cand_valid, cand_provider, 0)
+    finite_max = jnp.max(jnp.where(cand_valid, cand_cost, 0.0))
+    give_up = -(2.0 * finite_max + 10.0) if retire else _NEG
+
+    def cond(state):
+        it, price, owner, p4t, retired = state
+        return (it < max_iters) & jnp.any((p4t < 0) & task_feasible & ~retired)
+
+    def body(state):
+        it, price, owner, p4t, retired = state
+        open_mask = (p4t < 0) & task_feasible & ~retired  # [T]
+
+        # ---- frontier selection: up to B open tasks (fill = T -> dropped)
+        f_idx = jnp.flatnonzero(open_mask, size=B, fill_value=T).astype(jnp.int32)
+        f_ok = f_idx < T
+        f_safe = jnp.where(f_ok, f_idx, 0)
+
+        cp = cand_safe[f_safe]  # [B, K] (static-index row gather)
+        vb = value_base[f_safe]
+        value = vb - price[cp]  # [B, K] — the only dynamic gather that scales
+        k1 = jnp.argmax(value, axis=1).astype(jnp.int32)
+        v1 = jnp.take_along_axis(value, k1[:, None], axis=1)[:, 0]
+        v2 = jnp.max(
+            jnp.where(jnp.arange(K)[None, :] == k1[:, None], _NEG, value), axis=1
+        )
+        v2 = jnp.maximum(v2, jnp.float32(-1e8))
+        p1 = jnp.take_along_axis(cp, k1[:, None], axis=1)[:, 0]
+
+        newly_retired = f_ok & (v1 < give_up)
+        retired = retired.at[jnp.where(newly_retired, f_idx, T)].set(True, mode="drop")
+
+        bidding = f_ok & ~newly_retired & (v1 > _NEG * 0.5)
+        bid_amt = price[p1] + (v1 - v2) + eps  # [B]
+        tgt = jnp.where(bidding, p1, P)
+
+        win_bid = jnp.full(P, _NEG).at[tgt].max(
+            jnp.where(bidding, bid_amt, _NEG), mode="drop"
+        )
+        # among max bidders per provider, lowest task index wins
+        is_winner_bid = bidding & (bid_amt >= win_bid[p1])
+        win_task = jnp.full(P, T, jnp.int32).at[tgt].min(
+            jnp.where(is_winner_bid, f_idx, T), mode="drop"
+        )
+        got_bid = (win_bid > _NEG * 0.5) & (win_task < T)
+
+        evict_t = jnp.where(got_bid & (owner >= 0), owner, T)
+        p4t = p4t.at[evict_t].set(-1, mode="drop")
+        p_idx = jnp.arange(P, dtype=jnp.int32)
+        win_t_safe = jnp.where(got_bid, win_task, T)
+        p4t = p4t.at[win_t_safe].set(jnp.where(got_bid, p_idx, -1), mode="drop")
+        owner = jnp.where(got_bid, win_task, owner)
+        price = jnp.where(got_bid, win_bid, price)
+        return it + 1, price, owner, p4t, retired
+
+    if state is None:
+        state = (
+            jnp.int32(0),
+            jnp.zeros(P, jnp.float32),
+            jnp.full(P, -1, jnp.int32),
+            jnp.full(T, -1, jnp.int32),
+            jnp.zeros(T, bool),
+        )
+    else:
+        # reset the iteration counter for this phase
+        state = (jnp.int32(0),) + tuple(state[1:])
+    return lax.while_loop(cond, body, state)
+
+
+def assign_auction_sparse_scaled(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    eps_start: float = 4.0,
+    eps_end: float = 0.02,
+    scale: float = 0.25,
+    max_iters_per_phase: int = 4000,
+    frontier: int = 4096,
+) -> AssignResult:
+    """eps-scaling auction: geometric eps ladder with prices, assignment and
+    retirement warm-started phase to phase (Bertsekas' eps-scaling — total
+    bid events O(n log(1/eps)) instead of O(price_range / eps))."""
+    T = cand_cost.shape[0]
+    P = num_providers
+    state = None
+    eps = eps_start
+    while True:
+        state = _sparse_auction_phase(
+            cand_provider, cand_cost, num_providers, state,
+            eps=eps, max_iters=max_iters_per_phase, frontier=frontier,
+        )
+        if eps <= eps_end:
+            break
+        eps = max(eps * scale, eps_end)
+        # NOTE: assignments are kept across phases (Gauss-Seidel warm start),
+        # deliberately NOT the textbook reset-and-rebid: with an unfillable
+        # surplus, equilibrium prices get pumped toward the give-up level,
+        # and a phase reset at pumped prices makes *viable* holders retire
+        # en masse (their re-bid values sit below give-up). Keeping holders
+        # seated bounds the matching at coarse-eps quality for early
+        # assignments; the quality tests vs the optimal oracle keep this
+        # honest.
+    p4t = state[3]
+    return AssignResult(p4t, _invert(p4t, num_providers))
+
+
+def assign_topk(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    weights: CostWeights | None = None,
+    k: int = 64,
+    tile: int = 1024,
+    eps: float = 0.01,
+    max_iters: int = 1000,
+) -> AssignResult:
+    """Full sparse pipeline: streaming candidate generation + sparse auction."""
+    cand_p, cand_c = candidates_topk(ep, er, weights, k=k, tile=tile)
+    return assign_auction_sparse(
+        cand_p, cand_c, num_providers=ep.num, eps=eps, max_iters=max_iters
+    )

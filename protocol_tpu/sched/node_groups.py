@@ -1,0 +1,437 @@
+"""Node groups: gang scheduling / topologies.
+
+Reference: crates/orchestrator/src/plugins/node_groups/ (1,708 LoC) — the
+reference's mechanism for multi-node workloads. Behaviors kept:
+
+- ``NodeGroupConfiguration{name, min_group_size, max_group_size,
+  compute_requirements}`` (mod.rs:30-37), configs sorted larger-min-first
+  then more-specific-first (mod.rs:150-164).
+- Store schema: group blob ``node_group:{id}``, ``node_to_group`` hash,
+  ``group_task:{id}`` (SET-NX race-safe assignment, mod.rs:471-476),
+  groups index set, enabled-configs set (mod.rs:25-28, 1328-1346).
+- Management tick: form new groups from healthy+p2p+unassigned nodes with
+  Haversine proximity seeding (mod.rs:478-628, 217-255), then merge solo
+  groups (mod.rs:631-860) under a task-switching policy.
+- Task observers: creating a task enables the topologies it allows;
+  deleting it dissolves that task's groups and disables empty topologies
+  (mod.rs:1224-1326).
+- Scheduler-side filter for grouped nodes with dissolved-group recovery and
+  ``${GROUP_ID}/${GROUP_INDEX}/${GROUP_SIZE}/${NEXT_P2P_ADDRESS}(ring)/
+  ${TOTAL_UPLOAD_COUNT}/${LAST_FILE_IDX}`` expansion
+  (scheduler_impl.rs:11-210). The ring wiring is what distributed workloads
+  (e.g. ring-allreduce training) consume.
+
+TPU-first deviation: per-config node eligibility is not a per-node string
+walk — all (node, config) pairs are evaluated in ONE batched compat_mask
+call on the accelerator (the same kernel the batch matcher uses), and
+proximity ordering uses the vectorized haversine. Only the final greedy
+fill (group sizes are small) stays on host.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import json
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.models.node import ComputeRequirements
+from protocol_tpu.models.task import Task
+from protocol_tpu.ops.encoding import FeatureEncoder, compat_mask
+from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
+
+GROUP_KEY = "node_group:{}"
+NODE_TO_GROUP = "node_to_group"
+GROUP_TASK_KEY = "group_task:{}"
+GROUPS_INDEX = "orchestrator:groups_index"
+ENABLED_CONFIGS = "available_node_group_configs"
+UPLOAD_COUNTER_KEY = "upload:{}:{}:{}"  # addr, group, file
+
+
+@dataclass
+class NodeGroupConfiguration:
+    name: str
+    min_group_size: int
+    max_group_size: int
+    compute_requirements: Optional[str] = None  # requirements DSL
+
+    def parsed_requirements(self) -> ComputeRequirements:
+        if self.compute_requirements:
+            return ComputeRequirements.parse(self.compute_requirements)
+        return ComputeRequirements()
+
+    def specificity(self) -> int:
+        """Constraint count for the more-specific-first sort."""
+        r = self.parsed_requirements()
+        n = len(r.gpu)
+        n += sum(x is not None for x in (r.cpu, r.ram_mb, r.storage_gb))
+        return n
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "min_group_size": self.min_group_size,
+            "max_group_size": self.max_group_size,
+            "compute_requirements": self.compute_requirements,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeGroupConfiguration":
+        return cls(
+            name=d["name"],
+            min_group_size=int(d["min_group_size"]),
+            max_group_size=int(d["max_group_size"]),
+            compute_requirements=d.get("compute_requirements"),
+        )
+
+
+@dataclass
+class NodeGroup:
+    id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    configuration_name: str = ""
+    nodes: list[str] = field(default_factory=list)  # ordered: index = rank
+    created_at: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "configuration_name": self.configuration_name,
+            "nodes": self.nodes,
+            "created_at": self.created_at,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeGroup":
+        return cls(
+            id=d["id"],
+            configuration_name=d["configuration_name"],
+            nodes=list(d["nodes"]),
+            created_at=float(d.get("created_at", 0.0)),
+        )
+
+
+class TaskSwitchingPolicy(str, enum.Enum):
+    """Whether solo-group merging may move a node off its current task
+    (mod.rs:71-98)."""
+
+    ALWAYS = "always"
+    NEVER = "never"
+    IF_SAME_TASK = "if_same_task"
+
+
+def _haversine_km_np(lat1, lon1, lat2, lon2) -> np.ndarray:
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    a = np.sin(dlat / 2) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2) ** 2
+    return 2 * 6371.0 * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+class NodeGroupsPlugin:
+    def __init__(
+        self,
+        store: StoreContext,
+        configurations: list[NodeGroupConfiguration],
+        merge_policy: TaskSwitchingPolicy = TaskSwitchingPolicy.IF_SAME_TASK,
+        rng: Optional[random.Random] = None,
+    ):
+        self.store = store
+        self.merge_policy = merge_policy
+        self.rng = rng or random.Random()
+        self.encoder = FeatureEncoder()
+        # larger min first, then more specific requirements first
+        # (mod.rs:150-164)
+        self.configurations = sorted(
+            configurations,
+            key=lambda c: (-c.min_group_size, -c.specificity(), c.name),
+        )
+        by_name: dict[str, NodeGroupConfiguration] = {}
+        for c in self.configurations:
+            if c.name in by_name:
+                raise ValueError(f"duplicate group configuration name: {c.name}")
+            if c.min_group_size <= 0 or c.max_group_size < c.min_group_size:
+                raise ValueError(f"invalid size bounds for configuration {c.name}")
+            by_name[c.name] = c
+        self.config_by_name = by_name
+
+    # ------------- wiring -------------
+
+    def attach_observers(self) -> None:
+        self.store.task_store.subscribe_created(self.on_task_created)
+        self.store.task_store.subscribe_deleted(self.on_task_deleted)
+
+    # ------------- config enable/disable (mod.rs:1224-1326) -------------
+
+    def on_task_created(self, task: Task) -> None:
+        for topo in task.allowed_topologies():
+            if topo in self.config_by_name:
+                self.store.kv.sadd(ENABLED_CONFIGS, topo)
+
+    def on_task_deleted(self, task: Task) -> None:
+        # dissolve this task's groups
+        for group in self.get_groups():
+            tid = self.store.kv.get(GROUP_TASK_KEY.format(group.id))
+            if tid == task.id:
+                self.dissolve_group(group.id)
+        # disable topologies no remaining task allows
+        still_allowed: set[str] = set()
+        for t in self.store.task_store.get_all_tasks():
+            still_allowed.update(t.allowed_topologies())
+        for name in list(self.store.kv.smembers(ENABLED_CONFIGS)):
+            if name not in still_allowed:
+                self.store.kv.srem(ENABLED_CONFIGS, name)
+
+    def enabled_configurations(self) -> list[NodeGroupConfiguration]:
+        enabled = self.store.kv.smembers(ENABLED_CONFIGS)
+        return [c for c in self.configurations if c.name in enabled]
+
+    # ------------- group store ops -------------
+
+    def get_groups(self) -> list[NodeGroup]:
+        ids = sorted(self.store.kv.smembers(GROUPS_INDEX))
+        out = []
+        for gid in ids:
+            raw = self.store.kv.get(GROUP_KEY.format(gid))
+            if raw:
+                out.append(NodeGroup.from_dict(json.loads(raw)))
+        return out
+
+    def get_group(self, group_id: str) -> Optional[NodeGroup]:
+        raw = self.store.kv.get(GROUP_KEY.format(group_id))
+        return NodeGroup.from_dict(json.loads(raw)) if raw else None
+
+    def group_for_node(self, address: str) -> Optional[NodeGroup]:
+        gid = self.store.kv.hget(NODE_TO_GROUP, address)
+        if gid is None:
+            return None
+        group = self.get_group(gid)
+        if group is None:
+            # dissolved-group recovery (scheduler_impl.rs:90-104,
+            # mod.rs:1073-1119): stale mapping -> clear it
+            self.store.kv.hdel(NODE_TO_GROUP, address)
+            return None
+        return group
+
+    def _create_group(self, config: NodeGroupConfiguration, members: list[str]) -> NodeGroup:
+        group = NodeGroup(configuration_name=config.name, nodes=members)
+        with self.store.kv.atomic():  # mirror of the reference's pipeline
+            self.store.kv.set(GROUP_KEY.format(group.id), json.dumps(group.to_dict()))
+            self.store.kv.sadd(GROUPS_INDEX, group.id)
+            for addr in members:
+                self.store.kv.hset(NODE_TO_GROUP, addr, group.id)
+        return group
+
+    def dissolve_group(self, group_id: str) -> None:
+        with self.store.kv.atomic():
+            group = self.get_group(group_id)
+            if group is None:
+                return
+            for addr in group.nodes:
+                if self.store.kv.hget(NODE_TO_GROUP, addr) == group_id:
+                    self.store.kv.hdel(NODE_TO_GROUP, addr)
+            self.store.kv.delete(GROUP_KEY.format(group_id))
+            self.store.kv.delete(GROUP_TASK_KEY.format(group_id))
+            self.store.kv.srem(GROUPS_INDEX, group_id)
+
+    # ------------- status-change hook -------------
+
+    def handle_status_change(self, node: OrchestratorNode) -> None:
+        """A grouped node leaving Healthy dissolves its group — gang
+        semantics: the workload's ring is broken (reference status plugin
+        path)."""
+        if node.status == NodeStatus.HEALTHY:
+            return
+        group = self.group_for_node(node.address)
+        if group is not None:
+            self.dissolve_group(group.id)
+
+    # ------------- management tick (mod.rs:180-203) -------------
+
+    def run_group_management(self) -> dict:
+        formed = self.try_form_new_groups()
+        merged = self.try_merge_solo_groups()
+        return {"formed": formed, "merged": merged}
+
+    def _eligible_nodes(self) -> list[OrchestratorNode]:
+        grouped = set(self.store.kv.hgetall(NODE_TO_GROUP))
+        return [
+            n
+            for n in self.store.node_store.get_nodes()
+            if n.status == NodeStatus.HEALTHY
+            and n.p2p_id
+            and n.address not in grouped
+        ]
+
+    def try_form_new_groups(self) -> int:
+        """Greedy per-config formation with proximity seeding. Eligibility
+        for ALL (node, config) pairs is one batched compat_mask solve."""
+        configs = self.enabled_configurations()
+        nodes = self._eligible_nodes()
+        if not configs or not nodes:
+            return 0
+
+        ep = self.encoder.encode_providers(
+            [n.compute_specs for n in nodes], locations=[n.location for n in nodes]
+        )
+        er = self.encoder.encode_requirements(
+            [c.parsed_requirements() for c in configs]
+        )
+        mask = np.asarray(compat_mask(ep, er))  # [N, C]
+        lat = np.asarray(ep.lat)
+        lon = np.asarray(ep.lon)
+        has_loc = np.asarray(ep.has_location)
+
+        available = np.ones(len(nodes), bool)
+        formed = 0
+        for ci, config in enumerate(configs):
+            while True:
+                idxs = np.nonzero(available & mask[:, ci])[0]
+                if len(idxs) < config.min_group_size:
+                    break
+                # proximity seeding (mod.rs:217-255): seed = first eligible;
+                # fill with nearest neighbors (locationless nodes last)
+                seed = idxs[0]
+                if has_loc[seed]:
+                    d = _haversine_km_np(lat[seed], lon[seed], lat[idxs], lon[idxs])
+                    d = np.where(has_loc[idxs], d, np.inf)
+                else:
+                    d = np.zeros(len(idxs))
+                order = idxs[np.argsort(d, kind="stable")]
+                members = order[: config.max_group_size]
+                self._create_group(config, [nodes[i].address for i in members])
+                available[members] = False
+                formed += 1
+        return formed
+
+    def try_merge_solo_groups(self) -> int:
+        """Merge single-node groups of the same configuration
+        (mod.rs:631-860), gated by the task-switching policy."""
+        solos_by_config: dict[str, list[NodeGroup]] = {}
+        for g in self.get_groups():
+            if len(g.nodes) == 1:
+                solos_by_config.setdefault(g.configuration_name, []).append(g)
+
+        merged = 0
+        for name, solos in solos_by_config.items():
+            config = self.config_by_name.get(name)
+            if config is None or len(solos) < 2:
+                continue
+            if self.merge_policy == TaskSwitchingPolicy.NEVER:
+                continue
+            if self.merge_policy == TaskSwitchingPolicy.IF_SAME_TASK:
+                by_task: dict[Optional[str], list[NodeGroup]] = {}
+                for g in solos:
+                    tid = self.store.kv.get(GROUP_TASK_KEY.format(g.id))
+                    by_task.setdefault(tid, []).append(g)
+                buckets = list(by_task.items())
+            else:
+                buckets = [(None, solos)]
+
+            for tid, bucket in buckets:
+                while len(bucket) >= 2:
+                    chunk = bucket[: config.max_group_size]
+                    if len(chunk) < max(2, config.min_group_size):
+                        break
+                    members = [g.nodes[0] for g in chunk]
+                    with self.store.kv.atomic():
+                        for g in chunk:
+                            self.dissolve_group(g.id)
+                        new_group = self._create_group(config, members)
+                        if tid is not None:
+                            self.store.kv.set(
+                                GROUP_TASK_KEY.format(new_group.id), tid
+                            )
+                    bucket = bucket[len(chunk):]
+                    merged += 1
+        return merged
+
+    # ------------- scheduler-side filter (scheduler_impl.rs) -------------
+
+    def filter_tasks(self, tasks: list[Task], node: OrchestratorNode) -> list[Task]:
+        group = self.group_for_node(node.address)
+        if group is None:
+            # topology-scheduled pools give ungrouped nodes nothing
+            return []
+
+        task = self._task_for_group(group, tasks)
+        if task is None:
+            return []
+        return [self._expand_group_vars(task, group, node.address)]
+
+    def _task_for_group(self, group: NodeGroup, tasks: list[Task]) -> Optional[Task]:
+        key = GROUP_TASK_KEY.format(group.id)
+        tid = self.store.kv.get(key)
+        if tid is not None:
+            task = next((t for t in tasks if t.id == tid), None)
+            if task is not None:
+                return task
+            self.store.kv.delete(key)  # assigned task no longer exists
+        applicable = [
+            t for t in tasks if group.configuration_name in t.allowed_topologies()
+        ]
+        if not applicable:
+            return None
+        choice = self.rng.choice(applicable)  # mod.rs:1176-1188
+        # SET NX: first scheduler wins the race (mod.rs:471-476)
+        self.store.kv.set(key, choice.id, nx=True)
+        tid = self.store.kv.get(key)
+        return next((t for t in tasks if t.id == tid), None)
+
+    def _expand_group_vars(
+        self, task: Task, group: NodeGroup, node_address: str
+    ) -> Task:
+        """${GROUP_*} / ring-neighbor / upload-counter expansion
+        (scheduler_impl.rs:112-205)."""
+        t = copy.deepcopy(task)
+        index = group.nodes.index(node_address)
+        size = len(group.nodes)
+        next_addr = group.nodes[(index + 1) % size]
+        next_node = self.store.node_store.get_node(next_addr)
+        next_p2p = ""
+        if next_node and next_node.p2p_addresses:
+            next_p2p = next_node.p2p_addresses[0]
+        elif next_node and next_node.p2p_id:
+            next_p2p = next_node.p2p_id
+
+        total_uploads = 0
+        last_idx = 0
+        if t.storage_config and t.storage_config.file_name_template:
+            counter_key = UPLOAD_COUNTER_KEY.format(
+                node_address, group.id, t.storage_config.file_name_template
+            )
+            raw = self.store.kv.get(counter_key)
+            total_uploads = int(raw) if raw else 0
+            last_idx = max(0, total_uploads - 1)
+
+        mapping = {
+            "${GROUP_ID}": group.id,
+            "${GROUP_INDEX}": str(index),
+            "${GROUP_SIZE}": str(size),
+            "${NEXT_P2P_ADDRESS}": next_p2p,
+            "${TOTAL_UPLOAD_COUNT}": str(total_uploads),
+            "${LAST_FILE_IDX}": str(last_idx),
+        }
+
+        def sub(s: str) -> str:
+            for k, v in mapping.items():
+                s = s.replace(k, v)
+            return s
+
+        if t.env_vars:
+            t.env_vars = {k: sub(v) for k, v in t.env_vars.items()}
+        if t.cmd:
+            t.cmd = [sub(c) for c in t.cmd]
+        if t.entrypoint:
+            t.entrypoint = [sub(c) for c in t.entrypoint]
+        if t.volume_mounts:
+            t.volume_mounts = [
+                type(vm)(host_path=sub(vm.host_path), container_path=sub(vm.container_path))
+                for vm in t.volume_mounts
+            ]
+        return t

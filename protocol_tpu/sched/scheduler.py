@@ -81,16 +81,18 @@ class Scheduler:
 
         if self.batch_matcher is not None:
             task, covered = self.batch_matcher.lookup(node)
-            if covered:
-                # The batch solve considered this node. Its verdict is final:
-                # an unassigned-but-covered node stays idle (requirements or
-                # replica bounds excluded it) rather than falling through to
-                # the ungated greedy chain.
-                if task is None:
-                    return None
-                return expand_task_for_node(task, node_address)
-            # fall through to the greedy chain only for nodes the batch
-            # didn't consider (e.g. not in a schedulable status at solve time)
+            if not covered:
+                # A node the last solve never considered (e.g. it just became
+                # schedulable): request a re-solve and look again. The matcher
+                # throttles, so at worst this node waits one heartbeat — the
+                # reference reschedules on a 10 s beat anyway. There is NO
+                # greedy fallthrough here: it would bypass replica bounds and
+                # compute-requirement gates.
+                self.batch_matcher.mark_dirty()
+                task, covered = self.batch_matcher.lookup(node)
+            if task is None:
+                return None
+            return expand_task_for_node(task, node_address)
 
         tasks = self.store.task_store.get_all_tasks()
         for plugin in self.plugins:

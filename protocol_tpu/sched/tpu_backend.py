@@ -153,6 +153,10 @@ class TpuBatchMatcher:
 
     def refresh(self) -> None:
         t_start = time.perf_counter()
+        # clear the dirty flag BEFORE reading state: a concurrent mark_dirty
+        # landing mid-read must trigger another solve, not be erased
+        self._dirty = False
+        self._last_solve = self._time()
         nodes = [
             n for n in self.store.node_store.get_nodes() if n.status in SCHEDULABLE
         ]
@@ -168,8 +172,6 @@ class TpuBatchMatcher:
                 continue
             ok_tasks.append(t)
         tasks = ok_tasks
-        self._dirty = False
-        self._last_solve = self._time()
         self._assignment = {}
         self._covered = {n.address for n in nodes}
         if not nodes or not tasks:

@@ -1,0 +1,70 @@
+"""Request signing: nonce injection + sorted-key JSON + endpoint binding.
+
+Reference: crates/shared/src/security/request_signer.rs:22-68 —
+``sign_request_with_nonce`` inserts a uuid nonce into the JSON body, sorts
+object keys recursively, and signs ``endpoint + json``. Same scheme here;
+the verifier recomputes the canonical JSON from the received body.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Optional
+
+from protocol_tpu.security.wallet import Wallet, verify_signature
+
+
+def canonical_json(body: Any) -> str:
+    """Deterministic JSON: recursively sorted keys, compact separators."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":"))
+
+
+def sign_request(
+    endpoint: str,
+    wallet: Wallet,
+    body: Optional[dict] = None,
+) -> tuple[dict[str, str], Optional[dict]]:
+    """Returns (headers, body-with-nonce).
+
+    Signed message = endpoint + x-timestamp (+ canonical body JSON). The
+    timestamp is signed so bodyless (GET-style) requests are replayable only
+    within the middleware's freshness window — the body-nonce cache does not
+    cover them.
+    """
+    import time
+
+    timestamp = f"{time.time():.6f}"
+    signed_body = None
+    message = endpoint + timestamp
+    if body is not None:
+        signed_body = dict(body)
+        signed_body["nonce"] = uuid.uuid4().hex  # 32 alnum chars
+        message += canonical_json(signed_body)
+    signature = wallet.sign_message(message)
+    return {
+        "x-address": wallet.address,
+        "x-signature": signature,
+        "x-timestamp": timestamp,
+    }, signed_body
+
+
+def verify_request(
+    endpoint: str,
+    headers: dict[str, str],
+    body: Optional[dict] = None,
+) -> Optional[str]:
+    """Validates headers against the endpoint+timestamp+body; returns the
+    authenticated address, or None. Freshness of x-timestamp is enforced by
+    the middleware, not here."""
+    address = headers.get("x-address")
+    signature = headers.get("x-signature")
+    timestamp = headers.get("x-timestamp")
+    if not address or not signature or timestamp is None:
+        return None
+    message = endpoint + timestamp
+    if body is not None:
+        message += canonical_json(body)
+    if verify_signature(message, signature, address):
+        return address.lower()
+    return None

@@ -1,0 +1,73 @@
+"""Wallet: Ed25519 keypair with a derived on-ledger address.
+
+Reference counterpart: crates/shared/src/web3/wallet.rs (alloy
+PrivateKeySigner). Deviation, by design: the reference uses secp256k1
+ECDSA with address recovery; here identity is an Ed25519 keypair and the
+address is ``0x + sha256(pubkey)[:20].hex()``. Signatures travel as
+``<pubkey_hex>:<sig_hex>`` so any verifier can (a) check the pubkey hashes
+to the claimed address and (b) verify the signature — the same
+trust-nothing property recovery gives, without secp dependencies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.exceptions import InvalidSignature
+
+
+def _address_from_pubkey(pub_bytes: bytes) -> str:
+    return "0x" + hashlib.sha256(pub_bytes).digest()[:20].hex()
+
+
+class Wallet:
+    def __init__(self, private_key: Optional[Ed25519PrivateKey] = None):
+        self._key = private_key or Ed25519PrivateKey.generate()
+        self._pub_bytes = self._key.public_key().public_bytes_raw()
+        self.address = _address_from_pubkey(self._pub_bytes)
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "Wallet":
+        """Deterministic wallet from a 32-byte seed (dev/test fixtures)."""
+        if len(seed) != 32:
+            seed = hashlib.sha256(seed).digest()
+        return cls(Ed25519PrivateKey.from_private_bytes(seed))
+
+    @classmethod
+    def from_hex(cls, hex_key: str) -> "Wallet":
+        return cls(Ed25519PrivateKey.from_private_bytes(bytes.fromhex(hex_key.removeprefix("0x"))))
+
+    def private_key_hex(self) -> str:
+        return self._key.private_bytes_raw().hex()
+
+    def sign_message(self, message: bytes | str) -> str:
+        """Returns '<pubkey_hex>:<sig_hex>'."""
+        if isinstance(message, str):
+            message = message.encode()
+        sig = self._key.sign(message)
+        return f"{self._pub_bytes.hex()}:{sig.hex()}"
+
+
+def verify_signature(message: bytes | str, signature: str, expected_address: str) -> bool:
+    """Checks the signature verifies AND its embedded pubkey hashes to the
+    claimed address (the recovery-equivalent step)."""
+    if isinstance(message, str):
+        message = message.encode()
+    try:
+        pub_hex, sig_hex = signature.split(":", 1)
+        pub_bytes = bytes.fromhex(pub_hex)
+        sig = bytes.fromhex(sig_hex)
+    except ValueError:
+        return False
+    if _address_from_pubkey(pub_bytes) != expected_address.lower():
+        return False
+    try:
+        Ed25519PublicKey.from_public_bytes(pub_bytes).verify(sig, message)
+        return True
+    except (InvalidSignature, ValueError):
+        return False

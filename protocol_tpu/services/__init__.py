@@ -1,0 +1,9 @@
+"""Control-plane services: discovery, orchestrator, worker, validator.
+
+Each service mirrors its reference crate's API surface and loops
+(SURVEY.md §2.3-2.6) as an asyncio aiohttp application over the in-process
+KV store, wallet-signed security layer, and ledger substrate. Services are
+constructed as objects with ``make_app()`` (HTTP surface) and explicit
+``*_once()`` loop bodies so tests can tick them deterministically — the
+hermetic equivalent of the reference's tokio interval loops.
+"""

@@ -1,0 +1,251 @@
+"""Discovery service: the node registry.
+
+Reference: crates/discovery (SURVEY.md §2.3). Surface kept:
+
+  PUT  /api/nodes          worker-signed registration. x-address must equal
+                           node.id (node.rs:32-35); nodes active in a pool
+                           are immutable except p2p fixups (:39-91); per-IP
+                           active-node cap (:93-127); ledger existence check
+                           (:140-150); pool ComputeRequirements gate via
+                           specs.meets() (:152-197).
+  GET  /api/pool/{id}      pool-filtered, validated+active nodes (signed
+                           readers: pool creator/manager).
+  GET  /api/validator      non-validated nodes for the validator (signed).
+  GET  /api/platform       all nodes, paginated (admin API key).
+  /health
+
+Loops (tickable): chain_sync_once — refresh balance / active / validated /
+whitelist flags from the ledger, writing only on change (chainsync/
+sync.rs:16,76-87,135-222); location enrichment via a pluggable resolver
+(location_enrichment.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.models.api import ApiResponse
+from protocol_tpu.models.node import (
+    ComputeRequirements,
+    DiscoveryNode,
+    Node,
+    NodeLocation,
+)
+from protocol_tpu.security.middleware import (
+    api_key_middleware,
+    validate_signature_middleware,
+)
+from protocol_tpu.store.kv import KVStore
+
+NODE_KEY = "node:{}"
+NODE_IDS = "node:ids"
+
+LocationResolver = Callable[[str], Awaitable[Optional[NodeLocation]]]
+
+
+class DiscoveryNodeStore:
+    """Redis-schema node store (discovery/src/store/node_store.rs:78-158)."""
+
+    def __init__(self, kv: KVStore):
+        self.kv = kv
+
+    def put(self, dn: DiscoveryNode) -> None:
+        dn.last_updated = time.time()
+        with self.kv.atomic():
+            self.kv.set(NODE_KEY.format(dn.node.id), dn.to_json())
+            self.kv.sadd(NODE_IDS, dn.node.id)
+
+    def get(self, node_id: str) -> Optional[DiscoveryNode]:
+        raw = self.kv.get(NODE_KEY.format(node_id))
+        return DiscoveryNode.from_json(raw) if raw else None
+
+    def all(self) -> list[DiscoveryNode]:
+        ids = sorted(self.kv.smembers(NODE_IDS))
+        raws = self.kv.mget(NODE_KEY.format(i) for i in ids)
+        nodes = [DiscoveryNode.from_json(r) for r in raws if r]
+        nodes.sort(key=lambda d: d.last_updated or 0, reverse=True)
+        return nodes
+
+
+class DiscoveryService:
+    def __init__(
+        self,
+        ledger: Ledger,
+        pool_id: int,
+        kv: Optional[KVStore] = None,
+        max_nodes_per_ip: int = 5,
+        admin_api_key: str = "admin",
+        location_resolver: Optional[LocationResolver] = None,
+    ):
+        self.ledger = ledger
+        self.pool_id = pool_id
+        self.kv = kv or KVStore()
+        self.store = DiscoveryNodeStore(self.kv)
+        self.max_nodes_per_ip = max_nodes_per_ip
+        self.admin_api_key = admin_api_key
+        self.location_resolver = location_resolver
+
+    # ---------------- HTTP surface ----------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[
+                validate_signature_middleware(
+                    self.kv, ["/api/nodes", "/api/pool", "/api/validator"]
+                ),
+                api_key_middleware(self.admin_api_key, ["/api/platform"]),
+            ]
+        )
+        app.router.add_put("/api/nodes", self.register_node)
+        app.router.add_get("/api/pool/{pool_id}", self.get_pool_nodes)
+        app.router.add_get("/api/validator", self.get_unvalidated_nodes)
+        app.router.add_get("/api/platform", self.get_all_nodes)
+        app.router.add_get("/health", self.health)
+        return app
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def register_node(self, request: web.Request) -> web.Response:
+        body = request.get("auth_body") or {}
+        address = request["auth_address"]
+        node = Node.from_dict(body)
+
+        # x-address must be the node being registered (node.rs:32-35)
+        if node.id.lower() != address:
+            return _err("address mismatch", 401)
+
+        # ledger existence: the node must be registered on the substrate
+        if not self.ledger.node_exists(node.id):
+            return _err("node not registered on ledger", 400)
+
+        existing = self.store.get(node.id)
+
+        # nodes active in a pool are immutable except p2p/gpu-index fixups
+        # (node.rs:39-91)
+        if existing and existing.is_active:
+            kept = existing.node
+            kept.worker_p2p_id = node.worker_p2p_id or kept.worker_p2p_id
+            kept.worker_p2p_addresses = (
+                node.worker_p2p_addresses or kept.worker_p2p_addresses
+            )
+            existing.node = kept
+            self.store.put(existing)
+            return web.json_response(ApiResponse(True, "updated p2p only").to_dict())
+
+        # per-IP active-node cap (node.rs:93-127)
+        same_ip = [
+            d
+            for d in self.store.all()
+            if d.node.ip_address == node.ip_address and d.node.id != node.id
+        ]
+        if len(same_ip) >= self.max_nodes_per_ip:
+            return _err("too many nodes from this IP", 429)
+
+        # pool ComputeRequirements gate (node.rs:152-197)
+        pool = self.ledger.get_pool_info(self.pool_id)
+        if pool.pool_data_uri:
+            try:
+                reqs = ComputeRequirements.parse(pool.pool_data_uri)
+            except ValueError:
+                reqs = None
+            if reqs is not None:
+                specs = node.compute_specs
+                if specs is None or not specs.meets(reqs):
+                    return _err("node does not meet pool compute requirements", 400)
+
+        dn = existing or DiscoveryNode(node=node)
+        dn.node = node
+        if dn.created_at is None:
+            dn.created_at = time.time()
+        self.store.put(dn)
+        return web.json_response(ApiResponse(True, "ok").to_dict())
+
+    async def get_pool_nodes(self, request: web.Request) -> web.Response:
+        # signed readers only: orchestrator (compute manager) or creator
+        pool = self.ledger.get_pool_info(int(request.match_info["pool_id"]))
+        addr = request["auth_address"]
+        if addr not in (pool.creator, pool.compute_manager_key):
+            return _err("not authorized for pool", 401)
+        nodes = [
+            d.to_dict()
+            for d in self.store.all()
+            if d.node.compute_pool_id == pool.pool_id and d.is_validated
+        ]
+        return web.json_response({"success": True, "data": nodes})
+
+    async def get_unvalidated_nodes(self, request: web.Request) -> web.Response:
+        nodes = [d.to_dict() for d in self.store.all() if not d.is_validated]
+        return web.json_response({"success": True, "data": nodes})
+
+    async def get_all_nodes(self, request: web.Request) -> web.Response:
+        try:
+            page = int(request.query.get("page", "0"))
+            per_page = min(int(request.query.get("per_page", "50")), 200)
+        except ValueError:
+            return _err("invalid pagination", 400)
+        nodes = self.store.all()
+        chunk = nodes[page * per_page : (page + 1) * per_page]
+        return web.json_response(
+            {
+                "success": True,
+                "data": [d.to_dict() for d in chunk],
+                "total": len(nodes),
+                "page": page,
+            }
+        )
+
+    # ---------------- loops ----------------
+
+    def chain_sync_once(self) -> int:
+        """One sync tick (chainsync/sync.rs:46-132): refresh ledger-derived
+        flags per node, writing only on change. Returns changed count."""
+        changed = 0
+        for dn in self.store.all():
+            node_id = dn.node.id
+            is_validated = self.ledger.is_node_validated(node_id)
+            in_pool = self.ledger.is_node_in_pool(self.pool_id, node_id)
+            balance = self.ledger.balance_of(dn.node.provider_address)
+            whitelisted = self.ledger.is_provider_whitelisted(dn.node.provider_address)
+            blacklisted = (
+                node_id.lower() in self.ledger.get_pool_info(self.pool_id).blacklist
+            )
+            if (
+                dn.is_validated != is_validated
+                or dn.is_active != in_pool
+                or dn.latest_balance != balance
+                or dn.is_provider_whitelisted != whitelisted
+                or dn.is_blacklisted != blacklisted
+            ):
+                dn.is_validated = is_validated
+                dn.is_active = in_pool
+                dn.latest_balance = balance
+                dn.is_provider_whitelisted = whitelisted
+                dn.is_blacklisted = blacklisted
+                self.store.put(dn)
+                changed += 1
+        return changed
+
+    async def enrich_locations_once(self) -> int:
+        """Fill missing node locations via the pluggable resolver
+        (location_enrichment.rs, 30 s loop in the reference)."""
+        if self.location_resolver is None:
+            return 0
+        enriched = 0
+        for dn in self.store.all():
+            if dn.location is None and dn.node.ip_address:
+                loc = await self.location_resolver(dn.node.ip_address)
+                if loc is not None:
+                    dn.location = loc
+                    self.store.put(dn)
+                    enriched += 1
+        return enriched
+
+
+def _err(msg: str, status: int) -> web.Response:
+    return web.json_response({"success": False, "error": msg}, status=status)

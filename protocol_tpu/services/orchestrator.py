@@ -1,0 +1,619 @@
+"""Orchestrator: pool coordination, health FSM, scheduling, storage.
+
+Reference: crates/orchestrator (13,802 LoC; SURVEY.md §2.4). Surface kept:
+
+  POST /heartbeat                worker-signed; ban check, task-state + p2p
+                                 update, TTL'd beat, metric storage, reply
+                                 carries the scheduled task
+                                 (api/routes/heartbeat.rs:16-170)
+  /tasks CRUD                    admin; name uniqueness; topology required
+                                 when grouping is active (task.rs:46-80)
+  /nodes, /nodes/{id}/ban        admin node views + ban
+  /groups, /groups/configs       admin group views; force-regroup
+  /metrics, /metrics/prometheus  pool metrics
+  POST /storage/request-upload   worker-signed; 100 MB cap; per-address
+                                 hourly rate limit; file-name template
+                                 expansion with group vars + upload
+                                 counters; mapping file + signed URL
+                                 (api/routes/storage.rs:24-309)
+  /health                        loop-watchdog gated
+                                 (utils/loop_heartbeats.rs:77-137)
+
+Loops (tickable, async-loop-wrapped in serve()):
+  discovery_monitor_once   discovery sync + status reconciliation
+                           (discovery/monitor.rs:90-420)
+  invite_once              invite Discovered nodes with a ledger-verifiable
+                           signed invite (node/invite.rs:73-223)
+  status_update_once       heartbeat health FSM + dead-node ejection
+                           (status_update/mod.rs:118-350)
+  group management         via NodeGroupsPlugin.run_group_management()
+
+The scheduling hot path is the TPU batch matcher
+(protocol_tpu.sched.tpu_backend) behind the same get-task-for-node seam the
+reference exposes (scheduler/mod.rs:26-74).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from protocol_tpu.chain import Ledger, LedgerError
+from protocol_tpu.chain.ledger import invite_digest
+from protocol_tpu.models.heartbeat import HeartbeatRequest
+from protocol_tpu.models.metric import MetricEntry, MetricKey
+from protocol_tpu.models.node import DiscoveryNode
+from protocol_tpu.models.task import Task, TaskRequest, TaskState
+from protocol_tpu.sched import Scheduler
+from protocol_tpu.sched.node_groups import NodeGroupsPlugin, UPLOAD_COUNTER_KEY
+from protocol_tpu.security.middleware import (
+    api_key_middleware,
+    validate_signature_middleware,
+)
+from protocol_tpu.security.wallet import Wallet
+from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
+from protocol_tpu.utils.storage import StorageProvider
+
+BAN_KEY = "orchestrator:banned:{}"
+UPLOAD_RATE_KEY = "orchestrator:upload_rate:{}"
+
+MAX_UPLOAD_BYTES = 100 * 1024 * 1024  # storage.rs:10
+DEAD_MISS_THRESHOLD = 3  # status_update/mod.rs:43
+WAITING_GIVE_UP_MISSES = 360  # status_update/mod.rs:295
+LOOP_STALE_SECONDS = 120.0  # loop_heartbeats.rs
+
+DiscoveryFetcher = Callable[[], Awaitable[list[DiscoveryNode]]]
+InviteSender = Callable[[OrchestratorNode, dict], Awaitable[bool]]
+
+
+class OrchestratorService:
+    def __init__(
+        self,
+        ledger: Ledger,
+        pool_id: int,
+        wallet: Wallet,  # the pool's compute-manager key
+        store: Optional[StoreContext] = None,
+        scheduler: Optional[Scheduler] = None,
+        groups_plugin: Optional[NodeGroupsPlugin] = None,
+        storage: Optional[StorageProvider] = None,
+        discovery_fetcher: Optional[DiscoveryFetcher] = None,
+        invite_sender: Optional[InviteSender] = None,
+        admin_api_key: str = "admin",
+        disable_ejection: bool = False,
+        uploads_per_hour: int = 3,  # main.rs:76-78
+        heartbeat_url: str = "http://localhost:8090",
+    ):
+        self.ledger = ledger
+        self.pool_id = pool_id
+        self.wallet = wallet
+        self.store = store or StoreContext.new_test()
+        self.scheduler = scheduler or Scheduler(self.store)
+        self.groups_plugin = groups_plugin
+        self.storage = storage
+        self.discovery_fetcher = discovery_fetcher
+        self.invite_sender = invite_sender
+        self.admin_api_key = admin_api_key
+        self.disable_ejection = disable_ejection
+        self.uploads_per_hour = uploads_per_hour
+        self.heartbeat_url = heartbeat_url
+        self.loop_beats: dict[str, float] = {}
+
+    # ================= HTTP =================
+
+    def make_app(self) -> web.Application:
+        async def node_known(address: str) -> bool:
+            # async validator: node exists and is not ejected/banned
+            # (api/server.rs:170-185) — gates BOTH /heartbeat and /storage
+            if self.store.kv.exists(BAN_KEY.format(address)):
+                return False
+            node = self.store.node_store.get_node(address)
+            return node is not None and node.status not in (
+                NodeStatus.EJECTED,
+                NodeStatus.BANNED,
+            )
+
+        app = web.Application(
+            middlewares=[
+                validate_signature_middleware(
+                    self.store.kv,
+                    ["/heartbeat", "/storage"],
+                    validator=node_known,
+                ),
+                api_key_middleware(
+                    self.admin_api_key,
+                    ["/tasks", "/nodes", "/groups", "/metrics"],
+                ),
+            ]
+        )
+        app.router.add_post("/heartbeat", self.heartbeat)
+        app.router.add_post("/storage/request-upload", self.request_upload)
+        app.router.add_post("/tasks", self.create_task)
+        app.router.add_get("/tasks", self.list_tasks)
+        app.router.add_delete("/tasks/{task_id}", self.delete_task)
+        app.router.add_get("/nodes", self.list_nodes)
+        app.router.add_post("/nodes/{address}/ban", self.ban_node)
+        app.router.add_get("/groups", self.list_groups)
+        app.router.add_get("/groups/configs", self.list_group_configs)
+        app.router.add_post("/groups/force-regroup", self.force_regroup)
+        app.router.add_get("/metrics", self.get_metrics)
+        app.router.add_get("/metrics/prometheus", self.get_prometheus)
+        app.router.add_get("/health", self.health)
+        return app
+
+    async def health(self, request: web.Request) -> web.Response:
+        now = time.monotonic()
+        stale = {
+            name: round(now - t, 1)
+            for name, t in self.loop_beats.items()
+            if now - t > LOOP_STALE_SECONDS
+        }
+        if stale:
+            return web.json_response(
+                {"status": "unhealthy", "stale_loops": stale}, status=503
+            )
+        return web.json_response({"status": "ok"})
+
+    # ----- heartbeat (the hot path) -----
+
+    async def heartbeat(self, request: web.Request) -> web.Response:
+        body = request.get("auth_body") or {}
+        address = request["auth_address"]
+        hb = HeartbeatRequest.from_dict(body)
+        if hb.address.lower() != address:
+            return _err("address mismatch", 401)
+
+        if self.store.kv.exists(BAN_KEY.format(address)):
+            return _err("node is banned", 401)
+
+        node = self.store.node_store.get_node(address)
+        if node is not None:
+            self.store.node_store.update_node_task(
+                address, hb.task_id, hb.task_state_enum()
+            )
+            if hb.p2p_id and node.p2p_id != hb.p2p_id:
+                self.store.node_store.update_node_p2p(
+                    address, hb.p2p_id, hb.p2p_addresses
+                )
+
+        self.store.heartbeat_store.beat(hb)
+
+        if hb.metrics:
+            entries = []
+            for m in hb.metrics:
+                try:
+                    entries.append(MetricEntry.from_dict(m))
+                except (KeyError, ValueError, TypeError):
+                    continue
+            if entries:
+                self.store.metrics_store.store_metrics(entries, address)
+
+        task = self.scheduler.get_task_for_node(address)
+        return web.json_response(
+            {
+                "success": True,
+                "data": {"current_task": task.to_dict() if task else None},
+            }
+        )
+
+    # ----- storage (api/routes/storage.rs:24-309) -----
+
+    async def request_upload(self, request: web.Request) -> web.Response:
+        if self.storage is None:
+            return _err("storage not configured", 501)
+        body = request.get("auth_body") or {}
+        address = request["auth_address"]
+
+        try:
+            file_name = str(body["file_name"])
+            file_size = int(body["file_size"])
+            sha256 = str(body["sha256"])
+        except (KeyError, ValueError):
+            return _err("missing file_name/file_size/sha256", 400)
+        task_id = body.get("task_id")
+
+        if file_size > MAX_UPLOAD_BYTES:
+            return _err("file too large", 400)
+
+        # rate limit N/hour/address (storage.rs:80-104)
+        rate_key = UPLOAD_RATE_KEY.format(address)
+        count = self.store.kv.incr(rate_key)
+        if count == 1:
+            self.store.kv.expire(rate_key, 3600)
+        if count > self.uploads_per_hour:
+            return _err("upload rate exceeded", 429)
+
+        object_name = file_name
+        task = self.store.task_store.get_task(task_id) if task_id else None
+        if task and task.storage_config and task.storage_config.file_name_template:
+            object_name = self._expand_file_template(
+                task.storage_config.file_name_template, file_name, address
+            )
+
+        await self.storage.generate_mapping_file(sha256, object_name)
+        url = await self.storage.generate_upload_signed_url(
+            object_name, max_bytes=file_size
+        )
+        return web.json_response(
+            {"success": True, "data": {"signed_url": url, "object_name": object_name}}
+        )
+
+    def _expand_file_template(
+        self, template: str, original_name: str, address: str
+    ) -> str:
+        """Template vars incl. group context + upload counters
+        (storage.rs:127-215)."""
+        group = None
+        index = 0
+        size = 0
+        if self.groups_plugin is not None:
+            group = self.groups_plugin.group_for_node(address)
+            if group is not None:
+                index = group.nodes.index(address) if address in group.nodes else 0
+                size = len(group.nodes)
+        counter_key = UPLOAD_COUNTER_KEY.format(
+            address, group.id if group else "-", template
+        )
+        total_after = self.store.kv.incr(counter_key)
+        out = template.replace("${ORIGINAL_NAME}", original_name)
+        out = out.replace("${NODE_GROUP_ID}", group.id if group else "")
+        out = out.replace("${NODE_GROUP_SIZE}", str(size))
+        out = out.replace("${NODE_GROUP_INDEX}", str(index))
+        out = out.replace("${TOTAL_UPLOAD_COUNT_AFTER}", str(total_after))
+        out = out.replace("${CURRENT_FILE_INDEX}", str(max(0, total_after - 1)))
+        return out
+
+    # ----- tasks (api/routes/task.rs) -----
+
+    async def create_task(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _err("invalid json", 400)
+        req = TaskRequest.from_dict(body)
+        if not req.name or not req.image:
+            return _err("name and image required", 400)
+        if self.store.task_store.name_exists(req.name):
+            return _err("task name already exists", 409)
+        # topology requirement when grouping is active (task.rs:68-80)
+        if self.groups_plugin is not None:
+            topos = (
+                req.scheduling_config.allowed_topologies()
+                if req.scheduling_config
+                else []
+            )
+            if not topos:
+                return _err("task must declare allowed_topologies", 400)
+            unknown = [
+                t for t in topos if t not in self.groups_plugin.config_by_name
+            ]
+            if unknown:
+                return _err(f"unknown topologies: {unknown}", 400)
+        try:
+            task = Task.from_request(req)
+        except ValueError as e:
+            return _err(str(e), 400)
+        self.store.task_store.add_task(task)
+        return web.json_response({"success": True, "data": task.to_dict()}, status=201)
+
+    async def list_tasks(self, request: web.Request) -> web.Response:
+        tasks = [t.to_dict() for t in self.store.task_store.get_all_tasks()]
+        return web.json_response({"success": True, "data": tasks})
+
+    async def delete_task(self, request: web.Request) -> web.Response:
+        task = self.store.task_store.delete_task(request.match_info["task_id"])
+        if task is None:
+            return _err("task not found", 404)
+        self.store.metrics_store.delete_metrics_for_task(task.id)
+        return web.json_response({"success": True, "data": task.to_dict()})
+
+    # ----- nodes -----
+
+    async def list_nodes(self, request: web.Request) -> web.Response:
+        status_filter = request.query.get("status")
+        nodes = self.store.node_store.get_nodes()
+        if status_filter:
+            nodes = [n for n in nodes if n.status.value == status_filter]
+        return web.json_response(
+            {"success": True, "data": [n.to_dict() for n in nodes]}
+        )
+
+    async def ban_node(self, request: web.Request) -> web.Response:
+        address = request.match_info["address"].lower()
+        self.store.kv.set(BAN_KEY.format(address), "1")
+        node = self.store.node_store.get_node(address)
+        if node is not None:
+            self.store.node_store.update_node_status(address, NodeStatus.BANNED)
+            self.store.metrics_store.delete_metrics_for_node(address)
+            if self.groups_plugin is not None:
+                node.status = NodeStatus.BANNED
+                self.groups_plugin.handle_status_change(node)
+        return web.json_response({"success": True, "data": "banned"})
+
+    # ----- groups -----
+
+    async def list_groups(self, request: web.Request) -> web.Response:
+        if self.groups_plugin is None:
+            return web.json_response({"success": True, "data": []})
+        groups = [g.to_dict() for g in self.groups_plugin.get_groups()]
+        return web.json_response({"success": True, "data": groups})
+
+    async def list_group_configs(self, request: web.Request) -> web.Response:
+        if self.groups_plugin is None:
+            return web.json_response({"success": True, "data": []})
+        return web.json_response(
+            {
+                "success": True,
+                "data": [c.to_dict() for c in self.groups_plugin.configurations],
+            }
+        )
+
+    async def force_regroup(self, request: web.Request) -> web.Response:
+        if self.groups_plugin is None:
+            return _err("grouping not enabled", 400)
+        stats = self.groups_plugin.run_group_management()
+        return web.json_response({"success": True, "data": stats})
+
+    # ----- metrics -----
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"success": True, "data": self.store.metrics_store.get_all_metrics()}
+        )
+
+    async def get_prometheus(self, request: web.Request) -> web.Response:
+        """Prometheus exposition (metrics/sync_service.rs rebuild, rendered
+        on demand)."""
+        lines = []
+        nodes = self.store.node_store.get_nodes()
+        by_status: dict[str, int] = {}
+        for n in nodes:
+            by_status[n.status.value] = by_status.get(n.status.value, 0) + 1
+        lines.append("# TYPE orchestrator_nodes_total gauge")
+        for status, count in sorted(by_status.items()):
+            lines.append(
+                f'orchestrator_nodes_total{{status="{status}"}} {count}'
+            )
+        lines.append("# TYPE orchestrator_tasks_total gauge")
+        lines.append(
+            f"orchestrator_tasks_total {len(self.store.task_store.get_all_tasks())}"
+        )
+        if self.groups_plugin is not None:
+            lines.append("# TYPE orchestrator_groups_total gauge")
+            lines.append(
+                f"orchestrator_groups_total {len(self.groups_plugin.get_groups())}"
+            )
+        for task_id, labels in self.store.metrics_store.get_all_metrics().items():
+            for label, per_node in labels.items():
+                for node_addr, value in per_node.items():
+                    lines.append(
+                        f'orchestrator_task_metric{{task_id="{task_id}",label="{label}",node="{node_addr}"}} {value}'
+                    )
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    # ================= loops =================
+
+    def _beat(self, loop_name: str) -> None:
+        self.loop_beats[loop_name] = time.monotonic()
+
+    async def discovery_monitor_once(self) -> int:
+        """Sync nodes from discovery + reconcile statuses
+        (discovery/monitor.rs:90-420)."""
+        if self.discovery_fetcher is None:
+            return 0
+        discovered = await self.discovery_fetcher()
+        seen: dict[str, DiscoveryNode] = {}
+        for dn in discovered:  # dedup by id (monitor.rs:202-215)
+            seen.setdefault(dn.node.id.lower(), dn)
+
+        changed = 0
+        known = {n.address: n for n in self.store.node_store.get_nodes()}
+        for addr, dn in seen.items():
+            node = known.get(addr)
+            if node is None:
+                # duplicate-endpoint dead-marking (monitor.rs:236-290)
+                for other in known.values():
+                    if (
+                        other.ip_address == dn.node.ip_address
+                        and other.port == dn.node.port
+                        and other.address != addr
+                        and other.status != NodeStatus.DEAD
+                    ):
+                        self.store.node_store.update_node_status(
+                            other.address, NodeStatus.DEAD
+                        )
+                self.store.node_store.add_node(
+                    OrchestratorNode(
+                        address=addr,
+                        ip_address=dn.node.ip_address,
+                        port=dn.node.port,
+                        status=NodeStatus.DISCOVERED,
+                        compute_specs=dn.node.compute_specs,
+                        p2p_id=dn.node.worker_p2p_id,
+                        p2p_addresses=dn.node.worker_p2p_addresses,
+                        location=dn.location,
+                    )
+                )
+                changed += 1
+                continue
+
+            # dead -> discovered on newer update + spec refresh
+            # (monitor.rs:359-383)
+            if node.status == NodeStatus.DEAD and dn.last_updated and (
+                node.last_status_change is None
+                or dn.last_updated > node.last_status_change
+            ):
+                node.compute_specs = dn.node.compute_specs
+                node.status = NodeStatus.DISCOVERED
+                node.last_status_change = time.time()
+                self.store.node_store.update_node(node)
+                changed += 1
+            # zero balance -> LowBalance (monitor.rs:385-395)
+            elif dn.latest_balance == 0 and node.status == NodeStatus.HEALTHY:
+                self.store.node_store.update_node_status(addr, NodeStatus.LOW_BALANCE)
+                changed += 1
+            elif (
+                node.status == NodeStatus.LOW_BALANCE
+                and (dn.latest_balance or 0) > 0
+            ):
+                self.store.node_store.update_node_status(addr, NodeStatus.UNHEALTHY)
+                changed += 1
+        return changed
+
+    async def invite_once(self) -> int:
+        """Invite Discovered nodes (node/invite.rs:73-223): build a signed,
+        ledger-verifiable invite and deliver it via the pluggable sender
+        (the reference's libp2p Invite protocol)."""
+        if self.invite_sender is None:
+            return 0
+        invited = 0
+        pool = self.ledger.get_pool_info(self.pool_id)
+        for node in self.store.node_store.get_uninvited_nodes():
+            nonce = uuid.uuid4().hex
+            expiration = time.time() + 600
+            digest = invite_digest(
+                pool.domain_id, self.pool_id, node.address, nonce, expiration
+            )
+            # NB: field name is invite_nonce — the request signer injects its
+            # own replay "nonce" into every signed body, which must not
+            # collide with the invite's ledger nonce
+            payload = {
+                "pool_id": self.pool_id,
+                "domain_id": pool.domain_id,
+                "invite_nonce": nonce,
+                "expiration": expiration,
+                "invite_signature": self.wallet.sign_message(digest),
+                "heartbeat_url": self.heartbeat_url,
+            }
+            ok = await self.invite_sender(node, payload)
+            if ok:
+                self.store.node_store.update_node_status(
+                    node.address, NodeStatus.WAITING_FOR_HEARTBEAT
+                )
+                self.store.heartbeat_store.clear_unhealthy_counter(node.address)
+                invited += 1
+        return invited
+
+    async def status_update_once(self) -> None:
+        """Health FSM (status_update/mod.rs:215-312) + chain sync
+        (:118-142)."""
+        hs = self.store.heartbeat_store
+        for node in self.store.node_store.get_nodes():
+            addr = node.address
+            if node.status in (NodeStatus.BANNED, NodeStatus.EJECTED):
+                continue
+            beat = hs.get_heartbeat(addr)
+            if beat is not None:
+                if node.status in (
+                    NodeStatus.UNHEALTHY,
+                    NodeStatus.WAITING_FOR_HEARTBEAT,
+                    NodeStatus.DISCOVERED,
+                    NodeStatus.DEAD,
+                    NodeStatus.HEALTHY,
+                ):
+                    in_pool = self.ledger.is_node_in_pool(self.pool_id, addr)
+                    target = NodeStatus.HEALTHY if in_pool else NodeStatus.UNHEALTHY
+                    if node.status != target:
+                        self.store.node_store.update_node_status(addr, target)
+                        if target != NodeStatus.HEALTHY and self.groups_plugin:
+                            node.status = target
+                            self.groups_plugin.handle_status_change(node)
+                    hs.clear_unhealthy_counter(addr)
+            else:
+                if node.status == NodeStatus.HEALTHY:
+                    self.store.node_store.update_node_status(addr, NodeStatus.UNHEALTHY)
+                    hs.increment_unhealthy_counter(addr)
+                    if self.groups_plugin:
+                        node.status = NodeStatus.UNHEALTHY
+                        self.groups_plugin.handle_status_change(node)
+                elif node.status == NodeStatus.UNHEALTHY:
+                    misses = hs.increment_unhealthy_counter(addr)
+                    if misses >= DEAD_MISS_THRESHOLD:
+                        self._mark_dead(node)
+                elif node.status == NodeStatus.WAITING_FOR_HEARTBEAT:
+                    misses = hs.increment_unhealthy_counter(addr)
+                    if misses >= WAITING_GIVE_UP_MISSES:
+                        self._mark_dead(node)
+
+        # dead + in-pool -> eject (status_update/mod.rs:118-142)
+        if not self.disable_ejection:
+            for node in self.store.node_store.get_nodes():
+                if node.status == NodeStatus.DEAD and self.ledger.is_node_in_pool(
+                    self.pool_id, node.address
+                ):
+                    try:
+                        self.ledger.eject_node(
+                            self.pool_id, node.address, self.wallet.address
+                        )
+                    except LedgerError:
+                        pass
+
+    def _mark_dead(self, node: OrchestratorNode) -> None:
+        self.store.node_store.update_node_status(node.address, NodeStatus.DEAD)
+        # dead nodes lose their metrics (status_update/mod.rs:314-350)
+        self.store.metrics_store.delete_metrics_for_node(node.address)
+        if self.groups_plugin is not None:
+            node.status = NodeStatus.DEAD
+            self.groups_plugin.handle_status_change(node)
+
+    async def group_management_once(self) -> dict:
+        if self.groups_plugin is None:
+            return {}
+        return self.groups_plugin.run_group_management()
+
+    # ================= runner =================
+
+    async def serve(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8090,
+        monitor_interval: float = 10.0,
+        invite_interval: float = 10.0,
+        status_interval: float = 15.0,
+        group_interval: float = 10.0,
+    ) -> web.AppRunner:
+        """Start the HTTP server + background loops (intervals mirror the
+        reference: discovery 10 s, invites 10 s, status 15 s, groups 10 s)."""
+        app = self.make_app()
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+
+        import logging
+
+        log = logging.getLogger("protocol_tpu.orchestrator")
+
+        async def loop(name, fn, interval):
+            while True:
+                try:
+                    await fn()
+                    # beat only on success so /health surfaces a loop that
+                    # fails every tick (loop_heartbeats.rs semantics)
+                    self._beat(name)
+                except Exception:
+                    log.exception("loop %s tick failed", name)
+                await asyncio.sleep(interval)
+
+        app["loops"] = [
+            asyncio.create_task(
+                loop("discovery_monitor", self.discovery_monitor_once, monitor_interval)
+            ),
+            asyncio.create_task(loop("inviter", self.invite_once, invite_interval)),
+            asyncio.create_task(
+                loop("status_updater", self.status_update_once, status_interval)
+            ),
+            asyncio.create_task(
+                loop("group_manager", self.group_management_once, group_interval)
+            ),
+        ]
+        return runner
+
+
+def _err(msg: str, status: int) -> web.Response:
+    return web.json_response({"success": False, "error": msg}, status=status)

@@ -1,0 +1,408 @@
+"""Validator: hardware attestation + synthetic-data work validation.
+
+Reference: crates/validator (5,288 LoC; SURVEY.md §2.6, loop §3.6). Kept:
+
+  - main loop: validate submitted work, fetch non-validated nodes from
+    discovery, stake-gate providers (cached), run hardware challenges
+    (validator/src/main.rs:434-631)
+  - hardware challenge: random dense matmul round-trip, result comparison,
+    then ledger validate_node (validators/hardware.rs:34-97,
+    hardware_challenge.rs). The reference matmuls with nalgebra on CPU;
+    here both sides compute with jnp on their accelerator.
+  - toploc client: external verification service speaking
+    POST /validate/{file} & /validategroup/{file},
+    GET /status/{file} & /statusgroup/{file} ->
+    {status, input_flops, output_flops, failing_indices, reason}; bearer
+    auth; per-model file_prefix_filter routing
+    (validators/synthetic_data/toploc.rs:83-397)
+  - work-key lifecycle in the KV store: work_validation_status:{key},
+    work_info:{key}, rejection zset; sha -> file resolution through the
+    storage mapping; filename-regex grouping
+    ``...-(groupid)-(size)-(filenum)-(idx).ext`` with completeness tracking
+    and an incomplete-group grace window -> soft invalidation; hard
+    invalidation (+penalty) for toploc rejections, soft for work-unit
+    mismatches (validators/synthetic_data/mod.rs:119-1620, types.rs:49-169)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+import numpy as np
+from aiohttp import web
+
+from protocol_tpu.chain import Ledger, LedgerError
+from protocol_tpu.models.node import DiscoveryNode
+from protocol_tpu.security.signer import sign_request
+from protocol_tpu.security.wallet import Wallet
+from protocol_tpu.store.kv import KVStore
+
+STATUS_KEY = "work_validation_status:{}"
+WORK_INFO_KEY = "work_info:{}"
+REJECTIONS_ZSET = "work_rejections"
+GROUP_HASH = "group:{}:{}:{}"  # group_id, size, file_num
+INCOMPLETE_GROUPS_ZSET = "incomplete_groups"
+
+# filename grouping regex (types.rs:113-169)
+GROUP_RE = re.compile(r"-([A-Za-z0-9]+)-(\d+)-(\d+)-(\d+)\.[A-Za-z0-9]+$")
+
+
+class ValidationResult:
+    UNKNOWN = "Unknown"
+    PENDING = "Pending"
+    ACCEPT = "Accept"
+    REJECT = "Reject"
+    CRASHED = "Crashed"
+    WORK_MISMATCH = "WorkUnitsMismatch"
+
+
+@dataclass
+class GroupKey:
+    group_id: str
+    size: int
+    file_num: int
+    index: int
+
+    @classmethod
+    def parse(cls, file_name: str) -> Optional["GroupKey"]:
+        m = GROUP_RE.search(file_name)
+        if not m:
+            return None
+        return cls(m.group(1), int(m.group(2)), int(m.group(3)), int(m.group(4)))
+
+
+class ToplocClient:
+    """HTTP client for the external verification service
+    (toploc.rs:96-397)."""
+
+    def __init__(
+        self,
+        server_url: str,
+        http,
+        auth_token: Optional[str] = None,
+        file_prefix_filter: Optional[str] = None,
+    ):
+        self.server_url = server_url.rstrip("/")
+        self.http = http
+        self.auth_token = auth_token
+        self.file_prefix_filter = file_prefix_filter
+
+    def accepts(self, file_name: str) -> bool:
+        return not self.file_prefix_filter or file_name.startswith(
+            self.file_prefix_filter
+        )
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.auth_token}"} if self.auth_token else {}
+
+    async def trigger(self, file_name: str, group: bool = False) -> bool:
+        kind = "validategroup" if group else "validate"
+        try:
+            async with self.http.post(
+                f"{self.server_url}/{kind}/{file_name}", headers=self._headers()
+            ) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    async def status(self, file_name: str, group: bool = False) -> Optional[dict]:
+        kind = "statusgroup" if group else "status"
+        try:
+            async with self.http.get(
+                f"{self.server_url}/{kind}/{file_name}", headers=self._headers()
+            ) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.json()
+        except Exception:
+            return None
+
+
+class SyntheticDataValidator:
+    """Work-key pipeline (validators/synthetic_data/mod.rs)."""
+
+    def __init__(
+        self,
+        ledger: Ledger,
+        pool_id: int,
+        storage,  # StorageProvider: resolve_mapping_for_sha
+        toploc_clients: list[ToplocClient],
+        kv: Optional[KVStore] = None,
+        penalty: int = 10,
+        grace_period: float = 300.0,
+        work_window: float = 3600.0,
+    ):
+        self.ledger = ledger
+        self.pool_id = pool_id
+        self.storage = storage
+        self.clients = toploc_clients
+        self.kv = kv or KVStore()
+        self.penalty = penalty
+        self.grace_period = grace_period
+        self.work_window = work_window
+
+    def _client_for(self, file_name: str) -> Optional[ToplocClient]:
+        for c in self.clients:
+            if c.accepts(file_name):
+                return c
+        return None
+
+    def get_status(self, work_key: str) -> str:
+        return self.kv.get(STATUS_KEY.format(work_key)) or ValidationResult.UNKNOWN
+
+    def _set_status(self, work_key: str, status: str) -> None:
+        self.kv.set(STATUS_KEY.format(work_key), status)
+        if status in (ValidationResult.REJECT, ValidationResult.WORK_MISMATCH):
+            self.kv.zadd(REJECTIONS_ZSET, {work_key: time.time()})
+
+    async def validate_work_once(self) -> dict:
+        """One tick: discover new work keys, resolve + group, trigger
+        validations, poll statuses, process expired groups."""
+        stats = {"triggered": 0, "accepted": 0, "rejected": 0, "soft": 0}
+        since = time.time() - self.work_window
+        for work in self.ledger.get_work_since(self.pool_id, since):
+            key = work.work_key
+            if self.get_status(key) != ValidationResult.UNKNOWN:
+                continue
+            file_name = await self.storage.resolve_mapping_for_sha(key)
+            if file_name is None:
+                continue  # retried next tick until the mapping lands
+            self.kv.set(
+                WORK_INFO_KEY.format(key),
+                json.dumps(
+                    {"file": file_name, "node": work.node, "units": work.work_units}
+                ),
+            )
+            gk = GroupKey.parse(file_name)
+            if gk is None:
+                client = self._client_for(file_name)
+                if client and await client.trigger(file_name):
+                    self._set_status(key, ValidationResult.PENDING)
+                    stats["triggered"] += 1
+            else:
+                ghash = GROUP_HASH.format(gk.group_id, gk.size, gk.file_num)
+                self.kv.hset(ghash, str(gk.index), key)
+                members = self.kv.hgetall(ghash)
+                self._set_status(key, ValidationResult.PENDING)
+                if len(members) >= gk.size:
+                    # complete group -> group validation trigger
+                    client = self._client_for(file_name)
+                    if client and await client.trigger(file_name, group=True):
+                        stats["triggered"] += 1
+                    self.kv.zrem(INCOMPLETE_GROUPS_ZSET, ghash)
+                else:
+                    if self.kv.zscore(INCOMPLETE_GROUPS_ZSET, ghash) is None:
+                        self.kv.zadd(INCOMPLETE_GROUPS_ZSET, {ghash: time.time()})
+
+        stats.update(await self.poll_statuses_once())
+        stats["expired_groups"] = await self.process_groups_past_grace()
+        return stats
+
+    async def poll_statuses_once(self) -> dict:
+        """Status polling -> accept / hard invalidate (failing indices) /
+        soft invalidate on work-unit mismatch (mod.rs:1248-1356)."""
+        out = {"accepted": 0, "rejected": 0, "soft": 0}
+        for skey in self.kv.keys("work_validation_status:*"):
+            work_key = skey.split(":", 1)[1]
+            if self.kv.get(skey) != ValidationResult.PENDING:
+                continue
+            raw = self.kv.get(WORK_INFO_KEY.format(work_key))
+            if not raw:
+                continue
+            info = json.loads(raw)
+            file_name = info["file"]
+            gk = GroupKey.parse(file_name)
+            client = self._client_for(file_name)
+            if client is None:
+                continue
+            status = await client.status(file_name, group=gk is not None)
+            if not status:
+                continue
+            result = status.get("status")
+            if result == "Accept":
+                claimed = info.get("units", 0)
+                reported = status.get("output_flops")
+                if reported is not None and claimed and reported != claimed:
+                    # work-unit mismatch -> soft invalidate (types.rs:49-62)
+                    self._soft_invalidate(work_key)
+                    out["soft"] += 1
+                else:
+                    self._set_status(work_key, ValidationResult.ACCEPT)
+                    out["accepted"] += 1
+            elif result == "Reject":
+                failing = status.get("failing_indices")
+                if gk is not None and failing is not None:
+                    ghash = GROUP_HASH.format(gk.group_id, gk.size, gk.file_num)
+                    members = self.kv.hgetall(ghash)
+                    for idx_str, member_key in members.items():
+                        if int(idx_str) in failing:
+                            self._hard_invalidate(member_key)
+                            out["rejected"] += 1
+                        elif self.get_status(member_key) == ValidationResult.PENDING:
+                            self._set_status(member_key, ValidationResult.ACCEPT)
+                            out["accepted"] += 1
+                else:
+                    self._hard_invalidate(work_key)
+                    out["rejected"] += 1
+            elif result == "Crashed":
+                self._set_status(work_key, ValidationResult.CRASHED)
+        return out
+
+    async def process_groups_past_grace(self) -> int:
+        """Incomplete groups past the grace window -> soft-invalidate their
+        members (mod.rs:119-308, 1528-1620)."""
+        expired = self.kv.zrangebyscore(
+            INCOMPLETE_GROUPS_ZSET, 0, time.time() - self.grace_period
+        )
+        count = 0
+        for ghash, _ in expired:
+            for member_key in self.kv.hgetall(ghash).values():
+                if self.get_status(member_key) == ValidationResult.PENDING:
+                    self._soft_invalidate(member_key)
+                    count += 1
+            self.kv.zrem(INCOMPLETE_GROUPS_ZSET, ghash)
+        return count
+
+    def _hard_invalidate(self, work_key: str) -> None:
+        try:
+            self.ledger.invalidate_work(self.pool_id, work_key, penalty=self.penalty)
+        except LedgerError:
+            pass
+        self._set_status(work_key, ValidationResult.REJECT)
+
+    def _soft_invalidate(self, work_key: str) -> None:
+        try:
+            self.ledger.soft_invalidate_work(self.pool_id, work_key)
+        except LedgerError:
+            pass
+        self._set_status(work_key, ValidationResult.WORK_MISMATCH)
+
+    def rejections(self) -> list[tuple[str, float]]:
+        return self.kv.zrangebyscore(REJECTIONS_ZSET)
+
+
+DiscoveryFetcher = Callable[[], Awaitable[list[DiscoveryNode]]]
+
+
+class ValidatorService:
+    def __init__(
+        self,
+        wallet: Wallet,
+        ledger: Ledger,
+        pool_id: int,
+        synthetic: Optional[SyntheticDataValidator] = None,
+        discovery_fetcher: Optional[DiscoveryFetcher] = None,
+        http=None,
+        challenge_size: int = 64,
+        challenge_tolerance: float = 1e-2,
+    ):
+        self.wallet = wallet
+        self.ledger = ledger
+        self.pool_id = pool_id
+        self.synthetic = synthetic
+        self.discovery_fetcher = discovery_fetcher
+        self.http = http
+        self.challenge_size = challenge_size
+        self.challenge_tolerance = challenge_tolerance
+        self._stake_cache: dict[str, tuple[bool, float]] = {}
+        self.last_loop = 0.0
+        self.rng = np.random.default_rng(0)
+
+    # ----- hardware validation (validators/hardware.rs) -----
+
+    async def challenge_node(self, control_url: str) -> bool:
+        """Matmul round-trip: both sides compute on their accelerator; the
+        worker's answer must match within tolerance."""
+        import jax.numpy as jnp
+
+        n = self.challenge_size
+        a = self.rng.standard_normal((n, n), dtype=np.float32)
+        b = self.rng.standard_normal((n, n), dtype=np.float32)
+        payload = {"matrix_a": a.tolist(), "matrix_b": b.tolist()}
+        headers, body = sign_request("/control/challenge", self.wallet, payload)
+        try:
+            async with self.http.post(
+                f"{control_url}/challenge", json=body, headers=headers
+            ) as resp:
+                if resp.status != 200:
+                    return False
+                data = await resp.json()
+        except Exception:
+            return False
+        expected = np.asarray(jnp.asarray(a) @ jnp.asarray(b))
+        got = np.asarray(data.get("result", []), dtype=np.float32)
+        if got.shape != expected.shape:
+            return False
+        return bool(np.allclose(got, expected, atol=self.challenge_tolerance * n))
+
+    def _stake_ok(self, provider: str) -> bool:
+        """Stake gate with a per-provider cache (main.rs:561-613)."""
+        cached = self._stake_cache.get(provider)
+        if cached and time.time() - cached[1] < 300:
+            return cached[0]
+        ok = self.ledger.get_stake(provider) >= self.ledger.calculate_stake(
+            self.ledger.get_provider_total_compute(provider)
+        )
+        self._stake_cache[provider] = (ok, time.time())
+        return ok
+
+    async def validation_loop_once(self) -> dict:
+        """One main-loop tick (main.rs:434-631): work validation, then
+        hardware validation of unvalidated nodes (sequential, as the
+        reference requires for signer-nonce safety)."""
+        self.last_loop = time.time()
+        stats: dict = {}
+        if self.synthetic is not None:
+            stats["work"] = await self.synthetic.validate_work_once()
+
+        validated = 0
+        if self.discovery_fetcher is not None:
+            for dn in await self.discovery_fetcher():
+                node_id = dn.node.id
+                if self.ledger.is_node_validated(node_id):
+                    continue
+                if not self._stake_ok(dn.node.provider_address):
+                    continue
+                urls = dn.node.worker_p2p_addresses or []
+                if not urls:
+                    continue
+                if await self.challenge_node(urls[0]):
+                    try:
+                        self.ledger.validate_node(node_id)
+                        validated += 1
+                    except LedgerError:
+                        pass
+        stats["validated_nodes"] = validated
+        return stats
+
+    # ----- HTTP surface (main.rs:90-121, /rejections, /metrics) -----
+
+    def make_app(self, stale_after: float = 120.0) -> web.Application:
+        app = web.Application()
+
+        async def health(request):
+            if time.time() - self.last_loop > stale_after:
+                return web.json_response({"status": "stale"}, status=503)
+            return web.json_response({"status": "ok"})
+
+        async def rejections(request):
+            data = self.synthetic.rejections() if self.synthetic else []
+            return web.json_response(
+                {"success": True, "data": [{"key": k, "at": t} for k, t in data]}
+            )
+
+        async def metrics(request):
+            lines = ["# TYPE validator_rejections_total gauge"]
+            n = len(self.synthetic.rejections()) if self.synthetic else 0
+            lines.append(f"validator_rejections_total {n}")
+            return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+        app.router.add_get("/health", health)
+        app.router.add_get("/rejections", rejections)
+        app.router.add_get("/metrics", metrics)
+        return app

@@ -1,0 +1,9 @@
+"""Shared utilities: storage providers, prometheus text rendering."""
+
+from protocol_tpu.utils.storage import (
+    LocalDirStorageProvider,
+    MockStorageProvider,
+    StorageProvider,
+)
+
+__all__ = ["LocalDirStorageProvider", "MockStorageProvider", "StorageProvider"]

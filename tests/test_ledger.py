@@ -1,0 +1,190 @@
+"""Ledger tests: the economic-substrate lifecycle the reference drives
+through its contract wrappers (register -> stake -> add node -> validate ->
+create/start pool -> signed invite join -> submit work -> invalidate)."""
+
+import time
+
+import pytest
+
+from protocol_tpu.chain import Ledger, LedgerError, PoolStatus
+from protocol_tpu.chain.ledger import invite_digest
+from protocol_tpu.security import Wallet
+
+
+@pytest.fixture
+def world():
+    ledger = Ledger(min_stake_per_compute_unit=10)
+    provider = Wallet.from_seed(b"provider")
+    node = Wallet.from_seed(b"node")
+    manager = Wallet.from_seed(b"pool-manager")
+    ledger.mint(provider.address, 1000)
+    did = ledger.create_domain("synthetic-data", validation_logic="toploc")
+    pid = ledger.create_pool(did, provider.address, manager.address, "gpu:count=1")
+    return ledger, provider, node, manager, did, pid
+
+
+def join(ledger, manager, pid, node, provider):
+    exp = time.time() + 60
+    sig = manager.sign_message(
+        invite_digest(ledger.get_pool_info(pid).domain_id, pid, node.address, "n0nce", exp)
+    )
+    ledger.join_compute_pool(pid, provider.address, node.address, "n0nce", exp, sig)
+
+
+class TestTokenAndStake:
+    def test_mint_transfer(self, world):
+        ledger, provider, *_ = world
+        assert ledger.balance_of(provider.address) == 1000
+        ledger.transfer(provider.address, "0xother", 100)
+        assert ledger.balance_of("0xother") == 100
+
+    def test_register_provider_takes_stake(self, world):
+        ledger, provider, *_ = world
+        ledger.register_provider(provider.address, 100)
+        assert ledger.get_stake(provider.address) == 100
+        assert ledger.balance_of(provider.address) == 900
+
+    def test_register_requires_balance_and_minimum(self, world):
+        ledger, provider, *_ = world
+        with pytest.raises(LedgerError):
+            ledger.register_provider("0xpoor", 100)
+        with pytest.raises(LedgerError):
+            ledger.register_provider(provider.address, 5)  # below min
+
+    def test_reclaim_respects_node_requirements(self, world):
+        ledger, provider, node, *_ = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        with pytest.raises(LedgerError):
+            ledger.reclaim_stake(provider.address, 95)
+        ledger.reclaim_stake(provider.address, 80)
+        assert ledger.get_stake(provider.address) == 20
+
+
+class TestNodesAndPools:
+    def test_full_join_flow(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        ledger.start_pool(pid, provider.address)
+        join(ledger, manager, pid, node, provider)
+        assert ledger.is_node_in_pool(pid, node.address)
+
+    def test_join_requires_validation(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.start_pool(pid, provider.address)
+        with pytest.raises(LedgerError, match="not validated"):
+            join(ledger, manager, pid, node, provider)
+
+    def test_join_requires_valid_signature(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        ledger.start_pool(pid, provider.address)
+        rogue = Wallet.from_seed(b"rogue")
+        exp = time.time() + 60
+        sig = rogue.sign_message(invite_digest(did, pid, node.address, "n0nce", exp))
+        with pytest.raises(LedgerError, match="invalid invite"):
+            ledger.join_compute_pool(pid, provider.address, node.address, "n0nce", exp, sig)
+
+    def test_join_rejects_expired_invite(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        ledger.start_pool(pid, provider.address)
+        exp = time.time() - 1
+        sig = manager.sign_message(invite_digest(did, pid, node.address, "n0nce", exp))
+        with pytest.raises(LedgerError, match="expired"):
+            ledger.join_compute_pool(pid, provider.address, node.address, "n0nce", exp, sig)
+
+    def test_pool_must_be_active(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        with pytest.raises(LedgerError, match="not active"):
+            join(ledger, manager, pid, node, provider)
+
+    def test_eject_and_blacklist(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        ledger.start_pool(pid, provider.address)
+        join(ledger, manager, pid, node, provider)
+
+        ledger.eject_node(pid, node.address, manager.address)
+        assert not ledger.is_node_in_pool(pid, node.address)
+
+        ledger.blacklist_node(pid, node.address, manager.address)
+        with pytest.raises(LedgerError, match="blacklisted"):
+            join(ledger, manager, pid, node, provider)
+
+    def test_eject_requires_authority(self, world):
+        ledger, provider, node, manager, did, pid = world
+        with pytest.raises(LedgerError, match="authorized"):
+            ledger.eject_node(pid, node.address, "0xrandom")
+
+    def test_stake_gates_node_count(self, world):
+        ledger, provider, node, *_ = world
+        ledger.register_provider(provider.address, 10)  # exactly 1 unit
+        ledger.add_compute_node(provider.address, node.address)
+        with pytest.raises(LedgerError, match="insufficient stake"):
+            ledger.add_compute_node(provider.address, "0xsecond")
+
+
+class TestWork:
+    def _join(self, world):
+        ledger, provider, node, manager, did, pid = world
+        ledger.register_provider(provider.address, 100)
+        ledger.add_compute_node(provider.address, node.address)
+        ledger.validate_node(node.address)
+        ledger.start_pool(pid, provider.address)
+        join(ledger, manager, pid, node, provider)
+        return ledger, node, pid
+
+    def test_submit_and_query(self, world):
+        ledger, node, pid = self._join(world)
+        t0 = time.time()
+        ledger.submit_work(pid, node.address, "sha-1", 500)
+        assert ledger.get_work_keys(pid) == ["sha-1"]
+        info = ledger.get_work_info(pid, "sha-1")
+        assert info.work_units == 500
+        assert ledger.get_rewards(node.address) == 500
+        assert [w.work_key for w in ledger.get_work_since(pid, t0 - 1)] == ["sha-1"]
+
+    def test_duplicate_work_key_rejected(self, world):
+        ledger, node, pid = self._join(world)
+        ledger.submit_work(pid, node.address, "sha-1", 500)
+        with pytest.raises(LedgerError, match="already submitted"):
+            ledger.submit_work(pid, node.address, "sha-1", 1)
+
+    def test_submit_requires_pool_membership(self, world):
+        ledger, provider, node, manager, did, pid = world
+        with pytest.raises(LedgerError, match="unknown node|not in pool"):
+            ledger.submit_work(pid, node.address, "sha-1", 1)
+
+    def test_hard_invalidate_slashes(self, world):
+        ledger, node, pid = self._join(world)
+        ledger.submit_work(pid, node.address, "sha-1", 500)
+        provider_addr = ledger.get_node(node.address).provider
+        stake_before = ledger.get_stake(provider_addr)
+        ledger.invalidate_work(pid, "sha-1", penalty=30)
+        assert ledger.get_rewards(node.address) == 0
+        assert ledger.get_stake(provider_addr) == stake_before - 30
+        assert ledger.get_work_info(pid, "sha-1").invalidated
+
+    def test_soft_invalidate_no_slash(self, world):
+        ledger, node, pid = self._join(world)
+        ledger.submit_work(pid, node.address, "sha-1", 500)
+        provider_addr = ledger.get_node(node.address).provider
+        stake_before = ledger.get_stake(provider_addr)
+        ledger.soft_invalidate_work(pid, "sha-1")
+        assert ledger.get_rewards(node.address) == 0
+        assert ledger.get_stake(provider_addr) == stake_before
+        assert ledger.get_work_info(pid, "sha-1").soft_invalidated

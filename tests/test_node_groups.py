@@ -1,0 +1,297 @@
+"""Node-groups plugin tests: formation (batched eligibility + proximity),
+merge, dissolve, task binding (SET-NX), ring-variable expansion — mirroring
+the scenarios of the reference's node_groups test module."""
+
+import random
+
+from protocol_tpu.models import (
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    NodeLocation,
+    SchedulingConfig,
+    Task,
+    TaskState,
+)
+from protocol_tpu.sched.node_groups import (
+    ENABLED_CONFIGS,
+    GROUP_TASK_KEY,
+    NodeGroup,
+    NodeGroupConfiguration,
+    NodeGroupsPlugin,
+    TaskSwitchingPolicy,
+)
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+
+def mk_node(addr, gpu="H100", count=8, status=NodeStatus.HEALTHY, p2p=True, loc=None):
+    return OrchestratorNode(
+        address=addr,
+        status=status,
+        p2p_id=f"p2p-{addr}" if p2p else None,
+        p2p_addresses=[f"/ip4/10.0.0.1/tcp/4001/p2p/{addr}"] if p2p else None,
+        compute_specs=ComputeSpecs(
+            gpu=GpuSpecs(count=count, model=gpu, memory_mb=80000),
+            cpu=CpuSpecs(cores=32),
+            ram_mb=65536,
+            storage_gb=1000,
+        ),
+        location=loc,
+    )
+
+
+def mk_topo_task(name, topologies, created_at=100):
+    return Task(
+        name=name,
+        image="img",
+        created_at=created_at,
+        state=TaskState.PENDING,
+        scheduling_config=SchedulingConfig(
+            plugins={"node_groups": {"allowed_topologies": topologies}}
+        ),
+    )
+
+
+def make_plugin(ctx, configs, policy=TaskSwitchingPolicy.IF_SAME_TASK, seed=0):
+    p = NodeGroupsPlugin(ctx, configs, merge_policy=policy, rng=random.Random(seed))
+    p.attach_observers()
+    return p
+
+
+CFG2 = NodeGroupConfiguration(name="pair", min_group_size=2, max_group_size=2)
+CFG4 = NodeGroupConfiguration(
+    name="quad-h100",
+    min_group_size=4,
+    max_group_size=4,
+    compute_requirements="gpu:count=8;gpu:model=H100",
+)
+
+
+class TestConfigOrdering:
+    def test_sorted_larger_min_then_specific(self):
+        ctx = StoreContext.new_test()
+        loose4 = NodeGroupConfiguration(name="quad-any", min_group_size=4, max_group_size=8)
+        p = make_plugin(ctx, [CFG2, loose4, CFG4])
+        assert [c.name for c in p.configurations] == ["quad-h100", "quad-any", "pair"]
+
+    def test_invalid_bounds_rejected(self):
+        import pytest
+
+        ctx = StoreContext.new_test()
+        with pytest.raises(ValueError):
+            make_plugin(ctx, [NodeGroupConfiguration(name="bad", min_group_size=3, max_group_size=2)])
+
+
+class TestEnableDisable:
+    def test_task_lifecycle_toggles_configs(self):
+        ctx = StoreContext.new_test()
+        make_plugin(ctx, [CFG2, CFG4])
+        t = mk_topo_task("train", ["quad-h100"])
+        ctx.task_store.add_task(t)
+        assert ctx.kv.smembers(ENABLED_CONFIGS) == {"quad-h100"}
+        ctx.task_store.delete_task(t.id)
+        assert ctx.kv.smembers(ENABLED_CONFIGS) == set()
+
+
+class TestFormation:
+    def test_forms_group_when_enough_eligible(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG4])
+        for i in range(5):
+            ctx.node_store.add_node(mk_node(f"0x{i}"))
+        ctx.task_store.add_task(mk_topo_task("train", ["quad-h100"]))
+        stats = plugin.run_group_management()
+        assert stats["formed"] == 1
+        groups = plugin.get_groups()
+        assert len(groups) == 1 and len(groups[0].nodes) == 4
+
+    def test_requirements_gate_formation(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG4])
+        for i in range(3):
+            ctx.node_store.add_node(mk_node(f"0xh{i}", gpu="H100"))
+        for i in range(4):
+            ctx.node_store.add_node(mk_node(f"0xa{i}", gpu="A100"))
+        ctx.task_store.add_task(mk_topo_task("train", ["quad-h100"]))
+        assert plugin.run_group_management()["formed"] == 0  # only 3 H100s
+
+        ctx.node_store.add_node(mk_node("0xh3", gpu="H100"))
+        assert plugin.run_group_management()["formed"] == 1
+        group = plugin.get_groups()[0]
+        assert all(a.startswith("0xh") for a in group.nodes)
+
+    def test_unhealthy_or_no_p2p_excluded(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        ctx.node_store.add_node(mk_node("0xa"))
+        ctx.node_store.add_node(mk_node("0xb", status=NodeStatus.UNHEALTHY))
+        ctx.node_store.add_node(mk_node("0xc", p2p=False))
+        ctx.task_store.add_task(mk_topo_task("t", ["pair"]))
+        assert plugin.run_group_management()["formed"] == 0
+
+    def test_proximity_seeding(self):
+        """Nearest nodes group together: 2 in Paris + 2 in Tokyo + config
+        max=2 -> the Paris pair forms one group, Tokyo pair the other."""
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        paris = NodeLocation(latitude=48.85, longitude=2.35)
+        paris2 = NodeLocation(latitude=48.80, longitude=2.30)
+        tokyo = NodeLocation(latitude=35.68, longitude=139.69)
+        tokyo2 = NodeLocation(latitude=35.60, longitude=139.60)
+        ctx.node_store.add_node(mk_node("0xp1", loc=paris))
+        ctx.node_store.add_node(mk_node("0xt1", loc=tokyo))
+        ctx.node_store.add_node(mk_node("0xp2", loc=paris2))
+        ctx.node_store.add_node(mk_node("0xt2", loc=tokyo2))
+        ctx.task_store.add_task(mk_topo_task("t", ["pair"]))
+        assert plugin.run_group_management()["formed"] == 2
+        memberships = [set(g.nodes) for g in plugin.get_groups()]
+        assert {"0xp1", "0xp2"} in memberships
+        assert {"0xt1", "0xt2"} in memberships
+
+    def test_nodes_not_double_grouped(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        for i in range(4):
+            ctx.node_store.add_node(mk_node(f"0x{i}"))
+        ctx.task_store.add_task(mk_topo_task("t", ["pair"]))
+        assert plugin.run_group_management()["formed"] == 2
+        assert plugin.run_group_management()["formed"] == 0  # all grouped
+
+
+class TestDissolve:
+    def test_status_change_dissolves_group(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        ctx.node_store.add_node(mk_node("0xa"))
+        ctx.node_store.add_node(mk_node("0xb"))
+        ctx.task_store.add_task(mk_topo_task("t", ["pair"]))
+        plugin.run_group_management()
+        assert len(plugin.get_groups()) == 1
+
+        node = ctx.node_store.get_node("0xa")
+        node.status = NodeStatus.DEAD
+        ctx.node_store.update_node(node)
+        plugin.handle_status_change(node)
+        assert plugin.get_groups() == []
+        assert plugin.group_for_node("0xb") is None
+
+    def test_task_delete_dissolves_its_groups(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        ctx.node_store.add_node(mk_node("0xa"))
+        ctx.node_store.add_node(mk_node("0xb"))
+        t = mk_topo_task("t", ["pair"])
+        ctx.task_store.add_task(t)
+        plugin.run_group_management()
+        group = plugin.get_groups()[0]
+        # bind the group to the task via the scheduler path
+        node = ctx.node_store.get_node("0xa")
+        assert plugin.filter_tasks([t], node)
+        ctx.task_store.delete_task(t.id)
+        assert plugin.get_groups() == []
+
+    def test_stale_mapping_recovered(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        ctx.kv.hset("node_to_group", "0xa", "ghost-group")
+        assert plugin.group_for_node("0xa") is None
+        assert ctx.kv.hget("node_to_group", "0xa") is None
+
+
+class TestMerge:
+    def _solo(self, plugin, ctx, addr):
+        ctx.node_store.add_node(mk_node(addr))
+        return plugin._create_group(
+            NodeGroupConfiguration(name="elastic", min_group_size=1, max_group_size=4),
+            [addr],
+        )
+
+    def test_merge_solo_groups(self):
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="elastic", min_group_size=1, max_group_size=4)
+        plugin = make_plugin(ctx, [cfg])
+        g1 = self._solo(plugin, ctx, "0xa")
+        g2 = self._solo(plugin, ctx, "0xb")
+        g3 = self._solo(plugin, ctx, "0xc")
+        assert plugin.try_merge_solo_groups() == 1
+        groups = plugin.get_groups()
+        assert len(groups) == 1 and len(groups[0].nodes) == 3
+
+    def test_merge_respects_never_policy(self):
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="elastic", min_group_size=1, max_group_size=4)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.NEVER)
+        self._solo(plugin, ctx, "0xa")
+        self._solo(plugin, ctx, "0xb")
+        assert plugin.try_merge_solo_groups() == 0
+
+    def test_if_same_task_policy_buckets(self):
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="elastic", min_group_size=1, max_group_size=4)
+        plugin = make_plugin(ctx, [cfg])
+        g1 = self._solo(plugin, ctx, "0xa")
+        g2 = self._solo(plugin, ctx, "0xb")
+        g3 = self._solo(plugin, ctx, "0xc")
+        ctx.kv.set(GROUP_TASK_KEY.format(g1.id), "task-1")
+        ctx.kv.set(GROUP_TASK_KEY.format(g2.id), "task-1")
+        ctx.kv.set(GROUP_TASK_KEY.format(g3.id), "task-2")
+        assert plugin.try_merge_solo_groups() == 1  # only the task-1 pair
+        merged = [g for g in plugin.get_groups() if len(g.nodes) == 2][0]
+        assert ctx.kv.get(GROUP_TASK_KEY.format(merged.id)) == "task-1"
+
+
+class TestSchedulerFilter:
+    def _grouped_pair(self):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, [CFG2])
+        ctx.node_store.add_node(mk_node("0xa"))
+        ctx.node_store.add_node(mk_node("0xb"))
+        task = mk_topo_task("ring-train", ["pair"])
+        task.env_vars = {
+            "RANK": "${GROUP_INDEX}",
+            "WORLD": "${GROUP_SIZE}",
+            "NEXT": "${NEXT_P2P_ADDRESS}",
+            "GID": "${GROUP_ID}",
+        }
+        ctx.task_store.add_task(task)
+        plugin.run_group_management()
+        return ctx, plugin, task
+
+    def test_ungrouped_node_gets_nothing(self):
+        ctx, plugin, task = self._grouped_pair()
+        ctx.node_store.add_node(mk_node("0xc"))
+        node = ctx.node_store.get_node("0xc")
+        assert plugin.filter_tasks([task], node) == []
+
+    def test_group_task_binding_is_stable(self):
+        ctx, plugin, task = self._grouped_pair()
+        other = mk_topo_task("other", ["pair"], created_at=200)
+        na = ctx.node_store.get_node("0xa")
+        nb = ctx.node_store.get_node("0xb")
+        first = plugin.filter_tasks([task, other], na)[0]
+        second = plugin.filter_tasks([task, other], nb)[0]
+        assert first.id == second.id  # SET NX: both members see one task
+
+    def test_ring_variable_expansion(self):
+        ctx, plugin, task = self._grouped_pair()
+        group = plugin.get_groups()[0]
+        a_idx = group.nodes.index("0xa")
+        na = ctx.node_store.get_node("0xa")
+        got = plugin.filter_tasks([task], na)[0]
+        assert got.env_vars["RANK"] == str(a_idx)
+        assert got.env_vars["WORLD"] == "2"
+        assert got.env_vars["GID"] == group.id
+        # ring neighbor of a 2-group is the other member
+        other = group.nodes[(a_idx + 1) % 2]
+        assert other in got.env_vars["NEXT"]
+        # original task untouched
+        assert task.env_vars["RANK"] == "${GROUP_INDEX}"
+
+    def test_deleted_bound_task_rebinds(self):
+        ctx, plugin, task = self._grouped_pair()
+        na = ctx.node_store.get_node("0xa")
+        plugin.filter_tasks([task], na)
+        other = mk_topo_task("other", ["pair"], created_at=200)
+        # bound task vanishes from the task list -> rebind to applicable one
+        got = plugin.filter_tasks([other], na)
+        assert got and got[0].name == "other"

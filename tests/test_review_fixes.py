@@ -49,18 +49,39 @@ def test_fallback_respects_replica_bound():
     assert sum(1 for t in got if t is not None) == 2
 
 
-def test_uncovered_node_still_falls_back():
-    """Nodes the batch never considered (e.g. added after the solve, below
-    the dirty threshold) fall through to the greedy chain."""
+def test_uncovered_node_triggers_resolve_never_greedy():
+    """A node the batch never considered marks the matcher dirty and gets a
+    fresh solve — it must NOT fall through to an ungated greedy pick."""
     ctx = StoreContext.new_test()
     ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
     ctx.task_store.add_task(mk_task("t", created_at=100))
-    matcher = TpuBatchMatcher(ctx, min_solve_interval=3600)
+    clock = [1000.0]
+    matcher = TpuBatchMatcher(ctx, min_solve_interval=10.0, time_fn=lambda: clock[0])
     sched = Scheduler(ctx, batch_matcher=matcher)
     assert sched.get_task_for_node("0xa").name == "t"
-    # new node arrives; matcher throttled -> not covered -> greedy fallback
+
     ctx.node_store.add_node(mk_node("0xlate", gpu_model="H100", gpu_count=8))
+    # throttled: the new node waits for the next solve window, no fallback
+    assert sched.get_task_for_node("0xlate") is None
+    clock[0] += 11
     assert sched.get_task_for_node("0xlate").name == "t"
+
+
+def test_uncovered_node_cannot_bypass_replica_bound():
+    """The scenario from review: replicas=1 task fully assigned; a late
+    node must not receive it via any fallback."""
+    ctx = StoreContext.new_test()
+    ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
+    bounded = mk_task(
+        "one-replica", created_at=100,
+        sched_plugins={"tpu_scheduler": {"replicas": ["1"]}},
+    )
+    ctx.task_store.add_task(bounded)
+    matcher = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+    sched = Scheduler(ctx, batch_matcher=matcher)
+    assert sched.get_task_for_node("0xa").name == "one-replica"
+    ctx.node_store.add_node(mk_node("0xlate", gpu_model="H100", gpu_count=8))
+    assert sched.get_task_for_node("0xlate") is None
 
 
 def test_malformed_plugin_config_rejected_at_creation():

@@ -1,0 +1,211 @@
+"""Security layer tests: wallet signatures, request signing round-trips, and
+the aiohttp signature middleware (sig, nonce replay, rate limit, api key) —
+mirroring the reference's middleware test coverage."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.security import Wallet, sign_request, verify_request, verify_signature
+from protocol_tpu.security.middleware import (
+    RateLimiter,
+    api_key_middleware,
+    validate_signature_middleware,
+)
+from protocol_tpu.security.signer import canonical_json
+from protocol_tpu.store.kv import KVStore
+
+
+class TestWallet:
+    def test_sign_verify_roundtrip(self):
+        w = Wallet()
+        sig = w.sign_message("hello")
+        assert verify_signature("hello", sig, w.address)
+
+    def test_wrong_message_rejected(self):
+        w = Wallet()
+        sig = w.sign_message("hello")
+        assert not verify_signature("other", sig, w.address)
+
+    def test_wrong_address_rejected(self):
+        w, w2 = Wallet(), Wallet()
+        sig = w.sign_message("hello")
+        assert not verify_signature("hello", sig, w2.address)
+
+    def test_garbage_signature(self):
+        assert not verify_signature("m", "nonsense", "0xabc")
+        assert not verify_signature("m", "aa:bb", "0xabc")
+
+    def test_deterministic_from_seed(self):
+        a = Wallet.from_seed(b"x" * 32)
+        b = Wallet.from_seed(b"x" * 32)
+        assert a.address == b.address
+
+    def test_hex_roundtrip(self):
+        w = Wallet()
+        w2 = Wallet.from_hex(w.private_key_hex())
+        assert w.address == w2.address
+
+
+class TestSigner:
+    def test_signed_body_roundtrip(self):
+        w = Wallet()
+        headers, body = sign_request("/heartbeat", w, {"address": w.address, "b": 1})
+        assert "nonce" in body
+        assert verify_request("/heartbeat", headers, body) == w.address
+
+    def test_get_request_roundtrip(self):
+        w = Wallet()
+        headers, body = sign_request("/api/pool/0", w)
+        assert body is None
+        assert verify_request("/api/pool/0", headers) == w.address
+
+    def test_tampered_body_rejected(self):
+        w = Wallet()
+        headers, body = sign_request("/x", w, {"v": 1})
+        body["v"] = 2
+        assert verify_request("/x", headers, body) is None
+
+    def test_wrong_endpoint_rejected(self):
+        w = Wallet()
+        headers, body = sign_request("/x", w, {"v": 1})
+        assert verify_request("/y", headers, body) is None
+
+    def test_canonical_json_sorts_keys(self):
+        assert canonical_json({"b": 1, "a": {"d": 2, "c": 3}}) == '{"a":{"c":3,"d":2},"b":1}'
+
+
+class TestRateLimiter:
+    def test_limits_within_window(self):
+        rl = RateLimiter(limit=3, window=60)
+        assert all(rl.allow("a", now=0.0) for _ in range(3))
+        assert not rl.allow("a", now=1.0)
+        assert rl.allow("b", now=1.0)  # other address unaffected
+        assert rl.allow("a", now=61.0)  # window rolls
+
+
+def make_app(kv, **mw_kwargs):
+    async def echo(request):
+        return web.json_response(
+            {"success": True, "address": request.get("auth_address")}
+        )
+
+    app = web.Application(
+        middlewares=[
+            validate_signature_middleware(kv, ["/signed"], **mw_kwargs),
+            api_key_middleware("admin-key", ["/admin"]),
+        ]
+    )
+    app.router.add_post("/signed/echo", echo)
+    app.router.add_get("/open", echo)
+    app.router.add_get("/admin/list", echo)
+    return app
+
+
+async def _request(app, method, path, headers=None, body=None):
+    async with TestClient(TestServer(app)) as client:
+        resp = await client.request(
+            method, path, headers=headers or {},
+            data=json.dumps(body) if body is not None else None,
+        )
+        return resp.status, await resp.json()
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestSignatureMiddleware:
+    def test_valid_signature_passes(self):
+        kv = KVStore()
+        w = Wallet()
+        headers, body = sign_request("/signed/echo", w, {"hello": 1})
+        status, data = run(_request(make_app(kv), "POST", "/signed/echo", headers, body))
+        assert status == 200 and data["address"] == w.address
+
+    def test_missing_headers_rejected(self):
+        status, _ = run(_request(make_app(KVStore()), "POST", "/signed/echo", {}, {"a": 1}))
+        assert status == 401
+
+    def test_nonce_replay_rejected(self):
+        kv = KVStore()
+        w = Wallet()
+        app = make_app(kv)
+
+        async def replay():
+            async with TestClient(TestServer(app)) as client:
+                headers, body = sign_request("/signed/echo", w, {"hello": 1})
+                r1 = await client.post("/signed/echo", headers=headers, data=json.dumps(body))
+                r2 = await client.post("/signed/echo", headers=headers, data=json.dumps(body))
+                return r1.status, r2.status
+
+        s1, s2 = run(replay())
+        assert s1 == 200 and s2 == 401
+
+    def test_tampered_body_rejected(self):
+        kv = KVStore()
+        w = Wallet()
+        headers, body = sign_request("/signed/echo", w, {"hello": 1})
+        body["hello"] = 2
+        status, _ = run(_request(make_app(kv), "POST", "/signed/echo", headers, body))
+        assert status == 401
+
+    def test_unprotected_route_open(self):
+        status, _ = run(_request(make_app(KVStore()), "GET", "/open"))
+        assert status == 200
+
+    def test_allow_list(self):
+        kv = KVStore()
+        w = Wallet()
+        headers, body = sign_request("/signed/echo", w, {"a": 1})
+        status, _ = run(
+            _request(make_app(kv, allowed_addresses=["0xother"]), "POST", "/signed/echo", headers, body)
+        )
+        assert status == 401
+
+    def test_async_validator(self):
+        kv = KVStore()
+        w = Wallet()
+
+        async def reject_all(addr):
+            return False
+
+        headers, body = sign_request("/signed/echo", w, {"a": 1})
+        status, _ = run(
+            _request(make_app(kv, validator=reject_all), "POST", "/signed/echo", headers, body)
+        )
+        assert status == 401
+
+    def test_rate_limit(self):
+        kv = KVStore()
+        w = Wallet()
+        app = make_app(kv, rate_limiter=RateLimiter(limit=2))
+
+        async def burst():
+            async with TestClient(TestServer(app)) as client:
+                statuses = []
+                for _ in range(3):
+                    headers, body = sign_request("/signed/echo", w, {"a": 1})
+                    r = await client.post("/signed/echo", headers=headers, data=json.dumps(body))
+                    statuses.append(r.status)
+                return statuses
+
+        assert run(burst()) == [200, 200, 429]
+
+
+class TestApiKeyMiddleware:
+    def test_admin_requires_key(self):
+        async def flow():
+            app = make_app(KVStore())
+            async with TestClient(TestServer(app)) as client:
+                r1 = await client.get("/admin/list")
+                r2 = await client.get(
+                    "/admin/list", headers={"Authorization": "Bearer admin-key"}
+                )
+                return r1.status, r2.status
+
+        s1, s2 = run(flow())
+        assert s1 == 401 and s2 == 200

@@ -1,0 +1,606 @@
+"""Service-level tests: discovery registration gates, orchestrator routes +
+health FSM, validator synthetic-data pipeline against a mock toploc server."""
+
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.models import ComputeSpecs, CpuSpecs, GpuSpecs, Node
+from protocol_tpu.models.heartbeat import HeartbeatRequest
+from protocol_tpu.security import Wallet, sign_request
+from protocol_tpu.services.discovery import DiscoveryService
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.services.validator import (
+    GroupKey,
+    SyntheticDataValidator,
+    ToplocClient,
+    ValidationResult,
+)
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.utils.storage import MockStorageProvider
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def specs():
+    return ComputeSpecs(
+        gpu=GpuSpecs(count=8, model="H100", memory_mb=80000),
+        cpu=CpuSpecs(cores=32),
+        ram_mb=65536,
+        storage_gb=2000,
+    )
+
+
+def make_world(pool_requirements=""):
+    ledger = Ledger()
+    creator = Wallet.from_seed(b"creator")
+    manager = Wallet.from_seed(b"manager")
+    provider = Wallet.from_seed(b"provider-1")
+    node = Wallet.from_seed(b"node-1")
+    ledger.mint(provider.address, 1000)
+    did = ledger.create_domain("synth")
+    pid = ledger.create_pool(did, creator.address, manager.address, pool_requirements)
+    ledger.start_pool(pid, creator.address)
+    ledger.register_provider(provider.address, 100)
+    ledger.add_compute_node(provider.address, node.address)
+    return ledger, creator, manager, provider, node, pid
+
+
+class TestDiscovery:
+    def _node_payload(self, node_wallet, provider_wallet, pid, with_specs=True):
+        return Node(
+            id=node_wallet.address,
+            provider_address=provider_wallet.address,
+            ip_address="10.0.0.1",
+            port=8091,
+            compute_pool_id=pid,
+            compute_specs=specs() if with_specs else None,
+        ).to_dict()
+
+    def test_register_and_read(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = DiscoveryService(ledger, pid)
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = self._node_payload(node, provider, pid)
+                headers, body = sign_request("/api/nodes", node, payload)
+                r = await client.put("/api/nodes", json=body, headers=headers)
+                assert r.status == 200, await r.text()
+
+                # unvalidated -> /api/validator view (signed)
+                h2, _ = sign_request("/api/validator", manager)
+                r2 = await client.get("/api/validator", headers=h2)
+                data = await r2.json()
+                assert len(data["data"]) == 1
+
+                # validate on ledger -> chain sync -> pool view
+                ledger.validate_node(node.address)
+                svc.chain_sync_once()
+                h3, _ = sign_request(f"/api/pool/{pid}", manager)
+                r3 = await client.get(f"/api/pool/{pid}", headers=h3)
+                pool_nodes = (await r3.json())["data"]
+                assert [n["id"] for n in pool_nodes] == [node.address]
+
+        run(flow())
+
+    def test_register_rejects_wrong_address(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = DiscoveryService(ledger, pid)
+        rogue = Wallet.from_seed(b"rogue")
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = self._node_payload(node, provider, pid)
+                headers, body = sign_request("/api/nodes", rogue, payload)
+                r = await client.put("/api/nodes", json=body, headers=headers)
+                assert r.status == 401
+
+        run(flow())
+
+    def test_register_requires_ledger_node(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = DiscoveryService(ledger, pid)
+        ghost = Wallet.from_seed(b"ghost")
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = self._node_payload(ghost, provider, pid)
+                headers, body = sign_request("/api/nodes", ghost, payload)
+                r = await client.put("/api/nodes", json=body, headers=headers)
+                assert r.status == 400
+
+        run(flow())
+
+    def test_pool_requirements_gate(self):
+        ledger, creator, manager, provider, node, pid = make_world(
+            pool_requirements="gpu:count=8;gpu:model=B200"
+        )
+        svc = DiscoveryService(ledger, pid)
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = self._node_payload(node, provider, pid)  # H100 specs
+                headers, body = sign_request("/api/nodes", node, payload)
+                r = await client.put("/api/nodes", json=body, headers=headers)
+                assert r.status == 400
+                assert "requirements" in (await r.json())["error"]
+
+        run(flow())
+
+    def test_active_node_immutable_except_p2p(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = DiscoveryService(ledger, pid)
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = self._node_payload(node, provider, pid)
+                headers, body = sign_request("/api/nodes", node, payload)
+                assert (await client.put("/api/nodes", json=body, headers=headers)).status == 200
+                # mark active (as chain sync would after pool join)
+                dn = svc.store.get(node.address)
+                dn.is_active = True
+                svc.store.put(dn)
+                # re-register with different ip + p2p: only p2p sticks
+                payload2 = dict(payload)
+                payload2["ip_address"] = "99.9.9.9"
+                payload2["worker_p2p_id"] = "p2p-new"
+                h2, b2 = sign_request("/api/nodes", node, payload2)
+                r = await client.put("/api/nodes", json=b2, headers=h2)
+                assert r.status == 200
+                dn2 = svc.store.get(node.address)
+                assert dn2.node.ip_address == "10.0.0.1"
+                assert dn2.node.worker_p2p_id == "p2p-new"
+
+        run(flow())
+
+    def test_per_ip_cap(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        for i in range(2, 5):
+            w = Wallet.from_seed(f"node-{i}".encode())
+            ledger.add_compute_node(provider.address, w.address)
+        svc = DiscoveryService(ledger, pid, max_nodes_per_ip=2)
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                statuses = []
+                for i in [1, 2, 3, 4]:
+                    w = Wallet.from_seed(f"node-{i}".encode())
+                    payload = self._node_payload(w, provider, pid)
+                    headers, body = sign_request("/api/nodes", w, payload)
+                    r = await client.put("/api/nodes", json=body, headers=headers)
+                    statuses.append(r.status)
+                return statuses
+
+        statuses = run(flow())
+        assert statuses[:2] == [200, 200]
+        assert 429 in statuses[2:]
+
+    def test_platform_requires_api_key(self):
+        ledger, *_, pid = make_world()
+        svc = DiscoveryService(ledger, pid, admin_api_key="k")
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                r1 = await client.get("/api/platform")
+                r2 = await client.get(
+                    "/api/platform", headers={"Authorization": "Bearer k"}
+                )
+                return r1.status, r2.status
+
+        assert run(flow()) == (401, 200)
+
+
+class TestOrchestratorRoutes:
+    def _svc(self, groups=None):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = OrchestratorService(
+            ledger, pid, manager, groups_plugin=groups, storage=MockStorageProvider()
+        )
+        return svc, node, manager
+
+    def test_heartbeat_flow(self):
+        svc, node, _ = self._svc()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+        from protocol_tpu.models.task import Task, TaskState
+
+        svc.store.task_store.add_task(Task(name="t", image="i", created_at=1, state=TaskState.PENDING))
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = {
+                    "address": node.address,
+                    "task_state": "RUNNING",
+                    "metrics": [
+                        {"key": {"task_id": "t1", "label": "loss"}, "value": 0.7}
+                    ],
+                }
+                headers, body = sign_request("/heartbeat", node, payload)
+                r = await client.post("/heartbeat", json=body, headers=headers)
+                assert r.status == 200, await r.text()
+                data = await r.json()
+                assert data["data"]["current_task"]["name"] == "t"
+
+        run(flow())
+        assert svc.store.heartbeat_store.get_heartbeat(node.address) is not None
+        assert svc.store.metrics_store.get_metrics_for_task("t1") == {
+            "loss": {node.address: 0.7}
+        }
+
+    def test_heartbeat_rejects_unknown_node(self):
+        svc, node, _ = self._svc()
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                headers, body = sign_request(
+                    "/heartbeat", node, {"address": node.address}
+                )
+                return (await client.post("/heartbeat", json=body, headers=headers)).status
+
+        assert run(flow()) == 401
+
+    def test_banned_node_rejected(self):
+        svc, node, _ = self._svc()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+        svc.store.kv.set(f"orchestrator:banned:{node.address}", "1")
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                headers, body = sign_request(
+                    "/heartbeat", node, {"address": node.address}
+                )
+                return (await client.post("/heartbeat", json=body, headers=headers)).status
+
+        assert run(flow()) == 401
+
+    def test_task_crud_and_name_uniqueness(self):
+        svc, *_ = self._svc()
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                auth = {"Authorization": "Bearer admin"}
+                t = {"name": "a", "image": "img"}
+                r1 = await client.post("/tasks", json=t, headers=auth)
+                r2 = await client.post("/tasks", json=t, headers=auth)
+                r3 = await client.get("/tasks", headers=auth)
+                tid = (await r1.json())["data"]["id"]
+                r4 = await client.delete(f"/tasks/{tid}", headers=auth)
+                return r1.status, r2.status, len((await r3.json())["data"]), r4.status
+
+        assert run(flow()) == (201, 409, 1, 200)
+
+    def test_storage_upload_flow(self):
+        svc, node, _ = self._svc()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = {
+                    "file_name": "out.parquet",
+                    "file_size": 1024,
+                    "file_type": "application/octet-stream",
+                    "sha256": "abc123",
+                }
+                headers, body = sign_request(
+                    "/storage/request-upload", node, payload
+                )
+                r = await client.post(
+                    "/storage/request-upload", json=body, headers=headers
+                )
+                assert r.status == 200, await r.text()
+                return (await r.json())["data"]
+
+        data = run(flow())
+        assert data["signed_url"].startswith("mock://upload/")
+        assert run(svc.storage.resolve_mapping_for_sha("abc123")) == "out.parquet"
+
+    def test_storage_rate_limit(self):
+        svc, node, _ = self._svc()
+        svc.uploads_per_hour = 1
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                statuses = []
+                for _ in range(2):
+                    payload = {
+                        "file_name": "f",
+                        "file_size": 1,
+                        "file_type": "x",
+                        "sha256": "s",
+                    }
+                    headers, body = sign_request(
+                        "/storage/request-upload", node, payload
+                    )
+                    r = await client.post(
+                        "/storage/request-upload", json=body, headers=headers
+                    )
+                    statuses.append(r.status)
+                return statuses
+
+        assert run(flow()) == [200, 429]
+
+    def test_prometheus_exposition(self):
+        svc, node, _ = self._svc()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                r = await client.get(
+                    "/metrics/prometheus", headers={"Authorization": "Bearer admin"}
+                )
+                return await r.text()
+
+        text = run(flow())
+        assert 'orchestrator_nodes_total{status="Healthy"} 1' in text
+
+
+class TestStatusFSM:
+    def _world(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        svc = OrchestratorService(ledger, pid, manager)
+        return svc, ledger, manager, provider, node, pid
+
+    def test_heartbeat_present_in_pool_becomes_healthy(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        ledger.validate_node(node.address)
+        # join pool via signed invite
+        from protocol_tpu.chain.ledger import invite_digest
+
+        exp = time.time() + 60
+        sig = manager.sign_message(invite_digest(0, pid, node.address, "n", exp))
+        ledger.join_compute_pool(pid, provider.address, node.address, "n", exp, sig)
+
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.WAITING_FOR_HEARTBEAT)
+        )
+        svc.store.heartbeat_store.beat(HeartbeatRequest(address=node.address))
+        run(svc.status_update_once())
+        assert svc.store.node_store.get_node(node.address).status == NodeStatus.HEALTHY
+
+    def test_heartbeat_present_not_in_pool_unhealthy(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.WAITING_FOR_HEARTBEAT)
+        )
+        svc.store.heartbeat_store.beat(HeartbeatRequest(address=node.address))
+        run(svc.status_update_once())
+        assert svc.store.node_store.get_node(node.address).status == NodeStatus.UNHEALTHY
+
+    def test_missing_beats_healthy_to_dead(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+        run(svc.status_update_once())  # -> Unhealthy (miss 1)
+        assert svc.store.node_store.get_node(node.address).status == NodeStatus.UNHEALTHY
+        run(svc.status_update_once())  # miss 2
+        run(svc.status_update_once())  # miss 3 -> Dead
+        assert svc.store.node_store.get_node(node.address).status == NodeStatus.DEAD
+
+    def test_dead_in_pool_gets_ejected(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        ledger.validate_node(node.address)
+        from protocol_tpu.chain.ledger import invite_digest
+
+        exp = time.time() + 60
+        sig = manager.sign_message(invite_digest(0, pid, node.address, "n", exp))
+        ledger.join_compute_pool(pid, provider.address, node.address, "n", exp, sig)
+        svc.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.DEAD)
+        )
+        run(svc.status_update_once())
+        assert not ledger.is_node_in_pool(pid, node.address)
+
+    def test_discovery_monitor_adds_discovered(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        from protocol_tpu.models.node import DiscoveryNode
+
+        async def fetcher():
+            return [
+                DiscoveryNode(
+                    node=Node(id=node.address, ip_address="1.1.1.1", port=80),
+                    is_validated=True,
+                    last_updated=time.time(),
+                )
+            ]
+
+        svc.discovery_fetcher = fetcher
+        run(svc.discovery_monitor_once())
+        got = svc.store.node_store.get_node(node.address)
+        assert got is not None and got.status == NodeStatus.DISCOVERED
+
+    def test_invite_flow_marks_waiting(self):
+        svc, ledger, manager, provider, node, pid = self._world()
+        svc.store.node_store.add_node(OrchestratorNode(address=node.address))
+        sent = []
+
+        async def sender(n, payload):
+            sent.append((n.address, payload))
+            return True
+
+        svc.invite_sender = sender
+        assert run(svc.invite_once()) == 1
+        assert svc.store.node_store.get_node(node.address).status == NodeStatus.WAITING_FOR_HEARTBEAT
+        # the invite payload must verify on the ledger
+        ledger.validate_node(node.address)
+        addr, payload = sent[0]
+        ledger.join_compute_pool(
+            pid, provider.address, node.address,
+            payload["invite_nonce"], payload["expiration"], payload["invite_signature"],
+        )
+        assert ledger.is_node_in_pool(pid, node.address)
+
+
+def make_toploc_app(results: dict):
+    """Mock toploc server (the reference mocks it with mockito,
+    toploc.rs:399-795)."""
+    triggered = []
+
+    async def validate(request):
+        triggered.append(request.match_info["file"])
+        return web.json_response({"status": "ok"})
+
+    async def status(request):
+        f = request.match_info["file"]
+        if f not in results:
+            return web.json_response({"status": "Pending"})
+        return web.json_response(results[f])
+
+    app = web.Application()
+    app.router.add_post("/validate/{file}", validate)
+    app.router.add_post("/validategroup/{file}", validate)
+    app.router.add_get("/status/{file}", status)
+    app.router.add_get("/statusgroup/{file}", status)
+    app["triggered"] = triggered
+    return app
+
+
+class TestSyntheticValidation:
+    def test_group_key_regex(self):
+        gk = GroupKey.parse("out-abc123-4-0-2.parquet")
+        assert gk == GroupKey("abc123", 4, 0, 2)
+        assert GroupKey.parse("plain-file.parquet") is None
+
+    def _submit(self, ledger, manager, provider, node, pid, sha, units=100):
+        if not ledger.is_node_in_pool(pid, node.address):
+            from protocol_tpu.chain.ledger import invite_digest
+
+            ledger.validate_node(node.address)
+            exp = time.time() + 60
+            sig = manager.sign_message(invite_digest(0, pid, node.address, "n", exp))
+            ledger.join_compute_pool(pid, provider.address, node.address, "n", exp, sig)
+        ledger.submit_work(pid, node.address, sha, units)
+
+    def test_single_file_accept_and_reject(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        storage = MockStorageProvider()
+        results = {
+            "good.parquet": {"status": "Accept", "output_flops": 100},
+            "bad.parquet": {"status": "Reject"},
+        }
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(ledger, pid, storage, [toploc])
+                self._submit(ledger, manager, provider, node, pid, "sha-good")
+                self._submit(ledger, manager, provider, node, pid, "sha-bad")
+                await storage.generate_mapping_file("sha-good", "good.parquet")
+                await storage.generate_mapping_file("sha-bad", "bad.parquet")
+                await sv.validate_work_once()  # trigger
+                await sv.validate_work_once()  # poll
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-good") == ValidationResult.ACCEPT
+        assert sv.get_status("sha-bad") == ValidationResult.REJECT
+        assert ledger.get_work_info(pid, "sha-bad").invalidated
+        assert not ledger.get_work_info(pid, "sha-good").invalidated
+        assert [k for k, _ in sv.rejections()] == ["sha-bad"]
+
+    def test_work_unit_mismatch_soft_invalidates(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        storage = MockStorageProvider()
+        results = {"f.parquet": {"status": "Accept", "output_flops": 42}}
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(ledger, pid, storage, [toploc])
+                self._submit(ledger, manager, provider, node, pid, "sha-f", units=100)
+                await storage.generate_mapping_file("sha-f", "f.parquet")
+                await sv.validate_work_once()
+                await sv.validate_work_once()
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-f") == ValidationResult.WORK_MISMATCH
+        assert ledger.get_work_info(pid, "sha-f").soft_invalidated
+
+    def test_group_failing_indices(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        storage = MockStorageProvider()
+        results = {
+            "out-g1-2-0-1.parquet": {"status": "Reject", "failing_indices": [1]},
+            "out-g1-2-0-0.parquet": {"status": "Reject", "failing_indices": [1]},
+        }
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(ledger, pid, storage, [toploc])
+                self._submit(ledger, manager, provider, node, pid, "sha-0")
+                self._submit(ledger, manager, provider, node, pid, "sha-1")
+                await storage.generate_mapping_file("sha-0", "out-g1-2-0-0.parquet")
+                await storage.generate_mapping_file("sha-1", "out-g1-2-0-1.parquet")
+                await sv.validate_work_once()  # collect both, trigger group
+                await sv.validate_work_once()  # poll
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-0") == ValidationResult.ACCEPT
+        assert sv.get_status("sha-1") == ValidationResult.REJECT
+
+    def test_incomplete_group_grace_soft_invalidation(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        storage = MockStorageProvider()
+
+        async def flow():
+            app = make_toploc_app({})
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(
+                    ledger, pid, storage, [toploc], grace_period=0.0
+                )
+                self._submit(ledger, manager, provider, node, pid, "sha-0")
+                await storage.generate_mapping_file("sha-0", "out-g2-3-0-0.parquet")
+                await sv.validate_work_once()  # registers incomplete group
+                await asyncio.sleep(0.01)
+                await sv.validate_work_once()  # grace expired -> soft invalidate
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-0") == ValidationResult.WORK_MISMATCH
+        assert ledger.get_work_info(pid, "sha-0").soft_invalidated
+
+    def test_prefix_filter_routing(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+        storage = MockStorageProvider()
+
+        async def flow():
+            app_a = make_toploc_app({})
+            app_b = make_toploc_app({})
+            async with TestClient(TestServer(app_a)) as ca, TestClient(
+                TestServer(app_b)
+            ) as cb:
+                t_a = ToplocClient("", ca, file_prefix_filter="modelA-")
+                t_b = ToplocClient("", cb, file_prefix_filter="modelB-")
+                sv = SyntheticDataValidator(ledger, pid, storage, [t_a, t_b])
+                self._submit(ledger, manager, provider, node, pid, "sha-b")
+                await storage.generate_mapping_file("sha-b", "modelB-file.parquet")
+                await sv.validate_work_once()
+                return app_a["triggered"], app_b["triggered"]
+
+        ta, tb = run(flow())
+        assert ta == [] and tb == ["modelB-file.parquet"]

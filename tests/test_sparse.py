@@ -1,0 +1,105 @@
+"""Sparse top-K pipeline tests: candidate generation vs brute force, full-K
+parity with the dense auction, restricted-graph quality vs scipy optimum."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+import jax.numpy as jnp
+
+from protocol_tpu.ops.assign import assign_auction
+from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.sparse import assign_auction_sparse, assign_topk, candidates_topk
+from protocol_tpu.ops.encoding import FeatureEncoder, compat_mask
+
+from tests.test_assign import check_feasible, matching_cost, random_cost
+from tests.test_encoding import random_requirements, random_specs
+
+
+def encode_random_marketplace(seed, P, T):
+    import random
+
+    rng = random.Random(seed)
+    enc = FeatureEncoder()
+    ep = enc.encode_providers([random_specs(rng) for _ in range(P)])
+    er = enc.encode_requirements([random_requirements(rng) for _ in range(T)])
+    return ep, er
+
+
+class TestCandidates:
+    def test_matches_bruteforce_topk(self):
+        ep, er = encode_random_marketplace(0, 32, 16)
+        cand_p, cand_c = candidates_topk(ep, er, k=8, tile=8)
+        cost = np.asarray(cost_matrix(ep, er, CostWeights())[0])  # [P, T]
+        for t in range(16):
+            order = np.argsort(cost[:, t], kind="stable")[:8]
+            expected = [int(p) if cost[p, t] < INFEASIBLE * 0.5 else -1 for p in order]
+            got = list(np.asarray(cand_p)[t])
+            assert got == expected, f"task {t}: {got} vs {expected}"
+            feas = [i for i, p in enumerate(expected) if p >= 0]
+            np.testing.assert_allclose(
+                np.asarray(cand_c)[t][feas], cost[order, t][feas], rtol=1e-6
+            )
+
+    def test_tile_divisibility_enforced(self):
+        ep, er = encode_random_marketplace(1, 8, 10)
+        with pytest.raises(ValueError):
+            candidates_topk(ep, er, k=4, tile=4)
+
+
+class TestSparseAuction:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_full_k_parity_with_dense(self, seed):
+        rng = np.random.default_rng(seed)
+        P, T = 32, 32
+        cost = random_cost(rng, P, T, p_infeasible=0.2)
+        # build full candidate lists (k = P) sorted by cost, as topk would
+        order = np.argsort(cost, axis=0, kind="stable").T  # [T, P]
+        cand_c = np.take_along_axis(cost.T, order, axis=1).astype(np.float32)
+        cand_p = np.where(cand_c < INFEASIBLE * 0.5, order.astype(np.int32), -1)
+
+        # frontier >= T + no retirement = the dense Jacobi schedule exactly
+        res_sparse = assign_auction_sparse(
+            jnp.asarray(cand_p), jnp.asarray(cand_c), num_providers=P,
+            eps=0.05, max_iters=5000, frontier=T, retire=False,
+        )
+        res_dense = assign_auction(jnp.asarray(cost), eps=0.05, max_iters=5000)
+        check_feasible(res_sparse, cost)
+        np.testing.assert_array_equal(
+            np.asarray(res_sparse.provider_for_task),
+            np.asarray(res_dense.provider_for_task),
+        )
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_restricted_quality(self, seed):
+        """k=16 of 64 providers: matching cost within a few % of optimal."""
+        rng = np.random.default_rng(seed)
+        n = 64
+        cost = rng.uniform(0.0, 10.0, size=(n, n)).astype(np.float32)
+        order = np.argsort(cost, axis=0, kind="stable").T[:, :16]
+        cand_c = np.take_along_axis(cost.T, order, axis=1).astype(np.float32)
+        cand_p = order.astype(np.int32)
+        res = assign_auction_sparse(
+            jnp.asarray(cand_p), jnp.asarray(cand_c), num_providers=n,
+            eps=0.01, max_iters=5000, frontier=16,
+        )
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).sum() >= n - 2  # near-perfect matching on 25% graph
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        got = matching_cost(cost, p4t)
+        assert got <= opt * 1.10 + n * 0.011, f"sparse {got} vs optimal {opt}"
+
+
+class TestEndToEndTopk:
+    def test_pipeline_feasibility_and_compat(self):
+        ep, er = encode_random_marketplace(3, 48, 32)
+        res = assign_topk(ep, er, k=8, tile=8, eps=0.05, max_iters=3000)
+        mask = np.asarray(compat_mask(ep, er))
+        p4t = np.asarray(res.provider_for_task)
+        used = set()
+        for t, p in enumerate(p4t):
+            if p >= 0:
+                assert mask[p, t], f"incompatible assignment t={t} p={p}"
+                assert p not in used
+                used.add(p)
