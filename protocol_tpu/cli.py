@@ -1,0 +1,255 @@
+"""Operator / provider CLI.
+
+Covers the reference's dev-utils chain CLIs (crates/dev-utils/examples/:
+create_domain, compute_pool, mint_ai_token, whitelist_provider,
+get_node_info, eject_node, submit_work, invalidate_work, transfer_eth,
+set_min_stake_amount) and the worker CLI subcommands
+(crates/worker/src/cli/command.rs:49-186: Run / Check / GenerateWallets /
+Balance / SignMessage) against a running devnet's HTTP APIs.
+
+    python -m protocol_tpu.cli [--ledger URL] [--orchestrator URL]
+                               [--api-key KEY] <command> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import aiohttp
+
+from protocol_tpu.security import Wallet, sign_request
+
+
+def _print(data) -> None:
+    print(json.dumps(data, indent=2, default=str))
+
+
+async def ledger_call(args, kind: str, op: str, params: dict):
+    headers = {"Authorization": f"Bearer {args.api_key}"} if kind == "write" else {}
+    async with aiohttp.ClientSession() as session:
+        async with session.post(
+            f"{args.ledger}/ledger/{kind}/{op}", json=params, headers=headers
+        ) as resp:
+            data = await resp.json()
+            _print(data)
+            return 0 if data.get("success") else 1
+
+
+async def orch_call(args, method: str, path: str, body=None):
+    headers = {"Authorization": f"Bearer {args.api_key}"}
+    async with aiohttp.ClientSession() as session:
+        async with session.request(
+            method, f"{args.orchestrator}{path}", json=body, headers=headers
+        ) as resp:
+            data = await resp.json()
+            _print(data)
+            return 0 if resp.status < 400 else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="protocol_tpu.cli")
+    parser.add_argument("--ledger", default="http://127.0.0.1:8095")
+    parser.add_argument("--orchestrator", default="http://127.0.0.1:8090")
+    parser.add_argument("--api-key", default="admin")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    # ---- wallet ops (worker CLI: GenerateWallets / SignMessage / Balance)
+    sub.add_parser("generate-wallet", help="print a fresh wallet keypair")
+
+    p = sub.add_parser("sign-message")
+    p.add_argument("--key", required=True)
+    p.add_argument("--message", required=True)
+
+    p = sub.add_parser("balance")
+    p.add_argument("--address", required=True)
+
+    # ---- chain admin ops (dev-utils)
+    p = sub.add_parser("mint")
+    p.add_argument("--address", required=True)
+    p.add_argument("--amount", type=int, required=True)
+
+    p = sub.add_parser("transfer")
+    p.add_argument("--sender", required=True)
+    p.add_argument("--to", required=True)
+    p.add_argument("--amount", type=int, required=True)
+
+    p = sub.add_parser("create-domain")
+    p.add_argument("--name", required=True)
+    p.add_argument("--validation-logic", default="")
+
+    p = sub.add_parser("create-pool")
+    p.add_argument("--domain-id", type=int, required=True)
+    p.add_argument("--creator", required=True)
+    p.add_argument("--manager", required=True)
+    p.add_argument("--requirements", default="")
+
+    p = sub.add_parser("start-pool")
+    p.add_argument("--pool-id", type=int, required=True)
+    p.add_argument("--caller", required=True)
+
+    p = sub.add_parser("whitelist-provider")
+    p.add_argument("--provider", required=True)
+
+    p = sub.add_parser("get-node-info")
+    p.add_argument("--node", required=True)
+
+    p = sub.add_parser("eject-node")
+    p.add_argument("--pool-id", type=int, required=True)
+    p.add_argument("--node", required=True)
+    p.add_argument("--caller", required=True)
+
+    p = sub.add_parser("submit-work")
+    p.add_argument("--pool-id", type=int, required=True)
+    p.add_argument("--node", required=True)
+    p.add_argument("--work-key", required=True)
+    p.add_argument("--work-units", type=int, required=True)
+
+    p = sub.add_parser("invalidate-work")
+    p.add_argument("--pool-id", type=int, required=True)
+    p.add_argument("--work-key", required=True)
+    p.add_argument("--penalty", type=int, default=0)
+    p.add_argument("--soft", action="store_true")
+
+    p = sub.add_parser("pool-info")
+    p.add_argument("--pool-id", type=int, required=True)
+
+    # ---- orchestrator admin ops
+    p = sub.add_parser("create-task")
+    p.add_argument("--name", required=True)
+    p.add_argument("--image", required=True)
+    p.add_argument("--cmd", dest="task_cmd", default="", help="comma-separated argv")
+    p.add_argument("--env", default="", help="K=V,K2=V2")
+    p.add_argument("--topologies", default="", help="comma-separated group configs")
+    p.add_argument("--replicas", type=int, default=0)
+    p.add_argument("--requirements", default="", help="tpu_scheduler requirements DSL")
+
+    sub.add_parser("list-tasks")
+    sub.add_parser("list-nodes")
+    sub.add_parser("list-groups")
+
+    p = sub.add_parser("delete-task")
+    p.add_argument("--task-id", required=True)
+
+    p = sub.add_parser("ban-node")
+    p.add_argument("--address", required=True)
+
+    args = parser.parse_args(argv)
+
+    # local wallet commands need no server
+    if args.cmd == "generate-wallet":
+        w = Wallet()
+        _print({"address": w.address, "private_key": w.private_key_hex()})
+        return 0
+    if args.cmd == "sign-message":
+        w = Wallet.from_hex(args.key)
+        _print({"address": w.address, "signature": w.sign_message(args.message)})
+        return 0
+
+    async def dispatch() -> int:
+        if args.cmd == "balance":
+            return await ledger_call(args, "read", "balance_of", {"address": args.address})
+        if args.cmd == "mint":
+            return await ledger_call(
+                args, "write", "mint", {"address": args.address, "amount": args.amount}
+            )
+        if args.cmd == "transfer":
+            return await ledger_call(
+                args, "write", "transfer",
+                {"sender": args.sender, "to": args.to, "amount": args.amount},
+            )
+        if args.cmd == "create-domain":
+            return await ledger_call(
+                args, "write", "create_domain",
+                {"name": args.name, "validation_logic": args.validation_logic},
+            )
+        if args.cmd == "create-pool":
+            return await ledger_call(
+                args, "write", "create_pool",
+                {
+                    "domain_id": args.domain_id,
+                    "creator": args.creator,
+                    "compute_manager_key": args.manager,
+                    "pool_data_uri": args.requirements,
+                },
+            )
+        if args.cmd == "start-pool":
+            return await ledger_call(
+                args, "write", "start_pool",
+                {"pool_id": args.pool_id, "caller": args.caller},
+            )
+        if args.cmd == "whitelist-provider":
+            return await ledger_call(
+                args, "write", "whitelist_provider", {"provider": args.provider}
+            )
+        if args.cmd == "get-node-info":
+            return await ledger_call(args, "read", "get_node", {"node": args.node})
+        if args.cmd == "eject-node":
+            return await ledger_call(
+                args, "write", "eject_node",
+                {"pool_id": args.pool_id, "node": args.node, "caller": args.caller},
+            )
+        if args.cmd == "submit-work":
+            return await ledger_call(
+                args, "write", "submit_work",
+                {
+                    "pool_id": args.pool_id,
+                    "node": args.node,
+                    "work_key": args.work_key,
+                    "work_units": args.work_units,
+                },
+            )
+        if args.cmd == "invalidate-work":
+            op = "soft_invalidate_work" if args.soft else "invalidate_work"
+            params = {"pool_id": args.pool_id, "work_key": args.work_key}
+            if not args.soft:
+                params["penalty"] = args.penalty
+            return await ledger_call(args, "write", op, params)
+        if args.cmd == "pool-info":
+            return await ledger_call(
+                args, "read", "get_pool_info", {"pool_id": args.pool_id}
+            )
+
+        if args.cmd == "create-task":
+            body: dict = {"name": args.name, "image": args.image}
+            if args.task_cmd:
+                body["cmd"] = [c for c in args.task_cmd.split(",") if c]
+            if args.env:
+                body["env_vars"] = dict(
+                    kv.split("=", 1) for kv in args.env.split(",") if "=" in kv
+                )
+            plugins: dict = {}
+            if args.topologies:
+                plugins["node_groups"] = {
+                    "allowed_topologies": args.topologies.split(",")
+                }
+            tpu_cfg: dict = {}
+            if args.replicas:
+                tpu_cfg["replicas"] = [str(args.replicas)]
+            if args.requirements:
+                tpu_cfg["compute_requirements"] = [args.requirements]
+            if tpu_cfg:
+                plugins["tpu_scheduler"] = tpu_cfg
+            if plugins:
+                body["scheduling_config"] = {"plugins": plugins}
+            return await orch_call(args, "POST", "/tasks", body)
+        if args.cmd == "list-tasks":
+            return await orch_call(args, "GET", "/tasks")
+        if args.cmd == "list-nodes":
+            return await orch_call(args, "GET", "/nodes")
+        if args.cmd == "list-groups":
+            return await orch_call(args, "GET", "/groups")
+        if args.cmd == "delete-task":
+            return await orch_call(args, "DELETE", f"/tasks/{args.task_id}")
+        if args.cmd == "ban-node":
+            return await orch_call(args, "POST", f"/nodes/{args.address}/ban")
+        parser.error(f"unhandled command {args.cmd}")
+        return 2
+
+    return asyncio.run(dispatch())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""Devnet: the one-command local cluster.
+
+The reference's ``make up`` boots a reth devnet + redis + contract deploy +
+discovery/orchestrator/validator in tmux panes (Makefile:57-116,
+docker-compose.yml). Here the whole stack is one asyncio process:
+
+    python -m protocol_tpu.devnet [--workers N] [--requirements DSL]
+
+Boots: ledger API (:8095), discovery (:8089), orchestrator (:8090),
+validator (:8094), and N in-process workers with subprocess runtimes.
+Prints admin credentials and example CLI invocations, then runs the loops
+until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import aiohttp
+from aiohttp import web
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.models.node import DiscoveryNode
+from protocol_tpu.sched import Scheduler, TpuBatchMatcher
+from protocol_tpu.sched.node_groups import NodeGroupConfiguration, NodeGroupsPlugin
+from protocol_tpu.security import Wallet, sign_request
+from protocol_tpu.services.discovery import DiscoveryService
+from protocol_tpu.services.ledger_api import LedgerApiService
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.services.validator import (
+    SyntheticDataValidator,
+    ToplocClient,
+    ValidatorService,
+)
+from protocol_tpu.services.worker import SubprocessRuntime, TaskBridge, WorkerAgent, detect_compute_specs
+from protocol_tpu.store import StoreContext
+from protocol_tpu.utils.storage import LocalDirStorageProvider
+
+
+async def start_app(app: web.Application, port: int) -> web.AppRunner:
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    return runner
+
+
+async def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="protocol_tpu local devnet")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--requirements", default="", help="pool requirements DSL")
+    parser.add_argument("--admin-key", default="admin")
+    parser.add_argument("--storage-dir", default="/tmp/protocol_tpu_storage")
+    parser.add_argument("--base-port", type=int, default=8089)
+    parser.add_argument(
+        "--group-configs",
+        default="",
+        help='JSON list of {"name","min_group_size","max_group_size","compute_requirements"}',
+    )
+    parser.add_argument("--oneshot", action="store_true", help="boot, print state, exit (smoke test)")
+    parser.add_argument(
+        "--probe-accelerator",
+        action="store_true",
+        help="include jax.devices() in worker hardware detection (can block "
+        "if the accelerator plugin is unreachable)",
+    )
+    parser.add_argument(
+        "--cpu",
+        action="store_true",
+        help="pin JAX to the host CPU backend (devnet without an accelerator)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    dport, oport, vport, lport = (
+        args.base_port,
+        args.base_port + 1,
+        args.base_port + 5,
+        args.base_port + 6,
+    )
+
+    # ---- substrate
+    ledger = Ledger()
+    creator = Wallet.from_seed(b"devnet-creator")
+    manager = Wallet.from_seed(b"devnet-manager")
+    validator_wallet = Wallet.from_seed(b"devnet-validator")
+    did = ledger.create_domain("devnet", validation_logic="toploc")
+    pid = ledger.create_pool(did, creator.address, manager.address, args.requirements)
+    ledger.start_pool(pid, creator.address)
+
+    session = aiohttp.ClientSession()
+    runners = []
+
+    # ---- ledger API
+    ledger_api = LedgerApiService(ledger, admin_api_key=args.admin_key)
+    runners.append(await start_app(ledger_api.make_app(), lport))
+
+    # ---- discovery
+    discovery = DiscoveryService(ledger, pid, admin_api_key=args.admin_key)
+    runners.append(await start_app(discovery.make_app(), dport))
+    discovery_url = f"http://127.0.0.1:{dport}"
+
+    # ---- orchestrator
+    store = StoreContext.new_test()
+    groups_plugin = None
+    if args.group_configs:
+        configs = [
+            NodeGroupConfiguration.from_dict(d) for d in json.loads(args.group_configs)
+        ]
+        groups_plugin = NodeGroupsPlugin(store, configs)
+        groups_plugin.attach_observers()
+        scheduler = Scheduler(store, plugins=[groups_plugin])
+    else:
+        matcher = TpuBatchMatcher(store)
+        matcher.attach_observers()
+        scheduler = Scheduler(store, batch_matcher=matcher)
+
+    async def discovery_fetcher():
+        headers, _ = sign_request(f"/api/pool/{pid}", manager)
+        async with session.get(
+            f"{discovery_url}/api/pool/{pid}", headers=headers
+        ) as resp:
+            data = await resp.json()
+            return [DiscoveryNode.from_dict(d) for d in data.get("data", [])]
+
+    async def invite_sender(node, payload):
+        url = (node.p2p_addresses or [None])[0]
+        if not url:
+            return False
+        headers, body = sign_request("/control/invite", manager, payload)
+        try:
+            async with session.post(
+                f"{url}/invite", json=body, headers=headers
+            ) as resp:
+                return resp.status == 200
+        except aiohttp.ClientError:
+            return False
+
+    orchestrator = OrchestratorService(
+        ledger,
+        pid,
+        manager,
+        store=store,
+        scheduler=scheduler,
+        groups_plugin=groups_plugin,
+        storage=LocalDirStorageProvider(args.storage_dir),
+        discovery_fetcher=discovery_fetcher,
+        invite_sender=invite_sender,
+        admin_api_key=args.admin_key,
+        heartbeat_url=f"http://127.0.0.1:{oport}",
+    )
+    runners.append(await orchestrator.serve(port=oport))
+
+    # ---- validator
+    async def validator_fetcher():
+        headers, _ = sign_request("/api/validator", validator_wallet)
+        async with session.get(
+            f"{discovery_url}/api/validator", headers=headers
+        ) as resp:
+            data = await resp.json()
+            return [DiscoveryNode.from_dict(d) for d in data.get("data", [])]
+
+    validator = ValidatorService(
+        validator_wallet,
+        ledger,
+        pid,
+        synthetic=None,  # attach a toploc server via TOPLOC_URL when present
+        discovery_fetcher=validator_fetcher,
+        http=session,
+        challenge_size=64,
+    )
+    runners.append(await start_app(validator.make_app(), vport))
+
+    async def validator_loop():
+        while True:
+            try:
+                await validator.validation_loop_once()
+            except Exception:
+                pass
+            await asyncio.sleep(5.0)  # validator/src/main.rs:33
+
+    async def discovery_sync_loop():
+        # ChainSync every 10 s (discovery/src/chainsync/sync.rs:16) +
+        # location enrichment (location_enrichment.rs)
+        while True:
+            try:
+                discovery.chain_sync_once()
+                await discovery.enrich_locations_once()
+            except Exception:
+                pass
+            await asyncio.sleep(10.0)
+
+    loops = [
+        asyncio.get_running_loop().create_task(validator_loop()),
+        asyncio.get_running_loop().create_task(discovery_sync_loop()),
+    ]
+
+    # ---- workers
+    workers = []
+    specs, _report = detect_compute_specs(
+        "/", probe_accelerator=args.probe_accelerator
+    )
+    for i in range(args.workers):
+        provider = Wallet.from_seed(f"devnet-provider-{i}".encode())
+        node = Wallet.from_seed(f"devnet-node-{i}".encode())
+        ledger.mint(provider.address, 1_000_000)
+        wport = args.base_port + 10 + i
+        socket_path = f"/tmp/protocol_tpu_worker_{i}/bridge.sock"
+        agent = WorkerAgent(
+            provider_wallet=provider,
+            node_wallet=node,
+            ledger=ledger,
+            pool_id=pid,
+            runtime=SubprocessRuntime(socket_path=socket_path),
+            compute_specs=specs,
+            port=wport,
+            http=session,
+            known_orchestrators=[manager.address],
+            known_validators=[validator_wallet.address],
+        )
+        agent.register_on_ledger()
+        bridge = TaskBridge(socket_path, agent)
+        await bridge.start()
+        runners.append(await start_app(agent.make_control_app(), wport))
+        await agent.upload_to_discovery([discovery_url])
+        workers.append(agent)
+
+        async def worker_loop(agent=agent):
+            while True:
+                try:
+                    await agent.heartbeat_once()
+                except Exception:
+                    pass
+                await asyncio.sleep(10.0)  # heartbeat interval (reference)
+
+        loops.append(asyncio.get_running_loop().create_task(worker_loop()))
+
+    print(f"devnet up: pool {pid} (domain {did})")
+    print(f"  ledger api    http://127.0.0.1:{lport}   (admin key: {args.admin_key})")
+    print(f"  discovery     {discovery_url}")
+    print(f"  orchestrator  http://127.0.0.1:{oport}")
+    print(f"  validator     http://127.0.0.1:{vport}")
+    print(f"  workers       {len(workers)} in-process agents")
+    print(f"  manager addr  {manager.address}")
+    print("try:")
+    print(
+        f"  python -m protocol_tpu.cli --orchestrator http://127.0.0.1:{oport} "
+        f"--api-key {args.admin_key} create-task --name hello --image demo "
+        "--cmd 'echo,hello-from-${NODE_ADDRESS}'"
+    )
+    sys.stdout.flush()
+
+    if args.oneshot:
+        await asyncio.sleep(0.5)
+        for t in loops:
+            t.cancel()
+        for r in runners:
+            await r.cleanup()
+        await session.close()
+        return
+
+    try:
+        await asyncio.Event().wait()
+    finally:
+        for t in loops:
+            t.cancel()
+        for r in runners:
+            await r.cleanup()
+        await session.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -36,7 +36,11 @@ from jax import lax
 
 from protocol_tpu.ops.cost import INFEASIBLE
 
-_NEG = jnp.float32(-1e18)  # -inf stand-in that survives arithmetic
+# -inf stand-in that survives arithmetic. A Python float on purpose:
+# a jnp scalar at module level would initialize the JAX backend at
+# import time (fatal for control-plane processes when the remote
+# accelerator is unreachable).
+_NEG = -1e18
 
 
 @jax.tree_util.register_dataclass

@@ -39,10 +39,12 @@ EARTH_RADIUS_KM = 6371.0
 @jax.tree_util.register_dataclass
 @dataclass
 class CostWeights:
-    price: jax.Array = field(default_factory=lambda: jnp.float32(1.0))
-    load: jax.Array = field(default_factory=lambda: jnp.float32(1.0))
-    proximity: jax.Array = field(default_factory=lambda: jnp.float32(0.001))  # per km
-    priority: jax.Array = field(default_factory=lambda: jnp.float32(0.0))
+    # plain floats (valid pytree leaves); jnp scalars here would initialize
+    # the JAX backend on construction, which control-plane code must avoid
+    price: float = 1.0
+    load: float = 1.0
+    proximity: float = 0.001  # per km
+    priority: float = 0.0
 
 
 def haversine_km(

@@ -36,7 +36,7 @@ from protocol_tpu.ops.assign import AssignResult, _invert
 from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 
-_NEG = jnp.float32(-1e18)
+_NEG = -1e18
 
 
 def _slice_requirements(r: EncodedRequirements, start: int, size: int) -> EncodedRequirements:
@@ -67,10 +67,23 @@ def candidates_topk(
     if T % tile != 0:
         raise ValueError(f"T={T} not divisible by tile={tile}; pad requirements")
     n_tiles = T // tile
+    k = min(k, int(ep.gpu_count.shape[0]))  # lax.top_k requires k <= P
+
+    P = ep.gpu_count.shape[0]
+    p_idx = jnp.arange(P, dtype=jnp.uint32)
 
     def step(carry, t0):
         r_tile = _slice_requirements(er, t0, tile)
         cost, _mask = cost_matrix(ep, r_tile, weights)  # [P, tile]
+        # Degeneracy breaker: marketplaces have many identically-priced
+        # providers; without jitter every task's top-k is the SAME k
+        # providers, capping the matching at k regardless of supply. A tiny
+        # deterministic hash(p, t) epsilon decorrelates candidate sets while
+        # preserving any real cost gap > 1e-4.
+        t_idx = (t0 + jnp.arange(tile, dtype=jnp.uint32))[None, :]
+        h = p_idx[:, None] * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
+        jitter = (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
+        cost = jnp.where(cost < INFEASIBLE * 0.5, cost + jitter, cost)
         neg, idx = lax.top_k(-cost.T, k)  # [tile, k] best (lowest cost) first
         cost_k = -neg
         provider = jnp.where(cost_k < INFEASIBLE * 0.5, idx.astype(jnp.int32), -1)

@@ -31,7 +31,7 @@ from jax import shard_map
 from protocol_tpu.ops.assign import AssignResult, _invert
 from protocol_tpu.ops.cost import INFEASIBLE
 
-_NEG = jnp.float32(-1e18)
+_NEG = -1e18
 
 
 def assign_auction_sharded(

@@ -1,0 +1,7 @@
+"""Generated protobuf messages for the scheduler gRPC shim.
+
+Regenerate with:  protoc --python_out=. protocol_tpu/proto/scheduler.proto
+(run from the repo root). The gRPC service wiring is hand-rolled in
+protocol_tpu.services.scheduler_grpc via generic method handlers, so no
+grpc protoc plugin is required.
+"""

@@ -145,6 +145,9 @@ class NodeGroupsPlugin:
         self.merge_policy = merge_policy
         self.rng = rng or random.Random()
         self.encoder = FeatureEncoder()
+        # optional lifecycle hooks (fed to the webhook plugin)
+        self.on_group_created = None
+        self.on_group_dissolved = None
         # larger min first, then more specific requirements first
         # (mod.rs:150-164)
         self.configurations = sorted(
@@ -225,6 +228,8 @@ class NodeGroupsPlugin:
             self.store.kv.sadd(GROUPS_INDEX, group.id)
             for addr in members:
                 self.store.kv.hset(NODE_TO_GROUP, addr, group.id)
+        if self.on_group_created is not None:
+            self.on_group_created(group.to_dict())
         return group
 
     def dissolve_group(self, group_id: str) -> None:
@@ -238,6 +243,8 @@ class NodeGroupsPlugin:
             self.store.kv.delete(GROUP_KEY.format(group_id))
             self.store.kv.delete(GROUP_TASK_KEY.format(group_id))
             self.store.kv.srem(GROUPS_INDEX, group_id)
+        if self.on_group_dissolved is not None:
+            self.on_group_dissolved(group.to_dict())
 
     # ------------- status-change hook -------------
 

@@ -26,6 +26,7 @@ growth, not on every membership change.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -107,7 +108,7 @@ class TpuBatchMatcher:
         time_fn=time.monotonic,
     ):
         self.store = store
-        self.weights = weights or CostWeights(priority=jnp.float32(1.0))
+        self.weights = weights or CostWeights(priority=1.0)
         self.min_solve_interval = min_solve_interval
         self.max_replica_slots = max_replica_slots
         self._time = time_fn
@@ -115,6 +116,9 @@ class TpuBatchMatcher:
         self._last_solve = float("-inf")
         self._assignment: dict[str, str] = {}  # node address -> task id
         self._covered: set[str] = set()  # addresses the last solve considered
+        # heartbeats arrive from worker threads (asyncio.to_thread): one lock
+        # serializes solves and makes (_assignment, _covered) swaps atomic
+        self._solve_lock = threading.Lock()
         self.encoder = FeatureEncoder()
         self.last_solve_stats: dict = {}
 
@@ -145,9 +149,14 @@ class TpuBatchMatcher:
     def _ensure_fresh(self) -> None:
         # Re-solve only when something changed, and never more often than
         # min_solve_interval — population churn must not turn back into a
-        # per-heartbeat O(solve) cost.
+        # per-heartbeat O(solve) cost. The lock keeps concurrent heartbeat
+        # threads from solving twice or observing a half-swapped assignment.
         if self._dirty and self._time() - self._last_solve >= self.min_solve_interval:
-            self.refresh()
+            with self._solve_lock:
+                if self._dirty and (
+                    self._time() - self._last_solve >= self.min_solve_interval
+                ):
+                    self.refresh()
 
     # ----- batch solve
 
@@ -172,9 +181,12 @@ class TpuBatchMatcher:
                 continue
             ok_tasks.append(t)
         tasks = ok_tasks
-        self._assignment = {}
-        self._covered = {n.address for n in nodes}
+        # build the new solution locally and swap at the end so concurrent
+        # readers never observe a half-built assignment
+        assignment: dict[str, str] = {}
+        covered = {n.address for n in nodes}
         if not nodes or not tasks:
+            self._assignment, self._covered = assignment, covered
             self.last_solve_stats = {"nodes": len(nodes), "tasks": len(tasks)}
             return
 
@@ -220,7 +232,7 @@ class TpuBatchMatcher:
             t4p = np.asarray(_solve_bounded(ep, er, self.weights))[:P]
             for p_idx, s_idx in enumerate(t4p):
                 if s_idx >= 0 and s_idx < len(slot_task):
-                    self._assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
+                    assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
                     assigned[p_idx] = True
 
         # ---- phase 2: remaining nodes -> cheapest compatible unbounded task
@@ -235,12 +247,13 @@ class TpuBatchMatcher:
             best = np.asarray(best)[:P]
             for p_idx in range(P):
                 if not assigned[p_idx] and best[p_idx] >= 0 and best[p_idx] < len(unbounded):
-                    self._assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id
+                    assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id
 
+        self._assignment, self._covered = assignment, covered
         self.last_solve_stats = {
             "nodes": P,
             "tasks": len(tasks),
             "bounded_tasks": len(bounded),
-            "assigned": len(self._assignment),
+            "assigned": len(assignment),
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
         }

@@ -105,7 +105,10 @@ def validate_signature_middleware(
                 {"success": False, "error": "stale timestamp"}, status=401
             )
 
-        address = verify_request(request.path, dict(request.headers), body)
+        # pass the CIMultiDict through: its .get is case-insensitive, so
+        # clients sending X-Address/X-Signature (standard casing) still
+        # authenticate
+        address = verify_request(request.path, request.headers, body)
         if address is None:
             return web.json_response(
                 {"success": False, "error": "invalid signature"}, status=401

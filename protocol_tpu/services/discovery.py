@@ -44,6 +44,7 @@ from protocol_tpu.store.kv import KVStore
 
 NODE_KEY = "node:{}"
 NODE_IDS = "node:ids"
+IP_INDEX = "node:ip:{}"  # per-IP membership set: O(1) per-IP cap checks
 
 LocationResolver = Callable[[str], Awaitable[Optional[NodeLocation]]]
 
@@ -57,8 +58,17 @@ class DiscoveryNodeStore:
     def put(self, dn: DiscoveryNode) -> None:
         dn.last_updated = time.time()
         with self.kv.atomic():
+            prev = self.get(dn.node.id)
+            if prev is not None and prev.node.ip_address != dn.node.ip_address:
+                self.kv.srem(IP_INDEX.format(prev.node.ip_address), dn.node.id)
             self.kv.set(NODE_KEY.format(dn.node.id), dn.to_json())
             self.kv.sadd(NODE_IDS, dn.node.id)
+            if dn.node.ip_address:
+                self.kv.sadd(IP_INDEX.format(dn.node.ip_address), dn.node.id)
+
+    def count_for_ip(self, ip: str, exclude: str = "") -> int:
+        members = self.kv.smembers(IP_INDEX.format(ip))
+        return len(members - {exclude})
 
     def get(self, node_id: str) -> Optional[DiscoveryNode]:
         raw = self.kv.get(NODE_KEY.format(node_id))
@@ -138,13 +148,9 @@ class DiscoveryService:
             self.store.put(existing)
             return web.json_response(ApiResponse(True, "updated p2p only").to_dict())
 
-        # per-IP active-node cap (node.rs:93-127)
-        same_ip = [
-            d
-            for d in self.store.all()
-            if d.node.ip_address == node.ip_address and d.node.id != node.id
-        ]
-        if len(same_ip) >= self.max_nodes_per_ip:
+        # per-IP active-node cap (node.rs:93-127) — O(1) via the IP index,
+        # not a full-store scan (fleet onboarding must stay linear)
+        if self.store.count_for_ip(node.ip_address, exclude=node.id) >= self.max_nodes_per_ip:
             return _err("too many nodes from this IP", 429)
 
         # pool ComputeRequirements gate (node.rs:152-197)

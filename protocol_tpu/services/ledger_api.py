@@ -1,0 +1,133 @@
+"""Ledger HTTP API: the devnet's chain-RPC endpoint.
+
+The reference dev environment runs a local Ethereum devnet (reth) that every
+service and the dev-utils CLIs talk to over JSON-RPC (docker-compose.yml,
+Makefile). This service is that seam for the in-process ledger: a small
+HTTP API exposing the contract-wrapper surface so CLIs, tests, and
+out-of-process services share one economic substrate.
+
+Write ops are admin-key gated (the devnet holds the faucet); reads are open.
+POST /ledger/{op} with a JSON params object; responses are
+{"success": bool, "data"|"error": ...}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from aiohttp import web
+
+from protocol_tpu.chain import Ledger, LedgerError
+from protocol_tpu.security.middleware import api_key_middleware
+
+WRITE_OPS = {
+    "mint",
+    "transfer",
+    "approve",
+    "create_domain",
+    "register_provider",
+    "increase_stake",
+    "reclaim_stake",
+    "whitelist_provider",
+    "add_compute_node",
+    "remove_compute_node",
+    "validate_node",
+    "create_pool",
+    "start_pool",
+    "join_compute_pool",
+    "eject_node",
+    "blacklist_node",
+    "submit_work",
+    "invalidate_work",
+    "soft_invalidate_work",
+}
+
+READ_OPS = {
+    "balance_of",
+    "get_domain",
+    "provider_exists",
+    "get_provider",
+    "get_stake",
+    "is_provider_whitelisted",
+    "node_exists",
+    "get_node",
+    "is_node_validated",
+    "get_provider_total_compute",
+    "get_pool_info",
+    "is_node_in_pool",
+    "get_work_keys",
+    "get_work_info",
+    "get_work_since",
+    "get_rewards",
+    "calculate_stake",
+}
+
+
+def _jsonable(value: Any) -> Any:
+    import enum
+
+    if isinstance(value, enum.Enum):
+        # must precede the __dict__ branch: enum members have a __dict__ of
+        # private fields that would serialize as {}
+        return value.value
+    if hasattr(value, "__dict__"):
+        return {
+            k: _jsonable(v)
+            for k, v in vars(value).items()
+            if not k.startswith("_")
+        }
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, set):
+        return sorted(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if hasattr(value, "value") and not isinstance(value, (int, float, str, bool)):
+        return value.value  # enums
+    return value
+
+
+class LedgerApiService:
+    def __init__(self, ledger: Ledger, admin_api_key: str = "admin"):
+        self.ledger = ledger
+        self.admin_api_key = admin_api_key
+
+    def make_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[api_key_middleware(self.admin_api_key, ["/ledger/write"])]
+        )
+        app.router.add_post("/ledger/write/{op}", self.write_op)
+        app.router.add_post("/ledger/read/{op}", self.read_op)
+        app.router.add_get("/health", self.health)
+        return app
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _call(self, op: str, allowed: set[str], request: web.Request) -> web.Response:
+        if op not in allowed:
+            return web.json_response(
+                {"success": False, "error": f"unknown op {op}"}, status=404
+            )
+        try:
+            params = await request.json() if request.can_read_body else {}
+        except json.JSONDecodeError:
+            return web.json_response(
+                {"success": False, "error": "invalid json"}, status=400
+            )
+        try:
+            result = getattr(self.ledger, op)(**params)
+        except LedgerError as e:
+            return web.json_response({"success": False, "error": str(e)}, status=400)
+        except TypeError as e:
+            return web.json_response(
+                {"success": False, "error": f"bad params: {e}"}, status=400
+            )
+        return web.json_response({"success": True, "data": _jsonable(result)})
+
+    async def write_op(self, request: web.Request) -> web.Response:
+        return await self._call(request.match_info["op"], WRITE_OPS, request)
+
+    async def read_op(self, request: web.Request) -> web.Response:
+        return await self._call(request.match_info["op"], READ_OPS, request)

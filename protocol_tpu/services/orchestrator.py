@@ -88,6 +88,7 @@ class OrchestratorService:
         disable_ejection: bool = False,
         uploads_per_hour: int = 3,  # main.rs:76-78
         heartbeat_url: str = "http://localhost:8090",
+        webhook=None,  # WebhookPlugin (plugins/webhook/mod.rs)
     ):
         self.ledger = ledger
         self.pool_id = pool_id
@@ -102,7 +103,20 @@ class OrchestratorService:
         self.disable_ejection = disable_ejection
         self.uploads_per_hour = uploads_per_hour
         self.heartbeat_url = heartbeat_url
+        self.webhook = webhook
         self.loop_beats: dict[str, float] = {}
+        if webhook is not None and groups_plugin is not None:
+            groups_plugin.on_group_created = webhook.handle_group_created
+            groups_plugin.on_group_dissolved = webhook.handle_group_destroyed
+
+    def _set_status(self, address: str, status: NodeStatus) -> None:
+        """Status transition + webhook notification (the reference's
+        StatusUpdatePlugin dispatch, plugins/mod.rs:17-34)."""
+        node = self.store.node_store.get_node(address)
+        old = node.status if node else None
+        self.store.node_store.update_node_status(address, status)
+        if self.webhook is not None and old is not None and old != status:
+            self.webhook.handle_status_change(address, old.value, status.value)
 
     # ================= HTTP =================
 
@@ -193,7 +207,8 @@ class OrchestratorService:
             if entries:
                 self.store.metrics_store.store_metrics(entries, address)
 
-        task = self.scheduler.get_task_for_node(address)
+        # the batch solve runs device work; keep it off the event loop
+        task = await asyncio.to_thread(self.scheduler.get_task_for_node, address)
         return web.json_response(
             {
                 "success": True,
@@ -213,7 +228,7 @@ class OrchestratorService:
             file_name = str(body["file_name"])
             file_size = int(body["file_size"])
             sha256 = str(body["sha256"])
-        except (KeyError, ValueError):
+        except (KeyError, ValueError, TypeError):
             return _err("missing file_name/file_size/sha256", 400)
         task_id = body.get("task_id")
 
@@ -328,7 +343,7 @@ class OrchestratorService:
         self.store.kv.set(BAN_KEY.format(address), "1")
         node = self.store.node_store.get_node(address)
         if node is not None:
-            self.store.node_store.update_node_status(address, NodeStatus.BANNED)
+            self._set_status(address, NodeStatus.BANNED)
             self.store.metrics_store.delete_metrics_for_node(address)
             if self.groups_plugin is not None:
                 node.status = NodeStatus.BANNED
@@ -424,9 +439,7 @@ class OrchestratorService:
                         and other.address != addr
                         and other.status != NodeStatus.DEAD
                     ):
-                        self.store.node_store.update_node_status(
-                            other.address, NodeStatus.DEAD
-                        )
+                        self._set_status(other.address, NodeStatus.DEAD)
                 self.store.node_store.add_node(
                     OrchestratorNode(
                         address=addr,
@@ -455,13 +468,13 @@ class OrchestratorService:
                 changed += 1
             # zero balance -> LowBalance (monitor.rs:385-395)
             elif dn.latest_balance == 0 and node.status == NodeStatus.HEALTHY:
-                self.store.node_store.update_node_status(addr, NodeStatus.LOW_BALANCE)
+                self._set_status(addr, NodeStatus.LOW_BALANCE)
                 changed += 1
             elif (
                 node.status == NodeStatus.LOW_BALANCE
                 and (dn.latest_balance or 0) > 0
             ):
-                self.store.node_store.update_node_status(addr, NodeStatus.UNHEALTHY)
+                self._set_status(addr, NodeStatus.UNHEALTHY)
                 changed += 1
         return changed
 
@@ -492,9 +505,7 @@ class OrchestratorService:
             }
             ok = await self.invite_sender(node, payload)
             if ok:
-                self.store.node_store.update_node_status(
-                    node.address, NodeStatus.WAITING_FOR_HEARTBEAT
-                )
+                self._set_status(node.address, NodeStatus.WAITING_FOR_HEARTBEAT)
                 self.store.heartbeat_store.clear_unhealthy_counter(node.address)
                 invited += 1
         return invited
@@ -519,14 +530,14 @@ class OrchestratorService:
                     in_pool = self.ledger.is_node_in_pool(self.pool_id, addr)
                     target = NodeStatus.HEALTHY if in_pool else NodeStatus.UNHEALTHY
                     if node.status != target:
-                        self.store.node_store.update_node_status(addr, target)
+                        self._set_status(addr, target)
                         if target != NodeStatus.HEALTHY and self.groups_plugin:
                             node.status = target
                             self.groups_plugin.handle_status_change(node)
                     hs.clear_unhealthy_counter(addr)
             else:
                 if node.status == NodeStatus.HEALTHY:
-                    self.store.node_store.update_node_status(addr, NodeStatus.UNHEALTHY)
+                    self._set_status(addr, NodeStatus.UNHEALTHY)
                     hs.increment_unhealthy_counter(addr)
                     if self.groups_plugin:
                         node.status = NodeStatus.UNHEALTHY
@@ -554,7 +565,7 @@ class OrchestratorService:
                         pass
 
     def _mark_dead(self, node: OrchestratorNode) -> None:
-        self.store.node_store.update_node_status(node.address, NodeStatus.DEAD)
+        self._set_status(node.address, NodeStatus.DEAD)
         # dead nodes lose their metrics (status_update/mod.rs:314-350)
         self.store.metrics_store.delete_metrics_for_node(node.address)
         if self.groups_plugin is not None:

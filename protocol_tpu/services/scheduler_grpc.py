@@ -1,0 +1,264 @@
+"""Scheduler gRPC backend service.
+
+The seam from BASELINE.json's north star: a control plane (the reference's
+Rust orchestrator, or this repo's Python one) calls ``Assign`` with columnar
+provider/requirement batches; the backend builds the cost structure on the
+accelerator and returns the matching. Columnar fixed-width payloads keep the
+(de)serialization cost linear in P+T — no per-entity JSON on the hot path
+(SURVEY.md §7 hard part #6).
+
+Service stubs are hand-wired with grpc generic handlers (no protoc grpc
+plugin needed); messages come from protocol_tpu.proto.scheduler_pb2.
+
+Kernels: "greedy" (first-fit scan), "auction" (dense Bertsekas),
+"sinkhorn" (entropic OT + rounding), "topk" (streaming candidates + sparse
+frontier auction — the scale path).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from protocol_tpu.ops.cost import CostWeights, cost_matrix
+from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
+from protocol_tpu.proto import scheduler_pb2 as pb
+
+SERVICE_NAME = "protocol_tpu.scheduler.v1.SchedulerBackend"
+
+
+def _np(arr, dtype):
+    return np.asarray(list(arr), dtype=dtype)
+
+
+def providers_from_proto(msg: pb.ProviderBatch) -> EncodedProviders:
+    n = len(msg.gpu_count)
+    return EncodedProviders(
+        gpu_count=_np(msg.gpu_count, np.int32),
+        gpu_mem_mb=_np(msg.gpu_mem_mb, np.int32),
+        gpu_model_id=_np(msg.gpu_model_id, np.int32),
+        has_gpu=_np(msg.has_gpu, bool),
+        has_cpu=_np(msg.has_cpu, bool),
+        cpu_cores=_np(msg.cpu_cores, np.int32),
+        ram_mb=_np(msg.ram_mb, np.int32),
+        storage_gb=_np(msg.storage_gb, np.int32),
+        lat=_np(msg.lat, np.float32),
+        lon=_np(msg.lon, np.float32),
+        has_location=_np(msg.has_location, bool),
+        price=_np(msg.price, np.float32),
+        load=_np(msg.load, np.float32),
+        valid=np.ones(n, bool),
+    )
+
+
+def requirements_from_proto(msg: pb.RequirementBatch) -> EncodedRequirements:
+    t = len(msg.cpu_cores)
+    k = max(int(msg.max_gpu_options), 1)
+    w = max(int(msg.model_words), 1)
+    return EncodedRequirements(
+        cpu_required=_np(msg.cpu_required, bool),
+        cpu_cores=_np(msg.cpu_cores, np.int32),
+        ram_mb=_np(msg.ram_mb, np.int32),
+        storage_gb=_np(msg.storage_gb, np.int32),
+        gpu_opt_valid=_np(msg.gpu_opt_valid, bool).reshape(t, k),
+        gpu_count=_np(msg.gpu_count, np.int32).reshape(t, k),
+        gpu_mem_min=_np(msg.gpu_mem_min, np.int32).reshape(t, k),
+        gpu_mem_max=_np(msg.gpu_mem_max, np.int32).reshape(t, k),
+        gpu_total_mem_min=_np(msg.gpu_total_mem_min, np.int32).reshape(t, k),
+        gpu_total_mem_max=_np(msg.gpu_total_mem_max, np.int32).reshape(t, k),
+        gpu_model_mask=_np(msg.gpu_model_mask, np.uint32).reshape(t, k, w),
+        gpu_model_constrained=_np(msg.gpu_model_constrained, bool).reshape(t, k),
+        lat=_np(msg.lat, np.float32),
+        lon=_np(msg.lon, np.float32),
+        has_location=_np(msg.has_location, bool),
+        priority=_np(msg.priority, np.float32),
+        valid=np.ones(t, bool),
+    )
+
+
+class SchedulerBackendServicer:
+    def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        t0 = time.perf_counter()
+        ep = providers_from_proto(request.providers)
+        er = requirements_from_proto(request.requirements)
+        weights = CostWeights(
+            price=request.weights.price or 1.0,
+            load=request.weights.load or 1.0,
+            proximity=request.weights.proximity or 0.001,
+            priority=request.weights.priority or 0.0,
+        )
+        kernel = request.kernel or "auction"
+
+        P = int(np.asarray(ep.gpu_count).shape[0])
+        T = int(np.asarray(er.cpu_cores).shape[0])
+        if P == 0 or T == 0:
+            # degenerate batches are legal: nothing to match
+            return pb.AssignResponse(
+                provider_for_task=[-1] * T,
+                task_for_provider=[-1] * P,
+                num_assigned=0,
+                solve_ms=(time.perf_counter() - t0) * 1e3,
+            )
+
+        if kernel == "topk":
+            from protocol_tpu.ops.sparse import assign_topk
+
+            # tile must divide T: fall back to T itself for small batches
+            T = er.cpu_cores.shape[0]
+            tile = min(1024, T)
+            while T % tile != 0:
+                tile -= 1
+            res = assign_topk(
+                ep, er, weights,
+                k=max(int(request.top_k) or 64, 1),
+                tile=tile,
+                eps=request.eps or 0.01,
+            )
+        else:
+            from protocol_tpu.ops.assign import (
+                assign_auction,
+                assign_greedy,
+                assign_sinkhorn,
+            )
+
+            cost, _ = cost_matrix(ep, er, weights)
+            if kernel == "greedy":
+                res = assign_greedy(cost)
+            elif kernel == "sinkhorn":
+                res = assign_sinkhorn(cost, eps=request.eps or 0.05)
+            elif kernel == "auction":
+                res = assign_auction(cost, eps=request.eps or 0.01)
+            else:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel {kernel!r}"
+                )
+
+        p4t = np.asarray(res.provider_for_task)
+        t4p = np.asarray(res.task_for_provider)
+        return pb.AssignResponse(
+            provider_for_task=p4t.tolist(),
+            task_for_provider=t4p.tolist(),
+            num_assigned=int((p4t >= 0).sum()),
+            solve_ms=(time.perf_counter() - t0) * 1e3,
+        )
+
+    def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
+        import jax
+
+        devices = jax.devices()
+        return pb.HealthResponse(
+            status="ok",
+            platform=devices[0].platform if devices else "none",
+            device_count=len(devices),
+        )
+
+
+def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
+    return grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "Assign": grpc.unary_unary_rpc_method_handler(
+                servicer.Assign,
+                request_deserializer=pb.AssignRequest.FromString,
+                response_serializer=pb.AssignResponse.SerializeToString,
+            ),
+            "Health": grpc.unary_unary_rpc_method_handler(
+                servicer.Health,
+                request_deserializer=pb.HealthRequest.FromString,
+                response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+        },
+    )
+
+
+def serve(address: str = "127.0.0.1:50061", max_workers: int = 4) -> grpc.Server:
+    """Start the backend server (non-blocking; call .wait_for_termination())."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_handlers(SchedulerBackendServicer()),))
+    server.add_insecure_port(address)
+    server.start()
+    return server
+
+
+class SchedulerBackendClient:
+    """Thin client stub (what a non-Python control plane would generate)."""
+
+    def __init__(self, address: str = "127.0.0.1:50061"):
+        self.channel = grpc.insecure_channel(address)
+        self._assign = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/Assign",
+            request_serializer=pb.AssignRequest.SerializeToString,
+            response_deserializer=pb.AssignResponse.FromString,
+        )
+        self._health = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+
+    def assign(self, request: pb.AssignRequest, timeout: float = 60.0) -> pb.AssignResponse:
+        return self._assign(request, timeout=timeout)
+
+    def health(self, timeout: float = 10.0) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=timeout)
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def encoded_to_proto(
+    ep: EncodedProviders, er: EncodedRequirements, weights: Optional[CostWeights] = None,
+    kernel: str = "topk", top_k: int = 64, eps: float = 0.01,
+) -> pb.AssignRequest:
+    """Host-side helper: pack numpy-backed encodings into an AssignRequest."""
+    w = weights or CostWeights()
+    t, k = np.asarray(er.gpu_opt_valid).shape
+    words = np.asarray(er.gpu_model_mask).shape[-1]
+    return pb.AssignRequest(
+        providers=pb.ProviderBatch(
+            gpu_count=np.asarray(ep.gpu_count).tolist(),
+            gpu_mem_mb=np.asarray(ep.gpu_mem_mb).tolist(),
+            gpu_model_id=np.asarray(ep.gpu_model_id).tolist(),
+            has_gpu=np.asarray(ep.has_gpu).tolist(),
+            has_cpu=np.asarray(ep.has_cpu).tolist(),
+            cpu_cores=np.asarray(ep.cpu_cores).tolist(),
+            ram_mb=np.asarray(ep.ram_mb).tolist(),
+            storage_gb=np.asarray(ep.storage_gb).tolist(),
+            lat=np.asarray(ep.lat).tolist(),
+            lon=np.asarray(ep.lon).tolist(),
+            has_location=np.asarray(ep.has_location).tolist(),
+            price=np.asarray(ep.price).tolist(),
+            load=np.asarray(ep.load).tolist(),
+        ),
+        requirements=pb.RequirementBatch(
+            cpu_required=np.asarray(er.cpu_required).tolist(),
+            cpu_cores=np.asarray(er.cpu_cores).tolist(),
+            ram_mb=np.asarray(er.ram_mb).tolist(),
+            storage_gb=np.asarray(er.storage_gb).tolist(),
+            max_gpu_options=k,
+            model_words=words,
+            gpu_opt_valid=np.asarray(er.gpu_opt_valid).reshape(-1).tolist(),
+            gpu_count=np.asarray(er.gpu_count).reshape(-1).tolist(),
+            gpu_mem_min=np.asarray(er.gpu_mem_min).reshape(-1).tolist(),
+            gpu_mem_max=np.asarray(er.gpu_mem_max).reshape(-1).tolist(),
+            gpu_total_mem_min=np.asarray(er.gpu_total_mem_min).reshape(-1).tolist(),
+            gpu_total_mem_max=np.asarray(er.gpu_total_mem_max).reshape(-1).tolist(),
+            gpu_model_mask=np.asarray(er.gpu_model_mask).reshape(-1).tolist(),
+            gpu_model_constrained=np.asarray(er.gpu_model_constrained).reshape(-1).tolist(),
+            lat=np.asarray(er.lat).tolist(),
+            lon=np.asarray(er.lon).tolist(),
+            has_location=np.asarray(er.has_location).tolist(),
+            priority=np.asarray(er.priority).tolist(),
+        ),
+        weights=pb.CostWeights(
+            price=float(w.price), load=float(w.load),
+            proximity=float(w.proximity), priority=float(w.priority),
+        ),
+        kernel=kernel,
+        top_k=top_k,
+        eps=eps,
+    )

@@ -190,11 +190,17 @@ class SyntheticDataValidator:
                 members = self.kv.hgetall(ghash)
                 self._set_status(key, ValidationResult.PENDING)
                 if len(members) >= gk.size:
-                    # complete group -> group validation trigger
+                    # complete group -> group validation trigger. Only leave
+                    # the incomplete set once the trigger actually landed;
+                    # a transient toploc outage must keep the group eligible
+                    # for retry / grace-expiry instead of stranding members
+                    # in Pending forever.
                     client = self._client_for(file_name)
                     if client and await client.trigger(file_name, group=True):
                         stats["triggered"] += 1
-                    self.kv.zrem(INCOMPLETE_GROUPS_ZSET, ghash)
+                        self.kv.zrem(INCOMPLETE_GROUPS_ZSET, ghash)
+                    elif self.kv.zscore(INCOMPLETE_GROUPS_ZSET, ghash) is None:
+                        self.kv.zadd(INCOMPLETE_GROUPS_ZSET, {ghash: time.time()})
                 else:
                     if self.kv.zscore(INCOMPLETE_GROUPS_ZSET, ghash) is None:
                         self.kv.zadd(INCOMPLETE_GROUPS_ZSET, {ghash: time.time()})
@@ -318,8 +324,6 @@ class ValidatorService:
     async def challenge_node(self, control_url: str) -> bool:
         """Matmul round-trip: both sides compute on their accelerator; the
         worker's answer must match within tolerance."""
-        import jax.numpy as jnp
-
         n = self.challenge_size
         a = self.rng.standard_normal((n, n), dtype=np.float32)
         b = self.rng.standard_normal((n, n), dtype=np.float32)
@@ -334,7 +338,14 @@ class ValidatorService:
                 data = await resp.json()
         except Exception:
             return False
-        expected = np.asarray(jnp.asarray(a) @ jnp.asarray(b))
+
+        def compute():
+            # device work off the event loop (synchronous jax call)
+            import jax.numpy as jnp
+
+            return np.asarray(jnp.asarray(a) @ jnp.asarray(b))
+
+        expected = await asyncio.to_thread(compute)
         got = np.asarray(data.get("result", []), dtype=np.float32)
         if got.shape != expected.shape:
             return False

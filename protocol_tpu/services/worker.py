@@ -80,9 +80,16 @@ class IssueReport:
         return [i for i in self.issues if i.level == "critical"]
 
 
-def detect_compute_specs(storage_path: str = "/") -> tuple[ComputeSpecs, IssueReport]:
+def detect_compute_specs(
+    storage_path: str = "/", probe_accelerator: bool = True
+) -> tuple[ComputeSpecs, IssueReport]:
     """Host introspection (checks/hardware/): CPU cores, RAM, disk; TPU/GPU
-    detection via the JAX device list when available."""
+    detection via the JAX device list when available.
+
+    ``probe_accelerator=False`` skips the jax.devices() call — backend
+    initialization can block indefinitely when a remote accelerator plugin
+    is unreachable, and control-plane processes must boot regardless.
+    """
     report = IssueReport()
     cores = os.cpu_count() or 1
     ram_mb = 0
@@ -105,14 +112,15 @@ def detect_compute_specs(storage_path: str = "/") -> tuple[ComputeSpecs, IssueRe
         report.add("warning", f"only {storage_gb} GB storage (minimum 1 TB)")
 
     gpu = None
-    try:  # accelerator presence via jax, the framework's device layer
-        import jax
+    if probe_accelerator:
+        try:  # accelerator presence via jax, the framework's device layer
+            import jax
 
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
-        if devs:
-            gpu = GpuSpecs(count=len(devs), model=devs[0].device_kind)
-    except Exception:
-        pass
+            devs = [d for d in jax.devices() if d.platform != "cpu"]
+            if devs:
+                gpu = GpuSpecs(count=len(devs), model=devs[0].device_kind)
+        except Exception:
+            pass
 
     specs = ComputeSpecs(
         gpu=gpu,
@@ -480,14 +488,19 @@ class WorkerAgent:
                 {"success": False, "error": "missing matrices"}, status=400
             )
         import numpy as np
-        import jax.numpy as jnp
 
-        result = jnp.asarray(np.asarray(a, np.float32)) @ jnp.asarray(
-            np.asarray(b, np.float32)
-        )
-        return web.json_response(
-            {"success": True, "result": np.asarray(result).tolist()}
-        )
+        def compute():
+            # device work off the event loop: jax calls are synchronous and
+            # must not stall the control plane if the accelerator is slow
+            import jax.numpy as jnp
+
+            out = jnp.asarray(np.asarray(a, np.float32)) @ jnp.asarray(
+                np.asarray(b, np.float32)
+            )
+            return np.asarray(out).tolist()
+
+        result = await asyncio.to_thread(compute)
+        return web.json_response({"success": True, "result": result})
 
     async def handle_logs(self, request: web.Request) -> web.Response:
         logs = getattr(self.runtime, "logs", [])

@@ -1,0 +1,85 @@
+"""gRPC scheduler-backend shim: round-trip over a real localhost channel,
+kernel selection, and parity with the in-process kernels."""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.models.node import ComputeRequirements
+from protocol_tpu.ops.encoding import FeatureEncoder
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.services.scheduler_grpc import (
+    SchedulerBackendClient,
+    encoded_to_proto,
+    serve,
+)
+
+from tests.test_encoding import random_requirements, random_specs
+
+
+@pytest.fixture(scope="module")
+def backend():
+    server = serve(address="127.0.0.1:50971")
+    client = SchedulerBackendClient("127.0.0.1:50971")
+    yield client
+    client.close()
+    server.stop(grace=None)
+
+
+def build_batch(seed=0, P=24, T=16):
+    import random
+
+    rng = random.Random(seed)
+    enc = FeatureEncoder()
+    specs = [random_specs(rng) for _ in range(P)]
+    reqs = [random_requirements(rng) for _ in range(T)]
+    ep = enc.encode_providers(specs)
+    er = enc.encode_requirements(reqs)
+    return ep, er, specs, reqs
+
+
+def test_health(backend):
+    h = backend.health()
+    assert h.status == "ok"
+    assert h.device_count >= 1
+
+
+@pytest.mark.parametrize("kernel", ["greedy", "auction", "sinkhorn", "topk"])
+def test_assign_kernels_feasible(backend, kernel):
+    ep, er, specs, reqs = build_batch()
+    req = encoded_to_proto(ep, er, kernel=kernel, top_k=8)
+    resp = backend.assign(req)
+    p4t = list(resp.provider_for_task)
+    assert len(p4t) == 16
+    used = set()
+    for t, p in enumerate(p4t):
+        if p >= 0:
+            assert specs[p].meets(reqs[t]), f"incompatible {kernel} match t={t} p={p}"
+            assert p not in used
+            used.add(p)
+    assert resp.num_assigned == sum(1 for p in p4t if p >= 0)
+    assert resp.solve_ms > 0
+
+
+def test_greedy_parity_with_inprocess(backend):
+    from protocol_tpu.ops.assign import assign_greedy
+    from protocol_tpu.ops.cost import CostWeights, cost_matrix
+
+    ep, er, _, _ = build_batch(seed=1)
+    req = encoded_to_proto(ep, er, kernel="greedy")
+    resp = backend.assign(req)
+    cost, _ = cost_matrix(ep, er, CostWeights())
+    local = assign_greedy(cost)
+    np.testing.assert_array_equal(
+        np.asarray(resp.provider_for_task),
+        np.asarray(local.provider_for_task),
+    )
+
+
+def test_unknown_kernel_rejected(backend):
+    import grpc
+
+    ep, er, _, _ = build_batch(seed=2, P=4, T=4)
+    req = encoded_to_proto(ep, er, kernel="magic")
+    with pytest.raises(grpc.RpcError) as e:
+        backend.assign(req)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT

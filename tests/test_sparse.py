@@ -26,11 +26,21 @@ def encode_random_marketplace(seed, P, T):
     return ep, er
 
 
+def jittered_cost(cost: np.ndarray) -> np.ndarray:
+    """Replicates the kernel's deterministic tie-breaking jitter."""
+    P, T = cost.shape
+    p = np.arange(P, dtype=np.uint32)[:, None]
+    t = np.arange(T, dtype=np.uint32)[None, :]
+    h = (p * np.uint32(2654435761)) ^ (t * np.uint32(40503))
+    jit = (h & np.uint32(1023)).astype(np.float32) * np.float32(1e-7)
+    return np.where(cost < INFEASIBLE * 0.5, cost + jit, cost).astype(np.float32)
+
+
 class TestCandidates:
     def test_matches_bruteforce_topk(self):
         ep, er = encode_random_marketplace(0, 32, 16)
         cand_p, cand_c = candidates_topk(ep, er, k=8, tile=8)
-        cost = np.asarray(cost_matrix(ep, er, CostWeights())[0])  # [P, T]
+        cost = jittered_cost(np.asarray(cost_matrix(ep, er, CostWeights())[0]))
         for t in range(16):
             order = np.argsort(cost[:, t], kind="stable")[:8]
             expected = [int(p) if cost[p, t] < INFEASIBLE * 0.5 else -1 for p in order]
@@ -40,6 +50,24 @@ class TestCandidates:
             np.testing.assert_allclose(
                 np.asarray(cand_c)[t][feas], cost[order, t][feas], rtol=1e-6
             )
+
+    def test_identical_providers_not_capped_at_k(self):
+        """Degenerate marketplace: N identical providers must not collapse
+        every task's candidate list to the same k entries."""
+        from protocol_tpu.models.node import ComputeRequirements, ComputeSpecs, CpuSpecs, GpuSpecs
+        from protocol_tpu.ops.sparse import assign_topk
+
+        enc = FeatureEncoder()
+        spec = ComputeSpecs(
+            gpu=GpuSpecs(count=8, model="H100", memory_mb=80000),
+            cpu=CpuSpecs(cores=32), ram_mb=65536, storage_gb=1000,
+        )
+        ep = enc.encode_providers([spec] * 16)
+        er = enc.encode_requirements(
+            [ComputeRequirements.parse("gpu:count=8;gpu:model=H100")] * 8
+        )
+        res = assign_topk(ep, er, k=4, tile=8, eps=0.01)
+        assert int(np.asarray(res.provider_for_task >= 0).sum()) == 8
 
     def test_tile_divisibility_enforced(self):
         ep, er = encode_random_marketplace(1, 8, 10)

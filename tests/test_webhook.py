@@ -1,0 +1,111 @@
+"""Webhook plugin tests: event flow from status changes and group lifecycle
+to HTTP delivery, filters, and overflow behavior."""
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.sched.node_groups import NodeGroupConfiguration, NodeGroupsPlugin
+from protocol_tpu.sched.webhook import WebhookConfig, WebhookPlugin
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+from tests.test_services import make_world
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_sink():
+    received = []
+
+    async def hook(request):
+        received.append(await request.json())
+        return web.json_response({"ok": True})
+
+    app = web.Application()
+    app.router.add_post("/hook", hook)
+    return app, received
+
+
+class TestWebhookPlugin:
+    def test_config_from_env_json(self):
+        cfgs = WebhookConfig.from_json_env(
+            json.dumps([{"url": "http://x/hook", "event_types": ["group_created"]}])
+        )
+        assert cfgs[0].url == "http://x/hook"
+        assert cfgs[0].event_types == ["group_created"]
+
+    def test_delivery_and_filter(self):
+        async def flow():
+            app, received = make_sink()
+            async with TestClient(TestServer(app)) as client:
+                wh = WebhookPlugin(
+                    [WebhookConfig(url="/hook", event_types=["node_status_changed"])],
+                    http=client,
+                )
+                wh.handle_status_change("0xa", "Healthy", "Dead")
+                wh.handle_group_created({"id": "g1"})  # filtered out
+                await wh.drain_once()
+                return received
+
+        received = run(flow())
+        assert len(received) == 1
+        assert received[0]["type"] == "node_status_changed"
+        assert received[0]["new_status"] == "Dead"
+
+    def test_overflow_drops_oldest(self):
+        async def flow():
+            wh = WebhookPlugin([], http=None, queue_size=2)
+            for i in range(4):
+                wh.emit("e", n=i)
+            out = []
+            while not wh.queue.empty():
+                out.append(wh.queue.get_nowait()["n"])
+            return out, wh.dropped
+
+        out, dropped = run(flow())
+        assert out == [2, 3] and dropped == 2
+
+    def test_orchestrator_status_changes_emit(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+
+        async def flow():
+            app, received = make_sink()
+            async with TestClient(TestServer(app)) as client:
+                wh = WebhookPlugin([WebhookConfig(url="/hook")], http=client)
+                svc = OrchestratorService(ledger, pid, manager, webhook=wh)
+                svc.store.node_store.add_node(
+                    OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+                )
+                await svc.status_update_once()  # no beat -> Unhealthy
+                await wh.drain_once()
+                return received
+
+        received = run(flow())
+        assert [e["type"] for e in received] == ["node_status_changed"]
+        assert received[0]["old_status"] == "Healthy"
+        assert received[0]["new_status"] == "Unhealthy"
+
+    def test_group_lifecycle_events(self):
+        async def flow():
+            app, received = make_sink()
+            async with TestClient(TestServer(app)) as client:
+                wh = WebhookPlugin([WebhookConfig(url="/hook")], http=client)
+                ctx = StoreContext.new_test()
+                plugin = NodeGroupsPlugin(
+                    ctx,
+                    [NodeGroupConfiguration(name="pair", min_group_size=1, max_group_size=2)],
+                )
+                plugin.on_group_created = wh.handle_group_created
+                plugin.on_group_dissolved = wh.handle_group_destroyed
+                g = plugin._create_group(plugin.configurations[0], ["0xa"])
+                plugin.dissolve_group(g.id)
+                await wh.drain_once()
+                return received
+
+        received = run(flow())
+        assert [e["type"] for e in received] == ["group_created", "group_destroyed"]
