@@ -1,0 +1,33 @@
+# Developer entry points (the reference drives its dev environment from a
+# Makefile too: reth devnet + redis + tmux service panes; here the whole
+# cluster is one process).
+
+PY ?= python
+
+.PHONY: test test-fast native devnet bench clean lint
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+# native CPU assignment engine (ctypes-loaded shared library)
+native:
+	g++ -O3 -march=native -shared -fPIC -o native/libassign_engine.so native/assign_engine.cpp
+
+# one-command local cluster: ledger API + discovery + orchestrator +
+# validator + workers. See python -m protocol_tpu.devnet --help.
+devnet:
+	$(PY) -m protocol_tpu.devnet --workers 2 --cpu
+
+# the scheduler-kernel benchmark (real accelerator; prints one JSON line)
+bench:
+	$(PY) bench.py
+
+# regenerate protobuf messages for the gRPC shim
+proto:
+	protoc --python_out=. protocol_tpu/proto/scheduler.proto
+
+clean:
+	rm -rf native/libassign_engine.so **/__pycache__ .pytest_cache

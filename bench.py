@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from protocol_tpu.ops.assign import assign_auction, assign_greedy
 from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
-from protocol_tpu.ops.sparse import assign_auction_sparse, candidates_topk
+from protocol_tpu.ops.sparse import assign_auction_sparse_scaled, candidates_topk
 
 P, T = 32768, 32768
 TOPK = 64
@@ -101,13 +101,16 @@ def synth_requirements(rng: np.random.Generator, n: int) -> EncodedRequirements:
     )
 
 
-@jax.jit
 def tpu_match(ep: EncodedProviders, er: EncodedRequirements):
     """Full hot path: streaming top-K candidate generation over the
-    featurized cost tensor (never materializing [P, T]) + sparse auction."""
+    featurized cost tensor (never materializing [P, T]) + eps-scaled sparse
+    frontier auction with cleanup. Host loop over jitted phases — each phase
+    executable is cached after warmup."""
+
     cand_p, cand_c = candidates_topk(ep, er, CostWeights(), k=TOPK, tile=TILE)
-    res = assign_auction_sparse(
-        cand_p, cand_c, num_providers=ep.gpu_count.shape[0], eps=0.02, max_iters=600
+    res = assign_auction_sparse_scaled(
+        cand_p, cand_c, num_providers=ep.gpu_count.shape[0],
+        eps_start=4.0, eps_end=0.05, max_iters_per_phase=400,
     )
     return res.provider_for_task, res.num_assigned()
 
@@ -147,6 +150,22 @@ def main() -> None:
         )
     _, cpu_time = cpu_greedy_baseline(cost_np)
     log(f"cpu greedy wall: {cpu_time * 1e3:.1f} ms")
+
+    # informational: the native C++ engine (this framework's own CPU
+    # fallback backend) on the same problem
+    try:
+        from protocol_tpu import native
+
+        t0 = time.perf_counter()
+        cand_p, cand_c = native.topk_candidates(cost_np, k=TOPK)
+        p4t_native = native.auction_sparse(cand_p, cand_c, num_providers=P)
+        native_time = time.perf_counter() - t0
+        log(
+            f"native C++ topk+auction wall: {native_time * 1e3:.1f} ms "
+            f"({int((p4t_native >= 0).sum())} assigned)"
+        )
+    except Exception as e:
+        log(f"native engine unavailable: {e}")
     del cost_np
 
     # ---- TPU path: ship features (O(P+T) bytes), compile, time

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""In-container workload example: report metrics through the TaskBridge.
+
+The worker injects SOCKET_PATH / PRIME_TASK_ID / NODE_ADDRESS into every
+task's environment (protocol_tpu/services/worker.py, mirroring the
+reference's examples/python/taskbridge_basic.py client of the docker
+taskbridge socket). A workload connects to the unix socket and writes
+concatenated JSON objects:
+
+    {"task_id": "...", "loss": 0.25, "throughput": 1234.0}
+
+Those land in the worker's metric store and flow to the orchestrator on the
+next heartbeat.
+"""
+
+import json
+import os
+import socket
+import time
+
+SOCKET_PATH = os.environ.get("SOCKET_PATH", "/tmp/protocol_tpu_worker_0/bridge.sock")
+TASK_ID = os.environ.get("PRIME_TASK_ID", "example-task")
+
+
+def main() -> None:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(SOCKET_PATH)
+    try:
+        for step in range(5):
+            metrics = {
+                "task_id": TASK_ID,
+                "loss": 1.0 / (step + 1),
+                "step": float(step),
+            }
+            sock.sendall(json.dumps(metrics).encode())
+            print(f"sent metrics: {metrics}")
+            time.sleep(1.0)
+    finally:
+        sock.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""In-container workload example: submit validatable work output.
+
+Equivalent of the reference's examples/python/work_validation.py: after
+producing an artifact, the workload reports its sha256 and claimed FLOPs
+through the TaskBridge. The worker requests a signed upload URL from the
+orchestrator, submits the work key on the ledger, and the validator later
+verifies it through the toploc-style pipeline (accepting, rejecting with a
+stake slash, or soft-invalidating on a work-unit mismatch).
+
+File names matching ``...-<groupid>-<size>-<filenum>-<idx>.<ext>`` are
+validated as a group once all members arrive.
+"""
+
+import hashlib
+import json
+import os
+import socket
+
+SOCKET_PATH = os.environ.get("SOCKET_PATH", "/tmp/protocol_tpu_worker_0/bridge.sock")
+TASK_ID = os.environ.get("PRIME_TASK_ID", "example-task")
+
+
+def main() -> None:
+    # produce an artifact
+    payload = os.urandom(1024)
+    sha = hashlib.sha256(payload).hexdigest()
+    file_name = f"synthetic-{sha[:8]}-1-0-0.parquet"
+    out_path = f"/tmp/{file_name}"
+    with open(out_path, "wb") as f:
+        f.write(payload)
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(SOCKET_PATH)
+    try:
+        message = {
+            "output": {
+                "sha256": sha,
+                "output_flops": 123456,
+                "file_name": file_name,
+                "save_path": out_path,
+            }
+        }
+        sock.sendall(json.dumps(message).encode())
+        print(f"submitted work: sha={sha[:16]}... flops=123456")
+    finally:
+        sock.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,231 @@
+// Native CPU assignment engine.
+//
+// The host-side counterpart of the JAX kernels in protocol_tpu/ops: the
+// control plane's fallback scheduler backend when no accelerator is
+// reachable, and the honest CPU baseline for bench.py. Implements the same
+// contracts as ops/assign.py / ops/sparse.py:
+//
+//   greedy_assign       task-ordered greedy: each task takes the cheapest
+//                       free compatible provider (ties -> lowest provider
+//                       index) — bit-compatible with assign_greedy.
+//   auction_sparse      Gauss-Seidel Bertsekas auction on top-K candidate
+//                       lists with eps-scaling and give-up retirement —
+//                       the CPU mirror of assign_auction_sparse_scaled.
+//   topk_candidates     per-task top-k cheapest providers from a dense
+//                       cost matrix (with the same deterministic hash
+//                       jitter as candidates_topk).
+//
+// Exposed as a C ABI for ctypes (no pybind11 dependency). All matrices are
+// row-major contiguous; cost is [P, T] f32 with INFEASIBLE = 1e9 marking
+// incompatible pairs. Build: make native  (g++ -O3 -shared -fPIC).
+
+#include <algorithm>
+#include <cfloat>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+constexpr float kInfeasible = 1e9f;
+constexpr float kNeg = -1e18f;
+
+inline float jitter(uint32_t p, uint32_t t) {
+  // must match protocol_tpu/ops/sparse.py candidates_topk
+  uint32_t h = (p * 2654435761u) ^ (t * 40503u);
+  return static_cast<float>(h & 1023u) * 1e-7f;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Greedy matching. cost: [P*T] row-major ([p*T + t]); task_order: length T
+// (or null for 0..T-1); out_provider_for_task: length T (filled with -1 for
+// unassigned).
+void greedy_assign(const float* cost, int32_t P, int32_t T,
+                   const int32_t* task_order, int32_t* out_provider_for_task) {
+  std::vector<uint8_t> avail(P, 1);
+  for (int32_t i = 0; i < T; ++i) {
+    out_provider_for_task[i] = -1;
+  }
+  for (int32_t i = 0; i < T; ++i) {
+    const int32_t t = task_order ? task_order[i] : i;
+    float best = kInfeasible;
+    int32_t best_p = -1;
+    for (int32_t p = 0; p < P; ++p) {
+      if (!avail[p]) continue;
+      const float c = cost[static_cast<int64_t>(p) * T + t];
+      if (c < best) {
+        best = c;
+        best_p = p;
+      }
+    }
+    if (best_p >= 0 && best < kInfeasible * 0.5f) {
+      out_provider_for_task[t] = best_p;
+      avail[best_p] = 0;
+    }
+  }
+}
+
+// Per-task top-k candidates from a dense cost matrix, jittered for
+// degenerate marketplaces. out_cand_provider/out_cand_cost: [T*k].
+void topk_candidates(const float* cost, int32_t P, int32_t T, int32_t k,
+                     int32_t* out_cand_provider, float* out_cand_cost) {
+  if (k > P) k = P;
+  std::vector<std::pair<float, int32_t>> row(P);
+  for (int32_t t = 0; t < T; ++t) {
+    for (int32_t p = 0; p < P; ++p) {
+      float c = cost[static_cast<int64_t>(p) * T + t];
+      if (c < kInfeasible * 0.5f) c += jitter(p, t);
+      row[p] = {c, p};
+    }
+    std::partial_sort(row.begin(), row.begin() + k, row.end());
+    for (int32_t j = 0; j < k; ++j) {
+      const bool feas = row[j].first < kInfeasible * 0.5f;
+      out_cand_provider[static_cast<int64_t>(t) * k + j] =
+          feas ? row[j].second : -1;
+      out_cand_cost[static_cast<int64_t>(t) * k + j] = row[j].first;
+    }
+  }
+}
+
+// Gauss-Seidel auction on candidate lists with eps-scaling.
+// cand_provider/cand_cost: [T*K]; out_provider_for_task: length T.
+// Returns the number of assigned tasks.
+int32_t auction_sparse(const int32_t* cand_provider, const float* cand_cost,
+                       int32_t P, int32_t T, int32_t K, float eps_start,
+                       float eps_end, float scale, int64_t max_events,
+                       int32_t* out_provider_for_task) {
+  std::vector<float> price(P, 0.0f);
+  std::vector<int32_t> owner(P, -1);  // task holding each provider
+  std::vector<int32_t> p4t(T, -1);
+  std::vector<uint8_t> retired(T, 0);
+
+  float max_cost = 0.0f;
+  for (int64_t i = 0; i < static_cast<int64_t>(T) * K; ++i) {
+    if (cand_provider[i] >= 0 && cand_cost[i] > max_cost) {
+      max_cost = cand_cost[i];
+    }
+  }
+  const float give_up = -(2.0f * max_cost + 10.0f);
+
+  std::vector<int32_t> open;
+  open.reserve(T);
+  int64_t events = 0;
+
+  float eps = eps_start;
+  while (true) {
+    const bool final_phase = eps <= eps_end;
+    // Retirement only in the final phase: at coarse eps, price overshoot
+    // from an unfillable tail would push *viable* tasks past give-up.
+    // Coarse phases instead get a bounded event budget and hand off.
+    const int64_t phase_budget =
+        final_phase ? max_events : events + 4 * static_cast<int64_t>(T);
+
+    // collect open tasks for this eps phase
+    open.clear();
+    for (int32_t t = 0; t < T; ++t) {
+      if (p4t[t] < 0 && !retired[t]) open.push_back(t);
+    }
+    // Gauss-Seidel sweeps until the phase stabilizes or exhausts its budget
+    while (!open.empty() && events < phase_budget && events < max_events) {
+      const int32_t t = open.back();
+      open.pop_back();
+      if (p4t[t] >= 0 || retired[t]) continue;
+      // best + second-best value over candidates at current prices
+      float v1 = kNeg, v2 = kNeg;
+      int32_t p1 = -1;
+      for (int32_t j = 0; j < K; ++j) {
+        const int32_t p = cand_provider[static_cast<int64_t>(t) * K + j];
+        if (p < 0) continue;
+        const float v =
+            -cand_cost[static_cast<int64_t>(t) * K + j] - price[p];
+        if (v > v1) {
+          v2 = v1;
+          v1 = v;
+          p1 = p;
+        } else if (v > v2) {
+          v2 = v;
+        }
+      }
+      if (p1 < 0) {
+        retired[t] = 1;  // no feasible candidates at all
+        continue;
+      }
+      if (v1 < give_up) {
+        if (final_phase) {
+          retired[t] = 1;  // priced out everywhere: not worth it
+        }
+        continue;  // coarse phases: park it; the next phase re-collects
+      }
+      if (v2 < -1e8f) v2 = -1e8f;  // single-option floor
+      ++events;
+      price[p1] += (v1 - v2) + eps;
+      const int32_t evicted = owner[p1];
+      owner[p1] = t;
+      p4t[t] = p1;
+      if (evicted >= 0) {
+        p4t[evicted] = -1;
+        open.push_back(evicted);
+      }
+    }
+    if (eps <= eps_end || events >= max_events) break;
+    eps = std::max(eps * scale, eps_end);
+    // eps-CS repair: holders whose assignment violates the tighter eps
+    // re-enter the auction (keeping happy holders seated avoids both the
+    // full-reset cost and the mass-retirement pathology of pumped prices)
+    for (int32_t t = 0; t < T; ++t) {
+      const int32_t held = p4t[t];
+      if (held < 0 || retired[t]) continue;
+      float v1 = kNeg;
+      float vcur = kNeg;
+      for (int32_t j = 0; j < K; ++j) {
+        const int32_t p = cand_provider[static_cast<int64_t>(t) * K + j];
+        if (p < 0) continue;
+        const float v =
+            -cand_cost[static_cast<int64_t>(t) * K + j] - price[p];
+        if (v > v1) v1 = v;
+        if (p == held) vcur = v;
+      }
+      if (vcur < v1 - eps) {
+        owner[held] = -1;
+        p4t[t] = -1;
+      }
+    }
+  }
+
+  // Cleanup pass: a forward auction never lowers prices, so an unfillable
+  // tail can leave providers stranded at pumped prices while feasible tasks
+  // sit retired. Sweep the leftover graph greedily (cheapest free candidate
+  // per remaining task) — the reference's matcher semantics on the tail,
+  // guaranteeing no provider stays idle while a compatible task waits.
+  for (int32_t t = 0; t < T; ++t) {
+    if (p4t[t] >= 0) continue;
+    float best = kInfeasible;
+    int32_t best_p = -1;
+    for (int32_t j = 0; j < K; ++j) {
+      const int32_t p = cand_provider[static_cast<int64_t>(t) * K + j];
+      if (p < 0 || owner[p] >= 0) continue;
+      const float c = cand_cost[static_cast<int64_t>(t) * K + j];
+      if (c < best) {
+        best = c;
+        best_p = p;
+      }
+    }
+    if (best_p >= 0 && best < kInfeasible * 0.5f) {
+      owner[best_p] = t;
+      p4t[t] = best_p;
+    }
+  }
+
+  int32_t assigned = 0;
+  for (int32_t t = 0; t < T; ++t) {
+    out_provider_for_task[t] = p4t[t];
+    if (p4t[t] >= 0) ++assigned;
+  }
+  return assigned;
+}
+
+}  // extern "C"

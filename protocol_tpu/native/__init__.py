@@ -1,0 +1,120 @@
+"""ctypes bindings for the native CPU assignment engine.
+
+Builds native/assign_engine.cpp on demand (g++ -O3 -shared -fPIC, cached by
+source mtime) and exposes numpy-friendly wrappers with the same contracts as
+the JAX kernels in protocol_tpu.ops. This is the control plane's
+no-accelerator fallback backend and the honest CPU baseline for bench.py —
+the counterpart of the reference's in-process Rust matcher
+(crates/orchestrator/src/scheduler/mod.rs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "assign_engine.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "libassign_engine.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        detail = getattr(e, "stderr", str(e))
+        raise NativeBuildError(f"native engine build failed: {detail}") from e
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the engine. Raises NativeBuildError if no
+    toolchain is available — callers fall back to the numpy/JAX paths."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        _build()
+    lib = ctypes.CDLL(_SO)
+
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+    lib.greedy_assign.argtypes = [
+        f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, i32p
+    ]
+    lib.greedy_assign.restype = None
+    lib.topk_candidates.argtypes = [
+        f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, i32p, f32p
+    ]
+    lib.topk_candidates.restype = None
+    lib.auction_sparse.argtypes = [
+        i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64, i32p,
+    ]
+    lib.auction_sparse.restype = ctypes.c_int32
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeBuildError:
+        return False
+
+
+def greedy_assign(cost: np.ndarray, task_order: Optional[np.ndarray] = None) -> np.ndarray:
+    lib = load()
+    cost = np.ascontiguousarray(cost, np.float32)
+    P, T = cost.shape
+    out = np.empty(T, np.int32)
+    if task_order is None:
+        lib.greedy_assign(cost, P, T, None, out)
+    else:
+        order = np.ascontiguousarray(task_order, np.int32)
+        lib.greedy_assign(cost, P, T, order.ctypes.data_as(ctypes.c_void_p), out)
+    return out
+
+
+def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    lib = load()
+    cost = np.ascontiguousarray(cost, np.float32)
+    P, T = cost.shape
+    k = min(k, P)
+    cand_p = np.empty((T, k), np.int32)
+    cand_c = np.empty((T, k), np.float32)
+    lib.topk_candidates(cost, P, T, k, cand_p, cand_c)
+    return cand_p, cand_c
+
+
+def auction_sparse(
+    cand_provider: np.ndarray,
+    cand_cost: np.ndarray,
+    num_providers: int,
+    eps_start: float = 4.0,
+    eps_end: float = 0.02,
+    scale: float = 0.25,
+    max_events: int = 50_000_000,
+) -> np.ndarray:
+    lib = load()
+    cand_p = np.ascontiguousarray(cand_provider, np.int32)
+    cand_c = np.ascontiguousarray(cand_cost, np.float32)
+    T, K = cand_p.shape
+    out = np.empty(T, np.int32)
+    lib.auction_sparse(
+        cand_p, cand_c, num_providers, T, K,
+        eps_start, eps_end, scale, max_events, out,
+    )
+    return out

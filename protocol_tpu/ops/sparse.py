@@ -227,6 +227,75 @@ def _sparse_auction_phase(
     return lax.while_loop(cond, body, state)
 
 
+@jax.jit
+def _unassign_unhappy(cand_provider, cand_cost, price, owner, p4t, eps_next):
+    """eps-CS repair between phases: holders whose assignment violates the
+    tighter eps re-enter the auction; happy holders stay seated (avoids both
+    full-reset cost and the mass-retirement pathology of pumped prices)."""
+    cand_valid = cand_provider >= 0
+    cand_safe = jnp.where(cand_valid, cand_provider, 0)
+    value = jnp.where(cand_valid, -cand_cost - price[cand_safe], _NEG)  # [T,K]
+    v1 = jnp.max(value, axis=1)
+    held = p4t  # [T]
+    vcur = jnp.max(
+        jnp.where(cand_safe == jnp.maximum(held, 0)[:, None], value, _NEG), axis=1
+    )
+    unhappy = (held >= 0) & (vcur < v1 - eps_next)
+    P = owner.shape[0]
+    owner = owner.at[jnp.where(unhappy, held, P)].set(-1, mode="drop")
+    p4t = jnp.where(unhappy, -1, p4t)
+    return owner, p4t
+
+
+@partial(jax.jit, static_argnames=("budget",))
+def _greedy_cleanup_compacted(cand_provider, cand_cost, owner, p4t, budget: int):
+    """Forward auctions never lower prices, so an unfillable tail can strand
+    providers at pumped prices. Sweep the OPEN tasks greedily (cheapest free
+    candidate each) — the reference matcher's semantics on the tail; no
+    provider idles while a compatible task waits.
+
+    The scan is inherently sequential, so it runs over a compacted index set
+    of at most ``budget`` open tasks (static size), never all T — the caller
+    skips it entirely when nothing is open."""
+    T, K = cand_cost.shape
+    free = owner < 0  # [P]
+    cand_valid = cand_provider >= 0
+    cand_safe = jnp.where(cand_valid, cand_provider, 0)
+
+    open_idx = jnp.flatnonzero(p4t < 0, size=budget, fill_value=T).astype(jnp.int32)
+    ok = open_idx < T
+    safe_idx = jnp.where(ok, open_idx, 0)
+
+    def step(free, inputs):
+        t_ok, cp, cc, valid = inputs
+        cost_row = jnp.where(valid & free[cp], cc, INFEASIBLE)
+        j = jnp.argmin(cost_row)
+        feasible = (cost_row[j] < INFEASIBLE * 0.5) & t_ok
+        p = cp[j]
+        free = free.at[p].set(jnp.where(feasible, False, free[p]))
+        return free, jnp.where(feasible, p, -1)
+
+    _, picks = lax.scan(
+        step, free, (ok, cand_safe[safe_idx], cand_cost[safe_idx], cand_valid[safe_idx])
+    )
+    return p4t.at[jnp.where(ok & (picks >= 0), open_idx, T)].set(
+        jnp.where(picks >= 0, picks, -1), mode="drop"
+    )
+
+
+def _greedy_cleanup(cand_provider, cand_cost, owner, p4t):
+    """Host wrapper: one scalar readback decides whether cleanup is needed;
+    the compaction budget is a pow-2 bucket of the open count."""
+    n_open = int(jnp.sum(p4t < 0))
+    if n_open == 0:
+        return p4t
+    budget = 1024
+    while budget < n_open:
+        budget *= 2
+    budget = min(budget, int(cand_cost.shape[0]))
+    return _greedy_cleanup_compacted(cand_provider, cand_cost, owner, p4t, budget)
+
+
 def assign_auction_sparse_scaled(
     cand_provider: jax.Array,
     cand_cost: jax.Array,
@@ -237,30 +306,36 @@ def assign_auction_sparse_scaled(
     max_iters_per_phase: int = 4000,
     frontier: int = 4096,
 ) -> AssignResult:
-    """eps-scaling auction: geometric eps ladder with prices, assignment and
-    retirement warm-started phase to phase (Bertsekas' eps-scaling — total
-    bid events O(n log(1/eps)) instead of O(price_range / eps))."""
-    T = cand_cost.shape[0]
-    P = num_providers
+    """eps-scaling auction: geometric eps ladder with warm-started prices
+    (Bertsekas' eps-scaling — total bid events O(n log(1/eps)) instead of
+    O(price_range / eps)).
+
+    Phase discipline (mirrors native/assign_engine.cpp):
+      - retirement only in the FINAL phase (coarse-eps price overshoot from
+        an unfillable tail would retire viable tasks);
+      - between phases, eps-CS repair re-opens only unhappy holders;
+      - a final greedy cleanup seats any stranded provider/task pairs.
+    """
     state = None
     eps = eps_start
     while True:
+        final = eps <= eps_end
         state = _sparse_auction_phase(
             cand_provider, cand_cost, num_providers, state,
             eps=eps, max_iters=max_iters_per_phase, frontier=frontier,
+            retire=final,
         )
-        if eps <= eps_end:
+        if final:
             break
         eps = max(eps * scale, eps_end)
-        # NOTE: assignments are kept across phases (Gauss-Seidel warm start),
-        # deliberately NOT the textbook reset-and-rebid: with an unfillable
-        # surplus, equilibrium prices get pumped toward the give-up level,
-        # and a phase reset at pumped prices makes *viable* holders retire
-        # en masse (their re-bid values sit below give-up). Keeping holders
-        # seated bounds the matching at coarse-eps quality for early
-        # assignments; the quality tests vs the optimal oracle keep this
-        # honest.
-    p4t = state[3]
+        it, price, owner, p4t, retired = state
+        owner, p4t = _unassign_unhappy(
+            cand_provider, cand_cost, price, owner, p4t, eps
+        )
+        state = (it, price, owner, p4t, retired)
+
+    _, _, owner, p4t, _ = state
+    p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
     return AssignResult(p4t, _invert(p4t, num_providers))
 
 

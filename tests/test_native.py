@@ -1,0 +1,86 @@
+"""Native CPU engine: build + exact parity with the JAX kernels and the
+numpy oracles, plus quality vs the optimal assignment."""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+import jax.numpy as jnp
+
+from protocol_tpu import native
+from protocol_tpu.ops.assign import assign_greedy
+from protocol_tpu.ops.cost import INFEASIBLE
+
+from tests.test_assign import greedy_oracle, matching_cost, random_cost
+from tests.test_sparse import jittered_cost
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+
+class TestGreedyNative:
+    @pytest.mark.parametrize("seed,P,T", [(0, 16, 16), (1, 64, 256), (2, 256, 64)])
+    def test_parity_with_oracle_and_jax(self, seed, P, T):
+        rng = np.random.default_rng(seed)
+        cost = random_cost(rng, P, T)
+        got = native.greedy_assign(cost)
+        np.testing.assert_array_equal(got, greedy_oracle(cost))
+        jax_res = assign_greedy(jnp.asarray(cost))
+        np.testing.assert_array_equal(got, np.asarray(jax_res.provider_for_task))
+
+    def test_task_order(self):
+        rng = np.random.default_rng(3)
+        cost = random_cost(rng, 32, 48)
+        order = rng.permutation(48).astype(np.int32)
+        got = native.greedy_assign(cost, task_order=order)
+        np.testing.assert_array_equal(got, greedy_oracle(cost, order=list(order)))
+
+
+class TestTopkNative:
+    def test_matches_jax_candidates(self):
+        from protocol_tpu.ops.sparse import candidates_topk
+        from protocol_tpu.ops.cost import CostWeights, cost_matrix
+        from tests.test_sparse import encode_random_marketplace
+
+        ep, er = encode_random_marketplace(5, 32, 16)
+        cost = np.asarray(cost_matrix(ep, er, CostWeights())[0])
+        jp, jc = candidates_topk(ep, er, k=8, tile=8)
+        cp, cc = native.topk_candidates(cost, k=8)
+        np.testing.assert_array_equal(cp, np.asarray(jp))
+        np.testing.assert_allclose(cc, np.asarray(jc), rtol=1e-6)
+
+
+class TestAuctionNative:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_near_optimal(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 64
+        cost = rng.uniform(0, 10, size=(n, n)).astype(np.float32)
+        cand_p, cand_c = native.topk_candidates(cost, k=n)
+        p4t = native.auction_sparse(cand_p, cand_c, num_providers=n, eps_end=0.005)
+        assert (p4t >= 0).all()
+        used = set()
+        for p in p4t:
+            assert p not in used
+            used.add(p)
+        ri, ci = linear_sum_assignment(jittered_cost(cost))
+        opt = jittered_cost(cost)[ri, ci].sum()
+        got = sum(jittered_cost(cost)[p, t] for t, p in enumerate(p4t))
+        assert got <= opt + n * 0.006, f"native auction {got} vs optimal {opt}"
+
+    def test_infeasible_and_contention(self):
+        rng = np.random.default_rng(7)
+        cost = random_cost(rng, 16, 64, p_infeasible=0.3)  # oversubscribed
+        cand_p, cand_c = native.topk_candidates(cost, k=16)
+        p4t = native.auction_sparse(cand_p, cand_c, num_providers=16)
+        # every assignment feasible + unique; at most P assigned
+        used = set()
+        n_assigned = 0
+        for t, p in enumerate(p4t):
+            if p >= 0:
+                assert cost[p, t] < INFEASIBLE * 0.5
+                assert p not in used
+                used.add(p)
+                n_assigned += 1
+        assert n_assigned == 16  # full provider utilization under contention

@@ -119,6 +119,43 @@ class TestSparseAuction:
         assert got <= opt * 1.10 + n * 0.011, f"sparse {got} vs optimal {opt}"
 
 
+class TestScaledAuction:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_near_optimal(self, seed):
+        from protocol_tpu.ops.sparse import assign_auction_sparse_scaled
+
+        rng = np.random.default_rng(seed)
+        n = 64
+        cost = rng.uniform(0, 10, size=(n, n)).astype(np.float32)
+        order = np.argsort(cost, axis=0, kind="stable").T
+        cand_c = np.take_along_axis(cost.T, order, axis=1).astype(np.float32)
+        cand_p = order.astype(np.int32)
+        res = assign_auction_sparse_scaled(
+            jnp.asarray(cand_p), jnp.asarray(cand_c), num_providers=n,
+            eps_end=0.005,
+        )
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).all()
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        got = matching_cost(cost, p4t)
+        assert got <= opt + n * 0.006, f"scaled auction {got} vs optimal {opt}"
+
+    def test_contention_full_utilization(self):
+        from protocol_tpu.ops.sparse import assign_auction_sparse_scaled
+
+        rng = np.random.default_rng(7)
+        cost = random_cost(rng, 16, 64, p_infeasible=0.3)  # oversubscribed
+        order = np.argsort(cost, axis=0, kind="stable").T
+        cand_c = np.take_along_axis(cost.T, order, axis=1).astype(np.float32)
+        cand_p = np.where(cand_c < INFEASIBLE * 0.5, order.astype(np.int32), -1)
+        res = assign_auction_sparse_scaled(
+            jnp.asarray(cand_p), jnp.asarray(cand_c), num_providers=16,
+        )
+        p4t = check_feasible(res, cost)
+        assert (p4t >= 0).sum() == 16  # every provider seated
+
+
 class TestEndToEndTopk:
     def test_pipeline_feasibility_and_compat(self):
         ep, er = encode_random_marketplace(3, 48, 32)
