@@ -1,0 +1,146 @@
+"""Blocked (matrix-free) Sinkhorn: ladder config #3 at 100k+ scale.
+
+The dense Sinkhorn kernel (ops/assign.py) materializes [P, T] — ~40 GB at
+100k x 100k, beyond a single chip. This variant keeps only the potentials
+u[P], v[T] and recomputes cost blocks from the feature encodings on the fly
+(the same streaming trick as candidates_topk):
+
+  v-update: per task tile, a full column logsumexp over P — direct.
+  u-update: per provider row, logsumexp over ALL T — a running
+            (max, sum-exp) accumulator carried across task tiles in one
+            lax.scan (associative streaming logsumexp).
+
+Rounding: the optimal-plan mass for task t is monotone in
+(u_p - cost[p,t]/eps), so the plan's top-K entries per task are exactly a
+top-K candidate generation under the provider offset -eps*u — which then
+feeds the sparse auction / greedy machinery. Sinkhorn supplies global
+prices; the candidate auction supplies feasibility.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from protocol_tpu.ops.assign import AssignResult
+from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
+from protocol_tpu.ops.sparse import (
+    _slice_requirements,
+    assign_auction_sparse_scaled,
+    candidates_topk,
+)
+
+_NEG = -1e18
+
+
+@partial(jax.jit, static_argnames=("num_iters", "tile"))
+def sinkhorn_potentials_blocked(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    weights: CostWeights | None = None,
+    eps: float | jax.Array = 0.05,
+    num_iters: int = 50,
+    tile: int = 1024,
+) -> tuple[jax.Array, jax.Array]:
+    """Log-domain potentials (u[P], v[T]) without materializing [P, T].
+
+    Peak memory O(P * tile); each iteration streams the cost tensor twice
+    (v pass + u pass).
+    """
+    if weights is None:
+        weights = CostWeights()
+    Pn = ep.gpu_count.shape[0]
+    T = er.cpu_cores.shape[0]
+    if T % tile != 0:
+        raise ValueError(f"T={T} not divisible by tile={tile}; pad requirements")
+    n_tiles = T // tile
+    starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+
+    def k_block(t0):
+        r_tile = _slice_requirements(er, t0, tile)
+        cost, _ = cost_matrix(ep, r_tile, weights)  # [P, tile]
+        return jnp.where(cost < INFEASIBLE * 0.5, -cost / eps, _NEG)
+
+    # feasibility-count pass -> balanced marginals (ops/assign.py semantics)
+    def feas_step(row_any, t0):
+        feas = k_block(t0) > _NEG * 0.5
+        return row_any | jnp.any(feas, axis=1), jnp.any(feas, axis=0)
+
+    row_any, col_any_tiles = lax.scan(feas_step, jnp.zeros(Pn, bool), starts)
+    col_any = col_any_tiles.reshape(T)
+    np_valid = jnp.maximum(jnp.sum(row_any), 1)
+    nt_valid = jnp.maximum(jnp.sum(col_any), 1)
+    m = jnp.minimum(np_valid, nt_valid).astype(jnp.float32)
+    log_a = jnp.where(row_any, jnp.log(m / np_valid.astype(jnp.float32)), _NEG)
+    log_b = jnp.where(col_any, jnp.log(m / nt_valid.astype(jnp.float32)), _NEG)
+
+    def iteration(_i, uv):
+        u, v = uv
+
+        # ---- u-update: streaming logsumexp over all task tiles
+        def u_step(carry, t0):
+            run_max, run_sum = carry  # [P], [P]
+            k = k_block(t0) + lax.dynamic_slice_in_dim(v, t0, tile)[None, :]
+            blk_max = jnp.max(k, axis=1)
+            new_max = jnp.maximum(run_max, blk_max)
+            # rescale both running sum and block contribution to new_max
+            run_sum = run_sum * jnp.exp(run_max - new_max) + jnp.sum(
+                jnp.exp(k - new_max[:, None]), axis=1
+            )
+            return (new_max, run_sum), None
+
+        (m_u, s_u), _ = lax.scan(
+            u_step, (jnp.full(Pn, _NEG, jnp.float32), jnp.zeros(Pn, jnp.float32)), starts
+        )
+        lse_u = m_u + jnp.log(jnp.maximum(s_u, 1e-30))
+        u = jnp.where(row_any, log_a - lse_u, _NEG)
+
+        # ---- v-update: per-tile full column logsumexp
+        def v_step(carry, t0):
+            k = k_block(t0) + u[:, None]
+            blk_max = jnp.max(k, axis=0)
+            lse = blk_max + jnp.log(
+                jnp.maximum(jnp.sum(jnp.exp(k - blk_max[None, :]), axis=0), 1e-30)
+            )
+            return carry, lse
+
+        _, lse_v_tiles = lax.scan(v_step, None, starts)
+        v = log_b - lse_v_tiles.reshape(T)
+        v = jnp.where(col_any, v, _NEG)
+        return u, v
+
+    u0 = jnp.zeros(Pn, jnp.float32)
+    v0 = jnp.zeros(T, jnp.float32)
+    return lax.fori_loop(0, num_iters, iteration, (u0, v0))
+
+
+def assign_sinkhorn_blocked(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    weights: CostWeights | None = None,
+    eps: float = 0.05,
+    num_iters: int = 50,
+    tile: int = 1024,
+    k: int = 32,
+) -> AssignResult:
+    """Full matrix-free Sinkhorn matching: blocked potentials -> plan-guided
+    top-K candidates (provider offset -eps*u) -> sparse auction rounding."""
+    if weights is None:
+        weights = CostWeights()
+    u, _v = sinkhorn_potentials_blocked(
+        ep, er, weights, eps=eps, num_iters=num_iters, tile=tile
+    )
+    # plan mass per (p, t) is monotone in u_p - cost/eps: bias candidate
+    # selection by the provider potential
+    offset = -eps * jnp.where(u > _NEG * 0.5, u, 0.0)
+    cand_p, cand_c = candidates_topk(
+        ep, er, weights, k=k, tile=tile, provider_offset=offset
+    )
+    return assign_auction_sparse_scaled(
+        cand_p, cand_c, num_providers=ep.gpu_count.shape[0],
+        eps_start=1.0, eps_end=0.02,
+    )

@@ -46,6 +46,27 @@ def _slice_requirements(r: EncodedRequirements, start: int, size: int) -> Encode
     )
 
 
+def frontier_bids(cand_safe, value_base, price, f_idx, f_ok, num_options: int):
+    """The auction's per-frontier bid computation, shared verbatim by the
+    single-device kernel and the task-sharded mesh kernel — bit-identical
+    math here is what the Jacobi parity guarantee between them rests on.
+
+    Returns (p1 best provider, v1 best value, v2 runner-up value [floored]).
+    """
+    f_safe = jnp.where(f_ok, f_idx, 0)
+    cp = cand_safe[f_safe]  # [B, K]
+    value = value_base[f_safe] - price[cp]  # the only dynamic gather at scale
+    k1 = jnp.argmax(value, axis=1).astype(jnp.int32)
+    v1 = jnp.take_along_axis(value, k1[:, None], axis=1)[:, 0]
+    v2 = jnp.max(
+        jnp.where(jnp.arange(num_options)[None, :] == k1[:, None], _NEG, value),
+        axis=1,
+    )
+    v2 = jnp.maximum(v2, jnp.float32(-1e8))  # single-option floor
+    p1 = jnp.take_along_axis(cp, k1[:, None], axis=1)[:, 0]
+    return p1, v1, v2
+
+
 @partial(jax.jit, static_argnames=("k", "tile"))
 def candidates_topk(
     ep: EncodedProviders,
@@ -53,6 +74,7 @@ def candidates_topk(
     weights: CostWeights | None = None,
     k: int = 64,
     tile: int = 1024,
+    provider_offset: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Each task's top-k cheapest compatible providers.
 
@@ -60,6 +82,10 @@ def candidates_topk(
     memory O(P * tile), suitable for P up to ~1M with tile sized to fit.
     Returns (cand_provider i32 [T, k] with -1 padding, cand_cost f32 [T, k]).
     T must be divisible by tile (pad the requirements first).
+
+    ``provider_offset`` [P] biases the SELECTION (e.g. -eps*u from Sinkhorn
+    potentials: pick candidates by plan mass) while the returned costs stay
+    the true costs, so downstream matchers optimize the real objective.
     """
     if weights is None:
         weights = CostWeights()
@@ -84,9 +110,16 @@ def candidates_topk(
         h = p_idx[:, None] * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
         jitter = (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
         cost = jnp.where(cost < INFEASIBLE * 0.5, cost + jitter, cost)
-        neg, idx = lax.top_k(-cost.T, k)  # [tile, k] best (lowest cost) first
-        cost_k = -neg
-        provider = jnp.where(cost_k < INFEASIBLE * 0.5, idx.astype(jnp.int32), -1)
+        if provider_offset is None:
+            selection = cost
+        else:
+            selection = jnp.where(
+                cost < INFEASIBLE * 0.5, cost + provider_offset[:, None], cost
+            )
+        neg_sel, idx = lax.top_k(-selection.T, k)  # [tile, k] best first
+        cost_k = jnp.take_along_axis(cost.T, idx, axis=1)  # true costs
+        sel_k = -neg_sel
+        provider = jnp.where(sel_k < INFEASIBLE * 0.5, idx.astype(jnp.int32), -1)
         return carry, (provider, cost_k)
 
     _, (cand_p, cand_c) = lax.scan(
@@ -174,18 +207,7 @@ def _sparse_auction_phase(
         # ---- frontier selection: up to B open tasks (fill = T -> dropped)
         f_idx = jnp.flatnonzero(open_mask, size=B, fill_value=T).astype(jnp.int32)
         f_ok = f_idx < T
-        f_safe = jnp.where(f_ok, f_idx, 0)
-
-        cp = cand_safe[f_safe]  # [B, K] (static-index row gather)
-        vb = value_base[f_safe]
-        value = vb - price[cp]  # [B, K] — the only dynamic gather that scales
-        k1 = jnp.argmax(value, axis=1).astype(jnp.int32)
-        v1 = jnp.take_along_axis(value, k1[:, None], axis=1)[:, 0]
-        v2 = jnp.max(
-            jnp.where(jnp.arange(K)[None, :] == k1[:, None], _NEG, value), axis=1
-        )
-        v2 = jnp.maximum(v2, jnp.float32(-1e8))
-        p1 = jnp.take_along_axis(cp, k1[:, None], axis=1)[:, 0]
+        p1, v1, v2 = frontier_bids(cand_safe, value_base, price, f_idx, f_ok, K)
 
         newly_retired = f_ok & (v1 < give_up)
         retired = retired.at[jnp.where(newly_retired, f_idx, T)].set(True, mode="drop")

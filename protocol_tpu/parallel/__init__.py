@@ -10,5 +10,11 @@ of replicated state).
 
 from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
 from protocol_tpu.parallel.auction import assign_auction_sharded
+from protocol_tpu.parallel.sparse import assign_auction_sparse_sharded
 
-__all__ = ["assign_auction_sharded", "make_mesh", "pad_to_multiple"]
+__all__ = [
+    "assign_auction_sharded",
+    "assign_auction_sparse_sharded",
+    "make_mesh",
+    "pad_to_multiple",
+]

@@ -1,0 +1,161 @@
+"""Task-sharded sparse auction over a device mesh.
+
+The 1M x 1M configuration (BASELINE.md ladder #4/#5): candidate lists
+[T, K] are sharded task-wise across the mesh (tasks outnumber everything
+and their state is per-task), while the per-provider price/owner vectors
+[P] are replicated and combined with max/min collectives each round —
+P floats of ICI traffic per array, independent of T*K.
+
+Round structure per device (mirrors ops/sparse.py's frontier auction):
+  1. local frontier of open local tasks -> local bids
+  2. local provider-side winner resolution (scatter-max / scatter-min)
+  3. global combine: win_bid = pmax, win_task = pmin among max-bidders
+     (task ids are globally formed as shard_offset + local index, so ties
+     break identically to the single-device kernel)
+  4. replicated price/owner update; each shard applies evictions/wins to
+     the task rows it owns
+
+With frontier >= T/D and retire=False this is the Jacobi schedule and is
+exactly parity with the single-device sparse kernel — tested on the
+virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from protocol_tpu.ops.assign import AssignResult, _invert
+from protocol_tpu.ops.sparse import frontier_bids
+
+_NEG = -1e18
+
+
+def assign_auction_sparse_sharded(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    mesh: Mesh,
+    eps: float = 0.01,
+    max_iters: int = 10000,
+    frontier: int = 4096,
+    retire: bool = True,
+    axis: str = "p",
+) -> AssignResult:
+    """Sparse auction with tasks sharded over ``mesh`` axis ``axis``.
+
+    cand_provider/cand_cost are [T, K] with T divisible by the mesh size.
+    Returns a replicated AssignResult.
+    """
+    T, K = cand_cost.shape
+    D = mesh.shape[axis]
+    if T % D != 0:
+        raise ValueError(f"T={T} not divisible by mesh size {D}; pad first")
+    Pn = num_providers
+    B = min(frontier, T // D)
+
+    sharding = NamedSharding(mesh, P(axis, None))
+    cand_provider = jax.device_put(cand_provider, sharding)
+    cand_cost = jax.device_put(cand_cost, sharding)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(cand_p_local: jax.Array, cand_c_local: jax.Array) -> jax.Array:
+        Tl = cand_p_local.shape[0]
+        shard = lax.axis_index(axis)
+        offset = (shard * Tl).astype(jnp.int32)
+
+        cand_valid = cand_p_local >= 0
+        value_base = jnp.where(cand_valid, -cand_c_local, _NEG)  # [Tl, K]
+        task_feasible = jnp.any(cand_valid, axis=1)
+        cand_safe = jnp.where(cand_valid, cand_p_local, 0)
+        finite_max = lax.pmax(
+            jnp.max(jnp.where(cand_valid, cand_c_local, 0.0)), axis
+        )
+        give_up = -(2.0 * finite_max + 10.0) if retire else jnp.float32(_NEG)
+
+        def cond(state):
+            it, price, owner, p4t_local, retired = state
+            n_open = lax.psum(
+                jnp.sum((p4t_local < 0) & task_feasible & ~retired), axis
+            )
+            return (it < max_iters) & (n_open > 0)
+
+        def body(state):
+            it, price, owner, p4t_local, retired = state
+            open_mask = (p4t_local < 0) & task_feasible & ~retired
+
+            f_idx = jnp.flatnonzero(open_mask, size=B, fill_value=Tl).astype(
+                jnp.int32
+            )
+            f_ok = f_idx < Tl
+            # shared bid math: bit-identical to the single-device kernel
+            p1, v1, v2 = frontier_bids(
+                cand_safe, value_base, price, f_idx, f_ok, K
+            )
+
+            newly_retired = f_ok & (v1 < give_up)
+            retired = retired.at[jnp.where(newly_retired, f_idx, Tl)].set(
+                True, mode="drop"
+            )
+
+            bidding = f_ok & ~newly_retired & (v1 > _NEG * 0.5)
+            bid_amt = price[p1] + (v1 - v2) + eps
+            tgt = jnp.where(bidding, p1, Pn)
+            gtask = offset + f_idx  # global task ids of the frontier
+
+            # local winner resolution
+            win_bid_l = jnp.full(Pn, _NEG).at[tgt].max(
+                jnp.where(bidding, bid_amt, _NEG), mode="drop"
+            )
+            # global max bid per provider
+            win_bid = lax.pmax(win_bid_l, axis)
+            # global winner task: min global-task-id among global-max bidders
+            is_winner = bidding & (bid_amt >= win_bid[p1])
+            win_task_l = jnp.full(Pn, T, jnp.int32).at[tgt].min(
+                jnp.where(is_winner, gtask, T), mode="drop"
+            )
+            win_task = lax.pmin(win_task_l, axis)
+            got_bid = (win_bid > _NEG * 0.5) & (win_task < T)
+
+            # evictions + installs on the task rows this shard owns
+            # (explicit range masks: negative scatter indices are not
+            # reliably dropped, so map out-of-shard ids to Tl)
+            evict_g = jnp.where(got_bid & (owner >= 0), owner, T)  # global ids
+            e_in = (evict_g >= offset) & (evict_g < offset + Tl)
+            p4t_local = p4t_local.at[jnp.where(e_in, evict_g - offset, Tl)].set(
+                -1, mode="drop"
+            )
+            p_idx = jnp.arange(Pn, dtype=jnp.int32)
+            w_in = got_bid & (win_task >= offset) & (win_task < offset + Tl)
+            p4t_local = p4t_local.at[jnp.where(w_in, win_task - offset, Tl)].set(
+                jnp.where(w_in, p_idx, -1), mode="drop"
+            )
+
+            # replicated provider state
+            owner = jnp.where(got_bid, win_task, owner)
+            price = jnp.where(got_bid, win_bid, price)
+            return it + 1, price, owner, p4t_local, retired
+
+        state0 = (
+            jnp.int32(0),
+            jnp.zeros(Pn, jnp.float32),
+            jnp.full(Pn, -1, jnp.int32),  # owner holds GLOBAL task ids
+            jnp.full(Tl, -1, jnp.int32),
+            jnp.zeros(Tl, bool),
+        )
+        _, _, _, p4t_local, _ = lax.while_loop(cond, body, state0)
+        return lax.all_gather(p4t_local, axis).reshape(T)
+
+    p4t = run(cand_provider, cand_cost)
+    return AssignResult(p4t, _invert(p4t, Pn))

@@ -60,6 +60,49 @@ from protocol_tpu.store.kv import KVStore
 RESTART_BACKOFF_SECONDS = 10.0  # docker/service.rs:30
 
 
+class SystemState:
+    """Crash-recovery state (reference: worker/src/state/system_state.rs —
+    persisted heartbeat endpoint + p2p keypair in the platform data dir,
+    enabling `--no-auto-recover`-style resume after restart).
+
+    Persists the orchestrator heartbeat URL and the node wallet key as JSON
+    under ``state_dir``; a restarted worker resumes heartbeating without
+    waiting for a fresh invite.
+    """
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        self.path = os.path.join(state_dir, "worker_state.json")
+
+    def save(self, orchestrator_url: Optional[str], node_key_hex: str) -> None:
+        # the file holds a private key: owner-only permissions throughout
+        os.makedirs(self.state_dir, mode=0o700, exist_ok=True)
+        tmp = self.path + ".tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(
+                {
+                    "orchestrator_url": orchestrator_url,
+                    "node_key_hex": node_key_hex,
+                },
+                f,
+            )
+        os.replace(tmp, self.path)  # atomic: a crash never leaves half-state
+
+    def load(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def clear(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 # ---------------------------------------------------------------- checks
 
 @dataclass
@@ -342,6 +385,8 @@ class WorkerAgent:
         http=None,  # aiohttp.ClientSession-compatible (tests inject)
         known_orchestrators: Optional[list[str]] = None,
         known_validators: Optional[list[str]] = None,
+        state: Optional[SystemState] = None,
+        auto_recover: bool = True,
     ):
         self.provider_wallet = provider_wallet
         self.node_wallet = node_wallet
@@ -360,6 +405,21 @@ class WorkerAgent:
         self.known_orchestrators = [a.lower() for a in (known_orchestrators or [])]
         self.known_validators = [a.lower() for a in (known_validators or [])]
         self.p2p_id = f"worker-{node_wallet.address[:10]}"
+        self.state = state
+        if state is not None and auto_recover:
+            # crash recovery (cli/command.rs:832-835): resume heartbeating
+            # the persisted endpoint without waiting for a new invite —
+            # but only when the persisted identity IS this wallet; stale
+            # state from another identity would leave the worker signing
+            # beats the orchestrator never invited
+            saved = state.load()
+            if (
+                saved
+                and saved.get("orchestrator_url")
+                and saved.get("node_key_hex") == node_wallet.private_key_hex()
+            ):
+                self.orchestrator_url = saved["orchestrator_url"]
+                self.heartbeat_active = True
 
     # ----- boot (cli/command.rs:194-848) -----
 
@@ -473,6 +533,8 @@ class WorkerAgent:
                 )
         self.orchestrator_url = heartbeat_url
         self.heartbeat_active = True
+        if self.state is not None:
+            self.state.save(heartbeat_url, self.node_wallet.private_key_hex())
         return web.json_response({"success": True})
 
     async def handle_challenge(self, request: web.Request) -> web.Response:

@@ -1,0 +1,80 @@
+"""Worker crash recovery: persisted endpoint resumes heartbeating."""
+
+import tempfile
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.security import Wallet
+from protocol_tpu.services.worker import SystemState, WorkerAgent
+
+
+def test_state_roundtrip_and_recovery():
+    with tempfile.TemporaryDirectory() as d:
+        import os
+        import stat
+
+        node = Wallet.from_seed(b"n")
+        state = SystemState(d)
+        state.save("http://orch:8090", node.private_key_hex())
+        assert state.load()["orchestrator_url"] == "http://orch:8090"
+        # the file holds a private key: owner-only permissions
+        mode = stat.S_IMODE(os.stat(state.path).st_mode)
+        assert mode == 0o600, oct(mode)
+
+        ledger = Ledger()
+        agent = WorkerAgent(
+            provider_wallet=Wallet.from_seed(b"p"),
+            node_wallet=node,
+            ledger=ledger,
+            pool_id=0,
+            state=SystemState(d),
+        )
+        # restart: resumes the persisted endpoint without a fresh invite
+        assert agent.heartbeat_active
+        assert agent.orchestrator_url == "http://orch:8090"
+
+
+def test_recovery_refused_for_foreign_identity():
+    """Stale state written by a DIFFERENT node wallet must not be resumed —
+    the worker would sign beats the orchestrator never invited."""
+    with tempfile.TemporaryDirectory() as d:
+        SystemState(d).save("http://orch:8090", Wallet.from_seed(b"other").private_key_hex())
+        agent = WorkerAgent(
+            provider_wallet=Wallet.from_seed(b"p"),
+            node_wallet=Wallet.from_seed(b"n"),
+            ledger=Ledger(),
+            pool_id=0,
+            state=SystemState(d),
+        )
+        assert not agent.heartbeat_active
+
+
+def test_no_auto_recover_flag():
+    with tempfile.TemporaryDirectory() as d:
+        SystemState(d).save("http://orch:8090", "ab" * 32)
+        agent = WorkerAgent(
+            provider_wallet=Wallet.from_seed(b"p"),
+            node_wallet=Wallet.from_seed(b"n"),
+            ledger=Ledger(),
+            pool_id=0,
+            state=SystemState(d),
+            auto_recover=False,
+        )
+        assert not agent.heartbeat_active
+
+
+def test_missing_state_is_clean():
+    with tempfile.TemporaryDirectory() as d:
+        assert SystemState(d).load() is None
+        agent = WorkerAgent(
+            provider_wallet=Wallet.from_seed(b"p"),
+            node_wallet=Wallet.from_seed(b"n"),
+            ledger=Ledger(),
+            pool_id=0,
+            state=SystemState(d),
+        )
+        assert not agent.heartbeat_active
+
+        state = SystemState(d)
+        state.save("u", "k")
+        state.clear()
+        assert state.load() is None
