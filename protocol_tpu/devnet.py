@@ -150,11 +150,14 @@ async def main(argv=None) -> None:
         store=store,
         scheduler=scheduler,
         groups_plugin=groups_plugin,
-        storage=LocalDirStorageProvider(args.storage_dir),
+        storage=LocalDirStorageProvider(
+            args.storage_dir, public_base_url=f"http://127.0.0.1:{oport}"
+        ),
         discovery_fetcher=discovery_fetcher,
         invite_sender=invite_sender,
         admin_api_key=args.admin_key,
         heartbeat_url=f"http://127.0.0.1:{oport}",
+        control_http=session,
     )
     runners.append(await orchestrator.serve(port=oport))
 

@@ -89,6 +89,7 @@ class OrchestratorService:
         uploads_per_hour: int = 3,  # main.rs:76-78
         heartbeat_url: str = "http://localhost:8090",
         webhook=None,  # WebhookPlugin (plugins/webhook/mod.rs)
+        control_http=None,  # aiohttp session for worker control-plane calls
     ):
         self.ledger = ledger
         self.pool_id = pool_id
@@ -104,6 +105,7 @@ class OrchestratorService:
         self.uploads_per_hour = uploads_per_hour
         self.heartbeat_url = heartbeat_url
         self.webhook = webhook
+        self.control_http = control_http
         self.loop_beats: dict[str, float] = {}
         if webhook is not None and groups_plugin is not None:
             groups_plugin.on_group_created = webhook.handle_group_created
@@ -133,10 +135,16 @@ class OrchestratorService:
             )
 
         app = web.Application(
+            # raise aiohttp's 1 MiB default so the advertised 100 MB upload
+            # cap is actually reachable (the handlers enforce it themselves)
+            client_max_size=MAX_UPLOAD_BYTES + 65536,
             middlewares=[
+                # NB: /storage/upload is NOT signature-gated — like a GCS
+                # signed URL, its auth is the time-limited HMAC token bound
+                # to the object name, issued by /storage/request-upload
                 validate_signature_middleware(
                     self.store.kv,
-                    ["/heartbeat", "/storage"],
+                    ["/heartbeat", "/storage/request-upload"],
                     validator=node_known,
                 ),
                 api_key_middleware(
@@ -147,11 +155,15 @@ class OrchestratorService:
         )
         app.router.add_post("/heartbeat", self.heartbeat)
         app.router.add_post("/storage/request-upload", self.request_upload)
+        app.router.add_put("/storage/upload/{object_name:.+}", self.upload_object)
         app.router.add_post("/tasks", self.create_task)
         app.router.add_get("/tasks", self.list_tasks)
         app.router.add_delete("/tasks/{task_id}", self.delete_task)
         app.router.add_get("/nodes", self.list_nodes)
         app.router.add_post("/nodes/{address}/ban", self.ban_node)
+        app.router.add_get("/nodes/{address}/logs", self.node_logs)
+        app.router.add_post("/nodes/{address}/restart", self.node_restart)
+        app.router.add_get("/groups/{group_id}/logs", self.group_logs)
         app.router.add_get("/groups", self.list_groups)
         app.router.add_get("/groups/configs", self.list_group_configs)
         app.router.add_post("/groups/force-regroup", self.force_regroup)
@@ -250,13 +262,45 @@ class OrchestratorService:
                 task.storage_config.file_name_template, file_name, address
             )
 
-        await self.storage.generate_mapping_file(sha256, object_name)
-        url = await self.storage.generate_upload_signed_url(
-            object_name, max_bytes=file_size
-        )
+        try:
+            await self.storage.generate_mapping_file(sha256, object_name)
+            url = await self.storage.generate_upload_signed_url(
+                object_name, max_bytes=file_size
+            )
+        except ValueError as e:  # e.g. path-escaping object names
+            return _err(str(e), 400)
         return web.json_response(
             {"success": True, "data": {"signed_url": url, "object_name": object_name}}
         )
+
+    async def upload_object(self, request: web.Request) -> web.Response:
+        """Signed-URL upload endpoint for the LocalDir provider (the dev
+        stand-in for GCS's signed PUT)."""
+        from protocol_tpu.utils.storage import LocalDirStorageProvider
+
+        if not isinstance(self.storage, LocalDirStorageProvider):
+            return _err("uploads not served by this deployment", 501)
+        object_name = request.match_info["object_name"]
+        try:
+            expires = int(request.query.get("expires", "0"))
+        except ValueError:
+            return _err("invalid expires", 400)
+        token = request.query.get("token", "")
+        try:
+            if not self.storage.verify_upload_url(object_name, expires, token):
+                return _err("invalid or expired upload token", 403)
+        except ValueError:
+            return _err("invalid object name", 400)
+        if request.content_length and request.content_length > MAX_UPLOAD_BYTES:
+            return _err("file too large", 413)
+        data = await request.read()
+        if len(data) > MAX_UPLOAD_BYTES:
+            return _err("file too large", 413)
+        try:
+            await self.storage.put(object_name, data)
+        except ValueError as e:  # path-escaping names with a forged URL
+            return _err(str(e), 400)
+        return web.json_response({"success": True, "data": {"bytes": len(data)}})
 
     def _expand_file_template(
         self, template: str, original_name: str, address: str
@@ -349,6 +393,86 @@ class OrchestratorService:
                 node.status = NodeStatus.BANNED
                 self.groups_plugin.handle_status_change(node)
         return web.json_response({"success": True, "data": "banned"})
+
+    # ----- node control proxies (reference: /nodes/{id}/logs|restart via
+    # the p2p GetTaskLogs/Restart channels, api/routes/nodes.rs) -----
+
+    async def _control_call(
+        self, node: OrchestratorNode, method: str, path: str, timeout: float = 10.0
+    ):
+        """Signed control-plane call to a worker (the p2p channel analog).
+        Non-2xx / success=false responses surface as errors — a rejected
+        restart must not read as a successful one."""
+        if self.control_http is None:
+            return None, "control client not configured"
+        url = (node.p2p_addresses or [None])[0]
+        if not url:
+            return None, "node has no control address"
+        import aiohttp as _aiohttp
+
+        from protocol_tpu.security.signer import sign_request
+
+        req_timeout = _aiohttp.ClientTimeout(total=timeout)
+        try:
+            if method == "GET":
+                headers, _ = sign_request(path, self.wallet)
+                async with self.control_http.get(
+                    f"{url}{path.removeprefix('/control')}",
+                    headers=headers,
+                    timeout=req_timeout,
+                ) as resp:
+                    data = await resp.json()
+            else:
+                headers, body = sign_request(path, self.wallet, {})
+                async with self.control_http.post(
+                    f"{url}{path.removeprefix('/control')}",
+                    json=body,
+                    headers=headers,
+                    timeout=req_timeout,
+                ) as resp:
+                    data = await resp.json()
+            if resp.status >= 400 or data.get("success") is False:
+                return None, data.get("error", f"worker returned {resp.status}")
+            return data, None
+        except Exception as e:
+            return None, str(e)
+
+    async def node_logs(self, request: web.Request) -> web.Response:
+        node = self.store.node_store.get_node(request.match_info["address"].lower())
+        if node is None:
+            return _err("node not found", 404)
+        data, err = await self._control_call(node, "GET", "/control/logs")
+        if err:
+            return _err(err, 502)
+        return web.json_response({"success": True, "data": data.get("logs", [])})
+
+    async def node_restart(self, request: web.Request) -> web.Response:
+        node = self.store.node_store.get_node(request.match_info["address"].lower())
+        if node is None:
+            return _err("node not found", 404)
+        data, err = await self._control_call(node, "POST", "/control/restart")
+        if err:
+            return _err(err, 502)
+        return web.json_response({"success": True})
+
+    async def group_logs(self, request: web.Request) -> web.Response:
+        """Per-member log fan-out (reference groups.rs:217-318)."""
+        if self.groups_plugin is None:
+            return _err("grouping not enabled", 400)
+        group = self.groups_plugin.get_group(request.match_info["group_id"])
+        if group is None:
+            return _err("group not found", 404)
+        async def fetch(addr: str):
+            node = self.store.node_store.get_node(addr)
+            if node is None:
+                return addr, {"error": "unknown node"}
+            data, err = await self._control_call(node, "GET", "/control/logs")
+            return addr, ({"error": err} if err else data.get("logs", []))
+
+        # concurrent fan-out with per-call timeouts: one wedged member must
+        # not serialize/stall the whole group (groups.rs:217-318 fans out too)
+        results = await asyncio.gather(*(fetch(a) for a in group.nodes))
+        return web.json_response({"success": True, "data": dict(results)})
 
     # ----- groups -----
 

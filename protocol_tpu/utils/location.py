@@ -1,0 +1,65 @@
+"""IP -> geo location resolvers.
+
+Reference: crates/discovery/src/location_service.rs — an ipapi.co-style
+GET per IP feeding NodeLocation, consumed by the 30 s enrichment loop.
+Here the resolver is the pluggable seam `DiscoveryService.location_resolver`
+expects; two implementations:
+
+  HttpLocationResolver    ip-api-style JSON endpoint with an in-memory
+                          cache (one lookup per distinct IP).
+  StaticLocationResolver  table/prefix-based (dev clusters, tests, and
+                          air-gapped deployments).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from protocol_tpu.models.node import NodeLocation
+
+
+class StaticLocationResolver:
+    def __init__(self, table: Optional[dict[str, NodeLocation]] = None,
+                 default: Optional[NodeLocation] = None):
+        self.table = table or {}
+        self.default = default
+
+    async def __call__(self, ip: str) -> Optional[NodeLocation]:
+        if ip in self.table:
+            return self.table[ip]
+        # longest-prefix match on dotted quads ("10.1." -> region)
+        best, best_len = self.default, -1
+        for prefix, loc in self.table.items():
+            if prefix.endswith(".") and ip.startswith(prefix) and len(prefix) > best_len:
+                best, best_len = loc, len(prefix)
+        return best
+
+
+class HttpLocationResolver:
+    """GET {base_url}/{ip} expecting {"latitude": .., "longitude": ..,
+    "city"/"region"/"country": ..} (the reference's location-service shape),
+    with per-IP caching and an optional API key header."""
+
+    def __init__(self, base_url: str, http, api_key: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.http = http
+        self.api_key = api_key
+        self._cache: dict[str, Optional[NodeLocation]] = {}
+
+    async def __call__(self, ip: str) -> Optional[NodeLocation]:
+        if ip in self._cache:
+            return self._cache[ip]
+        headers = {"Authorization": f"Bearer {self.api_key}"} if self.api_key else {}
+        loc: Optional[NodeLocation] = None
+        try:
+            async with self.http.get(f"{self.base_url}/{ip}", headers=headers) as resp:
+                if resp.status == 200:
+                    d = await resp.json()
+                    if "latitude" in d and "longitude" in d:
+                        loc = NodeLocation.from_dict(d)
+        except Exception:
+            loc = None
+        # negative results are NOT cached: the enrichment loop retries them
+        if loc is not None:
+            self._cache[ip] = loc
+        return loc

@@ -75,9 +75,17 @@ class LocalDirStorageProvider(StorageProvider):
     """Filesystem-backed provider for dev deployments; URLs carry an HMAC
     token so the upload endpoint can reject unsigned paths."""
 
-    def __init__(self, root: str, secret: bytes = b"dev-secret"):
+    def __init__(
+        self,
+        root: str,
+        secret: bytes = b"dev-secret",
+        public_base_url: str = "",
+    ):
         self.root = root
         self.secret = secret
+        # when set, signed URLs are HTTP PUT endpoints (served by the
+        # orchestrator's /storage/upload route) instead of file:// paths
+        self.public_base_url = public_base_url.rstrip("/")
         os.makedirs(root, exist_ok=True)
 
     def _path(self, object_name: str) -> str:
@@ -102,9 +110,25 @@ class LocalDirStorageProvider(StorageProvider):
     async def generate_upload_signed_url(
         self, object_name, content_type=None, expires_in=3600.0, max_bytes=None
     ) -> str:
+        from urllib.parse import quote
+
+        # reject escaping names at ISSUE time (the token would otherwise
+        # validate while the write later fails)
+        self._path(object_name)
         expires = int(time.time() + expires_in)
         token = self._token(object_name, expires)
+        if self.public_base_url:
+            return (
+                f"{self.public_base_url}/storage/upload/{quote(object_name, safe='/')}"
+                f"?expires={expires}&token={token}"
+            )
         return f"file://{self._path(object_name)}?expires={expires}&token={token}"
+
+    async def put(self, object_name: str, data: bytes) -> None:
+        path = self._path(object_name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
 
     def verify_upload_url(self, object_name: str, expires: int, token: str) -> bool:
         if time.time() > expires:

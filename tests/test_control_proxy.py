@@ -1,0 +1,153 @@
+"""Orchestrator control proxies (node logs/restart, group log fan-out) and
+location resolvers."""
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.models.node import NodeLocation
+from protocol_tpu.sched.node_groups import NodeGroupConfiguration, NodeGroupsPlugin
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.services.worker import SubprocessRuntime, WorkerAgent
+from protocol_tpu.store import NodeStatus, OrchestratorNode
+from protocol_tpu.utils.location import HttpLocationResolver, StaticLocationResolver
+
+from tests.test_services import make_world
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestControlProxies:
+    def test_node_logs_and_restart_proxy(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+
+        async def flow():
+            async with aiohttp.ClientSession() as session:
+                agent = WorkerAgent(
+                    provider, node, ledger, pid,
+                    runtime=SubprocessRuntime(),
+                    http=session,
+                    known_orchestrators=[manager.address],
+                )
+                agent.runtime.logs.extend(["line-1", "line-2"])
+                wsrv = TestServer(agent.make_control_app())
+                await wsrv.start_server()
+                control_url = str(wsrv.make_url("/control"))
+
+                svc = OrchestratorService(
+                    ledger, pid, manager, control_http=session
+                )
+                svc.store.node_store.add_node(
+                    OrchestratorNode(
+                        address=node.address,
+                        status=NodeStatus.HEALTHY,
+                        p2p_addresses=[control_url],
+                    )
+                )
+                async with TestClient(TestServer(svc.make_app())) as client:
+                    auth = {"Authorization": "Bearer admin"}
+                    r1 = await client.get(f"/nodes/{node.address}/logs", headers=auth)
+                    logs = (await r1.json())["data"]
+                    r2 = await client.post(
+                        f"/nodes/{node.address}/restart", headers=auth
+                    )
+                    r3 = await client.get("/nodes/0xmissing/logs", headers=auth)
+                    await wsrv.close()
+                    return r1.status, logs, r2.status, r3.status
+
+        s1, logs, s2, s3 = run(flow())
+        assert s1 == 200 and logs[-2:] == ["line-1", "line-2"]
+        assert s2 == 200
+        assert s3 == 404
+
+    def test_group_logs_fanout(self):
+        ledger, creator, manager, provider, node, pid = make_world()
+
+        async def flow():
+            async with aiohttp.ClientSession() as session:
+                from protocol_tpu.store import StoreContext
+
+                store = StoreContext.new_test()
+                groups = NodeGroupsPlugin(
+                    store,
+                    [NodeGroupConfiguration(name="pair", min_group_size=1, max_group_size=2)],
+                )
+                agents, servers, urls = [], [], []
+                from protocol_tpu.security import Wallet
+
+                for i in range(2):
+                    w = Wallet.from_seed(f"gl-{i}".encode())
+                    a = WorkerAgent(
+                        provider, w, ledger, pid,
+                        runtime=SubprocessRuntime(),
+                        http=session,
+                        known_orchestrators=[manager.address],
+                    )
+                    a.runtime.logs.append(f"member-{i}")
+                    s = TestServer(a.make_control_app())
+                    await s.start_server()
+                    urls.append(str(s.make_url("/control")))
+                    store.node_store.add_node(
+                        OrchestratorNode(
+                            address=w.address,
+                            status=NodeStatus.HEALTHY,
+                            p2p_addresses=[urls[-1]],
+                        )
+                    )
+                    agents.append(a)
+                    servers.append(s)
+                group = groups._create_group(
+                    groups.configurations[0], [a.node_wallet.address for a in agents]
+                )
+                svc = OrchestratorService(
+                    ledger, pid, manager, store=store,
+                    groups_plugin=groups, control_http=session,
+                )
+                async with TestClient(TestServer(svc.make_app())) as client:
+                    r = await client.get(
+                        f"/groups/{group.id}/logs",
+                        headers={"Authorization": "Bearer admin"},
+                    )
+                    data = (await r.json())["data"]
+                for s in servers:
+                    await s.close()
+                return data, agents
+
+        data, agents = run(flow())
+        for i, a in enumerate(agents):
+            assert data[a.node_wallet.address] == [f"member-{i}"]
+
+
+class TestLocationResolvers:
+    def test_static_table_and_prefix(self):
+        paris = NodeLocation(latitude=48.85, longitude=2.35, city="Paris")
+        dc = NodeLocation(latitude=38.9, longitude=-77.0, region="dc-east")
+        r = StaticLocationResolver({"1.2.3.4": paris, "10.1.": dc})
+        assert run(r("1.2.3.4")).city == "Paris"
+        assert run(r("10.1.99.5")).region == "dc-east"
+        assert run(r("8.8.8.8")) is None
+
+    def test_http_resolver_caches(self):
+        calls = []
+
+        async def handler(request):
+            calls.append(request.match_info["ip"])
+            return web.json_response({"latitude": 1.0, "longitude": 2.0, "city": "X"})
+
+        async def flow():
+            app = web.Application()
+            app.router.add_get("/{ip}", handler)
+            async with TestClient(TestServer(app)) as client:
+                r = HttpLocationResolver("", client)
+                a = await r("9.9.9.9")
+                b = await r("9.9.9.9")
+                return a, b
+
+        a, b = run(flow())
+        assert a.city == "X" and b.city == "X"
+        assert calls == ["9.9.9.9"]  # second hit served from cache

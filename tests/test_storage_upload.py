@@ -1,0 +1,96 @@
+"""Signed-URL upload loop with the LocalDir provider: request-upload ->
+HTTP PUT with the HMAC token -> artifact on disk -> validator mapping."""
+
+import asyncio
+import tempfile
+from urllib.parse import urlparse
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.security import sign_request
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.store import NodeStatus, OrchestratorNode
+from protocol_tpu.utils.storage import LocalDirStorageProvider
+
+from tests.test_services import make_world
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_full_signed_upload_loop():
+    ledger, creator, manager, provider, node, pid = make_world()
+
+    async def flow():
+        with tempfile.TemporaryDirectory() as root:
+            storage = LocalDirStorageProvider(root, public_base_url="http://x")
+            svc = OrchestratorService(ledger, pid, manager, storage=storage)
+            svc.store.node_store.add_node(
+                OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+            )
+            async with TestClient(TestServer(svc.make_app())) as client:
+                payload = {
+                    "file_name": "artifact.bin",
+                    "file_size": 11,
+                    "file_type": "application/octet-stream",
+                    "sha256": "deadbeef",
+                }
+                headers, body = sign_request("/storage/request-upload", node, payload)
+                r = await client.post(
+                    "/storage/request-upload", json=body, headers=headers
+                )
+                assert r.status == 200, await r.text()
+                url = (await r.json())["data"]["signed_url"]
+                # PUT through the signed URL (token auth, no wallet signature)
+                path_q = url.split("http://x", 1)[1]
+                r2 = await client.put(path_q, data=b"hello world")
+                assert r2.status == 200, await r2.text()
+
+                # artifact landed; the validator can resolve the mapping
+                assert await storage.file_exists("artifact.bin")
+                assert await storage.resolve_mapping_for_sha("deadbeef") == "artifact.bin"
+
+                # tampered token rejected
+                r3 = await client.put(path_q[:-4] + "beef", data=b"x")
+                assert r3.status == 403
+
+                # path traversal rejected
+                parsed = urlparse(path_q)
+                r4 = await client.put(
+                    "/storage/upload/..%2Fescape?" + parsed.query, data=b"x"
+                )
+                assert r4.status in (400, 403)
+
+                # uploads above aiohttp's 1 MiB default must pass (the
+                # advertised cap is 100 MB; regression for client_max_size)
+                big_payload = {
+                    "file_name": "big.bin",
+                    "file_size": 5 * 1024 * 1024,
+                    "file_type": "bin",
+                    "sha256": "b1b1",
+                }
+                h2, b2 = sign_request("/storage/request-upload", node, big_payload)
+                r5 = await client.post(
+                    "/storage/request-upload", json=b2, headers=h2
+                )
+                url5 = (await r5.json())["data"]["signed_url"]
+                r6 = await client.put(
+                    url5.split("http://x", 1)[1], data=b"z" * (5 * 1024 * 1024)
+                )
+                assert r6.status == 200, await r6.text()
+
+                # escaping file_name rejected at ISSUE time
+                bad = {
+                    "file_name": "../../etc/passwd",
+                    "file_size": 1,
+                    "file_type": "bin",
+                    "sha256": "ee",
+                }
+                h3, b3 = sign_request("/storage/request-upload", node, bad)
+                r7 = await client.post(
+                    "/storage/request-upload", json=b3, headers=h3
+                )
+                assert r7.status == 400
+
+    run(flow())
