@@ -1,0 +1,352 @@
+"""Pallas TPU kernel: fused compat + cost + running-top-K candidates.
+
+The XLA path (ops/sparse.candidates_topk) materializes each [P, tile] cost
+block in HBM and then runs lax.top_k over it. This kernel fuses the whole
+candidate pipeline in VMEM: a grid over provider blocks computes the cost
+block (capability mask -> cost terms -> tie-breaking jitter) and folds it
+into a running per-task top-K held in scratch — the [P, tile] tensor never
+exists outside VMEM, cutting the HBM traffic of candidate generation from
+O(P*T) writes+reads to O(P*T) reads of the packed features only.
+
+Feature packing (host side, ops/encoding-compatible; the kernel's
+feasibility mask depends on the `valid` slots — an alternative packer must
+fill them):
+  pi  i32 [P, 8]  gpu_count, gpu_mem_mb, gpu_model_id, has_gpu, has_cpu,
+                  cpu_cores, ram_mb, storage_gb         (-1 = absent)
+  pf  f32 [P, 8]  lat, lon, has_loc, price, load, VALID(0/1), 0, 0
+  ri  i32 [T, 8]  cpu_required, cpu_cores, ram_mb, storage_gb,
+                  gpu_required(any option), VALID(0/1), 0, 0
+  ro  i32 [T, K*8] per GPU OR-option: valid, count, mem_min, mem_max,
+                  tot_min, tot_max, model_constrained, 0
+  rm  u32 [T, K*W] model-class bitmask words
+  rf  f32 [T, 8]  lat, lon, has_loc, priority, 0, 0, 0, 0
+
+The kernel reproduces ops/encoding.compat_mask + ops/cost.cost_matrix +
+the hash jitter bit-for-bit (parity-tested in interpret mode against the
+XLA path); integration stays behind `use_pallas=` flags until profiled on
+real hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from protocol_tpu.ops.cost import EARTH_RADIUS_KM, INFEASIBLE, CostWeights
+from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
+
+_NEG = -1e18
+
+
+# ---------------------------------------------------------------- packing
+
+
+def pack_features(
+    ep: EncodedProviders, er: EncodedRequirements
+) -> tuple[jax.Array, ...]:
+    """Host-side packing of the encoding dataclasses into the kernel's
+    fixed-width matrices."""
+    P = ep.gpu_count.shape[0]
+    T, K = er.gpu_opt_valid.shape
+    W = er.gpu_model_mask.shape[-1]
+
+    pi = jnp.stack(
+        [
+            jnp.asarray(ep.gpu_count, jnp.int32),
+            jnp.asarray(ep.gpu_mem_mb, jnp.int32),
+            jnp.asarray(ep.gpu_model_id, jnp.int32),
+            jnp.asarray(ep.has_gpu, jnp.int32),
+            jnp.asarray(ep.has_cpu, jnp.int32),
+            jnp.asarray(ep.cpu_cores, jnp.int32),
+            jnp.asarray(ep.ram_mb, jnp.int32),
+            jnp.asarray(ep.storage_gb, jnp.int32),
+        ],
+        axis=1,
+    )
+    pf = jnp.stack(
+        [
+            jnp.asarray(ep.lat, jnp.float32),
+            jnp.asarray(ep.lon, jnp.float32),
+            jnp.asarray(ep.has_location, jnp.float32),
+            jnp.asarray(ep.price, jnp.float32),
+            jnp.asarray(ep.load, jnp.float32),
+            jnp.asarray(ep.valid, jnp.float32),
+            jnp.zeros(P, jnp.float32),
+            jnp.zeros(P, jnp.float32),
+        ],
+        axis=1,
+    )
+    ri = jnp.stack(
+        [
+            jnp.asarray(er.cpu_required, jnp.int32),
+            jnp.asarray(er.cpu_cores, jnp.int32),
+            jnp.asarray(er.ram_mb, jnp.int32),
+            jnp.asarray(er.storage_gb, jnp.int32),
+            jnp.any(jnp.asarray(er.gpu_opt_valid), axis=1).astype(jnp.int32),
+            jnp.asarray(er.valid, jnp.int32),
+            jnp.zeros(T, jnp.int32),
+            jnp.zeros(T, jnp.int32),
+        ],
+        axis=1,
+    )
+    ro = jnp.concatenate(
+        [
+            jnp.stack(
+                [
+                    jnp.asarray(er.gpu_opt_valid[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_count[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_mem_min[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_mem_max[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_total_mem_min[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_total_mem_max[:, k], jnp.int32),
+                    jnp.asarray(er.gpu_model_constrained[:, k], jnp.int32),
+                    jnp.zeros(T, jnp.int32),
+                ],
+                axis=1,
+            )
+            for k in range(K)
+        ],
+        axis=1,
+    )
+    rm = jnp.asarray(er.gpu_model_mask, jnp.uint32).reshape(T, K * W)
+    rf = jnp.stack(
+        [
+            jnp.asarray(er.lat, jnp.float32),
+            jnp.asarray(er.lon, jnp.float32),
+            jnp.asarray(er.has_location, jnp.float32),
+            jnp.asarray(er.priority, jnp.float32),
+            jnp.zeros(T, jnp.float32),
+            jnp.zeros(T, jnp.float32),
+            jnp.zeros(T, jnp.float32),
+            jnp.zeros(T, jnp.float32),
+        ],
+        axis=1,
+    )
+    return pi, pf, ri, ro, rm, rf
+
+
+# ---------------------------------------------------------------- kernel
+
+
+def _cost_block(pi, pf, ri, ro, rm, rf, weights, p0, K, W):
+    """[PB, TB] cost block from packed features (pure jnp; runs inside the
+    kernel body on VMEM-resident blocks)."""
+    PB = pi.shape[0]
+    TB = ri.shape[0]
+
+    def col_i(mat, j):
+        return mat[:, j]
+
+    # provider columns
+    p_count = col_i(pi, 0)[:, None]
+    p_mem = col_i(pi, 1)[:, None]
+    p_model = col_i(pi, 2)[:, None]
+    p_hasgpu = col_i(pi, 3)[:, None]
+    p_hascpu = col_i(pi, 4)[:, None]
+    p_cores = col_i(pi, 5)[:, None]
+    p_ram = col_i(pi, 6)[:, None]
+    p_stor = col_i(pi, 7)[:, None]
+
+    r_cpureq = col_i(ri, 0)[None, :]
+    r_cores = col_i(ri, 1)[None, :]
+    r_ram = col_i(ri, 2)[None, :]
+    r_stor = col_i(ri, 3)[None, :]
+    r_anygpu = col_i(ri, 4)[None, :]
+    r_valid = col_i(ri, 5)[None, :]
+
+    def ge_min(spec, req):
+        return (req < 0) | ((spec >= 0) & (spec >= req))
+
+    ok = (r_cpureq == 0) | ((p_hascpu > 0) & ge_min(p_cores, r_cores))
+    ok &= ge_min(p_ram, r_ram)
+    ok &= ge_min(p_stor, r_stor)
+
+    any_opt_ok = jnp.zeros((PB, TB), bool)
+    word = jnp.maximum(p_model, 0) >> 5
+    bit = (jnp.maximum(p_model, 0) & 31).astype(jnp.uint32)
+    for k in range(K):
+        o = ro[:, k * 8 : (k + 1) * 8]
+        o_valid = o[:, 0][None, :]
+        o_count = o[:, 1][None, :]
+        o_mmin = o[:, 2][None, :]
+        o_mmax = o[:, 3][None, :]
+        o_tmin = o[:, 4][None, :]
+        o_tmax = o[:, 5][None, :]
+        o_constr = o[:, 6][None, :]
+
+        count_ok = (o_count < 0) | jnp.where(p_count < 0, o_count == 0, p_count == o_count)
+        mem_ok = ge_min(p_mem, o_mmin) & ((o_mmax < 0) | ((p_mem >= 0) & (p_mem <= o_mmax)))
+        total = p_count * p_mem
+        have_total = (p_count >= 0) & (p_mem >= 0)
+        tot_ok = ((o_tmin < 0) | ~have_total | (total >= o_tmin)) & (
+            (o_tmax < 0) | ~have_total | (total <= o_tmax)
+        )
+        # model bitmask: select this option's word by provider class
+        words = rm[:, k * W : (k + 1) * W]  # [TB, W]
+        sel = jnp.zeros((PB, TB), jnp.uint32)
+        for w in range(W):
+            sel = jnp.where(word == w, words[:, w][None, :], sel)
+        model_hit = ((sel >> bit) & 1) > 0
+        model_ok = (o_constr == 0) | ((p_model >= 0) & model_hit)
+
+        any_opt_ok |= (o_valid > 0) & count_ok & mem_ok & tot_ok & model_ok
+
+    gpu_ok = jnp.where(r_anygpu > 0, (p_hasgpu > 0) & any_opt_ok, True)
+    ok &= gpu_ok
+    ok &= (pf[:, 5] > 0)[:, None] & (r_valid > 0)
+
+    # cost terms (ops/cost.cost_matrix)
+    lat1, lon1 = pf[:, 0][:, None], pf[:, 1][:, None]
+    lat2, lon2 = rf[:, 0][None, :], rf[:, 1][None, :]
+    dlat, dlon = lat2 - lat1, lon2 - lon1
+    a = jnp.sin(dlat / 2) ** 2 + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
+    dist = 2.0 * EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+    has_loc = (pf[:, 2] > 0)[:, None] & (rf[:, 2] > 0)[None, :]
+
+    cost = weights.price * pf[:, 3][:, None] + weights.load * pf[:, 4][:, None]
+    cost = cost + jnp.where(has_loc, weights.proximity * dist, 0.0)
+    cost = cost - weights.priority * rf[:, 3][None, :]
+    cost = jnp.where(ok, cost, INFEASIBLE)
+
+    # deterministic tie-breaking jitter (ops/sparse.candidates_topk)
+    gp = (p0 + jax.lax.broadcasted_iota(jnp.uint32, (PB, TB), 0)).astype(jnp.uint32)
+    gt = jax.lax.broadcasted_iota(jnp.uint32, (PB, TB), 1).astype(jnp.uint32)
+    h = gp * jnp.uint32(2654435761) ^ gt * jnp.uint32(40503)
+    jitter = (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
+    return jnp.where(cost < INFEASIBLE * 0.5, cost + jitter, cost)
+
+
+def _topk_kernel(pi, pf, ri, ro, rm, rf, out_val, out_idx, weights, K, W, PB, k):
+    """Grid step: fold this provider block's cost into the running top-k.
+
+    Scratchless variant: the running top-k lives in the OUTPUT refs (same
+    block for every grid step along providers), initialized at step 0.
+    Selection per slot: k rounds of masked row-min over the [PB+k] merge
+    candidates — k is small (<=128), PB is the block size.
+    """
+    step = pl.program_id(0)
+    p0 = (step * PB).astype(jnp.uint32)
+
+    cost = _cost_block(pi[:], pf[:], ri[:], ro[:], rm[:], rf[:], weights, p0, K, W)
+    TB = cost.shape[1]
+
+    @pl.when(step == 0)
+    def _init():
+        out_val[:] = jnp.full((TB, k), INFEASIBLE, jnp.float32)
+        out_idx[:] = jnp.full((TB, k), -1, jnp.int32)
+
+    # merge: [TB, k + PB] values; select k smallest per row
+    blk_val = cost.T  # [TB, PB]
+    blk_idx = (step * PB + jax.lax.broadcasted_iota(jnp.int32, (TB, PB), 1))
+    merged_val = jnp.concatenate([out_val[:], blk_val], axis=1)
+    merged_idx = jnp.concatenate([out_idx[:], blk_idx], axis=1)
+
+    # iterative selection: k rounds of row-argmin with masking
+    def select(i, carry):
+        mval, midx, oval, oidx = carry
+        j = jnp.argmin(mval, axis=1)  # [TB]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (TB,), 0)
+        best_v = mval[rows, j]
+        best_i = midx[rows, j]
+        oval = oval.at[:, i].set(best_v)
+        oidx = oidx.at[:, i].set(best_i)
+        mval = mval.at[rows, j].set(INFEASIBLE * 2.0)
+        return mval, midx, oval, oidx
+
+    _, _, new_val, new_idx = jax.lax.fori_loop(
+        0,
+        k,
+        select,
+        (
+            merged_val,
+            merged_idx,
+            jnp.zeros((TB, k), jnp.float32),
+            jnp.zeros((TB, k), jnp.int32),
+        ),
+    )
+    out_val[:] = new_val
+    out_idx[:] = jnp.where(new_val < INFEASIBLE * 0.5, new_idx, -1)
+
+
+def candidates_topk_pallas(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    weights: CostWeights | None = None,
+    k: int = 64,
+    provider_block: int = 1024,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused candidate generation; same contract as ops/sparse.candidates_topk
+    (for T small enough to fit one task tile in VMEM — pair with an outer
+    task loop at larger T). Returns (cand_provider [T, k], cand_cost [T, k]).
+
+    Cost weights are baked into the kernel as compile-time constants (one
+    executable per weight setting — weights change rarely), which keeps the
+    kernel signature to the six packed feature blocks.
+    """
+    if weights is None:
+        weights = CostWeights()
+    wtuple = (
+        float(weights.price),
+        float(weights.load),
+        float(weights.proximity),
+        float(weights.priority),
+    )
+    return _candidates_topk_pallas_jit(
+        ep, er, wtuple, k=k, provider_block=provider_block, interpret=interpret
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("wtuple", "k", "provider_block", "interpret")
+)
+def _candidates_topk_pallas_jit(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    wtuple: tuple,
+    k: int,
+    provider_block: int,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array]:
+    weights = CostWeights(*wtuple)
+    pi, pf, ri, ro, rm, rf = pack_features(ep, er)
+    P = pi.shape[0]
+    T = ri.shape[0]
+    K = er.gpu_opt_valid.shape[1]
+    W = er.gpu_model_mask.shape[-1]
+    if P % provider_block != 0:
+        raise ValueError(f"P={P} not divisible by provider_block={provider_block}")
+    k = min(k, P)
+
+    kernel = functools.partial(
+        _topk_kernel, weights=weights, K=K, W=W, PB=provider_block, k=k
+    )
+    grid = (P // provider_block,)
+    val, idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((provider_block, 8), lambda i: (i, 0)),
+            pl.BlockSpec((provider_block, 8), lambda i: (i, 0)),
+            pl.BlockSpec((T, 8), lambda i: (0, 0)),
+            pl.BlockSpec((T, K * 8), lambda i: (0, 0)),
+            pl.BlockSpec((T, K * W), lambda i: (0, 0)),
+            pl.BlockSpec((T, 8), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((T, k), lambda i: (0, 0)),
+            pl.BlockSpec((T, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, k), jnp.float32),
+            jax.ShapeDtypeStruct((T, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(pi, pf, ri, ro, rm, rf)
+    provider = jnp.where(val < INFEASIBLE * 0.5, idx, -1)
+    return provider, val

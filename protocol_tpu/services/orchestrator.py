@@ -263,10 +263,12 @@ class OrchestratorService:
             )
 
         try:
-            await self.storage.generate_mapping_file(sha256, object_name)
+            # URL first: an invalid object name must not leave a poisoned
+            # sha->name mapping behind
             url = await self.storage.generate_upload_signed_url(
                 object_name, max_bytes=file_size
             )
+            await self.storage.generate_mapping_file(sha256, object_name)
         except ValueError as e:  # e.g. path-escaping object names
             return _err(str(e), 400)
         return web.json_response(
@@ -283,24 +285,31 @@ class OrchestratorService:
         object_name = request.match_info["object_name"]
         try:
             expires = int(request.query.get("expires", "0"))
+            max_bytes = int(request.query.get("max_bytes", "0"))
         except ValueError:
-            return _err("invalid expires", 400)
+            return _err("invalid expires/max_bytes", 400)
         token = request.query.get("token", "")
         try:
-            if not self.storage.verify_upload_url(object_name, expires, token):
+            if not self.storage.verify_upload_url(
+                object_name, expires, token, max_bytes=max_bytes
+            ):
                 return _err("invalid or expired upload token", 403)
         except ValueError:
             return _err("invalid object name", 400)
-        if request.content_length and request.content_length > MAX_UPLOAD_BYTES:
-            return _err("file too large", 413)
-        data = await request.read()
-        if len(data) > MAX_UPLOAD_BYTES:
-            return _err("file too large", 413)
+        # the HMAC binds the approved size; 0 means "global cap only"
+        cap = min(max_bytes or MAX_UPLOAD_BYTES, MAX_UPLOAD_BYTES)
+        if request.content_length and request.content_length > cap:
+            return _err("file larger than approved size", 413)
+        # stream to disk in chunks: concurrent 100 MB uploads must not
+        # buffer whole bodies in orchestrator memory
         try:
-            await self.storage.put(object_name, data)
-        except ValueError as e:  # path-escaping names with a forged URL
-            return _err(str(e), 400)
-        return web.json_response({"success": True, "data": {"bytes": len(data)}})
+            total = await self.storage.put_stream(
+                object_name, request.content.iter_chunked(1 << 20), cap
+            )
+        except ValueError as e:  # size overflow or path-escaping name
+            status = 413 if "approved size" in str(e) else 400
+            return _err(str(e), status)
+        return web.json_response({"success": True, "data": {"bytes": total}})
 
     def _expand_file_template(
         self, template: str, original_name: str, address: str

@@ -99,9 +99,13 @@ class LocalDirStorageProvider(StorageProvider):
             raise ValueError(f"object name escapes storage root: {object_name!r}")
         return full
 
-    def _token(self, object_name: str, expires: int) -> str:
+    def _token(self, object_name: str, expires: int, max_bytes: int) -> str:
+        # max_bytes is part of the signed payload: the approved size is
+        # enforceable at upload time (GCS content-length-range semantics)
         return hmac.new(
-            self.secret, f"{object_name}|{expires}".encode(), hashlib.sha256
+            self.secret,
+            f"{object_name}|{expires}|{max_bytes}".encode(),
+            hashlib.sha256,
         ).hexdigest()[:32]
 
     async def file_exists(self, object_name: str) -> bool:
@@ -116,13 +120,17 @@ class LocalDirStorageProvider(StorageProvider):
         # validate while the write later fails)
         self._path(object_name)
         expires = int(time.time() + expires_in)
-        token = self._token(object_name, expires)
+        size_cap = int(max_bytes) if max_bytes else 0
+        token = self._token(object_name, expires, size_cap)
         if self.public_base_url:
             return (
                 f"{self.public_base_url}/storage/upload/{quote(object_name, safe='/')}"
-                f"?expires={expires}&token={token}"
+                f"?expires={expires}&max_bytes={size_cap}&token={token}"
             )
-        return f"file://{self._path(object_name)}?expires={expires}&token={token}"
+        return (
+            f"file://{self._path(object_name)}"
+            f"?expires={expires}&max_bytes={size_cap}&token={token}"
+        )
 
     async def put(self, object_name: str, data: bytes) -> None:
         path = self._path(object_name)
@@ -130,10 +138,38 @@ class LocalDirStorageProvider(StorageProvider):
         with open(path, "wb") as f:
             f.write(data)
 
-    def verify_upload_url(self, object_name: str, expires: int, token: str) -> bool:
+    async def put_stream(self, object_name: str, chunk_iter, cap: int) -> int:
+        """Stream chunks to disk; deletes the partial file and raises
+        ValueError if the running total exceeds ``cap``. Returns bytes
+        written."""
+        path = self._path(object_name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        total = 0
+        tmp = path + ".part"
+        try:
+            with open(tmp, "wb") as f:
+                async for chunk in chunk_iter:
+                    total += len(chunk)
+                    if total > cap:
+                        raise ValueError("upload exceeds approved size")
+                    f.write(chunk)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return total
+
+    def verify_upload_url(
+        self, object_name: str, expires: int, token: str, max_bytes: int = 0
+    ) -> bool:
         if time.time() > expires:
             return False
-        return hmac.compare_digest(self._token(object_name, expires), token)
+        return hmac.compare_digest(
+            self._token(object_name, expires, max_bytes), token
+        )
 
     async def generate_mapping_file(self, sha256: str, file_name: str) -> None:
         path = self._path(f"mapping/{sha256}")

@@ -1,0 +1,33 @@
+"""Pallas fused candidate kernel: interpret-mode parity with the XLA path.
+
+(Interpret mode runs the kernel logic on CPU; native Mosaic compilation is
+exercised on real TPU hardware where available.)"""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.ops.cost import CostWeights
+from protocol_tpu.ops.pallas_kernels import candidates_topk_pallas
+from protocol_tpu.ops.sparse import candidates_topk
+
+from tests.test_sparse import encode_random_marketplace
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_interpret_parity_with_xla_path(seed):
+    ep, er = encode_random_marketplace(seed, 32, 16)
+    xp, xc = candidates_topk(ep, er, CostWeights(), k=8, tile=16)
+    pp, pc = candidates_topk_pallas(
+        ep, er, CostWeights(), k=8, provider_block=16, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(pp), np.asarray(xp))
+    feas = np.asarray(xp) >= 0
+    np.testing.assert_allclose(
+        np.asarray(pc)[feas], np.asarray(xc)[feas], rtol=1e-5
+    )
+
+
+def test_block_divisibility_enforced():
+    ep, er = encode_random_marketplace(2, 24, 8)
+    with pytest.raises(ValueError):
+        candidates_topk_pallas(ep, er, k=4, provider_block=16, interpret=True)

@@ -80,6 +80,16 @@ def test_full_signed_upload_loop():
                 )
                 assert r6.status == 200, await r6.text()
 
+                # the token binds the APPROVED size: a PUT larger than the
+                # requested file_size is rejected even with a valid token
+                r8 = await client.put(
+                    url5.split("http://x", 1)[1],
+                    data=b"z" * (6 * 1024 * 1024),  # approved 5 MiB
+                )
+                assert r8.status == 413, await r8.text()
+                # overflow must not leave a partial artifact behind
+                assert not await storage.file_exists("big.bin.part")
+
                 # escaping file_name rejected at ISSUE time
                 bad = {
                     "file_name": "../../etc/passwd",
