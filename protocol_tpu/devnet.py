@@ -229,6 +229,7 @@ async def main(argv=None) -> None:
             known_validators=[validator_wallet.address],
         )
         agent.register_on_ledger()
+        ledger.whitelist_provider(provider.address)  # devnet auto-onboards
         bridge = TaskBridge(socket_path, agent)
         await bridge.start()
         runners.append(await start_app(agent.make_control_app(), wport))

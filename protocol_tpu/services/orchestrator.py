@@ -549,9 +549,23 @@ class OrchestratorService:
     def _beat(self, loop_name: str) -> None:
         self.loop_beats[loop_name] = time.monotonic()
 
+    INACTIVE_GRACE_SECONDS = 300.0  # monitor.rs:298-334 (5 min)
+
     async def discovery_monitor_once(self) -> int:
-        """Sync nodes from discovery + reconcile statuses
-        (discovery/monitor.rs:90-420)."""
+        """Sync nodes from discovery + reconcile statuses. Rule set mirrors
+        discovery/monitor.rs:236-420, in its order:
+
+        1. a non-Healthy node sharing its endpoint with a Healthy one -> Dead
+        2. validated but provider no longer whitelisted -> Ejected
+        3. Ejected + provider re-whitelisted -> Dead (so it can recover)
+        4. inactive-on-ledger while Healthy, past a 5-min grace since the
+           last status change -> Ejected (or Dead when still whitelisted)
+        5. IP changes and missing locations are absorbed
+        6. Dead + newer discovery update -> Discovered (+ spec refresh)
+        7. zero balance -> LowBalance
+        8. unknown nodes are added as Discovered unless their endpoint is
+           already taken by a Healthy node
+        """
         if self.discovery_fetcher is None:
             return 0
         discovered = await self.discovery_fetcher()
@@ -559,56 +573,142 @@ class OrchestratorService:
         for dn in discovered:  # dedup by id (monitor.rs:202-215)
             seen.setdefault(dn.node.id.lower(), dn)
 
-        changed = 0
+        # one store read per tick, not per node; the healthy-endpoint index
+        # is maintained incrementally for the only in-loop mutation that can
+        # affect it (a HEALTHY node leaving HEALTHY)
         known = {n.address: n for n in self.store.node_store.get_nodes()}
+        healthy_endpoints: dict[tuple[str, int], set[str]] = {}
+        for o in known.values():
+            if o.status == NodeStatus.HEALTHY:
+                healthy_endpoints.setdefault((o.ip_address, o.port), set()).add(
+                    o.address
+                )
+
+        def demote_healthy(address: str, status: NodeStatus) -> None:
+            n = known.get(address)
+            if n is not None and n.status == NodeStatus.HEALTHY:
+                healthy_endpoints.get((n.ip_address, n.port), set()).discard(address)
+            self._set_status(address, status)
+            if n is not None:
+                n.status = status
+                n.last_status_change = time.time()
+
+        changed = 0
         for addr, dn in seen.items():
             node = known.get(addr)
+            owners = healthy_endpoints.get(
+                (dn.node.ip_address, dn.node.port), set()
+            )
+            healthy_same_endpoint = len(owners - {addr})
+            # start-of-iteration snapshot for rule 6 (monitor.rs:359-383
+            # evaluates against the pre-tick node state, so a node marked
+            # Dead earlier in this same tick can never be lifted here)
+            orig_status = node.status if node else None
+            orig_last_change = node.last_status_change if node else None
+
             if node is None:
-                # duplicate-endpoint dead-marking (monitor.rs:236-290)
-                for other in known.values():
-                    if (
-                        other.ip_address == dn.node.ip_address
-                        and other.port == dn.node.port
-                        and other.address != addr
-                        and other.status != NodeStatus.DEAD
-                    ):
-                        self._set_status(other.address, NodeStatus.DEAD)
-                self.store.node_store.add_node(
-                    OrchestratorNode(
-                        address=addr,
-                        ip_address=dn.node.ip_address,
-                        port=dn.node.port,
-                        status=NodeStatus.DISCOVERED,
-                        compute_specs=dn.node.compute_specs,
-                        p2p_id=dn.node.worker_p2p_id,
-                        p2p_addresses=dn.node.worker_p2p_addresses,
-                        location=dn.location,
-                    )
+                # rule 8: endpoint already owned by a healthy node -> skip
+                if healthy_same_endpoint > 0:
+                    continue
+                fresh = OrchestratorNode(
+                    address=addr,
+                    ip_address=dn.node.ip_address,
+                    port=dn.node.port,
+                    status=NodeStatus.DISCOVERED,
+                    compute_specs=dn.node.compute_specs,
+                    p2p_id=dn.node.worker_p2p_id,
+                    p2p_addresses=dn.node.worker_p2p_addresses,
+                    location=dn.location,
                 )
+                self.store.node_store.add_node(fresh)
+                known[addr] = fresh
                 changed += 1
                 continue
 
-            # dead -> discovered on newer update + spec refresh
-            # (monitor.rs:359-383)
-            if node.status == NodeStatus.DEAD and dn.last_updated and (
-                node.last_status_change is None
-                or dn.last_updated > node.last_status_change
+            # rule 1: endpoint squatting by a non-healthy node
+            if healthy_same_endpoint > 0 and node.status != NodeStatus.HEALTHY:
+                demote_healthy(addr, NodeStatus.DEAD)
+                changed += 1
+                continue
+
+            # rule 2: whitelist revoked
+            if dn.is_validated and not dn.is_provider_whitelisted:
+                if node.status != NodeStatus.EJECTED:
+                    demote_healthy(addr, NodeStatus.EJECTED)
+                    changed += 1
+            # rule 3: ejected + re-whitelisted -> dead (recoverable)
+            if (
+                dn.is_validated
+                and dn.is_provider_whitelisted
+                and node.status == NodeStatus.EJECTED
+            ):
+                demote_healthy(addr, NodeStatus.DEAD)
+                changed += 1
+
+            node = self.store.node_store.get_node(addr) or node
+            known[addr] = node
+
+            # rule 4: inactive on ledger while healthy, past the grace
+            if not dn.is_active and node.status == NodeStatus.HEALTHY:
+                past_grace = (
+                    node.last_status_change is None
+                    or time.time() - node.last_status_change
+                    > self.INACTIVE_GRACE_SECONDS
+                )
+                if past_grace:
+                    target = (
+                        NodeStatus.DEAD
+                        if dn.is_provider_whitelisted
+                        else NodeStatus.EJECTED
+                    )
+                    demote_healthy(addr, target)
+                    changed += 1
+                    node = self.store.node_store.get_node(addr) or node
+                    known[addr] = node
+
+            # rule 5: absorb IP changes + missing locations (single write)
+            dirty = False
+            if node.ip_address != dn.node.ip_address:
+                node.ip_address = dn.node.ip_address
+                dirty = True
+            if node.location is None and dn.location is not None:
+                node.location = dn.location
+                dirty = True
+
+            # rule 6: dead -> discovered on a newer discovery update, judged
+            # against the START-of-tick snapshot: a node marked Dead earlier
+            # in this very tick is not lifted (and, per the reference, both
+            # timestamps must be present)
+            if (
+                orig_status == NodeStatus.DEAD
+                and orig_last_change is not None
+                and dn.last_updated
+                and dn.last_updated > orig_last_change
             ):
                 node.compute_specs = dn.node.compute_specs
                 node.status = NodeStatus.DISCOVERED
                 node.last_status_change = time.time()
-                self.store.node_store.update_node(node)
+                known[addr] = node
+                dirty = True
                 changed += 1
-            # zero balance -> LowBalance (monitor.rs:385-395)
+            # rule 7: zero balance -> LowBalance
             elif dn.latest_balance == 0 and node.status == NodeStatus.HEALTHY:
-                self._set_status(addr, NodeStatus.LOW_BALANCE)
+                if dirty:
+                    self.store.node_store.update_node(node)
+                    dirty = False
+                demote_healthy(addr, NodeStatus.LOW_BALANCE)
                 changed += 1
             elif (
                 node.status == NodeStatus.LOW_BALANCE
                 and (dn.latest_balance or 0) > 0
             ):
+                if dirty:
+                    self.store.node_store.update_node(node)
+                    dirty = False
                 self._set_status(addr, NodeStatus.UNHEALTHY)
                 changed += 1
+            if dirty:
+                self.store.node_store.update_node(node)
         return changed
 
     async def invite_once(self) -> int:

@@ -75,12 +75,15 @@ async def build_cluster(session: aiohttp.ClientSession, toploc_results: dict):
             pool_id=pid,
             runtime=MockRuntime(),
             compute_specs=specs(),
+            port=8091 + i,  # distinct endpoints: the duplicate-endpoint
+            # defense (monitor rule 1) kills same-ip:port squatters
             http=session,
             known_orchestrators=[manager.address],
             known_validators=[validator_wallet.address],
         )
         assert agent.check_pool_requirements()
         agent.register_on_ledger()
+        ledger.whitelist_provider(provider.address)  # admin onboarding step
         server = TestServer(agent.make_control_app())
         await server.start_server()
         control_url = str(server.make_url("/control"))

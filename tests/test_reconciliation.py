@@ -1,0 +1,113 @@
+"""Discovery-monitor reconciliation rules (discovery/monitor.rs:236-420):
+endpoint squatting, whitelist revocation/recovery, inactive grace."""
+
+import asyncio
+import time
+
+from protocol_tpu.models.node import DiscoveryNode, Node
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.store import NodeStatus, OrchestratorNode
+
+from tests.test_services import make_world
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def dn(address, ip="1.1.1.1", port=80, validated=True, whitelisted=True,
+       active=True, balance=100, last_updated=None):
+    return DiscoveryNode(
+        node=Node(id=address, ip_address=ip, port=port),
+        is_validated=validated,
+        is_provider_whitelisted=whitelisted,
+        is_active=active,
+        latest_balance=balance,
+        last_updated=last_updated or time.time(),
+    )
+
+
+def svc_with(nodes, discovered):
+    ledger, creator, manager, provider, node, pid = make_world()
+    svc = OrchestratorService(ledger, pid, manager)
+    for n in nodes:
+        svc.store.node_store.add_node(n)
+
+    async def fetcher():
+        return discovered
+
+    svc.discovery_fetcher = fetcher
+    return svc
+
+
+def test_rule1_nonhealthy_node_sharing_healthy_endpoint_dies():
+    svc = svc_with(
+        [
+            OrchestratorNode(address="0xhealthy", ip_address="9.9.9.9", port=80,
+                             status=NodeStatus.HEALTHY),
+            OrchestratorNode(address="0xsquat", ip_address="9.9.9.9", port=80,
+                             status=NodeStatus.DISCOVERED),
+        ],
+        [dn("0xsquat", ip="9.9.9.9", port=80)],
+    )
+    run(svc.discovery_monitor_once())
+    assert svc.store.node_store.get_node("0xsquat").status == NodeStatus.DEAD
+    assert svc.store.node_store.get_node("0xhealthy").status == NodeStatus.HEALTHY
+
+
+def test_rule2_whitelist_revoked_ejects():
+    svc = svc_with(
+        [OrchestratorNode(address="0xa", status=NodeStatus.HEALTHY)],
+        [dn("0xa", whitelisted=False)],
+    )
+    run(svc.discovery_monitor_once())
+    assert svc.store.node_store.get_node("0xa").status == NodeStatus.EJECTED
+
+
+def test_rule3_rewhitelisted_ejected_becomes_dead_then_recovers():
+    svc = svc_with(
+        [OrchestratorNode(address="0xa", status=NodeStatus.EJECTED)],
+        [dn("0xa", whitelisted=True, last_updated=time.time() + 10)],
+    )
+    run(svc.discovery_monitor_once())
+    # ejected -> dead (recoverable); rule 6 then lifts dead -> discovered
+    # because the discovery record is newer than the status change...
+    status = svc.store.node_store.get_node("0xa").status
+    assert status in (NodeStatus.DEAD, NodeStatus.DISCOVERED)
+    # second tick with a fresh discovery update completes recovery
+    run(svc.discovery_monitor_once())
+    assert svc.store.node_store.get_node("0xa").status == NodeStatus.DISCOVERED
+
+
+def test_rule4_inactive_grace():
+    # recently-healthy node: grace protects it
+    fresh = OrchestratorNode(address="0xa", status=NodeStatus.HEALTHY,
+                             last_status_change=time.time())
+    svc = svc_with([fresh], [dn("0xa", active=False)])
+    run(svc.discovery_monitor_once())
+    assert svc.store.node_store.get_node("0xa").status == NodeStatus.HEALTHY
+
+    # past grace: whitelisted -> Dead
+    stale = OrchestratorNode(address="0xb", status=NodeStatus.HEALTHY,
+                             last_status_change=time.time() - 400)
+    svc2 = svc_with([stale], [dn("0xb", active=False, whitelisted=True,
+                                 last_updated=time.time() - 500)])
+    run(svc2.discovery_monitor_once())
+    assert svc2.store.node_store.get_node("0xb").status == NodeStatus.DEAD
+
+    # past grace: not whitelisted -> Ejected
+    stale2 = OrchestratorNode(address="0xc", status=NodeStatus.HEALTHY,
+                              last_status_change=time.time() - 400)
+    svc3 = svc_with([stale2], [dn("0xc", active=False, whitelisted=False)])
+    run(svc3.discovery_monitor_once())
+    assert svc3.store.node_store.get_node("0xc").status == NodeStatus.EJECTED
+
+
+def test_rule8_new_node_skipped_when_endpoint_taken():
+    svc = svc_with(
+        [OrchestratorNode(address="0xhealthy", ip_address="9.9.9.9", port=80,
+                          status=NodeStatus.HEALTHY)],
+        [dn("0xnew", ip="9.9.9.9", port=80)],
+    )
+    run(svc.discovery_monitor_once())
+    assert svc.store.node_store.get_node("0xnew") is None

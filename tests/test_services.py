@@ -49,6 +49,7 @@ def make_world(pool_requirements=""):
     pid = ledger.create_pool(did, creator.address, manager.address, pool_requirements)
     ledger.start_pool(pid, creator.address)
     ledger.register_provider(provider.address, 100)
+    ledger.whitelist_provider(provider.address)
     ledger.add_compute_node(provider.address, node.address)
     return ledger, creator, manager, provider, node, pid
 
