@@ -37,6 +37,57 @@ from protocol_tpu.ops.sparse import (
 _NEG = -1e18
 
 
+def make_k_block(ep, er, weights, eps, tile: int):
+    """Factory for the streamed Gibbs-kernel block K[:, t0:t0+tile] =
+    -cost/eps with infeasible entries at _NEG. Shared by the single-device
+    and mesh-sharded Sinkhorn kernels — bit-identical math here is what
+    their parity guarantee rests on."""
+
+    def k_block(t0):
+        r_tile = _slice_requirements(er, t0, tile)
+        cost, _ = cost_matrix(ep, r_tile, weights)
+        return jnp.where(cost < INFEASIBLE * 0.5, -cost / eps, _NEG)
+
+    return k_block
+
+
+def feasibility_scan(k_block, num_providers: int, starts: jax.Array):
+    """One streaming pass: (row_any [P], col_any_tiles [n_tiles, tile])."""
+
+    def feas_step(row_any, t0):
+        feas = k_block(t0) > _NEG * 0.5
+        return row_any | jnp.any(feas, axis=1), jnp.any(feas, axis=0)
+
+    return lax.scan(feas_step, jnp.zeros(num_providers, bool), starts)
+
+
+def streaming_row_logsumexp(
+    k_block, v: jax.Array, starts: jax.Array, num_providers: int, tile: int
+) -> jax.Array:
+    """Row-wise logsumexp of K + v over all task tiles via a running
+    (max, sum-exp) accumulator."""
+
+    def u_step(carry, t0):
+        run_max, run_sum = carry
+        k = k_block(t0) + lax.dynamic_slice_in_dim(v, t0, tile)[None, :]
+        blk_max = jnp.max(k, axis=1)
+        new_max = jnp.maximum(run_max, blk_max)
+        run_sum = run_sum * jnp.exp(run_max - new_max) + jnp.sum(
+            jnp.exp(k - new_max[:, None]), axis=1
+        )
+        return (new_max, run_sum), None
+
+    (m_u, s_u), _ = lax.scan(
+        u_step,
+        (
+            jnp.full(num_providers, _NEG, jnp.float32),
+            jnp.zeros(num_providers, jnp.float32),
+        ),
+        starts,
+    )
+    return m_u + jnp.log(jnp.maximum(s_u, 1e-30))
+
+
 @partial(jax.jit, static_argnames=("num_iters", "tile"))
 def sinkhorn_potentials_blocked(
     ep: EncodedProviders,
@@ -60,17 +111,10 @@ def sinkhorn_potentials_blocked(
     n_tiles = T // tile
     starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
 
-    def k_block(t0):
-        r_tile = _slice_requirements(er, t0, tile)
-        cost, _ = cost_matrix(ep, r_tile, weights)  # [P, tile]
-        return jnp.where(cost < INFEASIBLE * 0.5, -cost / eps, _NEG)
+    k_block = make_k_block(ep, er, weights, eps, tile)
 
     # feasibility-count pass -> balanced marginals (ops/assign.py semantics)
-    def feas_step(row_any, t0):
-        feas = k_block(t0) > _NEG * 0.5
-        return row_any | jnp.any(feas, axis=1), jnp.any(feas, axis=0)
-
-    row_any, col_any_tiles = lax.scan(feas_step, jnp.zeros(Pn, bool), starts)
+    row_any, col_any_tiles = feasibility_scan(k_block, Pn, starts)
     col_any = col_any_tiles.reshape(T)
     np_valid = jnp.maximum(jnp.sum(row_any), 1)
     nt_valid = jnp.maximum(jnp.sum(col_any), 1)
@@ -82,21 +126,7 @@ def sinkhorn_potentials_blocked(
         u, v = uv
 
         # ---- u-update: streaming logsumexp over all task tiles
-        def u_step(carry, t0):
-            run_max, run_sum = carry  # [P], [P]
-            k = k_block(t0) + lax.dynamic_slice_in_dim(v, t0, tile)[None, :]
-            blk_max = jnp.max(k, axis=1)
-            new_max = jnp.maximum(run_max, blk_max)
-            # rescale both running sum and block contribution to new_max
-            run_sum = run_sum * jnp.exp(run_max - new_max) + jnp.sum(
-                jnp.exp(k - new_max[:, None]), axis=1
-            )
-            return (new_max, run_sum), None
-
-        (m_u, s_u), _ = lax.scan(
-            u_step, (jnp.full(Pn, _NEG, jnp.float32), jnp.zeros(Pn, jnp.float32)), starts
-        )
-        lse_u = m_u + jnp.log(jnp.maximum(s_u, 1e-30))
+        lse_u = streaming_row_logsumexp(k_block, v, starts, Pn, tile)
         u = jnp.where(row_any, log_a - lse_u, _NEG)
 
         # ---- v-update: per-tile full column logsumexp

@@ -10,6 +10,7 @@ of replicated state).
 
 from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
 from protocol_tpu.parallel.auction import assign_auction_sharded
+from protocol_tpu.parallel.sinkhorn import sinkhorn_potentials_sharded
 from protocol_tpu.parallel.sparse import assign_auction_sparse_sharded
 
 __all__ = [
@@ -17,4 +18,5 @@ __all__ = [
     "assign_auction_sparse_sharded",
     "make_mesh",
     "pad_to_multiple",
+    "sinkhorn_potentials_sharded",
 ]
