@@ -1,0 +1,44 @@
+"""JAX platform forcing for hermetic CPU runs.
+
+The ambient environment registers a remote-TPU PJRT plugin ("axon") via
+sitecustomize and forces ``jax_platforms="axon,cpu"`` through
+``jax.config.update`` at import, which takes precedence over the
+``JAX_PLATFORMS`` env var. Any code that must run on the virtual host-CPU
+mesh (tests, the driver's multi-chip dryrun) has to override the config
+value *after* importing jax AND ensure the host device count is set before
+the CPU backend first initializes. This module is the single home for that
+dance.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_host_cpu(n_devices: int = 8) -> None:
+    """Pin JAX to the host-CPU platform with >= ``n_devices`` devices.
+
+    Must run before the CPU backend is first initialized (before any jax
+    op runs on CPU in this process). Raises with a diagnosis if the
+    requested device count cannot be satisfied.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(re.escape(_FLAG) + r"=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" {_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(m.group(0), f"{_FLAG}={n_devices}")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    got = len(jax.devices("cpu"))
+    if got < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} host devices, got {got}: the CPU backend was "
+            "already initialized before force_host_cpu() — call it before "
+            "any jax op in this process"
+        )
