@@ -115,6 +115,7 @@ class Ledger:
         self.domains: dict[int, DomainInfo] = {}
         self.work: dict[tuple[int, str], WorkInfo] = {}  # (pool, work_key)
         self.rewards: dict[str, int] = {}
+        self.validator_roles: set[str] = set()
         self.min_stake_per_compute_unit = min_stake_per_compute_unit
         self._next_pool_id = 0
         self._next_domain_id = 0
@@ -249,6 +250,21 @@ class Ledger:
                 raise LedgerError("node is in a pool")
             del self.nodes[node.lower()]
             pinfo.nodes.remove(node.lower())
+
+    def grant_validator_role(self, address: str) -> None:
+        """Register a validator wallet on the substrate (reference
+        prime_network.get_validator_role surface; workers derive their
+        control-plane allowlist from this set, cli/command.rs:717-734)."""
+        with self._lock:
+            self.validator_roles.add(address.lower())
+
+    def revoke_validator_role(self, address: str) -> None:
+        with self._lock:
+            self.validator_roles.discard(address.lower())
+
+    def get_validator_role(self) -> list[str]:
+        with self._lock:
+            return sorted(self.validator_roles)
 
     def validate_node(self, node: str) -> None:
         """Validator attests hardware (reference

@@ -65,7 +65,14 @@ def validate_signature_middleware(
     """
     prefixes = tuple(protected_prefixes)
     limiter = rate_limiter or RateLimiter()
-    allow = {a.lower() for a in allowed_addresses} if allowed_addresses else None
+    # None = no address filtering; an EMPTY allowlist fails closed (rejects
+    # every address) — callers that want an open surface must pass None
+    # explicitly rather than an empty list.
+    allow = (
+        {a.lower() for a in allowed_addresses}
+        if allowed_addresses is not None
+        else None
+    )
 
     @web.middleware
     async def middleware(request: web.Request, handler):

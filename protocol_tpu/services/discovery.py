@@ -44,7 +44,7 @@ from protocol_tpu.store.kv import KVStore
 
 NODE_KEY = "node:{}"
 NODE_IDS = "node:ids"
-IP_INDEX = "node:ip:{}"  # per-IP membership set: O(1) per-IP cap checks
+IP_INDEX = "node:ip:{}"  # per-IP ACTIVE-node set: O(1) per-IP cap checks
 
 LocationResolver = Callable[[str], Awaitable[Optional[NodeLocation]]]
 
@@ -63,10 +63,19 @@ class DiscoveryNodeStore:
                 self.kv.srem(IP_INDEX.format(prev.node.ip_address), dn.node.id)
             self.kv.set(NODE_KEY.format(dn.node.id), dn.to_json())
             self.kv.sadd(NODE_IDS, dn.node.id)
+            # only pool-ACTIVE nodes count toward the per-IP cap (reference
+            # count_active_nodes_by_ip, discovery node_store.rs:55-75):
+            # chain_sync's active-state writes maintain the index, so dead
+            # or stale registrations never consume the cap
             if dn.node.ip_address:
-                self.kv.sadd(IP_INDEX.format(dn.node.ip_address), dn.node.id)
+                if dn.is_active:
+                    self.kv.sadd(IP_INDEX.format(dn.node.ip_address), dn.node.id)
+                else:
+                    self.kv.srem(IP_INDEX.format(dn.node.ip_address), dn.node.id)
 
     def count_for_ip(self, ip: str, exclude: str = "") -> int:
+        """Active nodes on this IP, excluding ``exclude`` (the reference's
+        effective_count when re-registering an already-active node)."""
         members = self.kv.smembers(IP_INDEX.format(ip))
         return len(members - {exclude})
 
@@ -149,7 +158,10 @@ class DiscoveryService:
             return web.json_response(ApiResponse(True, "updated p2p only").to_dict())
 
         # per-IP active-node cap (node.rs:93-127) — O(1) via the IP index,
-        # not a full-store scan (fleet onboarding must stay linear)
+        # not a full-store scan (fleet onboarding must stay linear).
+        # NB inherited scope (same as the reference): the cap gates
+        # REGISTRATION only; nodes registered while inactive that later all
+        # join the pool are not re-checked at activation time.
         if self.store.count_for_ip(node.ip_address, exclude=node.id) >= self.max_nodes_per_ip:
             return _err("too many nodes from this IP", 429)
 

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import posixpath
+import re
 import time
 import uuid
 from typing import Awaitable, Callable, Optional
@@ -62,6 +64,7 @@ from protocol_tpu.utils.storage import StorageProvider
 
 BAN_KEY = "orchestrator:banned:{}"
 UPLOAD_RATE_KEY = "orchestrator:upload_rate:{}"
+UPLOAD_SHA_OWNER_KEY = "orchestrator:upload_sha_owner:{}"
 
 MAX_UPLOAD_BYTES = 100 * 1024 * 1024  # storage.rs:10
 DEAD_MISS_THRESHOLD = 3  # status_update/mod.rs:43
@@ -242,6 +245,13 @@ class OrchestratorService:
             sha256 = str(body["sha256"])
         except (KeyError, ValueError, TypeError):
             return _err("missing file_name/file_size/sha256", 400)
+        # the sha becomes a storage object name (mapping/{sha}) and a KV key:
+        # anything but plain LOWERCASE hex is rejected — mixed case would
+        # alias one digest to multiple owner keys / mapping objects (a
+        # case-variant sha could remap a victim's resolution), and honest
+        # clients send hexdigest() output which is lowercase
+        if not re.fullmatch(r"[0-9a-f]{64}", sha256):
+            return _err("sha256 must be 64 lowercase hex chars", 400)
         task_id = body.get("task_id")
 
         if file_size > MAX_UPLOAD_BYTES:
@@ -262,15 +272,53 @@ class OrchestratorService:
                 task.storage_config.file_name_template, file_name, address
             )
 
+        # The reference leaves the object-name surface open; close it here:
+        # a node must not write under mapping/ (the validator's sha ->
+        # file-name resolution namespace) or it could misdirect validation
+        # of a victim's pending work (hard invalidation + slash).
+        norm = posixpath.normpath(object_name)
+        if posixpath.isabs(norm) or norm == ".." or norm.startswith("../"):
+            # provider-independent: escaping names must die here, not rely
+            # on each StorageProvider's own path checks
+            return _err("invalid object name", 400)
+        if norm == "mapping" or norm.startswith("mapping/"):
+            return _err("object name under mapping/ is reserved", 400)
+
         try:
-            # URL first: an invalid object name must not leave a poisoned
-            # sha->name mapping behind
+            # URL first: an invalid object name must fail before any state
+            # (sha ownership, mapping) is written
             url = await self.storage.generate_upload_signed_url(
                 object_name, max_bytes=file_size
             )
-            await self.storage.generate_mapping_file(sha256, object_name)
         except ValueError as e:  # e.g. path-escaping object names
             return _err(str(e), 400)
+
+        # One sha, one owner: refuse re-mapping a sha another node already
+        # claimed (prevents overwriting a victim's pending-work resolution).
+        # Claimed only AFTER the object name validated; released if the
+        # mapping write itself fails, so a failed request cannot squat a
+        # victim's sha.
+        owner_key = UPLOAD_SHA_OWNER_KEY.format(sha256)
+        claimed_now = bool(self.store.kv.set(owner_key, address, nx=True))
+        if not claimed_now and self.store.kv.get(owner_key) != address:
+            # another node holds the claim — honored only while it is live:
+            # if the mapped object never materialized (claimant crashed
+            # before its PUT), the claim is stale and may be taken over, so
+            # a dead node cannot squat a deterministic artifact's sha forever
+            mapped = await self.storage.resolve_mapping_for_sha(sha256)
+            if mapped is not None and await self.storage.file_exists(mapped):
+                return _err("sha256 already mapped by another node", 409)
+            self.store.kv.set(owner_key, address)
+        try:
+            await self.storage.generate_mapping_file(sha256, object_name)
+        except ValueError as e:
+            if claimed_now:
+                self.store.kv.delete(owner_key)
+            return _err(str(e), 400)
+        except Exception:
+            if claimed_now:
+                self.store.kv.delete(owner_key)
+            return _err("storage backend failure", 500)
         return web.json_response(
             {"success": True, "data": {"signed_url": url, "object_name": object_name}}
         )
@@ -685,11 +733,16 @@ class OrchestratorService:
                 and dn.last_updated
                 and dn.last_updated > orig_last_change
             ):
+                # spec refresh first, then the transition through _set_status
+                # so webhook observers see Dead -> Discovered like every
+                # other transition in this loop (monitor.rs:359-383)
                 node.compute_specs = dn.node.compute_specs
-                node.status = NodeStatus.DISCOVERED
-                node.last_status_change = time.time()
+                if dirty or node.compute_specs is not None:
+                    self.store.node_store.update_node(node)
+                    dirty = False
+                self._set_status(addr, NodeStatus.DISCOVERED)
+                node = self.store.node_store.get_node(addr) or node
                 known[addr] = node
-                dirty = True
                 changed += 1
             # rule 7: zero balance -> LowBalance
             elif dn.latest_balance == 0 and node.status == NodeStatus.HEALTHY:

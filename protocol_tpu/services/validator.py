@@ -231,15 +231,18 @@ class SyntheticDataValidator:
                 continue
             result = status.get("status")
             if result == "Accept":
-                claimed = info.get("units", 0)
                 reported = status.get("output_flops")
-                if reported is not None and claimed and reported != claimed:
-                    # work-unit mismatch -> soft invalidate (types.rs:49-62)
-                    self._soft_invalidate(work_key)
-                    out["soft"] += 1
+                if gk is not None:
+                    self._accept_group(gk, reported, out)
                 else:
-                    self._set_status(work_key, ValidationResult.ACCEPT)
-                    out["accepted"] += 1
+                    claimed = info.get("units", 0)
+                    if reported is not None and claimed and reported != claimed:
+                        # work-unit mismatch -> soft invalidate (types.rs:49-62)
+                        self._soft_invalidate(work_key)
+                        out["soft"] += 1
+                    else:
+                        self._set_status(work_key, ValidationResult.ACCEPT)
+                        out["accepted"] += 1
             elif result == "Reject":
                 failing = status.get("failing_indices")
                 if gk is not None and failing is not None:
@@ -258,6 +261,42 @@ class SyntheticDataValidator:
             elif result == "Crashed":
                 self._set_status(work_key, ValidationResult.CRASHED)
         return out
+
+    def _accept_group(self, gk: GroupKey, reported, out: dict) -> None:
+        """Group acceptance with the work-units check (mod.rs:972-1095,
+        1248-1356): sum ALL members' claimed units and compare to the
+        group-level output_flops with +/-1 tolerance; on mismatch,
+        soft-invalidate only the nodes whose claim deviates from
+        output_flops/num_nodes by more than 1 — honest members whose
+        individual claims are a fraction of the total are still accepted."""
+        ghash = GROUP_HASH.format(gk.group_id, gk.size, gk.file_num)
+        members = []  # (work_key, node, units)
+        for _idx, mkey in sorted(self.kv.hgetall(ghash).items()):
+            raw = self.kv.get(WORK_INFO_KEY.format(mkey))
+            minfo = json.loads(raw) if raw else {}
+            members.append((mkey, minfo.get("node"), minfo.get("units", 0)))
+
+        # per-node units map, reference overwrite semantics (mod.rs:972-988)
+        node_units = {node: units for _k, node, units in members if node is not None}
+        total = sum(units for _k, _n, units in members)
+        mismatch = reported is not None and abs(total - reported) > 1
+        bad_nodes = set()
+        if mismatch and node_units:
+            expected = reported // len(node_units)
+            bad_nodes = {
+                node
+                for node, units in node_units.items()
+                if abs(units - expected) > 1
+            }
+        for mkey, node, _units in members:
+            if self.get_status(mkey) != ValidationResult.PENDING:
+                continue
+            if node in bad_nodes:
+                self._soft_invalidate(mkey)
+                out["soft"] += 1
+            else:
+                self._set_status(mkey, ValidationResult.ACCEPT)
+                out["accepted"] += 1
 
     async def process_groups_past_grace(self) -> int:
         """Incomplete groups past the grace window -> soft-invalidate their

@@ -485,10 +485,23 @@ class WorkerAgent:
 
     def make_control_app(self) -> web.Application:
         allowed = set(self.known_orchestrators + self.known_validators)
+        if not allowed:
+            # Fail closed: with no configured orchestrator/validator
+            # allowlist, derive it from the substrate exactly like the
+            # reference (cli/command.rs:717-734): pool creator + compute
+            # manager + every wallet holding the validator role
+            # (prime_network.get_validator_role) — never "any valid
+            # signature". If the lookup fails the surface rejects all.
+            try:
+                pool = self.ledger.get_pool_info(self.pool_id)
+                allowed = {pool.creator, pool.compute_manager_key}
+                allowed.update(self.ledger.get_validator_role())
+            except Exception:
+                allowed = set()
         app = web.Application(
             middlewares=[
                 validate_signature_middleware(
-                    self.kv, ["/control"], allowed_addresses=allowed or None
+                    self.kv, ["/control"], allowed_addresses=allowed
                 )
             ]
         )

@@ -123,6 +123,46 @@ class TestControlProxies:
             assert data[a.node_wallet.address] == [f"member-{i}"]
 
 
+class TestControlSurfaceAuth:
+    def test_no_allowlist_fails_closed_to_pool_manager(self):
+        """With no configured orchestrator/validator allowlist the /control
+        surface must NOT accept arbitrary valid wallet signatures: it derives
+        the allowlist from the pool on the ledger (creator + compute manager),
+        mirroring worker/src/p2p/mod.rs:320-322."""
+        from protocol_tpu.security import Wallet
+        from protocol_tpu.security.signer import sign_request
+
+        ledger, creator, manager, provider, node, pid = make_world()
+
+        async def flow():
+            async with aiohttp.ClientSession() as session:
+                agent = WorkerAgent(
+                    provider, node, ledger, pid,
+                    runtime=SubprocessRuntime(),
+                    http=session,
+                    # no known_orchestrators / known_validators configured
+                )
+                agent.runtime.logs.append("secret")
+                validator_w = Wallet.from_seed(b"roled-validator")
+                ledger.grant_validator_role(validator_w.address)
+                async with TestClient(TestServer(agent.make_control_app())) as c:
+                    stranger = Wallet.from_seed(b"stranger")
+                    h_bad, _ = sign_request("/control/logs", stranger)
+                    r_bad = await c.get("/control/logs", headers=h_bad)
+                    h_mgr, _ = sign_request("/control/logs", manager)
+                    r_mgr = await c.get("/control/logs", headers=h_mgr)
+                    # wallets holding the on-ledger validator role are allowed
+                    # (reference cli/command.rs:717-734 get_validator_role)
+                    h_val, _ = sign_request("/control/logs", validator_w)
+                    r_val = await c.get("/control/logs", headers=h_val)
+                    return r_bad.status, r_mgr.status, r_val.status
+
+        bad, ok, val = run(flow())
+        assert bad == 401
+        assert ok == 200
+        assert val == 200
+
+
 class TestLocationResolvers:
     def test_static_table_and_prefix(self):
         paris = NodeLocation(latitude=48.85, longitude=2.35, city="Paris")

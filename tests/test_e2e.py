@@ -249,18 +249,18 @@ def test_full_lifecycle(cluster_results):
             w0 = workers[0]
             w0.orchestrator_url = c["orch_url"]
             w0.metrics[("t", "loss")] = 0.5
-            assert await w0.submit_output(sha="shaX", flops=777, file_name="out.parquet")
-            info = ledger.get_work_info(pid, "shaX")
+            assert await w0.submit_output(sha="fa" * 32, flops=777, file_name="out.parquet")
+            info = ledger.get_work_info(pid, "fa" * 32)
             assert info is not None and info.work_units == 777
 
             # 8. upload mapping exists; validator validates the work (§3.6)
-            assert await c["storage"].resolve_mapping_for_sha("shaX") == "out.parquet"
+            assert await c["storage"].resolve_mapping_for_sha("fa" * 32) == "out.parquet"
             await validator.validation_loop_once()  # trigger
             await validator.validation_loop_once()  # poll
             assert (
-                validator.synthetic.get_status("shaX") == ValidationResult.ACCEPT
+                validator.synthetic.get_status("fa" * 32) == ValidationResult.ACCEPT
             )
-            assert not ledger.get_work_info(pid, "shaX").invalidated
+            assert not ledger.get_work_info(pid, "fa" * 32).invalidated
 
             # 9. metrics flowed through the heartbeat into the store
             for agent in workers:

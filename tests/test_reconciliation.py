@@ -103,6 +103,35 @@ def test_rule4_inactive_grace():
     assert svc3.store.node_store.get_node("0xc").status == NodeStatus.EJECTED
 
 
+def test_rule6_dead_to_discovered_emits_webhook_and_refreshes_specs():
+    """The Dead -> Discovered recovery must route through _set_status so
+    webhook observers see it like every other transition (monitor.rs:359-383),
+    and must absorb the refreshed compute specs from discovery."""
+    from protocol_tpu.models import ComputeSpecs, CpuSpecs
+
+    d = dn("0xa", last_updated=time.time() + 5)
+    d.node.compute_specs = ComputeSpecs(cpu=CpuSpecs(cores=64), ram_mb=1)
+    svc = svc_with(
+        [OrchestratorNode(address="0xa", status=NodeStatus.DEAD,
+                          last_status_change=time.time() - 30)],
+        [d],
+    )
+    events = []
+
+    class Hook:
+        def handle_status_change(self, addr, old, new):
+            events.append((addr, old, new))
+
+    svc.webhook = Hook()
+    run(svc.discovery_monitor_once())
+    node = svc.store.node_store.get_node("0xa")
+    assert node.status == NodeStatus.DISCOVERED
+    assert node.compute_specs is not None and node.compute_specs.cpu.cores == 64
+    assert (
+        "0xa", NodeStatus.DEAD.value, NodeStatus.DISCOVERED.value
+    ) in events
+
+
 def test_rule8_new_node_skipped_when_endpoint_taken():
     svc = svc_with(
         [OrchestratorNode(address="0xhealthy", ip_address="9.9.9.9", port=80,

@@ -162,27 +162,55 @@ class TestDiscovery:
 
         run(flow())
 
-    def test_per_ip_cap(self):
+    def _join_pool(self, ledger, manager, provider, node_addr, pid):
+        from protocol_tpu.chain.ledger import invite_digest
+
+        ledger.validate_node(node_addr)
+        exp = time.time() + 60
+        sig = manager.sign_message(invite_digest(0, pid, node_addr, "n", exp))
+        ledger.join_compute_pool(pid, provider.address, node_addr, "n", exp, sig)
+
+    def test_per_ip_cap_counts_only_active_nodes(self):
+        """Reference semantics (node_store.rs:55-75): only pool-ACTIVE nodes
+        consume the per-IP cap. Plain registrations never hit it; once the
+        cap's worth of nodes on an IP are active, further registrations are
+        rejected; and a node leaving the pool frees its slot."""
         ledger, creator, manager, provider, node, pid = make_world()
-        for i in range(2, 5):
+        for i in range(2, 6):
             w = Wallet.from_seed(f"node-{i}".encode())
             ledger.add_compute_node(provider.address, w.address)
         svc = DiscoveryService(ledger, pid, max_nodes_per_ip=2)
 
+        def register(client, i):
+            w = Wallet.from_seed(f"node-{i}".encode())
+            payload = self._node_payload(w, provider, pid)
+            headers, body = sign_request("/api/nodes", w, payload)
+            return client.put("/api/nodes", json=body, headers=headers)
+
         async def flow():
             async with TestClient(TestServer(svc.make_app())) as client:
-                statuses = []
-                for i in [1, 2, 3, 4]:
+                # inactive registrations do NOT consume the cap
+                first = [(await register(client, i)).status for i in [1, 2, 3]]
+                # activate nodes 1+2 (join pool, then chain sync)
+                for i in [1, 2]:
                     w = Wallet.from_seed(f"node-{i}".encode())
-                    payload = self._node_payload(w, provider, pid)
-                    headers, body = sign_request("/api/nodes", w, payload)
-                    r = await client.put("/api/nodes", json=body, headers=headers)
-                    statuses.append(r.status)
-                return statuses
+                    self._join_pool(ledger, manager, provider, w.address, pid)
+                svc.chain_sync_once()
+                # cap reached: a new registration on the same IP is rejected
+                rejected = (await register(client, 4)).status
+                # an ACTIVE node may still re-register (p2p fixups)
+                rereg = (await register(client, 1)).status
+                # node-1 leaves the pool -> slot freed
+                ledger.leave_compute_pool(pid, Wallet.from_seed(b"node-1").address)
+                svc.chain_sync_once()
+                freed = (await register(client, 4)).status
+                return first, rejected, rereg, freed
 
-        statuses = run(flow())
-        assert statuses[:2] == [200, 200]
-        assert 429 in statuses[2:]
+        first, rejected, rereg, freed = run(flow())
+        assert first == [200, 200, 200]
+        assert rejected == 429
+        assert rereg == 200
+        assert freed == 200
 
     def test_platform_requires_api_key(self):
         ledger, *_, pid = make_world()
@@ -293,7 +321,7 @@ class TestOrchestratorRoutes:
                     "file_name": "out.parquet",
                     "file_size": 1024,
                     "file_type": "application/octet-stream",
-                    "sha256": "abc123",
+                    "sha256": "ab"*32,
                 }
                 headers, body = sign_request(
                     "/storage/request-upload", node, payload
@@ -306,7 +334,7 @@ class TestOrchestratorRoutes:
 
         data = run(flow())
         assert data["signed_url"].startswith("mock://upload/")
-        assert run(svc.storage.resolve_mapping_for_sha("abc123")) == "out.parquet"
+        assert run(svc.storage.resolve_mapping_for_sha("ab"*32)) == "out.parquet"
 
     def test_storage_rate_limit(self):
         svc, node, _ = self._svc()
@@ -323,7 +351,7 @@ class TestOrchestratorRoutes:
                         "file_name": "f",
                         "file_size": 1,
                         "file_type": "x",
-                        "sha256": "s",
+                        "sha256": "5a"*32,
                     }
                     headers, body = sign_request(
                         "/storage/request-upload", node, payload
@@ -562,6 +590,73 @@ class TestSyntheticValidation:
         sv = run(flow())
         assert sv.get_status("sha-0") == ValidationResult.ACCEPT
         assert sv.get_status("sha-1") == ValidationResult.REJECT
+
+    def _second_node(self, ledger, provider):
+        node2 = Wallet.from_seed(b"node-2")
+        ledger.add_compute_node(provider.address, node2.address)
+        return node2
+
+    def test_group_work_units_summed_accepts_honest_members(self):
+        # Each member claims a FRACTION of the group total; the check must
+        # sum claims across the group (mod.rs:972-1090), not compare each
+        # member's claim to the group-level output_flops.
+        ledger, creator, manager, provider, node, pid = make_world()
+        node2 = self._second_node(ledger, provider)
+        storage = MockStorageProvider()
+        results = {
+            f"out-g3-2-0-{i}.parquet": {"status": "Accept", "output_flops": 100}
+            for i in range(2)
+        }
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(ledger, pid, storage, [toploc])
+                self._submit(ledger, manager, provider, node, pid, "sha-0", units=50)
+                self._submit(ledger, manager, provider, node2, pid, "sha-1", units=50)
+                await storage.generate_mapping_file("sha-0", "out-g3-2-0-0.parquet")
+                await storage.generate_mapping_file("sha-1", "out-g3-2-0-1.parquet")
+                await sv.validate_work_once()  # collect + trigger group
+                await sv.validate_work_once()  # poll
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-0") == ValidationResult.ACCEPT
+        assert sv.get_status("sha-1") == ValidationResult.ACCEPT
+        assert not ledger.get_work_info(pid, "sha-0").soft_invalidated
+        assert not ledger.get_work_info(pid, "sha-1").soft_invalidated
+
+    def test_group_work_units_mismatch_penalizes_only_deviating_node(self):
+        # total claimed 130 vs toploc 100 -> mismatch; expected per node is
+        # 50, so only the node claiming 80 is soft-invalidated
+        # (mod.rs:1059-1095, 1327-1343).
+        ledger, creator, manager, provider, node, pid = make_world()
+        node2 = self._second_node(ledger, provider)
+        storage = MockStorageProvider()
+        results = {
+            f"out-g4-2-0-{i}.parquet": {"status": "Accept", "output_flops": 100}
+            for i in range(2)
+        }
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(ledger, pid, storage, [toploc])
+                self._submit(ledger, manager, provider, node, pid, "sha-0", units=50)
+                self._submit(ledger, manager, provider, node2, pid, "sha-1", units=80)
+                await storage.generate_mapping_file("sha-0", "out-g4-2-0-0.parquet")
+                await storage.generate_mapping_file("sha-1", "out-g4-2-0-1.parquet")
+                await sv.validate_work_once()
+                await sv.validate_work_once()
+                return sv
+
+        sv = run(flow())
+        assert sv.get_status("sha-0") == ValidationResult.ACCEPT
+        assert sv.get_status("sha-1") == ValidationResult.WORK_MISMATCH
+        assert not ledger.get_work_info(pid, "sha-0").soft_invalidated
+        assert ledger.get_work_info(pid, "sha-1").soft_invalidated
 
     def test_incomplete_group_grace_soft_invalidation(self):
         ledger, creator, manager, provider, node, pid = make_world()

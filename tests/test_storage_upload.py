@@ -25,7 +25,9 @@ def test_full_signed_upload_loop():
     async def flow():
         with tempfile.TemporaryDirectory() as root:
             storage = LocalDirStorageProvider(root, public_base_url="http://x")
-            svc = OrchestratorService(ledger, pid, manager, storage=storage)
+            svc = OrchestratorService(
+                ledger, pid, manager, storage=storage, uploads_per_hour=100
+            )
             svc.store.node_store.add_node(
                 OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
             )
@@ -34,7 +36,7 @@ def test_full_signed_upload_loop():
                     "file_name": "artifact.bin",
                     "file_size": 11,
                     "file_type": "application/octet-stream",
-                    "sha256": "deadbeef",
+                    "sha256": "de"*32,
                 }
                 headers, body = sign_request("/storage/request-upload", node, payload)
                 r = await client.post(
@@ -49,7 +51,7 @@ def test_full_signed_upload_loop():
 
                 # artifact landed; the validator can resolve the mapping
                 assert await storage.file_exists("artifact.bin")
-                assert await storage.resolve_mapping_for_sha("deadbeef") == "artifact.bin"
+                assert await storage.resolve_mapping_for_sha("de"*32) == "artifact.bin"
 
                 # tampered token rejected
                 r3 = await client.put(path_q[:-4] + "beef", data=b"x")
@@ -68,7 +70,7 @@ def test_full_signed_upload_loop():
                     "file_name": "big.bin",
                     "file_size": 5 * 1024 * 1024,
                     "file_type": "bin",
-                    "sha256": "b1b1",
+                    "sha256": "b1"*32,
                 }
                 h2, b2 = sign_request("/storage/request-upload", node, big_payload)
                 r5 = await client.post(
@@ -95,12 +97,92 @@ def test_full_signed_upload_loop():
                     "file_name": "../../etc/passwd",
                     "file_size": 1,
                     "file_type": "bin",
-                    "sha256": "ee",
+                    "sha256": "ee"*32,
                 }
                 h3, b3 = sign_request("/storage/request-upload", node, bad)
                 r7 = await client.post(
                     "/storage/request-upload", json=b3, headers=h3
                 )
                 assert r7.status == 400
+
+                # a non-hex sha (e.g. path traversal aimed at the mapping
+                # namespace) is rejected before any state is written
+                for sha in ("x/../" + "de" * 32, "de" * 8, "zz" * 32):
+                    h_s, b_s = sign_request(
+                        "/storage/request-upload", node,
+                        {"file_name": "n.bin", "file_size": 1,
+                         "file_type": "bin", "sha256": sha},
+                    )
+                    r_s = await client.post(
+                        "/storage/request-upload", json=b_s, headers=h_s
+                    )
+                    assert r_s.status == 400, sha
+
+                # the validator's mapping/ namespace is write-protected:
+                # a node must not mint signed URLs for resolution objects
+                for name in ("mapping/deadbeef", "x/../mapping/deadbeef"):
+                    h4, b4 = sign_request(
+                        "/storage/request-upload", node,
+                        {"file_name": name, "file_size": 1,
+                         "file_type": "bin", "sha256": "aa"*32},
+                    )
+                    r9 = await client.post(
+                        "/storage/request-upload", json=b4, headers=h4
+                    )
+                    assert r9.status == 400, name
+
+                # one sha, one owner: a second node cannot re-map a sha
+                # another node already claimed (would misdirect validation)
+                from protocol_tpu.security import Wallet
+
+                node2 = Wallet.from_seed(b"upload-node-2")
+                svc.store.node_store.add_node(
+                    OrchestratorNode(address=node2.address,
+                                     status=NodeStatus.HEALTHY)
+                )
+                steal = {
+                    "file_name": "steal.bin",
+                    "file_size": 1,
+                    "file_type": "bin",
+                    "sha256": "de"*32,  # node-1's pending work sha
+                }
+                h5, b5 = sign_request("/storage/request-upload", node2, steal)
+                r10 = await client.post(
+                    "/storage/request-upload", json=b5, headers=h5
+                )
+                assert r10.status == 409
+                # unchanged mapping
+                assert await storage.resolve_mapping_for_sha("de"*32) == "artifact.bin"
+                # ...but the owner may re-request its own sha
+                h6, b6 = sign_request(
+                    "/storage/request-upload", node,
+                    {"file_name": "artifact-v2.bin", "file_size": 1,
+                     "file_type": "bin", "sha256": "de"*32},
+                )
+                r11 = await client.post(
+                    "/storage/request-upload", json=b6, headers=h6
+                )
+                assert r11.status == 200
+
+                # a STALE claim (mapped object never uploaded — claimant
+                # crashed before its PUT) may be taken over by another node
+                h7, b7 = sign_request(
+                    "/storage/request-upload", node,
+                    {"file_name": "ghost.bin", "file_size": 1,
+                     "file_type": "bin", "sha256": "09" * 32},
+                )
+                assert (await client.post(
+                    "/storage/request-upload", json=b7, headers=h7
+                )).status == 200
+                # node never PUTs ghost.bin; node2 takes the sha over
+                h8, b8 = sign_request(
+                    "/storage/request-upload", node2,
+                    {"file_name": "revived.bin", "file_size": 1,
+                     "file_type": "bin", "sha256": "09" * 32},
+                )
+                assert (await client.post(
+                    "/storage/request-upload", json=b8, headers=h8
+                )).status == 200
+                assert await storage.resolve_mapping_for_sha("09" * 32) == "revived.bin"
 
     run(flow())
