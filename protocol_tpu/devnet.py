@@ -51,6 +51,13 @@ async def start_app(app: web.Application, port: int) -> web.AppRunner:
 async def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="protocol_tpu local devnet")
     parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument(
+        "--runtime",
+        choices=["subprocess", "docker"],
+        default="subprocess",
+        help="worker task runtime (docker mirrors the reference's container "
+        "execution model; requires a docker CLI on PATH)",
+    )
     parser.add_argument("--requirements", default="", help="pool requirements DSL")
     parser.add_argument("--admin-key", default="admin")
     parser.add_argument("--storage-dir", default="/tmp/protocol_tpu_storage")
@@ -216,12 +223,18 @@ async def main(argv=None) -> None:
         ledger.mint(provider.address, 1_000_000)
         wport = args.base_port + 10 + i
         socket_path = f"/tmp/protocol_tpu_worker_{i}/bridge.sock"
+        if args.runtime == "docker":
+            from protocol_tpu.services.docker_runtime import DockerRuntime
+
+            runtime = DockerRuntime(socket_path=socket_path)
+        else:
+            runtime = SubprocessRuntime(socket_path=socket_path)
         agent = WorkerAgent(
             provider_wallet=provider,
             node_wallet=node,
             ledger=ledger,
             pool_id=pid,
-            runtime=SubprocessRuntime(socket_path=socket_path),
+            runtime=runtime,
             compute_specs=specs,
             port=wport,
             http=session,

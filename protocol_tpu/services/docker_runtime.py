@@ -1,0 +1,340 @@
+"""Container task runtime driving the docker CLI.
+
+Counterpart of the reference worker's Docker execution model:
+
+  - crates/worker/src/docker/docker_manager.rs:1-850 — bollard client:
+    pull, create (GPU device requests, volumes, host networking unless
+    disabled, shm sizing), start/stop/remove/inspect/logs
+  - crates/worker/src/docker/service.rs:56-295 — 5 s reconcile loop:
+    container identity ``prime-task-{id}-{confighash}``, stale-container
+    removal, ${SOCKET_PATH} expansion, NODE_ADDRESS / PRIME_TASK_ID
+    injection, socket-dir + task volume mounts, shm = RAM/2, restart
+    backoff + consecutive-failure count, container status -> TaskState
+
+Instead of a daemon-API client library this drives the ``docker`` CLI
+through asyncio subprocesses: same lifecycle semantics, zero extra
+dependencies, and tests interpose a fake ``docker`` binary on PATH (the
+role bollard fakes play in the reference's tests). All state queries are
+cached at reconcile time so the synchronous ``state()`` contract of
+``TaskRuntime`` holds between ticks, like the reference's DockerState.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Optional
+
+from protocol_tpu.models.heartbeat import TaskDetails
+from protocol_tpu.models.task import Task, TaskState
+
+from .worker import RESTART_BACKOFF_SECONDS, TaskRuntime
+
+TASK_PREFIX = "prime-task"
+
+# container status -> TaskState (service.rs:267-281)
+_STATUS_MAP = {
+    "running": TaskState.RUNNING,
+    "created": TaskState.PENDING,
+    "dead": TaskState.FAILED,
+    "paused": TaskState.PAUSED,
+    "restarting": TaskState.RESTARTING,
+}
+
+
+class DockerCliError(RuntimeError):
+    pass
+
+
+class DockerCli:
+    """Minimal async wrapper over the docker CLI (the docker_manager.rs
+    surface this framework needs)."""
+
+    def __init__(self, docker_bin: str = "docker"):
+        self.docker_bin = docker_bin
+
+    async def _run(self, *args: str, check: bool = True) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            self.docker_bin,
+            *args,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate()
+        if check and proc.returncode != 0:
+            raise DockerCliError(
+                f"docker {' '.join(args[:2])} failed rc={proc.returncode}: "
+                f"{err.decode(errors='replace').strip()[:500]}"
+            )
+        return out.decode(errors="replace")
+
+    async def list_task_containers(self) -> list[str]:
+        """Names of all prime-task-* containers, running or not."""
+        out = await self._run(
+            "ps", "-a", "--filter", f"name={TASK_PREFIX}", "--format", "{{.Names}}"
+        )
+        return [line.strip() for line in out.splitlines() if line.strip()]
+
+    async def remove(self, name: str) -> None:
+        await self._run("rm", "-f", name, check=False)
+
+    async def restart(self, name: str) -> None:
+        await self._run("restart", name, check=False)
+
+    async def logs(self, name: str, tail: int = 100) -> str:
+        return await self._run("logs", "--tail", str(tail), name, check=False)
+
+    async def inspect_state(self, name: str) -> Optional[dict]:
+        """{'status': str, 'exit_code': int, 'id': str, 'image': str} or
+        None when the container does not exist."""
+        out = await self._run(
+            "inspect",
+            "--format",
+            '{"status":"{{.State.Status}}","exit_code":{{.State.ExitCode}},'
+            '"id":"{{.Id}}","image":"{{.Config.Image}}"}',
+            name,
+            check=False,
+        )
+        out = out.strip()
+        if not out.startswith("{"):
+            return None
+        try:
+            return json.loads(out)
+        except json.JSONDecodeError:
+            return None
+
+    async def run_detached(
+        self,
+        name: str,
+        image: str,
+        cmd: list[str],
+        env: dict[str, str],
+        volumes: list[tuple[str, str, bool]],  # (host, container, read_only)
+        shm_size_bytes: Optional[int] = None,
+        gpu_device_ids: Optional[list[str]] = None,
+        entrypoint: Optional[list[str]] = None,
+        host_network: bool = True,
+    ) -> str:
+        """docker run -d with the reference's HostConfig surface
+        (docker_manager.rs:397-440): host networking by default, GPU
+        device requests, shm sizing, bind mounts."""
+        args: list[str] = ["run", "-d", "--name", name]
+        if host_network:
+            args += ["--network", "host"]
+        if shm_size_bytes:
+            args += ["--shm-size", str(shm_size_bytes)]
+        if gpu_device_ids is not None:
+            spec = (
+                "all"
+                if not gpu_device_ids
+                else "device=" + ",".join(gpu_device_ids)
+            )
+            args += ["--gpus", spec]
+        for key, value in env.items():
+            args += ["-e", f"{key}={value}"]
+        for host, container, read_only in volumes:
+            args += ["-v", f"{host}:{container}" + (":ro" if read_only else "")]
+        full_cmd = list(cmd)
+        if entrypoint:
+            # CLI --entrypoint takes one binary; extra entrypoint args are
+            # prepended to the command (same process argv as the API path)
+            args += ["--entrypoint", entrypoint[0]]
+            full_cmd = list(entrypoint[1:]) + full_cmd
+        args.append(image)
+        args += full_cmd
+        out = await self._run(*args)
+        return out.strip()
+
+
+class DockerRuntime(TaskRuntime):
+    """TaskRuntime backed by containers (docker/service.rs semantics)."""
+
+    def __init__(
+        self,
+        socket_path: Optional[str] = None,
+        docker_bin: str = "docker",
+        system_memory_mb: Optional[int] = None,
+        gpu_device_ids: Optional[list[str]] = None,  # None = no GPU request
+        host_network: bool = True,
+    ):
+        self.cli = DockerCli(docker_bin)
+        self.socket_path = socket_path
+        self.system_memory_mb = system_memory_mb
+        self.gpu_device_ids = gpu_device_ids
+        self.host_network = host_network
+
+        self.current: Optional[Task] = None
+        self.failures = 0
+        self.last_started = 0.0
+        self.logs: list[str] = []
+        self._diag: list[str] = []  # start/daemon errors, kept across ticks
+        self._scope: Optional[str] = None  # per-node container namespace
+        self._cached_state: tuple[Optional[str], TaskState, Optional[TaskDetails]] = (
+            None,
+            TaskState.UNKNOWN,
+            None,
+        )
+        self._current_name: Optional[str] = None
+        self._last_task_state: Optional[TaskState] = None
+
+    # container identity: node scope + task id + config hash, so any
+    # env/cmd/image change is a different container (service.rs:69-74).
+    # The node scope keeps workers sharing one docker daemon (devnet) from
+    # reconciling away each other's containers — the reference assumes one
+    # worker per dockerd and needs no scope.
+    def _name_prefix(self) -> str:
+        return f"{TASK_PREFIX}-{self._scope}" if self._scope else TASK_PREFIX
+
+    def container_name(self, task: Task) -> str:
+        return f"{self._name_prefix()}-{task.id}-{task.generate_config_hash()[:16]}"
+
+    async def apply(self, task: Optional[Task], node_address: str) -> None:
+        self.current = task
+        self._scope = node_address[-8:].lower() if node_address else None
+        await self.reconcile_once(node_address)
+
+    async def reconcile_once(self, node_address: str) -> None:
+        """One reconcile tick (service.rs:56-295): remove stale task
+        containers, start the current task's container if absent (with
+        restart backoff), refresh the cached state from docker."""
+        task = self.current
+        expected = self.container_name(task) if task else None
+        if expected != self._current_name:
+            # task identity changed: per-task counters restart
+            self._current_name = expected
+            self._last_task_state = None
+            self.failures = 0
+        try:
+            names = await self.cli.list_task_containers()
+        except (DockerCliError, OSError) as e:
+            self._diag.append(f"docker unavailable: {e}")
+            # never report the previous container's state while blind
+            self._cached_state = (
+                task.id if task else None,
+                TaskState.UNKNOWN,
+                TaskDetails(error_message=str(e)[:500]) if task else None,
+            )
+            self._compose_logs(None)
+            return
+
+        prefix = self._name_prefix()
+        for name in names:
+            if name.startswith(prefix) and name != expected:
+                await self.cli.remove(name)
+
+        if task is None or expected is None:
+            self._cached_state = (None, TaskState.UNKNOWN, None)
+            return
+
+        state = await self.cli.inspect_state(expected)
+        if state is None:
+            # container missing -> start, honoring the restart backoff
+            # (service.rs:160-175)
+            if time.monotonic() - self.last_started < RESTART_BACKOFF_SECONDS:
+                self._cached_state = (task.id, TaskState.PENDING, None)
+                return
+            await self._start(task, expected, node_address)
+            state = await self.cli.inspect_state(expected)
+
+        self._refresh_cache(task, state)
+        try:
+            self._compose_logs(await self.cli.logs(expected))
+        except (DockerCliError, OSError):
+            pass
+
+    def _compose_logs(self, raw: Optional[str]) -> None:
+        """Container logs plus retained runtime diagnostics, so /logs still
+        explains past start failures after the container is recreated."""
+        self._diag = self._diag[-100:]
+        lines = raw.splitlines()[-1000:] if raw else []
+        self.logs = self._diag + lines
+
+    async def _start(self, task: Task, name: str, node_address: str) -> None:
+        sock = self.socket_path or ""
+        expand = lambda s: s.replace("${SOCKET_PATH}", sock)  # noqa: E731
+
+        cmd = [expand(c) for c in (task.cmd or [])]
+        if not cmd and not task.entrypoint:
+            # idle placeholder only when the task specifies NO process at
+            # all (service.rs:184-188); with an entrypoint, leave argv empty
+            cmd = ["sleep", "infinity"]
+        env = {k: expand(v) for k, v in (task.env_vars or {}).items()}
+        env["NODE_ADDRESS"] = node_address
+        env["PRIME_TASK_ID"] = str(task.id)
+        volumes: list[tuple[str, str, bool]] = []
+        if sock:
+            env["PRIME_MONITOR__SOCKET__PATH"] = sock
+            sock_dir = os.path.dirname(sock)
+            volumes.append((sock_dir, sock_dir, False))
+        for vm in task.volume_mounts or []:
+            volumes.append((vm.host_path, vm.container_path, False))
+        # shm = RAM/2 (service.rs:222-228); 64 MB default like the reference
+        shm = (
+            self.system_memory_mb * 1024 * 1024 // 2
+            if self.system_memory_mb
+            else 64 * 1024 * 1024
+        )
+        self.last_started = time.monotonic()
+        try:
+            await self.cli.run_detached(
+                name,
+                task.image,
+                cmd,
+                env,
+                volumes,
+                shm_size_bytes=shm,
+                gpu_device_ids=self.gpu_device_ids,
+                entrypoint=task.entrypoint,
+                host_network=self.host_network,
+            )
+        except (DockerCliError, OSError) as e:
+            self._diag.append(f"container start failed: {e}")
+            self._compose_logs(None)
+            self.failures += 1
+            self._cached_state = (
+                task.id,
+                TaskState.FAILED,
+                TaskDetails(error_message=str(e)[:500]),
+            )
+
+    def _refresh_cache(self, task: Task, state: Optional[dict]) -> None:
+        if state is None:
+            return  # start already cached FAILED, or PENDING backoff
+        status = state.get("status", "")
+        exit_code = state.get("exit_code")
+        if status == "exited":
+            ts = (
+                TaskState.COMPLETED
+                if exit_code == 0
+                else (TaskState.FAILED if exit_code is not None else TaskState.UNKNOWN)
+            )
+        else:
+            ts = _STATUS_MAP.get(status, TaskState.UNKNOWN)
+        # consecutive-failure counting on state CHANGES (service.rs:283-295)
+        if ts != self._last_task_state:
+            if ts == TaskState.FAILED:
+                self.failures += 1
+            elif ts == TaskState.RUNNING:
+                self.failures = 0
+            self._last_task_state = ts
+        self._cached_state = (
+            task.id,
+            ts,
+            TaskDetails(
+                container_id=state.get("id"),
+                container_status=status,
+                exit_code=exit_code if status == "exited" else None,
+            ),
+        )
+
+    async def restart_task(self) -> None:
+        """Explicit restart of the current container (service.rs:332-343)."""
+        if self.current is not None:
+            await self.cli.restart(self.container_name(self.current))
+
+    def state(self) -> tuple[Optional[str], TaskState, Optional[TaskDetails]]:
+        if self.current is None:
+            return None, TaskState.UNKNOWN, None
+        return self._cached_state

@@ -582,7 +582,13 @@ class WorkerAgent:
         return web.json_response({"success": True, "logs": logs[-100:]})
 
     async def handle_restart(self, request: web.Request) -> web.Response:
-        if self.current_task is not None:
+        restart = getattr(self.runtime, "restart_task", None)
+        if restart is not None:
+            # runtimes with an in-place restart (DockerRuntime -> docker
+            # restart, service.rs:332-343) keep the container identity and
+            # avoid the remove->backoff window a stop/start cycle would hit
+            await restart()
+        elif self.current_task is not None:
             await self.runtime.apply(None, self.node_wallet.address)
             await self.runtime.apply(self.current_task, self.node_wallet.address)
         return web.json_response({"success": True})

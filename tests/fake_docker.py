@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Fake ``docker`` CLI for DockerRuntime tests (the role bollard fakes
+play in the reference's worker tests — no dockerd in CI).
+
+State lives in $FAKE_DOCKER_STATE (a JSON file). Supported subcommands:
+ps -a, run -d, rm -f, restart, logs, inspect. Containers "run" until
+stopped; an env var FAKE_EXIT=<n> on the container makes it exit
+immediately with that code (simulating a crashing or completing task).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"containers": {}, "calls": []}
+
+
+def save(path, state):
+    with open(path, "w") as f:
+        json.dump(state, f, indent=1)
+
+
+def main() -> int:
+    path = os.environ["FAKE_DOCKER_STATE"]
+    state = load(path)
+    argv = sys.argv[1:]
+    state["calls"].append(argv)
+    cmd = argv[0] if argv else ""
+
+    if cmd == "ps":
+        for name in state["containers"]:
+            print(name)
+    elif cmd == "rm":
+        name = argv[-1]
+        state["containers"].pop(name, None)
+    elif cmd == "restart":
+        name = argv[-1]
+        c = state["containers"].get(name)
+        if c:
+            c["status"] = "running"
+            c["exit_code"] = 0
+    elif cmd == "logs":
+        name = argv[-1]
+        c = state["containers"].get(name)
+        if c:
+            print(f"log line from {name}")
+    elif cmd == "inspect":
+        name = argv[-1]
+        c = state["containers"].get(name)
+        if c is None:
+            print(f"Error: No such object: {name}", file=sys.stderr)
+            save(path, state)
+            return 1
+        print(json.dumps({
+            "status": c["status"],
+            "exit_code": c["exit_code"],
+            "id": c["id"],
+            "image": c["image"],
+        }))
+    elif cmd == "run":
+        # parse the docker run surface DockerRuntime emits
+        it = iter(argv[1:])
+        c = {"env": {}, "volumes": [], "flags": [], "cmd": [],
+             "entrypoint": None, "status": "running", "exit_code": 0,
+             "image": "", "id": f"cid-{int(time.time() * 1000) % 100000}"}
+        name = ""
+        positionals = []
+        for a in it:
+            if a == "--name":
+                name = next(it)
+            elif a == "-e":
+                k, _, v = next(it).partition("=")
+                c["env"][k] = v
+            elif a == "-v":
+                c["volumes"].append(next(it))
+            elif a in ("--network", "--shm-size", "--gpus", "--entrypoint"):
+                c["flags"].append((a, next(it)))
+                if a == "--entrypoint":
+                    c["entrypoint"] = c["flags"][-1][1]
+            elif a == "-d":
+                continue
+            else:
+                positionals.append(a)
+        c["image"] = positionals[0] if positionals else ""
+        c["cmd"] = positionals[1:]
+        if "FAKE_EXIT" in c["env"]:
+            c["status"] = "exited"
+            c["exit_code"] = int(c["env"]["FAKE_EXIT"])
+        state["containers"][name] = c
+        print(c["id"])
+    else:
+        print(f"fake docker: unknown command {cmd}", file=sys.stderr)
+        save(path, state)
+        return 1
+
+    save(path, state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
