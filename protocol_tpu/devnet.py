@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 
 import aiohttp
@@ -61,6 +62,12 @@ async def main(argv=None) -> None:
     parser.add_argument("--requirements", default="", help="pool requirements DSL")
     parser.add_argument("--admin-key", default="admin")
     parser.add_argument("--storage-dir", default="/tmp/protocol_tpu_storage")
+    parser.add_argument(
+        "--state-dir",
+        default="",
+        help="persist discovery/orchestrator state here (AOF journals); "
+        "empty = volatile, as before",
+    )
     parser.add_argument("--base-port", type=int, default=8089)
     parser.add_argument(
         "--group-configs",
@@ -110,12 +117,26 @@ async def main(argv=None) -> None:
     runners.append(await start_app(ledger_api.make_app(), lport))
 
     # ---- discovery
-    discovery = DiscoveryService(ledger, pid, admin_api_key=args.admin_key)
+    discovery = DiscoveryService(
+        ledger,
+        pid,
+        admin_api_key=args.admin_key,
+        persist_path=(
+            os.path.join(args.state_dir, "discovery.aof") if args.state_dir else None
+        ),
+    )
     runners.append(await start_app(discovery.make_app(), dport))
     discovery_url = f"http://127.0.0.1:{dport}"
 
     # ---- orchestrator
-    store = StoreContext.new_test()
+    if args.state_dir:
+        from protocol_tpu.store.kv import KVStore
+
+        store = StoreContext(
+            KVStore(persist_path=os.path.join(args.state_dir, "orchestrator.aof"))
+        )
+    else:
+        store = StoreContext.new_test()
     groups_plugin = None
     if args.group_configs:
         configs = [

@@ -100,10 +100,11 @@ class DiscoveryService:
         max_nodes_per_ip: int = 5,
         admin_api_key: str = "admin",
         location_resolver: Optional[LocationResolver] = None,
+        persist_path: Optional[str] = None,
     ):
         self.ledger = ledger
         self.pool_id = pool_id
-        self.kv = kv or KVStore()
+        self.kv = kv or KVStore(persist_path=persist_path)
         self.store = DiscoveryNodeStore(self.kv)
         self.max_nodes_per_ip = max_nodes_per_ip
         self.admin_api_key = admin_api_key

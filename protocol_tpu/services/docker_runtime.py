@@ -229,6 +229,19 @@ class DockerRuntime(TaskRuntime):
             return
 
         state = await self.cli.inspect_state(expected)
+        if state is not None and state.get("status") == "exited" and state.get(
+            "exit_code"
+        ):
+            # crashed container: count the failure, then remove + restart
+            # once past the backoff (SubprocessRuntime semantics; the
+            # reference leaves crashed containers dead until an operator
+            # /restart — restarting with backoff strictly improves on that)
+            self._refresh_cache(task, state)
+            if time.monotonic() - self.last_started < RESTART_BACKOFF_SECONDS:
+                return
+            await self.cli.remove(expected)
+            state = None
+
         if state is None:
             # container missing -> start, honoring the restart backoff
             # (service.rs:160-175)
@@ -239,10 +252,6 @@ class DockerRuntime(TaskRuntime):
             state = await self.cli.inspect_state(expected)
 
         self._refresh_cache(task, state)
-        try:
-            self._compose_logs(await self.cli.logs(expected))
-        except (DockerCliError, OSError):
-            pass
 
     def _compose_logs(self, raw: Optional[str]) -> None:
         """Container logs plus retained runtime diagnostics, so /logs still
@@ -250,6 +259,19 @@ class DockerRuntime(TaskRuntime):
         self._diag = self._diag[-100:]
         lines = raw.splitlines()[-1000:] if raw else []
         self.logs = self._diag + lines
+
+    async def get_logs(self) -> list[str]:
+        """On-demand container logs (+diagnostics) for the /control/logs
+        surface; logs are NOT fetched every reconcile tick — that would
+        fork a docker subprocess per heartbeat for output nobody reads."""
+        if self.current is not None:
+            try:
+                self._compose_logs(
+                    await self.cli.logs(self.container_name(self.current))
+                )
+            except (DockerCliError, OSError):
+                self._compose_logs(None)
+        return self.logs
 
     async def _start(self, task: Task, name: str, node_address: str) -> None:
         sock = self.socket_path or ""

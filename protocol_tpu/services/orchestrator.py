@@ -93,11 +93,20 @@ class OrchestratorService:
         heartbeat_url: str = "http://localhost:8090",
         webhook=None,  # WebhookPlugin (plugins/webhook/mod.rs)
         control_http=None,  # aiohttp session for worker control-plane calls
+        persist_path: Optional[str] = None,
     ):
         self.ledger = ledger
         self.pool_id = pool_id
         self.wallet = wallet
-        self.store = store or StoreContext.new_test()
+        if store is None:
+            # persist_path gives the coordinator the reference's
+            # restart-survival property (Redis outliving the process,
+            # store/core/redis.rs:38-72): nodes/tasks/groups/heartbeat
+            # state journal to disk and reload on boot
+            from protocol_tpu.store.kv import KVStore
+
+            store = StoreContext(KVStore(persist_path=persist_path))
+        self.store = store
         self.scheduler = scheduler or Scheduler(self.store)
         self.groups_plugin = groups_plugin
         self.storage = storage

@@ -136,12 +136,13 @@ class SyntheticDataValidator:
         penalty: int = 10,
         grace_period: float = 300.0,
         work_window: float = 3600.0,
+        persist_path: Optional[str] = None,
     ):
         self.ledger = ledger
         self.pool_id = pool_id
         self.storage = storage
         self.clients = toploc_clients
-        self.kv = kv or KVStore()
+        self.kv = kv or KVStore(persist_path=persist_path)
         self.penalty = penalty
         self.grace_period = grace_period
         self.work_window = work_window

@@ -578,7 +578,8 @@ class WorkerAgent:
         return web.json_response({"success": True, "result": result})
 
     async def handle_logs(self, request: web.Request) -> web.Response:
-        logs = getattr(self.runtime, "logs", [])
+        fetch = getattr(self.runtime, "get_logs", None)
+        logs = await fetch() if fetch is not None else getattr(self.runtime, "logs", [])
         return web.json_response({"success": True, "logs": logs[-100:]})
 
     async def handle_restart(self, request: web.Request) -> web.Response:

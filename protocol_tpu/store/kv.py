@@ -1,4 +1,4 @@
-"""In-process KV store with Redis semantics.
+"""In-process KV store with Redis semantics and optional durability.
 
 The reference talks to a real Redis from every service and spawns an embedded
 redis-server per test (orchestrator/src/store/core/redis.rs:38-72). This
@@ -19,22 +19,187 @@ Lazy TTL expiry against a monotonic clock; a ``time_fn`` hook makes expiry
 deterministic in tests. Keys are strings, values are strings (callers do
 their own JSON), mirroring the wire-level Redis model so a networked Redis
 backend could be slotted in behind the same interface later.
+
+Durability (``persist_path``): the reference's services resume statelessly
+because Redis outlives the process (redis.rs:38-72). With a persist path,
+every mutation is appended to a JSON-lines journal (Redis-AOF style,
+line-buffered so a killed process loses at most the in-flight line) and
+replayed at construction; the journal is compacted to a minimal op
+sequence at load and when it grows past ``compact_threshold`` entries.
+TTLs are journaled as absolute wall-clock deadlines so they keep their
+meaning across restarts (a persistent store therefore defaults to
+``time.time`` rather than the monotonic clock).
 """
 
 from __future__ import annotations
 
 import fnmatch
+import functools
+import json
+import os
 import threading
 import time
 from typing import Callable, Iterable, Optional
 
 
+def _journaled(fn):
+    """Decorator for mutating methods: append (method, args) to the journal
+    after the outermost successful call. Nested journaled calls (e.g.
+    ``hdel`` -> ``delete``) are not journaled — replaying the outer op
+    reproduces them."""
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if self._journal is None:
+            return fn(self, *args, **kwargs)
+        with self._lock:
+            self._jdepth += 1
+            try:
+                out = fn(self, *args, **kwargs)
+            finally:
+                self._jdepth -= 1
+            # no-op writes are NOT journaled: a failed SET NX / EXPIRE on a
+            # missing key mutated nothing, and replaying it (especially an
+            # expired SET-with-TTL, which replay resolves by deleting the
+            # key) would corrupt state that the original call never touched
+            if self._jdepth == 0 and not (name in ("set", "expire") and not out):
+                self._journal_append(name, args, kwargs)
+            return out
+
+    return wrapper
+
+
 class KVStore:
-    def __init__(self, time_fn: Callable[[], float] = time.monotonic):
+    def __init__(
+        self,
+        time_fn: Optional[Callable[[], float]] = None,
+        persist_path: Optional[str] = None,
+        compact_threshold: int = 100_000,
+    ):
         self._lock = threading.RLock()
         self._data: dict[str, object] = {}
         self._expiry: dict[str, float] = {}
-        self._time = time_fn
+        # persistence needs wall-clock TTLs; in-memory stays monotonic
+        self._time = time_fn or (time.time if persist_path else time.monotonic)
+        self._journal = None
+        self._jdepth = 0
+        self._journal_ops = 0
+        self._compact_threshold = compact_threshold
+        self._persist_path = persist_path
+        if persist_path is not None:
+            os.makedirs(os.path.dirname(persist_path) or ".", exist_ok=True)
+            if os.path.exists(persist_path):
+                self._replay(persist_path)
+            self._compact()  # also (re)opens the journal for appending
+
+    # ------------- persistence -------------
+
+    def _journal_append(self, method: str, args: tuple, kwargs: dict) -> None:
+        entry: dict = {"m": method, "a": list(args)}
+        kw = dict(kwargs)
+        # TTLs become absolute wall deadlines (restart-stable)
+        if method == "set" and kw.get("ex") is not None:
+            kw["abs_ex"] = self._time() + kw.pop("ex")
+        if method == "expire":
+            # expire(key, seconds) — seconds may be positional
+            seconds = kw.pop("seconds", None)
+            if seconds is None and len(entry["a"]) == 2:
+                seconds = entry["a"].pop(1)
+            entry["abs"] = self._time() + float(seconds)
+        if kw:
+            entry["kw"] = kw
+        self._journal.write(json.dumps(entry) + "\n")
+        self._journal_ops += 1
+        if self._journal_ops >= self._compact_threshold:
+            self._compact()
+
+    def _replay(self, path: str) -> None:
+        # self._journal is None here, so the @_journaled wrappers pass
+        # straight through without re-journaling
+        now = self._time()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a crash
+                method = entry.get("m")
+                args = entry.get("a", [])
+                kw = dict(entry.get("kw", {}))
+                if method == "expire":
+                    remaining = entry.get("abs", now) - now
+                    if remaining <= 0:
+                        self._data.pop(args[0], None)
+                        self._expiry.pop(args[0], None)
+                    else:
+                        self.expire(args[0], remaining)
+                    continue
+                abs_ex = kw.pop("abs_ex", None)
+                fn = getattr(self, method, None)
+                if fn is None:
+                    continue
+                if abs_ex is not None:
+                    if abs_ex <= now:
+                        fn(*args, **kw)
+                        self._data.pop(args[0], None)
+                        self._expiry.pop(args[0], None)
+                        continue
+                    kw["ex"] = abs_ex - now
+                fn(*args, **kw)
+
+    def _compact(self) -> None:
+        """Rewrite the journal as the minimal op sequence reconstructing the
+        current state, atomically (tmp + rename)."""
+        if self._persist_path is None:
+            return
+        with self._lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+            tmp = self._persist_path + ".tmp"
+            now = self._time()
+            with open(tmp, "w") as f:
+                for key in list(self._data):
+                    if self._expired(key):
+                        continue
+                    val = self._data[key]
+                    if isinstance(val, str):
+                        f.write(json.dumps({"m": "set", "a": [key, val]}) + "\n")
+                    elif isinstance(val, set):
+                        f.write(json.dumps({"m": "sadd", "a": [key, *sorted(val)]}) + "\n")
+                    elif isinstance(val, list):
+                        f.write(json.dumps({"m": "rpush", "a": [key, *val]}) + "\n")
+                    elif isinstance(val, dict):
+                        # hashes hold str values, zsets hold floats
+                        if val and isinstance(next(iter(val.values())), float):
+                            f.write(json.dumps({"m": "zadd", "a": [key, val]}) + "\n")
+                        else:
+                            f.write(
+                                json.dumps({"m": "hset_mapping", "a": [key, val]})
+                                + "\n"
+                            )
+                    exp = self._expiry.get(key)
+                    if exp is not None:
+                        f.write(
+                            json.dumps({"m": "expire", "a": [key], "abs": exp}) + "\n"
+                        )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._persist_path)
+            self._journal = open(self._persist_path, "a", buffering=1)
+            self._journal_ops = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal is not None:
+                self._compact()
+                self._journal.close()
+                self._journal = None
+                self._persist_path = None
 
     # ------------- internals -------------
 
@@ -63,6 +228,7 @@ class KVStore:
 
     # ------------- strings -------------
 
+    @_journaled
     def set(
         self,
         key: str,
@@ -90,6 +256,7 @@ class KVStore:
         with self._lock:
             return [self._get_typed(k, str) for k in keys]
 
+    @_journaled
     def incr(self, key: str, amount: int = 1) -> int:
         with self._lock:
             cur = self._get_typed(key, str)
@@ -97,6 +264,7 @@ class KVStore:
             self._data[key] = str(val)
             return val
 
+    @_journaled
     def delete(self, *keys: str) -> int:
         with self._lock:
             n = 0
@@ -113,6 +281,7 @@ class KVStore:
             self._expired(key)
             return key in self._data
 
+    @_journaled
     def expire(self, key: str, seconds: float) -> bool:
         with self._lock:
             self._expired(key)
@@ -134,6 +303,7 @@ class KVStore:
         with self._lock:
             return [k for k in list(self._data) if not self._expired(k) and fnmatch.fnmatch(k, pattern)]
 
+    @_journaled
     def flushall(self) -> None:
         with self._lock:
             self._data.clear()
@@ -141,6 +311,7 @@ class KVStore:
 
     # ------------- hashes -------------
 
+    @_journaled
     def hset(self, key: str, field: str, value: str) -> int:
         with self._lock:
             h = self._get_typed(key, dict, create=True)
@@ -148,6 +319,7 @@ class KVStore:
             h[field] = str(value)
             return int(is_new)
 
+    @_journaled
     def hset_mapping(self, key: str, mapping: dict[str, str]) -> int:
         with self._lock:
             h = self._get_typed(key, dict, create=True)
@@ -165,6 +337,7 @@ class KVStore:
             h = self._get_typed(key, dict)
             return dict(h) if h else {}
 
+    @_journaled
     def hdel(self, key: str, *fields: str) -> int:
         with self._lock:
             h = self._get_typed(key, dict)
@@ -179,6 +352,7 @@ class KVStore:
                 self.delete(key)
             return n
 
+    @_journaled
     def hincrby(self, key: str, field: str, amount: int = 1) -> int:
         with self._lock:
             h = self._get_typed(key, dict, create=True)
@@ -188,6 +362,7 @@ class KVStore:
 
     # ------------- sets -------------
 
+    @_journaled
     def sadd(self, key: str, *members: str) -> int:
         with self._lock:
             s = self._get_typed(key, set, create=True)
@@ -195,6 +370,7 @@ class KVStore:
             s.update(str(m) for m in members)
             return n
 
+    @_journaled
     def srem(self, key: str, *members: str) -> int:
         with self._lock:
             s = self._get_typed(key, set)
@@ -223,6 +399,7 @@ class KVStore:
 
     # ------------- sorted sets -------------
 
+    @_journaled
     def zadd(self, key: str, mapping: dict[str, float]) -> int:
         with self._lock:
             z = self._get_typed(key, dict, create=True)
@@ -235,6 +412,7 @@ class KVStore:
             z = self._get_typed(key, dict)
             return None if z is None else z.get(member)
 
+    @_journaled
     def zrem(self, key: str, *members: str) -> int:
         with self._lock:
             z = self._get_typed(key, dict)
@@ -260,6 +438,7 @@ class KVStore:
             out.sort(key=lambda ms: (ms[1], ms[0]))
             return out
 
+    @_journaled
     def zremrangebyscore(self, key: str, min_score: float, max_score: float) -> int:
         with self._lock:
             victims = [m for m, _ in self.zrangebyscore(key, min_score, max_score)]
@@ -272,12 +451,14 @@ class KVStore:
 
     # ------------- lists -------------
 
+    @_journaled
     def rpush(self, key: str, *values: str) -> int:
         with self._lock:
             lst = self._get_typed(key, list, create=True)
             lst.extend(str(v) for v in values)
             return len(lst)
 
+    @_journaled
     def lpush(self, key: str, *values: str) -> int:
         with self._lock:
             lst = self._get_typed(key, list, create=True)
@@ -294,6 +475,7 @@ class KVStore:
                 return list(lst[start:])
             return list(lst[start : stop + 1])
 
+    @_journaled
     def lrem(self, key: str, count: int, value: str) -> int:
         """Redis LREM semantics for count >= 0 (remove first `count`
         occurrences; 0 = all)."""

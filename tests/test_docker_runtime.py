@@ -91,7 +91,7 @@ def test_start_injects_identity_env_volumes(fake_docker, tmp_path):
     tid, ts, details = rt.state()
     assert (tid, ts) == ("t1", TaskState.RUNNING)
     assert details.container_status == "running"
-    assert rt.logs  # reconcile pulled container logs
+    assert run(rt.get_logs())  # logs fetched on demand, not per tick
 
 
 def test_config_change_replaces_container(fake_docker):
@@ -138,9 +138,21 @@ def test_exit_code_maps_to_completed_or_failed(fake_docker):
     _, ts2, details2 = rt2.state()
     assert ts2 == TaskState.FAILED and details2.exit_code == 3
     assert rt2.failures == 1
-    # failure count rises only on state CHANGES (service.rs:283-295)
+    # failure count rises only on state CHANGES (service.rs:283-295);
+    # within the backoff window the crashed container is left in place
     run(rt2.apply(bad, "0xn"))
     assert rt2.failures == 1
+    assert rt2.state()[1] == TaskState.FAILED
+
+    # past the backoff, the crashed container is removed and restarted
+    rt2.last_started = 0.0
+    run(rt2.apply(bad, "0xn"))
+    # fake docker restarts it with FAKE_EXIT again -> exited; the failure
+    # transition FAILED->FAILED doesn't double count, but the restart
+    # attempt happened (a fresh container id)
+    _, ts3, details3 = rt2.state()
+    assert ts3 == TaskState.FAILED
+    assert details3.container_id != details2.container_id
 
 
 def test_restart_backoff_blocks_immediate_restart(fake_docker):

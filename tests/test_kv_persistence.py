@@ -1,0 +1,137 @@
+"""KV durability: journal + replay + compaction, orchestrator
+kill-and-restart preserving nodes/tasks/groups (the reference's Redis
+outliving the process, orchestrator/src/store/core/redis.rs:38-72), and a
+SIGKILL'd writer process losing nothing that was journaled."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from protocol_tpu.chain import Ledger
+from protocol_tpu.models.task import Task, TaskRequest
+from protocol_tpu.security import Wallet
+from protocol_tpu.sched.node_groups import NodeGroupConfiguration, NodeGroupsPlugin
+from protocol_tpu.services.orchestrator import OrchestratorService
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.store.kv import KVStore
+
+
+def test_journal_replay_all_types(tmp_path):
+    p = str(tmp_path / "kv.aof")
+    kv = KVStore(persist_path=p)
+    kv.set("a", "1")
+    kv.set("gone", "x", ex=0.01)
+    kv.set("keep", "y", ex=3600)
+    kv.hset("h", "f", "v")
+    kv.hincrby("h", "n", 7)
+    kv.sadd("s", "m1", "m2")
+    kv.srem("s", "m2")
+    kv.zadd("z", {"p": 1.5, "q": 2.5})
+    kv.zrem("z", "q")
+    kv.rpush("l", "x", "y")
+    kv.lrem("l", 1, "x")
+    kv.incr("ctr")
+    kv.incr("ctr")
+    kv.delete("a")
+    time.sleep(0.02)
+
+    kv2 = KVStore(persist_path=p)
+    assert kv2.get("a") is None
+    assert kv2.get("gone") is None  # TTL expired across the restart
+    assert kv2.get("keep") == "y" and kv2.ttl("keep") > 3500
+    assert kv2.hgetall("h") == {"f": "v", "n": "7"}
+    assert kv2.smembers("s") == {"m1"}
+    assert kv2.zrangebyscore("z") == [("p", 1.5)]
+    assert kv2.lrange("l") == ["y"]
+    assert kv2.get("ctr") == "2"
+
+
+def test_failed_nx_write_not_journaled(tmp_path):
+    """A failed SET NX (and EXPIRE on a missing key) mutates nothing and
+    must not be journaled: replaying an expired NX SET would otherwise
+    delete a durable value the original call never replaced."""
+    p = str(tmp_path / "kv.aof")
+    kv = KVStore(persist_path=p)
+    kv.set("k", "durable")
+    assert kv.set("k", "claim", nx=True, ex=0.01) is False
+    assert kv.expire("missing", 5) is False
+    time.sleep(0.02)
+
+    kv2 = KVStore(persist_path=p)
+    assert kv2.get("k") == "durable"
+    assert kv2.ttl("k") is None
+
+
+def test_compaction_bounds_journal(tmp_path):
+    p = str(tmp_path / "kv.aof")
+    kv = KVStore(persist_path=p, compact_threshold=50)
+    for i in range(300):
+        kv.set("k", str(i))  # same key rewritten: compacts to one line
+    kv2 = KVStore(persist_path=p)
+    assert kv2.get("k") == "299"
+    assert len(open(p).read().splitlines()) <= 51
+
+
+def test_orchestrator_restart_preserves_pool_state(tmp_path):
+    p = str(tmp_path / "orch.aof")
+    ledger = Ledger()
+    creator, manager = Wallet.from_seed(b"kc"), Wallet.from_seed(b"km")
+    did = ledger.create_domain("d")
+    pid = ledger.create_pool(did, creator.address, manager.address, "")
+
+    svc = OrchestratorService(ledger, pid, manager, persist_path=p)
+    svc.store.node_store.add_node(
+        OrchestratorNode(address="0xn1", status=NodeStatus.HEALTHY,
+                         ip_address="1.2.3.4", port=80)
+    )
+    task = Task.from_request(TaskRequest(name="job", image="img"))
+    svc.store.task_store.add_task(task)
+    groups = NodeGroupsPlugin(
+        svc.store,
+        [NodeGroupConfiguration(name="solo", min_group_size=1, max_group_size=1)],
+    )
+    group = groups._create_group(groups.configurations[0], ["0xn1"])
+    del svc  # "kill" the orchestrator
+
+    svc2 = OrchestratorService(ledger, pid, manager, persist_path=p)
+    node = svc2.store.node_store.get_node("0xn1")
+    assert node is not None and node.status == NodeStatus.HEALTHY
+    tasks = svc2.store.task_store.get_all_tasks()
+    assert [t.name for t in tasks] == ["job"]
+    groups2 = NodeGroupsPlugin(
+        svc2.store,
+        [NodeGroupConfiguration(name="solo", min_group_size=1, max_group_size=1)],
+    )
+    restored = groups2.group_for_node("0xn1")
+    assert restored is not None and restored.id == group.id
+
+
+def test_sigkilled_writer_loses_nothing_journaled(tmp_path):
+    """SIGKILL the writing process mid-run; every write it completed must
+    be visible after reload (line-buffered AOF semantics)."""
+    p = str(tmp_path / "kv.aof")
+    ready = str(tmp_path / "ready")
+    code = f"""
+import sys, time
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from protocol_tpu.store.kv import KVStore
+kv = KVStore(persist_path={p!r})
+for i in range(500):
+    kv.set(f"k{{i}}", str(i))
+open({ready!r}, "w").write("500")
+time.sleep(30)  # hold the process open for the SIGKILL
+"""
+    proc = subprocess.Popen([sys.executable, "-S", "-c", code])
+    deadline = time.time() + 30
+    while not os.path.exists(ready) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(ready), "writer never finished its writes"
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+
+    kv = KVStore(persist_path=p)
+    for i in range(500):
+        assert kv.get(f"k{i}") == str(i)
