@@ -158,6 +158,16 @@ class TpuBatchMatcher:
                 ):
                     self.refresh()
 
+    # ----- device solves (overridden by RemoteBatchMatcher to route the
+    # same columnar batches through the gRPC scheduler backend)
+
+    def _bounded_t4p(self, ep, er) -> np.ndarray:
+        return np.asarray(_solve_bounded(ep, er, self.weights))
+
+    def _unbounded_best(self, ep, er) -> np.ndarray:
+        best, _feas = _solve_unbounded(ep, er, self.weights)
+        return np.asarray(best)
+
     # ----- batch solve
 
     def refresh(self) -> None:
@@ -229,7 +239,7 @@ class TpuBatchMatcher:
             er = self.encoder.encode_requirements(
                 reqs, priorities=prios, pad_to=s_bucket
             )
-            t4p = np.asarray(_solve_bounded(ep, er, self.weights))[:P]
+            t4p = self._bounded_t4p(ep, er)[:P]
             for p_idx, s_idx in enumerate(t4p):
                 if s_idx >= 0 and s_idx < len(slot_task):
                     assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
@@ -243,8 +253,7 @@ class TpuBatchMatcher:
             er = self.encoder.encode_requirements(
                 reqs, priorities=prios, pad_to=t_bucket
             )
-            best, feas = _solve_unbounded(ep, er, self.weights)
-            best = np.asarray(best)[:P]
+            best = self._unbounded_best(ep, er)[:P]
             for p_idx in range(P):
                 if not assigned[p_idx] and best[p_idx] >= 0 and best[p_idx] < len(unbounded):
                     assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id

@@ -27,6 +27,7 @@ import numpy as np
 from protocol_tpu.ops.cost import CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
 
 SERVICE_NAME = "protocol_tpu.scheduler.v1.SchedulerBackend"
 
@@ -80,17 +81,44 @@ def requirements_from_proto(msg: pb.RequirementBatch) -> EncodedRequirements:
     )
 
 
+def _pad_pow2(enc, n_real: int):
+    """Pad an encoded batch to the next pow2 bucket with valid=False rows:
+    the wire carries only real rows (no valid mask), while bucketed shapes
+    keep the backend's jit cache from recompiling per batch size."""
+    import dataclasses
+
+    if n_real <= 0:
+        return enc
+    target = 1 << (n_real - 1).bit_length()
+    if target == n_real:
+        return enc
+    out = {}
+    for f in dataclasses.fields(enc):
+        a = np.asarray(getattr(enc, f.name))
+        pad = [(0, target - n_real)] + [(0, 0)] * (a.ndim - 1)
+        out[f.name] = np.pad(a, pad)
+    out["valid"] = np.concatenate(
+        [np.ones(n_real, bool), np.zeros(target - n_real, bool)]
+    )
+    return dataclasses.replace(enc, **out)
+
+
 class SchedulerBackendServicer:
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
         t0 = time.perf_counter()
         ep = providers_from_proto(request.providers)
         er = requirements_from_proto(request.requirements)
-        weights = CostWeights(
-            price=request.weights.price or 1.0,
-            load=request.weights.load or 1.0,
-            proximity=request.weights.proximity or 0.001,
-            priority=request.weights.priority or 0.0,
-        )
+        if request.HasField("weights"):
+            # submessage presence is real in proto3: a set weights message
+            # is used verbatim, so a legitimate 0.0 weight survives the wire
+            weights = CostWeights(
+                price=request.weights.price,
+                load=request.weights.load,
+                proximity=request.weights.proximity,
+                priority=request.weights.priority,
+            )
+        else:
+            weights = CostWeights()
         kernel = request.kernel or "auction"
 
         P = int(np.asarray(ep.gpu_count).shape[0])
@@ -103,14 +131,34 @@ class SchedulerBackendServicer:
                 num_assigned=0,
                 solve_ms=(time.perf_counter() - t0) * 1e3,
             )
+        # bucket the batch (valid=False padding rows) so repeat calls reuse
+        # the jit cache; replies are sliced back to the real row counts, and
+        # padding rows are infeasible by mask so they never win assignments
+        ep = _pad_pow2(ep, P)
+        er = _pad_pow2(er, T)
+
+        if kernel == "best":
+            # per-provider argmin over compatible tasks: the one-to-many
+            # unbounded phase of the batch matcher (many providers may pick
+            # the same task, so this is not a matching kernel)
+            from protocol_tpu.sched.tpu_backend import _solve_unbounded
+
+            best, _feas = _solve_unbounded(ep, er, weights)
+            t4p = np.asarray(best)[:P]
+            return pb.AssignResponse(
+                provider_for_task=[-1] * T,
+                task_for_provider=t4p.tolist(),
+                num_assigned=int((t4p >= 0).sum()),
+                solve_ms=(time.perf_counter() - t0) * 1e3,
+            )
 
         if kernel == "topk":
             from protocol_tpu.ops.sparse import assign_topk
 
-            # tile must divide T: fall back to T itself for small batches
-            T = er.cpu_cores.shape[0]
-            tile = min(1024, T)
-            while T % tile != 0:
+            # tile must divide the (padded, pow2) T
+            t_padded = int(np.asarray(er.cpu_cores).shape[0])
+            tile = min(1024, t_padded)
+            while t_padded % tile != 0:
                 tile -= 1
             res = assign_topk(
                 ep, er, weights,
@@ -129,16 +177,24 @@ class SchedulerBackendServicer:
             if kernel == "greedy":
                 res = assign_greedy(cost)
             elif kernel == "sinkhorn":
-                res = assign_sinkhorn(cost, eps=request.eps or 0.05)
+                res = assign_sinkhorn(
+                    cost,
+                    eps=request.eps or 0.05,
+                    num_iters=int(request.max_iters) or 200,
+                )
             elif kernel == "auction":
-                res = assign_auction(cost, eps=request.eps or 0.01)
+                res = assign_auction(
+                    cost,
+                    eps=request.eps or 0.01,
+                    max_iters=int(request.max_iters) or 500,
+                )
             else:
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel {kernel!r}"
                 )
 
-        p4t = np.asarray(res.provider_for_task)
-        t4p = np.asarray(res.task_for_provider)
+        p4t = np.asarray(res.provider_for_task)[:T]
+        t4p = np.asarray(res.task_for_provider)[:P]
         return pb.AssignResponse(
             provider_for_task=p4t.tolist(),
             task_for_provider=t4p.tolist(),
@@ -212,7 +268,7 @@ class SchedulerBackendClient:
 
 def encoded_to_proto(
     ep: EncodedProviders, er: EncodedRequirements, weights: Optional[CostWeights] = None,
-    kernel: str = "topk", top_k: int = 64, eps: float = 0.01,
+    kernel: str = "topk", top_k: int = 64, eps: float = 0.01, max_iters: int = 0,
 ) -> pb.AssignRequest:
     """Host-side helper: pack numpy-backed encodings into an AssignRequest."""
     w = weights or CostWeights()
@@ -261,4 +317,78 @@ def encoded_to_proto(
         kernel=kernel,
         top_k=top_k,
         eps=eps,
+        max_iters=max_iters,
     )
+
+
+class RemoteBatchMatcher(TpuBatchMatcher):
+    """TpuBatchMatcher whose device solves go through the gRPC scheduler
+    backend (``scheduler_backend=remote``): the control plane stays a thin
+    host process while the kernels run wherever the backend's accelerator
+    lives. This is the load-bearing form of the BASELINE.json north-star
+    seam — the same columnar batches the in-process matcher feeds its
+    jitted kernels are packed into AssignRequests instead, so control
+    plane and backend can be scaled and deployed independently (the
+    reference's Rust-orchestrator-calls-TPU-service shape).
+
+    Round-trip cost shows up in ``last_solve_stats`` as
+    ``remote_rtt_ms`` (client-observed) next to the backend-reported
+    ``solve_ms`` per call; the difference is the columnar seam's cost
+    (SURVEY.md §7 hard part #6 wants it cheap — measured, not asserted).
+    """
+
+    def __init__(self, store, address: str = "127.0.0.1:50061", **kwargs):
+        super().__init__(store, **kwargs)
+        self.client = SchedulerBackendClient(address)
+        self._rtt_ms: list[float] = []
+        self._backend_ms: list[float] = []
+
+    def refresh(self) -> None:
+        self._rtt_ms, self._backend_ms = [], []
+        super().refresh()  # replaces last_solve_stats; re-attach remote cost
+        if self._rtt_ms:
+            self.last_solve_stats["remote_calls"] = len(self._rtt_ms)
+            self.last_solve_stats["remote_rtt_ms"] = round(sum(self._rtt_ms), 3)
+            self.last_solve_stats["remote_backend_ms"] = round(
+                sum(self._backend_ms), 3
+            )
+
+    @staticmethod
+    def _strip_padding(enc):
+        """Drop the pow2-padding rows before serialization: the wire format
+        carries no valid mask, so padded rows would otherwise become real
+        (zero-cost, always-compatible) entities on the backend — and they
+        double the payload for nothing."""
+        import dataclasses
+
+        n = int(np.asarray(enc.valid).sum())
+        return dataclasses.replace(
+            enc,
+            **{
+                f.name: np.asarray(getattr(enc, f.name))[:n]
+                for f in dataclasses.fields(enc)
+            },
+        )
+
+    def _call(self, ep, er, kernel: str, eps: float, max_iters: int):
+        req = encoded_to_proto(
+            self._strip_padding(ep),
+            self._strip_padding(er),
+            self.weights,
+            kernel=kernel,
+            eps=eps,
+            max_iters=max_iters,
+        )
+        t0 = time.perf_counter()
+        resp = self.client.assign(req)
+        self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
+        self._backend_ms.append(resp.solve_ms)
+        return resp
+
+    def _bounded_t4p(self, ep, er) -> np.ndarray:
+        resp = self._call(ep, er, "auction", eps=0.05, max_iters=300)
+        return np.asarray(resp.task_for_provider, np.int32)
+
+    def _unbounded_best(self, ep, er) -> np.ndarray:
+        resp = self._call(ep, er, "best", eps=0.0, max_iters=0)
+        return np.asarray(resp.task_for_provider, np.int32)

@@ -83,3 +83,94 @@ def test_unknown_kernel_rejected(backend):
     with pytest.raises(grpc.RpcError) as e:
         backend.assign(req)
     assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def _pool_world(n_nodes=12, n_tasks=5):
+    """Control-plane world: store + healthy nodes + tasks (bounded and
+    unbounded) for an end-to-end matcher run."""
+    import random
+
+    from protocol_tpu.models.task import SchedulingConfig, Task, TaskRequest
+    from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+    rng = random.Random(7)
+    store = StoreContext.new_test()
+    for i in range(n_nodes):
+        store.node_store.add_node(
+            OrchestratorNode(
+                address=f"0xnode{i:02d}",
+                status=NodeStatus.HEALTHY,
+                ip_address=f"10.0.0.{i}",
+                port=9000 + i,
+                compute_specs=random_specs(rng),
+            )
+        )
+    for i in range(n_tasks):
+        cfg = None
+        if i % 2 == 0:  # bounded: wants 2 replicas
+            cfg = SchedulingConfig(plugins={"tpu_scheduler": {"replicas": ["2"]}})
+        store.task_store.add_task(
+            Task.from_request(
+                TaskRequest(name=f"task-{i}", image="img", scheduling_config=cfg)
+            )
+        )
+    return store
+
+
+def test_remote_matcher_end_to_end_parity_and_rtt(backend):
+    """Control plane -> RemoteBatchMatcher -> gRPC -> kernels -> assignment:
+    the full scheduler path with the seam load-bearing, checked for parity
+    against the in-process matcher and measuring the round-trip cost
+    (BASELINE.json north star; SURVEY §7 hard part #6)."""
+    from protocol_tpu.sched import Scheduler
+    from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+    from protocol_tpu.services.scheduler_grpc import RemoteBatchMatcher
+
+    store = _pool_world()
+    local = TpuBatchMatcher(store, min_solve_interval=0.0)
+    remote = RemoteBatchMatcher(
+        store, "127.0.0.1:50971", min_solve_interval=0.0
+    )
+
+    sched = Scheduler(store, batch_matcher=remote)
+    assignments = {}
+    for node in store.node_store.get_nodes():
+        task = sched.get_task_for_node(node.address)
+        if task is not None:
+            assignments[node.address] = task.name
+
+    local.refresh()
+    local_assignments = {
+        addr: store.task_store.get_task(tid).name
+        for addr, tid in local._assignment.items()
+    }
+    assert assignments == local_assignments
+    assert assignments, "remote matcher assigned nothing"
+
+    stats = remote.last_solve_stats
+    assert stats["remote_calls"] >= 1
+    assert stats["remote_rtt_ms"] > 0
+    assert stats["remote_backend_ms"] > 0
+    # the columnar seam must stay cheap: serialization + transport overhead
+    # (rtt - backend solve) bounded well under the 10 s heartbeat cadence
+    overhead_ms = stats["remote_rtt_ms"] - stats["remote_backend_ms"]
+    assert overhead_ms < 1000, stats
+    print(f"remote seam: {stats}")
+
+
+def test_remote_matcher_replica_bounds_respected(backend):
+    """Bounded tasks keep their replica caps through the remote path."""
+    from protocol_tpu.services.scheduler_grpc import RemoteBatchMatcher
+
+    store = _pool_world(n_nodes=10, n_tasks=3)
+    remote = RemoteBatchMatcher(
+        store, "127.0.0.1:50971", min_solve_interval=0.0
+    )
+    remote.refresh()
+    by_task: dict = {}
+    for addr, tid in remote._assignment.items():
+        by_task.setdefault(store.task_store.get_task(tid).name, []).append(addr)
+    for name, nodes in by_task.items():
+        idx = int(name.split("-")[1])
+        if idx % 2 == 0:  # bounded at 2 replicas
+            assert len(nodes) <= 2, (name, nodes)
