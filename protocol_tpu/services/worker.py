@@ -640,29 +640,73 @@ class WorkerAgent:
 
     # ----- bridge output -> upload + work submission -----
 
-    async def submit_output(self, sha: str, flops: int, file_name: str) -> bool:
-        """Request a signed upload URL from the orchestrator, then submit
-        the work key on the ledger (docker/taskbridge/file_handler.rs)."""
+    async def submit_output(
+        self,
+        sha: str,
+        flops: int,
+        file_name: str,
+        data: Optional[bytes] = None,
+        max_retries: int = 5,
+    ) -> bool:
+        """Upload the artifact then submit the work key on the ledger
+        (docker/taskbridge/file_handler.rs:21-118): request a signed URL
+        from the orchestrator with exponential-backoff retries, PUT the
+        bytes through it, then submitWork(sha, flops). With no ``data``
+        the URL request is best-effort (the workload may upload out of
+        band) and the work is submitted regardless."""
+        if data is not None and (not self.orchestrator_url or self.http is None):
+            return False  # nowhere to upload: no artifact -> no work claim
         if self.orchestrator_url and self.http is not None:
             payload = {
                 "file_name": file_name,
-                "file_size": 0,
+                "file_size": len(data) if data is not None else 0,
                 "file_type": "application/octet-stream",
                 "sha256": sha,
                 "task_id": self.current_task.id if self.current_task else None,
             }
-            headers, body = sign_request(
-                "/storage/request-upload", self.node_wallet, payload
-            )
-            try:
-                async with self.http.post(
-                    f"{self.orchestrator_url}/storage/request-upload",
-                    json=body,
-                    headers=headers,
-                ) as resp:
-                    pass  # upload itself is the workload's concern in tests
-            except Exception:
-                pass
+
+            class _Fatal(Exception):
+                """Deterministic 4xx: retrying re-signs the same doomed
+                request (and 429 retries dig the rate-limit hole deeper)."""
+
+            for attempt in range(max_retries):
+                try:
+                    headers, body = sign_request(
+                        "/storage/request-upload", self.node_wallet, payload
+                    )
+                    async with self.http.post(
+                        f"{self.orchestrator_url}/storage/request-upload",
+                        json=body,
+                        headers=headers,
+                    ) as resp:
+                        if 400 <= resp.status < 500:
+                            raise _Fatal(f"request-upload {resp.status}")
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"request-upload {resp.status}"
+                            )
+                        url = (await resp.json())["data"]["signed_url"]
+                    if data is not None:
+                        async with self.http.put(
+                            url,
+                            data=data,
+                            headers={"Content-Length": str(len(data))},
+                        ) as up:
+                            if 400 <= up.status < 500 and up.status not in (408, 429):
+                                raise _Fatal(f"upload {up.status}")
+                            if up.status not in (200, 201):
+                                raise RuntimeError(f"upload {up.status}")
+                    break
+                except _Fatal:
+                    if data is not None:
+                        return False  # no artifact -> no work claim
+                    break  # bodyless legacy path stays best-effort
+                except Exception:
+                    if attempt == max_retries - 1:
+                        if data is not None:
+                            return False
+                        break
+                    await asyncio.sleep(min(0.1 * 2**attempt, 2.0))
         try:
             self.ledger.submit_work(self.pool_id, self.node_wallet.address, sha, flops)
             return True

@@ -1,0 +1,133 @@
+"""Fake GCS/S3 bucket: an aiohttp app that VERIFIES V4 signed URLs
+(signature reconstruction, expiry, signed content-length enforcement)
+and stores objects in memory — the integration target for the cloud
+storage providers (the reference tests against a real bucket via env
+creds, google_cloud.rs:184-233; this fake keeps the same checks
+hermetic)."""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+
+from aiohttp import web
+
+from protocol_tpu.utils.cloud_storage import _canonical_request
+
+
+class FakeBucket:
+    """Verifies GOOG4-RSA-SHA256 (with the SA public key) or
+    AWS4-HMAC-SHA256 (with the secret key) query-signed requests."""
+
+    def __init__(self, rsa_public_key=None, hmac_secret: str = "", region="auto"):
+        self.rsa_public_key = rsa_public_key
+        self.hmac_secret = hmac_secret
+        self.region = region
+        self.objects: dict[str, bytes] = {}
+        self.rejections: list[str] = []
+
+    def _reject(self, reason: str):
+        self.rejections.append(reason)
+        return web.Response(status=403, text=reason)
+
+    def _verify(self, request: web.Request, prefix: str, algorithm: str):
+        q = dict(request.query)
+        for want in ("Algorithm", "Credential", "Date", "Expires",
+                     "SignedHeaders", "Signature"):
+            if f"{prefix}{want}" not in q:
+                return f"missing {prefix}{want}"
+        if q[f"{prefix}Algorithm"] != algorithm:
+            return "wrong algorithm"
+        sig = q.pop(f"{prefix}Signature")
+
+        # expiry
+        stamp = q[f"{prefix}Date"]
+        t = datetime.datetime.strptime(stamp, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc
+        )
+        age = (datetime.datetime.now(datetime.timezone.utc) - t).total_seconds()
+        if age > int(q[f"{prefix}Expires"]):
+            return "expired"
+
+        # reconstruct the canonical request from what actually arrived
+        signed_headers = q[f"{prefix}SignedHeaders"].split(";")
+        headers = {}
+        for h in signed_headers:
+            if h == "host":
+                headers["host"] = request.headers.get("Host", "")
+            else:
+                v = request.headers.get(h)
+                if v is None:
+                    return f"signed header {h} missing from request"
+                headers[h] = v
+        # raw_path keeps the client's percent-encoding — request.path is
+        # already decoded, and re-quoting it would corrupt names that
+        # legitimately contain encoded sequences
+        canonical, _ = _canonical_request(
+            request.method, request.raw_path.split("?", 1)[0], q, headers
+        )
+        scope = q[f"{prefix}Credential"].split("/", 1)[1]
+        string_to_sign = "\n".join(
+            [algorithm, stamp, scope,
+             hashlib.sha256(canonical.encode()).hexdigest()]
+        ).encode()
+
+        if algorithm == "GOOG4-RSA-SHA256":
+            from cryptography.exceptions import InvalidSignature
+            from cryptography.hazmat.primitives import hashes
+            from cryptography.hazmat.primitives.asymmetric import padding
+
+            try:
+                self.rsa_public_key.verify(
+                    bytes.fromhex(sig), string_to_sign,
+                    padding.PKCS1v15(), hashes.SHA256(),
+                )
+            except (InvalidSignature, ValueError):
+                return "bad signature"
+        else:
+            def kd(key: bytes, msg: str) -> bytes:
+                return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+            datestamp = stamp[:8]
+            k = kd(f"AWS4{self.hmac_secret}".encode(), datestamp)
+            k = kd(k, self.region)
+            k = kd(k, "s3")
+            k = kd(k, "aws4_request")
+            want = hmac.new(k, string_to_sign, hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                return "bad signature"
+
+        # a signed content-length binds the upload size
+        if "content-length" in headers and request.method == "PUT":
+            if str(request.content_length) != headers["content-length"]:
+                return "content-length mismatch"
+        return None
+
+    async def handle(self, request: web.Request) -> web.Response:
+        prefix = "X-Goog-" if "X-Goog-Algorithm" in request.query else "X-Amz-"
+        algorithm = (
+            "GOOG4-RSA-SHA256" if prefix == "X-Goog-" else "AWS4-HMAC-SHA256"
+        )
+        err = self._verify(request, prefix, algorithm)
+        if err:
+            return self._reject(err)
+        key = request.path.lstrip("/")
+        if request.method == "PUT":
+            body = await request.read()
+            cl = request.headers.get("content-length")
+            if cl is not None and int(cl) != len(body):
+                return self._reject("body length lies about content-length")
+            self.objects[key] = body
+            return web.Response(status=200)
+        if key not in self.objects:
+            return web.Response(status=404)
+        if request.method == "HEAD":
+            return web.Response(status=200)
+        return web.Response(body=self.objects[key])
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        return app
