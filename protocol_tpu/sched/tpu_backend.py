@@ -121,6 +121,7 @@ class TpuBatchMatcher:
         self._solve_lock = threading.Lock()
         self.encoder = FeatureEncoder()
         self.last_solve_stats: dict = {}
+        self._solve_seq = 0
 
     # ----- invalidation hooks (wire to TaskStore observers + node changes)
 
@@ -197,7 +198,12 @@ class TpuBatchMatcher:
         covered = {n.address for n in nodes}
         if not nodes or not tasks:
             self._assignment, self._covered = assignment, covered
-            self.last_solve_stats = {"nodes": len(nodes), "tasks": len(tasks)}
+            self._solve_seq += 1
+            self.last_solve_stats = {
+                "nodes": len(nodes),
+                "tasks": len(tasks),
+                "seq": self._solve_seq,
+            }
             return
 
         # newest-first priority, matching NewestTaskPlugin ordering:
@@ -259,10 +265,12 @@ class TpuBatchMatcher:
                     assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id
 
         self._assignment, self._covered = assignment, covered
+        self._solve_seq += 1
         self.last_solve_stats = {
             "nodes": P,
             "tasks": len(tasks),
             "bounded_tasks": len(bounded),
             "assigned": len(assignment),
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
+            "seq": self._solve_seq,  # monotone id for scrape-side dedup
         }

@@ -60,6 +60,7 @@ from protocol_tpu.security.middleware import (
 from protocol_tpu.security.wallet import Wallet
 from protocol_tpu.store.context import StoreContext
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
+from protocol_tpu.utils.metrics import OrchestratorMetrics
 from protocol_tpu.utils.storage import StorageProvider
 
 BAN_KEY = "orchestrator:banned:{}"
@@ -119,6 +120,8 @@ class OrchestratorService:
         self.webhook = webhook
         self.control_http = control_http
         self.loop_beats: dict[str, float] = {}
+        self.metrics = OrchestratorMetrics(pool_id)
+        self._observed_solve = 0  # last seen matcher solve seq
         if webhook is not None and groups_plugin is not None:
             groups_plugin.on_group_created = webhook.handle_group_created
             groups_plugin.on_group_dissolved = webhook.handle_group_destroyed
@@ -182,7 +185,53 @@ class OrchestratorService:
         app.router.add_get("/metrics", self.get_metrics)
         app.router.add_get("/metrics/prometheus", self.get_prometheus)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/openapi.json", self.openapi)
         return app
+
+    async def openapi(self, request: web.Request) -> web.Response:
+        """OpenAPI document generated from the live route table (the
+        reference serves utoipa-generated Swagger, api/server.rs:46-97)."""
+        paths: dict = {}
+        for route in request.app.router.routes():
+            if route.method in ("HEAD", "*") or route.resource is None:
+                continue
+            info = route.resource.get_info()
+            path = info.get("path") or info.get("formatter")
+            if not path or path == "/openapi.json":
+                continue
+            doc = (route.handler.__doc__ or "").strip().splitlines()
+            params = [
+                {
+                    "name": m.group(1),
+                    "in": "path",
+                    "required": True,
+                    "schema": {"type": "string"},
+                }
+                for m in re.finditer(r"\{(\w+)(?::[^}]*)?\}", path)
+            ]
+            entry = {
+                "summary": doc[0] if doc else "",
+                "responses": {"200": {"description": "OK"}},
+            }
+            if params:
+                entry["parameters"] = params
+            paths.setdefault(re.sub(r"\{(\w+):[^}]*\}", r"{\1}", path), {})[
+                route.method.lower()
+            ] = entry
+        return web.json_response(
+            {
+                "openapi": "3.0.3",
+                "info": {
+                    "title": "protocol_tpu orchestrator",
+                    "version": "1.0",
+                    "description": (
+                        f"Pool {self.pool_id} coordination API "
+                        "(heartbeats, tasks, nodes, groups, storage, metrics)"
+                    ),
+                },
+                "paths": dict(sorted(paths.items())),
+            }
+        )
 
     async def health(self, request: web.Request) -> web.Response:
         now = time.monotonic()
@@ -231,8 +280,19 @@ class OrchestratorService:
             if entries:
                 self.store.metrics_store.store_metrics(entries, address)
 
+        self.metrics.record_heartbeat(address)
         # the batch solve runs device work; keep it off the event loop
         task = await asyncio.to_thread(self.scheduler.get_task_for_node, address)
+        matcher = getattr(self.scheduler, "batch_matcher", None)
+        if matcher is not None and matcher.last_solve_stats:
+            stats = matcher.last_solve_stats
+            seq = stats.get("seq", 0)
+            if seq > self._observed_solve and "solve_ms" in stats:
+                self._observed_solve = seq
+                self.metrics.solve_duration.labels(
+                    backend=type(matcher).__name__,
+                    pool_id=str(self.pool_id),
+                ).observe(stats["solve_ms"] / 1e3)
         return web.json_response(
             {
                 "success": True,
@@ -254,6 +314,17 @@ class OrchestratorService:
             sha256 = str(body["sha256"])
         except (KeyError, ValueError, TypeError):
             return _err("missing file_name/file_size/sha256", 400)
+        # counted at ENTRY so the counter still moves when requests fail —
+        # a flatlining upload counter during a storage outage would read as
+        # "no traffic" exactly when the operator needs the opposite signal
+        _mtask = (
+            self.store.task_store.get_task(str(body.get("task_id")))
+            if body.get("task_id")
+            else None
+        )
+        self.metrics.record_upload_request(
+            address, str(body.get("task_id") or ""), _mtask.name if _mtask else ""
+        )
         # the sha becomes a storage object name (mapping/{sha}) and a KV key:
         # anything but plain LOWERCASE hex is rejected — mixed case would
         # alias one digest to multiple owner keys / mapping objects (a
@@ -572,34 +643,14 @@ class OrchestratorService:
         )
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
-        """Prometheus exposition (metrics/sync_service.rs rebuild, rendered
-        on demand)."""
-        lines = []
-        nodes = self.store.node_store.get_nodes()
-        by_status: dict[str, int] = {}
-        for n in nodes:
-            by_status[n.status.value] = by_status.get(n.status.value, 0) + 1
-        lines.append("# TYPE orchestrator_nodes_total gauge")
-        for status, count in sorted(by_status.items()):
-            lines.append(
-                f'orchestrator_nodes_total{{status="{status}"}} {count}'
-            )
-        lines.append("# TYPE orchestrator_tasks_total gauge")
-        lines.append(
-            f"orchestrator_tasks_total {len(self.store.task_store.get_all_tasks())}"
+        """Prometheus exposition over the full metric-family registry
+        (metrics/mod.rs:6-126); the store -> registry rebuild
+        (metrics/sync_service.rs:37-180) runs at scrape time instead of on
+        a 10 s loop."""
+        self.metrics.sync(self.store, self.groups_plugin)
+        return web.Response(
+            body=self.metrics.render(), content_type="text/plain"
         )
-        if self.groups_plugin is not None:
-            lines.append("# TYPE orchestrator_groups_total gauge")
-            lines.append(
-                f"orchestrator_groups_total {len(self.groups_plugin.get_groups())}"
-            )
-        for task_id, labels in self.store.metrics_store.get_all_metrics().items():
-            for label, per_node in labels.items():
-                for node_addr, value in per_node.items():
-                    lines.append(
-                        f'orchestrator_task_metric{{task_id="{task_id}",label="{label}",node="{node_addr}"}} {value}'
-                    )
-        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     # ================= loops =================
 
@@ -808,6 +859,15 @@ class OrchestratorService:
     async def status_update_once(self) -> None:
         """Health FSM (status_update/mod.rs:215-312) + chain sync
         (:118-142)."""
+        _t0 = time.perf_counter()
+        try:
+            await self._status_update_once()
+        finally:
+            self.metrics.status_update_execution_time.labels(
+                pool_id=str(self.pool_id)
+            ).observe(time.perf_counter() - _t0)
+
+    async def _status_update_once(self) -> None:
         hs = self.store.heartbeat_store
         for node in self.store.node_store.get_nodes():
             addr = node.address

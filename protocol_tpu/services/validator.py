@@ -41,6 +41,7 @@ from protocol_tpu.models.node import DiscoveryNode
 from protocol_tpu.security.signer import sign_request
 from protocol_tpu.security.wallet import Wallet
 from protocol_tpu.store.kv import KVStore
+from protocol_tpu.utils.metrics import ValidatorMetrics
 
 STATUS_KEY = "work_validation_status:{}"
 WORK_INFO_KEY = "work_info:{}"
@@ -86,11 +87,24 @@ class ToplocClient:
         http,
         auth_token: Optional[str] = None,
         file_prefix_filter: Optional[str] = None,
+        metrics=None,  # ValidatorMetrics (validator/src/metrics.rs api_*)
     ):
         self.server_url = server_url.rstrip("/")
         self.http = http
         self.auth_token = auth_token
         self.file_prefix_filter = file_prefix_filter
+        self.metrics = metrics
+
+    def _record_api(self, endpoint: str, status: str, seconds: float) -> None:
+        if self.metrics is None:
+            return
+        base = self.metrics._base()
+        self.metrics.api_requests.labels(
+            **base, endpoint=endpoint, status=status
+        ).inc()
+        self.metrics.api_duration.labels(**base, endpoint=endpoint).observe(
+            seconds
+        )
 
     def accepts(self, file_name: str) -> bool:
         return not self.file_prefix_filter or file_name.startswith(
@@ -102,24 +116,30 @@ class ToplocClient:
 
     async def trigger(self, file_name: str, group: bool = False) -> bool:
         kind = "validategroup" if group else "validate"
+        t0 = time.perf_counter()
         try:
             async with self.http.post(
                 f"{self.server_url}/{kind}/{file_name}", headers=self._headers()
             ) as resp:
+                self._record_api(kind, str(resp.status), time.perf_counter() - t0)
                 return resp.status == 200
         except Exception:
+            self._record_api(kind, "error", time.perf_counter() - t0)
             return False
 
     async def status(self, file_name: str, group: bool = False) -> Optional[dict]:
         kind = "statusgroup" if group else "status"
+        t0 = time.perf_counter()
         try:
             async with self.http.get(
                 f"{self.server_url}/{kind}/{file_name}", headers=self._headers()
             ) as resp:
+                self._record_api(kind, str(resp.status), time.perf_counter() - t0)
                 if resp.status != 200:
                     return None
                 return await resp.json()
         except Exception:
+            self._record_api(kind, "error", time.perf_counter() - t0)
             return None
 
 
@@ -137,6 +157,7 @@ class SyntheticDataValidator:
         grace_period: float = 300.0,
         work_window: float = 3600.0,
         persist_path: Optional[str] = None,
+        metrics=None,  # ValidatorMetrics
     ):
         self.ledger = ledger
         self.pool_id = pool_id
@@ -146,6 +167,11 @@ class SyntheticDataValidator:
         self.penalty = penalty
         self.grace_period = grace_period
         self.work_window = work_window
+        self.metrics = metrics
+        if metrics is not None:
+            for c in toploc_clients:
+                if c.metrics is None:
+                    c.metrics = metrics
 
     def _client_for(self, file_name: str) -> Optional[ToplocClient]:
         for c in self.clients:
@@ -166,7 +192,19 @@ class SyntheticDataValidator:
         validations, poll statuses, process expired groups."""
         stats = {"triggered": 0, "accepted": 0, "rejected": 0, "soft": 0}
         since = time.time() - self.work_window
-        for work in self.ledger.get_work_since(self.pool_id, since):
+        work_items = self.ledger.get_work_since(self.pool_id, since)
+        if self.metrics is not None:
+            # only keys still awaiting processing: the backlog gauge must
+            # drain to 0, not sit at the window's total forever
+            pending = sum(
+                1
+                for w in work_items
+                if self.get_status(w.work_key) == ValidationResult.UNKNOWN
+            )
+            self.metrics.work_keys_to_process.labels(
+                **self.metrics._base()
+            ).set(pending)
+        for work in work_items:
             key = work.work_key
             if self.get_status(key) != ValidationResult.UNKNOWN:
                 continue
@@ -214,6 +252,7 @@ class SyntheticDataValidator:
         """Status polling -> accept / hard invalidate (failing indices) /
         soft invalidate on work-unit mismatch (mod.rs:1248-1356)."""
         out = {"accepted": 0, "rejected": 0, "soft": 0}
+        counted_reject_groups: set[str] = set()
         for skey in self.kv.keys("work_validation_status:*"):
             work_key = skey.split(":", 1)[1]
             if self.kv.get(skey) != ValidationResult.PENDING:
@@ -245,6 +284,18 @@ class SyntheticDataValidator:
                         self._set_status(work_key, ValidationResult.ACCEPT)
                         out["accepted"] += 1
             elif result == "Reject":
+                # one count per GROUP per poll, not per still-pending member
+                if (
+                    gk is not None
+                    and self.metrics is not None
+                    and gk.group_id not in counted_reject_groups
+                ):
+                    counted_reject_groups.add(gk.group_id)
+                    self.metrics.group_validations.labels(
+                        **self.metrics._base(),
+                        group_id=gk.group_id,
+                        result="reject",
+                    ).inc()
                 failing = status.get("failing_indices")
                 if gk is not None and failing is not None:
                     ghash = GROUP_HASH.format(gk.group_id, gk.size, gk.file_num)
@@ -281,6 +332,15 @@ class SyntheticDataValidator:
         node_units = {node: units for _k, node, units in members if node is not None}
         total = sum(units for _k, _n, units in members)
         mismatch = reported is not None and abs(total - reported) > 1
+        if self.metrics is not None:
+            self.metrics.group_work_units_check_total.labels(
+                **self.metrics._base(),
+                group_id=gk.group_id,
+                result="mismatch" if mismatch else "match",
+            ).inc()
+            self.metrics.group_validations.labels(
+                **self.metrics._base(), group_id=gk.group_id, result="accept"
+            ).inc()
         bad_nodes = set()
         if mismatch and node_units:
             expected = reported // len(node_units)
@@ -293,7 +353,7 @@ class SyntheticDataValidator:
             if self.get_status(mkey) != ValidationResult.PENDING:
                 continue
             if node in bad_nodes:
-                self._soft_invalidate(mkey)
+                self._soft_invalidate(mkey, group_key=ghash)
                 out["soft"] += 1
             else:
                 self._set_status(mkey, ValidationResult.ACCEPT)
@@ -320,13 +380,19 @@ class SyntheticDataValidator:
         except LedgerError:
             pass
         self._set_status(work_key, ValidationResult.REJECT)
+        if self.metrics is not None:
+            self.metrics.work_keys_invalidated.labels(**self.metrics._base()).inc()
 
-    def _soft_invalidate(self, work_key: str) -> None:
+    def _soft_invalidate(self, work_key: str, group_key: str = "") -> None:
         try:
             self.ledger.soft_invalidate_work(self.pool_id, work_key)
         except LedgerError:
             pass
         self._set_status(work_key, ValidationResult.WORK_MISMATCH)
+        if self.metrics is not None:
+            self.metrics.work_keys_soft_invalidated.labels(
+                **self.metrics._base(), group_key=group_key
+            ).inc()
 
     def rejections(self) -> list[tuple[str, float]]:
         return self.kv.zrangebyscore(REJECTIONS_ZSET)
@@ -358,6 +424,12 @@ class ValidatorService:
         self._stake_cache: dict[str, tuple[bool, float]] = {}
         self.last_loop = 0.0
         self.rng = np.random.default_rng(0)
+        self.metrics = ValidatorMetrics(wallet.address, pool_id)
+        if synthetic is not None and synthetic.metrics is None:
+            synthetic.metrics = self.metrics
+            for c in synthetic.clients:
+                if c.metrics is None:
+                    c.metrics = self.metrics
 
     # ----- hardware validation (validators/hardware.rs) -----
 
@@ -407,6 +479,7 @@ class ValidatorService:
         hardware validation of unvalidated nodes (sequential, as the
         reference requires for signer-nonce safety)."""
         self.last_loop = time.time()
+        _t0 = time.perf_counter()
         stats: dict = {}
         if self.synthetic is not None:
             stats["work"] = await self.synthetic.validate_work_once()
@@ -429,6 +502,9 @@ class ValidatorService:
                     except LedgerError:
                         pass
         stats["validated_nodes"] = validated
+        self.metrics.validation_loop_duration.labels(
+            **self.metrics._base()
+        ).observe(time.perf_counter() - _t0)
         return stats
 
     # ----- HTTP surface (main.rs:90-121, /rejections, /metrics) -----
@@ -448,10 +524,15 @@ class ValidatorService:
             )
 
         async def metrics(request):
-            lines = ["# TYPE validator_rejections_total gauge"]
             n = len(self.synthetic.rejections()) if self.synthetic else 0
-            lines.append(f"validator_rejections_total {n}")
-            return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+            extra = (
+                "# TYPE validator_rejections_total gauge\n"
+                f"validator_rejections_total {n}\n"
+            )
+            return web.Response(
+                body=self.metrics.render() + extra.encode(),
+                content_type="text/plain",
+            )
 
         app.router.add_get("/health", health)
         app.router.add_get("/rejections", rejections)

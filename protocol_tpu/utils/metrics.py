@@ -1,0 +1,282 @@
+"""Prometheus metrics registries for the orchestrator and validator.
+
+Mirrors the reference's metric families:
+
+  - orchestrator/src/metrics/mod.rs:6-126 — compute_task_gauges,
+    task_info, file-upload + heartbeat counters, node/task/group gauges,
+    nodes_per_task, task_state, status-update duration histogram
+  - orchestrator/src/metrics/sync_service.rs:37-180 — the 10 s
+    store -> registry rebuild (here run on scrape)
+  - validator/src/metrics.rs:8-70 — loop/api histograms, invalidation
+    and group-validation counters
+
+Plus one addition the reference has no analog for: the batch matcher's
+solve-duration histogram (the hot path this framework moves on-device).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_STATUS_BUCKETS = [
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 15.0, 30.0, 45.0,
+    60.0, 90.0, 120.0,
+]
+_LOOP_BUCKETS = [
+    0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 15.0, 30.0, 60.0,
+    120.0, 300.0,
+]
+_API_BUCKETS = [0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0]
+_SOLVE_BUCKETS = [
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+
+class OrchestratorMetrics:
+    """metrics/mod.rs:6-126 families on a private registry."""
+
+    def __init__(self, pool_id: int):
+        self.pool_id = str(pool_id)
+        self.registry = CollectorRegistry()
+        r = self.registry
+        self.compute_task_gauges = Gauge(
+            "compute_gauges",
+            "Compute task gauge metrics",
+            ["node_address", "task_id", "task_name", "label", "pool_id",
+             "group_id", "group_config_name"],
+            registry=r,
+        )
+        self.task_info = Gauge(
+            "task_info",
+            "Task information with metadata",
+            ["task_id", "task_name", "pool_id", "metadata"],
+            registry=r,
+        )
+        self.file_upload_requests_total = Counter(
+            "orchestrator_file_upload_requests",
+            "Total number of file upload requests",
+            ["task_id", "task_name", "node_address", "pool_id"],
+            registry=r,
+        )
+        self.nodes_total = Gauge(
+            "orchestrator_nodes_total",
+            "Total number of nodes by status",
+            ["status", "pool_id"],
+            registry=r,
+        )
+        self.tasks_total = Gauge(
+            "orchestrator_tasks_total",
+            "Total number of tasks",
+            ["pool_id"],
+            registry=r,
+        )
+        self.groups_total = Gauge(
+            "orchestrator_groups_total",
+            "Total number of node groups by configuration",
+            ["configuration_name", "pool_id"],
+            registry=r,
+        )
+        self.heartbeat_requests_total = Counter(
+            "orchestrator_heartbeat_requests",
+            "Total number of heartbeat requests per node",
+            ["node_address", "pool_id"],
+            registry=r,
+        )
+        self.nodes_per_task = Gauge(
+            "orchestrator_nodes_per_task",
+            "Number of nodes actively working on each task",
+            ["task_id", "task_name", "pool_id"],
+            registry=r,
+        )
+        self.task_state = Gauge(
+            "orchestrator_task_state",
+            "Task state reported from nodes (1 active, 0 inactive)",
+            ["node_address", "task_id", "task_state", "pool_id"],
+            registry=r,
+        )
+        self.status_update_execution_time = Histogram(
+            "orchestrator_status_update_execution_time_seconds",
+            "Duration of status update execution",
+            ["pool_id"],
+            buckets=_STATUS_BUCKETS,
+            registry=r,
+        )
+        # framework addition: the on-device matcher's solve cost
+        self.solve_duration = Histogram(
+            "orchestrator_scheduler_solve_duration_seconds",
+            "Duration of batch matcher solves",
+            ["backend", "pool_id"],
+            buckets=_SOLVE_BUCKETS,
+            registry=r,
+        )
+
+    def record_heartbeat(self, node_address: str) -> None:
+        self.heartbeat_requests_total.labels(
+            node_address=node_address, pool_id=self.pool_id
+        ).inc()
+
+    def record_upload_request(
+        self, node_address: str, task_id: str, task_name: str
+    ) -> None:
+        self.file_upload_requests_total.labels(
+            task_id=task_id or "",
+            task_name=task_name or "",
+            node_address=node_address,
+            pool_id=self.pool_id,
+        ).inc()
+
+    def sync(self, store, groups_plugin=None) -> None:
+        """Store -> registry rebuild (sync_service.rs:37-180), run at
+        scrape time instead of on a 10 s loop."""
+        pid = self.pool_id
+        self.nodes_total.clear()
+        by_status: dict[str, int] = {}
+        nodes = store.node_store.get_nodes()
+        for n in nodes:
+            by_status[n.status.value] = by_status.get(n.status.value, 0) + 1
+        for status, count in by_status.items():
+            self.nodes_total.labels(status=status, pool_id=pid).set(count)
+
+        tasks = store.task_store.get_all_tasks()
+        self.tasks_total.clear()
+        self.tasks_total.labels(pool_id=pid).set(len(tasks))
+        names = {t.id: t.name for t in tasks}
+        self.task_info.clear()
+        for t in tasks:
+            self.task_info.labels(
+                task_id=t.id, task_name=t.name, pool_id=pid, metadata=""
+            ).set(1)
+
+        self.groups_total.clear()
+        if groups_plugin is not None:
+            by_config: dict[str, int] = {}
+            for g in groups_plugin.get_groups():
+                by_config[g.configuration_name] = (
+                    by_config.get(g.configuration_name, 0) + 1
+                )
+            for config_name, count in by_config.items():
+                self.groups_total.labels(
+                    configuration_name=config_name, pool_id=pid
+                ).set(count)
+
+        # per-node task state + nodes-per-task from live heartbeats
+        self.task_state.clear()
+        self.nodes_per_task.clear()
+        per_task: dict[str, int] = {}
+        for n in nodes:
+            hb = store.heartbeat_store.get_heartbeat(n.address)
+            if hb is None or not hb.task_id:
+                continue
+            per_task[hb.task_id] = per_task.get(hb.task_id, 0) + 1
+            self.task_state.labels(
+                node_address=n.address,
+                task_id=hb.task_id,
+                task_state=hb.task_state or "UNKNOWN",
+                pool_id=pid,
+            ).set(1)
+        for task_id, count in per_task.items():
+            self.nodes_per_task.labels(
+                task_id=task_id, task_name=names.get(task_id, ""), pool_id=pid
+            ).set(count)
+
+        # workload metrics (container -> bridge -> heartbeat -> store)
+        self.compute_task_gauges.clear()
+        group_of = (
+            {a: g for g in (groups_plugin.get_groups() if groups_plugin else [])
+             for a in g.nodes}
+        )
+        for task_id, labels in store.metrics_store.get_all_metrics().items():
+            for label, per_node in labels.items():
+                for node_addr, value in per_node.items():
+                    g = group_of.get(node_addr)
+                    self.compute_task_gauges.labels(
+                        node_address=node_addr,
+                        task_id=task_id,
+                        task_name=names.get(task_id, ""),
+                        label=label,
+                        pool_id=pid,
+                        group_id=g.id if g else "",
+                        group_config_name=g.configuration_name if g else "",
+                    ).set(value)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class ValidatorMetrics:
+    """validator/src/metrics.rs:8-70 families on a private registry."""
+
+    def __init__(self, validator_id: str, pool_id: int):
+        self.validator_id = validator_id
+        self.pool_id = str(pool_id)
+        self.registry = CollectorRegistry()
+        r = self.registry
+        base = ["validator_id", "pool_id"]
+        self.validation_loop_duration = Histogram(
+            "validator_validation_loop_duration_seconds",
+            "Duration of the validation loop",
+            base,
+            buckets=_LOOP_BUCKETS,
+            registry=r,
+        )
+        self.work_keys_invalidated = Counter(
+            "validator_work_keys_invalidated",
+            "Total work keys invalidated",
+            base,
+            registry=r,
+        )
+        self.work_keys_soft_invalidated = Counter(
+            "validator_work_keys_soft_invalidated",
+            "Total work keys soft invalidated",
+            base + ["group_key"],
+            registry=r,
+        )
+        self.work_keys_to_process = Gauge(
+            "validator_work_keys_to_process",
+            "Work keys to process in the current validation loop",
+            base,
+            registry=r,
+        )
+        self.errors = Counter(
+            "validator_errors",
+            "Total errors",
+            base + ["error"],
+            registry=r,
+        )
+        self.api_duration = Histogram(
+            "validator_api_duration_seconds",
+            "Verification-API request duration",
+            base + ["endpoint"],
+            buckets=_API_BUCKETS,
+            registry=r,
+        )
+        self.api_requests = Counter(
+            "validator_api_requests",
+            "Total verification-API requests",
+            base + ["endpoint", "status"],
+            registry=r,
+        )
+        self.group_validations = Counter(
+            "validator_group_validations",
+            "Total group validations by result",
+            base + ["group_id", "result"],
+            registry=r,
+        )
+        self.group_work_units_check_total = Counter(
+            "validator_group_work_units_check",
+            "Whether the work units match the group total",
+            base + ["group_id", "result"],
+            registry=r,
+        )
+
+    def _base(self) -> dict:
+        return {"validator_id": self.validator_id, "pool_id": self.pool_id}
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -365,6 +365,9 @@ class TestOrchestratorRoutes:
         assert run(flow()) == [200, 429]
 
     def test_prometheus_exposition(self):
+        """Full metric-family parity surface (metrics/mod.rs:6-126):
+        gauges rebuilt at scrape, heartbeat/upload counters, and the
+        status-update + solve histograms."""
         svc, node, _ = self._svc()
         svc.store.node_store.add_node(
             OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
@@ -372,13 +375,51 @@ class TestOrchestratorRoutes:
 
         async def flow():
             async with TestClient(TestServer(svc.make_app())) as client:
+                # heartbeat increments the counter
+                hb = HeartbeatRequest(address=node.address).to_dict()
+                headers, body = sign_request("/heartbeat", node, hb)
+                r0 = await client.post("/heartbeat", json=body, headers=headers)
+                assert r0.status == 200, await r0.text()
+                # upload request increments its counter
+                up = {"file_name": "m.bin", "file_size": 1,
+                      "file_type": "bin", "sha256": "cd" * 32}
+                h2, b2 = sign_request("/storage/request-upload", node, up)
+                await client.post("/storage/request-upload", json=b2, headers=h2)
+                await svc.status_update_once()
                 r = await client.get(
                     "/metrics/prometheus", headers={"Authorization": "Bearer admin"}
                 )
                 return await r.text()
 
         text = run(flow())
-        assert 'orchestrator_nodes_total{status="Healthy"} 1' in text
+        pid = svc.pool_id
+        # the FSM demoted the heartbeating-but-not-in-pool node to Unhealthy
+        assert (
+            f'orchestrator_nodes_total{{pool_id="{pid}",status="Unhealthy"}} 1.0'
+            in text
+        )
+        assert "orchestrator_heartbeat_requests_total{" in text
+        assert "orchestrator_file_upload_requests_total{" in text
+        assert (
+            "orchestrator_status_update_execution_time_seconds_bucket" in text
+        )
+        assert "orchestrator_tasks_total{" in text
+
+    def test_openapi_document(self):
+        svc, node, _ = self._svc()
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                r = await client.get("/openapi.json")
+                return await r.json()
+
+        doc = run(flow())
+        assert doc["openapi"].startswith("3.")
+        assert "/heartbeat" in doc["paths"]
+        assert "post" in doc["paths"]["/heartbeat"]
+        assert "/tasks/{task_id}" in doc["paths"]
+        params = doc["paths"]["/tasks/{task_id}"]["delete"]["parameters"]
+        assert params[0]["name"] == "task_id"
 
 
 class TestStatusFSM:
@@ -657,6 +698,47 @@ class TestSyntheticValidation:
         assert sv.get_status("sha-1") == ValidationResult.WORK_MISMATCH
         assert not ledger.get_work_info(pid, "sha-0").soft_invalidated
         assert ledger.get_work_info(pid, "sha-1").soft_invalidated
+
+    def test_validator_metrics_families(self):
+        """validator/src/metrics.rs parity: loop/api histograms, work-key
+        counters, group work-units check results in the exposition."""
+        from protocol_tpu.utils.metrics import ValidatorMetrics
+
+        ledger, creator, manager, provider, node, pid = make_world()
+        node2 = self._second_node(ledger, provider)
+        storage = MockStorageProvider()
+        vm = ValidatorMetrics("0xval", pid)
+        results = {
+            f"out-gm-2-0-{i}.parquet": {"status": "Accept", "output_flops": 100}
+            for i in range(2)
+        }
+
+        async def flow():
+            app = make_toploc_app(results)
+            async with TestClient(TestServer(app)) as client:
+                toploc = ToplocClient("", client)
+                sv = SyntheticDataValidator(
+                    ledger, pid, storage, [toploc], metrics=vm
+                )
+                self._submit(ledger, manager, provider, node, pid, "sha-0", units=50)
+                self._submit(ledger, manager, provider, node2, pid, "sha-1", units=80)
+                await storage.generate_mapping_file("sha-0", "out-gm-2-0-0.parquet")
+                await storage.generate_mapping_file("sha-1", "out-gm-2-0-1.parquet")
+                await sv.validate_work_once()
+                await sv.validate_work_once()
+                return sv
+
+        run(flow())
+        text = vm.render().decode()
+        assert (
+            'validator_group_work_units_check_total{group_id="gm",'
+            f'pool_id="{pid}",result="mismatch",validator_id="0xval"}} 1.0'
+            in text
+        )
+        assert "validator_work_keys_soft_invalidated_total{" in text
+        assert "validator_api_requests_total{" in text
+        assert "validator_api_duration_seconds_bucket{" in text
+        assert "validator_work_keys_to_process{" in text
 
     def test_incomplete_group_grace_soft_invalidation(self):
         ledger, creator, manager, provider, node, pid = make_world()
