@@ -1,0 +1,32 @@
+# Service image for the Helm charts: one image, five entry points
+# (python -m protocol_tpu.serve {discovery,orchestrator,validator,scheduler,worker}).
+# The scheduler pod additionally needs the TPU-enabled jax wheel; override
+# JAX_SPEC at build time for TPU node pools.
+ARG PYTHON_VERSION=3.12
+FROM python:${PYTHON_VERSION}-slim AS build
+
+ARG JAX_SPEC="jax[cpu]"
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /app
+COPY Makefile ./
+COPY native ./native
+RUN make native
+COPY protocol_tpu ./protocol_tpu
+RUN pip install --no-cache-dir "${JAX_SPEC}" aiohttp grpcio protobuf \
+    cryptography numpy prometheus_client
+
+FROM python:${PYTHON_VERSION}-slim
+ARG PYTHON_VERSION
+ARG VERSION=dev
+ENV PROTOCOL_TPU_VERSION=${VERSION} \
+    PYTHONUNBUFFERED=1
+# docker CLI for the containerized task runtime (worker pods mount the
+# host's docker socket or run dind); control-plane pods just don't use it
+RUN apt-get update && apt-get install -y --no-install-recommends docker.io \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /app
+COPY --from=build /usr/local/lib/python${PYTHON_VERSION}/site-packages /usr/local/lib/python${PYTHON_VERSION}/site-packages
+COPY --from=build /app/protocol_tpu ./protocol_tpu
+COPY --from=build /app/native/libassign_engine.so ./native/libassign_engine.so
+ENTRYPOINT ["python", "-m", "protocol_tpu.serve"]

@@ -1,0 +1,3 @@
+{{- define "disc.fullname" -}}
+{{ .Chart.Name }}-{{ .Values.computePoolId }}
+{{- end -}}

@@ -1,0 +1,3 @@
+{{- define "orch.fullname" -}}
+{{ .Chart.Name }}-{{ .Values.computePoolId }}
+{{- end -}}

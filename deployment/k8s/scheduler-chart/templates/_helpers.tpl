@@ -1,0 +1,3 @@
+{{- define "sched.fullname" -}}
+{{ .Chart.Name }}
+{{- end -}}

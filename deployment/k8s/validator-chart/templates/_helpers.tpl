@@ -1,0 +1,3 @@
+{{- define "val.fullname" -}}
+{{ .Chart.Name }}-{{ .Values.computePoolId }}
+{{- end -}}

@@ -1,0 +1,435 @@
+"""Standalone service entry points for production deployments.
+
+The reference ships one binary per service (discovery/orchestrator/
+validator/worker mains wired by clap CLIs); the devnet here runs them all
+in one process. This module is the per-pod equivalent the Helm charts
+exec: each subcommand boots ONE service against a shared ledger API
+(chain/remote.RemoteLedger — the counterpart of the reference services'
+JSON-RPC contract wrappers) and runs its loops.
+
+    python -m protocol_tpu.serve discovery     --ledger-url ... --pool-id N
+    python -m protocol_tpu.serve orchestrator  --ledger-url ... --pool-id N
+    python -m protocol_tpu.serve validator     --ledger-url ... --pool-id N
+    python -m protocol_tpu.serve scheduler     --address 0.0.0.0:50061
+    python -m protocol_tpu.serve worker        --ledger-url ... --pool-id N
+
+Secrets come from env (MANAGER_KEY / ADMIN_API_KEY / S3_CREDENTIALS /
+PROVIDER_KEY / NODE_KEY), mirroring the reference charts' envFromSecret.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from typing import Optional
+
+VERSION = os.environ.get("PROTOCOL_TPU_VERSION", "dev")
+
+
+def _wallet_from_env(var: str):
+    from protocol_tpu.security import Wallet
+
+    key = os.environ.get(var, "")
+    if not key:
+        raise SystemExit(f"{var} env var required")
+    return Wallet.from_hex(key)
+
+
+def _ledger(args):
+    from protocol_tpu.chain.remote import RemoteLedger
+
+    return RemoteLedger(
+        args.ledger_url, admin_api_key=os.environ.get("LEDGER_API_KEY", "")
+    )
+
+
+def _storage(http):
+    creds = os.environ.get("S3_CREDENTIALS", "")
+    bucket = os.environ.get("BUCKET_NAME", "")
+    if creds and bucket:
+        from protocol_tpu.utils.cloud_storage import GcsStorageProvider
+
+        return GcsStorageProvider(bucket, creds, http)
+    root = os.environ.get("STORAGE_DIR", "")
+    if root:
+        from protocol_tpu.utils.storage import LocalDirStorageProvider
+
+        return LocalDirStorageProvider(
+            root, public_base_url=os.environ.get("STORAGE_PUBLIC_URL", "")
+        )
+    return None
+
+
+async def _run_app(app, port: int) -> None:
+    from aiohttp import web
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    print(f"listening on :{port} (version {VERSION})", flush=True)
+
+
+async def serve_discovery(args) -> None:
+    from protocol_tpu.services.discovery import DiscoveryService
+    from protocol_tpu.utils.location import HttpLocationResolver
+
+    resolver = None
+    if args.location_url:
+        import aiohttp
+
+        resolver = HttpLocationResolver(
+            args.location_url, aiohttp.ClientSession()
+        )
+    svc = DiscoveryService(
+        _ledger(args),
+        args.pool_id,
+        max_nodes_per_ip=args.max_nodes_per_ip,
+        admin_api_key=os.environ.get("ADMIN_API_KEY", "admin"),
+        location_resolver=resolver,
+        persist_path=(
+            os.path.join(args.state_dir, "discovery.aof") if args.state_dir else None
+        ),
+    )
+    await _run_app(svc.make_app(), args.port)
+    while True:
+        try:
+            await asyncio.to_thread(svc.chain_sync_once)
+            await svc.enrich_locations_once()
+        except Exception as e:
+            print(f"discovery loop error: {e}", file=sys.stderr)
+        await asyncio.sleep(args.sync_interval)
+
+
+async def serve_orchestrator(args) -> None:
+    import aiohttp
+
+    from protocol_tpu.models.node import DiscoveryNode
+    from protocol_tpu.security import sign_request
+    from protocol_tpu.sched import Scheduler
+    from protocol_tpu.sched.node_groups import (
+        NodeGroupConfiguration,
+        NodeGroupsPlugin,
+    )
+    from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+    from protocol_tpu.services.orchestrator import OrchestratorService
+    from protocol_tpu.store import StoreContext
+    from protocol_tpu.store.kv import KVStore
+
+    wallet = _wallet_from_env("MANAGER_KEY")
+    ledger = _ledger(args)
+    session = aiohttp.ClientSession()
+    store = StoreContext(
+        KVStore(
+            persist_path=(
+                os.path.join(args.state_dir, "orchestrator.aof")
+                if args.state_dir
+                else None
+            )
+        )
+    )
+
+    groups_plugin = None
+    group_configs = os.environ.get("NODE_GROUP_CONFIGS", "")
+    if group_configs:
+        configs = [
+            NodeGroupConfiguration.from_dict(d) for d in json.loads(group_configs)
+        ]
+        groups_plugin = NodeGroupsPlugin(store, configs)
+        groups_plugin.attach_observers()
+        scheduler = Scheduler(store, plugins=[groups_plugin])
+    elif args.scheduler_backend.startswith("remote"):
+        from protocol_tpu.services.scheduler_grpc import RemoteBatchMatcher
+
+        addr = args.scheduler_backend.partition(":")[2] or "127.0.0.1:50061"
+        matcher = RemoteBatchMatcher(store, addr)
+        matcher.attach_observers()
+        scheduler = Scheduler(store, batch_matcher=matcher)
+    else:
+        matcher = TpuBatchMatcher(store)
+        matcher.attach_observers()
+        scheduler = Scheduler(store, batch_matcher=matcher)
+
+    discovery_urls = [
+        u for u in os.environ.get("DISCOVERY_URLS", "").split(",") if u
+    ]
+
+    async def discovery_fetcher():
+        for url in discovery_urls:
+            headers, _ = sign_request(f"/api/pool/{args.pool_id}", wallet)
+            try:
+                async with session.get(
+                    f"{url}/api/pool/{args.pool_id}", headers=headers
+                ) as resp:
+                    data = await resp.json()
+                    return [
+                        DiscoveryNode.from_dict(d) for d in data.get("data", [])
+                    ]
+            except Exception:
+                continue
+        return []
+
+    async def invite_sender(node, payload):
+        url = (node.p2p_addresses or [None])[0]
+        if not url:
+            return False
+        headers, body = sign_request("/control/invite", wallet, payload)
+        try:
+            async with session.post(
+                f"{url}/invite", json=body, headers=headers
+            ) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    svc = OrchestratorService(
+        ledger,
+        args.pool_id,
+        wallet,
+        store=store,
+        scheduler=scheduler,
+        groups_plugin=groups_plugin,
+        storage=_storage(session),
+        discovery_fetcher=discovery_fetcher if discovery_urls else None,
+        invite_sender=invite_sender,
+        admin_api_key=os.environ.get("ADMIN_API_KEY", "admin"),
+        heartbeat_url=os.environ.get("HEARTBEAT_URL", f"http://localhost:{args.port}"),
+        uploads_per_hour=int(os.environ.get("UPLOADS_PER_HOUR", "3")),
+        control_http=session,
+    )
+    await svc.serve(host="0.0.0.0", port=args.port)
+    print(f"orchestrator on :{args.port} (version {VERSION})", flush=True)
+    while True:  # loops run as tasks inside serve(); keep the process alive
+        await asyncio.sleep(3600)
+
+
+async def serve_validator(args) -> None:
+    import aiohttp
+
+    from protocol_tpu.models.node import DiscoveryNode
+    from protocol_tpu.security import sign_request
+    from protocol_tpu.services.validator import (
+        SyntheticDataValidator,
+        ToplocClient,
+        ValidatorService,
+    )
+
+    wallet = _wallet_from_env("VALIDATOR_KEY")
+    ledger = _ledger(args)
+    session = aiohttp.ClientSession()
+
+    synthetic = None
+    storage = _storage(session)
+    toploc_configs = os.environ.get("TOPLOC_CONFIGS", "")
+    if toploc_configs and storage is not None:
+        clients = [
+            ToplocClient(
+                c["url"],
+                session,
+                auth_token=c.get("auth_token"),
+                file_prefix_filter=c.get("file_prefix_filter"),
+            )
+            for c in json.loads(toploc_configs)
+        ]
+        synthetic = SyntheticDataValidator(
+            ledger,
+            args.pool_id,
+            storage,
+            clients,
+            persist_path=(
+                os.path.join(args.state_dir, "validator.aof")
+                if args.state_dir
+                else None
+            ),
+        )
+
+    discovery_urls = [
+        u for u in os.environ.get("DISCOVERY_URLS", "").split(",") if u
+    ]
+
+    async def fetcher():
+        for url in discovery_urls:
+            headers, _ = sign_request("/api/validator", wallet)
+            try:
+                async with session.get(
+                    f"{url}/api/validator", headers=headers
+                ) as resp:
+                    data = await resp.json()
+                    return [
+                        DiscoveryNode.from_dict(d) for d in data.get("data", [])
+                    ]
+            except Exception:
+                continue
+        return []
+
+    svc = ValidatorService(
+        wallet,
+        ledger,
+        args.pool_id,
+        synthetic=synthetic,
+        discovery_fetcher=fetcher if discovery_urls else None,
+        http=session,
+    )
+    await _run_app(svc.make_app(), args.port)
+    while True:
+        try:
+            await svc.validation_loop_once()
+        except Exception as e:
+            print(f"validation loop error: {e}", file=sys.stderr)
+        await asyncio.sleep(args.loop_interval)
+
+
+async def serve_ledger_api(args) -> None:
+    """Dev economic substrate as a standalone pod (the reference devnet's
+    reth + contracts; production would point LEDGER_URL at a real chain
+    gateway instead)."""
+    from protocol_tpu.chain import Ledger
+    from protocol_tpu.services.ledger_api import LedgerApiService
+
+    ledger = Ledger()
+    svc = LedgerApiService(
+        ledger, admin_api_key=os.environ.get("ADMIN_API_KEY", "admin")
+    )
+    await _run_app(svc.make_app(), args.port)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def serve_scheduler(args) -> None:
+    """The gRPC kernel backend — the pod that actually holds the TPU."""
+    from protocol_tpu.services.scheduler_grpc import serve
+
+    server = serve(address=args.address, max_workers=args.max_workers)
+    print(f"scheduler backend on {args.address} (version {VERSION})", flush=True)
+    server.wait_for_termination()
+
+
+async def serve_worker(args) -> None:
+    import aiohttp
+
+    from protocol_tpu.services.worker import (
+        SubprocessRuntime,
+        TaskBridge,
+        WorkerAgent,
+        detect_compute_specs,
+    )
+
+    provider = _wallet_from_env("PROVIDER_KEY")
+    node = _wallet_from_env("NODE_KEY")
+    ledger = _ledger(args)
+    session = aiohttp.ClientSession()
+    specs, report = detect_compute_specs("/", probe_accelerator=False)
+    if args.runtime == "docker":
+        from protocol_tpu.services.docker_runtime import DockerRuntime
+
+        runtime = DockerRuntime(socket_path=args.socket_path)
+    else:
+        runtime = SubprocessRuntime(socket_path=args.socket_path)
+    agent = WorkerAgent(
+        provider,
+        node,
+        ledger,
+        args.pool_id,
+        runtime=runtime,
+        compute_specs=specs,
+        ip_address=args.advertise_ip,
+        port=args.port,
+        http=session,
+    )
+    agent.register_on_ledger()
+    bridge = TaskBridge(args.socket_path, agent)
+    await bridge.start()
+    await _run_app(agent.make_control_app(), args.port)
+    urls = [u for u in args.discovery_urls.split(",") if u]
+    await agent.upload_to_discovery(urls)
+    while True:
+        try:
+            await agent.heartbeat_once()
+            await agent.upload_to_discovery(urls)
+        except Exception as e:
+            print(f"worker loop error: {e}", file=sys.stderr)
+        await asyncio.sleep(10.0)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="protocol_tpu.serve")
+    parser.add_argument("--version", action="version", version=VERSION)
+    sub = parser.add_subparsers(dest="service", required=True)
+
+    def common(p):
+        # flags win; env (the charts' configuration surface) is the default
+        p.add_argument(
+            "--ledger-url", default=os.environ.get("LEDGER_URL", "")
+        )
+        p.add_argument(
+            "--pool-id",
+            type=int,
+            default=int(os.environ.get("COMPUTE_POOL_ID", "-1")),
+        )
+        p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
+
+    p = sub.add_parser("discovery")
+    common(p)
+    p.add_argument("--port", type=int, default=8089)
+    p.add_argument("--max-nodes-per-ip", type=int, default=5)
+    p.add_argument("--location-url", default="")
+    p.add_argument("--sync-interval", type=float, default=10.0)
+
+    p = sub.add_parser("orchestrator")
+    common(p)
+    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--scheduler-backend", default="local")
+
+    p = sub.add_parser("validator")
+    common(p)
+    p.add_argument("--port", type=int, default=9879)
+    p.add_argument("--loop-interval", type=float, default=5.0)
+
+    p = sub.add_parser("scheduler")
+    p.add_argument("--address", default="0.0.0.0:50061")
+    p.add_argument("--max-workers", type=int, default=4)
+
+    p = sub.add_parser("ledger-api")
+    p.add_argument("--port", type=int, default=8095)
+
+    p = sub.add_parser("worker")
+    common(p)
+    p.add_argument("--port", type=int, default=8091)
+    p.add_argument("--advertise-ip", default="127.0.0.1")
+    p.add_argument("--discovery-urls", default="")
+    p.add_argument("--runtime", choices=["subprocess", "docker"], default="docker")
+    p.add_argument("--socket-path", default="/var/run/protocol-tpu/bridge.sock")
+
+    args = parser.parse_args(argv)
+    # Operational platform pin (e.g. PROTOCOL_TPU_FORCE_PLATFORM=cpu for
+    # control-plane pods with no accelerator): applied via jax.config, which
+    # outranks JAX_PLATFORMS when a site hook has already forced a platform.
+    forced = os.environ.get("PROTOCOL_TPU_FORCE_PLATFORM", "")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+    if args.service not in ("scheduler", "ledger-api"):
+        if not args.ledger_url:
+            parser.error("--ledger-url (or LEDGER_URL env) required")
+        if args.pool_id < 0:
+            parser.error("--pool-id (or COMPUTE_POOL_ID env) required")
+    if args.service == "scheduler":
+        serve_scheduler(args)
+        return 0
+    coro = {
+        "discovery": serve_discovery,
+        "orchestrator": serve_orchestrator,
+        "validator": serve_validator,
+        "worker": serve_worker,
+        "ledger-api": serve_ledger_api,
+    }[args.service](args)
+    asyncio.run(coro)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

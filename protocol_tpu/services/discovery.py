@@ -134,6 +134,13 @@ class DiscoveryService:
     async def register_node(self, request: web.Request) -> web.Response:
         body = request.get("auth_body") or {}
         address = request["auth_address"]
+        # await-free gate logic with (possibly remote) ledger round-trips:
+        # off the event loop so a stalled ledger API cannot pin /health
+        import asyncio
+
+        return await asyncio.to_thread(self._register_node, body, address)
+
+    def _register_node(self, body: dict, address: str) -> web.Response:
         node = Node.from_dict(body)
 
         # x-address must be the node being registered (node.rs:32-35)
@@ -186,8 +193,12 @@ class DiscoveryService:
         return web.json_response(ApiResponse(True, "ok").to_dict())
 
     async def get_pool_nodes(self, request: web.Request) -> web.Response:
+        import asyncio
+
         # signed readers only: orchestrator (compute manager) or creator
-        pool = self.ledger.get_pool_info(int(request.match_info["pool_id"]))
+        pool = await asyncio.to_thread(
+            self.ledger.get_pool_info, int(request.match_info["pool_id"])
+        )
         addr = request["auth_address"]
         if addr not in (pool.creator, pool.compute_manager_key):
             return _err("not authorized for pool", 401)

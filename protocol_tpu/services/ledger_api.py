@@ -41,6 +41,9 @@ WRITE_OPS = {
     "submit_work",
     "invalidate_work",
     "soft_invalidate_work",
+    "leave_compute_pool",
+    "grant_validator_role",
+    "revoke_validator_role",
 }
 
 READ_OPS = {
@@ -61,6 +64,7 @@ READ_OPS = {
     "get_work_since",
     "get_rewards",
     "calculate_stake",
+    "get_validator_role",
 }
 
 

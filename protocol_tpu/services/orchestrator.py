@@ -861,13 +861,16 @@ class OrchestratorService:
         (:118-142)."""
         _t0 = time.perf_counter()
         try:
-            await self._status_update_once()
+            # await-free body + possibly-remote ledger calls: run in a
+            # thread so a stalled ledger API cannot pin the event loop
+            # (and /health with it)
+            await asyncio.to_thread(self._status_update_once)
         finally:
             self.metrics.status_update_execution_time.labels(
                 pool_id=str(self.pool_id)
             ).observe(time.perf_counter() - _t0)
 
-    async def _status_update_once(self) -> None:
+    def _status_update_once(self) -> None:
         hs = self.store.heartbeat_store
         for node in self.store.node_store.get_nodes():
             addr = node.address

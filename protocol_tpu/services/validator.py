@@ -192,7 +192,9 @@ class SyntheticDataValidator:
         validations, poll statuses, process expired groups."""
         stats = {"triggered": 0, "accepted": 0, "rejected": 0, "soft": 0}
         since = time.time() - self.work_window
-        work_items = self.ledger.get_work_since(self.pool_id, since)
+        work_items = await asyncio.to_thread(
+            self.ledger.get_work_since, self.pool_id, since
+        )
         if self.metrics is not None:
             # only keys still awaiting processing: the backlog gauge must
             # drain to 0, not sit at the window's total forever
@@ -488,16 +490,20 @@ class ValidatorService:
         if self.discovery_fetcher is not None:
             for dn in await self.discovery_fetcher():
                 node_id = dn.node.id
-                if self.ledger.is_node_validated(node_id):
+                # ledger reads via to_thread: with a RemoteLedger these are
+                # HTTP round-trips that must not pin the event loop
+                if await asyncio.to_thread(self.ledger.is_node_validated, node_id):
                     continue
-                if not self._stake_ok(dn.node.provider_address):
+                if not await asyncio.to_thread(
+                    self._stake_ok, dn.node.provider_address
+                ):
                     continue
                 urls = dn.node.worker_p2p_addresses or []
                 if not urls:
                     continue
                 if await self.challenge_node(urls[0]):
                     try:
-                        self.ledger.validate_node(node_id)
+                        await asyncio.to_thread(self.ledger.validate_node, node_id)
                         validated += 1
                     except LedgerError:
                         pass

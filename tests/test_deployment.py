@@ -1,0 +1,141 @@
+"""Deployment artifacts: chart structure/values sanity and the serve
+entry points (reference deployment/k8s + per-service binaries)."""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+K8S = os.path.join(REPO, "deployment", "k8s")
+CHARTS = ["discovery-chart", "orchestrator-chart", "validator-chart",
+          "scheduler-chart"]
+
+
+@pytest.mark.parametrize("chart", CHARTS)
+def test_chart_structure_and_values(chart):
+    base = os.path.join(K8S, chart)
+    meta = yaml.safe_load(open(os.path.join(base, "Chart.yaml")))
+    assert meta["apiVersion"] == "v2" and meta["name"].startswith("protocol-tpu")
+    values = yaml.safe_load(open(os.path.join(base, "values.yaml")))
+    assert "image" in values
+    templates = os.listdir(os.path.join(base, "templates"))
+    assert "deployment.yaml" in templates and "service.yaml" in templates
+
+
+@pytest.mark.parametrize("chart", CHARTS)
+def test_templates_reference_defined_values(chart):
+    """Every .Values.x.y referenced by a template must exist in
+    values.yaml (the cheap half of `helm lint` without helm)."""
+    base = os.path.join(K8S, chart)
+    values = yaml.safe_load(open(os.path.join(base, "values.yaml")))
+    for name in os.listdir(os.path.join(base, "templates")):
+        text = open(os.path.join(base, "templates", name)).read()
+        for m in re.finditer(r"\.Values\.([A-Za-z0-9_.]+)", text):
+            node = values
+            for part in m.group(1).split("."):
+                assert isinstance(node, dict) and part in node, (
+                    f"{chart}/templates/{name} references undefined "
+                    f".Values.{m.group(1)}"
+                )
+                node = node[part]
+
+
+def test_scheduler_chart_places_on_tpu_node_pool():
+    text = open(
+        os.path.join(K8S, "scheduler-chart", "templates", "deployment.yaml")
+    ).read()
+    assert "cloud.google.com/gke-tpu-accelerator" in text
+    assert "google.com/tpu" in text
+
+
+def test_serve_cli_surface():
+    """Arg parsing + required env/flag validation, without booting."""
+    env = dict(os.environ, PROTOCOL_TPU_VERSION="9.9-test")
+    out = subprocess.run(
+        [sys.executable, "-m", "protocol_tpu.serve", "--version"],
+        capture_output=True, text=True, env=env, cwd=REPO,
+    )
+    assert "9.9-test" in out.stdout
+
+    # missing ledger url fails loudly, not at first request
+    out2 = subprocess.run(
+        [sys.executable, "-m", "protocol_tpu.serve", "discovery",
+         "--pool-id", "0"],
+        capture_output=True, text=True, cwd=REPO,
+        env={k: v for k, v in os.environ.items() if k != "LEDGER_URL"},
+    )
+    assert out2.returncode != 0
+    assert "ledger-url" in out2.stderr.lower()
+
+
+def test_serve_discovery_boots_against_live_ledger_api(tmp_path):
+    """Multi-process shape: ledger API in-process, discovery booted via
+    the serve entry point in a SUBPROCESS (the pod shape), health-checked
+    over HTTP, then shut down."""
+    import asyncio
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    from aiohttp import web
+
+    from protocol_tpu.chain import Ledger
+    from protocol_tpu.services.ledger_api import LedgerApiService
+
+    ledger = Ledger()
+    did = ledger.create_domain("d")
+    pid = ledger.create_pool(did, "0xc", "0xm", "")
+    ready = threading.Event()
+    state = {}
+
+    def run_api():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            svc = LedgerApiService(ledger)
+            runner = web.AppRunner(svc.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            state["port"] = runner.addresses[0][1]
+            ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=run_api, daemon=True).start()
+    assert ready.wait(10)
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "protocol_tpu.serve", "discovery",
+         "--ledger-url", f"http://127.0.0.1:{state['port']}",
+         "--pool-id", str(pid), "--port", str(port)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 30
+        last = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=1
+                ) as resp:
+                    last = json.loads(resp.read())
+                    break
+            except Exception:
+                time.sleep(0.3)
+        assert last == {"status": "ok"}, last
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
