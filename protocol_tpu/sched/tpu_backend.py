@@ -26,6 +26,7 @@ growth, not on every membership change.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Optional
@@ -229,16 +230,30 @@ class TpuBatchMatcher:
         ep = self.encoder.encode_providers(specs, locations=locs, pad_to=p_bucket)
 
         assigned = np.zeros(P, bool)
+        truncated_slots = 0
 
         # ---- phase 1: bounded tasks -> replica slots -> auction
         if bounded:
             req_by_task = {i: task_requirements(tasks[i]) for i, _ in bounded}
             slot_task: list[int] = []
             for i, r in bounded:
-                for _ in range(min(r, P)):
-                    if len(slot_task) >= self.max_replica_slots:
-                        break
-                    slot_task.append(i)
+                take = min(
+                    min(r, P), self.max_replica_slots - len(slot_task)
+                )
+                slot_task.extend([i] * take)
+                if len(slot_task) >= self.max_replica_slots:
+                    break
+            # arithmetic, not loop iterations: demand can be ~1M slots
+            truncated_slots = sum(min(r, P) for _, r in bounded) - len(slot_task)
+            if truncated_slots:
+                # never a silent cap: at 1M-scale demand, dropped replica
+                # slots are a capacity decision the operator must see
+                logging.getLogger(__name__).warning(
+                    "replica demand exceeds max_replica_slots=%d: "
+                    "%d slots dropped this solve",
+                    self.max_replica_slots,
+                    truncated_slots,
+                )
             reqs = [req_by_task[i] for i in slot_task]
             prios = [prio[i] for i in slot_task]
             s_bucket = _pow2_bucket(len(slot_task))
@@ -272,5 +287,6 @@ class TpuBatchMatcher:
             "bounded_tasks": len(bounded),
             "assigned": len(assignment),
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
+            "truncated_replica_slots": truncated_slots,
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
         }

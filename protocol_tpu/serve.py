@@ -132,6 +132,16 @@ async def serve_orchestrator(args) -> None:
         )
     )
 
+    backend = args.scheduler_backend
+    if backend != "local" and not (
+        backend == "remote" or backend.startswith("remote:")
+    ):
+        raise SystemExit(
+            f"unknown --scheduler-backend {backend!r} "
+            "(want local | remote | remote:HOST:PORT)"
+        )
+
+    grpc_server = None
     groups_plugin = None
     group_configs = os.environ.get("NODE_GROUP_CONFIGS", "")
     if group_configs:
@@ -141,17 +151,31 @@ async def serve_orchestrator(args) -> None:
         groups_plugin = NodeGroupsPlugin(store, configs)
         groups_plugin.attach_observers()
         scheduler = Scheduler(store, plugins=[groups_plugin])
-    elif args.scheduler_backend.startswith("remote"):
-        from protocol_tpu.services.scheduler_grpc import RemoteBatchMatcher
+    elif backend != "local":
+        from protocol_tpu.services import scheduler_grpc
 
-        addr = args.scheduler_backend.partition(":")[2] or "127.0.0.1:50061"
-        matcher = RemoteBatchMatcher(store, addr)
+        addr = backend.partition(":")[2]
+        if not addr:
+            # bare "remote": boot an in-process backend (devnet semantics);
+            # hold the reference or the grpc.Server is GC'd and stops
+            addr = "127.0.0.1:50061"
+            grpc_server = scheduler_grpc.serve(addr)
+        matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
         matcher.attach_observers()
         scheduler = Scheduler(store, batch_matcher=matcher)
     else:
         matcher = TpuBatchMatcher(store)
         matcher.attach_observers()
         scheduler = Scheduler(store, batch_matcher=matcher)
+
+    webhook = None
+    webhook_configs = os.environ.get("WEBHOOK_CONFIGS", "")
+    if webhook_configs:
+        from protocol_tpu.sched.webhook import WebhookConfig, WebhookPlugin
+
+        webhook = WebhookPlugin(
+            WebhookConfig.from_json_env(webhook_configs), http=session
+        )
 
     discovery_urls = [
         u for u in os.environ.get("DISCOVERY_URLS", "").split(",") if u
@@ -199,7 +223,11 @@ async def serve_orchestrator(args) -> None:
         heartbeat_url=os.environ.get("HEARTBEAT_URL", f"http://localhost:{args.port}"),
         uploads_per_hour=int(os.environ.get("UPLOADS_PER_HOUR", "3")),
         control_http=session,
+        webhook=webhook,
     )
+    svc.grpc_server = grpc_server  # keep the in-process backend alive
+    if webhook is not None:
+        webhook.start()
     await svc.serve(host="0.0.0.0", port=args.port)
     print(f"orchestrator on :{args.port} (version {VERSION})", flush=True)
     while True:  # loops run as tasks inside serve(); keep the process alive

@@ -23,6 +23,7 @@ sync.rs:16,76-87,135-222); location enrichment via a pluggable resolver
 from __future__ import annotations
 
 import json
+import threading
 import time
 from typing import Awaitable, Callable, Optional
 
@@ -109,6 +110,10 @@ class DiscoveryService:
         self.max_nodes_per_ip = max_nodes_per_ip
         self.admin_api_key = admin_api_key
         self.location_resolver = location_resolver
+        # _register_node and chain_sync_once run in worker threads (their
+        # ledger calls may be remote HTTP): this lock restores the
+        # read-modify-write serialization the event loop used to provide
+        self._write_lock = threading.Lock()
 
     # ---------------- HTTP surface ----------------
 
@@ -141,6 +146,10 @@ class DiscoveryService:
         return await asyncio.to_thread(self._register_node, body, address)
 
     def _register_node(self, body: dict, address: str) -> web.Response:
+        with self._write_lock:
+            return self._register_node_locked(body, address)
+
+    def _register_node_locked(self, body: dict, address: str) -> web.Response:
         node = Node.from_dict(body)
 
         # x-address must be the node being registered (node.rs:32-35)
@@ -235,6 +244,10 @@ class DiscoveryService:
     def chain_sync_once(self) -> int:
         """One sync tick (chainsync/sync.rs:46-132): refresh ledger-derived
         flags per node, writing only on change. Returns changed count."""
+        with self._write_lock:
+            return self._chain_sync_once_locked()
+
+    def _chain_sync_once_locked(self) -> int:
         changed = 0
         for dn in self.store.all():
             node_id = dn.node.id

@@ -831,7 +831,8 @@ class OrchestratorService:
         if self.invite_sender is None:
             return 0
         invited = 0
-        pool = self.ledger.get_pool_info(self.pool_id)
+        # possibly-remote ledger read off the event loop
+        pool = await asyncio.to_thread(self.ledger.get_pool_info, self.pool_id)
         for node in self.store.node_store.get_uninvited_nodes():
             nonce = uuid.uuid4().hex
             expiration = time.time() + 600

@@ -275,12 +275,12 @@ class SyntheticDataValidator:
             if result == "Accept":
                 reported = status.get("output_flops")
                 if gk is not None:
-                    self._accept_group(gk, reported, out)
+                    await asyncio.to_thread(self._accept_group, gk, reported, out)
                 else:
                     claimed = info.get("units", 0)
                     if reported is not None and claimed and reported != claimed:
                         # work-unit mismatch -> soft invalidate (types.rs:49-62)
-                        self._soft_invalidate(work_key)
+                        await asyncio.to_thread(self._soft_invalidate, work_key)
                         out["soft"] += 1
                     else:
                         self._set_status(work_key, ValidationResult.ACCEPT)
@@ -304,13 +304,13 @@ class SyntheticDataValidator:
                     members = self.kv.hgetall(ghash)
                     for idx_str, member_key in members.items():
                         if int(idx_str) in failing:
-                            self._hard_invalidate(member_key)
+                            await asyncio.to_thread(self._hard_invalidate, member_key)
                             out["rejected"] += 1
                         elif self.get_status(member_key) == ValidationResult.PENDING:
                             self._set_status(member_key, ValidationResult.ACCEPT)
                             out["accepted"] += 1
                 else:
-                    self._hard_invalidate(work_key)
+                    await asyncio.to_thread(self._hard_invalidate, work_key)
                     out["rejected"] += 1
             elif result == "Crashed":
                 self._set_status(work_key, ValidationResult.CRASHED)
@@ -371,7 +371,7 @@ class SyntheticDataValidator:
         for ghash, _ in expired:
             for member_key in self.kv.hgetall(ghash).values():
                 if self.get_status(member_key) == ValidationResult.PENDING:
-                    self._soft_invalidate(member_key)
+                    await asyncio.to_thread(self._soft_invalidate, member_key)
                     count += 1
             self.kv.zrem(INCOMPLETE_GROUPS_ZSET, ghash)
         return count
