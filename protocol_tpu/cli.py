@@ -66,6 +66,26 @@ def main(argv=None) -> int:
     p = sub.add_parser("balance")
     p.add_argument("--address", required=True)
 
+    p = sub.add_parser(
+        "check",
+        help="hardware/software readiness report "
+        "(worker/src/cli/command.rs Check)",
+    )
+    p.add_argument("--storage-path", default="/")
+    p.add_argument("--probe-accelerator", action="store_true")
+
+    p = sub.add_parser(
+        "deregister",
+        help="remove a compute node and reclaim its stake "
+        "(worker/src/cli/command.rs Deregister)",
+    )
+    p.add_argument("--provider", required=True)
+    p.add_argument("--node", required=True)
+    p.add_argument(
+        "--reclaim", type=int, default=0,
+        help="stake amount to reclaim after removal (0 = none)",
+    )
+
     # ---- chain admin ops (dev-utils)
     p = sub.add_parser("mint")
     p.add_argument("--address", required=True)
@@ -147,6 +167,22 @@ def main(argv=None) -> int:
         w = Wallet.from_hex(args.key)
         _print({"address": w.address, "signature": w.sign_message(args.message)})
         return 0
+    if args.cmd == "check":
+        from protocol_tpu.services.worker import detect_compute_specs
+
+        specs, report = detect_compute_specs(
+            args.storage_path, probe_accelerator=args.probe_accelerator
+        )
+        _print(
+            {
+                "compute_specs": specs.to_dict(),
+                "issues": [
+                    {"level": i.level, "message": i.message} for i in report.issues
+                ],
+                "ready": not report.critical,
+            }
+        )
+        return 0 if not report.critical else 1
 
     async def dispatch() -> int:
         if args.cmd == "balance":
@@ -191,6 +227,17 @@ def main(argv=None) -> int:
                 args, "write", "eject_node",
                 {"pool_id": args.pool_id, "node": args.node, "caller": args.caller},
             )
+        if args.cmd == "deregister":
+            rc = await ledger_call(
+                args, "write", "remove_compute_node",
+                {"provider": args.provider, "node": args.node},
+            )
+            if rc == 0 and args.reclaim > 0:
+                rc = await ledger_call(
+                    args, "write", "reclaim_stake",
+                    {"provider": args.provider, "amount": args.reclaim},
+                )
+            return rc
         if args.cmd == "submit-work":
             return await ledger_call(
                 args, "write", "submit_work",

@@ -118,11 +118,20 @@ class NodeGroup:
 
 
 class TaskSwitchingPolicy(str, enum.Enum):
-    """Whether solo-group merging may move a node off its current task
-    (mod.rs:71-98)."""
+    """Whether solo-group merging may move a node off its current task.
+
+    The reference models this as {enabled, prefer_larger_groups}
+    (mod.rs:71-98, should_switch_tasks mod.rs:257-296):
+      NEVER         = enabled=false
+      IF_UNASSIGNED = enabled, prefer_larger_groups=false (merge only when
+                      no solo in the batch holds a task)
+      ALWAYS        = enabled, prefer_larger_groups=true (the default)
+    IF_SAME_TASK is this framework's extra conservative variant: merge only
+    solos already on the same task (never switches anything)."""
 
     ALWAYS = "always"
     NEVER = "never"
+    IF_UNASSIGNED = "if_unassigned"
     IF_SAME_TASK = "if_same_task"
 
 
@@ -317,46 +326,159 @@ class NodeGroupsPlugin:
         return formed
 
     def try_merge_solo_groups(self) -> int:
-        """Merge single-node groups of the same configuration
-        (mod.rs:631-860), gated by the task-switching policy."""
-        solos_by_config: dict[str, list[NodeGroup]] = {}
-        for g in self.get_groups():
-            if len(g.nodes) == 1:
-                solos_by_config.setdefault(g.configuration_name, []).append(g)
-
+        """Merge single-node groups per configuration (mod.rs:631-860):
+        collect compatible solos, build a proximity-ordered merge batch
+        (seed = first solo with a located node, nearest first,
+        mod.rs:760-850), gate on the task-switching policy
+        (should_switch_tasks, mod.rs:257-296), then dissolve + create in
+        one atomic pipeline and give the merged group the best applicable
+        task (find_best_task_for_group, mod.rs:1122-1188)."""
+        if self.merge_policy == TaskSwitchingPolicy.NEVER:
+            return 0
         merged = 0
-        for name, solos in solos_by_config.items():
-            config = self.config_by_name.get(name)
-            if config is None or len(solos) < 2:
-                continue
-            if self.merge_policy == TaskSwitchingPolicy.NEVER:
-                continue
-            if self.merge_policy == TaskSwitchingPolicy.IF_SAME_TASK:
-                by_task: dict[Optional[str], list[NodeGroup]] = {}
-                for g in solos:
-                    tid = self.store.kv.get(GROUP_TASK_KEY.format(g.id))
-                    by_task.setdefault(tid, []).append(g)
-                buckets = list(by_task.items())
-            else:
-                buckets = [(None, solos)]
-
-            for tid, bucket in buckets:
-                while len(bucket) >= 2:
-                    chunk = bucket[: config.max_group_size]
-                    if len(chunk) < max(2, config.min_group_size):
-                        break
-                    members = [g.nodes[0] for g in chunk]
-                    with self.store.kv.atomic():
-                        for g in chunk:
-                            self.dissolve_group(g.id)
-                        new_group = self._create_group(config, members)
-                        if tid is not None:
-                            self.store.kv.set(
-                                GROUP_TASK_KEY.format(new_group.id), tid
-                            )
-                    bucket = bucket[len(chunk):]
-                    merged += 1
+        nodes_by_addr = {
+            n.address: n for n in self.store.node_store.get_nodes()
+        }
+        # ONE store scan: the loop below maintains the solo pool
+        # incrementally as batches merge (no rescan per iteration)
+        all_solos = [g for g in self.get_groups() if len(g.nodes) == 1]
+        task_of = {
+            g.id: self.store.kv.get(GROUP_TASK_KEY.format(g.id))
+            for g in all_solos
+        }
+        # existing groups imply their config was enabled at formation time,
+        # so merging iterates all configurations (a disabled config simply
+        # has no solos left to merge)
+        for config in self.configurations:
+            pool = [g for g in all_solos if g.configuration_name == config.name]
+            while True:
+                candidates = pool
+                if self.merge_policy == TaskSwitchingPolicy.IF_SAME_TASK:
+                    # conservative variant: candidates must already share a
+                    # task (or be unassigned) — merge one bucket per pass
+                    by_task: dict[Optional[str], list[NodeGroup]] = {}
+                    for g in pool:
+                        by_task.setdefault(task_of.get(g.id), []).append(g)
+                    candidates = next(
+                        (
+                            b
+                            for b in by_task.values()
+                            if len(b) >= max(2, config.min_group_size)
+                        ),
+                        [],
+                    )
+                elif self.merge_policy == TaskSwitchingPolicy.IF_UNASSIGNED:
+                    # batch ONLY unassigned solos: a task-holding solo must
+                    # not poison the batch and livelock the rest
+                    candidates = [g for g in pool if task_of.get(g.id) is None]
+                batch = self._merge_batch(candidates, config, nodes_by_addr)
+                if batch is None:
+                    break
+                batch_tasks = [task_of.get(g.id) for g in batch]
+                if not self._should_switch_tasks(batch_tasks):
+                    break
+                # PRESERVE the proximity order _merge_batch built: ring
+                # neighbors (${NEXT_P2P_ADDRESS}) follow list order, so a
+                # nearest-first batch yields geographically-local hops
+                members = list(
+                    dict.fromkeys(a for g in batch for a in g.nodes)
+                )
+                # a single shared task carries over; otherwise the merged
+                # group gets a fresh best-task pick
+                distinct = {t for t in batch_tasks if t is not None}
+                carried = distinct.pop() if len(distinct) == 1 else None
+                with self.store.kv.atomic():
+                    for g in batch:
+                        self.dissolve_group(g.id)
+                    new_group = self._create_group(config, members)
+                    task_id = carried
+                    if task_id is None:
+                        best = self._find_best_task_for_group(new_group)
+                        task_id = best.id if best is not None else None
+                    if task_id is not None:
+                        self.store.kv.set(
+                            GROUP_TASK_KEY.format(new_group.id), task_id, nx=True
+                        )
+                merged += 1
+                merged_ids = {g.id for g in batch}
+                pool = [g for g in pool if g.id not in merged_ids]
         return merged
+
+    def _merge_batch(
+        self,
+        solos: list[NodeGroup],
+        config: NodeGroupConfiguration,
+        nodes_by_addr: dict[str, OrchestratorNode],
+    ) -> Optional[list[NodeGroup]]:
+        """Proximity-ordered selection of solos to merge (mod.rs:760-850):
+        seed with the first located solo and add nearest groups first;
+        fall back to original order when nothing has a location. Returns
+        None when no viable batch exists."""
+        if len(solos) < 2:
+            return None
+
+        def loc(g: NodeGroup):
+            node = nodes_by_addr.get(g.nodes[0])
+            return node.location if node is not None else None
+
+        batch: list[NodeGroup] = []
+        seed = next((g for g in solos if loc(g) is not None), None)
+        if seed is not None:
+            sloc = loc(seed)
+            batch.append(seed)
+            remaining = [
+                (s, g)
+                for g in solos
+                if g.id != seed.id
+                for lg in [loc(g)]
+                if lg is not None
+                for s in [
+                    float(
+                        _haversine_km_np(
+                            np.radians(sloc.latitude),
+                            np.radians(sloc.longitude),
+                            np.radians(lg.latitude),
+                            np.radians(lg.longitude),
+                        )
+                    )
+                ]
+            ]
+            remaining.sort(key=lambda sg: sg[0])
+            for _d, g in remaining:
+                if len(batch) >= config.max_group_size:
+                    break
+                batch.append(g)
+        if len(batch) < max(2, config.min_group_size):
+            # fallback: original order, location-blind (mod.rs:823-849)
+            batch = solos[: config.max_group_size]
+        if len(batch) < max(2, config.min_group_size):
+            return None
+        return batch
+
+    def _should_switch_tasks(self, batch_tasks: list[Optional[str]]) -> bool:
+        """should_switch_tasks (mod.rs:257-296) over the policy enum."""
+        if self.merge_policy == TaskSwitchingPolicy.ALWAYS:
+            return True
+        if self.merge_policy == TaskSwitchingPolicy.IF_UNASSIGNED:
+            # prefer_larger_groups=false: any held task blocks the merge
+            return all(t is None for t in batch_tasks)
+        if self.merge_policy == TaskSwitchingPolicy.IF_SAME_TASK:
+            return len({t for t in batch_tasks if t is not None}) <= 1
+        return False
+
+    def _find_best_task_for_group(self, group: NodeGroup) -> Optional[Task]:
+        """find_best_task_for_group (mod.rs:1122-1188): tasks with NO
+        topology restriction are compatible with any group; restricted
+        tasks must list this group's configuration. Random pick."""
+        applicable = [
+            t
+            for t in self.store.task_store.get_all_tasks()
+            if not t.allowed_topologies()
+            or group.configuration_name in t.allowed_topologies()
+        ]
+        if not applicable:
+            return None
+        return self.rng.choice(applicable)
 
     # ------------- scheduler-side filter (scheduler_impl.rs) -------------
 
@@ -378,7 +500,13 @@ class NodeGroupsPlugin:
             task = next((t for t in tasks if t.id == tid), None)
             if task is not None:
                 return task
-            self.store.kv.delete(key)  # assigned task no longer exists
+            # stale-task cleanup is COMPARE-and-delete (the reference's Lua
+            # script, mod.rs:447-467): another scheduler may have just
+            # SET-NX'd a fresh task under this key — deleting blindly would
+            # throw its assignment away
+            with self.store.kv.atomic():
+                if self.store.kv.get(key) == tid:
+                    self.store.kv.delete(key)
         applicable = [
             t for t in tasks if group.configuration_name in t.allowed_topologies()
         ]

@@ -295,3 +295,176 @@ class TestSchedulerFilter:
         # bound task vanishes from the task list -> rebind to applicable one
         got = plugin.filter_tasks([other], na)
         assert got and got[0].name == "other"
+
+
+class TestLongTail:
+    """The reference test module's long tail (node_groups/tests.rs):
+    atomic-pipeline races, task-switching merge ordering, stale-task
+    compare-and-delete."""
+
+    def _solo(self, plugin, ctx, addr, loc=None):
+        ctx.node_store.add_node(mk_node(addr, loc=loc))
+        cfg = plugin.configurations[0]
+        return plugin._create_group(cfg, [addr])
+
+    def test_concurrent_setnx_assignment_single_winner(self):
+        """Two schedulers race to bind a group's task: exactly one task id
+        wins and both observe it (SET-NX semantics, mod.rs:471-476)."""
+        import threading
+
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=2)
+        plugin = make_plugin(ctx, [cfg])
+        group = self._solo(plugin, ctx, "0xr1")
+        tasks = [mk_topo_task(f"t{i}", ["g"]) for i in range(8)]
+        for t in tasks:
+            ctx.task_store.add_task(t)
+
+        results: list[str] = []
+        barrier = threading.Barrier(8)
+
+        def assign(seed):
+            rng = random.Random(seed)
+            p2 = NodeGroupsPlugin(ctx, [cfg], rng=rng)
+            barrier.wait()
+            got = p2._task_for_group(group, tasks)
+            results.append(got.id if got else None)
+
+        threads = [threading.Thread(target=assign, args=(s,)) for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(results)) == 1 and results[0] is not None
+
+    def test_stale_task_compare_and_delete_preserves_fresh_assignment(self):
+        """The stale-task cleanup must not clobber a FRESH assignment that
+        landed between the read and the delete (the reference's Lua
+        compare-and-delete, mod.rs:447-467)."""
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=2)
+        plugin = make_plugin(ctx, [cfg])
+        group = self._solo(plugin, ctx, "0xs1")
+        key = GROUP_TASK_KEY.format(group.id)
+
+        live = mk_topo_task("live", ["g"])
+        ctx.task_store.add_task(live)
+        # group points at a deleted task; another scheduler swaps in a
+        # fresh one between our read and cleanup — simulate by hooking get
+        ctx.kv.set(key, "deleted-task-id")
+        real_get = ctx.kv.get
+        swapped = {"done": False}
+
+        def racy_get(k):
+            v = real_get(k)
+            if k == key and not swapped["done"]:
+                swapped["done"] = True
+                ctx.kv.set(key, live.id)  # the racing fresh assignment
+                return v  # caller still sees the stale value it read
+            return v
+
+        ctx.kv.get = racy_get
+        try:
+            got = plugin._task_for_group(group, [live])
+        finally:
+            ctx.kv.get = real_get
+        # the fresh assignment survived the cleanup and was returned
+        assert ctx.kv.get(key) == live.id
+        assert got is not None and got.id == live.id
+
+    def test_merge_proximity_orders_batch(self):
+        """Merged batch is seeded by a located solo and filled nearest
+        first (mod.rs:760-850): the far-away solo is left out."""
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=2)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.ALWAYS)
+        paris = NodeLocation(latitude=48.85, longitude=2.35)
+        lyon = NodeLocation(latitude=45.76, longitude=4.84)
+        tokyo = NodeLocation(latitude=35.68, longitude=139.69)
+        self._solo(plugin, ctx, "0xparis", loc=paris)
+        self._solo(plugin, ctx, "0xtokyo", loc=tokyo)
+        self._solo(plugin, ctx, "0xlyon", loc=lyon)
+        assert plugin.try_merge_solo_groups() >= 1
+        groups = plugin.get_groups()
+        merged = next(g for g in groups if len(g.nodes) == 2)
+        assert set(merged.nodes) == {"0xparis", "0xlyon"}
+
+    def test_if_unassigned_policy_blocks_on_any_task(self):
+        """IF_UNASSIGNED (the reference's prefer_larger_groups=false): one
+        held task in the batch blocks the merge (mod.rs:277-287)."""
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=2, max_group_size=4)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.IF_UNASSIGNED)
+        g1 = self._solo(plugin, ctx, "0xu1")
+        self._solo(plugin, ctx, "0xu2")
+        ctx.kv.set(GROUP_TASK_KEY.format(g1.id), "task-held")
+        assert plugin.try_merge_solo_groups() == 0
+        # free the task -> merge proceeds
+        ctx.kv.delete(GROUP_TASK_KEY.format(g1.id))
+        assert plugin.try_merge_solo_groups() == 1
+
+    def test_if_unassigned_merges_around_task_holder(self):
+        """A task-holding solo must not poison the batch: the unassigned
+        solos still merge (no livelock)."""
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=2, max_group_size=2)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.IF_UNASSIGNED)
+        held = self._solo(plugin, ctx, "0xh")
+        self._solo(plugin, ctx, "0xf1")
+        self._solo(plugin, ctx, "0xf2")
+        ctx.kv.set(GROUP_TASK_KEY.format(held.id), "task-held")
+        assert plugin.try_merge_solo_groups() == 1
+        merged = next(g for g in plugin.get_groups() if len(g.nodes) == 2)
+        assert set(merged.nodes) == {"0xf1", "0xf2"}
+        assert plugin.get_group(held.id) is not None  # untouched
+
+    def test_merged_group_gets_best_task_including_unrestricted(self):
+        """find_best_task_for_group treats tasks with NO topology
+        restriction as compatible with any group (mod.rs:1132-1164)."""
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=2)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.ALWAYS)
+        self._solo(plugin, ctx, "0xb1")
+        self._solo(plugin, ctx, "0xb2")
+        unrestricted = Task(name="anywhere", image="img", state=TaskState.PENDING)
+        ctx.task_store.add_task(unrestricted)
+        assert plugin.try_merge_solo_groups() == 1
+        merged = next(g for g in plugin.get_groups() if len(g.nodes) == 2)
+        assert ctx.kv.get(GROUP_TASK_KEY.format(merged.id)) == unrestricted.id
+
+    def test_concurrent_merge_and_dissolve_leave_consistent_state(self):
+        """Atomic-pipeline race: a status-change dissolve racing the merge
+        must never leave orphan node_to_group mappings or dangling
+        group_task keys (the reference's pipe.atomic() invariants)."""
+        import threading
+
+        ctx = StoreContext.new_test()
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=4)
+        plugin = make_plugin(ctx, [cfg], policy=TaskSwitchingPolicy.ALWAYS)
+        groups = [self._solo(plugin, ctx, f"0xc{i}") for i in range(6)]
+
+        barrier = threading.Barrier(2)
+
+        def merge():
+            barrier.wait()
+            plugin.try_merge_solo_groups()
+
+        def dissolve():
+            barrier.wait()
+            for g in groups:
+                plugin.dissolve_group(g.id)
+
+        t1 = threading.Thread(target=merge)
+        t2 = threading.Thread(target=dissolve)
+        t1.start(); t2.start(); t1.join(); t2.join()
+
+        # invariant: every node_to_group entry points at a live group, and
+        # every live group's members point back at it
+        live = {g.id: g for g in plugin.get_groups()}
+        mapping = ctx.kv.hgetall("node_to_group")
+        for addr, gid in mapping.items():
+            assert gid in live, f"orphan mapping {addr} -> {gid}"
+            assert addr in live[gid].nodes
+        for gid, g in live.items():
+            for addr in g.nodes:
+                assert mapping.get(addr) == gid

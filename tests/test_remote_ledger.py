@@ -114,6 +114,33 @@ def test_errors_become_ledger_errors(ledger_api):
         dead.balance_of("0xx")
 
 
+def test_cli_check_and_deregister(ledger_api, capsys):
+    """Worker CLI parity (worker/src/cli/command.rs Check / Deregister)."""
+    import json as _json
+
+    from protocol_tpu import cli
+
+    local, remote = ledger_api
+    rc = cli.main(["check", "--storage-path", "/"])
+    out = _json.loads(capsys.readouterr().out)
+    assert "compute_specs" in out and isinstance(out["issues"], list)
+    assert rc in (0, 1)
+
+    provider, node = Wallet.from_seed(b"cli-p"), Wallet.from_seed(b"cli-n")
+    remote.mint(provider.address, 1000)
+    remote.register_provider(provider.address, 100)
+    remote.add_compute_node(provider.address, node.address)
+    assert remote.node_exists(node.address)
+    rc = cli.main([
+        "--ledger", remote.base_url, "--api-key", "adm",
+        "deregister", "--provider", provider.address,
+        "--node", node.address, "--reclaim", "50",
+    ])
+    assert rc == 0
+    assert not remote.node_exists(node.address)
+    assert remote.get_stake(provider.address) == 50
+
+
 def test_services_accept_remote_ledger(ledger_api):
     """A DiscoveryService wired to the RemoteLedger behaves like one wired
     to the in-process ledger (the pod deployment shape)."""
