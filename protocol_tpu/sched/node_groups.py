@@ -411,9 +411,12 @@ class NodeGroupsPlugin:
         nodes_by_addr: dict[str, OrchestratorNode],
     ) -> Optional[list[NodeGroup]]:
         """Proximity-ordered selection of solos to merge (mod.rs:760-850):
-        seed with the first located solo and add nearest groups first;
-        fall back to original order when nothing has a location. Returns
-        None when no viable batch exists."""
+        seed deterministically with an endpoint of the CLOSEST located
+        pair (the reference seeds with its list's first located group,
+        which here would follow random uuid sort order — arbitrary
+        geography) and add nearest groups first; fall back to original
+        order when nothing has a location. Returns None when no viable
+        batch exists."""
         if len(solos) < 2:
             return None
 
@@ -422,7 +425,16 @@ class NodeGroupsPlugin:
             return node.location if node is not None else None
 
         batch: list[NodeGroup] = []
-        seed = next((g for g in solos if loc(g) is not None), None)
+        located = [g for g in solos if loc(g) is not None]
+        seed = None
+        if len(located) >= 2:
+            lat = np.radians([loc(g).latitude for g in located])
+            lon = np.radians([loc(g).longitude for g in located])
+            d = _haversine_km_np(lat[:, None], lon[:, None], lat[None, :], lon[None, :])
+            np.fill_diagonal(d, np.inf)
+            seed = located[int(np.argmin(d.min(axis=1)))]
+        elif located:
+            seed = located[0]
         if seed is not None:
             sloc = loc(seed)
             batch.append(seed)

@@ -432,6 +432,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--socket-path", default="/var/run/protocol-tpu/bridge.sock")
 
     args = parser.parse_args(argv)
+    from protocol_tpu.utils.logging import setup_logging
+
+    setup_logging(
+        level=os.environ.get("LOG_LEVEL", "info"),
+        loki_url=os.environ.get("LOKI_URL") or None,
+        labels={
+            "service": args.service,
+            "pool": str(getattr(args, "pool_id", "")),
+        },
+    )
     # Operational platform pin (e.g. PROTOCOL_TPU_FORCE_PLATFORM=cpu for
     # control-plane pods with no accelerator): applied via jax.config, which
     # outranks JAX_PLATFORMS when a site hook has already forced a platform.

@@ -1,0 +1,125 @@
+"""Structured logging with optional Loki push.
+
+Reference: crates/worker/src/utils/logging.rs:39-60 — env_logger plus an
+optional Loki sink configured by --loki-url, labeled with the node's
+address/pool/port so a Grafana stack can slice worker logs per pool.
+
+``LokiHandler`` batches records on a daemon thread and POSTs the Loki
+push-API shape ({"streams": [{"stream": labels, "values": [[ns, line]]}]})
+with plain urllib — no extra dependencies, and a failed push never
+raises into application code (batch is dropped after retries, counted in
+``dropped``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+
+class LokiHandler(logging.Handler):
+    def __init__(
+        self,
+        url: str,
+        labels: Optional[dict[str, str]] = None,
+        flush_interval: float = 2.0,
+        max_batch: int = 500,
+        timeout: float = 5.0,
+    ):
+        super().__init__()
+        self.url = url.rstrip("/") + "/loki/api/v1/push"
+        self.labels = {"job": "protocol_tpu", **(labels or {})}
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self.timeout = timeout
+        self.queue: "queue.Queue[tuple[int, str]]" = queue.Queue(maxsize=10_000)
+        self.dropped = 0
+        self.pushed = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:
+            return
+        try:
+            self.queue.put_nowait((time.time_ns(), line))
+        except queue.Full:
+            self.dropped += 1
+
+    def _drain(self) -> list[tuple[int, str]]:
+        out: list[tuple[int, str]] = []
+        while len(out) < self.max_batch:
+            try:
+                out.append(self.queue.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def _push(self, values: list[tuple[int, str]]) -> None:
+        payload = json.dumps(
+            {
+                "streams": [
+                    {
+                        "stream": self.labels,
+                        "values": [[str(ts), line] for ts, line in values],
+                    }
+                ]
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.url,
+            data=payload,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                self.pushed += len(values)
+        except Exception:
+            self.dropped += len(values)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(self.flush_interval)
+            batch = self._drain()
+            if batch:
+                self._push(batch)
+
+    def flush(self) -> None:
+        batch = self._drain()
+        if batch:
+            self._push(batch)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush()
+        super().close()
+
+
+def setup_logging(
+    level: str = "info",
+    loki_url: Optional[str] = None,
+    labels: Optional[dict[str, str]] = None,
+) -> Optional[LokiHandler]:
+    """env_logger-equivalent root config + optional Loki sink
+    (logging.rs:39-60). Returns the handler so callers can flush/close."""
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    if not loki_url:
+        return None
+    handler = LokiHandler(loki_url, labels=labels)
+    handler.setFormatter(
+        logging.Formatter("%(levelname)s %(name)s %(message)s")
+    )
+    logging.getLogger().addHandler(handler)
+    return handler
