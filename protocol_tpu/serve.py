@@ -356,6 +356,11 @@ async def serve_worker(args) -> None:
         runtime = DockerRuntime(socket_path=args.socket_path)
     else:
         runtime = SubprocessRuntime(socket_path=args.socket_path)
+    ipfs = None
+    if os.environ.get("IPFS_API_URL"):
+        from protocol_tpu.utils.ipfs import IpfsMirror
+
+        ipfs = IpfsMirror(os.environ["IPFS_API_URL"], http=session)
     agent = WorkerAgent(
         provider,
         node,
@@ -366,6 +371,7 @@ async def serve_worker(args) -> None:
         ip_address=args.advertise_ip,
         port=args.port,
         http=session,
+        ipfs=ipfs,
     )
     agent.register_on_ledger()
     bridge = TaskBridge(args.socket_path, agent)

@@ -387,7 +387,9 @@ class WorkerAgent:
         known_validators: Optional[list[str]] = None,
         state: Optional[SystemState] = None,
         auto_recover: bool = True,
+        ipfs=None,  # utils.ipfs.IpfsMirror: best-effort artifact mirroring
     ):
+        self.ipfs = ipfs
         self.provider_wallet = provider_wallet
         self.node_wallet = node_wallet
         self.ledger = ledger
@@ -696,6 +698,10 @@ class WorkerAgent:
                                 raise _Fatal(f"upload {up.status}")
                             if up.status not in (200, 201):
                                 raise RuntimeError(f"upload {up.status}")
+                        if self.ipfs is not None:
+                            # best-effort mirror, never blocks the primary
+                            # path (file_handler.rs:109-118)
+                            await self.ipfs.add(data, file_name=file_name)
                     break
                 except _Fatal:
                     if data is not None:

@@ -1,0 +1,70 @@
+"""Optional IPFS artifact mirroring.
+
+Reference: the worker can embed a rust-ipfs node and, on every artifact
+upload, additionally put the bytes as a raw block and provide the CID
+(worker/src/cli/command.rs:443-483 boots the node;
+docker/taskbridge/file_handler.rs:109-118, 342-352 mirrors uploads).
+
+The TPU-native deployment shape runs a kubo daemon as a sidecar instead
+of embedding a node in-process; this client speaks kubo's HTTP API
+(``POST /api/v0/add``) so the worker's upload path can mirror artifacts
+with zero new dependencies. Mirroring is strictly best-effort, exactly
+like the reference's: a down IPFS daemon never fails the primary
+signed-URL upload or the work submission.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+class IpfsMirror:
+    def __init__(
+        self,
+        api_url: str = "http://127.0.0.1:5001",
+        http=None,
+        timeout: float = 10.0,
+    ):
+        self.api_url = api_url.rstrip("/")
+        self.http = http  # aiohttp-compatible session
+        self.timeout = timeout
+        self.mirrored: int = 0
+        self.failed: int = 0
+
+    async def add(self, data: bytes, file_name: str = "artifact") -> Optional[str]:
+        """Add bytes; returns the CID or None (best-effort). Uses kubo's
+        multipart ``/api/v0/add`` with raw leaves (the reference stores a
+        raw block, file_handler.rs:342-347). A hung daemon is bounded by
+        ``timeout`` — mirroring must never stall work submission."""
+        import aiohttp
+
+        form = aiohttp.FormData()
+        # FormData handles filename escaping (quotes/CRLF in a
+        # workload-supplied name must not inject MIME headers)
+        form.add_field(
+            "file",
+            data,
+            filename=file_name,
+            content_type="application/octet-stream",
+        )
+        try:
+            async with self.http.post(
+                f"{self.api_url}/api/v0/add",
+                params={"raw-leaves": "true", "pin": "true"},
+                data=form,
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                if resp.status != 200:
+                    self.failed += 1
+                    return None
+                payload = json.loads(await resp.text())
+                cid = payload.get("Hash")
+                if cid:
+                    self.mirrored += 1
+                else:
+                    self.failed += 1  # 200 without a CID is still a miss
+                return cid
+        except Exception:
+            self.failed += 1
+            return None

@@ -1,0 +1,134 @@
+"""IPFS artifact mirroring (reference worker file_handler.rs:109-118,
+342-352) against a fake kubo /api/v0/add endpoint."""
+
+import asyncio
+import hashlib
+import json
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from protocol_tpu.utils.ipfs import IpfsMirror
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_kubo(fail: bool = False):
+    added: list[dict] = []
+
+    async def add(request):
+        if fail:
+            return web.Response(status=500)
+        assert request.query.get("raw-leaves") == "true"
+        reader = await request.multipart()
+        part = await reader.next()
+        data = await part.read()
+        cid = "bafk" + hashlib.sha256(data).hexdigest()[:20]
+        added.append({"name": part.filename, "bytes": data, "cid": cid})
+        return web.json_response({"Hash": cid, "Size": str(len(data))})
+
+    app = web.Application()
+    app.router.add_post("/api/v0/add", add)
+    app["added"] = added
+    return app
+
+
+def test_add_returns_cid_and_pins_bytes():
+    app = make_kubo()
+
+    async def flow():
+        async with TestClient(TestServer(app)) as client:
+            m = IpfsMirror("", http=client)
+            cid = await m.add(b"artifact-bytes", file_name="out.parquet")
+            return cid, m
+
+    cid, m = run(flow())
+    assert cid and cid.startswith("bafk")
+    assert m.mirrored == 1 and m.failed == 0
+    assert app["added"][0]["bytes"] == b"artifact-bytes"
+    assert app["added"][0]["name"] == "out.parquet"
+
+
+def test_down_daemon_is_best_effort():
+    app = make_kubo(fail=True)
+
+    async def flow():
+        async with TestClient(TestServer(app)) as client:
+            m = IpfsMirror("", http=client)
+            return await m.add(b"x"), m
+
+    cid, m = run(flow())
+    assert cid is None and m.failed == 1
+
+
+def test_worker_upload_mirrors_to_ipfs():
+    """submit_output mirrors the artifact after the primary signed-URL
+    upload; a dead IPFS daemon never fails the work submission."""
+    import time
+
+    from aiohttp.test_utils import TestServer as TS
+
+    from protocol_tpu.chain import Ledger
+    from protocol_tpu.chain.ledger import invite_digest
+    from protocol_tpu.security import Wallet
+    from protocol_tpu.services.orchestrator import OrchestratorService
+    from protocol_tpu.services.worker import MockRuntime, WorkerAgent
+    from protocol_tpu.store import NodeStatus, OrchestratorNode
+
+    ledger = Ledger()
+    creator, manager = Wallet.from_seed(b"ic"), Wallet.from_seed(b"im")
+    provider, node = Wallet.from_seed(b"ip"), Wallet.from_seed(b"iw")
+    ledger.mint(provider.address, 1000)
+    did = ledger.create_domain("d")
+    pid = ledger.create_pool(did, creator.address, manager.address, "")
+    ledger.start_pool(pid, creator.address)
+    ledger.register_provider(provider.address, 100)
+    ledger.add_compute_node(provider.address, node.address)
+    ledger.validate_node(node.address)
+    exp = time.time() + 60
+    sig = manager.sign_message(invite_digest(0, pid, node.address, "n", exp))
+    ledger.join_compute_pool(pid, provider.address, node.address, "n", exp, sig)
+
+    import tempfile
+
+    from protocol_tpu.utils.storage import LocalDirStorageProvider
+
+    storage = LocalDirStorageProvider(tempfile.mkdtemp())
+    kubo = make_kubo()
+
+    async def flow():
+        orch = OrchestratorService(ledger, pid, manager, storage=storage)
+        orch.store.node_store.add_node(
+            OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
+        )
+        orch_server = TS(orch.make_app())
+        await orch_server.start_server()
+        # signed URLs must point at the live orchestrator upload endpoint
+        storage.public_base_url = str(orch_server.make_url("/")).rstrip("/")
+        kubo_server = TS(kubo)
+        await kubo_server.start_server()
+        async with aiohttp.ClientSession() as session:
+            mirror = IpfsMirror(
+                str(kubo_server.make_url("/")).rstrip("/"), http=session
+            )
+            agent = WorkerAgent(
+                provider, node, ledger, pid, runtime=MockRuntime(),
+                http=session, ipfs=mirror,
+            )
+            agent.orchestrator_url = str(orch_server.make_url("/")).rstrip("/")
+            agent.heartbeat_active = True
+            ok = await agent.submit_output(
+                sha="ab" * 32, flops=7, file_name="a.bin", data=b"bytes"
+            )
+        await orch_server.close()
+        await kubo_server.close()
+        return ok, mirror
+
+    ok, mirror = run(flow())
+    assert ok
+    assert mirror.mirrored == 1
+    assert kubo["added"][0]["bytes"] == b"bytes"
+    assert ledger.get_work_info(pid, "ab" * 32).work_units == 7
