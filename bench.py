@@ -176,15 +176,27 @@ def main() -> None:
     # scalar off the accelerator.
     log("computing cost matrix + greedy baseline on host CPU...")
     cpu = jax.devices("cpu")[0]
+    cost_fn = jax.jit(lambda e, r: cost_matrix(e, r, CostWeights())[0])
+    cost_build_time = 0.0
     with jax.default_device(cpu):
-        cost_np = np.asarray(
-            jax.jit(lambda e, r: cost_matrix(e, r, CostWeights())[0])(ep, er)
-        )
+        cost_np = np.asarray(cost_fn(ep, er))
+        if fallback:
+            # timed second build (cheap at fallback scale) for the fair
+            # end-to-end comparison; the healthy path never rebuilds the
+            # multi-GB tensor just to decorate a log line
+            t0 = time.perf_counter()
+            cost_np = np.asarray(cost_fn(ep, er))
+            cost_build_time = time.perf_counter() - t0
     _, cpu_time = cpu_greedy_baseline(cost_np)
-    log(f"cpu greedy wall: {cpu_time * 1e3:.1f} ms")
+    log(
+        f"cpu greedy wall: {cpu_time * 1e3:.1f} ms "
+        f"(+{cost_build_time * 1e3:.1f} ms cost build)"
+    )
 
-    # informational: the native C++ engine (this framework's own CPU
-    # fallback backend) on the same problem
+    # the native C++ engine: this framework's own CPU fallback backend
+    # (TpuBatchMatcher(native_fallback=True) solves with it when the
+    # accelerator is absent)
+    native_time = None
     try:
         from protocol_tpu import native
 
@@ -198,6 +210,45 @@ def main() -> None:
         )
     except Exception as e:
         log(f"native engine unavailable: {e}")
+
+    if fallback and native_time is not None:
+        # Degraded mode measures the path the framework ACTUALLY runs
+        # without an accelerator: the native engine (cost build timed
+        # separately above; steady-state matcher re-solves reuse encoded
+        # features and rebuild cost on change). Report end-to-end
+        # cost+candidates+auction so the number is honest about the whole
+        # solve, not just the auction.
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with jax.default_device(cpu):
+                cost_i = np.asarray(cost_fn(ep, er))
+            cand_p, cand_c = native.topk_candidates(cost_i, k=TOPK)
+            p4t_native = native.auction_sparse(cand_p, cand_c, num_providers=P)
+        total = (time.perf_counter() - t0) / iters
+        n_assigned = int((p4t_native >= 0).sum())
+        # equal footing: both sides pay the cost-tensor build (the greedy
+        # baseline above was handed a prebuilt matrix)
+        baseline_total = cost_build_time + cpu_time
+        log(
+            f"native fallback end-to-end: {total * 1e3:.1f} ms/solve "
+            f"({n_assigned / total:,.0f} assignments/s; greedy end-to-end "
+            f"{baseline_total * 1e3:.1f} ms)"
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"sparse_top{TOPK}_{P}x{T}_native_engine_match_"
+                        "throughput_NATIVE_CPU_FALLBACK_accelerator_unreachable"
+                    ),
+                    "value": round(n_assigned / total, 1),
+                    "unit": "assignments/sec",
+                    "vs_baseline": round(baseline_total / total, 2),
+                }
+            )
+        )
+        return
     del cost_np
 
     # ---- TPU path: ship features (O(P+T) bytes), compile, time

@@ -92,6 +92,11 @@ def _solve_bounded(ep, er, weights) -> jax.Array:
 
 
 @jax.jit
+def _cost_only(ep, er, weights) -> jax.Array:
+    return cost_matrix(ep, er, weights)[0]
+
+
+@jax.jit
 def _solve_unbounded(ep, er, weights) -> tuple[jax.Array, jax.Array]:
     cost, _ = cost_matrix(ep, er, weights)
     best = jnp.argmin(cost, axis=1).astype(jnp.int32)  # [P]
@@ -106,12 +111,23 @@ class TpuBatchMatcher:
         weights: Optional[CostWeights] = None,
         min_solve_interval: float = 1.0,
         max_replica_slots: int = 4096,
+        native_fallback: bool = False,
         time_fn=time.monotonic,
     ):
         self.store = store
         self.weights = weights or CostWeights(priority=1.0)
         self.min_solve_interval = min_solve_interval
         self.max_replica_slots = max_replica_slots
+        # degraded mode: solve with the native C++ engine instead of the
+        # jitted kernels (for deployments whose accelerator is absent or
+        # unreachable — the engine is this framework's CPU backend, not an
+        # external dependency). Opt-in so tests keep covering the jax path.
+        self.native_fallback = native_fallback
+        if native_fallback:
+            # pin the process to the host platform NOW: the whole point is
+            # an unreachable accelerator, and letting jax initialize the
+            # remote platform on first use would hang the solve path
+            jax.config.update("jax_platforms", "cpu")
         self._time = time_fn
         self._dirty = True
         self._last_solve = float("-inf")
@@ -163,10 +179,33 @@ class TpuBatchMatcher:
     # ----- device solves (overridden by RemoteBatchMatcher to route the
     # same columnar batches through the gRPC scheduler backend)
 
+    def _native_cost(self, ep, er) -> np.ndarray:
+        # module-level jit: re-traces per shape bucket, not per solve
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return np.asarray(_cost_only(ep, er, self.weights))
+
     def _bounded_t4p(self, ep, er) -> np.ndarray:
+        if self.native_fallback:
+            from protocol_tpu import native
+
+            cost = self._native_cost(ep, er)
+            n_providers, _n_slots = cost.shape
+            cand_p, cand_c = native.topk_candidates(cost, k=min(64, n_providers))
+            p4s = native.auction_sparse(cand_p, cand_c, num_providers=n_providers)
+            t4p = np.full(n_providers, -1, np.int32)
+            for s_idx, p_idx in enumerate(p4s):
+                if p_idx >= 0:
+                    t4p[p_idx] = s_idx
+            return t4p
         return np.asarray(_solve_bounded(ep, er, self.weights))
 
     def _unbounded_best(self, ep, er) -> np.ndarray:
+        if self.native_fallback:
+            cost = self._native_cost(ep, er)
+            best = cost.argmin(axis=1).astype(np.int32)
+            feas = cost[np.arange(cost.shape[0]), best] < INFEASIBLE * 0.5
+            return np.where(feas, best, -1).astype(np.int32)
         best, _feas = _solve_unbounded(ep, er, self.weights)
         return np.asarray(best)
 

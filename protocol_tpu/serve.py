@@ -164,7 +164,13 @@ async def serve_orchestrator(args) -> None:
         matcher.attach_observers()
         scheduler = Scheduler(store, batch_matcher=matcher)
     else:
-        matcher = TpuBatchMatcher(store)
+        matcher = TpuBatchMatcher(
+            store,
+            native_fallback=os.environ.get(
+                "PROTOCOL_TPU_NATIVE_FALLBACK", ""
+            ).lower()
+            in ("1", "true", "yes"),
+        )
         matcher.attach_observers()
         scheduler = Scheduler(store, batch_matcher=matcher)
 
