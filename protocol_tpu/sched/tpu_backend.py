@@ -27,6 +27,7 @@ growth, not on every membership change.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
@@ -45,6 +46,8 @@ from protocol_tpu.store.context import StoreContext
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 
 SCHEDULABLE = (NodeStatus.HEALTHY, NodeStatus.WAITING_FOR_HEARTBEAT)
+
+_PROFILE_LOCK = threading.Lock()  # jax.profiler.trace is process-global
 
 
 def _pow2_bucket(n: int, floor: int = 8) -> int:
@@ -212,6 +215,19 @@ class TpuBatchMatcher:
     # ----- batch solve
 
     def refresh(self) -> None:
+        """One batch solve; with PROTOCOL_TPU_PROFILE_DIR set, each solve
+        is captured as an xprof trace (SURVEY §5's stated tracing plan:
+        JAX profiler instead of the reference's log-line timing)."""
+        profile_dir = os.environ.get("PROTOCOL_TPU_PROFILE_DIR", "")
+        if profile_dir:
+            # jax.profiler.trace is process-global and cannot nest: one
+            # lock across ALL matcher instances (devnet runs several)
+            with _PROFILE_LOCK, jax.profiler.trace(profile_dir):
+                self._refresh()
+            return
+        self._refresh()
+
+    def _refresh(self) -> None:
         t_start = time.perf_counter()
         # clear the dirty flag BEFORE reading state: a concurrent mark_dirty
         # landing mid-read must trigger another solve, not be erased

@@ -355,6 +355,21 @@ async def serve_worker(args) -> None:
     node = _wallet_from_env("NODE_KEY")
     ledger = _ledger(args)
     session = aiohttp.ClientSession()
+    if args.advertise_ip == "auto":
+        # STUN public-IP detection (reference checks/stun.rs via
+        # cli/command.rs:332-339); explicit --advertise-ip skips it
+        from protocol_tpu.utils.stun import get_public_ip
+
+        detected = await asyncio.to_thread(get_public_ip)
+        if detected is None:
+            # fail closed: advertising a guessed/loopback address would
+            # register an unreachable worker that still looks healthy
+            raise SystemExit(
+                "STUN public-IP detection failed (no UDP egress?); pass "
+                "--advertise-ip explicitly"
+            )
+        args.advertise_ip = detected
+        print(f"advertise ip (stun): {args.advertise_ip}", flush=True)
     specs, report = detect_compute_specs("/", probe_accelerator=False)
     if args.runtime == "docker":
         from protocol_tpu.services.docker_runtime import DockerRuntime
@@ -438,7 +453,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     p = sub.add_parser("worker")
     common(p)
     p.add_argument("--port", type=int, default=8091)
-    p.add_argument("--advertise-ip", default="127.0.0.1")
+    p.add_argument(
+        "--advertise-ip",
+        default="127.0.0.1",
+        help='"auto" = STUN public-IP detection (checks/stun.rs)',
+    )
     p.add_argument("--discovery-urls", default="")
     p.add_argument("--runtime", choices=["subprocess", "docker"], default="docker")
     p.add_argument("--socket-path", default="/var/run/protocol-tpu/bridge.sock")

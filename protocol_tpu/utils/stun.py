@@ -1,0 +1,77 @@
+"""STUN public-IP detection (RFC 5389 binding request, stdlib only).
+
+Reference: the worker discovers its public IP via a STUN check at boot
+(worker/src/checks/stun.rs, used at cli/command.rs:332-339) so the
+address it advertises to discovery is reachable from outside NAT. Same
+capability here: one UDP binding request, parse the
+(XOR-)MAPPED-ADDRESS attribute. Best-effort — deployments that know
+their address pass it explicitly (--advertise-ip), and this fills the
+gap when they don't.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from typing import Optional
+
+_BINDING_REQUEST = 0x0001
+_BINDING_RESPONSE = 0x0101
+_MAGIC_COOKIE = 0x2112A442
+_ATTR_MAPPED_ADDRESS = 0x0001
+_ATTR_XOR_MAPPED_ADDRESS = 0x0020
+
+DEFAULT_SERVERS = [
+    ("stun.l.google.com", 19302),
+    ("stun.cloudflare.com", 3478),
+]
+
+
+def _parse_response(data: bytes, txn_id: bytes) -> Optional[str]:
+    if len(data) < 20:
+        return None
+    msg_type, msg_len, cookie = struct.unpack("!HHI", data[:8])
+    if msg_type != _BINDING_RESPONSE or cookie != _MAGIC_COOKIE:
+        return None
+    if data[8:20] != txn_id:
+        return None
+    off = 20
+    end = min(len(data), 20 + msg_len)
+    while off + 4 <= end:
+        attr_type, attr_len = struct.unpack("!HH", data[off : off + 4])
+        value = data[off + 4 : off + 4 + attr_len]
+        if attr_type == _ATTR_XOR_MAPPED_ADDRESS and len(value) >= 8:
+            family = value[1]
+            if family == 0x01:  # IPv4
+                port = struct.unpack("!H", value[2:4])[0] ^ (_MAGIC_COOKIE >> 16)
+                raw = struct.unpack("!I", value[4:8])[0] ^ _MAGIC_COOKIE
+                return socket.inet_ntoa(struct.pack("!I", raw))
+        if attr_type == _ATTR_MAPPED_ADDRESS and len(value) >= 8:
+            if value[1] == 0x01:
+                return socket.inet_ntoa(value[4:8])
+        # attributes are 32-bit aligned
+        off += 4 + attr_len + ((4 - attr_len % 4) % 4)
+    return None
+
+
+def get_public_ip(
+    servers: Optional[list[tuple[str, int]]] = None,
+    timeout: float = 2.0,
+) -> Optional[str]:
+    """One binding round-trip per server until one answers; None if none
+    do (offline / egress-less environments)."""
+    txn_id = os.urandom(12)
+    request = struct.pack("!HHI", _BINDING_REQUEST, 0, _MAGIC_COOKIE) + txn_id
+    for host, port in servers or DEFAULT_SERVERS:
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as sock:
+                sock.settimeout(timeout)
+                sock.sendto(request, (host, port))
+                data, _addr = sock.recvfrom(2048)
+            ip = _parse_response(data, txn_id)
+            if ip:
+                return ip
+        except OSError:
+            continue
+    return None

@@ -1,0 +1,61 @@
+"""STUN public-IP detection against a local fake STUN server
+(reference worker/src/checks/stun.rs)."""
+
+import socket
+import struct
+import threading
+
+from protocol_tpu.utils.stun import (
+    _MAGIC_COOKIE,
+    get_public_ip,
+)
+
+
+def fake_stun_server(mapped_ip: str, mapped_port: int, xor: bool = True):
+    """One-shot UDP server answering a binding request."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+
+    def run():
+        data, addr = sock.recvfrom(2048)
+        msg_type, _len, cookie = struct.unpack("!HHI", data[:8])
+        assert msg_type == 0x0001 and cookie == _MAGIC_COOKIE
+        txn = data[8:20]
+        ip_raw = struct.unpack("!I", socket.inet_aton(mapped_ip))[0]
+        if xor:
+            attr_type = 0x0020
+            p = mapped_port ^ (_MAGIC_COOKIE >> 16)
+            raw = ip_raw ^ _MAGIC_COOKIE
+        else:
+            attr_type = 0x0001
+            p, raw = mapped_port, ip_raw
+        value = struct.pack("!BBH", 0, 0x01, p) + struct.pack("!I", raw)
+        attrs = struct.pack("!HH", attr_type, len(value)) + value
+        resp = struct.pack("!HHI", 0x0101, len(attrs), _MAGIC_COOKIE) + txn + attrs
+        sock.sendto(resp, addr)
+        sock.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def test_xor_mapped_address_round_trip():
+    port = fake_stun_server("203.0.113.7", 54321, xor=True)
+    ip = get_public_ip(servers=[("127.0.0.1", port)], timeout=3.0)
+    assert ip == "203.0.113.7"
+
+
+def test_plain_mapped_address_fallback():
+    port = fake_stun_server("198.51.100.9", 1234, xor=False)
+    ip = get_public_ip(servers=[("127.0.0.1", port)], timeout=3.0)
+    assert ip == "198.51.100.9"
+
+
+def test_unreachable_server_returns_none():
+    # closed port: fast OSError/timeout path, never raises
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    ip = get_public_ip(servers=[("127.0.0.1", dead_port)], timeout=0.3)
+    assert ip is None
