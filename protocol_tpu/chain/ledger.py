@@ -251,6 +251,93 @@ class Ledger:
             del self.nodes[node.lower()]
             pinfo.nodes.remove(node.lower())
 
+    # ------------- snapshot / restore -------------
+    #
+    # The reference's chain is durable by nature (reth devnet keeps state
+    # across orchestrator restarts). The in-process dev ledger gets the
+    # same property via explicit JSON snapshots, so a devnet --state-dir
+    # restart restores the ECONOMIC state coherently with the services'
+    # AOF journals (a surviving store against a wiped chain would strand
+    # every worker as Unhealthy/not-in-pool).
+
+    def snapshot(self, path: str) -> None:
+        import dataclasses
+        import json as _json
+        import os as _os
+
+        def enc(v):
+            if dataclasses.is_dataclass(v):
+                return {k: enc(x) for k, x in dataclasses.asdict(v).items()}
+            if isinstance(v, enum.Enum):
+                return v.value
+            if isinstance(v, set):
+                return sorted(v)
+            if isinstance(v, dict):
+                return {str(k): enc(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [enc(x) for x in v]
+            return v
+
+        with self._lock:
+            # every collection is COPIED under the lock; json.dump then
+            # runs outside it against a consistent frozen view
+            state = {
+                "balances": dict(self.balances),
+                "allowances": {f"{a}|{b}": v for (a, b), v in self.allowances.items()},
+                "providers": {k: enc(v) for k, v in self.providers.items()},
+                "nodes": {k: enc(v) for k, v in self.nodes.items()},
+                "pools": {str(k): enc(v) for k, v in self.pools.items()},
+                "domains": {str(k): enc(v) for k, v in self.domains.items()},
+                "work": {f"{p}|{w}": enc(v) for (p, w), v in self.work.items()},
+                "rewards": dict(self.rewards),
+                "validator_roles": sorted(self.validator_roles),
+                "next_pool_id": self._next_pool_id,
+                "next_domain_id": self._next_domain_id,
+                "min_stake_per_compute_unit": self.min_stake_per_compute_unit,
+            }
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(state, f)
+        _os.replace(tmp, path)
+
+    @classmethod
+    def restore(cls, path: str, **kwargs) -> "Ledger":
+        import json as _json
+
+        with open(path) as f:
+            s = _json.load(f)
+        # the persisted economics win unless explicitly overridden
+        kwargs.setdefault(
+            "min_stake_per_compute_unit",
+            s.get("min_stake_per_compute_unit", 10),
+        )
+        led = cls(**kwargs)
+        led.balances = dict(s["balances"])
+        led.allowances = {
+            tuple(k.split("|", 1)): v for k, v in s["allowances"].items()
+        }
+        led.providers = {
+            k: ProviderInfo(**v) for k, v in s["providers"].items()
+        }
+        led.nodes = {k: NodeInfo(**v) for k, v in s["nodes"].items()}
+        for k, v in s["pools"].items():
+            v = dict(v)
+            v["status"] = PoolStatus(v["status"])
+            v["blacklist"] = set(v["blacklist"])
+            led.pools[int(k)] = PoolInfo(**v)
+        led.domains = {
+            int(k): DomainInfo(**v) for k, v in s["domains"].items()
+        }
+        for k, v in s["work"].items():
+            pool_s, work_key = k.split("|", 1)
+            led.work[(int(pool_s), work_key)] = WorkInfo(**v)
+        led.rewards = dict(s["rewards"])
+        led.validator_roles = set(s["validator_roles"])
+        led._next_pool_id = s["next_pool_id"]
+        led._next_domain_id = s["next_domain_id"]
+        return led
+
     def grant_validator_role(self, address: str) -> None:
         """Register a validator wallet on the substrate (reference
         prime_network.get_validator_role surface; workers derive their

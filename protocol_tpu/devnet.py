@@ -108,13 +108,29 @@ async def main(argv=None) -> None:
     )
 
     # ---- substrate
-    ledger = Ledger()
     creator = Wallet.from_seed(b"devnet-creator")
     manager = Wallet.from_seed(b"devnet-manager")
     validator_wallet = Wallet.from_seed(b"devnet-validator")
-    did = ledger.create_domain("devnet", validation_logic="toploc")
-    pid = ledger.create_pool(did, creator.address, manager.address, args.requirements)
-    ledger.start_pool(pid, creator.address)
+    ledger_path = (
+        os.path.join(args.state_dir, "ledger.json") if args.state_dir else None
+    )
+    if ledger_path and os.path.exists(ledger_path):
+        # the chain must survive restarts WITH the service stores, or the
+        # restored pool strands every worker as not-in-pool (the reference
+        # chain is durable by nature)
+        ledger = Ledger.restore(ledger_path)
+        pid = min(ledger.pools)
+        did = ledger.pools[pid].domain_id
+        print(f"ledger restored from {ledger_path} (pool {pid})")
+    else:
+        ledger = Ledger()
+        did = ledger.create_domain("devnet", validation_logic="toploc")
+        pid = ledger.create_pool(
+            did, creator.address, manager.address, args.requirements
+        )
+        ledger.start_pool(pid, creator.address)
+        if ledger_path:
+            ledger.snapshot(ledger_path)
 
     session = aiohttp.ClientSession()
     runners = []
@@ -258,6 +274,13 @@ async def main(argv=None) -> None:
                 await discovery.enrich_locations_once()
             except Exception:
                 pass
+            if ledger_path:
+                try:
+                    ledger.snapshot(ledger_path)
+                except Exception as e:
+                    # a silently-stale ledger.json would restore an
+                    # incoherent chain later — make the failure visible
+                    print(f"ledger snapshot failed: {e}", file=sys.stderr)
             await asyncio.sleep(10.0)
 
     loops = [

@@ -38,21 +38,24 @@ def _parse_response(data: bytes, txn_id: bytes) -> Optional[str]:
         return None
     off = 20
     end = min(len(data), 20 + msg_len)
+    plain: Optional[str] = None
     while off + 4 <= end:
         attr_type, attr_len = struct.unpack("!HH", data[off : off + 4])
         value = data[off + 4 : off + 4 + attr_len]
         if attr_type == _ATTR_XOR_MAPPED_ADDRESS and len(value) >= 8:
             family = value[1]
             if family == 0x01:  # IPv4
-                port = struct.unpack("!H", value[2:4])[0] ^ (_MAGIC_COOKIE >> 16)
+                # XOR form wins regardless of attribute order: NAT ALGs
+                # rewrite the plain MAPPED-ADDRESS in flight (why RFC 5389
+                # introduced the XOR encoding)
                 raw = struct.unpack("!I", value[4:8])[0] ^ _MAGIC_COOKIE
                 return socket.inet_ntoa(struct.pack("!I", raw))
         if attr_type == _ATTR_MAPPED_ADDRESS and len(value) >= 8:
-            if value[1] == 0x01:
-                return socket.inet_ntoa(value[4:8])
+            if value[1] == 0x01 and plain is None:
+                plain = socket.inet_ntoa(value[4:8])
         # attributes are 32-bit aligned
         off += 4 + attr_len + ((4 - attr_len % 4) % 4)
-    return None
+    return plain
 
 
 def get_public_ip(

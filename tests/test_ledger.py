@@ -188,3 +188,51 @@ class TestWork:
         assert ledger.get_rewards(node.address) == 0
         assert ledger.get_stake(provider_addr) == stake_before
         assert ledger.get_work_info(pid, "sha-1").soft_invalidated
+
+
+def test_snapshot_restore_round_trip(tmp_path):
+    """Ledger state (balances, providers, nodes, pools incl. enum status
+    and blacklist, work, roles, id counters) survives snapshot/restore —
+    the dev substrate's equivalent of the reference's durable chain."""
+    import time as _time
+
+    from protocol_tpu.chain.ledger import PoolStatus, invite_digest
+    from protocol_tpu.security import Wallet
+
+    ledger = Ledger()
+    creator, manager = Wallet.from_seed(b"sc"), Wallet.from_seed(b"sm")
+    provider, node = Wallet.from_seed(b"sp"), Wallet.from_seed(b"sn")
+    ledger.mint(provider.address, 500)
+    did = ledger.create_domain("snap", validation_logic="toploc")
+    pid = ledger.create_pool(did, creator.address, manager.address, "ram_mb=1")
+    ledger.start_pool(pid, creator.address)
+    ledger.register_provider(provider.address, 100)
+    ledger.whitelist_provider(provider.address)
+    ledger.add_compute_node(provider.address, node.address)
+    ledger.validate_node(node.address)
+    ledger.grant_validator_role("0xval")
+    exp = _time.time() + 60
+    sig = manager.sign_message(invite_digest(did, pid, node.address, "n", exp))
+    ledger.join_compute_pool(pid, provider.address, node.address, "n", exp, sig)
+    ledger.submit_work(pid, node.address, "ab" * 32, 9)
+    ledger.soft_invalidate_work(pid, "ab" * 32)
+    ledger.blacklist_node(pid, "0xbad", manager.address)
+
+    path = str(tmp_path / "ledger.json")
+    ledger.snapshot(path)
+    restored = Ledger.restore(path)
+
+    assert restored.balance_of(provider.address) == ledger.balance_of(provider.address)
+    assert restored.get_pool_info(pid).status == PoolStatus.ACTIVE
+    assert restored.get_pool_info(pid).blacklist == {"0xbad"}
+    assert restored.is_node_in_pool(pid, node.address)
+    assert restored.is_provider_whitelisted(provider.address)
+    assert restored.is_node_validated(node.address)
+    assert restored.get_validator_role() == ["0xval"]
+    info = restored.get_work_info(pid, "ab" * 32)
+    assert info.work_units == 9 and info.soft_invalidated
+    # id counters continue, no collisions
+    assert restored.create_domain("next") == did + 1
+    assert (
+        restored.create_pool(did, creator.address, manager.address, "") == pid + 1
+    )
