@@ -319,17 +319,30 @@ async def serve_validator(args) -> None:
 async def serve_ledger_api(args) -> None:
     """Dev economic substrate as a standalone pod (the reference devnet's
     reth + contracts; production would point LEDGER_URL at a real chain
-    gateway instead)."""
+    gateway instead). With --state-dir the chain survives pod restarts
+    via periodic JSON snapshots (reth's durability, approximated)."""
     from protocol_tpu.chain import Ledger
     from protocol_tpu.services.ledger_api import LedgerApiService
 
-    ledger = Ledger()
+    ledger_path = (
+        os.path.join(args.state_dir, "ledger.json") if args.state_dir else None
+    )
+    if ledger_path and os.path.exists(ledger_path):
+        ledger = Ledger.restore(ledger_path)
+        print(f"ledger restored from {ledger_path}", flush=True)
+    else:
+        ledger = Ledger()
     svc = LedgerApiService(
         ledger, admin_api_key=os.environ.get("ADMIN_API_KEY", "admin")
     )
     await _run_app(svc.make_app(), args.port)
     while True:
-        await asyncio.sleep(3600)
+        await asyncio.sleep(10.0)
+        if ledger_path:
+            try:
+                await asyncio.to_thread(ledger.snapshot, ledger_path)
+            except Exception as e:
+                print(f"ledger snapshot failed: {e}", file=sys.stderr)
 
 
 def serve_scheduler(args) -> None:
@@ -449,6 +462,7 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     p = sub.add_parser("ledger-api")
     p.add_argument("--port", type=int, default=8095)
+    p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
 
     p = sub.add_parser("worker")
     common(p)
