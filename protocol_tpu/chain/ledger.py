@@ -302,6 +302,28 @@ class Ledger:
         _os.replace(tmp, path)
 
     @classmethod
+    def open(cls, path: Optional[str], **kwargs) -> "Ledger":
+        """Restore from ``path`` when it exists, else a fresh ledger —
+        the one entry point devnet and the ledger-api pod share."""
+        import os as _os
+
+        if path and _os.path.exists(path):
+            return cls.restore(path, **kwargs)
+        return cls(**kwargs)
+
+    def try_snapshot(self, path: str) -> bool:
+        """Snapshot with visible failure (a silently-stale ledger.json
+        restores an incoherent chain later)."""
+        try:
+            self.snapshot(path)
+            return True
+        except Exception as e:
+            import sys as _sys
+
+            print(f"ledger snapshot failed: {e}", file=_sys.stderr)
+            return False
+
+    @classmethod
     def restore(cls, path: str, **kwargs) -> "Ledger":
         import json as _json
 

@@ -114,23 +114,22 @@ async def main(argv=None) -> None:
     ledger_path = (
         os.path.join(args.state_dir, "ledger.json") if args.state_dir else None
     )
-    if ledger_path and os.path.exists(ledger_path):
-        # the chain must survive restarts WITH the service stores, or the
-        # restored pool strands every worker as not-in-pool (the reference
-        # chain is durable by nature)
-        ledger = Ledger.restore(ledger_path)
+    # the chain must survive restarts WITH the service stores, or the
+    # restored pool strands every worker as not-in-pool (the reference
+    # chain is durable by nature)
+    ledger = Ledger.open(ledger_path)
+    if ledger.pools:
         pid = min(ledger.pools)
         did = ledger.pools[pid].domain_id
         print(f"ledger restored from {ledger_path} (pool {pid})")
     else:
-        ledger = Ledger()
         did = ledger.create_domain("devnet", validation_logic="toploc")
         pid = ledger.create_pool(
             did, creator.address, manager.address, args.requirements
         )
         ledger.start_pool(pid, creator.address)
         if ledger_path:
-            ledger.snapshot(ledger_path)
+            ledger.try_snapshot(ledger_path)
 
     session = aiohttp.ClientSession()
     runners = []
@@ -275,12 +274,7 @@ async def main(argv=None) -> None:
             except Exception:
                 pass
             if ledger_path:
-                try:
-                    ledger.snapshot(ledger_path)
-                except Exception as e:
-                    # a silently-stale ledger.json would restore an
-                    # incoherent chain later — make the failure visible
-                    print(f"ledger snapshot failed: {e}", file=sys.stderr)
+                ledger.try_snapshot(ledger_path)
             await asyncio.sleep(10.0)
 
     loops = [

@@ -324,25 +324,38 @@ async def serve_ledger_api(args) -> None:
     from protocol_tpu.chain import Ledger
     from protocol_tpu.services.ledger_api import LedgerApiService
 
+    import signal
+
     ledger_path = (
         os.path.join(args.state_dir, "ledger.json") if args.state_dir else None
     )
+    ledger = Ledger.open(ledger_path)
     if ledger_path and os.path.exists(ledger_path):
-        ledger = Ledger.restore(ledger_path)
         print(f"ledger restored from {ledger_path}", flush=True)
-    else:
-        ledger = Ledger()
     svc = LedgerApiService(
         ledger, admin_api_key=os.environ.get("ADMIN_API_KEY", "admin")
     )
     await _run_app(svc.make_app(), args.port)
-    while True:
-        await asyncio.sleep(10.0)
-        if ledger_path:
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    try:
+        while not stop.is_set():
             try:
-                await asyncio.to_thread(ledger.snapshot, ledger_path)
-            except Exception as e:
-                print(f"ledger snapshot failed: {e}", file=sys.stderr)
+                await asyncio.wait_for(
+                    stop.wait(), timeout=10.0 if ledger_path else 3600.0
+                )
+            except asyncio.TimeoutError:
+                pass
+            if ledger_path:
+                await asyncio.to_thread(ledger.try_snapshot, ledger_path)
+    finally:
+        if ledger_path:
+            # final snapshot on SIGTERM (k8s rolling restart): acknowledged
+            # writes must never lose the race with the 10 s tick
+            ledger.try_snapshot(ledger_path)
 
 
 def serve_scheduler(args) -> None:
