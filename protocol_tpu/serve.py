@@ -122,15 +122,31 @@ async def serve_orchestrator(args) -> None:
     wallet = _wallet_from_env("MANAGER_KEY")
     ledger = _ledger(args)
     session = aiohttp.ClientSession()
-    store = StoreContext(
-        KVStore(
-            persist_path=(
-                os.path.join(args.state_dir, "orchestrator.aof")
-                if args.state_dir
-                else None
+    if args.kv_url:
+        # shared store pod (the reference's external Redis): api/processor
+        # replicas all see the same state
+        from protocol_tpu.store.remote_kv import RemoteKVStore
+
+        store = StoreContext(
+            RemoteKVStore(
+                args.kv_url, api_key=os.environ.get("KV_API_KEY", "admin")
             )
         )
-    )
+    else:
+        if args.mode != "full":
+            raise SystemExit(
+                f"--mode {args.mode} needs --kv-url: split replicas must "
+                "share a kv-api store pod"
+            )
+        store = StoreContext(
+            KVStore(
+                persist_path=(
+                    os.path.join(args.state_dir, "orchestrator.aof")
+                    if args.state_dir
+                    else None
+                )
+            )
+        )
 
     backend = args.scheduler_backend
     if backend != "local" and not (
@@ -234,9 +250,29 @@ async def serve_orchestrator(args) -> None:
     svc.grpc_server = grpc_server  # keep the in-process backend alive
     if webhook is not None:
         webhook.start()
-    await svc.serve(host="0.0.0.0", port=args.port)
-    print(f"orchestrator on :{args.port} (version {VERSION})", flush=True)
-    while True:  # loops run as tasks inside serve(); keep the process alive
+    # mode-dependent surface (the reference's api/processor/full split,
+    # orchestrator/src/main.rs + api/server.rs:202-220): api replicas serve
+    # HTTP only, the processor runs the loops, full does both
+    if args.mode == "api":
+        await _run_app(svc.make_app(), args.port)
+        print(f"orchestrator[api] on :{args.port} (version {VERSION})", flush=True)
+    elif args.mode == "processor":
+        from aiohttp import web as _web
+
+        health_app = _web.Application()
+        health_app.router.add_get("/health", svc.health)
+        await _run_app(health_app, args.port)
+        # only the loops; the HTTP surface lives in the api replicas.
+        # keep the task references — the event loop holds tasks weakly
+        svc.loop_tasks = svc.start_loops()
+        print(
+            f"orchestrator[processor] health on :{args.port} (version {VERSION})",
+            flush=True,
+        )
+    else:
+        await svc.serve(host="0.0.0.0", port=args.port)
+        print(f"orchestrator on :{args.port} (version {VERSION})", flush=True)
+    while True:  # loops run as tasks; keep the process alive
         await asyncio.sleep(3600)
 
 
@@ -358,6 +394,22 @@ async def serve_ledger_api(args) -> None:
             ledger.try_snapshot(ledger_path)
 
 
+async def serve_kv_api(args) -> None:
+    """Shared state store pod (the reference's external Redis)."""
+    from protocol_tpu.services.kv_api import KvApiService
+    from protocol_tpu.store.kv import KVStore
+
+    kv = KVStore(
+        persist_path=(
+            os.path.join(args.state_dir, "kv.aof") if args.state_dir else None
+        )
+    )
+    svc = KvApiService(kv, api_key=os.environ.get("KV_API_KEY", "admin"))
+    await _run_app(svc.make_app(), args.port)
+    while True:
+        await asyncio.sleep(3600)
+
+
 def serve_scheduler(args) -> None:
     """The gRPC kernel backend — the pod that actually holds the TPU."""
     from protocol_tpu.services.scheduler_grpc import serve
@@ -463,6 +515,22 @@ def main(argv: Optional[list[str]] = None) -> int:
     common(p)
     p.add_argument("--port", type=int, default=8090)
     p.add_argument("--scheduler-backend", default="local")
+    p.add_argument(
+        "--mode",
+        choices=["full", "api", "processor"],
+        default="full",
+        help="api = HTTP replicas, processor = loops; both need --kv-url "
+        "(the reference's mode split over shared Redis)",
+    )
+    p.add_argument(
+        "--kv-url",
+        default=os.environ.get("KV_URL", ""),
+        help="shared kv-api store pod (required for api/processor modes)",
+    )
+
+    p = sub.add_parser("kv-api")
+    p.add_argument("--port", type=int, default=8096)
+    p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
 
     p = sub.add_parser("validator")
     common(p)
@@ -508,7 +576,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", forced)
-    if args.service not in ("scheduler", "ledger-api"):
+    if args.service not in ("scheduler", "ledger-api", "kv-api"):
         if not args.ledger_url:
             parser.error("--ledger-url (or LEDGER_URL env) required")
         if args.pool_id < 0:
@@ -522,6 +590,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         "validator": serve_validator,
         "worker": serve_worker,
         "ledger-api": serve_ledger_api,
+        "kv-api": serve_kv_api,
     }[args.service](args)
     asyncio.run(coro)
     return 0

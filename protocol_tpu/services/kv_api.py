@@ -1,0 +1,137 @@
+"""KV HTTP API: the shared state store as its own pod.
+
+The reference's services scale because Redis is a separate process every
+replica talks to (orchestrator api/processor modes share one Redis,
+orchestrator/src/main.rs modes + store/core/redis.rs). This service is
+that seam for the in-process KV engine: one kv-api pod owns the store
+(optionally AOF-persisted) and any number of orchestrator replicas speak
+``store.remote_kv.RemoteKVStore`` to it.
+
+Surface: ``POST /kv/{op}`` with ``{"args": [...], "kwargs": {...}}``
+for every KVStore method, plus an advisory lock
+(``POST /kv/_lock`` acquire/release with token + TTL) that backs the
+remote client's ``atomic()`` — cross-client read-modify-write sequences
+serialize on it, mirroring how the reference leans on Redis pipelines /
+SET NX for the same invariants.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from protocol_tpu.security.middleware import api_key_middleware
+from protocol_tpu.store.kv import KVStore
+
+# methods a remote client may invoke (everything stateful and public)
+KV_OPS = {
+    "set", "get", "mget", "incr", "delete", "exists", "expire", "ttl",
+    "keys", "flushall", "hset", "hset_mapping", "hget", "hgetall", "hdel",
+    "hincrby", "sadd", "srem", "smembers", "sismember", "scard", "zadd",
+    "zscore", "zrem", "zrangebyscore", "zremrangebyscore", "zcard",
+    "rpush", "lpush", "lrange", "lrem", "llen",
+}
+
+
+def _jsonable(value):
+    if isinstance(value, set):
+        return sorted(value)
+    if isinstance(value, tuple):
+        return list(value)
+    if isinstance(value, list):
+        return [_jsonable(v) for v in value]
+    return value
+
+
+class KvApiService:
+    def __init__(
+        self,
+        kv: Optional[KVStore] = None,
+        api_key: str = "admin",
+        lock_ttl: float = 5.0,
+    ):
+        self.kv = kv or KVStore()
+        self.api_key = api_key
+        self.lock_ttl = lock_ttl
+        self._lock_token: Optional[str] = None
+        self._lock_expires = 0.0
+
+    def make_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[api_key_middleware(self.api_key, ["/kv"])]
+        )
+        app.router.add_post("/kv/_lock", self.lock_op)
+        app.router.add_post("/kv/{op}", self.kv_op)
+        app.router.add_get("/health", self.health)
+        return app
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    def _lock_live(self) -> bool:
+        return (
+            self._lock_token is not None and time.monotonic() < self._lock_expires
+        )
+
+    async def lock_op(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        action = body.get("action")
+        token = body.get("token", "")
+        if action == "acquire":
+            if self._lock_live() and token != self._lock_token:
+                return web.json_response(
+                    {"success": False, "error": "locked"}, status=423
+                )
+            self._lock_token = token or uuid.uuid4().hex
+            self._lock_expires = time.monotonic() + self.lock_ttl
+            return web.json_response(
+                {"success": True, "data": self._lock_token}
+            )
+        if action == "release":
+            if token == self._lock_token:
+                self._lock_token = None
+            return web.json_response({"success": True})
+        return web.json_response(
+            {"success": False, "error": "unknown action"}, status=400
+        )
+
+    async def kv_op(self, request: web.Request) -> web.Response:
+        op = request.match_info["op"]
+        if op not in KV_OPS:
+            return web.json_response(
+                {"success": False, "error": f"unknown op {op}"}, status=404
+            )
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"success": False, "error": "invalid json"}, status=400
+            )
+        args = body.get("args", [])
+        kwargs = body.get("kwargs", {})
+        # a live foreign lock blocks WRITES from other clients; reads pass
+        holder = body.get("lock_token", "")
+        if (
+            self._lock_live()
+            and holder != self._lock_token
+            and op not in ("get", "mget", "hget", "hgetall", "smembers",
+                           "sismember", "scard", "zscore", "zrangebyscore",
+                           "zcard", "lrange", "llen", "keys", "exists", "ttl")
+        ):
+            return web.json_response(
+                {"success": False, "error": "locked"}, status=423
+            )
+        if self._lock_live() and holder == self._lock_token:
+            # activity-based renewal: a long atomic section whose ops keep
+            # flowing never silently loses its serialization guarantee
+            self._lock_expires = time.monotonic() + self.lock_ttl
+        try:
+            result = getattr(self.kv, op)(*args, **kwargs)
+        except TypeError as e:
+            return web.json_response(
+                {"success": False, "error": f"bad params: {e}"}, status=400
+            )
+        return web.json_response({"success": True, "data": _jsonable(result)})

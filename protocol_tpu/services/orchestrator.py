@@ -138,9 +138,7 @@ class OrchestratorService:
     # ================= HTTP =================
 
     def make_app(self) -> web.Application:
-        async def node_known(address: str) -> bool:
-            # async validator: node exists and is not ejected/banned
-            # (api/server.rs:170-185) — gates BOTH /heartbeat and /storage
+        def _node_known_sync(address: str) -> bool:
             if self.store.kv.exists(BAN_KEY.format(address)):
                 return False
             node = self.store.node_store.get_node(address)
@@ -148,6 +146,14 @@ class OrchestratorService:
                 NodeStatus.EJECTED,
                 NodeStatus.BANNED,
             )
+
+        async def node_known(address: str) -> bool:
+            # async validator: node exists and is not ejected/banned
+            # (api/server.rs:170-185) — gates BOTH /heartbeat and /storage.
+            # Store ops run in a thread: with a RemoteKVStore (api-mode
+            # replicas) each is a blocking HTTP round-trip that must not
+            # pin the event loop.
+            return await asyncio.to_thread(_node_known_sync, address)
 
         app = web.Application(
             # raise aiohttp's 1 MiB default so the advertised 100 MB upload
@@ -248,16 +254,10 @@ class OrchestratorService:
 
     # ----- heartbeat (the hot path) -----
 
-    async def heartbeat(self, request: web.Request) -> web.Response:
-        body = request.get("auth_body") or {}
-        address = request["auth_address"]
-        hb = HeartbeatRequest.from_dict(body)
-        if hb.address.lower() != address:
-            return _err("address mismatch", 401)
-
+    def _heartbeat_store_ops(self, hb: HeartbeatRequest, address: str) -> bool:
+        """Synchronous store section of the heartbeat; returns banned."""
         if self.store.kv.exists(BAN_KEY.format(address)):
-            return _err("node is banned", 401)
-
+            return True
         node = self.store.node_store.get_node(address)
         if node is not None:
             self.store.node_store.update_node_task(
@@ -267,9 +267,7 @@ class OrchestratorService:
                 self.store.node_store.update_node_p2p(
                     address, hb.p2p_id, hb.p2p_addresses
                 )
-
         self.store.heartbeat_store.beat(hb)
-
         if hb.metrics:
             entries = []
             for m in hb.metrics:
@@ -279,6 +277,20 @@ class OrchestratorService:
                     continue
             if entries:
                 self.store.metrics_store.store_metrics(entries, address)
+        return False
+
+    async def heartbeat(self, request: web.Request) -> web.Response:
+        body = request.get("auth_body") or {}
+        address = request["auth_address"]
+        hb = HeartbeatRequest.from_dict(body)
+        if hb.address.lower() != address:
+            return _err("address mismatch", 401)
+
+        # all store writes in one thread hop: with a RemoteKVStore these
+        # are HTTP round-trips that must not pin the event loop
+        banned = await asyncio.to_thread(self._heartbeat_store_ops, hb, address)
+        if banned:
+            return _err("node is banned", 401)
 
         self.metrics.record_heartbeat(address)
         # the batch solve runs device work; keep it off the event loop
@@ -954,7 +966,22 @@ class OrchestratorService:
         await runner.setup()
         site = web.TCPSite(runner, host, port)
         await site.start()
+        # callers MUST keep the returned task references alive (the loop
+        # holds tasks weakly); serve() parks them on the app
+        app["loops"] = self.start_loops(
+            monitor_interval, invite_interval, status_interval, group_interval
+        )
+        return runner
 
+    def start_loops(
+        self,
+        monitor_interval: float = 10.0,
+        invite_interval: float = 10.0,
+        status_interval: float = 15.0,
+        group_interval: float = 10.0,
+    ) -> list:
+        """Start the four service loops (the reference's processor-mode
+        work); returns the task objects — hold them, or GC stops the pool."""
         import logging
 
         log = logging.getLogger("protocol_tpu.orchestrator")
@@ -970,7 +997,7 @@ class OrchestratorService:
                     log.exception("loop %s tick failed", name)
                 await asyncio.sleep(interval)
 
-        app["loops"] = [
+        return [
             asyncio.create_task(
                 loop("discovery_monitor", self.discovery_monitor_once, monitor_interval)
             ),
@@ -982,7 +1009,6 @@ class OrchestratorService:
                 loop("group_manager", self.group_management_once, group_interval)
             ),
         ]
-        return runner
 
 
 def _err(msg: str, status: int) -> web.Response:

@@ -1,0 +1,243 @@
+"""KVStore-interface client over the kv-api HTTP service.
+
+The counterpart of the reference services' Redis clients: orchestrator
+replicas (api/processor modes) construct ``StoreContext(RemoteKVStore(url))``
+and share one state store exactly as the reference replicas share one
+Redis (orchestrator/src/main.rs modes; store/core/redis.rs).
+
+Synchronous urllib transport, like chain.remote.RemoteLedger: callers on
+an event loop already route store-touching sections through
+``asyncio.to_thread``. ``atomic()`` maps to the server's advisory lock —
+read-modify-write sequences keep their cross-client serialization, the
+property the in-process store gets from its RLock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Iterable, Optional
+
+
+class RemoteKVError(RuntimeError):
+    pass
+
+
+class _RemoteLock:
+    """Context manager backing atomic(): acquires the server's advisory
+    lock (re-entrant per client, like the in-process RLock)."""
+
+    def __init__(self, store: "RemoteKVStore"):
+        self.store = store
+
+    def __enter__(self):
+        if self.store._lock_depth == 0:
+            # acquire BEFORE counting: a failed acquire must leave depth 0
+            # (no __exit__ runs when __enter__ raises)
+            self.store._lock_token = self.store._lock("acquire")
+        self.store._lock_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        self.store._lock_depth -= 1
+        if self.store._lock_depth == 0:
+            try:
+                self.store._lock("release")
+            finally:
+                self.store._lock_token = None
+        return False
+
+
+class RemoteKVStore:
+    def __init__(self, base_url: str, api_key: str = "admin", timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.timeout = timeout
+        self._tlocal = threading.local()
+
+    # re-entrancy bookkeeping is per-thread (services may call the store
+    # from worker threads concurrently)
+    @property
+    def _lock_depth(self) -> int:
+        return getattr(self._tlocal, "depth", 0)
+
+    @_lock_depth.setter
+    def _lock_depth(self, v: int) -> None:
+        self._tlocal.depth = v
+
+    @property
+    def _lock_token(self) -> Optional[str]:
+        return getattr(self._tlocal, "token", None)
+
+    @_lock_token.setter
+    def _lock_token(self, v: Optional[str]) -> None:
+        self._tlocal.token = v
+
+    def _post(self, path: str, payload: dict):
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(payload).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {self.api_key}",
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                out = json.loads(e.read())
+            except Exception:
+                raise RemoteKVError(f"kv api HTTP {e.code}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise RemoteKVError(f"kv api unreachable: {e}") from e
+        if not out.get("success"):
+            raise RemoteKVError(out.get("error", "kv op failed"))
+        return out.get("data")
+
+    def _lock(self, action: str) -> Optional[str]:
+        import time
+
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return self._post(
+                    "/kv/_lock",
+                    {"action": action, "token": self._lock_token or ""},
+                )
+            except RemoteKVError as e:
+                if action == "acquire" and "locked" in str(e):
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.01)
+                    continue
+                raise
+
+    def _call(self, op: str, *args, **kwargs):
+        import time
+
+        payload = {
+            "args": list(args),
+            "kwargs": kwargs,
+            "lock_token": self._lock_token or "",
+        }
+        # in-process RLock semantics: a write that meets a foreign atomic
+        # section BLOCKS until the lock frees (bounded by timeout), it
+        # does not 500 the caller on first contention
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return self._post(f"/kv/{op}", payload)
+            except RemoteKVError as e:
+                if "locked" in str(e) and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                    continue
+                raise
+
+    def atomic(self) -> _RemoteLock:
+        return _RemoteLock(self)
+
+    # ---- surface (matches KVStore) ----
+
+    def set(self, key, value, nx=False, ex=None):
+        return self._call("set", key, value, nx=nx, ex=ex)
+
+    def get(self, key):
+        return self._call("get", key)
+
+    def mget(self, keys: Iterable[str]):
+        return self._call("mget", list(keys))
+
+    def incr(self, key, amount=1):
+        return self._call("incr", key, amount)
+
+    def delete(self, *keys):
+        return self._call("delete", *keys)
+
+    def exists(self, key):
+        return self._call("exists", key)
+
+    def expire(self, key, seconds):
+        return self._call("expire", key, seconds)
+
+    def ttl(self, key):
+        return self._call("ttl", key)
+
+    def keys(self, pattern="*"):
+        return self._call("keys", pattern)
+
+    def flushall(self):
+        return self._call("flushall")
+
+    def hset(self, key, field, value):
+        return self._call("hset", key, field, value)
+
+    def hset_mapping(self, key, mapping):
+        return self._call("hset_mapping", key, mapping)
+
+    def hget(self, key, field):
+        return self._call("hget", key, field)
+
+    def hgetall(self, key):
+        return self._call("hgetall", key)
+
+    def hdel(self, key, *fields):
+        return self._call("hdel", key, *fields)
+
+    def hincrby(self, key, field, amount=1):
+        return self._call("hincrby", key, field, amount)
+
+    def sadd(self, key, *members):
+        return self._call("sadd", key, *members)
+
+    def srem(self, key, *members):
+        return self._call("srem", key, *members)
+
+    def smembers(self, key):
+        return set(self._call("smembers", key))
+
+    def sismember(self, key, member):
+        return self._call("sismember", key, member)
+
+    def scard(self, key):
+        return self._call("scard", key)
+
+    def zadd(self, key, mapping):
+        return self._call("zadd", key, mapping)
+
+    def zscore(self, key, member):
+        return self._call("zscore", key, member)
+
+    def zrem(self, key, *members):
+        return self._call("zrem", key, *members)
+
+    def zrangebyscore(self, key, min_score=float("-inf"), max_score=float("inf")):
+        # json has no infinities: clamp to sentinel bounds
+        lo = -1e300 if min_score == float("-inf") else min_score
+        hi = 1e300 if max_score == float("inf") else max_score
+        return [tuple(x) for x in self._call("zrangebyscore", key, lo, hi)]
+
+    def zremrangebyscore(self, key, min_score, max_score):
+        return self._call("zremrangebyscore", key, min_score, max_score)
+
+    def zcard(self, key):
+        return self._call("zcard", key)
+
+    def rpush(self, key, *values):
+        return self._call("rpush", key, *values)
+
+    def lpush(self, key, *values):
+        return self._call("lpush", key, *values)
+
+    def lrange(self, key, start=0, stop=-1):
+        return self._call("lrange", key, start, stop)
+
+    def lrem(self, key, count, value):
+        return self._call("lrem", key, count, value)
+
+    def llen(self, key):
+        return self._call("llen", key)

@@ -1,0 +1,152 @@
+"""kv-api store pod + RemoteKVStore client: the shared-state seam that
+lets orchestrator api/processor replicas scale like the reference's over
+external Redis (orchestrator/src/main.rs modes, store/core/redis.rs)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from protocol_tpu.services.kv_api import KvApiService
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.store.kv import KVStore
+from protocol_tpu.store.remote_kv import RemoteKVError, RemoteKVStore
+
+
+@pytest.fixture(scope="module")
+def kv_api():
+    ready = threading.Event()
+    state = {}
+    kv = KVStore()
+
+    def run():
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            svc = KvApiService(kv, api_key="k")
+            runner = web.AppRunner(svc.make_app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            state["port"] = runner.addresses[0][1]
+            ready.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert ready.wait(10)
+    yield kv, f"http://127.0.0.1:{state['port']}"
+
+
+def _client(url):
+    return RemoteKVStore(url, api_key="k")
+
+
+def test_full_surface_round_trip(kv_api):
+    _local, url = kv_api
+    r = _client(url)
+    assert r.set("s", "v") is True
+    assert r.get("s") == "v"
+    assert r.set("s", "w", nx=True) is False
+    assert r.mget(["s", "missing"]) == ["v", None]
+    assert r.incr("ctr", 5) == 5
+    r.hset("h", "f", "1")
+    r.hset_mapping("h", {"g": "2"})
+    assert r.hgetall("h") == {"f": "1", "g": "2"}
+    assert r.hincrby("h", "n", 3) == 3
+    assert r.hdel("h", "g") == 1
+    r.sadd("set", "a", "b")
+    assert r.smembers("set") == {"a", "b"}
+    assert r.sismember("set", "a") and not r.sismember("set", "z")
+    assert r.scard("set") == 2
+    r.zadd("z", {"m": 1.5, "n": 9.0})
+    assert r.zscore("z", "m") == 1.5
+    assert r.zrangebyscore("z") == [("m", 1.5), ("n", 9.0)]
+    assert r.zrangebyscore("z", 2.0, 10.0) == [("n", 9.0)]
+    assert r.zremrangebyscore("z", 0, 2) == 1
+    r.rpush("l", "x", "y")
+    r.lpush("l", "w")
+    assert r.lrange("l") == ["w", "x", "y"]
+    assert r.lrem("l", 1, "x") == 1
+    assert r.llen("l") == 2
+    assert r.exists("s")
+    r.expire("s", 100)
+    assert 90 < r.ttl("s") <= 100
+    assert "ctr" in r.keys("*")
+    assert r.delete("ctr") == 1
+    # the server-side store saw everything (one shared state)
+    assert _local.get("s") == "v"
+
+
+def test_atomic_serializes_read_modify_write_across_clients(kv_api):
+    _local, url = kv_api
+    clients = [_client(url) for _ in range(4)]
+    _local.set("rmw", "0")
+    barrier = threading.Barrier(4)
+
+    def bump(c):
+        barrier.wait()
+        for _ in range(5):
+            with c.atomic():
+                v = int(c.get("rmw"))
+                c.set("rmw", str(v + 1))
+
+    threads = [threading.Thread(target=bump, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # without the advisory lock, concurrent get+set would lose updates
+    assert _local.get("rmw") == "20"
+
+
+def test_writes_block_until_foreign_lock_frees(kv_api):
+    """In-process RLock semantics over the wire: a write meeting a foreign
+    atomic section WAITS for the lock (no 500s on first contention);
+    reads pass through immediately."""
+    import time
+
+    _local, url = kv_api
+    a, b = _client(url), _client(url)
+
+    def hold():
+        with a.atomic():
+            time.sleep(0.5)
+
+    th = threading.Thread(target=hold)
+    th.start()
+    time.sleep(0.1)  # let A take the lock
+    assert b.get("rmw") is not None  # reads never block
+    t0 = time.monotonic()
+    b.set("blocked", "x")  # blocks until A releases, then succeeds
+    waited = time.monotonic() - t0
+    th.join()
+    assert waited >= 0.25, waited
+    assert _local.get("blocked") == "x"
+
+    # a client that cannot ever get through still fails loudly (bounded)
+    slowpoke = RemoteKVStore(url, api_key="k", timeout=0.3)
+    with a.atomic():
+        with pytest.raises(RemoteKVError):
+            slowpoke.set("never", "x")
+
+
+def test_store_context_over_remote_kv(kv_api):
+    """Domain stores (node store etc.) run unchanged over the remote
+    client — the orchestrator-replica shape."""
+    _local, url = kv_api
+    store_a = StoreContext(_client(url))
+    store_b = StoreContext(_client(url))
+    store_a.node_store.add_node(
+        OrchestratorNode(address="0xshared", status=NodeStatus.HEALTHY,
+                         ip_address="4.4.4.4", port=9)
+    )
+    # replica B sees replica A's write immediately
+    node = store_b.node_store.get_node("0xshared")
+    assert node is not None and node.status == NodeStatus.HEALTHY
+    store_b.node_store.update_node_status("0xshared", NodeStatus.UNHEALTHY)
+    assert store_a.node_store.get_node("0xshared").status == NodeStatus.UNHEALTHY
