@@ -1,0 +1,3 @@
+{{- define "kv.fullname" -}}
+{{ .Chart.Name }}
+{{- end -}}

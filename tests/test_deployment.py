@@ -12,7 +12,7 @@ import yaml
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 K8S = os.path.join(REPO, "deployment", "k8s")
 CHARTS = ["discovery-chart", "orchestrator-chart", "validator-chart",
-          "scheduler-chart"]
+          "scheduler-chart", "kv-chart"]
 
 
 @pytest.mark.parametrize("chart", CHARTS)
