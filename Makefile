@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast native devnet bench clean lint
+.PHONY: test test-fast native devnet devnet-persistent bench bench-scaling clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -21,9 +21,20 @@ native:
 devnet:
 	$(PY) -m protocol_tpu.devnet --workers 2 --cpu
 
+# persistent devnet: docker runtime + remote scheduler seam + AOF/ledger
+# state surviving restarts
+devnet-persistent:
+	$(PY) -m protocol_tpu.devnet --workers 2 --cpu --runtime docker \
+	  --scheduler-backend remote --state-dir /var/tmp/protocol_tpu_devnet
+
 # the scheduler-kernel benchmark (real accelerator; prints one JSON line)
 bench:
 	$(PY) bench.py
+
+# ladder-#4 scaling measurement (per-shard rates + HBM envelopes; see
+# SCALING.md). Runs on the chip when healthy, CPU mesh otherwise.
+bench-scaling:
+	$(PY) bench_scaling.py --full
 
 # regenerate protobuf messages for the gRPC shim
 proto:
