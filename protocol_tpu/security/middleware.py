@@ -11,6 +11,7 @@ api_key_middleware.rs (``Authorization: Bearer <admin key>``).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import Awaitable, Callable, Iterable, Optional
@@ -122,9 +123,14 @@ def validate_signature_middleware(
             )
 
         if body is None:
-            # replay-cache the signature itself for the freshness window
+            # replay-cache the signature itself for the freshness window.
+            # kv.set runs in a thread: with a RemoteKVStore (api-mode
+            # replicas) it is a blocking HTTP round-trip
             sig = request.headers.get("x-signature", "")
-            if not kv.set(f"sig:{sig}", "1", nx=True, ex=NONCE_TTL_SECONDS * 2):
+            fresh = await asyncio.to_thread(
+                kv.set, f"sig:{sig}", "1", nx=True, ex=NONCE_TTL_SECONDS * 2
+            )
+            if not fresh:
                 return web.json_response(
                     {"success": False, "error": "signature replay"}, status=401
                 )
@@ -146,7 +152,10 @@ def validate_signature_middleware(
                 return web.json_response(
                     {"success": False, "error": "invalid nonce"}, status=401
                 )
-            if not kv.set(f"nonce:{nonce}", "1", nx=True, ex=NONCE_TTL_SECONDS):
+            fresh = await asyncio.to_thread(
+                kv.set, f"nonce:{nonce}", "1", nx=True, ex=NONCE_TTL_SECONDS
+            )
+            if not fresh:
                 return web.json_response(
                     {"success": False, "error": "nonce replay"}, status=401
                 )
