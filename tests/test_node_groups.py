@@ -468,3 +468,116 @@ class TestLongTail:
         for gid, g in live.items():
             for addr in g.nodes:
                 assert mapping.get(addr) == gid
+
+
+class TestReferenceScenarios:
+    """Direct ports of reference node_groups/tests.rs scenarios not yet
+    covered by the suites above."""
+
+    def _world(self, configs, nodes, policy=TaskSwitchingPolicy.ALWAYS):
+        ctx = StoreContext.new_test()
+        plugin = make_plugin(ctx, configs, policy=policy)
+        for n in nodes:
+            ctx.node_store.add_node(n)
+        return ctx, plugin
+
+    def test_group_formation_priority(self):
+        """tests.rs test_group_formation_priority: with contested nodes,
+        the larger-min / more-specific config forms first."""
+        big = NodeGroupConfiguration(name="big", min_group_size=3, max_group_size=3)
+        small = NodeGroupConfiguration(name="small", min_group_size=1, max_group_size=1)
+        # registration order is small-first: the sort must still give 'big'
+        # the nodes it needs
+        ctx, plugin = self._world(
+            [small, big], [mk_node(f"0xfp{i}") for i in range(3)]
+        )
+        for cfg in ("small", "big"):
+            ctx.kv.sadd(ENABLED_CONFIGS, cfg)
+        plugin.try_form_new_groups()
+        by_config = {}
+        for g in plugin.get_groups():
+            by_config.setdefault(g.configuration_name, []).append(g)
+        assert len(by_config.get("big", [])) == 1
+        assert len(by_config["big"][0].nodes) == 3
+        assert "small" not in by_config  # big consumed all three
+
+    def test_building_largest_possible_groups(self):
+        """tests.rs test_building_largest_possible_groups: formation fills
+        to max_group_size when nodes allow."""
+        cfg = NodeGroupConfiguration(name="g", min_group_size=2, max_group_size=4)
+        ctx, plugin = self._world([cfg], [mk_node(f"0xlg{i}") for i in range(4)])
+        ctx.kv.sadd(ENABLED_CONFIGS, "g")
+        plugin.try_form_new_groups()
+        groups = plugin.get_groups()
+        assert len(groups) == 1 and len(groups[0].nodes) == 4
+
+    def test_multiple_groups_same_configuration(self):
+        """tests.rs test_multiple_groups_same_configuration: abundant nodes
+        form several groups of one config."""
+        cfg = NodeGroupConfiguration(name="g", min_group_size=2, max_group_size=2)
+        ctx, plugin = self._world([cfg], [mk_node(f"0xmg{i}") for i in range(6)])
+        ctx.kv.sadd(ENABLED_CONFIGS, "g")
+        plugin.try_form_new_groups()
+        groups = plugin.get_groups()
+        assert len(groups) == 3
+        assert all(len(g.nodes) == 2 for g in groups)
+        grouped = [a for g in groups for a in g.nodes]
+        assert len(set(grouped)) == 6  # no node in two groups
+
+    def test_reformation_on_death(self):
+        """tests.rs test_reformation_on_death: a member death dissolves the
+        group; the next management tick re-forms from survivors + spares."""
+        cfg = NodeGroupConfiguration(name="g", min_group_size=2, max_group_size=2)
+        nodes = [mk_node(f"0xrd{i}") for i in range(3)]
+        ctx, plugin = self._world([cfg], nodes)
+        ctx.kv.sadd(ENABLED_CONFIGS, "g")
+        plugin.try_form_new_groups()
+        group = plugin.get_groups()[0]
+        victim_addr = group.nodes[0]
+        victim = ctx.node_store.get_node(victim_addr)
+        victim.status = NodeStatus.DEAD
+        ctx.node_store.update_node(victim)
+        plugin.handle_status_change(victim)
+        assert plugin.get_group(group.id) is None  # dissolved
+        # next tick: survivor + the spare re-form
+        plugin.try_form_new_groups()
+        regrouped = plugin.get_groups()
+        assert any(
+            len(g.nodes) == 2 and victim_addr not in g.nodes for g in regrouped
+        )
+
+    def test_merge_only_compatible_groups(self):
+        """tests.rs test_merge_only_compatible_groups: solos of different
+        configurations never merge together."""
+        a = NodeGroupConfiguration(name="a", min_group_size=1, max_group_size=4)
+        b = NodeGroupConfiguration(name="b", min_group_size=1, max_group_size=4)
+        ctx, plugin = self._world([a, b], [])
+        for i, cfg in enumerate([a, a, b, b]):
+            addr = f"0xmc{i}"
+            ctx.node_store.add_node(mk_node(addr))
+            plugin._create_group(cfg, [addr])
+        plugin.try_merge_solo_groups()
+        for g in plugin.get_groups():
+            prefix_cfg = g.configuration_name
+            assert len(g.nodes) == 2
+            assert prefix_cfg in ("a", "b")
+        merged_a = [g for g in plugin.get_groups() if g.configuration_name == "a"]
+        merged_b = [g for g in plugin.get_groups() if g.configuration_name == "b"]
+        assert len(merged_a) == 1 and len(merged_b) == 1
+        assert not (set(merged_a[0].nodes) & set(merged_b[0].nodes))
+
+    def test_task_assignment_during_merge(self):
+        """tests.rs test_task_assignment_during_merge: a single shared task
+        among merged solos carries to the merged group."""
+        cfg = NodeGroupConfiguration(name="g", min_group_size=1, max_group_size=2)
+        ctx, plugin = self._world([cfg], [])
+        task = mk_topo_task("carry", ["g"])
+        ctx.task_store.add_task(task)
+        for i in range(2):
+            addr = f"0xtm{i}"
+            ctx.node_store.add_node(mk_node(addr))
+            g = plugin._create_group(cfg, [addr])
+            ctx.kv.set(GROUP_TASK_KEY.format(g.id), task.id)
+        assert plugin.try_merge_solo_groups() == 1
+        merged = next(g for g in plugin.get_groups() if len(g.nodes) == 2)
+        assert ctx.kv.get(GROUP_TASK_KEY.format(merged.id)) == task.id
