@@ -1,0 +1,91 @@
+#!/bin/bash
+# Local pod-topology launcher: one OS process per service, wired by URLs +
+# env secrets — the Helm-chart shape without kubernetes (the reference's
+# docker-compose dev environment, docker-compose.yml). Ctrl-C stops all.
+#
+# Usage: scripts/run_pod_topology.sh [BASE_PORT] [STATE_DIR]
+set -u
+B=${1:-28500}
+STATE=${2:-}
+LEDGER=http://127.0.0.1:$((B+5))
+DISC=http://127.0.0.1:$B
+ORCH=http://127.0.0.1:$((B+1))
+SCHED=127.0.0.1:$((B+6))
+KV=http://127.0.0.1:$((B+7))
+STATE_ARGS=()
+[ -n "$STATE" ] && STATE_ARGS=(--state-dir "$STATE")
+
+wkey() { python -c "from protocol_tpu.security import Wallet; print(Wallet.from_seed(b'pod-$1').private_key_hex())"; }
+waddr() { python -c "from protocol_tpu.security import Wallet; print(Wallet.from_seed(b'pod-$1').address)"; }
+MANAGER_KEY=$(wkey manager)
+MANAGER_ADDR=$(waddr manager)
+CREATOR_ADDR=$(waddr creator)
+VALIDATOR_KEY=$(wkey validator)
+VALIDATOR_ADDR=$(waddr validator)
+PROVIDER_KEY=$(wkey provider)
+PROVIDER_ADDR=$(waddr provider)
+NODE_KEY=$(wkey node)
+
+PIDS=()
+trap 'kill "${PIDS[@]}" 2>/dev/null' EXIT INT TERM
+
+python -m protocol_tpu.serve ledger-api --port $((B+5)) "${STATE_ARGS[@]}" &
+PIDS+=($!)
+sleep 3
+
+CLI="python -m protocol_tpu.cli --ledger $LEDGER --api-key admin"
+if ! $CLI pool-info --pool-id 0 >/dev/null 2>&1; then
+  $CLI mint --address "$PROVIDER_ADDR" --amount 100000 > /dev/null
+  $CLI create-domain --name pods > /dev/null
+  $CLI create-pool --domain-id 0 --creator "$CREATOR_ADDR" --manager "$MANAGER_ADDR" > /dev/null
+  $CLI start-pool --pool-id 0 --caller "$CREATOR_ADDR" > /dev/null
+  curl -s -X POST -H "Authorization: Bearer admin" -H "Content-Type: application/json" \
+    -d "{\"address\": \"$VALIDATOR_ADDR\"}" "$LEDGER/ledger/write/grant_validator_role" > /dev/null
+fi
+
+python -m protocol_tpu.serve scheduler --address "$SCHED" &
+PIDS+=($!)
+KV_API_KEY=admin python -m protocol_tpu.serve kv-api --port $((B+7)) "${STATE_ARGS[@]}" &
+PIDS+=($!)
+ADMIN_API_KEY=admin python -m protocol_tpu.serve discovery \
+  --ledger-url "$LEDGER" --pool-id 0 --port "$B" "${STATE_ARGS[@]}" &
+PIDS+=($!)
+sleep 2
+MANAGER_KEY=$MANAGER_KEY ADMIN_API_KEY=admin DISCOVERY_URLS=$DISC \
+  HEARTBEAT_URL=$ORCH LEDGER_API_KEY=admin KV_API_KEY=admin \
+  python -m protocol_tpu.serve orchestrator --ledger-url "$LEDGER" --pool-id 0 \
+  --port $((B+1)) --scheduler-backend "remote:$SCHED" \
+  --mode api --kv-url "$KV" &
+PIDS+=($!)
+MANAGER_KEY=$MANAGER_KEY ADMIN_API_KEY=admin DISCOVERY_URLS=$DISC \
+  HEARTBEAT_URL=$ORCH LEDGER_API_KEY=admin KV_API_KEY=admin \
+  python -m protocol_tpu.serve orchestrator --ledger-url "$LEDGER" --pool-id 0 \
+  --port $((B+8)) --scheduler-backend local \
+  --mode processor --kv-url "$KV" &
+PIDS+=($!)
+VALIDATOR_KEY=$VALIDATOR_KEY DISCOVERY_URLS=$DISC LEDGER_API_KEY=admin \
+  python -m protocol_tpu.serve validator --ledger-url "$LEDGER" --pool-id 0 \
+  --port $((B+4)) &
+PIDS+=($!)
+PROVIDER_KEY=$PROVIDER_KEY NODE_KEY=$NODE_KEY LEDGER_API_KEY=admin \
+  python -m protocol_tpu.serve worker --ledger-url "$LEDGER" --pool-id 0 \
+  --port $((B+10)) --discovery-urls "$DISC" --runtime subprocess \
+  --socket-path /tmp/ptpu-pods-bridge.sock &
+PIDS+=($!)
+
+sleep 10
+$CLI whitelist-provider --provider "$PROVIDER_ADDR" > /dev/null 2>&1 || true
+
+cat <<INFO
+pod topology up:
+  discovery       $DISC
+  orchestrator    $ORCH         (api replica; processor health :$((B+8)))
+  validator       http://127.0.0.1:$((B+4))
+  ledger api      $LEDGER       (admin key: admin)
+  kv store        $KV
+  scheduler gRPC  $SCHED
+try:
+  python -m protocol_tpu.cli --orchestrator $ORCH --api-key admin \\
+      create-task --name hello --image demo --cmd 'echo,hello'
+INFO
+wait
