@@ -15,23 +15,25 @@ KV=http://127.0.0.1:$((B+7))
 STATE_ARGS=()
 [ -n "$STATE" ] && STATE_ARGS=(--state-dir "$STATE")
 
-wkey() { python -c "from protocol_tpu.security import Wallet; print(Wallet.from_seed(b'pod-$1').private_key_hex())"; }
-waddr() { python -c "from protocol_tpu.security import Wallet; print(Wallet.from_seed(b'pod-$1').address)"; }
-MANAGER_KEY=$(wkey manager)
-MANAGER_ADDR=$(waddr manager)
-CREATOR_ADDR=$(waddr creator)
-VALIDATOR_KEY=$(wkey validator)
-VALIDATOR_ADDR=$(waddr validator)
-PROVIDER_KEY=$(wkey provider)
-PROVIDER_ADDR=$(waddr provider)
-NODE_KEY=$(wkey node)
+eval "$(python - <<'PYEOF'
+from protocol_tpu.security import Wallet
+for name in ("manager", "creator", "validator", "provider", "node"):
+    w = Wallet.from_seed(f"pod-{name}".encode())
+    print(f"{name.upper()}_KEY={w.private_key_hex()}")
+    print(f"{name.upper()}_ADDR={w.address}")
+PYEOF
+)"
 
 PIDS=()
 trap 'kill "${PIDS[@]}" 2>/dev/null' EXIT INT TERM
 
 python -m protocol_tpu.serve ledger-api --port $((B+5)) "${STATE_ARGS[@]}" &
 PIDS+=($!)
-sleep 3
+for i in $(seq 1 60); do
+  curl -sf "$LEDGER/health" > /dev/null 2>&1 && break
+  sleep 0.5
+done
+curl -sf "$LEDGER/health" > /dev/null || { echo "ledger-api failed to start" >&2; exit 1; }
 
 CLI="python -m protocol_tpu.cli --ledger $LEDGER --api-key admin"
 if ! $CLI pool-info --pool-id 0 >/dev/null 2>&1; then
@@ -60,7 +62,7 @@ PIDS+=($!)
 MANAGER_KEY=$MANAGER_KEY ADMIN_API_KEY=admin DISCOVERY_URLS=$DISC \
   HEARTBEAT_URL=$ORCH LEDGER_API_KEY=admin KV_API_KEY=admin \
   python -m protocol_tpu.serve orchestrator --ledger-url "$LEDGER" --pool-id 0 \
-  --port $((B+8)) --scheduler-backend local \
+  --port $((B+8)) --scheduler-backend "remote:$SCHED" \
   --mode processor --kv-url "$KV" &
 PIDS+=($!)
 VALIDATOR_KEY=$VALIDATOR_KEY DISCOVERY_URLS=$DISC LEDGER_API_KEY=admin \
@@ -70,7 +72,7 @@ PIDS+=($!)
 PROVIDER_KEY=$PROVIDER_KEY NODE_KEY=$NODE_KEY LEDGER_API_KEY=admin \
   python -m protocol_tpu.serve worker --ledger-url "$LEDGER" --pool-id 0 \
   --port $((B+10)) --discovery-urls "$DISC" --runtime subprocess \
-  --socket-path /tmp/ptpu-pods-bridge.sock &
+  --socket-path /tmp/ptpu-pods-$B.sock &
 PIDS+=($!)
 
 sleep 10
