@@ -16,8 +16,7 @@ wrap service loops in ``asyncio.to_thread`` where latency matters.
 from __future__ import annotations
 
 import json
-import urllib.error
-import urllib.request
+import threading
 from typing import Optional
 
 from .ledger import (
@@ -41,32 +40,70 @@ class RemoteLedger:
         self.base_url = base_url.rstrip("/")
         self.admin_api_key = admin_api_key
         self.timeout = timeout
+        self._tlocal = threading.local()
 
     # ---- transport
 
-    def _call(self, kind: str, op: str, params: dict):
-        body = json.dumps(params).encode()
-        req = urllib.request.Request(
-            f"{self.base_url}/ledger/{kind}/{op}",
-            data=body,
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        if kind == "write" and self.admin_api_key:
-            req.add_header("Authorization", f"Bearer {self.admin_api_key}")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
+    def _connection(self):
+        """Per-thread keep-alive connection (fresh TCP handshakes per op
+        dominated measured client latency; see store/remote_kv.py)."""
+        import http.client
+        import urllib.parse
+
+        conn = getattr(self._tlocal, "conn", None)
+        if conn is None:
+            parsed = urllib.parse.urlparse(self.base_url)
+            cls = (
+                http.client.HTTPSConnection
+                if parsed.scheme == "https"
+                else http.client.HTTPConnection
+            )
+            conn = cls(parsed.netloc, timeout=self.timeout)
+            self._tlocal.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._tlocal, "conn", None)
+        if conn is not None:
             try:
-                payload = json.loads(e.read())
+                conn.close()
             except Exception:
-                raise LedgerError(f"ledger api {op}: HTTP {e.code}") from e
-        except (urllib.error.URLError, OSError) as e:
-            raise LedgerError(f"ledger api unreachable: {e}") from e
-        if not payload.get("success"):
-            raise LedgerError(payload.get("error", f"{op} failed"))
-        return payload.get("data")
+                pass
+            self._tlocal.conn = None
+
+    def _call(self, kind: str, op: str, params: dict):
+        import http.client
+
+        body = json.dumps(params)
+        headers = {"Content-Type": "application/json"}
+        if kind == "write" and self.admin_api_key:
+            headers["Authorization"] = f"Bearer {self.admin_api_key}"
+        last_exc = None
+        for attempt in (0, 1):  # one retry on a stale kept-alive socket
+            conn = self._connection()
+            try:
+                conn.request(
+                    "POST", f"/ledger/{kind}/{op}", body=body, headers=headers
+                )
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                self._drop_connection()
+                last_exc = e
+                if attempt == 0:
+                    continue
+                raise LedgerError(f"ledger api unreachable: {e}") from e
+            try:
+                payload = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._drop_connection()
+                raise LedgerError(
+                    f"ledger api {op}: bad response (HTTP {resp.status})"
+                ) from e
+            if not payload.get("success"):
+                raise LedgerError(payload.get("error", f"{op} failed"))
+            return payload.get("data")
+        raise LedgerError(f"ledger api unreachable: {last_exc}")
 
     def _read(self, op: str, **params):
         return self._call("read", op, params)

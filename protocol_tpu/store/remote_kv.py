@@ -14,10 +14,9 @@ property the in-process store gets from its RLock.
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
-import urllib.error
-import urllib.request
 from typing import Iterable, Optional
 
 
@@ -75,29 +74,64 @@ class RemoteKVStore:
     def _lock_token(self, v: Optional[str]) -> None:
         self._tlocal.token = v
 
-    def _post(self, path: str, payload: dict):
-        req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=json.dumps(payload).encode(),
-            headers={
-                "Content-Type": "application/json",
-                "Authorization": f"Bearer {self.api_key}",
-            },
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                out = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
+    def _connection(self):
+        """Persistent keep-alive connection, one per thread: the hot path
+        issues several kv ops per request and a fresh TCP handshake per op
+        dominated the measured latency."""
+        import http.client
+        import urllib.parse
+
+        conn = getattr(self._tlocal, "conn", None)
+        if conn is None:
+            parsed = urllib.parse.urlparse(self.base_url)
+            cls = (
+                http.client.HTTPSConnection
+                if parsed.scheme == "https"
+                else http.client.HTTPConnection
+            )
+            conn = cls(parsed.netloc, timeout=self.timeout)
+            self._tlocal.conn = conn
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._tlocal, "conn", None)
+        if conn is not None:
             try:
-                out = json.loads(e.read())
+                conn.close()
             except Exception:
-                raise RemoteKVError(f"kv api HTTP {e.code}") from e
-        except (urllib.error.URLError, OSError) as e:
-            raise RemoteKVError(f"kv api unreachable: {e}") from e
-        if not out.get("success"):
-            raise RemoteKVError(out.get("error", "kv op failed"))
-        return out.get("data")
+                pass
+            self._tlocal.conn = None
+
+    def _post(self, path: str, payload: dict):
+        body = json.dumps(payload)
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": f"Bearer {self.api_key}",
+        }
+        last_exc: Optional[Exception] = None
+        for attempt in (0, 1):  # one retry on a stale kept-alive socket
+            conn = self._connection()
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                self._drop_connection()
+                last_exc = e
+                if attempt == 0:
+                    continue
+                raise RemoteKVError(f"kv api unreachable: {e}") from e
+            try:
+                out = json.loads(raw)
+            except json.JSONDecodeError as e:
+                self._drop_connection()
+                raise RemoteKVError(
+                    f"kv api bad response (HTTP {resp.status})"
+                ) from e
+            if not out.get("success"):
+                raise RemoteKVError(out.get("error", "kv op failed"))
+            return out.get("data")
+        raise RemoteKVError(f"kv api unreachable: {last_exc}")
 
     def _lock(self, action: str) -> Optional[str]:
         import time
