@@ -7,17 +7,17 @@ speaking the LedgerApiService seam — same method surface as the in-process
 ``Ledger``, so every service constructor accepts either interchangeably.
 
 Synchronous on purpose: ledger calls sit on control-plane paths that are
-already synchronous (services call ``self.ledger.x(...)`` directly), volumes
-are tens of calls per loop tick, and a blocking urllib round-trip to a
-colocated API keeps the client dependency-free. Callers on the event loop
-wrap service loops in ``asyncio.to_thread`` where latency matters.
+already synchronous (services call ``self.ledger.x(...)`` directly) and
+volumes are tens of calls per loop tick; transport is the shared
+per-thread keep-alive client (utils.http_client). Callers on the event
+loop wrap service loops in ``asyncio.to_thread`` where latency matters.
 """
 
 from __future__ import annotations
 
-import json
-import threading
 from typing import Optional
+
+from protocol_tpu.utils.http_client import KeepAliveJsonClient
 
 from .ledger import (
     DomainInfo,
@@ -40,70 +40,23 @@ class RemoteLedger:
         self.base_url = base_url.rstrip("/")
         self.admin_api_key = admin_api_key
         self.timeout = timeout
-        self._tlocal = threading.local()
+        self._http = KeepAliveJsonClient(base_url, timeout, LedgerError)
 
     # ---- transport
 
-    def _connection(self):
-        """Per-thread keep-alive connection (fresh TCP handshakes per op
-        dominated measured client latency; see store/remote_kv.py)."""
-        import http.client
-        import urllib.parse
-
-        conn = getattr(self._tlocal, "conn", None)
-        if conn is None:
-            parsed = urllib.parse.urlparse(self.base_url)
-            cls = (
-                http.client.HTTPSConnection
-                if parsed.scheme == "https"
-                else http.client.HTTPConnection
-            )
-            conn = cls(parsed.netloc, timeout=self.timeout)
-            self._tlocal.conn = conn
-        return conn
-
-    def _drop_connection(self) -> None:
-        conn = getattr(self._tlocal, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            except Exception:
-                pass
-            self._tlocal.conn = None
-
     def _call(self, kind: str, op: str, params: dict):
-        import http.client
-
-        body = json.dumps(params)
-        headers = {"Content-Type": "application/json"}
+        headers = {}
         if kind == "write" and self.admin_api_key:
             headers["Authorization"] = f"Bearer {self.admin_api_key}"
-        last_exc = None
-        for attempt in (0, 1):  # one retry on a stale kept-alive socket
-            conn = self._connection()
-            try:
-                conn.request(
-                    "POST", f"/ledger/{kind}/{op}", body=body, headers=headers
-                )
-                resp = conn.getresponse()
-                raw = resp.read()
-            except (http.client.HTTPException, OSError) as e:
-                self._drop_connection()
-                last_exc = e
-                if attempt == 0:
-                    continue
-                raise LedgerError(f"ledger api unreachable: {e}") from e
-            try:
-                payload = json.loads(raw)
-            except json.JSONDecodeError as e:
-                self._drop_connection()
-                raise LedgerError(
-                    f"ledger api {op}: bad response (HTTP {resp.status})"
-                ) from e
-            if not payload.get("success"):
-                raise LedgerError(payload.get("error", f"{op} failed"))
-            return payload.get("data")
-        raise LedgerError(f"ledger api unreachable: {last_exc}")
+        payload = self._http.post(
+            f"/ledger/{kind}/{op}",
+            params,
+            headers=headers,
+            retry_response=(kind == "read"),
+        )
+        if not payload.get("success"):
+            raise LedgerError(payload.get("error", f"{op} failed"))
+        return payload.get("data")
 
     def _read(self, op: str, **params):
         return self._call("read", op, params)

@@ -5,19 +5,19 @@ replicas (api/processor modes) construct ``StoreContext(RemoteKVStore(url))``
 and share one state store exactly as the reference replicas share one
 Redis (orchestrator/src/main.rs modes; store/core/redis.rs).
 
-Synchronous urllib transport, like chain.remote.RemoteLedger: callers on
-an event loop already route store-touching sections through
-``asyncio.to_thread``. ``atomic()`` maps to the server's advisory lock —
-read-modify-write sequences keep their cross-client serialization, the
-property the in-process store gets from its RLock.
+Synchronous transport (per-thread keep-alive connections via
+utils.http_client): callers on an event loop already route store-touching
+sections through ``asyncio.to_thread``. ``atomic()`` maps to the server's
+advisory lock — read-modify-write sequences keep their cross-client
+serialization, the property the in-process store gets from its RLock.
 """
 
 from __future__ import annotations
 
-import http.client
-import json
 import threading
 from typing import Iterable, Optional
+
+from protocol_tpu.utils.http_client import KeepAliveJsonClient
 
 
 class RemoteKVError(RuntimeError):
@@ -50,10 +50,18 @@ class _RemoteLock:
 
 
 class RemoteKVStore:
+    # ops safe to resend after a lost response (no state change)
+    READ_OPS = frozenset({
+        "get", "mget", "hget", "hgetall", "smembers", "sismember", "scard",
+        "zscore", "zrangebyscore", "zcard", "lrange", "llen", "keys",
+        "exists", "ttl",
+    })
+
     def __init__(self, base_url: str, api_key: str = "admin", timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
         self.api_key = api_key
         self.timeout = timeout
+        self._http = KeepAliveJsonClient(base_url, timeout, RemoteKVError)
         self._tlocal = threading.local()
 
     # re-entrancy bookkeeping is per-thread (services may call the store
@@ -74,64 +82,16 @@ class RemoteKVStore:
     def _lock_token(self, v: Optional[str]) -> None:
         self._tlocal.token = v
 
-    def _connection(self):
-        """Persistent keep-alive connection, one per thread: the hot path
-        issues several kv ops per request and a fresh TCP handshake per op
-        dominated the measured latency."""
-        import http.client
-        import urllib.parse
-
-        conn = getattr(self._tlocal, "conn", None)
-        if conn is None:
-            parsed = urllib.parse.urlparse(self.base_url)
-            cls = (
-                http.client.HTTPSConnection
-                if parsed.scheme == "https"
-                else http.client.HTTPConnection
-            )
-            conn = cls(parsed.netloc, timeout=self.timeout)
-            self._tlocal.conn = conn
-        return conn
-
-    def _drop_connection(self) -> None:
-        conn = getattr(self._tlocal, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            except Exception:
-                pass
-            self._tlocal.conn = None
-
-    def _post(self, path: str, payload: dict):
-        body = json.dumps(payload)
-        headers = {
-            "Content-Type": "application/json",
-            "Authorization": f"Bearer {self.api_key}",
-        }
-        last_exc: Optional[Exception] = None
-        for attempt in (0, 1):  # one retry on a stale kept-alive socket
-            conn = self._connection()
-            try:
-                conn.request("POST", path, body=body, headers=headers)
-                resp = conn.getresponse()
-                raw = resp.read()
-            except (http.client.HTTPException, OSError) as e:
-                self._drop_connection()
-                last_exc = e
-                if attempt == 0:
-                    continue
-                raise RemoteKVError(f"kv api unreachable: {e}") from e
-            try:
-                out = json.loads(raw)
-            except json.JSONDecodeError as e:
-                self._drop_connection()
-                raise RemoteKVError(
-                    f"kv api bad response (HTTP {resp.status})"
-                ) from e
-            if not out.get("success"):
-                raise RemoteKVError(out.get("error", "kv op failed"))
-            return out.get("data")
-        raise RemoteKVError(f"kv api unreachable: {last_exc}")
+    def _post(self, path: str, payload: dict, retry_response: bool = False):
+        out = self._http.post(
+            path,
+            payload,
+            headers={"Authorization": f"Bearer {self.api_key}"},
+            retry_response=retry_response,
+        )
+        if not out.get("success"):
+            raise RemoteKVError(out.get("error", "kv op failed"))
+        return out.get("data")
 
     def _lock(self, action: str) -> Optional[str]:
         import time
@@ -142,6 +102,7 @@ class RemoteKVStore:
                 return self._post(
                     "/kv/_lock",
                     {"action": action, "token": self._lock_token or ""},
+                    retry_response=(action == "release"),
                 )
             except RemoteKVError as e:
                 if action == "acquire" and "locked" in str(e):
@@ -165,7 +126,9 @@ class RemoteKVStore:
         deadline = time.monotonic() + self.timeout
         while True:
             try:
-                return self._post(f"/kv/{op}", payload)
+                return self._post(
+                    f"/kv/{op}", payload, retry_response=op in self.READ_OPS
+                )
             except RemoteKVError as e:
                 if "locked" in str(e) and time.monotonic() < deadline:
                     time.sleep(0.01)
