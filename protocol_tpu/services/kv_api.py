@@ -100,6 +100,8 @@ class KvApiService:
 
     async def kv_op(self, request: web.Request) -> web.Response:
         op = request.match_info["op"]
+        if op == "_pipeline":
+            return await self._pipeline(request)
         if op not in KV_OPS:
             return web.json_response(
                 {"success": False, "error": f"unknown op {op}"}, status=404
@@ -128,6 +130,9 @@ class KvApiService:
             # activity-based renewal: a long atomic section whose ops keep
             # flowing never silently loses its serialization guarantee
             self._lock_expires = time.monotonic() + self.lock_ttl
+        return self._execute(op, args, kwargs)
+
+    def _execute(self, op: str, args: list, kwargs: dict) -> web.Response:
         try:
             result = getattr(self.kv, op)(*args, **kwargs)
         except TypeError as e:
@@ -135,3 +140,46 @@ class KvApiService:
                 {"success": False, "error": f"bad params: {e}"}, status=400
             )
         return web.json_response({"success": True, "data": _jsonable(result)})
+
+    async def _pipeline(self, request: web.Request) -> web.Response:
+        """Atomic op batch in one round trip (the Redis pipeline shape)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"success": False, "error": "invalid json"}, status=400
+            )
+        ops = body.get("ops", [])
+        try:
+            ok = all(
+                isinstance(entry, (list, tuple))
+                and len(entry) == 3
+                and entry[0] in KV_OPS
+                and isinstance(entry[1], list)
+                and isinstance(entry[2], dict)
+                for entry in ops
+            )
+        except TypeError:
+            ok = False
+        if not isinstance(ops, list) or not ok:
+            return web.json_response(
+                {"success": False, "error": "bad pipeline entry"}, status=400
+            )
+        holder = body.get("lock_token", "")
+        if self._lock_live() and holder != self._lock_token:
+            return web.json_response(
+                {"success": False, "error": "locked"}, status=423
+            )
+        if self._lock_live() and holder == self._lock_token:
+            self._lock_expires = time.monotonic() + self.lock_ttl
+        try:
+            results = self.kv.pipeline_execute(
+                [(op, args, kwargs) for op, args, kwargs in ops]
+            )
+        except TypeError as e:
+            return web.json_response(
+                {"success": False, "error": f"bad params: {e}"}, status=400
+            )
+        return web.json_response(
+            {"success": True, "data": [_jsonable(r) for r in results]}
+        )

@@ -509,3 +509,16 @@ class KVStore:
         scripts for group create/dissolve/merge atomicity
         (node_groups/mod.rs:298-322)."""
         return self._lock
+
+    def pipeline_execute(self, ops: list) -> list:
+        """Execute [(op, args, kwargs), ...] under one lock, returning each
+        op's result — the Redis pipeline shape (one round trip over the
+        remote client). Like a Redis pipeline, this is ISOLATED but not
+        transactional: ops apply in order and a failing op aborts the
+        remainder with earlier ops committed — batch only ops whose
+        validity is guaranteed by construction."""
+        out = []
+        with self._lock:
+            for op, args, kwargs in ops:
+                out.append(getattr(self, op)(*args, **(kwargs or {})))
+        return out

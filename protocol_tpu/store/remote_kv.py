@@ -112,31 +112,54 @@ class RemoteKVStore:
                     continue
                 raise
 
-    def _call(self, op: str, *args, **kwargs):
+    def _post_with_lock_retry(
+        self, path: str, payload: dict, retry_response: bool = False
+    ):
+        """in-process RLock semantics: a write that meets a foreign atomic
+        section BLOCKS until the lock frees (bounded by timeout), it does
+        not 500 the caller on first contention."""
         import time
 
-        payload = {
-            "args": list(args),
-            "kwargs": kwargs,
-            "lock_token": self._lock_token or "",
-        }
-        # in-process RLock semantics: a write that meets a foreign atomic
-        # section BLOCKS until the lock frees (bounded by timeout), it
-        # does not 500 the caller on first contention
         deadline = time.monotonic() + self.timeout
         while True:
             try:
-                return self._post(
-                    f"/kv/{op}", payload, retry_response=op in self.READ_OPS
-                )
+                return self._post(path, payload, retry_response=retry_response)
             except RemoteKVError as e:
                 if "locked" in str(e) and time.monotonic() < deadline:
                     time.sleep(0.01)
                     continue
                 raise
 
+    def _call(self, op: str, *args, **kwargs):
+        payload = {
+            "args": list(args),
+            "kwargs": kwargs,
+            "lock_token": self._lock_token or "",
+        }
+        return self._post_with_lock_retry(
+            f"/kv/{op}", payload, retry_response=op in self.READ_OPS
+        )
+
     def atomic(self) -> _RemoteLock:
         return _RemoteLock(self)
+
+    # ops whose wire shape differs from the KVStore return type
+    _RESHAPE = {"smembers": set, "zrangebyscore": lambda v: [tuple(x) for x in v]}
+
+    def pipeline_execute(self, ops: list) -> list:
+        """Op batch in ONE round trip (KVStore.pipeline_execute over the
+        wire; same isolated-not-transactional semantics). Writes may be
+        present: never response-retried. Results are reshaped to match
+        the in-process store's return types."""
+        payload = {
+            "ops": [[op, list(args), kwargs or {}] for op, args, kwargs in ops],
+            "lock_token": self._lock_token or "",
+        }
+        results = self._post_with_lock_retry("/kv/_pipeline", payload)
+        return [
+            self._RESHAPE[op](res) if op in self._RESHAPE else res
+            for (op, _a, _k), res in zip(ops, results)
+        ]
 
     # ---- surface (matches KVStore) ----
 

@@ -135,6 +135,59 @@ def test_writes_block_until_foreign_lock_frees(kv_api):
             slowpoke.set("never", "x")
 
 
+def test_pipeline_batch_atomic_single_round_trip(kv_api):
+    """_pipeline executes an op batch atomically with per-op results; the
+    foreign-lock gate applies to the whole batch."""
+    _local, url = kv_api
+    r = _client(url)
+    results = r.pipeline_execute([
+        ("set", ["p1", "v1"], {}),
+        ("hset", ["ph", "f", "x"], {}),
+        ("incr", ["pc"], {"amount": 3}),
+        ("get", ["p1"], {}),
+    ])
+    assert results == [True, 1, 3, "v1"]
+    assert _local.get("p1") == "v1" and _local.hget("ph", "f") == "x"
+
+    # unknown op in the batch is rejected wholesale
+    with pytest.raises(RemoteKVError):
+        r.pipeline_execute([("flushall_everything", [], {})])
+
+    # a foreign atomic section blocks the batch until released
+    import time
+
+    a = _client(url)
+
+    def hold():
+        with a.atomic():
+            time.sleep(0.4)
+
+    th = threading.Thread(target=hold)
+    th.start()
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    r.pipeline_execute([("set", ["p2", "v2"], {})])
+    waited = time.monotonic() - t0
+    th.join()
+    assert waited >= 0.2 and _local.get("p2") == "v2"
+
+
+def test_metrics_store_batches_over_remote_kv(kv_api):
+    from protocol_tpu.models.metric import MetricEntry
+
+    _local, url = kv_api
+    store = StoreContext(_client(url))
+    entries = [
+        MetricEntry.from_dict(
+            {"key": {"task_id": "t", "label": f"m{i}"}, "value": float(i)}
+        )
+        for i in range(5)
+    ]
+    store.metrics_store.store_metrics(entries, "0xnode")
+    got = store.metrics_store.get_metrics_for_task("t")
+    assert got == {f"m{i}": {"0xnode": float(i)} for i in range(5)}
+
+
 def test_store_context_over_remote_kv(kv_api):
     """Domain stores (node store etc.) run unchanged over the remote
     client — the orchestrator-replica shape."""
