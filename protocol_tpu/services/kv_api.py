@@ -58,6 +58,28 @@ class KvApiService:
         self.lock_ttl = lock_ttl
         self._lock_token: Optional[str] = None
         self._lock_expires = 0.0
+        from prometheus_client import (
+            CollectorRegistry,
+            Counter,
+            Histogram,
+            generate_latest,
+        )
+
+        self._generate_latest = generate_latest
+        self.registry = CollectorRegistry()
+        self.op_requests = Counter(
+            "kv_api_requests",
+            "KV API requests by op and outcome",
+            ["op", "outcome"],
+            registry=self.registry,
+        )
+        self.op_duration = Histogram(
+            "kv_api_op_duration_seconds",
+            "KV op execution time",
+            ["op"],
+            buckets=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5],
+            registry=self.registry,
+        )
 
     def make_app(self) -> web.Application:
         app = web.Application(
@@ -66,7 +88,13 @@ class KvApiService:
         app.router.add_post("/kv/_lock", self.lock_op)
         app.router.add_post("/kv/{op}", self.kv_op)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
         return app
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self._generate_latest(self.registry), content_type="text/plain"
+        )
 
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -133,12 +161,16 @@ class KvApiService:
         return self._execute(op, args, kwargs)
 
     def _execute(self, op: str, args: list, kwargs: dict) -> web.Response:
+        t0 = time.perf_counter()
         try:
             result = getattr(self.kv, op)(*args, **kwargs)
         except TypeError as e:
+            self.op_requests.labels(op=op, outcome="bad_params").inc()
             return web.json_response(
                 {"success": False, "error": f"bad params: {e}"}, status=400
             )
+        self.op_duration.labels(op=op).observe(time.perf_counter() - t0)
+        self.op_requests.labels(op=op, outcome="ok").inc()
         return web.json_response({"success": True, "data": _jsonable(result)})
 
     async def _pipeline(self, request: web.Request) -> web.Response:
@@ -172,14 +204,18 @@ class KvApiService:
             )
         if self._lock_live() and holder == self._lock_token:
             self._lock_expires = time.monotonic() + self.lock_ttl
+        t0 = time.perf_counter()
         try:
             results = self.kv.pipeline_execute(
                 [(op, args, kwargs) for op, args, kwargs in ops]
             )
         except TypeError as e:
+            self.op_requests.labels(op="_pipeline", outcome="bad_params").inc()
             return web.json_response(
                 {"success": False, "error": f"bad params: {e}"}, status=400
             )
+        self.op_duration.labels(op="_pipeline").observe(time.perf_counter() - t0)
+        self.op_requests.labels(op="_pipeline", outcome="ok").inc()
         return web.json_response(
             {"success": True, "data": [_jsonable(r) for r in results]}
         )

@@ -203,3 +203,18 @@ def test_store_context_over_remote_kv(kv_api):
     assert node is not None and node.status == NodeStatus.HEALTHY
     store_b.node_store.update_node_status("0xshared", NodeStatus.UNHEALTHY)
     assert store_a.node_store.get_node("0xshared").status == NodeStatus.UNHEALTHY
+
+
+def test_kv_api_prometheus_metrics(kv_api):
+    """The store pod exposes op counters + latency histograms."""
+    import urllib.request
+
+    _local, url = kv_api
+    r = _client(url)
+    r.set("metered", "1")
+    r.pipeline_execute([("incr", ["metered-ctr"], {})])
+    with urllib.request.urlopen(f"{url}/metrics", timeout=5) as resp:
+        text = resp.read().decode()
+    assert 'kv_api_requests_total{op="set",outcome="ok"}' in text
+    assert 'kv_api_requests_total{op="_pipeline",outcome="ok"}' in text
+    assert 'kv_api_op_duration_seconds_bucket{le="0.001",op="set"}' in text
