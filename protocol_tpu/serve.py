@@ -161,6 +161,15 @@ async def serve_orchestrator(args) -> None:
     groups_plugin = None
     group_configs = os.environ.get("NODE_GROUP_CONFIGS", "")
     if group_configs:
+        if backend != "local":
+            # fail loudly: silently running the groups scheduler locally
+            # while the operator believes solves route to the remote
+            # backend would be a misconfiguration with no symptom
+            raise SystemExit(
+                "NODE_GROUP_CONFIGS with --scheduler-backend "
+                f"{backend!r} is not supported: the node-groups scheduler "
+                "runs in-process (use --scheduler-backend local)"
+            )
         configs = [
             NodeGroupConfiguration.from_dict(d) for d in json.loads(group_configs)
         ]

@@ -142,23 +142,39 @@ class KvApiService:
             )
         args = body.get("args", [])
         kwargs = body.get("kwargs", {})
-        # a live foreign lock blocks WRITES from other clients; reads pass
         holder = body.get("lock_token", "")
-        if (
+        if holder:
+            lost = self._holder_check(holder)
+            if lost is not None:
+                self.op_requests.labels(op=op, outcome="lock_lost").inc()
+                return lost
+        elif (
             self._lock_live()
-            and holder != self._lock_token
             and op not in ("get", "mget", "hget", "hgetall", "smembers",
                            "sismember", "scard", "zscore", "zrangebyscore",
                            "zcard", "lrange", "llen", "keys", "exists", "ttl")
         ):
+            # a live foreign lock blocks WRITES from other clients; reads pass
             return web.json_response(
                 {"success": False, "error": "locked"}, status=423
             )
+        return self._execute(op, args, kwargs)
+
+    def _holder_check(self, holder: str) -> Optional[web.Response]:
+        """An op carrying a lock token either renews the live lock it
+        matches or fails 410: a holder that paused past lock_ttl (its lock
+        expired, possibly reacquired by another client) has already lost
+        its atomic section's serialization — the distinct error lets it
+        detect the loss and retry the WHOLE section instead of silently
+        interleaving its remaining ops with foreign writes."""
         if self._lock_live() and holder == self._lock_token:
             # activity-based renewal: a long atomic section whose ops keep
             # flowing never silently loses its serialization guarantee
             self._lock_expires = time.monotonic() + self.lock_ttl
-        return self._execute(op, args, kwargs)
+            return None
+        return web.json_response(
+            {"success": False, "error": "lock-lost"}, status=410
+        )
 
     def _execute(self, op: str, args: list, kwargs: dict) -> web.Response:
         t0 = time.perf_counter()
@@ -198,12 +214,15 @@ class KvApiService:
                 {"success": False, "error": "bad pipeline entry"}, status=400
             )
         holder = body.get("lock_token", "")
-        if self._lock_live() and holder != self._lock_token:
+        if holder:
+            lost = self._holder_check(holder)
+            if lost is not None:
+                self.op_requests.labels(op="_pipeline", outcome="lock_lost").inc()
+                return lost
+        elif self._lock_live():
             return web.json_response(
                 {"success": False, "error": "locked"}, status=423
             )
-        if self._lock_live() and holder == self._lock_token:
-            self._lock_expires = time.monotonic() + self.lock_ttl
         t0 = time.perf_counter()
         try:
             results = self.kv.pipeline_execute(

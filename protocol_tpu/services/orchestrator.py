@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import posixpath
 import re
 import time
@@ -59,6 +60,7 @@ from protocol_tpu.security.middleware import (
 )
 from protocol_tpu.security.wallet import Wallet
 from protocol_tpu.store.context import StoreContext
+from protocol_tpu.store.remote_kv import LockLostError
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 from protocol_tpu.utils.metrics import OrchestratorMetrics
 from protocol_tpu.utils.storage import StorageProvider
@@ -74,6 +76,25 @@ LOOP_STALE_SECONDS = 120.0  # loop_heartbeats.rs
 
 DiscoveryFetcher = Callable[[], Awaitable[list[DiscoveryNode]]]
 InviteSender = Callable[[OrchestratorNode, dict], Awaitable[bool]]
+
+
+def _parse_owner_claim(raw) -> Optional[dict]:
+    """Owner-key value -> {"addr", "ts", "first"} ("ts" = last refresh,
+    "first" = original claim time, for the total-age squat cap). Journals
+    written before claims carried timestamps hold a bare address; treat
+    those as epoch-old so they stay takeover-able exactly as they were."""
+    if raw is None:
+        return None
+    try:
+        rec = json.loads(raw)
+        ts = float(rec["ts"])
+        return {
+            "addr": str(rec["addr"]),
+            "ts": ts,
+            "first": float(rec.get("first", ts)),
+        }
+    except (ValueError, TypeError, KeyError):
+        return {"addr": str(raw), "ts": 0.0, "first": 0.0}
 
 
 class OrchestratorService:
@@ -95,6 +116,15 @@ class OrchestratorService:
         webhook=None,  # WebhookPlugin (plugins/webhook/mod.rs)
         control_http=None,  # aiohttp session for worker control-plane calls
         persist_path: Optional[str] = None,
+        # signed-URL validity AND the takeover-refusal window: a claim may
+        # be seized only once no URL issued for it can still be in flight.
+        # Default matches the providers' 1 h expiry (100 MiB on a slow link
+        # legitimately takes minutes; do not shrink this below worst-case
+        # upload duration). Claims refreshed by own-sha re-requests are
+        # still takeover-able after 4x this (total-age cap), so a live node
+        # cannot squat a never-uploaded sha forever by re-requesting.
+        upload_claim_grace: float = 3600.0,
+        time_fn=time.time,
     ):
         self.ledger = ledger
         self.pool_id = pool_id
@@ -119,12 +149,27 @@ class OrchestratorService:
         self.heartbeat_url = heartbeat_url
         self.webhook = webhook
         self.control_http = control_http
+        self.upload_claim_grace = upload_claim_grace
+        self._time = time_fn
         self.loop_beats: dict[str, float] = {}
         self.metrics = OrchestratorMetrics(pool_id)
         self._observed_solve = 0  # last seen matcher solve seq
         if webhook is not None and groups_plugin is not None:
             groups_plugin.on_group_created = webhook.handle_group_created
             groups_plugin.on_group_dissolved = webhook.handle_group_destroyed
+
+    async def _kv_section(self, fn, attempts: int = 3):
+        """Run a KV atomic section off the event loop (each op is a
+        blocking HTTP round trip on RemoteKVStore deployments). A section
+        can lose its advisory lock mid-flight (kv-api restart, >lock_ttl
+        stall); per the LockLostError contract the whole section — not the
+        single op — is retried."""
+        for attempt in range(attempts):
+            try:
+                return await asyncio.to_thread(fn)
+            except LockLostError:
+                if attempt == attempts - 1:
+                    raise
 
     def _set_status(self, address: str, status: NodeStatus) -> None:
         """Status transition + webhook notification (the reference's
@@ -378,9 +423,13 @@ class OrchestratorService:
 
         try:
             # URL first: an invalid object name must fail before any state
-            # (sha ownership, mapping) is written
+            # (sha ownership, mapping) is written. The URL's validity is
+            # capped to the claim grace window: a claim may only be taken
+            # over once NO signed URL issued for it can still be in flight
             url = await self.storage.generate_upload_signed_url(
-                object_name, max_bytes=file_size
+                object_name,
+                expires_in=self.upload_claim_grace,
+                max_bytes=file_size,
             )
         except ValueError as e:  # e.g. path-escaping object names
             return _err(str(e), 400)
@@ -389,27 +438,114 @@ class OrchestratorService:
         # claimed (prevents overwriting a victim's pending-work resolution).
         # Claimed only AFTER the object name validated; released if the
         # mapping write itself fails, so a failed request cannot squat a
-        # victim's sha.
+        # victim's sha. The claim records a timestamp: between a legitimate
+        # claimant's request-upload response and its signed-URL PUT neither
+        # the mapping nor the object exists yet, so "object missing" alone
+        # must not read as stale — takeover additionally requires the claim
+        # to be older than the signed-URL expiry (upload_claim_grace).
+        # KV ops run off the event loop (each is a blocking HTTP round trip
+        # on RemoteKVStore deployments) and inside one atomic section so a
+        # racing claimant cannot interleave with the read-modify-write.
         owner_key = UPLOAD_SHA_OWNER_KEY.format(sha256)
-        claimed_now = bool(self.store.kv.set(owner_key, address, nx=True))
-        if not claimed_now and self.store.kv.get(owner_key) != address:
-            # another node holds the claim — honored only while it is live:
+
+        def _claim_attempt():
+            # lock-free fast path: set-nx is already atomic, and the common
+            # case (fresh sha) must not serialize every upload on the
+            # store-wide advisory lock
+            now = self._time()
+            mine = {"addr": address, "ts": now, "first": now}
+            if self.store.kv.set(owner_key, json.dumps(mine), nx=True):
+                return "claimed", mine
+            with self.store.kv.atomic():
+                now = self._time()
+                mine = {"addr": address, "ts": now, "first": now}
+                if self.store.kv.set(owner_key, json.dumps(mine), nx=True):
+                    return "claimed", mine
+                cur = _parse_owner_claim(self.store.kv.get(owner_key))
+                if cur is None:  # released between set-nx and get: re-claim
+                    self.store.kv.set(owner_key, json.dumps(mine))
+                    return "claimed", mine
+                if cur["addr"] == address:
+                    # refresh the timestamp (this request issues a FRESH
+                    # signed URL, so the takeover grace restarts — else a
+                    # retried PUT could be seized mid-flight) but keep
+                    # "first": the total-age cap below is what stops a
+                    # live node from refresh-squatting a sha forever
+                    mine = {"addr": address, "ts": now, "first": cur["first"]}
+                    self.store.kv.set(owner_key, json.dumps(mine))
+                    return "own", mine
+                return "foreign", cur
+
+        try:
+            status, rec = await self._kv_section(_claim_attempt)
+        except LockLostError:
+            return _err("store contention, retry", 503)
+        claimed_now = status == "claimed"
+        if status == "foreign":
+            # another node holds the claim — honored while it is live: only
             # if the mapped object never materialized (claimant crashed
-            # before its PUT), the claim is stale and may be taken over, so
-            # a dead node cannot squat a deterministic artifact's sha forever
+            # before its PUT) AND the claim has outlived every signed URL
+            # issued for it is it stale and takeover-able, so a dead node
+            # cannot squat a deterministic artifact's sha forever while an
+            # in-flight first upload cannot be seized mid-PUT. The total-age
+            # cap bounds refresh-squatting: past 4x the grace with still no
+            # object, the claim falls regardless of re-request refreshes.
             mapped = await self.storage.resolve_mapping_for_sha(sha256)
-            if mapped is not None and await self.storage.file_exists(mapped):
+            uploaded = mapped is not None and await self.storage.file_exists(mapped)
+            now = self._time()
+            stale = (
+                now - rec["ts"] >= self.upload_claim_grace
+                or now - rec["first"] >= 4 * self.upload_claim_grace
+            )
+            if uploaded or not stale:
                 return _err("sha256 already mapped by another node", 409)
-            self.store.kv.set(owner_key, address)
+
+            def _takeover():
+                with self.store.kv.atomic():
+                    latest = _parse_owner_claim(self.store.kv.get(owner_key))
+                    if latest is not None and latest != rec:
+                        return None  # a concurrent takeover moved first
+                    t = self._time()
+                    mine = {"addr": address, "ts": t, "first": t}
+                    self.store.kv.set(owner_key, json.dumps(mine))
+                    return mine
+
+            try:
+                rec = await self._kv_section(_takeover)
+            except LockLostError:
+                return _err("store contention, retry", 503)
+            if rec is None:
+                return _err("sha256 already mapped by another node", 409)
+            claimed_now = True  # owns the claim now; release on failure below
+
+        async def _release_if_mine():
+            # only delete OUR record: an unconditional delete could drop a
+            # successor's live claim if this request stalled past the grace
+            # and lost a takeover race while its storage call was in flight.
+            # Best-effort — a release lost to store trouble merely leaves a
+            # claim that goes stale after the grace window
+            def release():
+                with self.store.kv.atomic():
+                    latest = _parse_owner_claim(self.store.kv.get(owner_key))
+                    if latest == rec:
+                        self.store.kv.delete(owner_key)
+
+            try:
+                await self._kv_section(release)
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "upload claim release failed for %s", owner_key, exc_info=True
+                )
+
         try:
             await self.storage.generate_mapping_file(sha256, object_name)
         except ValueError as e:
             if claimed_now:
-                self.store.kv.delete(owner_key)
+                await _release_if_mine()
             return _err(str(e), 400)
         except Exception:
             if claimed_now:
-                self.store.kv.delete(owner_key)
+                await _release_if_mine()
             return _err("storage backend failure", 500)
         return web.json_response(
             {"success": True, "data": {"signed_url": url, "object_name": object_name}}

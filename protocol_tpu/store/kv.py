@@ -233,6 +233,10 @@ class KVStore:
         self,
         key: str,
         value: str,
+        *,
+        # keyword-only: _journal_append rewrites the ex TTL to an absolute
+        # deadline by kwarg name — a positional TTL would journal raw and
+        # replay relative to RESTART time, extending expirations
         nx: bool = False,
         ex: Optional[float] = None,
     ) -> bool:

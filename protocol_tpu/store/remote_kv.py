@@ -24,6 +24,13 @@ class RemoteKVError(RuntimeError):
     pass
 
 
+class LockLostError(RemoteKVError):
+    """The server reports the advisory lock this client's atomic section
+    held has expired (and may have been reacquired by another client):
+    serialization is already broken, so the op did NOT execute. Callers
+    must retry the whole atomic section, not the single op."""
+
+
 class _RemoteLock:
     """Context manager backing atomic(): acquires the server's advisory
     lock (re-entrant per client, like the in-process RLock)."""
@@ -90,7 +97,10 @@ class RemoteKVStore:
             retry_response=retry_response,
         )
         if not out.get("success"):
-            raise RemoteKVError(out.get("error", "kv op failed"))
+            err = out.get("error", "kv op failed")
+            if err == "lock-lost":
+                raise LockLostError(err)
+            raise RemoteKVError(err)
         return out.get("data")
 
     def _lock(self, action: str) -> Optional[str]:
@@ -163,7 +173,7 @@ class RemoteKVStore:
 
     # ---- surface (matches KVStore) ----
 
-    def set(self, key, value, nx=False, ex=None):
+    def set(self, key, value, *, nx=False, ex=None):
         return self._call("set", key, value, nx=nx, ex=ex)
 
     def get(self, key):

@@ -10,14 +10,16 @@ import pytest
 from protocol_tpu.services.kv_api import KvApiService
 from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
 from protocol_tpu.store.kv import KVStore
-from protocol_tpu.store.remote_kv import RemoteKVError, RemoteKVStore
+from protocol_tpu.store.remote_kv import (
+    LockLostError,
+    RemoteKVError,
+    RemoteKVStore,
+)
 
 
-@pytest.fixture(scope="module")
-def kv_api():
+def _spawn_api(kv: KVStore, lock_ttl: float = 5.0) -> str:
     ready = threading.Event()
     state = {}
-    kv = KVStore()
 
     def run():
         from aiohttp import web
@@ -26,7 +28,7 @@ def kv_api():
         asyncio.set_event_loop(loop)
 
         async def boot():
-            svc = KvApiService(kv, api_key="k")
+            svc = KvApiService(kv, api_key="k", lock_ttl=lock_ttl)
             runner = web.AppRunner(svc.make_app())
             await runner.setup()
             site = web.TCPSite(runner, "127.0.0.1", 0)
@@ -39,7 +41,13 @@ def kv_api():
 
     threading.Thread(target=run, daemon=True).start()
     assert ready.wait(10)
-    yield kv, f"http://127.0.0.1:{state['port']}"
+    return f"http://127.0.0.1:{state['port']}"
+
+
+@pytest.fixture(scope="module")
+def kv_api():
+    kv = KVStore()
+    yield kv, _spawn_api(kv)
 
 
 def _client(url):
@@ -133,6 +141,32 @@ def test_writes_block_until_foreign_lock_frees(kv_api):
     with a.atomic():
         with pytest.raises(RemoteKVError):
             slowpoke.set("never", "x")
+
+
+def test_lock_lost_is_detected_not_silent():
+    """A holder that pauses past lock_ttl inside atomic() must get a
+    distinct failure on its next op — not silently interleave with the
+    client that meanwhile took the lock (advisor r2 finding)."""
+    import time
+
+    kv = KVStore()
+    url = _spawn_api(kv, lock_ttl=1.0)
+    a, b = _client(url), _client(url)
+
+    with pytest.raises(LockLostError):
+        with a.atomic():
+            a.set("k", "a1")
+            time.sleep(1.4)  # lock expires mid-section (e.g. a slow
+            # remote-ledger call between KV ops)
+            with b.atomic():  # another client takes the expired lock
+                b.set("k", "b")
+            a.set("k", "a2")  # stale token: 410, op must NOT execute
+    assert kv.get("k") == "b"
+
+    # the loser can retry the whole section and succeed
+    with a.atomic():
+        a.set("k", "a-retried")
+    assert kv.get("k") == "a-retried"
 
 
 def test_pipeline_batch_atomic_single_round_trip(kv_api):

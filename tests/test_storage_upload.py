@@ -25,8 +25,10 @@ def test_full_signed_upload_loop():
     async def flow():
         with tempfile.TemporaryDirectory() as root:
             storage = LocalDirStorageProvider(root, public_base_url="http://x")
+            clock = [1000.0]
             svc = OrchestratorService(
-                ledger, pid, manager, storage=storage, uploads_per_hour=100
+                ledger, pid, manager, storage=storage, uploads_per_hour=100,
+                time_fn=lambda: clock[0],
             )
             svc.store.node_store.add_node(
                 OrchestratorNode(address=node.address, status=NodeStatus.HEALTHY)
@@ -165,7 +167,10 @@ def test_full_signed_upload_loop():
                 assert r11.status == 200
 
                 # a STALE claim (mapped object never uploaded — claimant
-                # crashed before its PUT) may be taken over by another node
+                # crashed before its PUT) may be taken over by another node,
+                # but only once the claim has outlived the signed-URL window:
+                # an in-flight first upload (claimed, object not yet PUT)
+                # must not be seizable mid-PUT
                 h7, b7 = sign_request(
                     "/storage/request-upload", node,
                     {"file_name": "ghost.bin", "file_size": 1,
@@ -174,7 +179,8 @@ def test_full_signed_upload_loop():
                 assert (await client.post(
                     "/storage/request-upload", json=b7, headers=h7
                 )).status == 200
-                # node never PUTs ghost.bin; node2 takes the sha over
+                # node never PUTs ghost.bin; node2 tries immediately — the
+                # claim is still inside the signed-URL window, so refused
                 h8, b8 = sign_request(
                     "/storage/request-upload", node2,
                     {"file_name": "revived.bin", "file_size": 1,
@@ -182,7 +188,43 @@ def test_full_signed_upload_loop():
                 )
                 assert (await client.post(
                     "/storage/request-upload", json=b8, headers=h8
+                )).status == 409
+                assert await storage.resolve_mapping_for_sha("09" * 32) == "ghost.bin"
+                # ...after the grace window the claim is stale: takeover OK
+                clock[0] += svc.upload_claim_grace + 1
+                h8b, b8b = sign_request(
+                    "/storage/request-upload", node2,
+                    {"file_name": "revived.bin", "file_size": 1,
+                     "file_type": "bin", "sha256": "09" * 32},
+                )
+                assert (await client.post(
+                    "/storage/request-upload", json=b8b, headers=h8b
                 )).status == 200
                 assert await storage.resolve_mapping_for_sha("09" * 32) == "revived.bin"
+
+                # refresh-squatting is bounded: a node re-requesting its own
+                # never-uploaded sha keeps restarting the grace window, but
+                # past 4x grace TOTAL age the claim falls anyway
+                sha_sq = "0a" * 32
+                async def rereq(w, name):
+                    h, b = sign_request(
+                        "/storage/request-upload", w,
+                        {"file_name": name, "file_size": 1,
+                         "file_type": "bin", "sha256": sha_sq},
+                    )
+                    return await client.post(
+                        "/storage/request-upload", json=b, headers=h
+                    )
+                assert (await rereq(node, "squat.bin")).status == 200
+                for _ in range(4):  # refresh just inside each window
+                    clock[0] += svc.upload_claim_grace - 1
+                    assert (await rereq(node, "squat.bin")).status == 200
+                    # within the (refreshed) grace + total-age cap: refused
+                    assert (await rereq(node2, "take.bin")).status == 409
+                # total age now > 4x grace: the claim falls despite the
+                # squatter's latest refresh still being inside its grace
+                clock[0] += 5
+                assert (await rereq(node2, "take.bin")).status == 200
+                assert await storage.resolve_mapping_for_sha(sha_sq) == "take.bin"
 
     run(flow())
