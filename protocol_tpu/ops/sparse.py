@@ -327,7 +327,8 @@ def assign_auction_sparse_scaled(
     scale: float = 0.25,
     max_iters_per_phase: int = 4000,
     frontier: int = 4096,
-) -> AssignResult:
+    with_prices: bool = False,
+):
     """eps-scaling auction: geometric eps ladder with warm-started prices
     (Bertsekas' eps-scaling — total bid events O(n log(1/eps)) instead of
     O(price_range / eps)).
@@ -337,6 +338,10 @@ def assign_auction_sparse_scaled(
         an unfillable tail would retire viable tasks);
       - between phases, eps-CS repair re-opens only unhappy holders;
       - a final greedy cleanup seats any stranded provider/task pairs.
+
+    ``with_prices=True`` additionally returns the final price vector [P] —
+    the warm-start state for the NEXT solve (see
+    :func:`assign_auction_sparse_warm`).
     """
     state = None
     eps = eps_start
@@ -356,9 +361,69 @@ def assign_auction_sparse_scaled(
         )
         state = (it, price, owner, p4t, retired)
 
-    _, _, owner, p4t, _ = state
+    _, price, owner, p4t, _ = state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
-    return AssignResult(p4t, _invert(p4t, num_providers))
+    res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_prices:
+        return res, price
+    return res
+
+
+def assign_auction_sparse_warm(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    price0: jax.Array,
+    p4t0: jax.Array,
+    eps: float = 0.02,
+    max_iters: int = 20000,
+    frontier: int = 4096,
+) -> tuple[AssignResult, jax.Array]:
+    """Incremental (delta-frontier) auction solve: SURVEY §7 hard part 4.
+
+    The reference re-walks every task per heartbeat
+    (crates/orchestrator/src/scheduler/mod.rs:26-74); a cold batch re-solve
+    every population change would waste the batched win the same way. This
+    warm start carries the auction's dual state across solves:
+
+      ``price0`` [P]  final prices of the previous solve (new providers: 0).
+      ``p4t0``  [T]   previous assignment re-expressed in the new index
+                      space (-1 for new/changed tasks). Must be injective
+                      over >= 0.
+
+    Seeded pairs violating eps-complementary-slackness under ``price0`` —
+    including any whose seeded provider is no longer a candidate — are
+    evicted by the same repair used between eps-scaling phases, so only the
+    *delta frontier* (new tasks, freed providers, changed costs) re-enters
+    the bidding. Forward auction from arbitrary initial prices and a
+    partial eps-CS assignment terminates eps-optimal (Bertsekas), so the
+    warm path's solution quality matches the cold path's final phase.
+
+    Returns (AssignResult, final prices [P]).
+    """
+    # a seed for a task with NO candidates would sail through the eps-CS
+    # repair (vcur == v1 == -inf is not "unhappy") and emerge as an
+    # infeasible pair in the final matching — drop such seeds outright
+    task_has_cand = jnp.any(cand_provider >= 0, axis=1)
+    p4t0 = jnp.where(task_has_cand, p4t0, -1)
+    owner0 = _invert(p4t0, num_providers)
+    owner0, p4t0 = _unassign_unhappy(
+        cand_provider, cand_cost, price0, owner0, p4t0, eps
+    )
+    state = (
+        jnp.int32(0),
+        jnp.asarray(price0, jnp.float32),
+        owner0,
+        p4t0,
+        jnp.zeros(cand_cost.shape[0], bool),
+    )
+    state = _sparse_auction_phase(
+        cand_provider, cand_cost, num_providers, state,
+        eps=eps, max_iters=max_iters, frontier=frontier, retire=True,
+    )
+    _, price, owner, p4t, _ = state
+    p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
+    return AssignResult(p4t, _invert(p4t, num_providers)), price
 
 
 def assign_topk(

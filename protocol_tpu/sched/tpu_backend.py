@@ -42,6 +42,11 @@ from protocol_tpu.models.task import Task
 from protocol_tpu.ops.assign import assign_auction
 from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import FeatureEncoder
+from protocol_tpu.ops.sparse import (
+    assign_auction_sparse_scaled,
+    assign_auction_sparse_warm,
+    candidates_topk,
+)
 from protocol_tpu.store.context import StoreContext
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 
@@ -113,7 +118,10 @@ class TpuBatchMatcher:
         store: StoreContext,
         weights: Optional[CostWeights] = None,
         min_solve_interval: float = 1.0,
-        max_replica_slots: int = 4096,
+        max_replica_slots: int = 1 << 20,
+        dense_cell_budget: int = 1 << 24,
+        top_k: int = 64,
+        warm_start: bool = True,
         native_fallback: bool = False,
         time_fn=time.monotonic,
     ):
@@ -121,6 +129,23 @@ class TpuBatchMatcher:
         self.weights = weights or CostWeights(priority=1.0)
         self.min_solve_interval = min_solve_interval
         self.max_replica_slots = max_replica_slots
+        # [providers x slots] cost cells above which phase 1 switches from
+        # the dense auction to the streaming top-K + sparse frontier auction
+        # (the only viable shape at 1M scale — ops/sparse.py). 2^24 cells =
+        # 64 MB f32: comfortably dense below, pointlessly so above.
+        self.dense_cell_budget = dense_cell_budget
+        self.top_k = top_k
+        # carry auction prices + the previous matching across solves so
+        # population churn re-bids only the delta frontier (SURVEY §7 hard
+        # part 4) instead of cold-solving the full population
+        self.warm_start = warm_start
+        self._warm_price_by_addr: dict[str, float] = {}
+        # forward auctions never LOWER prices: uncapped carry-over would
+        # ratchet until every new bid starts below the retirement floor.
+        # Prices are min-normalized each solve (a uniform shift never
+        # changes any argmax) and a periodic cold solve re-grounds them.
+        self.cold_every = 32
+        self._warm_solves_since_cold = 0
         # degraded mode: solve with the native C++ engine instead of the
         # jitted kernels (for deployments whose accelerator is absent or
         # unreachable — the engine is this framework's CPU backend, not an
@@ -202,6 +227,33 @@ class TpuBatchMatcher:
                     t4p[p_idx] = s_idx
             return t4p
         return np.asarray(_solve_bounded(ep, er, self.weights))
+
+    def _bounded_t4p_sparse(
+        self, ep, er, price0: np.ndarray, p4s0: np.ndarray, warm: bool
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Phase 1 at scale: streaming top-K candidates + frontier auction
+        (ops/sparse.py — the 1M-shape architecture, now the live path above
+        dense_cell_budget). Returns (slot per provider [P_pad], prices [P_pad]).
+
+        ``warm=True`` runs the single-phase incremental solve seeded with the
+        previous solve's prices + matching; cold solves use the eps-scaling
+        ladder."""
+        s_bucket = int(np.asarray(er.cpu_cores).shape[0])
+        tile = min(1024, s_bucket)  # pow2 buckets: tile always divides
+        cand_p, cand_c = candidates_topk(
+            ep, er, self.weights, k=self.top_k, tile=tile
+        )
+        num_providers = int(np.asarray(ep.gpu_count).shape[0])
+        if warm:
+            res, price = assign_auction_sparse_warm(
+                cand_p, cand_c, num_providers,
+                price0=jnp.asarray(price0), p4t0=jnp.asarray(p4s0),
+            )
+        else:
+            res, price = assign_auction_sparse_scaled(
+                cand_p, cand_c, num_providers, with_prices=True
+            )
+        return np.asarray(res.task_for_provider), np.asarray(price)
 
     def _unbounded_best(self, ep, er) -> np.ndarray:
         if self.native_fallback:
@@ -286,17 +338,28 @@ class TpuBatchMatcher:
 
         assigned = np.zeros(P, bool)
         truncated_slots = 0
+        kernel_used = "none"
+        warm_used = False
+        warm_seeded = 0
 
         # ---- phase 1: bounded tasks -> replica slots -> auction
         if bounded:
             req_by_task = {i: task_requirements(tasks[i]) for i, _ in bounded}
+            # the native degraded-mode engine solves dense on the host: it
+            # keeps the old 4096-slot envelope regardless of the (much
+            # larger) sparse-path default
+            slot_cap = (
+                min(self.max_replica_slots, 4096)
+                if self.native_fallback
+                else self.max_replica_slots
+            )
             slot_task: list[int] = []
+            slot_range: dict[int, tuple[int, int]] = {}  # task idx -> (start, n)
             for i, r in bounded:
-                take = min(
-                    min(r, P), self.max_replica_slots - len(slot_task)
-                )
+                take = min(min(r, P), slot_cap - len(slot_task))
+                slot_range[i] = (len(slot_task), take)
                 slot_task.extend([i] * take)
-                if len(slot_task) >= self.max_replica_slots:
+                if len(slot_task) >= slot_cap:
                     break
             # arithmetic, not loop iterations: demand can be ~1M slots
             truncated_slots = sum(min(r, P) for _, r in bounded) - len(slot_task)
@@ -315,7 +378,63 @@ class TpuBatchMatcher:
             er = self.encoder.encode_requirements(
                 reqs, priorities=prios, pad_to=s_bucket
             )
-            t4p = self._bounded_t4p(ep, er)[:P]
+            use_sparse = (
+                not self.native_fallback
+                and p_bucket * s_bucket > self.dense_cell_budget
+            )
+            if use_sparse:
+                kernel_used = "sparse_topk"
+                price0 = np.zeros(p_bucket, np.float32)
+                p4s0 = np.full(s_bucket, -1, np.int32)
+                addrs = [n.address for n in nodes]
+                if self.warm_start:
+                    get_price = self._warm_price_by_addr.get
+                    price0[:P] = np.fromiter(
+                        (get_price(a, 0.0) for a in addrs), np.float32, count=P
+                    )
+                    # prices only ever rise within a warm chain; the
+                    # periodic cold solve (cold_every) is what re-grounds
+                    # them before they can ratchet toward the retirement
+                    # floor
+                    # seat previous holders back into their task's slots:
+                    # these seeds either satisfy eps-CS (and stay) or are
+                    # evicted by the kernel's repair pass — the remainder
+                    # is the delta frontier that actually re-bids
+                    addr_to_pidx = {a: idx for idx, a in enumerate(addrs)}
+                    tidx_by_id = {tasks[i].id: i for i, _ in bounded}
+                    prev_by_task: dict[int, list[int]] = {}
+                    for addr, tid in self._assignment.items():
+                        p_idx = addr_to_pidx.get(addr)
+                        i = tidx_by_id.get(tid)
+                        if p_idx is not None and i is not None and i in slot_range:
+                            prev_by_task.setdefault(i, []).append(p_idx)
+                    for i, holders in prev_by_task.items():
+                        start, take = slot_range[i]
+                        for j, p_idx in enumerate(holders[:take]):
+                            p4s0[start + j] = p_idx
+                    warm_seeded = int((p4s0 >= 0).sum())
+                warm_used = (
+                    self.warm_start
+                    and warm_seeded > 0
+                    and self._warm_solves_since_cold < self.cold_every
+                )
+                t4p, price = self._bounded_t4p_sparse(
+                    ep, er, price0, p4s0, warm=warm_used
+                )
+                t4p = t4p[:P]
+                if warm_used:
+                    self._warm_solves_since_cold += 1
+                else:
+                    self._warm_solves_since_cold = 0
+                if self.warm_start:
+                    self._warm_price_by_addr = dict(
+                        zip(addrs, np.asarray(price[:P], np.float64).tolist())
+                    )
+            else:
+                kernel_used = (
+                    "native_cpu" if self.native_fallback else "dense_auction"
+                )
+                t4p = self._bounded_t4p(ep, er)[:P]
             for p_idx, s_idx in enumerate(t4p):
                 if s_idx >= 0 and s_idx < len(slot_task):
                     assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
@@ -343,5 +462,8 @@ class TpuBatchMatcher:
             "assigned": len(assignment),
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
             "truncated_replica_slots": truncated_slots,
+            "kernel": kernel_used,  # dense_auction | sparse_topk | native_cpu
+            "warm": warm_used,
+            "warm_seeded_slots": warm_seeded,
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
         }

@@ -153,18 +153,65 @@ class SchedulerBackendServicer:
             )
 
         if kernel == "topk":
-            from protocol_tpu.ops.sparse import assign_topk
+            from protocol_tpu.ops.sparse import (
+                assign_auction_sparse_scaled,
+                assign_auction_sparse_warm,
+                candidates_topk,
+            )
 
             # tile must divide the (padded, pow2) T
             t_padded = int(np.asarray(er.cpu_cores).shape[0])
             tile = min(1024, t_padded)
             while t_padded % tile != 0:
                 tile -= 1
-            res = assign_topk(
+            p_padded = int(np.asarray(ep.gpu_count).shape[0])
+            cand_p, cand_c = candidates_topk(
                 ep, er, weights,
-                k=max(int(request.top_k) or 64, 1),
-                tile=tile,
-                eps=request.eps or 0.01,
+                k=max(int(request.top_k) or 64, 1), tile=tile,
+            )
+            if len(request.warm_price) == P and len(
+                request.seed_provider_for_task
+            ) == T:
+                # stateless incremental solve: warm state rode the wire.
+                # Wire input is untrusted: clamp out-of-range seeds and
+                # drop duplicates (the warm kernel requires injectivity
+                # over >= 0 — a duplicated provider index would produce a
+                # corrupt two-tasks-one-provider "matching").
+                price0 = np.zeros(p_padded, np.float32)
+                price0[:P] = np.nan_to_num(
+                    np.asarray(request.warm_price, np.float32),
+                    nan=0.0, posinf=0.0, neginf=0.0,
+                )
+                p4t0 = np.full(t_padded, -1, np.int32)
+                seeds = np.asarray(request.seed_provider_for_task, np.int32)
+                seeds = np.where((seeds >= 0) & (seeds < P), seeds, -1)
+                pos = seeds >= 0
+                _, first = np.unique(seeds[pos], return_index=True)
+                keep = np.zeros(int(pos.sum()), bool)
+                keep[first] = True
+                seeds[np.flatnonzero(pos)[~keep]] = -1
+                p4t0[:T] = seeds
+                res, price = assign_auction_sparse_warm(
+                    cand_p, cand_c, p_padded,
+                    price0=price0, p4t0=p4t0,
+                    eps=request.eps or 0.02,
+                    max_iters=int(request.max_iters) or 20000,
+                )
+            else:
+                res, price = assign_auction_sparse_scaled(
+                    cand_p, cand_c, p_padded,
+                    eps_end=request.eps or 0.02,
+                    max_iters_per_phase=int(request.max_iters) or 4000,
+                    with_prices=True,
+                )
+            p4t = np.asarray(res.provider_for_task)[:T]
+            t4p = np.asarray(res.task_for_provider)[:P]
+            return pb.AssignResponse(
+                provider_for_task=p4t.tolist(),
+                task_for_provider=t4p.tolist(),
+                num_assigned=int((p4t >= 0).sum()),
+                solve_ms=(time.perf_counter() - t0) * 1e3,
+                price=np.asarray(price)[:P].tolist(),
             )
         else:
             from protocol_tpu.ops.assign import (
@@ -388,6 +435,36 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     def _bounded_t4p(self, ep, er) -> np.ndarray:
         resp = self._call(ep, er, "auction", eps=0.05, max_iters=300)
         return np.asarray(resp.task_for_provider, np.int32)
+
+    def _bounded_t4p_sparse(
+        self, ep, er, price0: np.ndarray, p4s0: np.ndarray, warm: bool
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Scale path over the wire: the backend's "topk" kernel, with the
+        incremental-solve state (prices + previous matching) riding the
+        request/response so the backend stays stateless across replicas."""
+        n_p = int(np.asarray(ep.valid).sum())
+        n_s = int(np.asarray(er.valid).sum())
+        req = encoded_to_proto(
+            self._strip_padding(ep),
+            self._strip_padding(er),
+            self.weights,
+            kernel="topk",
+            top_k=self.top_k,
+            eps=0.02,
+        )
+        if warm:
+            req.warm_price.extend(np.asarray(price0[:n_p], np.float32).tolist())
+            req.seed_provider_for_task.extend(
+                np.asarray(p4s0[:n_s], np.int32).tolist()
+            )
+        t0 = time.perf_counter()
+        resp = self.client.assign(req)
+        self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
+        self._backend_ms.append(resp.solve_ms)
+        return (
+            np.asarray(resp.task_for_provider, np.int32),
+            np.asarray(resp.price, np.float32),
+        )
 
     def _unbounded_best(self, ep, er) -> np.ndarray:
         resp = self._call(ep, er, "best", eps=0.0, max_iters=0)
