@@ -36,6 +36,10 @@ bench:
 bench-scaling:
 	$(PY) bench_scaling.py --full
 
+# full-scale matcher tests (100k nodes x 10k slots; ~4 min on CPU)
+scale-tests:
+	PROTOCOL_TPU_SCALE_TESTS=1 $(PY) -m pytest tests/test_scale_matcher.py -v
+
 # regenerate protobuf messages for the gRPC shim
 proto:
 	protoc --python_out=. protocol_tpu/proto/scheduler.proto

@@ -75,6 +75,7 @@ def candidates_topk(
     k: int = 64,
     tile: int = 1024,
     provider_offset: jax.Array | None = None,
+    task_offset: int | jax.Array = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Each task's top-k cheapest compatible providers.
 
@@ -86,6 +87,12 @@ def candidates_topk(
     ``provider_offset`` [P] biases the SELECTION (e.g. -eps*u from Sinkhorn
     potentials: pick candidates by plan mass) while the returned costs stay
     the true costs, so downstream matchers optimize the real objective.
+
+    ``task_offset`` shifts the task index used by the tie-jitter hash:
+    callers that generate candidates in separate delta batches (the
+    incremental CandidateCache) pass a persistent cursor so tasks from
+    different batches stay decorrelated — identical jitter patterns would
+    recreate the everyone-picks-the-same-k collapse the jitter prevents.
     """
     if weights is None:
         weights = CostWeights()
@@ -106,7 +113,9 @@ def candidates_topk(
         # providers, capping the matching at k regardless of supply. A tiny
         # deterministic hash(p, t) epsilon decorrelates candidate sets while
         # preserving any real cost gap > 1e-4.
-        t_idx = (t0 + jnp.arange(tile, dtype=jnp.uint32))[None, :]
+        t_idx = (
+            t0 + jnp.uint32(task_offset) + jnp.arange(tile, dtype=jnp.uint32)
+        )[None, :]
         h = p_idx[:, None] * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
         jitter = (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
         cost = jnp.where(cost < INFEASIBLE * 0.5, cost + jitter, cost)
@@ -406,6 +415,15 @@ def assign_auction_sparse_warm(
     # infeasible pair in the final matching — drop such seeds outright
     task_has_cand = jnp.any(cand_provider >= 0, axis=1)
     p4t0 = jnp.where(task_has_cand, p4t0, -1)
+    # Forward auctions only raise prices, and carried prices compound
+    # across warm solves. The retirement floor is give_up =
+    # -(2*max_cost + 10); cap carried prices at max_cost + 5 so the
+    # worst seeded value -max_cost - cap = -(2*max_cost + 5) stays ABOVE
+    # the floor — a ratcheted price can slow a task down but can never
+    # spuriously retire it on entry. Relative order among capped prices
+    # is lost, but those providers were priced out of contention anyway.
+    finite_max = jnp.max(jnp.where(cand_provider >= 0, cand_cost, 0.0))
+    price0 = jnp.minimum(jnp.asarray(price0, jnp.float32), finite_max + 5.0)
     owner0 = _invert(p4t0, num_providers)
     owner0, p4t0 = _unassign_unhappy(
         cand_provider, cand_cost, price0, owner0, p4t0, eps

@@ -1,0 +1,494 @@
+"""Incremental candidate cache: the delta-aware half of the warm solve.
+
+SURVEY §7 hard part 4: the reference re-walks every task per heartbeat
+(crates/orchestrator/src/scheduler/mod.rs:26-74); a naive batched re-solve
+every population change re-pays the dominant stage — candidate generation,
+an O(P*T) streamed pass — even when one node joined. This cache makes the
+candidate structure itself persistent:
+
+  - **Row-stable provider registry.** Every address gets a row that never
+    moves until compaction; departed providers are masked invalid, changed
+    specs retire the row and allocate a fresh one. Columnar feature arrays
+    (the EncodedProviders fields) grow append-only, so per-solve encoding
+    cost is O(churn), not O(P).
+  - **Per-task candidate entries.** Each bounded task caches its slots'
+    top-K candidate rows plus the *static* part of their costs (proximity +
+    tie-jitter — everything except per-provider price/load and per-task
+    priority, which are re-applied at assembly). New tasks compute fresh
+    columns; new providers merge into cached lists via a small
+    [delta-P x S] pass — never the full [P x S] tensor.
+  - **Auction dual state.** Prices live per-row and survive churn, so the
+    frontier auction re-bids only the delta (ops/sparse.py
+    assign_auction_sparse_warm).
+
+Cost decomposition invariant (ops/cost.py): cost[p, t] =
+  base[p] (price/load terms) + static[p, t] (proximity + jitter)
+  - w_priority * prio[t], with INFEASIBLE for incompatible pairs.
+Per-provider and per-task terms shift whole rows/columns, so the cached
+*selection* stays valid under price/load/priority drift; values are exact
+because base and priority are re-applied from current state at assembly.
+Selection staleness from base drift is bounded by periodic rebuilds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from protocol_tpu.ops.cost import CostWeights
+from protocol_tpu.ops.encoding import (
+    EncodedProviders,
+    EncodedRequirements,
+    FeatureEncoder,
+)
+from protocol_tpu.ops.sparse import candidates_topk
+
+_P_FIELDS = (
+    "gpu_count", "gpu_mem_mb", "gpu_model_id", "has_gpu", "has_cpu",
+    "cpu_cores", "ram_mb", "storage_gb", "lat", "lon", "has_location",
+    "price", "load", "valid",
+)
+# integer columns whose "absent" sentinel is -1 (a 0 fill would read as a
+# real reported value to compat_mask, e.g. "0 cores")
+_P_INT_FIELDS = frozenset(
+    ("gpu_count", "gpu_mem_mb", "gpu_model_id", "cpu_cores", "ram_mb",
+     "storage_gb")
+)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class ProviderItem:
+    addr: str
+    specs: object  # Optional[ComputeSpecs]
+    location: object  # Optional[NodeLocation]
+    price: float = 0.0
+    load: float = 0.0
+
+    def fingerprint(self) -> tuple:
+        """Cheap structural identity for change detection — hand-rolled
+        field tuple, NOT to_dict/json (this runs once per provider per
+        solve; asdict costs ~30us each and dominated the warm path)."""
+        s = self.specs
+        g = s.gpu if s is not None else None
+        c = s.cpu if s is not None else None
+        loc = self.location
+        return (
+            (g.count, g.model, g.memory_mb) if g is not None else None,
+            (c.cores,) if c is not None else None,
+            (s.ram_mb, s.storage_gb) if s is not None else None,
+            (loc.latitude, loc.longitude) if loc is not None else None,
+        )
+
+
+@dataclass
+class TaskItem:
+    task_id: str
+    requirement: object  # ComputeRequirements
+    take: int  # replica slots this solve
+    prio: float = 0.0
+
+    def req_key(self) -> tuple:
+        """Cheap structural identity over the ENCODED requirement fields —
+        hand-rolled tuple, not to_dict/json, for the same reason as
+        ProviderItem.fingerprint: this runs once per task per solve."""
+        r = self.requirement
+        return (
+            (r.cpu.cores,) if r.cpu is not None else None,
+            r.ram_mb,
+            r.storage_gb,
+            tuple(
+                (g.count, g.model, g.memory_mb, g.memory_mb_min,
+                 g.memory_mb_max, g.total_memory_min, g.total_memory_max)
+                for g in r.gpu
+            ),
+        )
+
+
+@dataclass
+class _TaskEntry:
+    req_key: str
+    take: int
+    vocab_version: int
+    cand_p: np.ndarray  # [take, k] global rows, -1 pad
+    cand_static: np.ndarray  # [take, k] f32 cost minus base minus priority
+    er_row: dict  # single-row numpy EncodedRequirements fields
+
+
+@dataclass
+class PreparedSolve:
+    ep: EncodedProviders  # padded to p_bucket
+    cand_p: np.ndarray  # [S_pad, k]
+    cand_c: np.ndarray  # [S_pad, k] current full costs
+    price0: np.ndarray  # [p_bucket] f32
+    row_of_addr: dict
+    addr_of_row: list
+    num_rows: int
+    p_bucket: int
+    num_slots: int
+    rebuilt: bool
+    delta_tasks: int
+    delta_rows: int
+
+
+class CandidateCache:
+    def __init__(
+        self,
+        encoder: FeatureEncoder,
+        weights: CostWeights,
+        k: int = 64,
+        max_invalid_frac: float = 0.25,
+    ):
+        self.encoder = encoder
+        # candidate SELECTION is priority-free: the priority term shifts a
+        # task's whole row uniformly and can't change its provider ranking
+        self.weights = weights
+        self._sel_weights = dataclasses.replace(weights, priority=0.0)
+        self.k = k
+        self.max_invalid_frac = max_invalid_frac
+        self._clear()
+
+    # ---------------- provider registry ----------------
+
+    def _clear(self) -> None:
+        self.rows = 0
+        self.row_of_addr: dict[str, int] = {}
+        self.addr_of_row: list[Optional[str]] = []
+        self.fp_of_addr: dict[str, str] = {}
+        self.cols: dict[str, np.ndarray] = {}
+        self.prices = np.zeros(0, np.float32)
+        self.entries: dict[str, _TaskEntry] = {}
+        # persistent jitter cursor: delta batches must not restart the
+        # tie-jitter's task index at 0, or tasks registered one per solve
+        # on a homogeneous fleet would all cache the SAME k providers
+        # (capping the matching at k) — see candidates_topk(task_offset=...)
+        self._jitter_cursor = 0
+
+    def invalidate(self) -> None:
+        """Force a full rebuild on the next prepare (the periodic cold
+        solve that re-grounds prices and candidate selection)."""
+        self._clear()
+
+    def _grow(self, need: int) -> None:
+        cap = self.prices.shape[0]
+        if need <= cap:
+            return
+        new_cap = _pow2(need)
+        self.prices = np.concatenate(
+            [self.prices, np.zeros(new_cap - cap, np.float32)]
+        )
+        for name, arr in self.cols.items():
+            pad = np.zeros((new_cap - cap,) + arr.shape[1:], arr.dtype)
+            if name in _P_INT_FIELDS:
+                pad.fill(-1)
+            self.cols[name] = np.concatenate([arr, pad])
+
+    def _register_batch(self, items: list[ProviderItem]) -> np.ndarray:
+        """Encode a batch of new/changed providers and append rows.
+        Returns the new global row indices."""
+        n = len(items)
+        enc = self.encoder.encode_providers(
+            [it.specs for it in items],
+            locations=[it.location for it in items],
+            prices=[it.price for it in items],
+            loads=[it.load for it in items],
+        )
+        lo = self.rows
+        self._grow(lo + n)
+        if not self.cols:
+            # first registration: materialize columns at current capacity
+            cap = self.prices.shape[0]
+            for name in _P_FIELDS:
+                a = np.asarray(getattr(enc, name))
+                col = np.zeros((cap,) + a.shape[1:], a.dtype)
+                if name in _P_INT_FIELDS:
+                    col.fill(-1)
+                self.cols[name] = col
+        for name in _P_FIELDS:
+            self.cols[name][lo:lo + n] = np.asarray(getattr(enc, name))
+        rows = np.arange(lo, lo + n, dtype=np.int32)
+        for i, it in enumerate(items):
+            old = self.row_of_addr.get(it.addr)
+            if old is not None:
+                self.cols["valid"][old] = False
+            self.row_of_addr[it.addr] = lo + i
+            self.fp_of_addr[it.addr] = it.fingerprint()
+        self.addr_of_row.extend(it.addr for it in items)
+        self.rows = lo + n
+        return rows
+
+    def _pad_k(self, cp: np.ndarray, cs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """candidates_topk clamps k to the provider count: normalize cached
+        entries to self.k columns so assembly/merge shapes always line up."""
+        have = cp.shape[1]
+        if have >= self.k:
+            return cp[:, : self.k], cs[:, : self.k]
+        padp = np.full((cp.shape[0], self.k - have), -1, np.int32)
+        pads = np.zeros((cp.shape[0], self.k - have), np.float32)
+        return np.concatenate([cp, padp], axis=1), np.concatenate([cs, pads], axis=1)
+
+    def _base_now(self) -> np.ndarray:
+        w = self.weights
+        return (
+            w.price * self.cols["price"][: self.rows]
+            + w.load * self.cols["load"][: self.rows]
+        ).astype(np.float32)
+
+    def _assemble_ep(self, p_bucket: int) -> EncodedProviders:
+        kw = {}
+        for name in _P_FIELDS:
+            col = self.cols[name][: self.rows]
+            pad = np.zeros((p_bucket - self.rows,) + col.shape[1:], col.dtype)
+            if name in _P_INT_FIELDS:
+                pad.fill(-1)
+            kw[name] = jnp.asarray(np.concatenate([col, pad]))
+        return EncodedProviders(**kw)
+
+    # ---------------- requirements tiling ----------------
+
+    def _encode_req_row(self, item: TaskItem) -> dict:
+        enc = self.encoder.encode_requirements([item.requirement])
+        return {
+            f.name: np.asarray(getattr(enc, f.name))
+            for f in dataclasses.fields(enc)
+        }
+
+    @staticmethod
+    def _tile_er(rows: list[tuple[dict, int, float]], pad_to: int) -> EncodedRequirements:
+        """Assemble an EncodedRequirements by repeating cached single-row
+        encodings ``take`` times each (slots of a task share the
+        requirement; priority applied per slot)."""
+        fields = {}
+        names = list(rows[0][0].keys())
+        for name in names:
+            parts = [np.repeat(r[name], take, axis=0) for r, take, _ in rows]
+            total = sum(p.shape[0] for p in parts)
+            arr = np.concatenate(parts)
+            if pad_to > total:
+                pad = np.zeros((pad_to - total,) + arr.shape[1:], arr.dtype)
+                if name in ("cpu_cores", "ram_mb", "storage_gb", "gpu_count",
+                            "gpu_mem_min", "gpu_mem_max",
+                            "gpu_total_mem_min", "gpu_total_mem_max"):
+                    pad.fill(-1)
+                arr = np.concatenate([arr, pad])
+            fields[name] = arr
+        prio = np.zeros(pad_to, np.float32)
+        valid = np.zeros(pad_to, bool)
+        off = 0
+        for r, take, p in rows:
+            prio[off:off + take] = p
+            valid[off:off + take] = True
+            off += take
+        fields["priority"] = prio
+        fields["valid"] = valid
+        return EncodedRequirements(
+            **{k: jnp.asarray(v) for k, v in fields.items()}
+        )
+
+    # ---------------- the solve preparation ----------------
+
+    def prepare(self, providers: list[ProviderItem], tasks: list[TaskItem]) -> PreparedSolve:
+        """Sync registry + entries with the current population and return
+        the assembled solve inputs. O(churn * S) work, not O(P * S), when
+        the population is mostly unchanged."""
+        # ---- departures first: mask rows whose addr is gone
+        current_addrs = {it.addr for it in providers}
+        for addr, row in list(self.row_of_addr.items()):
+            if addr not in current_addrs:
+                self.cols["valid"][row] = False
+                del self.row_of_addr[addr]
+                self.fp_of_addr.pop(addr, None)
+        # ---- compaction trigger: too many dead rows -> full rebuild
+        if self.rows:
+            live = int(self.cols["valid"][: self.rows].sum())
+            if (self.rows - live) / self.rows > self.max_invalid_frac:
+                self._clear()
+        rebuilt = self.rows == 0
+
+        # ---- provider sync
+        delta_items: list[ProviderItem] = []
+        for it in providers:
+            row = self.row_of_addr.get(it.addr)
+            if row is None or self.fp_of_addr.get(it.addr) != it.fingerprint():
+                delta_items.append(it)
+            else:
+                # cheap per-solve drift: price/load update in place
+                self.cols["price"][row] = it.price
+                self.cols["load"][row] = it.load
+        new_rows = (
+            self._register_batch(delta_items)
+            if delta_items
+            else np.zeros(0, np.int32)
+        )
+        p_bucket = _pow2(self.rows)
+        ep = self._assemble_ep(p_bucket)
+        base = self._base_now()
+
+        # ---- task sync
+        current_ids = {t.task_id for t in tasks}
+        for tid in [t for t in self.entries if t not in current_ids]:
+            del self.entries[tid]
+        vocab = self.encoder.vocab_version
+        delta_tasks = [
+            t for t in tasks
+            if (e := self.entries.get(t.task_id)) is None
+            or e.take != t.take
+            or e.req_key != t.req_key()
+            or e.vocab_version != vocab
+        ]
+        fresh_ids = {t.task_id for t in delta_tasks}
+
+        if delta_tasks:
+            rows_meta = [
+                (self._encode_req_row(t), t.take, 0.0) for t in delta_tasks
+            ]
+            sd = sum(t.take for t in delta_tasks)
+            sd_pad = _pow2(sd)
+            er_d = self._tile_er(rows_meta, sd_pad)
+            tile = min(1024, sd_pad)
+            cp, cc = candidates_topk(
+                ep, er_d, self._sel_weights, k=self.k, tile=tile,
+                task_offset=self._jitter_cursor,
+            )
+            self._jitter_cursor += sd_pad
+            cp = np.asarray(cp)[:sd]
+            cc = np.asarray(cc)[:sd]
+            static = np.where(
+                cp >= 0, cc - base[np.maximum(cp, 0)], 0.0
+            ).astype(np.float32)
+            off = 0
+            for (er_row, take, _), t in zip(rows_meta, delta_tasks):
+                e_cp, e_cs = self._pad_k(
+                    cp[off:off + take], static[off:off + take]
+                )
+                self.entries[t.task_id] = _TaskEntry(
+                    req_key=t.req_key(),
+                    take=take,
+                    vocab_version=vocab,
+                    cand_p=e_cp.copy(),
+                    cand_static=e_cs.copy(),
+                    er_row=er_row,
+                )
+                off += take
+
+        # ---- merge new providers into UNCHANGED cached tasks
+        stale_tasks = [t for t in tasks if t.task_id not in fresh_ids]
+        if len(new_rows) and stale_tasks:
+            self._merge_new_rows(ep, new_rows, stale_tasks, base)
+
+        # ---- assembly
+        S = sum(t.take for t in tasks)
+        s_pad = _pow2(S)
+        cand_p = np.full((s_pad, self.k), -1, np.int32)
+        cand_c = np.zeros((s_pad, self.k), np.float32)
+        valid_row = self.cols["valid"][: self.rows]
+        wprio = self.weights.priority
+        off = 0
+        for t in tasks:
+            e = self.entries[t.task_id]
+            cp = e.cand_p
+            # departed/retired rows fall out of the matching here
+            cp = np.where((cp >= 0) & valid_row[np.maximum(cp, 0)], cp, -1)
+            cand_p[off:off + t.take] = cp
+            cand_c[off:off + t.take] = np.where(
+                cp >= 0,
+                e.cand_static + base[np.maximum(cp, 0)] - wprio * t.prio,
+                0.0,
+            )
+            off += t.take
+
+        return PreparedSolve(
+            ep=ep,
+            cand_p=cand_p,
+            cand_c=cand_c,
+            price0=np.concatenate(
+                [self.prices[: self.rows],
+                 np.zeros(p_bucket - self.rows, np.float32)]
+            ),
+            row_of_addr=self.row_of_addr,
+            addr_of_row=self.addr_of_row,
+            num_rows=self.rows,
+            p_bucket=p_bucket,
+            num_slots=S,
+            rebuilt=rebuilt,
+            delta_tasks=len(delta_tasks),
+            delta_rows=int(len(new_rows)),
+        )
+
+    def _merge_new_rows(
+        self,
+        ep: EncodedProviders,
+        new_rows: np.ndarray,
+        tasks: list[TaskItem],
+        base: np.ndarray,
+    ) -> None:
+        """Fold newly-registered provider rows into cached candidate lists:
+        one [delta-P x S] candidate pass + a host-side per-slot merge."""
+        d_pad = _pow2(len(new_rows))
+        sub = {}
+        for name in _P_FIELDS:
+            col = self.cols[name][new_rows]
+            pad = np.zeros((d_pad - len(new_rows),) + col.shape[1:], col.dtype)
+            if name in _P_INT_FIELDS:
+                pad.fill(-1)
+            sub[name] = jnp.asarray(np.concatenate([col, pad]))
+        ep_d = EncodedProviders(**sub)
+
+        rows_meta = [
+            (self.entries[t.task_id].er_row, t.take, 0.0) for t in tasks
+        ]
+        S = sum(t.take for t in tasks)
+        s_pad = _pow2(S)
+        er = self._tile_er(rows_meta, s_pad)
+        tile = min(1024, s_pad)
+        kd = min(self.k, d_pad)
+        cp_d, cc_d = candidates_topk(
+            ep_d, er, self._sel_weights, k=kd, tile=tile,
+            task_offset=self._jitter_cursor,
+        )
+        self._jitter_cursor += s_pad
+        cp_d = np.asarray(cp_d)[:S]
+        cc_d = np.asarray(cc_d)[:S]
+        valid_row = self.cols["valid"][: self.rows]
+        cp_d = np.where(cp_d >= 0, new_rows[np.maximum(cp_d, 0)], -1)
+        static_d = np.where(
+            cp_d >= 0, cc_d - base[np.maximum(cp_d, 0)], 0.0
+        ).astype(np.float32)
+
+        off = 0
+        for t in tasks:
+            e = self.entries[t.task_id]
+            take = e.take
+            allp = np.concatenate([e.cand_p, cp_d[off:off + take]], axis=1)
+            alls = np.concatenate(
+                [e.cand_static, static_d[off:off + take]], axis=1
+            )
+            # rank by CURRENT total cost; -1 entries AND dead rows sort
+            # last (a departed provider's stale entry must not hold a top-k
+            # slot against a live newcomer — the list would silently erode
+            # to fewer than k live candidates until a full rebuild)
+            live = (allp >= 0) & valid_row[np.maximum(allp, 0)]
+            key = np.where(live, alls + base[np.maximum(allp, 0)], np.inf)
+            idx = np.argsort(key, axis=1, kind="stable")[:, : self.k]
+            e.cand_p, e.cand_static = self._pad_k(
+                np.take_along_axis(allp, idx, axis=1),
+                np.take_along_axis(alls, idx, axis=1),
+            )
+            off += take
+
+    def store_prices(self, price: np.ndarray) -> None:
+        """Persist the auction's dual state (indexed by row)."""
+        self.prices[: self.rows] = np.asarray(
+            price[: self.rows], np.float32
+        )

@@ -47,6 +47,7 @@ from protocol_tpu.ops.sparse import (
     assign_auction_sparse_warm,
     candidates_topk,
 )
+from protocol_tpu.sched.cand_cache import CandidateCache, ProviderItem, TaskItem
 from protocol_tpu.store.context import StoreContext
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 
@@ -113,6 +114,10 @@ def _solve_unbounded(ep, er, weights) -> tuple[jax.Array, jax.Array]:
 
 
 class TpuBatchMatcher:
+    # the candidate cache is an in-process structure; RemoteBatchMatcher
+    # (whose candidates live behind the gRPC seam) turns it off
+    use_candidate_cache = True
+
     def __init__(
         self,
         store: StoreContext,
@@ -140,10 +145,12 @@ class TpuBatchMatcher:
         # part 4) instead of cold-solving the full population
         self.warm_start = warm_start
         self._warm_price_by_addr: dict[str, float] = {}
-        # forward auctions never LOWER prices: uncapped carry-over would
-        # ratchet until every new bid starts below the retirement floor.
-        # Prices are min-normalized each solve (a uniform shift never
-        # changes any argmax) and a periodic cold solve re-grounds them.
+        # forward auctions never LOWER prices: carried prices ratchet
+        # within a warm chain. Two bounds keep that safe: the warm kernel
+        # caps entry prices below its retirement floor
+        # (ops/sparse.py assign_auction_sparse_warm), and every
+        # ``cold_every`` solves a cold re-solve re-grounds prices and
+        # candidate selection from scratch.
         self.cold_every = 32
         self._warm_solves_since_cold = 0
         # degraded mode: solve with the native C++ engine instead of the
@@ -165,6 +172,9 @@ class TpuBatchMatcher:
         # serializes solves and makes (_assignment, _covered) swaps atomic
         self._solve_lock = threading.Lock()
         self.encoder = FeatureEncoder()
+        self._cache = CandidateCache(self.encoder, self.weights, k=top_k)
+        self._last_warm_used = False
+        self._last_warm_seeded = 0
         self.last_solve_stats: dict = {}
         self._solve_seq = 0
 
@@ -255,6 +265,69 @@ class TpuBatchMatcher:
             )
         return np.asarray(res.task_for_provider), np.asarray(price)
 
+    def _seed_slots(
+        self, p4s0: np.ndarray, row_of_addr: dict, tasks, bounded, slot_range
+    ) -> int:
+        """Seat the previous solve's holders back into their task's replica
+        slots (indices in ``row_of_addr``'s space). Seeds that no longer
+        satisfy eps-CS are evicted by the warm kernel's repair pass — the
+        remainder is the delta frontier that actually re-bids."""
+        tidx_by_id = {tasks[i].id: i for i, _ in bounded}
+        prev_by_task: dict[int, list[int]] = {}
+        for addr, tid in self._assignment.items():
+            row = row_of_addr.get(addr)
+            i = tidx_by_id.get(tid)
+            if row is not None and i is not None and i in slot_range:
+                prev_by_task.setdefault(i, []).append(row)
+        for i, holders in prev_by_task.items():
+            start, take = slot_range[i]
+            for j, row in enumerate(holders[:take]):
+                p4s0[start + j] = row
+        return int((p4s0 >= 0).sum())
+
+    def _warm_gate(self, seeded: int, rebuilt: bool = False) -> bool:
+        """Single source of truth for warm eligibility + the periodic-cold
+        counter (both the cached and the wire sparse paths go through it —
+        drift between duplicated gates is how warm bugs hide)."""
+        warm = (
+            self.warm_start
+            and seeded > 0
+            and not rebuilt
+            and self._warm_solves_since_cold < self.cold_every
+        )
+        if warm:
+            self._warm_solves_since_cold += 1
+        else:
+            self._warm_solves_since_cold = 0
+        return warm
+
+    def _solve_slots_cached(self, prepared, tasks, bounded, slot_range) -> np.ndarray:
+        """Phase 1 over the candidate cache's persistent structure: warm
+        single-phase auction when seeds exist, eps-scaling ladder otherwise.
+        Prices are stored back per-row so the NEXT solve re-bids only its
+        delta."""
+        p4s0 = np.full(prepared.cand_p.shape[0], -1, np.int32)
+        seeded = self._seed_slots(
+            p4s0, prepared.row_of_addr, tasks, bounded, slot_range
+        )
+        warm = self._warm_gate(seeded, rebuilt=prepared.rebuilt)
+        cand_p = jnp.asarray(prepared.cand_p)
+        cand_c = jnp.asarray(prepared.cand_c)
+        if warm:
+            res, price = assign_auction_sparse_warm(
+                cand_p, cand_c, prepared.p_bucket,
+                price0=jnp.asarray(prepared.price0),
+                p4t0=jnp.asarray(p4s0),
+            )
+        else:
+            res, price = assign_auction_sparse_scaled(
+                cand_p, cand_c, prepared.p_bucket, with_prices=True
+            )
+        self._cache.store_prices(np.asarray(price))
+        self._last_warm_used = warm
+        self._last_warm_seeded = seeded
+        return np.asarray(res.task_for_provider)[: prepared.num_rows]
+
     def _unbounded_best(self, ep, er) -> np.ndarray:
         if self.native_fallback:
             cost = self._native_cost(ep, er)
@@ -330,19 +403,19 @@ class TpuBatchMatcher:
             else:
                 bounded.append((i, r))
 
-        specs = [n.compute_specs for n in nodes]
-        locs = [n.location for n in nodes]
         P = len(nodes)
         p_bucket = _pow2_bucket(P)
-        ep = self.encoder.encode_providers(specs, locations=locs, pad_to=p_bucket)
 
-        assigned = np.zeros(P, bool)
         truncated_slots = 0
         kernel_used = "none"
         warm_used = False
         warm_seeded = 0
+        cache_stats: dict = {}
 
-        # ---- phase 1: bounded tasks -> replica slots -> auction
+        # ---- replica-slot expansion for bounded tasks (cheap, host-side)
+        slot_task: list[int] = []
+        slot_range: dict[int, tuple[int, int]] = {}  # task idx -> (start, n)
+        req_by_task: dict[int, ComputeRequirements] = {}
         if bounded:
             req_by_task = {i: task_requirements(tasks[i]) for i, _ in bounded}
             # the native degraded-mode engine solves dense on the host: it
@@ -353,8 +426,6 @@ class TpuBatchMatcher:
                 if self.native_fallback
                 else self.max_replica_slots
             )
-            slot_task: list[int] = []
-            slot_range: dict[int, tuple[int, int]] = {}  # task idx -> (start, n)
             for i, r in bounded:
                 take = min(min(r, P), slot_cap - len(slot_task))
                 slot_range[i] = (len(slot_task), take)
@@ -372,60 +443,102 @@ class TpuBatchMatcher:
                     self.max_replica_slots,
                     truncated_slots,
                 )
-            reqs = [req_by_task[i] for i in slot_task]
-            prios = [prio[i] for i in slot_task]
-            s_bucket = _pow2_bucket(len(slot_task))
-            er = self.encoder.encode_requirements(
-                reqs, priorities=prios, pad_to=s_bucket
+        s_bucket = _pow2_bucket(len(slot_task)) if slot_task else 0
+        use_sparse = bool(slot_task) and (
+            not self.native_fallback
+            and p_bucket * s_bucket > self.dense_cell_budget
+        )
+        # The candidate cache owns the provider index space on the cached
+        # path: rows are stable across solves (dead rows masked invalid), so
+        # per-solve encoding is O(churn) and candidate structure persists.
+        cached_path = (
+            use_sparse and self.warm_start and self.use_candidate_cache
+        )
+
+        prepared = None
+        if cached_path:
+            if self._warm_solves_since_cold >= self.cold_every:
+                # periodic full re-ground: fresh candidate selection AND
+                # fresh prices (bounds both selection staleness from base
+                # drift and the warm chain's monotone price ratchet)
+                self._cache.invalidate()
+            pitems = [
+                ProviderItem(
+                    addr=n.address,
+                    specs=n.compute_specs,
+                    location=n.location,
+                    price=n.price or 0.0,
+                    load=n.load or 0.0,
+                )
+                for n in nodes
+            ]
+            titems = [
+                TaskItem(
+                    task_id=tasks[i].id,
+                    requirement=req_by_task[i],
+                    take=slot_range[i][1],
+                    prio=float(prio[i]),
+                )
+                for i, _ in bounded
+                if i in slot_range and slot_range[i][1] > 0
+            ]
+            prepared = self._cache.prepare(pitems, titems)
+            ep = prepared.ep
+            idx_addrs = prepared.addr_of_row
+            N = prepared.num_rows
+            cache_stats = {
+                "cache_rebuilt": prepared.rebuilt,
+                "cache_delta_rows": prepared.delta_rows,
+                "cache_delta_tasks": prepared.delta_tasks,
+            }
+        else:
+            specs = [n.compute_specs for n in nodes]
+            locs = [n.location for n in nodes]
+            ep = self.encoder.encode_providers(
+                specs,
+                locations=locs,
+                prices=[n.price or 0.0 for n in nodes],
+                loads=[n.load or 0.0 for n in nodes],
+                pad_to=p_bucket,
             )
-            use_sparse = (
-                not self.native_fallback
-                and p_bucket * s_bucket > self.dense_cell_budget
-            )
-            if use_sparse:
+            idx_addrs = [n.address for n in nodes]
+            N = P
+
+        assigned = np.zeros(N, bool)
+
+        # ---- phase 1: bounded tasks -> replica slots -> auction
+        if slot_task:
+            if cached_path:
                 kernel_used = "sparse_topk"
+                t4p = self._solve_slots_cached(
+                    prepared, tasks, bounded, slot_range
+                )
+                warm_used = self._last_warm_used
+                warm_seeded = self._last_warm_seeded
+            elif use_sparse:
+                kernel_used = "sparse_topk"
+                er = self.encoder.encode_requirements(
+                    [req_by_task[i] for i in slot_task],
+                    priorities=[prio[i] for i in slot_task],
+                    pad_to=s_bucket,
+                )
                 price0 = np.zeros(p_bucket, np.float32)
                 p4s0 = np.full(s_bucket, -1, np.int32)
-                addrs = [n.address for n in nodes]
+                addrs = idx_addrs
                 if self.warm_start:
                     get_price = self._warm_price_by_addr.get
                     price0[:P] = np.fromiter(
                         (get_price(a, 0.0) for a in addrs), np.float32, count=P
                     )
-                    # prices only ever rise within a warm chain; the
-                    # periodic cold solve (cold_every) is what re-grounds
-                    # them before they can ratchet toward the retirement
-                    # floor
-                    # seat previous holders back into their task's slots:
-                    # these seeds either satisfy eps-CS (and stay) or are
-                    # evicted by the kernel's repair pass — the remainder
-                    # is the delta frontier that actually re-bids
-                    addr_to_pidx = {a: idx for idx, a in enumerate(addrs)}
-                    tidx_by_id = {tasks[i].id: i for i, _ in bounded}
-                    prev_by_task: dict[int, list[int]] = {}
-                    for addr, tid in self._assignment.items():
-                        p_idx = addr_to_pidx.get(addr)
-                        i = tidx_by_id.get(tid)
-                        if p_idx is not None and i is not None and i in slot_range:
-                            prev_by_task.setdefault(i, []).append(p_idx)
-                    for i, holders in prev_by_task.items():
-                        start, take = slot_range[i]
-                        for j, p_idx in enumerate(holders[:take]):
-                            p4s0[start + j] = p_idx
-                    warm_seeded = int((p4s0 >= 0).sum())
-                warm_used = (
-                    self.warm_start
-                    and warm_seeded > 0
-                    and self._warm_solves_since_cold < self.cold_every
-                )
+                    warm_seeded = self._seed_slots(
+                        p4s0, {a: i for i, a in enumerate(addrs)},
+                        tasks, bounded, slot_range,
+                    )
+                warm_used = self._warm_gate(warm_seeded)
                 t4p, price = self._bounded_t4p_sparse(
                     ep, er, price0, p4s0, warm=warm_used
                 )
                 t4p = t4p[:P]
-                if warm_used:
-                    self._warm_solves_since_cold += 1
-                else:
-                    self._warm_solves_since_cold = 0
                 if self.warm_start:
                     self._warm_price_by_addr = dict(
                         zip(addrs, np.asarray(price[:P], np.float64).tolist())
@@ -434,10 +547,15 @@ class TpuBatchMatcher:
                 kernel_used = (
                     "native_cpu" if self.native_fallback else "dense_auction"
                 )
-                t4p = self._bounded_t4p(ep, er)[:P]
+                er = self.encoder.encode_requirements(
+                    [req_by_task[i] for i in slot_task],
+                    priorities=[prio[i] for i in slot_task],
+                    pad_to=s_bucket,
+                )
+                t4p = self._bounded_t4p(ep, er)[:N]
             for p_idx, s_idx in enumerate(t4p):
                 if s_idx >= 0 and s_idx < len(slot_task):
-                    assignment[nodes[p_idx].address] = tasks[slot_task[s_idx]].id
+                    assignment[idx_addrs[p_idx]] = tasks[slot_task[s_idx]].id
                     assigned[p_idx] = True
 
         # ---- phase 2: remaining nodes -> cheapest compatible unbounded task
@@ -448,10 +566,10 @@ class TpuBatchMatcher:
             er = self.encoder.encode_requirements(
                 reqs, priorities=prios, pad_to=t_bucket
             )
-            best = self._unbounded_best(ep, er)[:P]
-            for p_idx in range(P):
+            best = self._unbounded_best(ep, er)[:N]
+            for p_idx in range(N):
                 if not assigned[p_idx] and best[p_idx] >= 0 and best[p_idx] < len(unbounded):
-                    assignment[nodes[p_idx].address] = tasks[unbounded[best[p_idx]]].id
+                    assignment[idx_addrs[p_idx]] = tasks[unbounded[best[p_idx]]].id
 
         self._assignment, self._covered = assignment, covered
         self._solve_seq += 1
@@ -466,4 +584,5 @@ class TpuBatchMatcher:
             "warm": warm_used,
             "warm_seeded_slots": warm_seeded,
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
+            **cache_stats,
         }

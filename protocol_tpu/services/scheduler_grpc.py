@@ -278,9 +278,22 @@ def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
     )
 
 
+# Columnar batches scale with the population: ~60 B/provider means the
+# 4 MB gRPC default tops out near 70k providers. 1 GiB covers the 1M-scale
+# ladder with headroom; it is a cap, not an allocation.
+MAX_MESSAGE_BYTES = 1 << 30
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+]
+
+
 def serve(address: str = "127.0.0.1:50061", max_workers: int = 4) -> grpc.Server:
     """Start the backend server (non-blocking; call .wait_for_termination())."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )
     server.add_generic_rpc_handlers((_handlers(SchedulerBackendServicer()),))
     server.add_insecure_port(address)
     server.start()
@@ -291,7 +304,7 @@ class SchedulerBackendClient:
     """Thin client stub (what a non-Python control plane would generate)."""
 
     def __init__(self, address: str = "127.0.0.1:50061"):
-        self.channel = grpc.insecure_channel(address)
+        self.channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._assign = self.channel.unary_unary(
             f"/{SERVICE_NAME}/Assign",
             request_serializer=pb.AssignRequest.SerializeToString,
@@ -384,8 +397,19 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     (SURVEY.md §7 hard part #6 wants it cheap — measured, not asserted).
     """
 
-    def __init__(self, store, address: str = "127.0.0.1:50061", **kwargs):
+    # candidates are generated behind the seam; the in-process candidate
+    # cache cannot hold them (warm prices still ride the wire)
+    use_candidate_cache = False
+
+    def __init__(
+        self,
+        store,
+        address: str = "127.0.0.1:50061",
+        request_timeout: float = 300.0,
+        **kwargs,
+    ):
         super().__init__(store, **kwargs)
+        self.request_timeout = request_timeout
         self.client = SchedulerBackendClient(address)
         self._rtt_ms: list[float] = []
         self._backend_ms: list[float] = []
@@ -427,7 +451,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             max_iters=max_iters,
         )
         t0 = time.perf_counter()
-        resp = self.client.assign(req)
+        resp = self.client.assign(req, timeout=self.request_timeout)
         self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
         self._backend_ms.append(resp.solve_ms)
         return resp
@@ -458,7 +482,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
                 np.asarray(p4s0[:n_s], np.int32).tolist()
             )
         t0 = time.perf_counter()
-        resp = self.client.assign(req)
+        resp = self.client.assign(req, timeout=self.request_timeout)
         self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
         self._backend_ms.append(resp.solve_ms)
         return (
