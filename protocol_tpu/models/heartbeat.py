@@ -49,13 +49,16 @@ class HeartbeatRequest:
     p2p_id: Optional[str] = None
     p2p_addresses: Optional[list[str]] = None
     task_details: Optional[TaskDetails] = None
+    # worker-reported host utilization 0..1 (external to this pool's own
+    # assignment so the matcher's load term cannot feed back into itself)
+    load: Optional[float] = None
 
     def task_state_enum(self) -> Optional[TaskState]:
         return TaskState.parse(self.task_state) if self.task_state else None
 
     def to_dict(self) -> dict:
         d: dict = {"address": self.address}
-        for k in ("task_id", "task_state", "metrics", "version", "timestamp", "p2p_id", "p2p_addresses"):
+        for k in ("task_id", "task_state", "metrics", "version", "timestamp", "p2p_id", "p2p_addresses", "load"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -77,4 +80,5 @@ class HeartbeatRequest:
             task_details=TaskDetails.from_dict(d["task_details"])
             if d.get("task_details")
             else None,
+            load=float(d["load"]) if d.get("load") is not None else None,
         )

@@ -385,6 +385,10 @@ class Node:
     compute_specs: Optional[ComputeSpecs] = None
     worker_p2p_id: Optional[str] = None
     worker_p2p_addresses: Optional[list[str]] = None
+    # provider-advertised ask price (cost units/hour); a live input to the
+    # batch matcher's price cost term — the reference scores nothing, so
+    # this field is the marketplace half of the redesign (ops/cost.py)
+    price: Optional[float] = None
 
     def to_dict(self) -> dict:
         d: dict[str, Any] = {
@@ -399,6 +403,8 @@ class Node:
             d["worker_p2p_id"] = self.worker_p2p_id
         if self.worker_p2p_addresses is not None:
             d["worker_p2p_addresses"] = self.worker_p2p_addresses
+        if self.price is not None:
+            d["price"] = self.price
         return d
 
     @classmethod
@@ -414,6 +420,7 @@ class Node:
             else None,
             worker_p2p_id=d.get("worker_p2p_id"),
             worker_p2p_addresses=d.get("worker_p2p_addresses"),
+            price=float(d["price"]) if d.get("price") is not None else None,
         )
 
     def to_json(self) -> str:

@@ -480,6 +480,7 @@ async def serve_worker(args) -> None:
         port=args.port,
         http=session,
         ipfs=ipfs,
+        price=args.price,
     )
     agent.register_on_ledger()
     bridge = TaskBridge(args.socket_path, agent)
@@ -565,6 +566,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--discovery-urls", default="")
     p.add_argument("--runtime", choices=["subprocess", "docker"], default="docker")
     p.add_argument("--socket-path", default="/var/run/protocol-tpu/bridge.sock")
+    p.add_argument(
+        "--price",
+        type=float,
+        default=None,
+        help="advertised ask price (cost units/hour) fed to the matcher's "
+        "price cost term via discovery",
+    )
 
     args = parser.parse_args(argv)
     from protocol_tpu.utils.logging import setup_logging

@@ -312,6 +312,15 @@ class OrchestratorService:
                 self.store.node_store.update_node_p2p(
                     address, hb.p2p_id, hb.p2p_addresses
                 )
+            if hb.load is not None:
+                # live load for the matcher's cost term. Clamp BEFORE the
+                # comparison (a worker reporting >1.0 must not rewrite an
+                # unchanged 1.0 every beat) and debounce at 0.01 — loadavg
+                # jitters every beat and this is the heartbeat hot path
+                clamped = min(max(float(hb.load), 0.0), 1.0)
+                if abs((node.load or 0.0) - clamped) > 0.01:
+                    node.load = clamped
+                    self.store.node_store.update_node(node)
         self.store.heartbeat_store.beat(hb)
         if hb.metrics:
             entries = []
@@ -875,6 +884,7 @@ class OrchestratorService:
                     p2p_id=dn.node.worker_p2p_id,
                     p2p_addresses=dn.node.worker_p2p_addresses,
                     location=dn.location,
+                    price=dn.node.price,
                 )
                 self.store.node_store.add_node(fresh)
                 known[addr] = fresh
@@ -930,6 +940,12 @@ class OrchestratorService:
             if node.location is None and dn.location is not None:
                 node.location = dn.location
                 dirty = True
+            # a LIVE cost-model input, not just a registration snapshot: a
+            # provider re-registering with a new ask must reach the matcher
+            # without dying first (rule 6 only covers Dead -> Discovered)
+            if node.price != dn.node.price:
+                node.price = dn.node.price
+                dirty = True
 
             # rule 6: dead -> discovered on a newer discovery update, judged
             # against the START-of-tick snapshot: a node marked Dead earlier
@@ -945,6 +961,7 @@ class OrchestratorService:
                 # so webhook observers see Dead -> Discovered like every
                 # other transition in this loop (monitor.rs:359-383)
                 node.compute_specs = dn.node.compute_specs
+                node.price = dn.node.price
                 if dirty or node.compute_specs is not None:
                     self.store.node_store.update_node(node)
                     dirty = False

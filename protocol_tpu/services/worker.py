@@ -388,8 +388,12 @@ class WorkerAgent:
         state: Optional[SystemState] = None,
         auto_recover: bool = True,
         ipfs=None,  # utils.ipfs.IpfsMirror: best-effort artifact mirroring
+        price: Optional[float] = None,
     ):
         self.ipfs = ipfs
+        # advertised ask price (cost units/hour), carried through discovery
+        # into the orchestrator's batch-matcher cost term
+        self.price = price
         self.provider_wallet = provider_wallet
         self.node_wallet = node_wallet
         self.ledger = ledger
@@ -464,6 +468,7 @@ class WorkerAgent:
             compute_specs=self.compute_specs,
             worker_p2p_id=self.p2p_id,
             worker_p2p_addresses=[f"http://{self.ip_address}:{self.port}/control"],
+            price=self.price,
         )
         return node.to_dict()
 
@@ -598,6 +603,16 @@ class WorkerAgent:
 
     # ----- heartbeat (operations/heartbeat/service.rs:140-293) -----
 
+    def _host_load(self) -> float:
+        """Self-reported host utilization 0..1 (1-min loadavg over cores),
+        shipped with every heartbeat. External to the pool's own assignment
+        state on purpose: the matcher's load cost term must not feed back
+        into the solve that produces it."""
+        try:
+            return min(os.getloadavg()[0] / max(os.cpu_count() or 1, 1), 1.0)
+        except OSError:
+            return 0.0
+
     def _collect_metrics(self) -> list[dict]:
         return [
             {"key": {"task_id": tid, "label": label}, "value": value}
@@ -618,6 +633,7 @@ class WorkerAgent:
             "p2p_id": self.p2p_id,
             "p2p_addresses": [f"http://{self.ip_address}:{self.port}/control"],
             "task_details": details.to_dict() if details else None,
+            "load": self._host_load(),
         }
         headers, body = sign_request("/heartbeat", self.node_wallet, payload)
         try:

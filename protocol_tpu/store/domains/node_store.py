@@ -56,6 +56,12 @@ class OrchestratorNode:
     location: Optional[NodeLocation] = None
     first_seen: float = field(default_factory=time.time)
     last_status_change: Optional[float] = None
+    # marketplace inputs to the batch matcher's cost terms: the provider's
+    # advertised ask price (from discovery) and its self-reported host
+    # utilization 0..1 (from heartbeats — external to this pool's own
+    # assignment, so the load term cannot feed back into the solve)
+    price: Optional[float] = None
+    load: float = 0.0
 
     def to_dict(self) -> dict:
         d: dict = {
@@ -81,6 +87,10 @@ class OrchestratorNode:
             d["location"] = self.location.to_dict()
         if self.last_status_change is not None:
             d["last_status_change"] = self.last_status_change
+        if self.price is not None:
+            d["price"] = self.price
+        if self.load:
+            d["load"] = self.load
         return d
 
     @classmethod
@@ -101,6 +111,8 @@ class OrchestratorNode:
             location=NodeLocation.from_dict(d["location"]) if d.get("location") else None,
             first_seen=float(d.get("first_seen", 0.0)),
             last_status_change=d.get("last_status_change"),
+            price=float(d["price"]) if d.get("price") is not None else None,
+            load=float(d.get("load", 0.0)),
         )
 
 

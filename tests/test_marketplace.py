@@ -1,0 +1,203 @@
+"""Live marketplace cost inputs (VERDICT r2 item 9).
+
+The cost model's price and load terms must be fed from real state —
+provider-advertised ask price (worker -> discovery -> orchestrator) and
+worker-reported host load (heartbeat) — not the identically-zero
+placeholders of round 2. Done-bar: a price change flips an assignment.
+"""
+
+import numpy as np
+
+from protocol_tpu.models import (
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    Node,
+    SchedulingConfig,
+    Task,
+    TaskState,
+)
+from protocol_tpu.models.heartbeat import HeartbeatRequest
+from protocol_tpu.sched import TpuBatchMatcher
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+
+def specs():
+    return ComputeSpecs(
+        gpu=GpuSpecs(count=8, model="H100", memory_mb=80000),
+        cpu=CpuSpecs(cores=32),
+        ram_mb=65536,
+        storage_gb=1000,
+    )
+
+
+def node(addr, price=None, load=0.0):
+    return OrchestratorNode(
+        address=addr,
+        status=NodeStatus.HEALTHY,
+        compute_specs=specs(),
+        price=price,
+        load=load,
+    )
+
+
+def one_slot_task():
+    return Task(
+        name="t",
+        image="img",
+        created_at=100,
+        state=TaskState.PENDING,
+        scheduling_config=SchedulingConfig(
+            plugins={"tpu_scheduler": {"replicas": ["1"]}}
+        ),
+    )
+
+
+class TestPriceFlipsAssignment:
+    def _solve(self, price_a, price_b, **matcher_kw):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(node("0xa", price=price_a))
+        ctx.node_store.add_node(node("0xb", price=price_b))
+        ctx.task_store.add_task(one_slot_task())
+        m = TpuBatchMatcher(ctx, min_solve_interval=0, **matcher_kw)
+        m.refresh()
+        assert m.last_solve_stats["assigned"] == 1
+        return next(iter(m._assignment))
+
+    def test_cheaper_node_wins_dense(self):
+        assert self._solve(5.0, 1.0) == "0xb"
+        assert self._solve(1.0, 5.0) == "0xa"
+
+    def test_cheaper_node_wins_sparse(self):
+        assert self._solve(5.0, 1.0, dense_cell_budget=0) == "0xb"
+        assert self._solve(1.0, 5.0, dense_cell_budget=0) == "0xa"
+
+    def test_price_change_flips_on_resolve(self):
+        ctx = StoreContext.new_test()
+        a, b = node("0xa", price=1.0), node("0xb", price=5.0)
+        ctx.node_store.add_node(a)
+        ctx.node_store.add_node(b)
+        ctx.task_store.add_task(one_slot_task())
+        m = TpuBatchMatcher(ctx, min_solve_interval=0, dense_cell_budget=0)
+        m.refresh()
+        assert "0xa" in m._assignment
+        # the provider raises its ask above the competitor's
+        a.price = 9.0
+        ctx.node_store.update_node(a)
+        m.mark_dirty()
+        m.refresh()
+        assert "0xb" in m._assignment and "0xa" not in m._assignment
+
+    def test_load_steers_unbounded_swarm(self):
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(node("0xbusy", load=1.0))
+        ctx.node_store.add_node(node("0xidle", load=0.0))
+        # one bounded slot: contention resolved by load when prices equal
+        ctx.task_store.add_task(one_slot_task())
+        m = TpuBatchMatcher(ctx, min_solve_interval=0)
+        m.refresh()
+        assert "0xidle" in m._assignment
+
+
+class TestPropagation:
+    def test_node_price_survives_discovery_payload(self):
+        n = Node(id="0xw", price=2.5, compute_specs=specs())
+        assert Node.from_dict(n.to_dict()).price == 2.5
+
+    def test_orchestrator_node_round_trip(self):
+        n = node("0xa", price=1.25, load=0.75)
+        back = OrchestratorNode.from_dict(n.to_dict())
+        assert back.price == 1.25 and back.load == 0.75
+
+    def test_heartbeat_load_round_trip(self):
+        hb = HeartbeatRequest(address="0xa", load=0.4)
+        assert HeartbeatRequest.from_dict(hb.to_dict()).load == 0.4
+
+    def test_price_flows_worker_to_orchestrator(self):
+        """Full hop: WorkerAgent(price=..) -> signed discovery registration
+        -> DiscoveryMonitor sync -> orchestrator node store."""
+        import asyncio
+
+        import aiohttp
+        from aiohttp.test_utils import TestServer
+
+        from protocol_tpu.chain.ledger import Ledger
+        from protocol_tpu.models import DiscoveryNode
+        from protocol_tpu.security.signer import sign_request
+        from protocol_tpu.security.wallet import Wallet
+        from protocol_tpu.sched import Scheduler
+        from protocol_tpu.services.discovery import DiscoveryService
+        from protocol_tpu.services.orchestrator import OrchestratorService
+        from protocol_tpu.services.worker import WorkerAgent
+
+        async def run():
+            ledger = Ledger()
+            creator = Wallet.from_seed(b"creator")
+            manager = Wallet.from_seed(b"manager")
+            did = ledger.create_domain("d", validation_logic="any")
+            pid = ledger.create_pool(did, creator.address, manager.address, "")
+            ledger.start_pool(pid, creator.address)
+            async with aiohttp.ClientSession() as session:
+                discovery = DiscoveryService(ledger, pid)
+                dserver = TestServer(discovery.make_app())
+                await dserver.start_server()
+                durl = str(dserver.make_url(""))
+
+                provider = Wallet.from_seed(b"p")
+                nodew = Wallet.from_seed(b"n")
+                ledger.mint(provider.address, 1000)
+                agent = WorkerAgent(
+                    provider_wallet=provider,
+                    node_wallet=nodew,
+                    ledger=ledger,
+                    pool_id=pid,
+                    compute_specs=specs(),
+                    http=session,
+                    price=3.75,
+                )
+                agent.register_on_ledger()
+                ledger.whitelist_provider(provider.address)
+                assert await agent.upload_to_discovery([durl])
+                # the pool view exposes only validated nodes: attest as the
+                # hardware validator would, then sync the ledger flags
+                ledger.validate_node(nodew.address)
+                discovery.chain_sync_once()
+
+                ctx = StoreContext.new_test()
+                sched = Scheduler(ctx)
+
+                async def fetcher():
+                    headers, _ = sign_request(f"/api/pool/{pid}", manager)
+                    async with session.get(
+                        f"{durl}/api/pool/{pid}", headers=headers
+                    ) as resp:
+                        data = await resp.json()
+                        return [
+                            DiscoveryNode.from_dict(d)
+                            for d in data.get("data", [])
+                        ]
+
+                orch = OrchestratorService(
+                    ledger, pid, manager, store=ctx, scheduler=sched,
+                    discovery_fetcher=fetcher,
+                )
+                assert await orch.discovery_monitor_once() == 1
+                stored = ctx.node_store.get_node(nodew.address)
+                assert stored is not None and stored.price == 3.75
+                await dserver.close()
+
+        asyncio.run(run())
+
+    def test_heartbeat_updates_node_load(self):
+        """The orchestrator's heartbeat store section persists reported
+        load onto the node (services/orchestrator.py heartbeat ops)."""
+        from protocol_tpu.services.orchestrator import OrchestratorService
+
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(node("0xa"))
+        svc = OrchestratorService.__new__(OrchestratorService)
+        svc.store = ctx
+        hb = HeartbeatRequest(address="0xa", load=0.6)
+        banned = svc._heartbeat_store_ops(hb, "0xa")
+        assert banned is False
+        assert ctx.node_store.get_node("0xa").load == 0.6
