@@ -278,6 +278,45 @@ def main() -> None:
         f"({secs_cold / max(secs_warm, 1e-9):.1f}x)"
     )
 
+    # ---------------- stage D: ladder #5 vector bin-pack ------------------
+    # BASELINE.md config #5: multi-resource capacity vectors + anti-affinity
+    # (ops/binpack.py). Measured at the 10k-task test scale.
+    from protocol_tpu.ops.binpack import assign_binpack_ffd
+
+    P_D, T_D, R_D = 2048, 10240, 4
+    log(f"stage D: vector bin-pack P={P_D} T={T_D} R={R_D} + anti-affinity")
+    rng_d = np.random.default_rng(5)
+    cost_d = rng_d.uniform(1.0, 10.0, (P_D, T_D)).astype(np.float32)
+    cost_d[rng_d.uniform(size=(P_D, T_D)) > 0.7] = 1e9
+    demand = rng_d.integers(1, 4, (T_D, R_D)).astype(np.float32)
+    capacity = rng_d.integers(8, 21, (P_D, R_D)).astype(np.float32)
+    n_groups = T_D // 8
+    anti = np.where(
+        rng_d.uniform(size=T_D) < 0.2,
+        rng_d.integers(0, n_groups, T_D),
+        -1,
+    ).astype(np.int32)
+    loc = rng_d.integers(0, 256, P_D).astype(np.int32)
+    secs_d, res_d = measure(
+        lambda: assign_binpack_ffd(
+            jnp.asarray(cost_d), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), loc_id=jnp.asarray(loc),
+            num_locations=256, num_groups=n_groups,
+        ).provider_for_task
+    )
+    packed = int((np.asarray(res_d) >= 0).sum())
+    rows.append(
+        {
+            "stage": "D vector bin-pack + anti-affinity (measured)",
+            "platform": platform,
+            "shape": f"P={P_D} T={T_D} R={R_D} groups={n_groups}",
+            "wall_s": round(secs_d, 3),
+            "tasks_per_s": round(packed / max(secs_d, 1e-9), 0),
+            "packed": packed,
+        }
+    )
+    log(f"  {secs_d:.3f}s, {packed}/{T_D} packed")
+
     print(json.dumps({"platform": platform, "devices": n_dev, "rows": rows}, indent=1))
 
 

@@ -1,0 +1,160 @@
+"""Multi-resource vector bin-pack with anti-affinity (ladder #5).
+
+BASELINE.md config #5: providers expose a CAPACITY VECTOR (gpu count,
+VRAM, bandwidth, cpu, ram — any fixed set of R resources) and tasks carry
+a DEMAND VECTOR; several tasks may land on one provider while capacity
+holds. This generalizes the one-task-per-provider matching kernels
+(ops/assign.py, ops/sparse.py), whose capacity model is the unit vector.
+
+Anti-affinity is modeled as exclusion GROUPS over placement DOMAINS:
+``anti_group[t]`` (-1 = none) names a group whose members must land on
+distinct domains, and ``loc_id[p]`` maps providers to domains. Same-
+provider exclusion is the special case ``loc_id = arange(P)``; same-
+location (city/region) exclusion passes the location class id. This is
+the spread-replicas / separate-failure-domains constraint the reference
+cannot express at all (its matcher hands every node the same newest task,
+crates/orchestrator/src/scheduler/mod.rs:26-74).
+
+Kernel: vectorized first-fit-decreasing as a lax.scan over tasks in
+``task_order`` (default: L1-demand descending — classic FFD). Each step is
+a fused [P]-wide feasibility mask (capacity + compatibility + group
+exclusion) and an argmin pick; running capacity and the [L, G] group
+occupancy matrix are scan carries. Deterministic ties (lowest provider
+index) make the kernel bit-parity with the host oracle in
+tests/test_binpack.py.
+
+Complexity: O(T) sequential steps of O(P*R) work — the right shape up to
+~10k tasks per solve (BASELINE ladder #5's test scale). Past that, run it
+per delta-frontier batch on top of the incremental matcher (the same
+amortization argument as SCALING.md's warm path) rather than cold at 1M.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from protocol_tpu.ops.cost import INFEASIBLE
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BinpackResult:
+    provider_for_task: jax.Array  # i32 [T], -1 = unassigned
+    remaining_capacity: jax.Array  # f32 [P, R]
+
+    def num_assigned(self) -> jax.Array:
+        return jnp.sum(self.provider_for_task >= 0)
+
+
+def ffd_demand_order(demand: jax.Array) -> jax.Array:
+    """Classic FFD visit order: largest total demand first (L1 norm over
+    the resource axis). Stable sort => deterministic among equals."""
+    return jnp.argsort(-jnp.sum(demand, axis=1), stable=True).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_locations", "num_groups"))
+def assign_binpack_ffd(
+    cost: jax.Array,  # f32 [P, T]; INFEASIBLE marks incompatibility
+    demand: jax.Array,  # f32 [T, R]
+    capacity: jax.Array,  # f32 [P, R]
+    task_order: jax.Array | None = None,  # i32 [T]
+    anti_group: jax.Array | None = None,  # i32 [T], -1 = unconstrained
+    loc_id: jax.Array | None = None,  # i32 [P] -> [0, num_locations)
+    num_locations: int = 0,  # static; 0 = default per-provider domains
+    num_groups: int = 0,  # static; 0 = no anti-affinity tracking
+) -> BinpackResult:
+    """First-fit-decreasing vector bin-pack on the accelerator.
+
+    Each task (in ``task_order``) takes the CHEAPEST provider that (a) is
+    compatible (finite cost), (b) has remaining capacity >= demand in every
+    resource, and (c) does not violate the task's anti-affinity group on
+    the provider's placement domain. Ties break to the lowest provider
+    index (argmin picks the first minimum), matching the host oracle.
+    """
+    P, T = cost.shape
+    if task_order is None:
+        task_order = ffd_demand_order(demand)
+    if anti_group is None:
+        anti_group = jnp.full(T, -1, jnp.int32)
+    if loc_id is None:
+        loc_id = jnp.arange(P, dtype=jnp.int32)
+        L = num_locations or P
+    else:
+        L = num_locations or P
+    G = max(num_groups, 1)
+
+    cols = jnp.take(cost.T, task_order, axis=0)  # [T, P] in visit order
+    dem = jnp.take(demand, task_order, axis=0)  # [T, R]
+    grp = jnp.take(anti_group, task_order, axis=0)  # [T]
+
+    def step(carry, inputs):
+        cap, used = carry  # cap [P, R]; used [L, G] bool
+        col, d, g = inputs
+        fits = jnp.all(cap >= d[None, :], axis=1)  # [P]
+        g_safe = jnp.maximum(g, 0)
+        # provider p excluded iff its domain already hosts group g
+        excluded = (g >= 0) & used[loc_id, g_safe]  # [P]
+        masked = jnp.where(fits & ~excluded, col, INFEASIBLE)
+        p = jnp.argmin(masked).astype(jnp.int32)
+        feasible = masked[p] < INFEASIBLE * 0.5
+        take = jnp.where(feasible, d, jnp.zeros_like(d))
+        cap = cap.at[p].add(-take)
+        mark = feasible & (g >= 0)
+        used = used.at[loc_id[p], g_safe].set(
+            jnp.where(mark, True, used[loc_id[p], g_safe])
+        )
+        return (cap, used), jnp.where(feasible, p, -1)
+
+    carry0 = (
+        capacity.astype(jnp.float32),
+        jnp.zeros((L, G), bool),
+    )
+    (cap_final, _), picks = lax.scan(step, carry0, (cols, dem, grp))
+    provider_for_task = (
+        jnp.full(T, -1, jnp.int32).at[task_order].set(picks.astype(jnp.int32))
+    )
+    return BinpackResult(provider_for_task, cap_final)
+
+
+def binpack_oracle(cost, demand, capacity, task_order=None, anti_group=None, loc_id=None):
+    """Host-side reference implementation (numpy, same tie-breaking):
+    the parity oracle for assign_binpack_ffd — mirrors SURVEY §4's
+    kernel-vs-CPU-oracle test strategy."""
+    import numpy as np
+
+    cost = np.asarray(cost)
+    demand = np.asarray(demand, np.float64)
+    cap = np.asarray(capacity, np.float64).copy()
+    P, T = cost.shape
+    if task_order is None:
+        task_order = np.argsort(-demand.sum(axis=1), kind="stable")
+    if anti_group is None:
+        anti_group = np.full(T, -1, np.int64)
+    if loc_id is None:
+        loc_id = np.arange(P)
+    used: set[tuple[int, int]] = set()
+    out = np.full(T, -1, np.int64)
+    for t in task_order:
+        d = demand[t]
+        g = int(anti_group[t])
+        best, best_cost = -1, INFEASIBLE
+        for p in range(P):
+            if cost[p, t] >= INFEASIBLE * 0.5:
+                continue
+            if not (cap[p] >= d - 1e-9).all():
+                continue
+            if g >= 0 and (int(loc_id[p]), g) in used:
+                continue
+            if cost[p, t] < best_cost:
+                best, best_cost = p, cost[p, t]
+        if best >= 0:
+            out[t] = best
+            cap[best] -= d
+            if g >= 0:
+                used.add((int(loc_id[best]), g))
+    return out, cap

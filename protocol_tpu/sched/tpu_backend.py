@@ -84,12 +84,35 @@ def task_requirements(task: Task) -> ComputeRequirements:
     return ComputeRequirements()
 
 
+def task_anti_affinity(task: Task) -> Optional[str]:
+    """Replica-spread constraint (BASELINE ladder #5's anti-affinity term):
+    ``"task"`` = replicas on distinct providers (the matching already
+    guarantees this; declared form documents intent), ``"location"`` =
+    replicas on distinct geographic locations (failure-domain spread the
+    reference cannot express — its matcher hands every node the same
+    task, scheduler/mod.rs:26-74)."""
+    cfg = task.scheduling_config
+    if cfg and cfg.plugins:
+        vals = cfg.plugins.get("tpu_scheduler", {}).get("anti_affinity")
+        if vals:
+            mode = str(vals[0])
+            if mode not in ("task", "location"):
+                raise ValueError(f"anti_affinity must be task|location, got {mode!r}")
+            return mode
+    return None
+
+
 def validate_tpu_scheduler_config(task: Task) -> None:
     """Reject malformed tpu_scheduler plugin config at task-creation time so
     user input can never break the batch solve (raises ValueError)."""
     try:
-        task_replicas(task)
+        replicas = task_replicas(task)
         task_requirements(task)
+        if task_anti_affinity(task) is not None and replicas is None:
+            raise ValueError(
+                "anti_affinity requires a replicas bound (unbounded swarm "
+                "tasks have no replica set to spread)"
+            )
     except Exception as e:
         raise ValueError(f"invalid tpu_scheduler config: {e}") from e
 
@@ -285,6 +308,116 @@ class TpuBatchMatcher:
                 p4s0[start + j] = row
         return int((p4s0 >= 0).sum())
 
+    def _solve_anti_affinity(
+        self, ep, N: int, aa, tasks, prio, idx_addrs, loc_by_addr
+    ) -> dict[int, int]:
+        """Phase 0: place anti-affinity task replicas via the bin-pack
+        kernel (ops/binpack.py) with unit capacity — one replica per
+        provider — and exclusion groups over the declared domain:
+        providers ("task") or geographic locations ("location").
+
+        Cost stays bounded at scale by solving over the UNION of each
+        slot's top-K candidates rather than all N providers. Returns
+        {provider row -> task idx}."""
+        import dataclasses as _dc
+
+        from protocol_tpu.ops.binpack import assign_binpack_ffd
+
+        results: dict[int, int] = {}
+        for mode in ("task", "location"):
+            items = [(i, take, m) for (i, take, m) in aa if m == mode]
+            if not items:
+                continue
+            slot_task: list[int] = []
+            groups: list[int] = []
+            for gi, (i, r, _m) in enumerate(items):
+                take = min(r, N, 4096)
+                if take < min(r, N):
+                    # same never-a-silent-cap rule as the phase-1 slot cap
+                    self._aa_truncated += min(r, N) - take
+                    logging.getLogger(__name__).warning(
+                        "anti-affinity replica demand for task %s capped at "
+                        "4096 slots (%d dropped this solve)",
+                        tasks[i].id, min(r, N) - take,
+                    )
+                slot_task.extend([i] * take)
+                groups.extend([gi] * take)
+            S = len(slot_task)
+            if S == 0:
+                continue
+            s_pad = _pow2_bucket(S)
+            er = self.encoder.encode_requirements(
+                [task_requirements(tasks[i]) for i in slot_task],
+                priorities=[float(prio[i]) for i in slot_task],
+                pad_to=s_pad,
+            )
+            cand_p, _ = candidates_topk(
+                ep, er, self.weights, k=self.top_k, tile=min(1024, s_pad)
+            )
+            rows = np.unique(np.asarray(cand_p))
+            rows = rows[rows >= 0].astype(np.int64)
+            if rows.size == 0:
+                continue
+            rpad = _pow2_bucket(len(rows))
+            gather = np.concatenate(
+                [rows, np.zeros(rpad - len(rows), np.int64)]
+            )
+            sub_ep = jax.tree.map(
+                lambda a: jnp.take(a, jnp.asarray(gather), axis=0), ep
+            )
+            sub_valid = np.zeros(rpad, bool)
+            sub_valid[: len(rows)] = np.asarray(ep.valid)[rows]
+            sub_ep = _dc.replace(sub_ep, valid=jnp.asarray(sub_valid))
+            cost = np.asarray(_cost_only(sub_ep, er, self.weights)).copy()
+            # rows claimed by a previous mode pass are taken
+            taken_local = np.isin(rows, np.fromiter(results, np.int64, len(results)))
+            cost[: len(rows)][taken_local] = INFEASIBLE
+            if mode == "location":
+                loc_local, L = self._location_classes(rows, idx_addrs, loc_by_addr)
+                loc = np.zeros(rpad, np.int32)
+                loc[: len(rows)] = loc_local
+            else:
+                loc = np.arange(rpad, dtype=np.int32)
+                L = rpad
+            res = assign_binpack_ffd(
+                jnp.asarray(cost),
+                jnp.ones((s_pad, 1), jnp.float32),
+                jnp.ones((rpad, 1), jnp.float32),
+                anti_group=jnp.asarray(
+                    np.concatenate(
+                        [np.asarray(groups, np.int32),
+                         np.full(s_pad - S, -1, np.int32)]
+                    )
+                ),
+                loc_id=jnp.asarray(loc),
+                # pow2 buckets: L and G size the jitted [L, G] carry, and
+                # unbucketed values would retrace on every population drift
+                num_locations=_pow2_bucket(int(L)),
+                num_groups=_pow2_bucket(len(items)),
+            )
+            p4s = np.asarray(res.provider_for_task)[:S]
+            for s, r_local in enumerate(p4s):
+                if 0 <= r_local < len(rows):
+                    results[int(rows[r_local])] = slot_task[s]
+        return results
+
+    def _location_classes(
+        self, rows: np.ndarray, idx_addrs, loc_by_addr
+    ) -> tuple[np.ndarray, int]:
+        """Location class id per subset row: nodes sharing a (rounded)
+        lat/lon coordinate share a class; nodes without a location are
+        each their own failure domain (they cannot be proven co-located,
+        so spreading treats them as distinct)."""
+        keys = []
+        for r in rows:
+            loc = loc_by_addr.get(idx_addrs[r]) if r < len(idx_addrs) else None
+            if loc is not None:
+                keys.append((round(loc.latitude, 3), round(loc.longitude, 3)))
+            else:
+                keys.append(("solo", int(r)))
+        uniq = {k: i for i, k in enumerate(dict.fromkeys(keys))}
+        return np.asarray([uniq[k] for k in keys], np.int32), len(uniq)
+
     def _warm_gate(self, seeded: int, rebuilt: bool = False) -> bool:
         """Single source of truth for warm eligibility + the periodic-cold
         counter (both the cached and the wire sparse paths go through it —
@@ -369,6 +502,7 @@ class TpuBatchMatcher:
             try:
                 task_replicas(t)
                 task_requirements(t)
+                task_anti_affinity(t)
             except Exception:
                 continue
             ok_tasks.append(t)
@@ -396,12 +530,17 @@ class TpuBatchMatcher:
 
         bounded: list[tuple[int, int]] = []  # (task idx, replicas)
         unbounded: list[int] = []
+        aa: list[tuple[int, int, str]] = []  # (task idx, replicas, mode)
         for i, t in enumerate(tasks):
             r = task_replicas(t)
             if r is None:
                 unbounded.append(i)
             else:
-                bounded.append((i, r))
+                mode = task_anti_affinity(t)
+                if mode:
+                    aa.append((i, r, mode))
+                else:
+                    bounded.append((i, r))
 
         P = len(nodes)
         p_bucket = _pow2_bucket(P)
@@ -506,6 +645,40 @@ class TpuBatchMatcher:
 
         assigned = np.zeros(N, bool)
 
+        # ---- phase 0: anti-affinity tasks -> bin-pack with exclusion
+        # domains (ladder #5's anti-affinity term, live): replicas spread
+        # across distinct providers/locations via ops/binpack; claimed
+        # providers are then excluded from the auction and phase 2.
+        aa_assigned = 0
+        self._aa_truncated = 0
+        if aa:
+            loc_by_addr = {n.address: n.location for n in nodes}
+            claims = self._solve_anti_affinity(
+                ep, N, aa, tasks, prio, idx_addrs, loc_by_addr
+            )
+            for row, i in claims.items():
+                assignment[idx_addrs[row]] = tasks[i].id
+                assigned[row] = True
+            aa_assigned = len(claims)
+            if aa_assigned:
+                claimed = np.zeros(
+                    int(np.asarray(ep.valid).shape[0]), bool
+                )
+                claimed[list(claims.keys())] = True
+                # the auction must not re-assign a claimed provider: drop
+                # them from the compatibility domain (ep.valid gates
+                # compat_mask) and from any pre-assembled candidate lists
+                import dataclasses as _dc
+
+                ep = _dc.replace(
+                    ep, valid=jnp.asarray(np.asarray(ep.valid) & ~claimed)
+                )
+                if prepared is not None:
+                    cp = prepared.cand_p
+                    prepared.cand_p = np.where(
+                        (cp >= 0) & claimed[np.maximum(cp, 0)], -1, cp
+                    )
+
         # ---- phase 1: bounded tasks -> replica slots -> auction
         if slot_task:
             if cached_path:
@@ -583,6 +756,8 @@ class TpuBatchMatcher:
             "kernel": kernel_used,  # dense_auction | sparse_topk | native_cpu
             "warm": warm_used,
             "warm_seeded_slots": warm_seeded,
+            "anti_affinity_assigned": aa_assigned,
+            "truncated_aa_slots": self._aa_truncated,
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
             **cache_stats,
         }

@@ -1,0 +1,170 @@
+"""Ladder #5: multi-resource vector bin-pack + anti-affinity.
+
+VERDICT r2 item 4 done-bar: an assign_* variant passing a randomized
+feasibility + optimality-gap test at 10k scale, with CPU-oracle parity
+(SURVEY §4 test strategy). Demands/capacities are integer-valued floats
+so f32 kernel arithmetic is exact against the f64 oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from protocol_tpu.ops.binpack import (
+    assign_binpack_ffd,
+    binpack_oracle,
+    ffd_demand_order,
+)
+from protocol_tpu.ops.cost import INFEASIBLE
+
+
+def random_instance(rng, P, T, R=4, compat=0.7, group_frac=0.0, n_locs=None):
+    cost = rng.uniform(1.0, 10.0, (P, T)).astype(np.float32)
+    cost[rng.uniform(size=(P, T)) > compat] = INFEASIBLE
+    demand = rng.integers(1, 4, (T, R)).astype(np.float32)
+    # sized so total capacity ~= 1.4x total demand per resource: a loose
+    # but contended instance (some providers/resources still bind)
+    capacity = rng.integers(8, 21, (P, R)).astype(np.float32)
+    if group_frac > 0:
+        n_groups = max(T // 8, 1)
+        anti = np.where(
+            rng.uniform(size=T) < group_frac,
+            rng.integers(0, n_groups, T),
+            -1,
+        ).astype(np.int32)
+    else:
+        n_groups, anti = 1, np.full(T, -1, np.int32)
+    loc = (
+        rng.integers(0, n_locs, P).astype(np.int32)
+        if n_locs
+        else np.arange(P, dtype=np.int32)
+    )
+    return cost, demand, capacity, anti, loc, n_groups, (n_locs or P)
+
+
+def check_feasible(cost, demand, capacity, anti, loc, p4t):
+    used_cap = np.zeros_like(capacity)
+    seen = set()
+    for t, p in enumerate(p4t):
+        if p < 0:
+            continue
+        assert cost[p, t] < INFEASIBLE * 0.5, "incompatible assignment"
+        used_cap[p] += demand[t]
+        g = int(anti[t])
+        if g >= 0:
+            key = (int(loc[p]), g)
+            assert key not in seen, "anti-affinity violated"
+            seen.add(key)
+    assert (used_cap <= capacity + 1e-6).all(), "capacity exceeded"
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_parity_randomized(self, seed):
+        rng = np.random.default_rng(seed)
+        cost, demand, capacity, anti, loc, G, L = random_instance(
+            rng, P=64, T=192, group_frac=0.4
+        )
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), loc_id=jnp.asarray(loc),
+            num_locations=L, num_groups=G,
+        )
+        got = np.asarray(res.provider_for_task)
+        want, want_cap = binpack_oracle(
+            cost, demand, capacity, anti_group=anti, loc_id=loc
+        )
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_allclose(
+            np.asarray(res.remaining_capacity), want_cap, atol=1e-5
+        )
+
+    def test_multiple_tasks_per_provider(self):
+        # one provider, capacity for exactly 3 unit tasks
+        cost = np.full((1, 4), 1.0, np.float32)
+        demand = np.ones((4, 1), np.float32)
+        capacity = np.array([[3.0]], np.float32)
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity)
+        )
+        p4t = np.asarray(res.provider_for_task)
+        assert (p4t >= 0).sum() == 3  # 4th task refused: capacity, not slots
+        assert float(res.remaining_capacity[0, 0]) == 0.0
+
+
+class TestAntiAffinity:
+    def test_group_spreads_across_providers(self):
+        # 3 providers with huge capacity; 3 same-group tasks must spread
+        cost = np.full((3, 3), 1.0, np.float32)
+        demand = np.ones((3, 2), np.float32)
+        capacity = np.full((3, 2), 100.0, np.float32)
+        anti = np.zeros(3, np.int32)
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), num_groups=1,
+        )
+        p4t = np.asarray(res.provider_for_task)
+        assert sorted(p4t.tolist()) == [0, 1, 2]
+
+    def test_group_larger_than_domains_leaves_surplus_unassigned(self):
+        cost = np.full((2, 3), 1.0, np.float32)
+        demand = np.ones((3, 1), np.float32)
+        capacity = np.full((2, 1), 100.0, np.float32)
+        anti = np.zeros(3, np.int32)
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), num_groups=1,
+        )
+        p4t = np.asarray(res.provider_for_task)
+        assert (p4t >= 0).sum() == 2
+
+    def test_location_level_exclusion(self):
+        # 4 providers in 2 locations; a 2-task group lands in DISTINCT
+        # locations even though 4 distinct providers exist
+        cost = np.full((4, 2), 1.0, np.float32)
+        cost[2:, :] = 0.5  # providers 2,3 cheaper — both in location 1
+        demand = np.ones((2, 1), np.float32)
+        capacity = np.full((4, 1), 100.0, np.float32)
+        anti = np.zeros(2, np.int32)
+        loc = np.array([0, 0, 1, 1], np.int32)
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), loc_id=jnp.asarray(loc),
+            num_locations=2, num_groups=1,
+        )
+        p4t = np.asarray(res.provider_for_task)
+        assert {int(loc[p]) for p in p4t} == {0, 1}
+
+
+class TestScale10k:
+    def test_feasibility_and_gap_at_10k(self):
+        rng = np.random.default_rng(7)
+        cost, demand, capacity, anti, loc, G, L = random_instance(
+            rng, P=2048, T=10240, group_frac=0.2, n_locs=256
+        )
+        res = assign_binpack_ffd(
+            jnp.asarray(cost), jnp.asarray(demand), jnp.asarray(capacity),
+            anti_group=jnp.asarray(anti), loc_id=jnp.asarray(loc),
+            num_locations=L, num_groups=G,
+        )
+        p4t = np.asarray(res.provider_for_task)
+        check_feasible(cost, demand, capacity, anti, loc, p4t)
+        assigned = p4t >= 0
+        # capacity-utilization sanity: most tasks place on this loose
+        # instance (total demand ~0.75x total capacity)
+        assert assigned.mean() > 0.5
+        # optimality gap vs the capacity-free lower bound: each assigned
+        # task's cost >= its min compatible cost, so LB = sum of row minima
+        # over assigned tasks. FFD must stay within 2x of LB here — the
+        # greedy pick IS the row min until capacity interferes.
+        lb = np.minimum.reduce(np.where(cost < INFEASIBLE * 0.5, cost, np.inf))
+        total = cost[p4t[assigned], np.flatnonzero(assigned)].sum()
+        assert total <= 2.0 * lb[assigned].sum()
+
+    def test_ffd_order_is_demand_descending(self):
+        demand = jnp.asarray(
+            np.array([[1, 1], [5, 5], [3, 3]], np.float32)
+        )
+        order = np.asarray(ffd_demand_order(demand))
+        assert order.tolist() == [1, 2, 0]
