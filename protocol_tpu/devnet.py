@@ -166,35 +166,41 @@ async def main(argv=None) -> None:
         ]
         groups_plugin = NodeGroupsPlugin(store, configs)
         groups_plugin.attach_observers()
-        scheduler = Scheduler(store, plugins=[groups_plugin])
-    else:
-        if args.scheduler_backend != "local" and not (
-            args.scheduler_backend == "remote"
-            or args.scheduler_backend.startswith("remote:")
-        ):
-            print(
-                f"unknown --scheduler-backend {args.scheduler_backend!r} "
-                "(want local | remote | remote:HOST:PORT)",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
-        if args.scheduler_backend != "local":
-            # control plane -> gRPC -> kernels (the north-star seam). A bare
-            # "remote" boots an in-process backend; "remote:HOST:PORT"
-            # points at an external one (e.g. the TPU node pool).
-            from protocol_tpu.services import scheduler_grpc
+    if args.scheduler_backend != "local" and not (
+        args.scheduler_backend == "remote"
+        or args.scheduler_backend.startswith("remote:")
+    ):
+        print(
+            f"unknown --scheduler-backend {args.scheduler_backend!r} "
+            "(want local | remote | remote:HOST:PORT)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    if args.scheduler_backend != "local":
+        # control plane -> gRPC -> kernels (the north-star seam). A bare
+        # "remote" boots an in-process backend; "remote:HOST:PORT"
+        # points at an external one (e.g. the TPU node pool).
+        from protocol_tpu.services import scheduler_grpc
 
-            addr = args.scheduler_backend.partition(":")[2]
-            grpc_server = None
-            if not addr:
-                addr = "127.0.0.1:50061"
-                # hold the reference: a dropped grpc.Server is GC'd and stops
-                grpc_server = scheduler_grpc.serve(addr)
-            matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
-            matcher.grpc_server = grpc_server
-        else:
-            matcher = TpuBatchMatcher(store)
-        matcher.attach_observers()
+        addr = args.scheduler_backend.partition(":")[2]
+        grpc_server = None
+        if not addr:
+            addr = "127.0.0.1:50061"
+            # hold the reference: a dropped grpc.Server is GC'd and stops
+            grpc_server = scheduler_grpc.serve(addr)
+        matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
+        matcher.grpc_server = grpc_server
+    else:
+        matcher = TpuBatchMatcher(store)
+    matcher.attach_observers()
+    if groups_plugin is not None:
+        # composed gang scheduling: grouped nodes through the plugin
+        # (matcher-ranked), ungrouped through the individual batch solve
+        matcher.attach_groups(groups_plugin)
+        scheduler = Scheduler(
+            store, plugins=[groups_plugin], batch_matcher=matcher
+        )
+    else:
         scheduler = Scheduler(store, batch_matcher=matcher)
 
     async def discovery_fetcher():

@@ -157,6 +157,13 @@ class NodeGroupsPlugin:
         # optional lifecycle hooks (fed to the webhook plugin)
         self.on_group_created = None
         self.on_group_dissolved = None
+        # optional group<->task ranker (wired by the batch matcher so the
+        # selection goes through the cost/auction path instead of
+        # rng.choice — SURVEY §7 hard part 5). Contract: ranker(group,
+        # applicable) returns the chosen Task, or None for "this group
+        # deliberately gets nothing this round" (e.g. a replica-bounded
+        # topology task's budget is spent on other groups).
+        self.task_ranker = None
         # larger min first, then more specific requirements first
         # (mod.rs:150-164)
         self.configurations = sorted(
@@ -217,6 +224,14 @@ class NodeGroupsPlugin:
     def get_group(self, group_id: str) -> Optional[NodeGroup]:
         raw = self.store.kv.get(GROUP_KEY.format(group_id))
         return NodeGroup.from_dict(json.loads(raw)) if raw else None
+
+    def grouped_addresses(self) -> set[str]:
+        """All addresses currently in any group (the batch matcher excludes
+        them from the individual solve — their work arrives group-wise)."""
+        out: set[str] = set()
+        for g in self.get_groups():
+            out.update(g.nodes)
+        return out
 
     def group_for_node(self, address: str) -> Optional[NodeGroup]:
         gid = self.store.kv.hget(NODE_TO_GROUP, address)
@@ -490,6 +505,8 @@ class NodeGroupsPlugin:
         ]
         if not applicable:
             return None
+        if self.task_ranker is not None:
+            return self.task_ranker(group, applicable)
         return self.rng.choice(applicable)
 
     # ------------- scheduler-side filter (scheduler_impl.rs) -------------
@@ -522,9 +539,32 @@ class NodeGroupsPlugin:
         applicable = [
             t for t in tasks if group.configuration_name in t.allowed_topologies()
         ]
-        if not applicable:
+        if self.task_ranker is not None:
+            # Composed mode: the matcher's group solve decides, and its
+            # universe includes unrestricted UNBOUNDED tasks (the
+            # reference's own recovery path hands those to groups,
+            # mod.rs:1122-1188 — the heartbeat path merely never offered
+            # them). Replica-bounded unrestricted tasks stay individual-
+            # only: their budget is accounted in the individual solve.
+            from protocol_tpu.sched.tpu_backend import task_replicas
+
+            for t in tasks:
+                if t.allowed_topologies():
+                    continue
+                try:
+                    if task_replicas(t) is None:
+                        applicable.append(t)
+                except ValueError:
+                    continue
+            if not applicable:
+                return None
+            choice = self.task_ranker(group, applicable)
+            if choice is None:
+                return None
+        elif not applicable:
             return None
-        choice = self.rng.choice(applicable)  # mod.rs:1176-1188
+        else:
+            choice = self.rng.choice(applicable)  # mod.rs:1176-1188
         # SET NX: first scheduler wins the race (mod.rs:471-476)
         self.store.kv.set(key, choice.id, nx=True)
         tid = self.store.kv.get(key)

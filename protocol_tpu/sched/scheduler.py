@@ -80,6 +80,29 @@ class Scheduler:
             return None
 
         if self.batch_matcher is not None:
+            # Composed gang scheduling (SURVEY §7 hard part 5): when a
+            # groups plugin rides alongside the matcher, grouped nodes
+            # resolve through the plugin's race-safe group-task machinery
+            # (whose selection the matcher ranks via its task_ranker hook);
+            # ungrouped nodes fall through to the individual batch solve,
+            # which excludes topology-restricted tasks and grouped nodes.
+            gp = next(
+                (
+                    p
+                    for p in self.plugins
+                    if hasattr(p, "group_for_node") and hasattr(p, "task_ranker")
+                ),
+                None,
+            )
+            if gp is not None:
+                group = gp.group_for_node(node_address)
+                if group is not None:
+                    tasks = self.store.task_store.get_all_tasks()
+                    filtered = gp.filter_tasks(tasks, node)
+                    if not filtered:
+                        return None
+                    return expand_task_for_node(filtered[0], node_address)
+
             task, covered = self.batch_matcher.lookup(node)
             if not covered:
                 # A node the last solve never considered (e.g. it just became

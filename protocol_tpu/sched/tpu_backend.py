@@ -198,6 +198,9 @@ class TpuBatchMatcher:
         self._cache = CandidateCache(self.encoder, self.weights, k=top_k)
         self._last_warm_used = False
         self._last_warm_seeded = 0
+        self._groups_plugin = None
+        self._group_assignment: dict[str, str] = {}  # group id -> task id
+        self._group_covered: set[str] = set()
         self.last_solve_stats: dict = {}
         self._solve_seq = 0
 
@@ -209,6 +212,27 @@ class TpuBatchMatcher:
     def attach_observers(self) -> None:
         self.store.task_store.subscribe_created(lambda t: self.mark_dirty())
         self.store.task_store.subscribe_deleted(lambda t: self.mark_dirty())
+
+    def attach_groups(self, plugin) -> None:
+        """Compose with a NodeGroupsPlugin (SURVEY §7 hard part 5): grouped
+        nodes leave the individual solve (their work arrives group-wise),
+        groups become pseudo-providers in a topology-masked cost solve, and
+        the plugin's group<->task selection goes through
+        :meth:`rank_task_for_group` instead of ``rng.choice`` — while ALL
+        of the plugin's race-safe commit machinery (SET-NX group task,
+        compare-and-delete cleanup, dissolved-group recovery) stays in
+        charge of the actual assignment."""
+        self._groups_plugin = plugin
+        plugin.task_ranker = self.rank_task_for_group
+        for hook_name in ("on_group_created", "on_group_dissolved"):
+            prev = getattr(plugin, hook_name)
+
+            def chained(group, prev=prev):
+                self.mark_dirty()
+                if prev is not None:
+                    prev(group)
+
+            setattr(plugin, hook_name, chained)
 
     # ----- lookup
 
@@ -418,6 +442,160 @@ class TpuBatchMatcher:
         uniq = {k: i for i, k in enumerate(dict.fromkeys(keys))}
         return np.asarray([uniq[k] for k in keys], np.int32), len(uniq)
 
+    def _solve_groups(
+        self, groups, tasks, prio
+    ) -> tuple[dict[str, str], set[str]]:
+        """Group <-> task solve through the real cost machinery.
+
+        Groups become pseudo-providers: aggregate price/load (member means)
+        and centroid location feed the same cost_matrix the node solve
+        uses, with compatibility supplied as an explicit topology mask
+        (group's configuration name in the task's allowed_topologies)
+        instead of the spec algebra. Replica-BOUNDED topology tasks are
+        unit-expanded and matched with the dense auction — their replica
+        count now bounds how many groups run them, which rng.choice could
+        never express; unassigned groups then take the best applicable
+        unbounded task (topology-matched, or unrestricted — the
+        reference's any-group-may-run-it semantics,
+        node_groups/mod.rs:1122-1188) by row argmin.
+
+        Returns ({group id -> task id}, covered group ids). The plugin's
+        SET-NX machinery commits assignments; this solve only ranks.
+        """
+        gcov = {g.id for g in groups}
+        if not groups or not tasks:
+            return {}, gcov
+        topo_bounded: list[tuple[int, int]] = []
+        pool_unbounded: list[int] = []  # phase-B candidates
+        for i, t in enumerate(tasks):
+            topos = t.allowed_topologies()
+            r = task_replicas(t)
+            if topos:
+                if r is None:
+                    pool_unbounded.append(i)
+                else:
+                    topo_bounded.append((i, r))
+            elif r is None:
+                # unrestricted unbounded: any group may run it
+                pool_unbounded.append(i)
+
+        G = len(groups)
+        g_pad = _pow2_bucket(G)
+        prices, loads, locs = [], [], []
+        for g in groups:
+            members = [self.store.node_store.get_node(a) for a in g.nodes]
+            members = [m for m in members if m is not None]
+            prices.append(
+                float(np.mean([m.price or 0.0 for m in members])) if members else 0.0
+            )
+            loads.append(
+                float(np.mean([m.load or 0.0 for m in members])) if members else 0.0
+            )
+            with_loc = [m.location for m in members if m.location is not None]
+            if with_loc:
+                from protocol_tpu.models.node import NodeLocation
+
+                locs.append(
+                    NodeLocation(
+                        latitude=float(np.mean([l.latitude for l in with_loc])),
+                        longitude=float(np.mean([l.longitude for l in with_loc])),
+                    )
+                )
+            else:
+                locs.append(None)
+        ep_g = self.encoder.encode_providers(
+            [None] * G, locations=locs, prices=prices, loads=loads, pad_to=g_pad
+        )
+
+        result: dict[str, str] = {}
+        taken = np.zeros(G, bool)
+
+        # ---- phase A: replica-bounded topology tasks -> dense auction
+        if topo_bounded:
+            slot_task: list[int] = []
+            for i, r in topo_bounded:
+                slot_task.extend([i] * min(r, G, 4096))
+            S = len(slot_task)
+            s_pad = _pow2_bucket(S)
+            er = self.encoder.encode_requirements(
+                [ComputeRequirements()] * S,
+                priorities=[float(prio[i]) for i in slot_task],
+                pad_to=s_pad,
+            )
+            mask = np.zeros((g_pad, s_pad), bool)
+            for s, i in enumerate(slot_task):
+                topos = set(tasks[i].allowed_topologies())
+                for gi, g in enumerate(groups):
+                    mask[gi, s] = g.configuration_name in topos
+            cost, _ = cost_matrix(ep_g, er, self.weights, mask=jnp.asarray(mask))
+            res = assign_auction(cost, eps=0.05, max_iters=300)
+            t4g = np.asarray(res.task_for_provider)[:G]
+            for gi, s_idx in enumerate(t4g):
+                if 0 <= s_idx < S:
+                    result[groups[gi].id] = tasks[slot_task[s_idx]].id
+                    taken[gi] = True
+
+        # ---- phase B: remaining groups -> best applicable unbounded task.
+        # Topology-restricted tasks outrank unrestricted ones regardless of
+        # cost: groups are the ONLY venue a topology task can run, while an
+        # unrestricted task also reaches every ungrouped node — letting a
+        # newer unrestricted task outbid a topology task would starve the
+        # gang workload (observed live before this tiering).
+        if pool_unbounded and not taken.all():
+            T2 = len(pool_unbounded)
+            t_pad = _pow2_bucket(T2)
+            er = self.encoder.encode_requirements(
+                [ComputeRequirements()] * T2,
+                priorities=[float(prio[i]) for i in pool_unbounded],
+                pad_to=t_pad,
+            )
+            mask = np.zeros((g_pad, t_pad), bool)
+            for c, i in enumerate(pool_unbounded):
+                topos = tasks[i].allowed_topologies()
+                for gi, g in enumerate(groups):
+                    mask[gi, c] = (not topos) or (g.configuration_name in topos)
+            cost, _ = cost_matrix(ep_g, er, self.weights, mask=jnp.asarray(mask))
+            cost_np = np.asarray(cost)[:G, :T2]
+            is_topo = np.asarray(
+                [bool(tasks[i].allowed_topologies()) for i in pool_unbounded]
+            )
+            # tier the argmin: feasible topo columns first
+            tiered = np.where(is_topo[None, :], cost_np, cost_np + INFEASIBLE * 0.25)
+            tiered = np.where(cost_np < INFEASIBLE * 0.5, tiered, INFEASIBLE)
+            best = tiered.argmin(axis=1)
+            feas = tiered[np.arange(G), best] < INFEASIBLE * 0.5
+            for gi in range(G):
+                if not taken[gi] and feas[gi]:
+                    result[groups[gi].id] = tasks[pool_unbounded[best[gi]]].id
+        return result, gcov
+
+    def rank_task_for_group(self, group, applicable):
+        """The NodeGroupsPlugin's task_ranker hook: serve the group solve's
+        choice. A group the solve covered but left unassigned deliberately
+        gets None (e.g. a bounded topology task's replica budget went to
+        other groups); a group formed after the last solve triggers a
+        re-solve."""
+        self._ensure_fresh()
+        if group.id not in self._group_covered:
+            self.mark_dirty()
+            self._ensure_fresh()
+        tid = self._group_assignment.get(group.id)
+        match = next((t for t in applicable if t.id == tid), None)
+        if match is not None:
+            return match
+        if group.id in self._group_covered:
+            return None
+        # Not covered even after a re-solve (e.g. solve throttled). Only
+        # UNBOUNDED tasks are safe to hand out here: a replica-bounded
+        # task's budget is accounted inside the solve, and _task_for_group
+        # commits choices sticky via SET-NX — an uncovered-group fallback
+        # grabbing a bounded task could exceed its replica bound
+        # permanently. Bounded-only groups wait one beat instead.
+        unbounded = [t for t in applicable if task_replicas(t) is None]
+        if not unbounded:
+            return None
+        return max(unbounded, key=lambda t: t.created_at)
+
     def _warm_gate(self, seeded: int, rebuilt: bool = False) -> bool:
         """Single source of truth for warm eligibility + the periodic-cold
         counter (both the cached and the wire sparse paths go through it —
@@ -507,6 +685,31 @@ class TpuBatchMatcher:
                 continue
             ok_tasks.append(t)
         tasks = ok_tasks
+        # newest-first priority, matching NewestTaskPlugin ordering:
+        # normalize created_at to [0, 1] so the priority cost term dominates
+        # ties in the same direction as the reference's sort.
+        if tasks:
+            created = np.asarray([t.created_at for t in tasks], np.float64)
+            span = max(created.max() - created.min(), 1.0)
+            prio = ((created - created.min()) / span).astype(np.float32)
+        else:
+            prio = np.zeros(0, np.float32)
+
+        # ---- group phase (composed gang scheduling): groups are
+        # pseudo-providers in a topology-masked cost solve; grouped nodes
+        # leave the individual solve entirely
+        if self._groups_plugin is not None:
+            groups = self._groups_plugin.get_groups()
+            try:
+                self._group_assignment, self._group_covered = (
+                    self._solve_groups(groups, tasks, prio)
+                )
+            except Exception:
+                logging.getLogger(__name__).exception("group solve failed")
+                self._group_assignment, self._group_covered = {}, set()
+            grouped = {a for g in groups for a in g.nodes}
+            nodes = [n for n in nodes if n.address not in grouped]
+
         # build the new solution locally and swap at the end so concurrent
         # readers never observe a half-built assignment
         assignment: dict[str, str] = {}
@@ -517,21 +720,22 @@ class TpuBatchMatcher:
             self.last_solve_stats = {
                 "nodes": len(nodes),
                 "tasks": len(tasks),
+                "group_assignments": len(self._group_assignment),
                 "seq": self._solve_seq,
             }
             return
-
-        # newest-first priority, matching NewestTaskPlugin ordering:
-        # normalize created_at to [0, 1] so the priority cost term dominates
-        # ties in the same direction as the reference's sort.
-        created = np.asarray([t.created_at for t in tasks], np.float64)
-        span = max(created.max() - created.min(), 1.0)
-        prio = ((created - created.min()) / span).astype(np.float32)
 
         bounded: list[tuple[int, int]] = []  # (task idx, replicas)
         unbounded: list[int] = []
         aa: list[tuple[int, int, str]] = []  # (task idx, replicas, mode)
         for i, t in enumerate(tasks):
+            if t.allowed_topologies() and self._groups_plugin is not None:
+                # topology-restricted tasks are group-only when gang
+                # scheduling is active: handing one to an individual node
+                # would violate the gang contract. Without a groups plugin
+                # (no gang semantics in this deployment) they stay
+                # individually schedulable as before.
+                continue
             r = task_replicas(t)
             if r is None:
                 unbounded.append(i)
@@ -758,6 +962,7 @@ class TpuBatchMatcher:
             "warm_seeded_slots": warm_seeded,
             "anti_affinity_assigned": aa_assigned,
             "truncated_aa_slots": self._aa_truncated,
+            "group_assignments": len(self._group_assignment),
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
             **cache_stats,
         }

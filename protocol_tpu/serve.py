@@ -161,22 +161,12 @@ async def serve_orchestrator(args) -> None:
     groups_plugin = None
     group_configs = os.environ.get("NODE_GROUP_CONFIGS", "")
     if group_configs:
-        if backend != "local":
-            # fail loudly: silently running the groups scheduler locally
-            # while the operator believes solves route to the remote
-            # backend would be a misconfiguration with no symptom
-            raise SystemExit(
-                "NODE_GROUP_CONFIGS with --scheduler-backend "
-                f"{backend!r} is not supported: the node-groups scheduler "
-                "runs in-process (use --scheduler-backend local)"
-            )
         configs = [
             NodeGroupConfiguration.from_dict(d) for d in json.loads(group_configs)
         ]
         groups_plugin = NodeGroupsPlugin(store, configs)
         groups_plugin.attach_observers()
-        scheduler = Scheduler(store, plugins=[groups_plugin])
-    elif backend != "local":
+    if backend != "local":
         from protocol_tpu.services import scheduler_grpc
 
         addr = backend.partition(":")[2]
@@ -186,8 +176,6 @@ async def serve_orchestrator(args) -> None:
             addr = "127.0.0.1:50061"
             grpc_server = scheduler_grpc.serve(addr)
         matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
-        matcher.attach_observers()
-        scheduler = Scheduler(store, batch_matcher=matcher)
     else:
         matcher = TpuBatchMatcher(
             store,
@@ -196,7 +184,16 @@ async def serve_orchestrator(args) -> None:
             ).lower()
             in ("1", "true", "yes"),
         )
-        matcher.attach_observers()
+    matcher.attach_observers()
+    if groups_plugin is not None:
+        # composed gang scheduling: grouped nodes resolve through the
+        # plugin (matcher-ranked selection), ungrouped through the batch
+        # solve — no longer mutually exclusive deployments
+        matcher.attach_groups(groups_plugin)
+        scheduler = Scheduler(
+            store, plugins=[groups_plugin], batch_matcher=matcher
+        )
+    else:
         scheduler = Scheduler(store, batch_matcher=matcher)
 
     webhook = None

@@ -633,14 +633,18 @@ class OrchestratorService:
             return _err("name and image required", 400)
         if self.store.task_store.name_exists(req.name):
             return _err("task name already exists", 409)
-        # topology requirement when grouping is active (task.rs:68-80)
+        # topology requirement when grouping is active (task.rs:68-80).
+        # Composed mode (groups plugin + batch matcher) relaxes it: plain
+        # tasks are legal there — ungrouped nodes get them from the
+        # individual batch solve while groups run topology tasks.
         if self.groups_plugin is not None:
             topos = (
                 req.scheduling_config.allowed_topologies()
                 if req.scheduling_config
                 else []
             )
-            if not topos:
+            composed = getattr(self.scheduler, "batch_matcher", None) is not None
+            if not topos and not composed:
                 return _err("task must declare allowed_topologies", 400)
             unknown = [
                 t for t in topos if t not in self.groups_plugin.config_by_name

@@ -401,6 +401,17 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     # cache cannot hold them (warm prices still ride the wire)
     use_candidate_cache = False
 
+    def attach_groups(self, plugin) -> None:
+        # The group solve is tiny (groups x tasks) and runs in-process even
+        # on the remote matcher — but this control-plane host must never
+        # lazily initialize a remote accelerator platform (a wedged tunnel
+        # would hang the solve path). Pin jax to the host CPU first; every
+        # LARGE solve still rides the gRPC seam.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        super().attach_groups(plugin)
+
     def __init__(
         self,
         store,
