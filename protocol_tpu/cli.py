@@ -73,6 +73,9 @@ def main(argv=None) -> int:
     )
     p.add_argument("--storage-path", default="/")
     p.add_argument("--probe-accelerator", action="store_true")
+    p.add_argument("--port", type=int, default=None, help="advertise port to bind-probe")
+    p.add_argument("--require-docker", action="store_true")
+    p.add_argument("--speed-url", default=None, help="interconnect probe URL")
 
     p = sub.add_parser(
         "deregister",
@@ -168,10 +171,14 @@ def main(argv=None) -> int:
         _print({"address": w.address, "signature": w.sign_message(args.message)})
         return 0
     if args.cmd == "check":
-        from protocol_tpu.services.worker import detect_compute_specs
+        from protocol_tpu.services.checks import run_all_checks
 
-        specs, report = detect_compute_specs(
-            args.storage_path, probe_accelerator=args.probe_accelerator
+        specs, report = run_all_checks(
+            args.storage_path,
+            port=args.port,
+            require_docker=args.require_docker,
+            probe_accelerator=args.probe_accelerator,
+            speed_url=args.speed_url,
         )
         _print(
             {

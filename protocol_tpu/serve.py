@@ -432,7 +432,6 @@ async def serve_worker(args) -> None:
         SubprocessRuntime,
         TaskBridge,
         WorkerAgent,
-        detect_compute_specs,
     )
 
     provider = _wallet_from_env("PROVIDER_KEY")
@@ -454,11 +453,29 @@ async def serve_worker(args) -> None:
             )
         args.advertise_ip = detected
         print(f"advertise ip (stun): {args.advertise_ip}", flush=True)
-    specs, report = detect_compute_specs("/", probe_accelerator=False)
+    from protocol_tpu.services.checks import run_all_checks
+
+    specs, report = run_all_checks(
+        "/",
+        port=args.port,
+        docker_bin=os.environ.get("PROTOCOL_TPU_DOCKER_BIN", "docker"),
+        require_docker=args.runtime == "docker",
+        probe_accelerator=False,
+    )
+    for issue in report.issues:
+        print(f"check [{issue.level}]: {issue.message}", flush=True)
+    if report.critical:
+        # checks/issue.rs gating via cli/command.rs:388-397: criticals
+        # block startup rather than registering a broken worker
+        raise SystemExit("critical readiness issues; aborting (see above)")
     if args.runtime == "docker":
         from protocol_tpu.services.docker_runtime import DockerRuntime
 
-        runtime = DockerRuntime(socket_path=args.socket_path)
+        # the SAME binary the boot gate just validated
+        runtime = DockerRuntime(
+            socket_path=args.socket_path,
+            docker_bin=os.environ.get("PROTOCOL_TPU_DOCKER_BIN", "docker"),
+        )
     else:
         runtime = SubprocessRuntime(socket_path=args.socket_path)
     ipfs = None
@@ -485,10 +502,27 @@ async def serve_worker(args) -> None:
     await _run_app(agent.make_control_app(), args.port)
     urls = [u for u in args.discovery_urls.split(",") if u]
     await agent.upload_to_discovery(urls)
+    last_monitor = 0.0
     while True:
         try:
             await agent.heartbeat_once()
             await agent.upload_to_discovery(urls)
+            import time as _time
+
+            if _time.monotonic() - last_monitor >= 60.0:
+                # stake/whitelist/membership drift watch
+                # (provider.rs:47-147, compute_node.rs:32-115)
+                last_monitor = _time.monotonic()
+                for alarm in await asyncio.to_thread(agent.stake_monitor_once):
+                    print(f"chain alarm: {alarm}", file=sys.stderr)
+                if agent.deregistered:
+                    # a deregistered node must STOP, not keep advertising
+                    # itself to discovery forever
+                    raise SystemExit(
+                        "compute node deregistered on-chain; exiting"
+                    )
+        except SystemExit:
+            raise
         except Exception as e:
             print(f"worker loop error: {e}", file=sys.stderr)
         await asyncio.sleep(10.0)

@@ -1,0 +1,331 @@
+"""Worker hardware/software checks: the admission substance.
+
+Reference: crates/worker/src/checks/ (~1,100 LoC of host introspection).
+On a real marketplace these checks are what stands between an operator's
+claims and the specs the scheduler matches on:
+
+  hardware/gpu.rs            NVML device enumeration + WORKER_VISIBLE_DEVICES
+                             filtering -> here: nvidia-smi CSV parsing (no
+                             NVML binding in this image; the binary is the
+                             stable interface and a fake binary makes the
+                             parser hermetically testable, same pattern as
+                             the fake-docker runtime tests)
+  hardware/storage*.rs       statvfs totals + mount-point scan for the
+                             largest-usable data volume
+  hardware/memory.rs         MemTotal/MemAvailable
+  hardware/interconnect.rs   timed download/upload probe (pluggable URL;
+                             zero-egress hosts record a warning, not a hang)
+  software/docker.rs         docker installed / daemon up / NVIDIA runtime
+  software/port.rs           bind-probe for the worker's advertise port
+
+``run_all_checks`` composes them into (ComputeSpecs, IssueReport) — the
+boot gate for ``cli.py check`` and the worker's serve path. Critical
+issues block startup (checks/issue.rs gating via cli/command.rs:388-397);
+warnings print and proceed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from protocol_tpu.models.node import ComputeSpecs, CpuSpecs, GpuSpecs
+
+# filesystems that can never be the data volume (storage_path.rs scan)
+_PSEUDO_FS = {
+    "proc", "sysfs", "devtmpfs", "devpts", "tmpfs", "cgroup", "cgroup2",
+    "overlay", "squashfs", "autofs", "mqueue", "hugetlbfs", "debugfs",
+    "tracefs", "securityfs", "pstore", "bpf", "binfmt_misc", "configfs",
+    "fusectl", "ramfs", "rpc_pipefs", "nsfs",
+}
+
+
+@dataclass
+class MountPoint:
+    path: str
+    fs_type: str
+    total_gb: float
+    available_gb: float
+
+
+# ---------------------------------------------------------------- hardware
+
+
+def detect_gpus(nvidia_smi: str = "nvidia-smi") -> list[GpuSpecs]:
+    """GPU enumeration via the nvidia-smi CSV interface (gpu.rs:25-100).
+
+    Honors WORKER_VISIBLE_DEVICES (comma-separated indices) exactly like
+    the reference's NVML path. Devices are grouped by model into one
+    GpuSpecs per distinct model (count + shared per-card memory + indices).
+    Returns [] when no NVIDIA stack is present.
+    """
+    try:
+        out = subprocess.run(
+            [
+                nvidia_smi,
+                "--query-gpu=index,name,memory.total",
+                "--format=csv,noheader,nounits",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=20,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0:
+        return []
+
+    visible: Optional[set[int]] = None
+    raw_visible = os.environ.get("WORKER_VISIBLE_DEVICES", "").strip()
+    if raw_visible:
+        try:
+            visible = {int(x) for x in raw_visible.split(",") if x.strip()}
+        except ValueError:
+            visible = None
+
+    by_model: dict[str, dict] = {}
+    for line in out.stdout.splitlines():
+        parts = [p.strip() for p in line.split(",")]
+        if len(parts) < 3:
+            continue
+        try:
+            idx = int(parts[0])
+            mem_mb = int(float(parts[2]))
+        except ValueError:
+            continue
+        if visible is not None and idx not in visible:
+            continue
+        model = parts[1].lower()
+        slot = by_model.setdefault(
+            model, {"indices": [], "memory_mb": mem_mb}
+        )
+        slot["indices"].append(idx)
+    return [
+        GpuSpecs(
+            count=len(v["indices"]),
+            model=model,
+            memory_mb=v["memory_mb"],
+            indices=sorted(v["indices"]),
+        )
+        for model, v in by_model.items()
+    ]
+
+
+def scan_mount_points(mounts_path: str = "/proc/mounts") -> list[MountPoint]:
+    """Real (non-pseudo) mounted filesystems with capacity, largest
+    available first (storage_path.rs mount scan)."""
+    points: list[MountPoint] = []
+    try:
+        with open(mounts_path) as f:
+            lines = f.readlines()
+    except OSError:
+        return points
+    seen: set[str] = set()
+    for line in lines:
+        parts = line.split()
+        if len(parts) < 3:
+            continue
+        _dev, path, fs_type = parts[0], parts[1], parts[2]
+        if fs_type in _PSEUDO_FS or path in seen:
+            continue
+        seen.add(path)
+        try:
+            st = os.statvfs(path)
+        except OSError:
+            continue
+        total = st.f_blocks * st.f_frsize / 1024**3
+        avail = st.f_bavail * st.f_frsize / 1024**3
+        if total <= 0:
+            continue
+        points.append(MountPoint(path, fs_type, total, avail))
+    points.sort(key=lambda m: -m.available_gb)
+    return points
+
+
+def best_storage_path(
+    mounts_path: str = "/proc/mounts", app_dir: str = "prime-worker"
+) -> tuple[str, float]:
+    """The mount with the most available space (the data volume the task
+    runtime should use), as (app-dir path on it, available_gb). The root
+    mount — and the fallback when /proc/mounts is unreadable — maps to
+    /var/lib/<app_dir>, so callers always get a writable directory path."""
+    points = scan_mount_points(mounts_path)
+    if not points:
+        return f"/var/lib/{app_dir}", shutil.disk_usage("/").free / 1024**3
+    best = points[0]
+    if best.path == "/":
+        return f"/var/lib/{app_dir}", best.available_gb
+    return os.path.join(best.path, app_dir), best.available_gb
+
+
+def memory_check(meminfo_path: str = "/proc/meminfo") -> tuple[int, int]:
+    """(MemTotal MB, MemAvailable MB); zeros when unreadable
+    (memory.rs)."""
+    total = avail = 0
+    try:
+        with open(meminfo_path) as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) // 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return total, avail
+
+
+def interconnect_check(
+    download_url: Optional[str] = None,
+    upload_url: Optional[str] = None,
+    http_get=None,
+) -> Optional[float]:
+    """Timed download probe -> Mbps (interconnect.rs:8-40). The reference
+    hardcodes Cloudflare's speed endpoint; here the URL is injected (tests
+    use a local server; zero-egress deployments leave it unset and the
+    check records a warning instead of hanging)."""
+    if download_url is None:
+        return None
+    try:
+        if http_get is not None:
+            t0 = time.perf_counter()
+            data = http_get(download_url)
+        else:
+            import urllib.request
+
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(download_url, timeout=30) as resp:
+                data = resp.read()
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        return len(data) * 8.0 / (elapsed * 1e6)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------- software
+
+
+def check_docker(docker_bin: str = "docker") -> tuple[bool, bool, Optional[str]]:
+    """(daemon_up, nvidia_runtime_present, error) via `docker info`
+    (software/docker.rs:8-80). Uses the CLI like the container runtime
+    does, so the fake-docker test pattern covers it."""
+    if shutil.which(docker_bin) is None and not os.path.isabs(docker_bin):
+        return False, False, f"{docker_bin} not installed"
+    try:
+        out = subprocess.run(
+            [docker_bin, "info", "--format", "{{json .}}"],
+            capture_output=True,
+            text=True,
+            timeout=20,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return False, False, str(e)
+    if out.returncode != 0:
+        return False, False, out.stderr.strip() or "docker daemon not running"
+    nvidia = False
+    try:
+        info = json.loads(out.stdout)
+        runtimes = info.get("Runtimes") or {}
+        nvidia = any("nvidia" in r.lower() for r in runtimes)
+    except (ValueError, AttributeError):
+        pass
+    return True, nvidia, None
+
+
+def check_port_available(port: int, host: str = "0.0.0.0") -> Optional[str]:
+    """Bind probe (software/port.rs:8-33); None = available."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.close()
+        return None
+    except OSError as e:
+        return str(e)
+
+
+# ---------------------------------------------------------------- composed
+
+
+def run_all_checks(
+    storage_path: str = "/",
+    port: Optional[int] = None,
+    nvidia_smi: str = "nvidia-smi",
+    docker_bin: str = "docker",
+    require_docker: bool = False,
+    probe_accelerator: bool = True,
+    speed_url: Optional[str] = None,
+    mounts_path: str = "/proc/mounts",
+):
+    """The reference's full boot gate (cli/command.rs:361-397): hardware
+    introspection + software checks -> (ComputeSpecs, IssueReport).
+
+    GPU specs prefer real nvidia-smi enumeration over the JAX device probe
+    (the probe proves an accelerator is reachable; the enumeration is what
+    the marketplace matches on). Criticals gate startup; warnings print.
+    """
+    from protocol_tpu.services.worker import IssueReport, detect_compute_specs
+
+    specs, report = detect_compute_specs(
+        storage_path, probe_accelerator=probe_accelerator
+    )
+
+    gpus = detect_gpus(nvidia_smi)
+    if gpus:
+        # one GpuSpecs per model; the node advertises the largest pool
+        primary = max(gpus, key=lambda g: g.count or 0)
+        specs.gpu = primary
+        if len(gpus) > 1:
+            report.add(
+                "warning",
+                f"heterogeneous GPUs detected ({len(gpus)} models); "
+                f"advertising {primary.model} x{primary.count}",
+            )
+
+    total_mb, avail_mb = memory_check()
+    if total_mb and avail_mb < max(total_mb // 10, 1):
+        report.add(
+            "warning",
+            f"only {avail_mb} MB of {total_mb} MB RAM available",
+        )
+
+    mounts = scan_mount_points(mounts_path)
+    if mounts:
+        best = mounts[0]
+        if best.path not in ("/",) and best.available_gb > (
+            shutil.disk_usage(storage_path).free / 1024**3
+        ):
+            report.add(
+                "warning",
+                f"larger data volume available at {best.path} "
+                f"({best.available_gb:.0f} GB free); consider --storage-path",
+            )
+
+    if port is not None:
+        err = check_port_available(port)
+        if err is not None:
+            report.add("critical", f"port {port} unavailable: {err}")
+
+    daemon_up, nvidia_rt, docker_err = check_docker(docker_bin)
+    if not daemon_up:
+        report.add(
+            "critical" if require_docker else "warning",
+            f"docker: {docker_err}",
+        )
+    elif specs.gpu is not None and not nvidia_rt:
+        report.add(
+            "warning",
+            "GPU present but docker has no NVIDIA runtime: GPU tasks will "
+            "not see devices",
+        )
+
+    mbps = interconnect_check(speed_url)
+    if speed_url is not None and mbps is None:
+        report.add("warning", "interconnect speed probe failed")
+
+    return specs, report

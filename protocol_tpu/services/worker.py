@@ -36,6 +36,7 @@ whole framework instead of two.
 from __future__ import annotations
 
 import asyncio
+import logging
 import hashlib
 import json
 import os
@@ -411,6 +412,11 @@ class WorkerAgent:
         self.known_orchestrators = [a.lower() for a in (known_orchestrators or [])]
         self.known_validators = [a.lower() for a in (known_validators or [])]
         self.p2p_id = f"worker-{node_wallet.address[:10]}"
+        # chain drift monitor state (stake_monitor_once)
+        self._chain_state: dict[str, bool] = {}
+        self._chain_error = False
+        self.chain_alarms: list[str] = []
+        self.deregistered = False
         self.state = state
         if state is not None and auto_recover:
             # crash recovery (cli/command.rs:832-835): resume heartbeating
@@ -602,6 +608,79 @@ class WorkerAgent:
         return web.json_response({"success": True})
 
     # ----- heartbeat (operations/heartbeat/service.rs:140-293) -----
+
+    # ----- stake / chain-event monitor (provider.rs:47-147,
+    # compute_node.rs:32-115) -----
+
+    def stake_monitor_once(self) -> list[str]:
+        """One tick of the reference's continuous provider monitors:
+        re-check stake sufficiency, whitelist status, node registration,
+        and pool membership. Returns the NEW alarms (True->False
+        transitions since the previous tick — levels alone would re-alarm
+        every tick), accumulates them on ``self.chain_alarms``, and stops
+        heartbeating when the node itself was deregistered on-chain.
+
+        The reference registers once at boot and then watches drift in
+        dedicated loops; round 2 of this framework only did the former, so
+        a mid-run slash went unnoticed by the worker (VERDICT r2 item 8).
+        """
+        state: dict[str, bool] = {}
+        alarms: list[str] = []
+        provider = self.provider_wallet.address
+        node = self.node_wallet.address
+        try:
+            units = max(self.ledger.get_provider_total_compute(provider), 1)
+            required = self.ledger.calculate_stake(units)
+            current = self.ledger.get_stake(provider)
+            state["stake_sufficient"] = current >= required
+            state["whitelisted"] = self.ledger.is_provider_whitelisted(provider)
+            state["node_registered"] = self.ledger.node_exists(node)
+            state["in_pool"] = self.ledger.is_node_in_pool(self.pool_id, node)
+        except Exception as e:
+            # transition-deduped like the drift alarms: a weekend-long
+            # ledger outage must not grow chain_alarms unboundedly
+            if not self._chain_error:
+                self._chain_error = True
+                alarms.append(f"chain monitor error: {e}")
+                self._record_alarms(alarms)
+            return alarms
+        self._chain_error = False
+
+        detail = {
+            "stake_sufficient": (
+                f"stake {current} below required {required} "
+                "(slashed or reclaimed?)"
+            ),
+            "whitelisted": "provider whitelist revoked",
+            "node_registered": "compute node deregistered on-chain",
+            "in_pool": "node no longer in pool (ejected?)",
+        }
+        prev = self._chain_state
+        if not prev:
+            # first tick establishes the baseline: a worker that boots
+            # before its invite is legitimately not in a pool yet — only
+            # True -> False TRANSITIONS are drift
+            self._chain_state = state
+            return []
+        for key, msg in detail.items():
+            if prev.get(key, True) and not state[key]:
+                alarms.append(msg)
+        self._chain_state = state
+        if alarms:
+            self._record_alarms(alarms)
+        if prev.get("node_registered", True) and not state["node_registered"]:
+            # a deregistered node signing heartbeats would just be rejected
+            # by the orchestrator's validator — stop cleanly instead (the
+            # serve loop exits on this flag)
+            self.heartbeat_active = False
+            self.deregistered = True
+        return alarms
+
+    def _record_alarms(self, alarms: list[str]) -> None:
+        for a in alarms:
+            logging.getLogger(__name__).warning("worker chain alarm: %s", a)
+        self.chain_alarms.extend(alarms)
+        del self.chain_alarms[:-100]  # bounded history
 
     def _host_load(self) -> float:
         """Self-reported host utilization 0..1 (1-min loadavg over cores),
