@@ -63,14 +63,42 @@ def _storage(http):
     return None
 
 
-async def _run_app(app, port: int) -> None:
+def _client_session():
+    """aiohttp session honoring PROTOCOL_TPU_TLS_CA for HTTPS peers."""
+    import aiohttp
+
+    from protocol_tpu.utils.tls import env_client_ssl_context
+
+    ctx = env_client_ssl_context()
+    if ctx is None:
+        return aiohttp.ClientSession()
+    return aiohttp.ClientSession(connector=aiohttp.TCPConnector(ssl=ctx))
+
+
+def _server_ssl(args):
+    """TLS server context from --tls-cert/--tls-key (or TLS_CERT/TLS_KEY
+    env, the charts' secret mounts). None = plaintext, the pre-TLS
+    behavior."""
+    cert = getattr(args, "tls_cert", "") or os.environ.get("TLS_CERT", "")
+    key = getattr(args, "tls_key", "") or os.environ.get("TLS_KEY", "")
+    if not cert and not key:
+        return None
+    if not (cert and key):
+        raise SystemExit("--tls-cert and --tls-key must be given together")
+    from protocol_tpu.utils.tls import server_ssl_context
+
+    return server_ssl_context(cert, key)
+
+
+async def _run_app(app, port: int, ssl_context=None) -> None:
     from aiohttp import web
 
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, "0.0.0.0", port)
+    site = web.TCPSite(runner, "0.0.0.0", port, ssl_context=ssl_context)
     await site.start()
-    print(f"listening on :{port} (version {VERSION})", flush=True)
+    scheme = "https" if ssl_context is not None else "http"
+    print(f"listening on :{port} ({scheme}, version {VERSION})", flush=True)
 
 
 async def serve_discovery(args) -> None:
@@ -79,10 +107,8 @@ async def serve_discovery(args) -> None:
 
     resolver = None
     if args.location_url:
-        import aiohttp
-
         resolver = HttpLocationResolver(
-            args.location_url, aiohttp.ClientSession()
+            args.location_url, _client_session()
         )
     svc = DiscoveryService(
         _ledger(args),
@@ -94,7 +120,7 @@ async def serve_discovery(args) -> None:
             os.path.join(args.state_dir, "discovery.aof") if args.state_dir else None
         ),
     )
-    await _run_app(svc.make_app(), args.port)
+    await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
     while True:
         try:
             await asyncio.to_thread(svc.chain_sync_once)
@@ -121,7 +147,7 @@ async def serve_orchestrator(args) -> None:
 
     wallet = _wallet_from_env("MANAGER_KEY")
     ledger = _ledger(args)
-    session = aiohttp.ClientSession()
+    session = _client_session()
     if args.kv_url:
         # shared store pod (the reference's external Redis): api/processor
         # replicas all see the same state
@@ -260,14 +286,14 @@ async def serve_orchestrator(args) -> None:
     # orchestrator/src/main.rs + api/server.rs:202-220): api replicas serve
     # HTTP only, the processor runs the loops, full does both
     if args.mode == "api":
-        await _run_app(svc.make_app(), args.port)
+        await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
         print(f"orchestrator[api] on :{args.port} (version {VERSION})", flush=True)
     elif args.mode == "processor":
         from aiohttp import web as _web
 
         health_app = _web.Application()
         health_app.router.add_get("/health", svc.health)
-        await _run_app(health_app, args.port)
+        await _run_app(health_app, args.port, ssl_context=_server_ssl(args))
         # only the loops; the HTTP surface lives in the api replicas.
         # keep the task references — the event loop holds tasks weakly
         svc.loop_tasks = svc.start_loops()
@@ -295,7 +321,7 @@ async def serve_validator(args) -> None:
 
     wallet = _wallet_from_env("VALIDATOR_KEY")
     ledger = _ledger(args)
-    session = aiohttp.ClientSession()
+    session = _client_session()
 
     synthetic = None
     storage = _storage(session)
@@ -349,7 +375,7 @@ async def serve_validator(args) -> None:
         discovery_fetcher=fetcher if discovery_urls else None,
         http=session,
     )
-    await _run_app(svc.make_app(), args.port)
+    await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
     while True:
         try:
             await svc.validation_loop_once()
@@ -377,7 +403,7 @@ async def serve_ledger_api(args) -> None:
     svc = LedgerApiService(
         ledger, admin_api_key=os.environ.get("ADMIN_API_KEY", "admin")
     )
-    await _run_app(svc.make_app(), args.port)
+    await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -411,7 +437,7 @@ async def serve_kv_api(args) -> None:
         )
     )
     svc = KvApiService(kv, api_key=os.environ.get("KV_API_KEY", "admin"))
-    await _run_app(svc.make_app(), args.port)
+    await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
     while True:
         await asyncio.sleep(3600)
 
@@ -437,7 +463,7 @@ async def serve_worker(args) -> None:
     provider = _wallet_from_env("PROVIDER_KEY")
     node = _wallet_from_env("NODE_KEY")
     ledger = _ledger(args)
-    session = aiohttp.ClientSession()
+    session = _client_session()
     if args.advertise_ip == "auto":
         # STUN public-IP detection (reference checks/stun.rs via
         # cli/command.rs:332-339); explicit --advertise-ip skips it
@@ -499,7 +525,7 @@ async def serve_worker(args) -> None:
     agent.register_on_ledger()
     bridge = TaskBridge(args.socket_path, agent)
     await bridge.start()
-    await _run_app(agent.make_control_app(), args.port)
+    await _run_app(agent.make_control_app(), args.port, ssl_context=_server_ssl(args))
     urls = [u for u in args.discovery_urls.split(",") if u]
     await agent.upload_to_discovery(urls)
     last_monitor = 0.0
@@ -544,6 +570,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             default=int(os.environ.get("COMPUTE_POOL_ID", "-1")),
         )
         p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
+        # transport confidentiality (the reference's Noise layer,
+        # p2p/src/lib.rs:324-335): serve HTTPS when a cert pair is given;
+        # clients verify via PROTOCOL_TPU_TLS_CA
+        p.add_argument("--tls-cert", default=os.environ.get("TLS_CERT", ""))
+        p.add_argument("--tls-key", default=os.environ.get("TLS_KEY", ""))
 
     p = sub.add_parser("discovery")
     common(p)
@@ -572,6 +603,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     p = sub.add_parser("kv-api")
     p.add_argument("--port", type=int, default=8096)
     p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
+    p.add_argument("--tls-cert", default=os.environ.get("TLS_CERT", ""))
+    p.add_argument("--tls-key", default=os.environ.get("TLS_KEY", ""))
 
     p = sub.add_parser("validator")
     common(p)
@@ -585,6 +618,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     p = sub.add_parser("ledger-api")
     p.add_argument("--port", type=int, default=8095)
     p.add_argument("--state-dir", default=os.environ.get("STATE_DIR", ""))
+    p.add_argument("--tls-cert", default=os.environ.get("TLS_CERT", ""))
+    p.add_argument("--tls-key", default=os.environ.get("TLS_KEY", ""))
 
     p = sub.add_parser("worker")
     common(p)

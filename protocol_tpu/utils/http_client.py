@@ -23,6 +23,8 @@ import threading
 import urllib.parse
 from typing import Optional, Type
 
+from protocol_tpu.utils.tls import env_client_ssl_context
+
 
 class KeepAliveJsonClient:
     def __init__(
@@ -37,17 +39,26 @@ class KeepAliveJsonClient:
         self._prefix = parsed.path.rstrip("/")
         self.timeout = timeout
         self.error_cls = error_cls
+        # https peers are verified against the deployment CA
+        # (PROTOCOL_TPU_TLS_CA) or system trust — never unverified
+        self._ssl_context = env_client_ssl_context() if self._https else None
         self._tlocal = threading.local()
 
     def _connection(self):
         conn = getattr(self._tlocal, "conn", None)
         if conn is None:
-            cls = (
-                http.client.HTTPSConnection
-                if self._https
-                else http.client.HTTPConnection
-            )
-            conn = cls(self._netloc, timeout=self.timeout)
+            if self._https:
+                import ssl as _ssl
+
+                conn = http.client.HTTPSConnection(
+                    self._netloc,
+                    timeout=self.timeout,
+                    context=self._ssl_context or _ssl.create_default_context(),
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    self._netloc, timeout=self.timeout
+                )
             self._tlocal.conn = conn
         return conn
 

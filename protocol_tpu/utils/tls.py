@@ -1,0 +1,122 @@
+"""TLS for the wire: confidentiality to match the reference's transport.
+
+The reference's control RPCs ride libp2p TCP+Noise+Yamux
+(crates/p2p/src/lib.rs:324-335) — encrypted AND mutually authenticated.
+This framework's redesign keeps mutual authentication through wallet
+signatures on every request (security/signer.py), but round 2 left every
+plane plaintext HTTP: integrity without confidentiality. This module adds
+the missing half — standard TLS on every aiohttp server and keep-alive
+client, driven by cert/key paths in serve.py args and chart values.
+
+  server_ssl_context(cert, key)   for aiohttp TCPSite / kv-api
+  client_ssl_context(ca)          verify servers against a deployment CA
+                                  (PROTOCOL_TPU_TLS_CA env, or system trust)
+  generate_self_signed(dir)       dev/test PKI: a CA plus a localhost server
+                                  cert signed by it (the devnet's Noise-less
+                                  equivalent of libp2p's generated keypair)
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Optional
+
+
+def server_ssl_context(cert_path: str, key_path: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def client_ssl_context(ca_path: Optional[str] = None) -> ssl.SSLContext:
+    """Verifying client context. ``ca_path`` pins a deployment CA (the
+    normal shape for self-hosted pools); None uses system trust."""
+    if ca_path:
+        ctx = ssl.create_default_context(cafile=ca_path)
+    else:
+        ctx = ssl.create_default_context()
+    return ctx
+
+
+def env_client_ssl_context() -> Optional[ssl.SSLContext]:
+    """The ambient client context: PROTOCOL_TPU_TLS_CA names the CA file.
+    Returns None when unset (plaintext deployments stay plaintext)."""
+    ca = os.environ.get("PROTOCOL_TPU_TLS_CA", "")
+    return client_ssl_context(ca) if ca else None
+
+
+def generate_self_signed(
+    out_dir: str,
+    hostnames: Optional[list[str]] = None,
+) -> dict:
+    """Dev/test PKI: writes ca.pem, server.pem, server.key under out_dir
+    and returns their paths. The server cert covers localhost + any extra
+    hostnames/IPs."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(out_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def _name(cn: str) -> x509.Name:
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("protocol-tpu dev CA"))
+        .issuer_name(_name("protocol-tpu dev CA"))
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    srv_key = ec.generate_private_key(ec.SECP256R1())
+    sans: list[x509.GeneralName] = [
+        x509.DNSName("localhost"),
+        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+    ]
+    for h in hostnames or []:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            sans.append(x509.DNSName(h))
+    srv_cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name("localhost"))
+        .issuer_name(ca_cert.subject)
+        .public_key(srv_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = {
+        "ca": os.path.join(out_dir, "ca.pem"),
+        "cert": os.path.join(out_dir, "server.pem"),
+        "key": os.path.join(out_dir, "server.key"),
+    }
+    with open(paths["ca"], "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths["cert"], "wb") as f:
+        f.write(srv_cert.public_bytes(serialization.Encoding.PEM))
+    fd = os.open(paths["key"], os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(
+            srv_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+    return paths
