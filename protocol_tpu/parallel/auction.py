@@ -20,7 +20,7 @@ provider ids win ties exactly as in the dense kernel
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -34,25 +34,15 @@ from protocol_tpu.ops.cost import INFEASIBLE
 _NEG = -1e18
 
 
-def assign_auction_sharded(
-    cost: jax.Array,
-    mesh: Mesh,
-    eps: float = 0.01,
-    max_iters: int = 500,
-    axis: str = "p",
-) -> AssignResult:
-    """Auction with cost rows sharded over ``mesh`` axis ``axis``.
-
-    ``cost`` is [P, T] with P divisible by the mesh size. Returns a fully
-    replicated AssignResult identical (same ties) to the dense kernel.
-    """
-    Ptot, T = cost.shape
+@lru_cache(maxsize=64)
+def _build_sharded_dense_auction(
+    mesh: Mesh, axis: str, eps: float, max_iters: int
+):
+    # Cached per static config: a closure rebuilt per call would re-trace
+    # and re-compile the while_loop on every solve (see parallel/sparse.py).
     D = mesh.shape[axis]
-    if Ptot % D != 0:
-        raise ValueError(f"P={Ptot} not divisible by mesh size {D}; pad first")
 
-    cost = jax.device_put(cost, NamedSharding(mesh, P(axis, None)))
-
+    @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
@@ -61,7 +51,7 @@ def assign_auction_sharded(
         check_vma=False,
     )
     def run(cost_local: jax.Array) -> jax.Array:
-        Pl = cost_local.shape[0]
+        Pl, T = cost_local.shape
         shard = lax.axis_index(axis)
         offset = (shard * Pl).astype(jnp.int32)
 
@@ -146,5 +136,27 @@ def assign_auction_sharded(
         _, _, _, p4t = lax.while_loop(cond, body, state0)
         return p4t
 
+    return run
+
+
+def assign_auction_sharded(
+    cost: jax.Array,
+    mesh: Mesh,
+    eps: float = 0.01,
+    max_iters: int = 500,
+    axis: str = "p",
+) -> AssignResult:
+    """Auction with cost rows sharded over ``mesh`` axis ``axis``.
+
+    ``cost`` is [P, T] with P divisible by the mesh size. Returns a fully
+    replicated AssignResult identical (same ties) to the dense kernel.
+    """
+    Ptot, T = cost.shape
+    D = mesh.shape[axis]
+    if Ptot % D != 0:
+        raise ValueError(f"P={Ptot} not divisible by mesh size {D}; pad first")
+
+    cost = jax.device_put(cost, NamedSharding(mesh, P(axis, None)))
+    run = _build_sharded_dense_auction(mesh, axis, float(eps), int(max_iters))
     p4t = run(cost)
     return AssignResult(p4t, _invert(p4t, Ptot))

@@ -19,7 +19,7 @@ Parity-tested against the single-device blocked kernel on the virtual
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -37,40 +37,33 @@ from protocol_tpu.ops.cost import CostWeights
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 
 
-def sinkhorn_potentials_sharded(
-    ep: EncodedProviders,
-    er: EncodedRequirements,
+@lru_cache(maxsize=64)
+def _build_sharded_sinkhorn(
     mesh: Mesh,
-    weights: CostWeights | None = None,
-    eps: float = 0.05,
-    num_iters: int = 50,
-    tile: int = 1024,
-    axis: str = "p",
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (u [P] provider-sharded-then-gathered, v [T] replicated)."""
-    if weights is None:
-        weights = CostWeights()
-    Pn = ep.gpu_count.shape[0]
-    T = er.cpu_cores.shape[0]
-    D = mesh.shape[axis]
-    if Pn % D != 0:
-        raise ValueError(f"P={Pn} not divisible by mesh size {D}; pad first")
-    if T % tile != 0:
-        raise ValueError(f"T={T} not divisible by tile={tile}; pad requirements")
+    axis: str,
+    weights_key: tuple,
+    eps: float,
+    num_iters: int,
+    tile: int,
+    T: int,
+):
+    # Cached per static config: a closure rebuilt per call would re-trace
+    # and re-compile the fori_loop on every solve (see parallel/sparse.py).
+    # ``er`` is a replicated ARGUMENT (not a capture) so data churn does
+    # not invalidate the cache.
+    weights = CostWeights(*weights_key)
     n_tiles = T // tile
     starts = jnp.arange(n_tiles, dtype=jnp.int32) * tile
 
-    shard_p = NamedSharding(mesh, P(axis))
-    ep = jax.tree.map(lambda x: jax.device_put(x, shard_p), ep)
-
+    @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis),),
+        in_specs=(P(axis), P()),
         out_specs=(P(axis), P()),
         check_vma=False,
     )
-    def run(ep_local: EncodedProviders):
+    def run(ep_local: EncodedProviders, er: EncodedRequirements):
         Pl = ep_local.gpu_count.shape[0]
 
         # shared streamed-kernel helpers (ops/blocked.py): bit-identical
@@ -120,4 +113,40 @@ def sinkhorn_potentials_sharded(
         v0 = jnp.zeros(T, jnp.float32)
         return lax.fori_loop(0, num_iters, iteration, (u0, v0))
 
-    return run(ep)
+    return run
+
+
+def sinkhorn_potentials_sharded(
+    ep: EncodedProviders,
+    er: EncodedRequirements,
+    mesh: Mesh,
+    weights: CostWeights | None = None,
+    eps: float = 0.05,
+    num_iters: int = 50,
+    tile: int = 1024,
+    axis: str = "p",
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (u [P] provider-sharded-then-gathered, v [T] replicated)."""
+    if weights is None:
+        weights = CostWeights()
+    Pn = ep.gpu_count.shape[0]
+    T = er.cpu_cores.shape[0]
+    D = mesh.shape[axis]
+    if Pn % D != 0:
+        raise ValueError(f"P={Pn} not divisible by mesh size {D}; pad first")
+    if T % tile != 0:
+        raise ValueError(f"T={T} not divisible by tile={tile}; pad requirements")
+
+    shard_p = NamedSharding(mesh, P(axis))
+    ep = jax.tree.map(lambda x: jax.device_put(x, shard_p), ep)
+
+    weights_key = (
+        float(weights.price),
+        float(weights.load),
+        float(weights.proximity),
+        float(weights.priority),
+    )
+    run = _build_sharded_sinkhorn(
+        mesh, axis, weights_key, float(eps), int(num_iters), int(tile), T
+    )
+    return run(ep, er)
