@@ -27,9 +27,17 @@ def _print(data) -> None:
     print(json.dumps(data, indent=2, default=str))
 
 
+def _session() -> aiohttp.ClientSession:
+    """Admin client honoring PROTOCOL_TPU_TLS_CA like serve.py's services —
+    otherwise a TLS-enabled deployment has no CLI that can reach it."""
+    from protocol_tpu.utils.tls import env_client_session
+
+    return env_client_session()
+
+
 async def ledger_call(args, kind: str, op: str, params: dict):
     headers = {"Authorization": f"Bearer {args.api_key}"} if kind == "write" else {}
-    async with aiohttp.ClientSession() as session:
+    async with _session() as session:
         async with session.post(
             f"{args.ledger}/ledger/{kind}/{op}", json=params, headers=headers
         ) as resp:
@@ -40,7 +48,7 @@ async def ledger_call(args, kind: str, op: str, params: dict):
 
 async def orch_call(args, method: str, path: str, body=None):
     headers = {"Authorization": f"Bearer {args.api_key}"}
-    async with aiohttp.ClientSession() as session:
+    async with _session() as session:
         async with session.request(
             method, f"{args.orchestrator}{path}", json=body, headers=headers
         ) as resp:

@@ -19,6 +19,7 @@ Parity-tested against the single-device blocked kernel on the virtual
 
 from __future__ import annotations
 
+import dataclasses
 from functools import lru_cache, partial
 
 import jax
@@ -140,11 +141,10 @@ def sinkhorn_potentials_sharded(
     shard_p = NamedSharding(mesh, P(axis))
     ep = jax.tree.map(lambda x: jax.device_put(x, shard_p), ep)
 
-    weights_key = (
-        float(weights.price),
-        float(weights.load),
-        float(weights.proximity),
-        float(weights.priority),
+    # astuple carries EVERY field in declaration order: a future CostWeights
+    # field automatically reaches both the cache key and the rebuilt weights
+    weights_key = tuple(
+        float(v) for v in dataclasses.astuple(weights)
     )
     run = _build_sharded_sinkhorn(
         mesh, axis, weights_key, float(eps), int(num_iters), int(tile), T

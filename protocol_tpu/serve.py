@@ -46,13 +46,16 @@ def _ledger(args):
     )
 
 
-def _storage(http):
+def _storage():
     creds = os.environ.get("S3_CREDENTIALS", "")
     bucket = os.environ.get("BUCKET_NAME", "")
     if creds and bucket:
         from protocol_tpu.utils.cloud_storage import GcsStorageProvider
+        from protocol_tpu.utils.tls import public_client_session
 
-        return GcsStorageProvider(bucket, creds, http)
+        # GCS/S3 are PUBLIC endpoints: their certs chain to system roots,
+        # not the pinned deployment CA, so they get their own session
+        return GcsStorageProvider(bucket, creds, public_client_session())
     root = os.environ.get("STORAGE_DIR", "")
     if root:
         from protocol_tpu.utils.storage import LocalDirStorageProvider
@@ -64,15 +67,17 @@ def _storage(http):
 
 
 def _client_session():
-    """aiohttp session honoring PROTOCOL_TPU_TLS_CA for HTTPS peers."""
-    import aiohttp
+    """aiohttp session honoring PROTOCOL_TPU_TLS_CA for internal peers."""
+    from protocol_tpu.utils.tls import env_client_session
 
-    from protocol_tpu.utils.tls import env_client_ssl_context
+    return env_client_session()
 
-    ctx = env_client_ssl_context()
-    if ctx is None:
-        return aiohttp.ClientSession()
-    return aiohttp.ClientSession(connector=aiohttp.TCPConnector(ssl=ctx))
+
+def _public_session():
+    """System-trust session for public endpoints (signed-URL storage)."""
+    from protocol_tpu.utils.tls import public_client_session
+
+    return public_client_session()
 
 
 def _server_ssl(args):
@@ -107,8 +112,12 @@ async def serve_discovery(args) -> None:
 
     resolver = None
     if args.location_url:
+        # geolocation is an external endpoint (reference location-service
+        # shape): system trust, not the pinned CA. Self-hosting it behind
+        # the deployment CA? Add that CA to the container's system trust
+        # store (standard CA-bundle mount).
         resolver = HttpLocationResolver(
-            args.location_url, _client_session()
+            args.location_url, _public_session()
         )
     svc = DiscoveryService(
         _ledger(args),
@@ -131,8 +140,6 @@ async def serve_discovery(args) -> None:
 
 
 async def serve_orchestrator(args) -> None:
-    import aiohttp
-
     from protocol_tpu.models.node import DiscoveryNode
     from protocol_tpu.security import sign_request
     from protocol_tpu.sched import Scheduler
@@ -270,11 +277,17 @@ async def serve_orchestrator(args) -> None:
         store=store,
         scheduler=scheduler,
         groups_plugin=groups_plugin,
-        storage=_storage(session),
+        storage=_storage(),
         discovery_fetcher=discovery_fetcher if discovery_urls else None,
         invite_sender=invite_sender,
         admin_api_key=os.environ.get("ADMIN_API_KEY", "admin"),
-        heartbeat_url=os.environ.get("HEARTBEAT_URL", f"http://localhost:{args.port}"),
+        # default scheme follows the listener: an https listener behind an
+        # http:// invite URL is unreachable to every worker dial
+        heartbeat_url=os.environ.get(
+            "HEARTBEAT_URL",
+            f"{'https' if _server_ssl(args) is not None else 'http'}"
+            f"://localhost:{args.port}",
+        ),
         uploads_per_hour=int(os.environ.get("UPLOADS_PER_HOUR", "3")),
         control_http=session,
         webhook=webhook,
@@ -309,8 +322,6 @@ async def serve_orchestrator(args) -> None:
 
 
 async def serve_validator(args) -> None:
-    import aiohttp
-
     from protocol_tpu.models.node import DiscoveryNode
     from protocol_tpu.security import sign_request
     from protocol_tpu.services.validator import (
@@ -324,13 +335,20 @@ async def serve_validator(args) -> None:
     session = _client_session()
 
     synthetic = None
-    storage = _storage(session)
     toploc_configs = os.environ.get("TOPLOC_CONFIGS", "")
+    # storage built lazily: _storage() opens its own public session for GCS,
+    # which must not sit idle (and unclosed) when toploc is unconfigured
+    storage = _storage() if toploc_configs else None
     if toploc_configs and storage is not None:
+        # toploc is an EXTERNAL verification service (bearer-auth HTTPS like
+        # the reference's toploc API): system trust, not the pinned CA.
+        # Self-hosting it behind the deployment CA? Add that CA to the
+        # container's system trust store (standard CA-bundle mount).
+        toploc_session = _public_session()
         clients = [
             ToplocClient(
                 c["url"],
-                session,
+                toploc_session,
                 auth_token=c.get("auth_token"),
                 file_prefix_filter=c.get("file_prefix_filter"),
             )
@@ -452,8 +470,6 @@ def serve_scheduler(args) -> None:
 
 
 async def serve_worker(args) -> None:
-    import aiohttp
-
     from protocol_tpu.services.worker import (
         SubprocessRuntime,
         TaskBridge,
@@ -509,6 +525,7 @@ async def serve_worker(args) -> None:
         from protocol_tpu.utils.ipfs import IpfsMirror
 
         ipfs = IpfsMirror(os.environ["IPFS_API_URL"], http=session)
+    server_ssl = _server_ssl(args)
     agent = WorkerAgent(
         provider,
         node,
@@ -521,11 +538,16 @@ async def serve_worker(args) -> None:
         http=session,
         ipfs=ipfs,
         price=args.price,
+        # advertise the scheme the control app actually serves: an https
+        # listener behind an http:// discovery record is unreachable to
+        # every orchestrator/validator dial
+        control_scheme="https" if server_ssl is not None else "http",
+        public_http="lazy",
     )
     agent.register_on_ledger()
     bridge = TaskBridge(args.socket_path, agent)
     await bridge.start()
-    await _run_app(agent.make_control_app(), args.port, ssl_context=_server_ssl(args))
+    await _run_app(agent.make_control_app(), args.port, ssl_context=server_ssl)
     urls = [u for u in args.discovery_urls.split(",") if u]
     await agent.upload_to_discovery(urls)
     last_monitor = 0.0

@@ -390,11 +390,20 @@ class WorkerAgent:
         auto_recover: bool = True,
         ipfs=None,  # utils.ipfs.IpfsMirror: best-effort artifact mirroring
         price: Optional[float] = None,
+        control_scheme: str = "http",  # "https" when the control app serves TLS
+        public_http=None,  # session for EXTERNAL signed-URL PUTs (GCS/S3).
+        # None = reuse ``http`` (tests, plaintext devnets); "lazy" = build a
+        # system-trust session on first external PUT (serve.py) so a pinned
+        # deployment CA can't break GCS uploads and a worker that never
+        # uploads never holds the extra session
     ):
         self.ipfs = ipfs
         # advertised ask price (cost units/hour), carried through discovery
         # into the orchestrator's batch-matcher cost term
         self.price = price
+        if control_scheme not in ("http", "https"):
+            raise ValueError(f"control_scheme must be http/https, got {control_scheme!r}")
+        self.control_scheme = control_scheme
         self.provider_wallet = provider_wallet
         self.node_wallet = node_wallet
         self.ledger = ledger
@@ -404,6 +413,7 @@ class WorkerAgent:
         self.ip_address = ip_address
         self.port = port
         self.http = http
+        self.public_http = public_http
         self.kv = KVStore()
         self.metrics: dict[tuple[str, str], float] = {}
         self.orchestrator_url: Optional[str] = None
@@ -473,7 +483,9 @@ class WorkerAgent:
             compute_pool_id=self.pool_id,
             compute_specs=self.compute_specs,
             worker_p2p_id=self.p2p_id,
-            worker_p2p_addresses=[f"http://{self.ip_address}:{self.port}/control"],
+            worker_p2p_addresses=[
+                f"{self.control_scheme}://{self.ip_address}:{self.port}/control"
+            ],
             price=self.price,
         )
         return node.to_dict()
@@ -710,7 +722,9 @@ class WorkerAgent:
             "version": "0.1.0",
             "timestamp": time.time(),
             "p2p_id": self.p2p_id,
-            "p2p_addresses": [f"http://{self.ip_address}:{self.port}/control"],
+            "p2p_addresses": [
+                f"{self.control_scheme}://{self.ip_address}:{self.port}/control"
+            ],
             "task_details": details.to_dict() if details else None,
             "load": self._host_load(),
         }
@@ -736,6 +750,20 @@ class WorkerAgent:
         return new_task
 
     # ----- bridge output -> upload + work submission -----
+
+    def _upload_session(self, url: str):
+        """Pick the trust root by the signed URL's DESTINATION: an
+        orchestrator-origin URL (LocalDirStorageProvider's /storage/upload
+        route) is a control-plane peer behind the pinned CA, while GCS/S3
+        signed URLs are public hosts under system trust — one session
+        cannot verify both."""
+        if self.orchestrator_url and url.startswith(self.orchestrator_url):
+            return self.http
+        if self.public_http == "lazy":
+            from protocol_tpu.utils.tls import public_client_session
+
+            self.public_http = public_client_session()
+        return self.public_http if self.public_http is not None else self.http
 
     async def submit_output(
         self,
@@ -784,7 +812,7 @@ class WorkerAgent:
                             )
                         url = (await resp.json())["data"]["signed_url"]
                     if data is not None:
-                        async with self.http.put(
+                        async with self._upload_session(url).put(
                             url,
                             data=data,
                             headers={"Content-Length": str(len(data))},

@@ -32,13 +32,15 @@ def server_ssl_context(cert_path: str, key_path: str) -> ssl.SSLContext:
 
 
 def client_ssl_context(ca_path: Optional[str] = None) -> ssl.SSLContext:
-    """Verifying client context. ``ca_path`` pins a deployment CA (the
-    normal shape for self-hosted pools); None uses system trust."""
+    """Verifying client context. ``ca_path`` PINS a deployment CA — it
+    REPLACES system trust, so only certs chaining to the operator's CA are
+    accepted on the control plane (any public CA being able to mint an
+    accepted cert would defeat pinning). None uses system trust. Public
+    endpoints (GCS/S3 signed URLs, geolocation) must use a SEPARATE
+    system-trust session — see public_client_session()."""
     if ca_path:
-        ctx = ssl.create_default_context(cafile=ca_path)
-    else:
-        ctx = ssl.create_default_context()
-    return ctx
+        return ssl.create_default_context(cafile=ca_path)
+    return ssl.create_default_context()
 
 
 def env_client_ssl_context() -> Optional[ssl.SSLContext]:
@@ -46,6 +48,30 @@ def env_client_ssl_context() -> Optional[ssl.SSLContext]:
     Returns None when unset (plaintext deployments stay plaintext)."""
     ca = os.environ.get("PROTOCOL_TPU_TLS_CA", "")
     return client_ssl_context(ca) if ca else None
+
+
+def env_client_session():
+    """aiohttp session for INTERNAL peers (discovery/orchestrator/worker/
+    validator/ledger/kv): verifies against the pinned deployment CA when
+    PROTOCOL_TPU_TLS_CA is set. The single construction point for the
+    control plane's client transport (serve.py services and the operator
+    CLI both use it)."""
+    import aiohttp
+
+    ctx = env_client_ssl_context()
+    if ctx is None:
+        return aiohttp.ClientSession()
+    return aiohttp.ClientSession(connector=aiohttp.TCPConnector(ssl=ctx))
+
+
+def public_client_session():
+    """aiohttp session for PUBLIC endpoints (GCS/S3 signed URLs,
+    geolocation): always system trust, never the pinned deployment CA —
+    pinning would break public hosts, and mixing the two trust roots in
+    one context would let any public CA reach the control plane."""
+    import aiohttp
+
+    return aiohttp.ClientSession()
 
 
 def generate_self_signed(

@@ -155,3 +155,129 @@ class TestSignedTlsHop:
             await runner.cleanup()
 
         asyncio.run(run())
+
+
+class TestReviewRegressions:
+    def test_pinned_ca_replaces_system_trust(self, pki):
+        """Pinning must be exclusive: if system roots stayed loaded, any
+        public CA could mint a cert the control plane accepts, defeating
+        the pin. Public endpoints use public_client_session() instead."""
+        pinned = client_ssl_context(pki["ca"]).cert_store_stats()["x509_ca"]
+        assert pinned == 1
+
+    def test_public_session_ignores_pinned_ca(self, pki, monkeypatch):
+        """GCS/S3/geolocation sessions must keep system trust even when a
+        deployment CA is pinned, or every public HTTPS call fails."""
+        import asyncio as _asyncio
+
+        from protocol_tpu.utils.tls import (
+            env_client_session,
+            public_client_session,
+        )
+
+        async def run():
+            monkeypatch.setenv("PROTOCOL_TPU_TLS_CA", pki["ca"])
+            internal, public = env_client_session(), public_client_session()
+            try:
+                assert isinstance(internal.connector._ssl, ssl.SSLContext)
+                assert not isinstance(
+                    getattr(public.connector, "_ssl", None), ssl.SSLContext
+                )
+            finally:
+                await internal.close()
+                await public.close()
+
+        _asyncio.run(run())
+
+    def test_worker_advertises_control_scheme(self):
+        """A TLS-serving worker must advertise https:// control URLs, or
+        every orchestrator/validator dial fails at the handshake."""
+        from protocol_tpu.chain.ledger import Ledger
+        from protocol_tpu.models import ComputeSpecs, CpuSpecs
+        from protocol_tpu.security.wallet import Wallet
+        from protocol_tpu.services.worker import WorkerAgent
+
+        def make(scheme):
+            return WorkerAgent(
+                provider_wallet=Wallet.from_seed(b"p"),
+                node_wallet=Wallet.from_seed(b"n"),
+                ledger=Ledger(),
+                pool_id=0,
+                compute_specs=ComputeSpecs(
+                    cpu=CpuSpecs(cores=8), ram_mb=16384, storage_gb=100
+                ),
+                control_scheme=scheme,
+            )
+
+        plain = make("http").discovery_node_payload()
+        tls = make("https").discovery_node_payload()
+        assert plain["worker_p2p_addresses"][0].startswith("http://")
+        assert tls["worker_p2p_addresses"][0].startswith("https://")
+        with pytest.raises(ValueError):
+            make("h2")
+
+    def test_cli_session_honors_tls_ca(self, pki, monkeypatch):
+        """The operator CLI must be able to reach TLS-enabled admin
+        endpoints via PROTOCOL_TPU_TLS_CA."""
+        import asyncio as _asyncio
+
+        from protocol_tpu.cli import _session
+
+        async def run():
+            monkeypatch.setenv("PROTOCOL_TPU_TLS_CA", pki["ca"])
+            s = _session()
+            try:
+                ctx = s.connector._ssl
+                assert isinstance(ctx, ssl.SSLContext)
+            finally:
+                await s.close()
+            monkeypatch.delenv("PROTOCOL_TPU_TLS_CA")
+            s2 = _session()
+            try:
+                assert not isinstance(
+                    getattr(s2.connector, "_ssl", None), ssl.SSLContext
+                )
+            finally:
+                await s2.close()
+
+        _asyncio.run(run())
+
+    def test_worker_upload_session_routing(self):
+        """Signed-URL PUTs pick the trust root by destination: orchestrator
+        -origin URLs (LocalDir storage route) use the pinned control-plane
+        session; external URLs use the public (system-trust) session."""
+        from protocol_tpu.chain.ledger import Ledger
+        from protocol_tpu.models import ComputeSpecs, CpuSpecs
+        from protocol_tpu.security.wallet import Wallet
+        from protocol_tpu.services.worker import WorkerAgent
+
+        internal = object()
+        agent = WorkerAgent(
+            provider_wallet=Wallet.from_seed(b"p"),
+            node_wallet=Wallet.from_seed(b"n"),
+            ledger=Ledger(),
+            pool_id=0,
+            compute_specs=ComputeSpecs(
+                cpu=CpuSpecs(cores=8), ram_mb=16384, storage_gb=100
+            ),
+            http=internal,
+        )
+        agent.orchestrator_url = "https://orch:8090"
+        # orchestrator-origin -> control-plane session
+        assert agent._upload_session(
+            "https://orch:8090/storage/upload/x"
+        ) is internal
+        # external, no public session configured -> falls back to http
+        # (tests / plaintext devnets)
+        assert agent._upload_session(
+            "https://storage.googleapis.com/b/o"
+        ) is internal
+        # external with an injected public session -> uses it
+        public = object()
+        agent.public_http = public
+        assert agent._upload_session(
+            "https://storage.googleapis.com/b/o"
+        ) is public
+        assert agent._upload_session(
+            "https://orch:8090/storage/upload/x"
+        ) is internal
