@@ -115,6 +115,22 @@ def tpu_match(ep: EncodedProviders, er: EncodedRequirements):
     return res.provider_for_task, res.num_assigned()
 
 
+def salt_providers(ep: EncodedProviders, salt) -> EncodedProviders:
+    """Identity-bust one input leaf with a zero-valued on-device add.
+
+    The axon remote-TPU client MEMOIZES executions keyed on (executable,
+    input buffer identities) and replays the cached result without running
+    anything — measured 0.0 ms for repeat same-buffer calls vs real wall
+    for salted ones. A per-iteration distinct salt forces a fresh buffer
+    identity (values are bit-identical: + salt*0.0), so every timed
+    iteration is a REAL on-chip execution. Host-side uploads are
+    content-deduplicated too, so re-device_putting identical bytes does
+    NOT bust the cache — the add must happen on device."""
+    import dataclasses
+
+    return dataclasses.replace(ep, price=ep.price + jnp.float32(salt) * 0.0)
+
+
 def cpu_greedy_baseline(cost: np.ndarray) -> tuple[np.ndarray, float]:
     """Reference-equivalent greedy: each task in arrival order takes the
     cheapest free compatible provider."""
@@ -262,9 +278,14 @@ def main() -> None:
 
     iters = 5
     t0 = time.perf_counter()
-    for _ in range(iters):
-        p4t, na = tpu_match(ep, er)
-    jax.block_until_ready((p4t, na))
+    for i in range(iters):
+        # distinct salt per iteration: without it the axon client replays
+        # memoized results and the "measurement" times nothing (see
+        # salt_providers). int(na) is the completion barrier: the axon
+        # client defers execution, and block_until_ready returns without
+        # running anything — only a value readback forces the solve.
+        p4t, na = tpu_match(salt_providers(ep, i + 1), er)
+        n_assigned = int(na)
     tpu_time = (time.perf_counter() - t0) / iters
     log(f"tpu full-match wall: {tpu_time * 1e3:.1f} ms  ({n_assigned / tpu_time:,.0f} assignments/s)")
 

@@ -82,14 +82,35 @@ def main() -> None:
 
     rows: list[dict] = []
 
-    def measure(fn, *a, warmup=1, iters=3, **kw):
+    # Identity-bust helper: the axon remote-TPU client memoizes executions
+    # on (executable, input buffer ids) AND content-dedups uploads, so
+    # repeat calls on the same (or re-uploaded identical) inputs replay
+    # cached results in ~0 ms. Every timed lambda takes a per-call salt and
+    # must thread it into one input via `x + salt * 0` ON DEVICE so each
+    # iteration is a real execution (values stay bit-identical).
+    _salt_counter = [0]
+
+    def _force(out):
+        # The axon client defers work: block_until_ready alone returns
+        # without executing (measured 0.000 s for full 2000-round solves).
+        # A SCALAR readback of the result is the only reliable completion
+        # barrier — device-side slice first so only bytes, not the tensor,
+        # cross the tunnel (large readbacks hang).
+        leaf = jnp.ravel(jax.tree.leaves(out)[0])[:1]
+        jax.device_get(leaf)
+
+    def measure(fn, warmup=1, iters=3):
+        # the salt is passed with a DISTINCT value (content-dedup would
+        # collapse identical 0.0 uploads); lambdas neutralize it on device
+        # via `x + z * 0`
         for _ in range(warmup):
-            out = fn(*a, **kw)
-            jax.block_until_ready(out)
+            _salt_counter[0] += 1
+            _force(fn(jnp.float32(_salt_counter[0])))
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = fn(*a, **kw)
-            jax.block_until_ready(out)
+            _salt_counter[0] += 1
+            out = fn(jnp.float32(_salt_counter[0]))
+            _force(out)
         return (time.perf_counter() - t0) / iters, out
 
     # ---------------- stage A: candidate generation ----------------
@@ -97,8 +118,12 @@ def main() -> None:
     ep_np, er_np = bench.synth_providers(rng, P_MEAS), bench.synth_requirements(
         rng, T_MEAS
     )
+    ep_dev = jax.tree.map(jnp.asarray, ep_np)
+    er_dev = jax.tree.map(jnp.asarray, er_np)
     secs, (cand_p, cand_c) = measure(
-        lambda: candidates_topk(ep_np, er_np, weights, k=K, tile=TILE)
+        lambda z: candidates_topk(
+            bench.salt_providers(ep_dev, z), er_dev, weights, k=K, tile=TILE
+        )
     )
     cells = P_MEAS * T_MEAS
     rows.append(
@@ -167,8 +192,8 @@ def main() -> None:
     cp, cc = candidates_topk(epb, erb, weights, k=K, tile=TILE)
     jax.block_until_ready((cp, cc))
     secs_b, res = measure(
-        lambda: assign_auction_sparse(
-            cp, cc, num_providers=P_B, eps=0.05, max_iters=2000,
+        lambda z: assign_auction_sparse(
+            cp, cc + z * 0, num_providers=P_B, eps=0.05, max_iters=2000,
             frontier=min(T_AUCTION, 8192), retire=True,
         ).provider_for_task
     )
@@ -190,8 +215,8 @@ def main() -> None:
     log(f"stage B: mesh-sharded auction over {n_dev} devices")
     mesh = make_mesh(n_dev)
     secs_s, res_s = measure(
-        lambda: assign_auction_sparse_sharded(
-            cp, cc, num_providers=P_B, mesh=mesh,
+        lambda z: assign_auction_sparse_sharded(
+            cp, cc + z * 0, num_providers=P_B, mesh=mesh,
             eps=0.05, max_iters=2000, frontier=min(T_AUCTION, 8192),
             retire=True,
         ).provider_for_task
@@ -245,8 +270,8 @@ def main() -> None:
 
     log(f"stage C: warm vs cold sparse solve T={T_AUCTION} K={K}")
     secs_cold, out_cold = measure(
-        lambda: assign_auction_sparse_scaled(
-            cp, cc, num_providers=P_B, frontier=min(T_AUCTION, 8192),
+        lambda z: assign_auction_sparse_scaled(
+            cp, cc + z * 0, num_providers=P_B, frontier=min(T_AUCTION, 8192),
             with_prices=True,
         )
     )
@@ -257,8 +282,8 @@ def main() -> None:
     n_churn = max(T_AUCTION // 100, 1)
     p4t0 = p4t0.at[:n_churn].set(-1)
     secs_warm, _ = measure(
-        lambda: assign_auction_sparse_warm(
-            cp, cc, num_providers=P_B,
+        lambda z: assign_auction_sparse_warm(
+            cp, cc + z * 0, num_providers=P_B,
             price0=price_cold, p4t0=p4t0,
             frontier=min(T_AUCTION, 8192),
         )[0].provider_for_task
@@ -297,10 +322,14 @@ def main() -> None:
         -1,
     ).astype(np.int32)
     loc = rng_d.integers(0, 256, P_D).astype(np.int32)
+    cost_d_dev, demand_dev, capacity_dev = (
+        jnp.asarray(cost_d), jnp.asarray(demand), jnp.asarray(capacity)
+    )
+    anti_dev, loc_dev = jnp.asarray(anti), jnp.asarray(loc)
     secs_d, res_d = measure(
-        lambda: assign_binpack_ffd(
-            jnp.asarray(cost_d), jnp.asarray(demand), jnp.asarray(capacity),
-            anti_group=jnp.asarray(anti), loc_id=jnp.asarray(loc),
+        lambda z: assign_binpack_ffd(
+            cost_d_dev + z * 0, demand_dev, capacity_dev,
+            anti_group=anti_dev, loc_id=loc_dev,
             num_locations=256, num_groups=n_groups,
         ).provider_for_task
     )

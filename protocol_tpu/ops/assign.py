@@ -252,6 +252,11 @@ def assign_auction_scaled(
     eps, warm-starting each phase from scratch prices (simple variant; price
     warm-starting is a planned optimization). Host-side loop over a few
     phases, device-side while_loop within each."""
+    from protocol_tpu.ops.cost import with_tie_jitter
+
+    # degeneracy breaker (see ops/cost.py tie_jitter): exact ties make
+    # every open bidder target the same provider — 1 assignment/round
+    cost = with_tie_jitter(cost)
     eps = eps_start
     result = None
     while True:

@@ -62,6 +62,40 @@ def haversine_km(
     return 2.0 * EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
 
 
+def tie_jitter(
+    num_providers: int,
+    num_tasks: int,
+    provider_offset: int | jax.Array = 0,
+    task_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Deterministic hash(p, t) epsilon grid [P, T] in [0, ~1e-4).
+
+    Marketplaces have many identically-priced providers; with exact ties
+    and deterministic argmax, every open bidder targets the SAME provider
+    each auction round — one assignment per round (observed: a 400-slot
+    dense solve assigning exactly max_iters providers). Adding this to
+    feasible cells decorrelates targets while preserving any real cost
+    gap > 1e-4. Shared by candidates_topk and the dense matcher solves so
+    their tie behavior matches."""
+    p_idx = (jnp.uint32(provider_offset) + jnp.arange(num_providers, dtype=jnp.uint32))[:, None]
+    t_idx = (jnp.uint32(task_offset) + jnp.arange(num_tasks, dtype=jnp.uint32))[None, :]
+    h = p_idx * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
+    return (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
+
+
+def with_tie_jitter(cost: jax.Array) -> jax.Array:
+    """Apply :func:`tie_jitter` to the feasible cells of a dense [P, T]
+    cost matrix — the one-line form every dense auction call site uses.
+    Not folded into assign_auction itself: the sparse kernels pre-jitter
+    inside candidates_topk, and parity tests feed both sides the same
+    matrix, so jitter must be applied exactly once at the builder."""
+    return jnp.where(
+        cost < INFEASIBLE * 0.5,
+        cost + tie_jitter(cost.shape[0], cost.shape[1]),
+        cost,
+    )
+
+
 def cost_matrix(
     p: EncodedProviders,
     r: EncodedRequirements,

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from protocol_tpu.ops.assign import AssignResult, _invert
-from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix, tie_jitter
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 
 _NEG = -1e18
@@ -67,7 +67,7 @@ def frontier_bids(cand_safe, value_base, price, f_idx, f_ok, num_options: int):
     return p1, v1, v2
 
 
-@partial(jax.jit, static_argnames=("k", "tile"))
+@partial(jax.jit, static_argnames=("k", "tile", "approx_recall"))
 def candidates_topk(
     ep: EncodedProviders,
     er: EncodedRequirements,
@@ -76,6 +76,7 @@ def candidates_topk(
     tile: int = 1024,
     provider_offset: jax.Array | None = None,
     task_offset: int | jax.Array = 0,
+    approx_recall: float | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Each task's top-k cheapest compatible providers.
 
@@ -93,6 +94,16 @@ def candidates_topk(
     incremental CandidateCache) pass a persistent cursor so tasks from
     different batches stay decorrelated — identical jitter patterns would
     recreate the everyone-picks-the-same-k collapse the jitter prevents.
+
+    ``approx_recall`` switches selection from exact ``lax.top_k`` (a
+    sort-shaped reduction that dominates wall-clock at large P on TPU —
+    measured 1.41 Gcells/s at P=131k, SCALING.md) to ``lax.approx_max_k``
+    (XLA's TPU-native PartialReduce; expected severalfold faster, on-chip
+    measurement pending) with the given per-row recall target. A missed
+    candidate only perturbs WHICH near-tied provider a task may match —
+    the same degeneracy the tie jitter above already randomizes — so
+    matching quality is insensitive to recall ~0.95 for marketplace
+    shapes. Deterministic for fixed inputs either way.
     """
     if weights is None:
         weights = CostWeights()
@@ -103,21 +114,15 @@ def candidates_topk(
     k = min(k, int(ep.gpu_count.shape[0]))  # lax.top_k requires k <= P
 
     P = ep.gpu_count.shape[0]
-    p_idx = jnp.arange(P, dtype=jnp.uint32)
 
     def step(carry, t0):
         r_tile = _slice_requirements(er, t0, tile)
         cost, _mask = cost_matrix(ep, r_tile, weights)  # [P, tile]
         # Degeneracy breaker: marketplaces have many identically-priced
         # providers; without jitter every task's top-k is the SAME k
-        # providers, capping the matching at k regardless of supply. A tiny
-        # deterministic hash(p, t) epsilon decorrelates candidate sets while
-        # preserving any real cost gap > 1e-4.
-        t_idx = (
-            t0 + jnp.uint32(task_offset) + jnp.arange(tile, dtype=jnp.uint32)
-        )[None, :]
-        h = p_idx[:, None] * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
-        jitter = (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
+        # providers, capping the matching at k regardless of supply (see
+        # ops/cost.py tie_jitter).
+        jitter = tie_jitter(P, tile, task_offset=t0 + jnp.uint32(task_offset))
         cost = jnp.where(cost < INFEASIBLE * 0.5, cost + jitter, cost)
         if provider_offset is None:
             selection = cost
@@ -125,7 +130,12 @@ def candidates_topk(
             selection = jnp.where(
                 cost < INFEASIBLE * 0.5, cost + provider_offset[:, None], cost
             )
-        neg_sel, idx = lax.top_k(-selection.T, k)  # [tile, k] best first
+        if approx_recall is None:
+            neg_sel, idx = lax.top_k(-selection.T, k)  # [tile, k] best first
+        else:
+            neg_sel, idx = lax.approx_max_k(
+                -selection.T, k, recall_target=approx_recall
+            )
         cost_k = jnp.take_along_axis(cost.T, idx, axis=1)  # true costs
         sel_k = -neg_sel
         provider = jnp.where(sel_k < INFEASIBLE * 0.5, idx.astype(jnp.int32), -1)
@@ -180,7 +190,7 @@ def assign_auction_sparse(
 
 @partial(
     jax.jit,
-    static_argnames=("num_providers", "max_iters", "frontier", "retire"),
+    static_argnames=("num_providers", "max_iters", "frontier", "retire", "stall_limit"),
 )
 def _sparse_auction_phase(
     cand_provider: jax.Array,
@@ -191,9 +201,18 @@ def _sparse_auction_phase(
     max_iters: int = 10000,
     frontier: int = 4096,
     retire: bool = True,
+    stall_limit: int = 0,
 ):
     """One eps phase of the frontier auction; ``state`` carries
-    (it, price, owner, p4t, retired) across phases for warm starts."""
+    (it, price, owner, p4t, retired) across phases for warm starts.
+
+    ``stall_limit`` > 0 additionally ends the phase after that many
+    consecutive rounds with NO NET assignment progress. Per-task
+    retirement cannot stop an unfillable tail: the open "hole" wanders
+    the graph through eviction chains, so no single neighborhood's prices
+    ever reach give_up (measured: 4000/4000 rounds with one open task).
+    A stalled phase is pure price circulation — the scaled ladder hands
+    the leftovers to the next phase / greedy cleanup instead."""
     T, K = cand_cost.shape
     P = num_providers
     B = min(frontier, T)
@@ -205,11 +224,15 @@ def _sparse_auction_phase(
     finite_max = jnp.max(jnp.where(cand_valid, cand_cost, 0.0))
     give_up = -(2.0 * finite_max + 10.0) if retire else _NEG
 
-    def cond(state):
-        it, price, owner, p4t, retired = state
-        return (it < max_iters) & jnp.any((p4t < 0) & task_feasible & ~retired)
+    def cond(loop):
+        (it, price, owner, p4t, retired), best, stall = loop
+        go = (it < max_iters) & jnp.any((p4t < 0) & task_feasible & ~retired)
+        if stall_limit > 0:
+            go &= stall < stall_limit
+        return go
 
-    def body(state):
+    def body(loop):
+        state, best, stall = loop
         it, price, owner, p4t, retired = state
         open_mask = (p4t < 0) & task_feasible & ~retired  # [T]
 
@@ -242,7 +265,11 @@ def _sparse_auction_phase(
         p4t = p4t.at[win_t_safe].set(jnp.where(got_bid, p_idx, -1), mode="drop")
         owner = jnp.where(got_bid, win_task, owner)
         price = jnp.where(got_bid, win_bid, price)
-        return it + 1, price, owner, p4t, retired
+        n_now = jnp.sum(p4t >= 0)
+        improved = n_now > best
+        best = jnp.maximum(best, n_now)
+        stall = jnp.where(improved, 0, stall + 1)
+        return (it + 1, price, owner, p4t, retired), best, stall
 
     if state is None:
         state = (
@@ -255,7 +282,9 @@ def _sparse_auction_phase(
     else:
         # reset the iteration counter for this phase
         state = (jnp.int32(0),) + tuple(state[1:])
-    return lax.while_loop(cond, body, state)
+    loop0 = (state, jnp.sum(state[3] >= 0), jnp.int32(0))
+    out, _, _ = lax.while_loop(cond, body, loop0)
+    return out
 
 
 @jax.jit
@@ -337,15 +366,21 @@ def assign_auction_sparse_scaled(
     max_iters_per_phase: int = 4000,
     frontier: int = 4096,
     with_prices: bool = False,
+    stall_limit: int = 64,
 ):
     """eps-scaling auction: geometric eps ladder with warm-started prices
     (Bertsekas' eps-scaling — total bid events O(n log(1/eps)) instead of
     O(price_range / eps)).
 
     Phase discipline (mirrors native/assign_engine.cpp):
-      - retirement only in the FINAL phase (coarse-eps price overshoot from
-        an unfillable tail would retire viable tasks);
-      - between phases, eps-CS repair re-opens only unhappy holders;
+      - retirement runs in EVERY phase as a circuit breaker, but non-final
+        retirements are REVERSED between phases (un-retire + eps-CS
+        repair), so only the final phase's retirement is binding. Without
+        this, an unfillable tail cycles through eviction chains until
+        max_iters in every coarse phase — measured 4000/4000 rounds with
+        ONE open task (50 s/phase on CPU at T=8k) vs ~tens of rounds to
+        retire it. A viable task retired early by coarse-eps overshoot is
+        re-opened at the next (finer) phase and re-bid correctly.
       - a final greedy cleanup seats any stranded provider/task pairs.
 
     ``with_prices=True`` additionally returns the final price vector [P] —
@@ -359,7 +394,12 @@ def assign_auction_sparse_scaled(
         state = _sparse_auction_phase(
             cand_provider, cand_cost, num_providers, state,
             eps=eps, max_iters=max_iters_per_phase, frontier=frontier,
-            retire=final,
+            # the FINAL phase's retirement is binding and its eviction
+            # chains (closing eps_end-sized price gaps) legitimately make
+            # no net progress for long stretches — give it 8x the
+            # circuit-breaker budget of the disposable coarse phases
+            retire=True,
+            stall_limit=stall_limit * (8 if final else 1),
         )
         if final:
             break
@@ -368,6 +408,8 @@ def assign_auction_sparse_scaled(
         owner, p4t = _unassign_unhappy(
             cand_provider, cand_cost, price, owner, p4t, eps
         )
+        # un-retire: coarse-phase retirement was only the circuit breaker
+        retired = jnp.zeros_like(retired)
         state = (it, price, owner, p4t, retired)
 
     _, price, owner, p4t, _ = state
@@ -387,6 +429,7 @@ def assign_auction_sparse_warm(
     eps: float = 0.02,
     max_iters: int = 20000,
     frontier: int = 4096,
+    stall_limit: int = 64,
 ) -> tuple[AssignResult, jax.Array]:
     """Incremental (delta-frontier) auction solve: SURVEY §7 hard part 4.
 
@@ -438,6 +481,9 @@ def assign_auction_sparse_warm(
     state = _sparse_auction_phase(
         cand_provider, cand_cost, num_providers, state,
         eps=eps, max_iters=max_iters, frontier=frontier, retire=True,
+        # the warm solve is a binding final phase: same 8x stall budget as
+        # the scaled ladder's last phase (see assign_auction_sparse_scaled)
+        stall_limit=stall_limit * 8,
     )
     _, price, owner, p4t, _ = state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)

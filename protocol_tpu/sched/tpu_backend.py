@@ -40,7 +40,12 @@ import jax.numpy as jnp
 from protocol_tpu.models.node import ComputeRequirements
 from protocol_tpu.models.task import Task
 from protocol_tpu.ops.assign import assign_auction
-from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
+from protocol_tpu.ops.cost import (
+    INFEASIBLE,
+    CostWeights,
+    cost_matrix,
+    with_tie_jitter,
+)
 from protocol_tpu.ops.encoding import FeatureEncoder
 from protocol_tpu.ops.sparse import (
     assign_auction_sparse_scaled,
@@ -120,7 +125,13 @@ def validate_tpu_scheduler_config(task: Task) -> None:
 @jax.jit
 def _solve_bounded(ep, er, weights) -> jax.Array:
     cost, _ = cost_matrix(ep, er, weights)
-    return assign_auction(cost, eps=0.05, max_iters=300).task_for_provider
+    # with_tie_jitter: without it, identically-specced providers make every
+    # open slot bid the SAME provider each round — one assignment per
+    # round, so the solve seats exactly max_iters replicas (observed
+    # 300/400 live)
+    return assign_auction(
+        with_tie_jitter(cost), eps=0.05, max_iters=300
+    ).task_for_provider
 
 
 @jax.jit
@@ -528,7 +539,7 @@ class TpuBatchMatcher:
                 for gi, g in enumerate(groups):
                     mask[gi, s] = g.configuration_name in topos
             cost, _ = cost_matrix(ep_g, er, self.weights, mask=jnp.asarray(mask))
-            res = assign_auction(cost, eps=0.05, max_iters=300)
+            res = assign_auction(with_tie_jitter(cost), eps=0.05, max_iters=300)
             t4g = np.asarray(res.task_for_provider)[:G]
             for gi, s_idx in enumerate(t4g):
                 if 0 <= s_idx < S:

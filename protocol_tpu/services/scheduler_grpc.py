@@ -230,8 +230,14 @@ class SchedulerBackendServicer:
                     num_iters=int(request.max_iters) or 200,
                 )
             elif kernel == "auction":
+                from protocol_tpu.ops.cost import with_tie_jitter
+
+                # same degeneracy breaker as the in-process dense solve
+                # (sched/tpu_backend._solve_bounded) — identical jitter is
+                # what RemoteBatchMatcher's parity with TpuBatchMatcher
+                # rests on
                 res = assign_auction(
-                    cost,
+                    with_tie_jitter(cost),
                     eps=request.eps or 0.01,
                     max_iters=int(request.max_iters) or 500,
                 )

@@ -137,6 +137,35 @@ class TestTpuBatchMatcher:
         assert names.count("bounded") == 2
         assert names.count("swarm") == 3
 
+    def test_identical_nodes_fill_all_replicas(self):
+        """Regression: with identically-specced nodes, exact cost ties made
+        every open slot bid the SAME provider each auction round — one
+        assignment per round, so a replica bound above max_iters seated
+        exactly max_iters nodes (observed 300/400 live). tie_jitter in the
+        dense solve decorrelates the targets."""
+        ctx = StoreContext.new_test()
+        n_nodes, replicas = 450, 350  # > the solve's 300-iteration cap
+        for i in range(n_nodes):
+            ctx.node_store.add_node(
+                mk_node(f"0x{i:03d}", gpu_model="H100", gpu_count=8)
+            )
+        ctx.task_store.add_task(
+            mk_task(
+                "wide",
+                created_at=100,
+                sched_plugins={"tpu_scheduler": {"replicas": [str(replicas)]}},
+            )
+        )
+        matcher = TpuBatchMatcher(ctx)
+        matcher.refresh()
+        seated = sum(
+            1
+            for i in range(n_nodes)
+            if matcher.task_for_node(ctx.node_store.get_node(f"0x{i:03d}"))
+            is not None
+        )
+        assert seated == replicas, seated
+
     def test_dirty_on_task_change(self):
         ctx = StoreContext.new_test()
         ctx.node_store.add_node(mk_node("0xa", gpu_model="H100", gpu_count=8))
