@@ -576,6 +576,95 @@ async def serve_worker(args) -> None:
         await asyncio.sleep(10.0)
 
 
+def run_bootstrap(args) -> int:
+    """Idempotent economic bootstrap for the compose stack (the reference
+    devnet's make-compose chain setup): ensure domain 0 + pool ``pool_id``
+    exist and are started, the PROVIDER_KEY wallet is funded/whitelisted,
+    and the VALIDATOR_KEY wallet holds the validator role. Safe to re-run;
+    waits for the ledger-api pod to come up first."""
+    import time
+
+    from protocol_tpu.chain.ledger import LedgerError
+
+    creator = _wallet_from_env("POOL_CREATOR_KEY")
+    manager = _wallet_from_env("MANAGER_KEY")
+    ledger = _ledger(args)
+
+    deadline = time.monotonic() + float(os.environ.get("BOOTSTRAP_WAIT", "60"))
+    while True:
+        try:
+            ledger.balance_of(creator.address)
+            break
+        except LedgerError as e:
+            if time.monotonic() > deadline:
+                print(f"ledger-api unreachable: {e}", file=sys.stderr)
+                return 1
+            time.sleep(2.0)
+
+    def _pool_probe():
+        # "unknown pool" must not be conflated with a transport blip: a
+        # create against a ledger that already has the pool would mint a
+        # duplicate domain/pool and wire the stack to the wrong id
+        while True:
+            try:
+                return ledger.get_pool_info(args.pool_id)
+            except LedgerError as e:
+                if not str(e).startswith("unreachable"):
+                    return None
+                if time.monotonic() > deadline:
+                    raise
+
+    pool = _pool_probe()
+    if pool is None:
+        did = ledger.create_domain("compose", validation_logic="any")
+        pid = ledger.create_pool(
+            did, creator.address, manager.address,
+            os.environ.get("POOL_DATA_URI", ""),
+        )
+        if pid != args.pool_id:
+            print(
+                f"created pool {pid} but COMPUTE_POOL_ID={args.pool_id}: "
+                "the stack would point at a nonexistent pool",
+                file=sys.stderr,
+            )
+            return 1
+        ledger.start_pool(pid, creator.address)
+        print(f"created domain {did} pool {pid} (started)", flush=True)
+    else:
+        # re-run repair: a crash between create_pool and start_pool must
+        # not leave the pool PENDING forever behind the exists fast path
+        if getattr(pool.status, "name", str(pool.status)) != "ACTIVE":
+            ledger.start_pool(args.pool_id, creator.address)
+            print(f"pool {args.pool_id} existed but was not active: started", flush=True)
+        else:
+            print(f"pool {args.pool_id} active; bootstrap already ran", flush=True)
+
+    provider_key = os.environ.get("PROVIDER_KEY", "")
+    if provider_key:
+        from protocol_tpu.security import Wallet
+
+        provider = Wallet.from_hex(provider_key)
+        if ledger.balance_of(provider.address) < 1000:
+            ledger.mint(provider.address, 1_000_000)
+        if not ledger.provider_exists(provider.address):
+            # whitelisting needs a registered provider; register here so
+            # the worker's own boot sees it and just adds its node
+            ledger.register_provider(
+                provider.address, ledger.calculate_stake(1)
+            )
+        ledger.whitelist_provider(provider.address)
+        print(f"provider {provider.address} funded + whitelisted", flush=True)
+
+    validator_key = os.environ.get("VALIDATOR_KEY", "")
+    if validator_key:
+        from protocol_tpu.security import Wallet
+
+        validator = Wallet.from_hex(validator_key)
+        ledger.grant_validator_role(validator.address)
+        print(f"validator role granted to {validator.address}", flush=True)
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="protocol_tpu.serve")
     parser.add_argument("--version", action="version", version=VERSION)
@@ -643,6 +732,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     p.add_argument("--tls-cert", default=os.environ.get("TLS_CERT", ""))
     p.add_argument("--tls-key", default=os.environ.get("TLS_KEY", ""))
 
+    p = sub.add_parser(
+        "bootstrap",
+        help="idempotent dev/e2e economic bootstrap against a ledger-api "
+        "pod: domain + pool + start + provider mint/whitelist + validator "
+        "role (the compose stack's init container)",
+    )
+    common(p)
+
     p = sub.add_parser("worker")
     common(p)
     p.add_argument("--port", type=int, default=8091)
@@ -689,6 +786,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.service == "scheduler":
         serve_scheduler(args)
         return 0
+    if args.service == "bootstrap":
+        return run_bootstrap(args)
     coro = {
         "discovery": serve_discovery,
         "orchestrator": serve_orchestrator,

@@ -237,6 +237,11 @@ class OrchestratorService:
         app.router.add_get("/metrics/prometheus", self.get_prometheus)
         app.router.add_get("/health", self.health)
         app.router.add_get("/openapi.json", self.openapi)
+        # interactive explorer over the spec (reference: Swagger UI at
+        # api/server.rs:46-97; here a self-contained zero-egress page)
+        from protocol_tpu.utils.api_docs import docs_handler
+
+        app.router.add_get("/docs", docs_handler())
         return app
 
     async def openapi(self, request: web.Request) -> web.Response:
@@ -248,7 +253,7 @@ class OrchestratorService:
                 continue
             info = route.resource.get_info()
             path = info.get("path") or info.get("formatter")
-            if not path or path == "/openapi.json":
+            if not path or path in ("/openapi.json", "/docs"):
                 continue
             doc = (route.handler.__doc__ or "").strip().splitlines()
             params = [

@@ -421,6 +421,20 @@ class TestOrchestratorRoutes:
         params = doc["paths"]["/tasks/{task_id}"]["delete"]["parameters"]
         assert params[0]["name"] == "task_id"
 
+    def test_docs_page(self):
+        """Interactive explorer (the reference's Swagger UI analog,
+        api/server.rs:46-97): self-contained HTML over /openapi.json."""
+        svc, node, _ = self._svc()
+
+        async def flow():
+            async with TestClient(TestServer(svc.make_app())) as client:
+                r = await client.get("/docs")
+                return r.status, r.content_type, await r.text()
+
+        status, ctype, html = run(flow())
+        assert status == 200 and ctype == "text/html"
+        assert "openapi.json" in html and "data-send" in html
+
 
 class TestStatusFSM:
     def _world(self):
