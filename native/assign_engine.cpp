@@ -21,10 +21,15 @@
 
 #include <algorithm>
 #include <cfloat>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <queue>
 #include <vector>
+
+#if defined(__AVX512F__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -69,24 +74,498 @@ void greedy_assign(const float* cost, int32_t P, int32_t T,
   }
 }
 
+namespace {
+
+// (cost, provider) lexicographic order packed into one u64: the f32 cost
+// bits go through the standard total-order transform (sign-flip for
+// nonneg, full flip for neg) so unsigned integer comparison == (cost,
+// provider) pair comparison with ties broken by lower provider index.
+inline uint64_t pack_key(float c, int32_t p) {
+  uint32_t b;
+  std::memcpy(&b, &c, 4);
+  b ^= static_cast<uint32_t>(static_cast<int32_t>(b) >> 31) | 0x80000000u;
+  return (static_cast<uint64_t>(b) << 32) | static_cast<uint32_t>(p);
+}
+
+inline float unpack_key_cost(uint64_t key) {
+  uint32_t b = static_cast<uint32_t>(key >> 32);
+  b ^= ~static_cast<uint32_t>(static_cast<int32_t>(b) >> 31) | 0x80000000u;
+  float c;
+  std::memcpy(&c, &b, 4);
+  return c;
+}
+
+// Insert key into the sorted length-k array buf, dropping the current max
+// (caller guarantees key < buf[k-1]). Position found branchlessly.
+inline void sorted_insert(uint64_t* buf, int32_t k, uint64_t key) {
+  int32_t pos = 0;
+#if defined(__AVX512F__)
+  const __m512i vk = _mm512_set1_epi64(static_cast<long long>(key));
+  int32_t g = 0;
+  for (; g + 8 <= k; g += 8) {
+    pos += __builtin_popcount(static_cast<uint32_t>(
+        _mm512_cmplt_epu64_mask(_mm512_loadu_si512(buf + g), vk)));
+  }
+  for (; g < k; ++g) pos += buf[g] < key;
+#else
+  pos = static_cast<int32_t>(std::lower_bound(buf, buf + k, key) - buf);
+#endif
+  std::memmove(buf + pos + 1, buf + pos,
+               static_cast<size_t>(k - 1 - pos) * 8);
+  buf[pos] = key;
+}
+
+}  // namespace
+
 // Per-task top-k candidates from a dense cost matrix, jittered for
 // degenerate marketplaces. out_cand_provider/out_cand_cost: [T*k].
+//
+// Blocked for cache behavior: the matrix is [P, T] row-major, so a
+// per-task column walk strides by T (one cache line per element). Instead
+// we sweep provider rows once, visiting a tile of B tasks per pass —
+// contiguous reads — and maintain a bounded max-heap of the k cheapest
+// candidates per task in the tile. The heap root gives a fast reject:
+// jitter >= 0, so an unjittered c > root can never enter.
 void topk_candidates(const float* cost, int32_t P, int32_t T, int32_t k,
                      int32_t* out_cand_provider, float* out_cand_cost) {
   if (k > P) k = P;
-  std::vector<std::pair<float, int32_t>> row(P);
-  for (int32_t t = 0; t < T; ++t) {
-    for (int32_t p = 0; p < P; ++p) {
-      float c = cost[static_cast<int64_t>(p) * T + t];
-      if (c < kInfeasible * 0.5f) c += jitter(p, t);
-      row[p] = {c, p};
+  if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
+  const int32_t B = 2048;  // tile buffers: 2048*k*8 B = 1 MB (L2) at k=64
+  std::vector<uint64_t> bufs(static_cast<size_t>(B) * k);  // sorted keys
+  std::vector<float> root_c(B);  // worst kept cost per task (fast reject)
+  const int32_t fill = std::min(k, P);
+  for (int32_t t0 = 0; t0 < T; t0 += B) {
+    const int32_t nb = std::min(B, T - t0);
+    // Fill phase: the first k providers all enter every task's buffer.
+    for (int32_t p = 0; p < fill; ++p) {
+      const float* row = cost + static_cast<int64_t>(p) * T + t0;
+      for (int32_t i = 0; i < nb; ++i) {
+        const float c = row[i];
+        const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t0 + i) : c;
+        bufs[static_cast<size_t>(i) * k + p] = pack_key(cj, p);
+      }
     }
-    std::partial_sort(row.begin(), row.begin() + k, row.end());
+    for (int32_t i = 0; i < nb; ++i) {
+      uint64_t* buf = bufs.data() + static_cast<size_t>(i) * k;
+      std::sort(buf, buf + k);
+      root_c[i] = unpack_key_cost(buf[k - 1]);
+    }
+    for (int32_t p = fill; p < P; ++p) {
+      const float* row = cost + static_cast<int64_t>(p) * T + t0;
+      const auto consider = [&](int32_t i) {
+        const float c = row[i];
+        const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t0 + i) : c;
+        const uint64_t key = pack_key(cj, p);
+        uint64_t* buf = bufs.data() + static_cast<size_t>(i) * k;
+        if (key >= buf[k - 1]) return;
+        sorted_insert(buf, k, key);
+        root_c[i] = unpack_key_cost(buf[k - 1]);
+      };
+      int32_t i = 0;
+#if defined(__AVX512F__)
+      // 16-lane reject: jitter >= 0, so unjittered c > root can never
+      // enter the buffer; survivors (rare after warm-up) take the slow path.
+      for (; i + 16 <= nb; i += 16) {
+        const __m512 vc = _mm512_loadu_ps(row + i);
+        const __m512 vr = _mm512_loadu_ps(root_c.data() + i);
+        uint32_t m = _mm512_cmp_ps_mask(vc, vr, _CMP_LE_OQ);
+        while (m) {
+          const int32_t j = __builtin_ctz(m);
+          m &= m - 1;
+          consider(i + j);
+        }
+      }
+#endif
+      for (; i < nb; ++i) {
+        if (row[i] <= root_c[i]) consider(i);
+      }
+    }
+    // emit (buffers already sorted ascending by (cost, provider))
+    for (int32_t i = 0; i < nb; ++i) {
+      const uint64_t* buf = bufs.data() + static_cast<size_t>(i) * k;
+      const int64_t base = static_cast<int64_t>(t0 + i) * k;
+      for (int32_t j = 0; j < k; ++j) {
+        const float c = unpack_key_cost(buf[j]);
+        const bool feas = c < kInfeasible * 0.5f;
+        out_cand_provider[base + j] =
+            feas ? static_cast<int32_t>(buf[j] & 0xffffffffu) : -1;
+        out_cand_cost[base + j] = c;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fused cost + top-k: the degraded-mode hot path. Mirrors what the TPU
+// pipeline does (ops/sparse.py candidates_topk streams tiles of the cost
+// tensor without materializing [P, T]): compute each task's provider costs
+// from the encoded features (ops/encoding.py compat_mask semantics,
+// ops/cost.py cost terms) directly into an L2-resident scratch row, then
+// select the top-k via the vectorized reject + sorted-insert kernel. The
+// [P, T] tensor never exists, which is where the old fallback spent ~90%
+// of its wall-clock (XLA cost build + strided re-read).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// cephes-style asinf: |err| a few ulp on [0, 1] — candidate selection is
+// jitter-decorrelated, so last-ulp drift vs XLA's asin only perturbs exact
+// near-ties between backends, never feasibility.
+inline float asin_poly(const float x) {
+  const bool big = x > 0.5f;
+  const float xx = big ? std::sqrt((1.0f - x) * 0.5f) : x;
+  const float z = xx * xx;
+  const float p =
+      ((((4.2163199048e-2f * z + 2.4181311049e-2f) * z + 4.5470025998e-2f) * z +
+        7.4953002686e-2f) *
+           z +
+       1.6666752422e-1f) *
+          z * xx +
+      xx;
+  return big ? 1.5707963267948966f - 2.0f * p : p;
+}
+
+// Option semantics of encoding.py _ge_min/_le_max: no constraint passes;
+// a constraint on an absent (-1) spec fails.
+inline bool ge_min(int32_t spec, int32_t req) {
+  return req < 0 || (spec >= 0 && spec >= req);
+}
+inline bool le_max(int32_t spec, int32_t req) {
+  return req < 0 || (spec >= 0 && spec <= req);
+}
+
+}  // namespace
+
+// Provider features, shape [P] each (i32 / u8 / f32 as in EncodedProviders).
+struct ProviderFeatures {
+  const int32_t* gpu_count;
+  const int32_t* gpu_mem_mb;
+  const int32_t* gpu_model_id;
+  const uint8_t* has_gpu;
+  const uint8_t* has_cpu;
+  const int32_t* cpu_cores;
+  const int32_t* ram_mb;
+  const int32_t* storage_gb;
+  const float* lat;
+  const float* lon;
+  const uint8_t* has_location;
+  const float* price;
+  const float* load;
+  const uint8_t* valid;
+};
+
+// Requirement features: scalars [T]; GPU options [T*K]; model mask [T*K*W].
+struct RequirementFeatures {
+  const uint8_t* cpu_required;
+  const int32_t* cpu_cores;
+  const int32_t* ram_mb;
+  const int32_t* storage_gb;
+  const uint8_t* gpu_opt_valid;
+  const int32_t* gpu_count;
+  const int32_t* gpu_mem_min;
+  const int32_t* gpu_mem_max;
+  const int32_t* gpu_total_mem_min;
+  const int32_t* gpu_total_mem_max;
+  const uint32_t* gpu_model_mask;
+  const uint8_t* gpu_model_constrained;
+  const float* lat;
+  const float* lon;
+  const uint8_t* has_location;
+  const float* priority;
+  const uint8_t* valid;
+};
+
+void fused_topk_candidates(const ProviderFeatures* pf,
+                           const RequirementFeatures* rf, int32_t P, int32_t T,
+                           int32_t K, int32_t W, int32_t k, float w_price,
+                           float w_load, float w_proximity, float w_priority,
+                           int32_t* out_cand_provider, float* out_cand_cost) {
+  if (k > P) k = P;
+  if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
+  // Per-solve provider precomputes: base cost term + trig for the
+  // cos-product haversine form (sin^2(d/2) = (1-cos d)/2 expands into
+  // products of per-side sin/cos — no per-cell trig).
+  std::vector<float> base(P), slat(P), clat(P), slon(P), clon(P);
+  std::vector<uint8_t> ok0(P);   // scalar (cpu/ram/storage/valid) gates
+  std::vector<uint8_t> gany(P);  // any GPU option satisfied
+  std::vector<float> scratch(P);
+  for (int32_t p = 0; p < P; ++p) {
+    base[p] = w_price * pf->price[p] + w_load * pf->load[p];
+    slat[p] = std::sin(pf->lat[p]);
+    clat[p] = std::cos(pf->lat[p]);
+    slon[p] = std::sin(pf->lon[p]);
+    clon[p] = std::cos(pf->lon[p]);
+  }
+
+  std::vector<uint64_t> topbuf(k);  // sorted packed (cost, provider) keys
+
+  for (int32_t t = 0; t < T; ++t) {
+    const uint8_t t_valid = rf->valid[t];
+    const uint8_t t_cpu_req = rf->cpu_required[t];
+    const int32_t t_cores = rf->cpu_cores[t];
+    const int32_t t_ram = rf->ram_mb[t];
+    const int32_t t_storage = rf->storage_gb[t];
+    const float t_slat = std::sin(rf->lat[t]);
+    const float t_clat = std::cos(rf->lat[t]);
+    const float t_slon = std::sin(rf->lon[t]);
+    const float t_clon = std::cos(rf->lon[t]);
+    const uint8_t t_has_loc = rf->has_location[t];
+    const float prio = w_priority * rf->priority[t];
+    bool any_opt = false;
+    for (int32_t o = 0; o < K; ++o) {
+      any_opt = any_opt || rf->gpu_opt_valid[static_cast<int64_t>(t) * K + o];
+    }
+    int32_t p0 = 0;
+#if defined(__AVX512F__)
+    {
+      const __m512i neg1 = _mm512_set1_epi32(-1);
+      const __m512i zero = _mm512_setzero_si512();
+      const __m512 vinf = _mm512_set1_ps(kInfeasible);
+      for (; p0 + 16 <= P; p0 += 16) {
+        // ---- scalar AND gates (compat_mask "scalar" block)
+        __mmask16 ok = t_valid ? static_cast<__mmask16>(0xffff) : 0;
+        ok &= _mm512_cmpgt_epi32_mask(
+            _mm512_cvtepu8_epi32(_mm_loadu_si128(
+                reinterpret_cast<const __m128i*>(pf->valid + p0))),
+            zero);
+        if (t_cpu_req) {
+          __mmask16 cpu_ok = _mm512_cmpgt_epi32_mask(
+              _mm512_cvtepu8_epi32(_mm_loadu_si128(
+                  reinterpret_cast<const __m128i*>(pf->has_cpu + p0))),
+              zero);
+          if (t_cores >= 0) {
+            const __m512i cores = _mm512_loadu_si512(pf->cpu_cores + p0);
+            cpu_ok &= _mm512_cmpge_epi32_mask(cores,
+                                              _mm512_set1_epi32(t_cores)) &
+                      _mm512_cmpge_epi32_mask(cores, zero);
+          }
+          ok &= cpu_ok;
+        }
+        if (t_ram >= 0) {
+          const __m512i ram = _mm512_loadu_si512(pf->ram_mb + p0);
+          ok &= _mm512_cmpge_epi32_mask(ram, _mm512_set1_epi32(t_ram)) &
+                _mm512_cmpge_epi32_mask(ram, zero);
+        }
+        if (t_storage >= 0) {
+          const __m512i st = _mm512_loadu_si512(pf->storage_gb + p0);
+          ok &= _mm512_cmpge_epi32_mask(st, _mm512_set1_epi32(t_storage)) &
+                _mm512_cmpge_epi32_mask(st, zero);
+        }
+        // ---- GPU OR alternatives
+        if (any_opt && ok) {
+          const __m512i pc = _mm512_loadu_si512(pf->gpu_count + p0);
+          const __m512i pm = _mm512_loadu_si512(pf->gpu_mem_mb + p0);
+          const __m512i mid = _mm512_loadu_si512(pf->gpu_model_id + p0);
+          const __mmask16 pc_abs = _mm512_cmplt_epi32_mask(pc, zero);
+          const __mmask16 pm_abs = _mm512_cmplt_epi32_mask(pm, zero);
+          __mmask16 gany_m = 0;
+          for (int32_t o = 0; o < K; ++o) {
+            const int64_t tk = static_cast<int64_t>(t) * K + o;
+            if (!rf->gpu_opt_valid[tk]) continue;
+            __mmask16 om = 0xffff;
+            const int32_t rc = rf->gpu_count[tk];
+            if (rc == 0) {
+              om &= pc_abs | _mm512_cmpeq_epi32_mask(pc, zero);
+            } else if (rc > 0) {
+              om &= _mm512_cmpeq_epi32_mask(pc, _mm512_set1_epi32(rc));
+            }
+            const int32_t rmem_min = rf->gpu_mem_min[tk];
+            if (rmem_min >= 0) {
+              om &= _mm512_cmpge_epi32_mask(pm, _mm512_set1_epi32(rmem_min)) &
+                    ~pm_abs;
+            }
+            const int32_t rmem_max = rf->gpu_mem_max[tk];
+            if (rmem_max >= 0) {
+              om &= _mm512_cmple_epi32_mask(pm, _mm512_set1_epi32(rmem_max)) &
+                    ~pm_abs;
+            }
+            const int32_t rtot_min = rf->gpu_total_mem_min[tk];
+            const int32_t rtot_max = rf->gpu_total_mem_max[tk];
+            if (rtot_min >= 0 || rtot_max >= 0) {
+              const __m512i total = _mm512_mullo_epi32(pc, pm);
+              const __mmask16 no_total = pc_abs | pm_abs;
+              if (rtot_min >= 0) {
+                om &= no_total | _mm512_cmpge_epi32_mask(
+                                     total, _mm512_set1_epi32(rtot_min));
+              }
+              if (rtot_max >= 0) {
+                om &= no_total | _mm512_cmple_epi32_mask(
+                                     total, _mm512_set1_epi32(rtot_max));
+              }
+            }
+            if (rf->gpu_model_constrained[tk]) {
+              const __m512i mid0 = _mm512_max_epi32(mid, zero);
+              const __m512i word = _mm512_min_epi32(
+                  _mm512_srli_epi32(mid0, 5), _mm512_set1_epi32(W - 1));
+              const __m512i bit = _mm512_and_si512(mid0, _mm512_set1_epi32(31));
+              const __m512i words = _mm512_i32gather_epi32(
+                  word, rf->gpu_model_mask + tk * W, 4);
+              const __m512i hit = _mm512_and_si512(
+                  _mm512_srlv_epi32(words, bit), _mm512_set1_epi32(1));
+              om &= _mm512_cmpgt_epi32_mask(hit, zero) &
+                    _mm512_cmpge_epi32_mask(mid, zero);
+            }
+            gany_m |= om;
+          }
+          const __mmask16 has_gpu = _mm512_cmpgt_epi32_mask(
+              _mm512_cvtepu8_epi32(_mm_loadu_si128(
+                  reinterpret_cast<const __m128i*>(pf->has_gpu + p0))),
+              zero);
+          ok &= has_gpu & gany_m;
+        }
+        // ---- cost terms
+        __m512 c = _mm512_sub_ps(_mm512_loadu_ps(base.data() + p0),
+                                 _mm512_set1_ps(prio));
+        if (t_has_loc) {
+          const __m512 pclat = _mm512_loadu_ps(clat.data() + p0);
+          const __m512 cos_dlat = _mm512_fmadd_ps(
+              pclat, _mm512_set1_ps(t_clat),
+              _mm512_mul_ps(_mm512_loadu_ps(slat.data() + p0),
+                            _mm512_set1_ps(t_slat)));
+          const __m512 cos_dlon = _mm512_fmadd_ps(
+              _mm512_loadu_ps(clon.data() + p0), _mm512_set1_ps(t_clon),
+              _mm512_mul_ps(_mm512_loadu_ps(slon.data() + p0),
+                            _mm512_set1_ps(t_slon)));
+          const __m512 one = _mm512_set1_ps(1.0f);
+          const __m512 half = _mm512_set1_ps(0.5f);
+          __m512 a = _mm512_fmadd_ps(
+              _mm512_mul_ps(_mm512_mul_ps(pclat, _mm512_set1_ps(t_clat)),
+                            half),
+              _mm512_sub_ps(one, cos_dlon),
+              _mm512_mul_ps(half, _mm512_sub_ps(one, cos_dlat)));
+          a = _mm512_min_ps(_mm512_max_ps(a, _mm512_setzero_ps()), one);
+          // asin(sqrt(a)), cephes split at 0.5
+          const __m512 x = _mm512_sqrt_ps(a);
+          const __mmask16 big = _mm512_cmp_ps_mask(x, half, _CMP_GT_OQ);
+          const __m512 xx = _mm512_mask_blend_ps(
+              big, x,
+              _mm512_sqrt_ps(_mm512_mul_ps(_mm512_sub_ps(one, x), half)));
+          const __m512 z = _mm512_mul_ps(xx, xx);
+          __m512 poly = _mm512_set1_ps(4.2163199048e-2f);
+          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(2.4181311049e-2f));
+          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(4.5470025998e-2f));
+          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(7.4953002686e-2f));
+          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(1.6666752422e-1f));
+          const __m512 asin_small =
+              _mm512_fmadd_ps(_mm512_mul_ps(poly, z), xx, xx);
+          const __m512 asin_x = _mm512_mask_blend_ps(
+              big, asin_small,
+              _mm512_fnmadd_ps(_mm512_set1_ps(2.0f), asin_small,
+                               _mm512_set1_ps(1.5707963267948966f)));
+          const __m512 dist =
+              _mm512_mul_ps(_mm512_set1_ps(2.0f * 6371.0f), asin_x);
+          const __mmask16 ploc = _mm512_cmpgt_epi32_mask(
+              _mm512_cvtepu8_epi32(_mm_loadu_si128(
+                  reinterpret_cast<const __m128i*>(pf->has_location + p0))),
+              zero);
+          c = _mm512_mask_add_ps(
+              c, ploc, c, _mm512_mul_ps(_mm512_set1_ps(w_proximity), dist));
+        }
+        _mm512_storeu_ps(scratch.data() + p0,
+                         _mm512_mask_blend_ps(ok, vinf, c));
+      }
+    }
+#endif
+    // scalar tail (and full path on non-AVX-512 builds)
+    if (p0 < P) {
+      for (int32_t p = p0; p < P; ++p) {
+        bool ok = !t_cpu_req ||
+                  (pf->has_cpu[p] && ge_min(pf->cpu_cores[p], t_cores));
+        ok = ok && ge_min(pf->ram_mb[p], t_ram);
+        ok = ok && ge_min(pf->storage_gb[p], t_storage);
+        ok = ok && pf->valid[p] && t_valid;
+        ok0[p] = ok;
+      }
+      std::memset(gany.data() + p0, 0, P - p0);
+      for (int32_t o = 0; o < K; ++o) {
+        const int64_t tk = static_cast<int64_t>(t) * K + o;
+        if (!rf->gpu_opt_valid[tk]) continue;
+        const int32_t rc = rf->gpu_count[tk];
+        const int32_t rmem_min = rf->gpu_mem_min[tk];
+        const int32_t rmem_max = rf->gpu_mem_max[tk];
+        const int32_t rtot_min = rf->gpu_total_mem_min[tk];
+        const int32_t rtot_max = rf->gpu_total_mem_max[tk];
+        const bool constrained = rf->gpu_model_constrained[tk];
+        const uint32_t* mask = rf->gpu_model_mask + tk * W;
+        for (int32_t p = p0; p < P; ++p) {
+          const int32_t pc = pf->gpu_count[p];
+          const int32_t pm = pf->gpu_mem_mb[p];
+          const bool count_ok = rc < 0 || (pc < 0 ? rc == 0 : pc == rc);
+          const bool mem_ok = ge_min(pm, rmem_min) && le_max(pm, rmem_max);
+          const int32_t total = pc * pm;
+          const bool have_total = pc >= 0 && pm >= 0;
+          const bool tot_ok =
+              (rtot_min < 0 || !have_total || total >= rtot_min) &&
+              (rtot_max < 0 || !have_total || total <= rtot_max);
+          const int32_t mid = pf->gpu_model_id[p];
+          const int32_t mid0 = mid > 0 ? mid : 0;
+          const bool model_hit = (mask[mid0 >> 5] >> (mid0 & 31)) & 1u;
+          const bool model_ok = !constrained || (mid >= 0 && model_hit);
+          gany[p] |=
+              static_cast<uint8_t>(count_ok && mem_ok && tot_ok && model_ok);
+        }
+      }
+      for (int32_t p = p0; p < P; ++p) {
+        const bool feas =
+            ok0[p] && (!any_opt || (pf->has_gpu[p] && gany[p]));
+        float c = base[p] - prio;
+        if (t_has_loc && pf->has_location[p]) {
+          const float cos_dlat = clat[p] * t_clat + slat[p] * t_slat;
+          const float cos_dlon = clon[p] * t_clon + slon[p] * t_slon;
+          float a = 0.5f * (1.0f - cos_dlat) +
+                    clat[p] * t_clat * 0.5f * (1.0f - cos_dlon);
+          a = a < 0.0f ? 0.0f : (a > 1.0f ? 1.0f : a);
+          const float dist = 2.0f * 6371.0f * asin_poly(std::sqrt(a));
+          c += w_proximity * dist;
+        }
+        scratch[p] = feas ? c : kInfeasible;
+      }
+    }
+    // top-k select: vectorized reject + sorted insertion (same output
+    // contract as topk_candidates on a dense row)
+    uint64_t* buf = topbuf.data();
+    for (int32_t p = 0; p < k; ++p) {
+      const float c = scratch[p];
+      const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t) : c;
+      buf[p] = pack_key(cj, p);
+    }
+    std::sort(buf, buf + k);
+    float root = unpack_key_cost(buf[k - 1]);
+    int32_t p = k;
+#if defined(__AVX512F__)
+    __m512 vr = _mm512_set1_ps(root);
+    for (; p + 16 <= P; p += 16) {
+      const __m512 vc = _mm512_loadu_ps(scratch.data() + p);
+      uint32_t m = _mm512_cmp_ps_mask(vc, vr, _CMP_LE_OQ);
+      while (m) {
+        const int32_t pp = p + __builtin_ctz(m);
+        m &= m - 1;
+        const float c = scratch[pp];
+        const float cj = (c < kInfeasible * 0.5f) ? c + jitter(pp, t) : c;
+        const uint64_t key = pack_key(cj, pp);
+        if (key >= buf[k - 1]) continue;
+        sorted_insert(buf, k, key);
+        root = unpack_key_cost(buf[k - 1]);
+        vr = _mm512_set1_ps(root);
+      }
+    }
+#endif
+    for (; p < P; ++p) {
+      const float c = scratch[p];
+      if (c > root) continue;
+      const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t) : c;
+      const uint64_t key = pack_key(cj, p);
+      if (key >= buf[k - 1]) continue;
+      sorted_insert(buf, k, key);
+      root = unpack_key_cost(buf[k - 1]);
+    }
+    const int64_t out_base = static_cast<int64_t>(t) * k;
     for (int32_t j = 0; j < k; ++j) {
-      const bool feas = row[j].first < kInfeasible * 0.5f;
-      out_cand_provider[static_cast<int64_t>(t) * k + j] =
-          feas ? row[j].second : -1;
-      out_cand_cost[static_cast<int64_t>(t) * k + j] = row[j].first;
+      const float c = unpack_key_cost(buf[j]);
+      const bool feas = c < kInfeasible * 0.5f;
+      out_cand_provider[out_base + j] =
+          feas ? static_cast<int32_t>(buf[j] & 0xffffffffu) : -1;
+      out_cand_cost[out_base + j] = c;
     }
   }
 }

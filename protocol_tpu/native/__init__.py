@@ -37,6 +37,47 @@ def _build() -> None:
         raise NativeBuildError(f"native engine build failed: {detail}") from e
 
 
+class _ProviderFeatures(ctypes.Structure):
+    _fields_ = [
+        ("gpu_count", ctypes.c_void_p),
+        ("gpu_mem_mb", ctypes.c_void_p),
+        ("gpu_model_id", ctypes.c_void_p),
+        ("has_gpu", ctypes.c_void_p),
+        ("has_cpu", ctypes.c_void_p),
+        ("cpu_cores", ctypes.c_void_p),
+        ("ram_mb", ctypes.c_void_p),
+        ("storage_gb", ctypes.c_void_p),
+        ("lat", ctypes.c_void_p),
+        ("lon", ctypes.c_void_p),
+        ("has_location", ctypes.c_void_p),
+        ("price", ctypes.c_void_p),
+        ("load", ctypes.c_void_p),
+        ("valid", ctypes.c_void_p),
+    ]
+
+
+class _RequirementFeatures(ctypes.Structure):
+    _fields_ = [
+        ("cpu_required", ctypes.c_void_p),
+        ("cpu_cores", ctypes.c_void_p),
+        ("ram_mb", ctypes.c_void_p),
+        ("storage_gb", ctypes.c_void_p),
+        ("gpu_opt_valid", ctypes.c_void_p),
+        ("gpu_count", ctypes.c_void_p),
+        ("gpu_mem_min", ctypes.c_void_p),
+        ("gpu_mem_max", ctypes.c_void_p),
+        ("gpu_total_mem_min", ctypes.c_void_p),
+        ("gpu_total_mem_max", ctypes.c_void_p),
+        ("gpu_model_mask", ctypes.c_void_p),
+        ("gpu_model_constrained", ctypes.c_void_p),
+        ("lat", ctypes.c_void_p),
+        ("lon", ctypes.c_void_p),
+        ("has_location", ctypes.c_void_p),
+        ("priority", ctypes.c_void_p),
+        ("valid", ctypes.c_void_p),
+    ]
+
+
 def load() -> ctypes.CDLL:
     """Build (if stale) and load the engine. Raises NativeBuildError if no
     toolchain is available — callers fall back to the numpy/JAX paths."""
@@ -63,6 +104,14 @@ def load() -> ctypes.CDLL:
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64, i32p,
     ]
     lib.auction_sparse.restype = ctypes.c_int32
+    lib.fused_topk_candidates.argtypes = [
+        ctypes.POINTER(_ProviderFeatures), ctypes.POINTER(_RequirementFeatures),
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        i32p, f32p,
+    ]
+    lib.fused_topk_candidates.restype = None
     _lib = lib
     return lib
 
@@ -96,6 +145,70 @@ def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
     cand_p = np.empty((T, k), np.int32)
     cand_c = np.empty((T, k), np.float32)
     lib.topk_candidates(cost, P, T, k, cand_p, cand_c)
+    return cand_p, cand_c
+
+
+def fused_topk_candidates(
+    providers, requirements, weights=None, k: int = 64
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fused cost + per-task top-k straight from encoded features — the
+    degraded-mode twin of ops.sparse.candidates_topk (same jitter, same
+    output contract) that never materializes the [P, T] cost tensor.
+
+    ``providers`` / ``requirements`` are EncodedProviders /
+    EncodedRequirements (numpy- or jax-backed); ``weights`` a CostWeights.
+    Returns (cand_provider [T, k] i32, cand_cost [T, k] f32).
+    """
+    lib = load()
+    if weights is None:
+        from protocol_tpu.ops.cost import CostWeights
+
+        weights = CostWeights()
+
+    def i32(a):
+        return np.ascontiguousarray(np.asarray(a), np.int32)
+
+    def f32(a):
+        return np.ascontiguousarray(np.asarray(a), np.float32)
+
+    def u8(a):
+        return np.ascontiguousarray(np.asarray(a), np.uint8)
+
+    def u32(a):
+        return np.ascontiguousarray(np.asarray(a), np.uint32)
+
+    p = providers
+    r = requirements
+    # keep references alive for the duration of the call
+    pa = [
+        i32(p.gpu_count), i32(p.gpu_mem_mb), i32(p.gpu_model_id),
+        u8(p.has_gpu), u8(p.has_cpu), i32(p.cpu_cores), i32(p.ram_mb),
+        i32(p.storage_gb), f32(p.lat), f32(p.lon), u8(p.has_location),
+        f32(p.price), f32(p.load), u8(p.valid),
+    ]
+    ra = [
+        u8(r.cpu_required), i32(r.cpu_cores), i32(r.ram_mb),
+        i32(r.storage_gb), u8(r.gpu_opt_valid), i32(r.gpu_count),
+        i32(r.gpu_mem_min), i32(r.gpu_mem_max), i32(r.gpu_total_mem_min),
+        i32(r.gpu_total_mem_max), u32(r.gpu_model_mask),
+        u8(r.gpu_model_constrained), f32(r.lat), f32(r.lon),
+        u8(r.has_location), f32(r.priority), u8(r.valid),
+    ]
+    P = pa[0].shape[0]
+    T = ra[1].shape[0]
+    K = ra[4].shape[1]
+    W = ra[10].shape[2]
+    k = min(k, P)
+    pf = _ProviderFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in pa])
+    rf = _RequirementFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in ra])
+    cand_p = np.empty((T, k), np.int32)
+    cand_c = np.empty((T, k), np.float32)
+    lib.fused_topk_candidates(
+        ctypes.byref(pf), ctypes.byref(rf), P, T, K, W, k,
+        float(weights.price), float(weights.load),
+        float(weights.proximity), float(weights.priority),
+        cand_p, cand_c,
+    )
     return cand_p, cand_c
 
 

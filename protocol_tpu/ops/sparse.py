@@ -180,7 +180,7 @@ def assign_auction_sparse(
     to the give-up level in eps-sized steps (millions of bid events at 32k);
     eps-scaling covers the same price range geometrically.
     """
-    state = _sparse_auction_phase(
+    state, _stall = _sparse_auction_phase(
         cand_provider, cand_cost, num_providers, None,
         eps=eps, max_iters=max_iters, frontier=frontier, retire=retire,
     )
@@ -283,8 +283,8 @@ def _sparse_auction_phase(
         # reset the iteration counter for this phase
         state = (jnp.int32(0),) + tuple(state[1:])
     loop0 = (state, jnp.sum(state[3] >= 0), jnp.int32(0))
-    out, _, _ = lax.while_loop(cond, body, loop0)
-    return out
+    out, _best, stall = lax.while_loop(cond, body, loop0)
+    return out, stall
 
 
 @jax.jit
@@ -367,6 +367,7 @@ def assign_auction_sparse_scaled(
     frontier: int = 4096,
     with_prices: bool = False,
     stall_limit: int = 64,
+    stats_out: dict | None = None,
 ):
     """eps-scaling auction: geometric eps ladder with warm-started prices
     (Bertsekas' eps-scaling — total bid events O(n log(1/eps)) instead of
@@ -383,6 +384,14 @@ def assign_auction_sparse_scaled(
         re-opened at the next (finer) phase and re-bid correctly.
       - a final greedy cleanup seats any stranded provider/task pairs.
 
+    The BINDING phase's stall circuit breaker (``stall_limit * 8``
+    no-net-progress rounds) truncates long eviction chains that reshuffle
+    without changing the assigned count; quality on such tails then falls
+    to the greedy cleanup. ``stall_limit=0`` opts out (run to
+    ``max_iters_per_phase``); a stall-terminated solve is reported via
+    ``stats_out["stall_exit"]`` and a log line so quality regressions at
+    large T stay observable.
+
     ``with_prices=True`` additionally returns the final price vector [P] —
     the warm-start state for the NEXT solve (see
     :func:`assign_auction_sparse_warm`).
@@ -391,7 +400,7 @@ def assign_auction_sparse_scaled(
     eps = eps_start
     while True:
         final = eps <= eps_end
-        state = _sparse_auction_phase(
+        state, stall = _sparse_auction_phase(
             cand_provider, cand_cost, num_providers, state,
             eps=eps, max_iters=max_iters_per_phase, frontier=frontier,
             # the FINAL phase's retirement is binding and its eviction
@@ -402,6 +411,7 @@ def assign_auction_sparse_scaled(
             stall_limit=stall_limit * (8 if final else 1),
         )
         if final:
+            _report_stall("scaled", stall, stall_limit * 8, stats_out)
             break
         eps = max(eps * scale, eps_end)
         it, price, owner, p4t, retired = state
@@ -420,6 +430,25 @@ def assign_auction_sparse_scaled(
     return res
 
 
+def _report_stall(kind: str, stall, limit: int, stats_out: dict | None) -> None:
+    """Record (and log) a binding-phase stall termination. One scalar
+    readback — negligible next to the solve it describes."""
+    stalled = bool(limit > 0 and int(stall) >= limit)
+    if stats_out is not None:
+        stats_out["stall_exit"] = stalled
+        stats_out["stall_rounds"] = int(stall)
+    if stalled:
+        import logging
+
+        logging.getLogger(__name__).info(
+            "sparse auction (%s) stall-terminated after %d no-progress "
+            "rounds; tail quality falls to greedy cleanup (stall_limit=0 "
+            "opts out)",
+            kind,
+            int(stall),
+        )
+
+
 def assign_auction_sparse_warm(
     cand_provider: jax.Array,
     cand_cost: jax.Array,
@@ -430,6 +459,7 @@ def assign_auction_sparse_warm(
     max_iters: int = 20000,
     frontier: int = 4096,
     stall_limit: int = 64,
+    stats_out: dict | None = None,
 ) -> tuple[AssignResult, jax.Array]:
     """Incremental (delta-frontier) auction solve: SURVEY §7 hard part 4.
 
@@ -478,13 +508,15 @@ def assign_auction_sparse_warm(
         p4t0,
         jnp.zeros(cand_cost.shape[0], bool),
     )
-    state = _sparse_auction_phase(
+    state, stall = _sparse_auction_phase(
         cand_provider, cand_cost, num_providers, state,
         eps=eps, max_iters=max_iters, frontier=frontier, retire=True,
         # the warm solve is a binding final phase: same 8x stall budget as
-        # the scaled ladder's last phase (see assign_auction_sparse_scaled)
+        # the scaled ladder's last phase (see assign_auction_sparse_scaled);
+        # stall_limit=0 opts out (run to max_iters)
         stall_limit=stall_limit * 8,
     )
+    _report_stall("warm", stall, stall_limit * 8, stats_out)
     _, price, owner, p4t, _ = state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
     return AssignResult(p4t, _invert(p4t, num_providers)), price

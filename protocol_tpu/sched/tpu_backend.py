@@ -209,6 +209,7 @@ class TpuBatchMatcher:
         self._cache = CandidateCache(self.encoder, self.weights, k=top_k)
         self._last_warm_used = False
         self._last_warm_seeded = 0
+        self._last_stall: dict = {}
         self._groups_plugin = None
         self._group_assignment: dict[str, str] = {}  # group id -> task id
         self._group_covered: set[str] = set()
@@ -285,9 +286,12 @@ class TpuBatchMatcher:
         if self.native_fallback:
             from protocol_tpu import native
 
-            cost = self._native_cost(ep, er)
-            n_providers, _n_slots = cost.shape
-            cand_p, cand_c = native.topk_candidates(cost, k=min(64, n_providers))
+            # fused feature->cost->top-k: the [P, T] tensor never exists
+            # (same streaming shape as the sparse TPU path)
+            n_providers = int(np.asarray(ep.gpu_count).shape[0])
+            cand_p, cand_c = native.fused_topk_candidates(
+                ep, er, self.weights, k=min(64, n_providers)
+            )
             p4s = native.auction_sparse(cand_p, cand_c, num_providers=n_providers)
             t4p = np.full(n_providers, -1, np.int32)
             for s_idx, p_idx in enumerate(p4s):
@@ -635,19 +639,23 @@ class TpuBatchMatcher:
         warm = self._warm_gate(seeded, rebuilt=prepared.rebuilt)
         cand_p = jnp.asarray(prepared.cand_p)
         cand_c = jnp.asarray(prepared.cand_c)
+        stall_stats: dict = {}
         if warm:
             res, price = assign_auction_sparse_warm(
                 cand_p, cand_c, prepared.p_bucket,
                 price0=jnp.asarray(prepared.price0),
                 p4t0=jnp.asarray(p4s0),
+                stats_out=stall_stats,
             )
         else:
             res, price = assign_auction_sparse_scaled(
-                cand_p, cand_c, prepared.p_bucket, with_prices=True
+                cand_p, cand_c, prepared.p_bucket, with_prices=True,
+                stats_out=stall_stats,
             )
         self._cache.store_prices(np.asarray(price))
         self._last_warm_used = warm
         self._last_warm_seeded = seeded
+        self._last_stall = stall_stats
         return np.asarray(res.task_for_provider)[: prepared.num_rows]
 
     def _unbounded_best(self, ep, er) -> np.ndarray:
@@ -971,6 +979,9 @@ class TpuBatchMatcher:
             "kernel": kernel_used,  # dense_auction | sparse_topk | native_cpu
             "warm": warm_used,
             "warm_seeded_slots": warm_seeded,
+            # binding-phase stall circuit breaker (ops/sparse.py): True
+            # means tail quality fell to greedy cleanup this solve
+            "stall_exit": self._last_stall.get("stall_exit", False),
             "anti_affinity_assigned": aa_assigned,
             "truncated_aa_slots": self._aa_truncated,
             "group_assignments": len(self._group_assignment),

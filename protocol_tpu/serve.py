@@ -80,6 +80,25 @@ def _public_session():
     return public_client_session()
 
 
+async def _close_sessions(*sessions) -> None:
+    """Close aiohttp ClientSessions on graceful exit. The lazily-created
+    public-trust sessions (worker signed-URL PUTs, GCS, toploc,
+    geolocation) would otherwise leak their connectors when a serve
+    coroutine is cancelled."""
+    for s in sessions:
+        if s is None or isinstance(s, str) or getattr(s, "closed", False):
+            continue
+        close = getattr(s, "close", None)
+        if close is None:
+            continue
+        try:
+            r = close()
+            if asyncio.iscoroutine(r):
+                await r
+        except Exception:
+            pass
+
+
 def _server_ssl(args):
     """TLS server context from --tls-cert/--tls-key (or TLS_CERT/TLS_KEY
     env, the charts' secret mounts). None = plaintext, the pre-TLS
@@ -130,13 +149,16 @@ async def serve_discovery(args) -> None:
         ),
     )
     await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
-    while True:
-        try:
-            await asyncio.to_thread(svc.chain_sync_once)
-            await svc.enrich_locations_once()
-        except Exception as e:
-            print(f"discovery loop error: {e}", file=sys.stderr)
-        await asyncio.sleep(args.sync_interval)
+    try:
+        while True:
+            try:
+                await asyncio.to_thread(svc.chain_sync_once)
+                await svc.enrich_locations_once()
+            except Exception as e:
+                print(f"discovery loop error: {e}", file=sys.stderr)
+            await asyncio.sleep(args.sync_interval)
+    finally:
+        await _close_sessions(resolver.http if resolver else None)
 
 
 async def serve_orchestrator(args) -> None:
@@ -317,8 +339,13 @@ async def serve_orchestrator(args) -> None:
     else:
         await svc.serve(host="0.0.0.0", port=args.port)
         print(f"orchestrator on :{args.port} (version {VERSION})", flush=True)
-    while True:  # loops run as tasks; keep the process alive
-        await asyncio.sleep(3600)
+    try:
+        while True:  # loops run as tasks; keep the process alive
+            await asyncio.sleep(3600)
+    finally:
+        await _close_sessions(
+            session, getattr(getattr(svc, "storage", None), "http", None)
+        )
 
 
 async def serve_validator(args) -> None:
@@ -335,6 +362,7 @@ async def serve_validator(args) -> None:
     session = _client_session()
 
     synthetic = None
+    toploc_session = None
     toploc_configs = os.environ.get("TOPLOC_CONFIGS", "")
     # storage built lazily: _storage() opens its own public session for GCS,
     # which must not sit idle (and unclosed) when toploc is unconfigured
@@ -394,12 +422,17 @@ async def serve_validator(args) -> None:
         http=session,
     )
     await _run_app(svc.make_app(), args.port, ssl_context=_server_ssl(args))
-    while True:
-        try:
-            await svc.validation_loop_once()
-        except Exception as e:
-            print(f"validation loop error: {e}", file=sys.stderr)
-        await asyncio.sleep(args.loop_interval)
+    try:
+        while True:
+            try:
+                await svc.validation_loop_once()
+            except Exception as e:
+                print(f"validation loop error: {e}", file=sys.stderr)
+            await asyncio.sleep(args.loop_interval)
+    finally:
+        await _close_sessions(
+            session, toploc_session, getattr(storage, "http", None)
+        )
 
 
 async def serve_ledger_api(args) -> None:
@@ -551,29 +584,36 @@ async def serve_worker(args) -> None:
     urls = [u for u in args.discovery_urls.split(",") if u]
     await agent.upload_to_discovery(urls)
     last_monitor = 0.0
-    while True:
-        try:
-            await agent.heartbeat_once()
-            await agent.upload_to_discovery(urls)
-            import time as _time
+    try:
+        while True:
+            try:
+                await agent.heartbeat_once()
+                await agent.upload_to_discovery(urls)
+                import time as _time
 
-            if _time.monotonic() - last_monitor >= 60.0:
-                # stake/whitelist/membership drift watch
-                # (provider.rs:47-147, compute_node.rs:32-115)
-                last_monitor = _time.monotonic()
-                for alarm in await asyncio.to_thread(agent.stake_monitor_once):
-                    print(f"chain alarm: {alarm}", file=sys.stderr)
-                if agent.deregistered:
-                    # a deregistered node must STOP, not keep advertising
-                    # itself to discovery forever
-                    raise SystemExit(
-                        "compute node deregistered on-chain; exiting"
-                    )
-        except SystemExit:
-            raise
-        except Exception as e:
-            print(f"worker loop error: {e}", file=sys.stderr)
-        await asyncio.sleep(10.0)
+                if _time.monotonic() - last_monitor >= 60.0:
+                    # stake/whitelist/membership drift watch
+                    # (provider.rs:47-147, compute_node.rs:32-115)
+                    last_monitor = _time.monotonic()
+                    for alarm in await asyncio.to_thread(
+                        agent.stake_monitor_once
+                    ):
+                        print(f"chain alarm: {alarm}", file=sys.stderr)
+                    if agent.deregistered:
+                        # a deregistered node must STOP, not keep advertising
+                        # itself to discovery forever
+                        raise SystemExit(
+                            "compute node deregistered on-chain; exiting"
+                        )
+            except SystemExit:
+                raise
+            except Exception as e:
+                print(f"worker loop error: {e}", file=sys.stderr)
+            await asyncio.sleep(10.0)
+    finally:
+        # the "lazy" sentinel only becomes a session after the first
+        # external signed-URL upload; _close_sessions skips the sentinel
+        await _close_sessions(session, agent.public_http)
 
 
 def run_bootstrap(args) -> int:
@@ -613,6 +653,7 @@ def run_bootstrap(args) -> int:
                     return None
                 if time.monotonic() > deadline:
                     raise
+                time.sleep(2.0)  # ledger blip: pace retries like the wait loop
 
     pool = _pool_probe()
     if pool is None:

@@ -757,8 +757,20 @@ class WorkerAgent:
         route) is a control-plane peer behind the pinned CA, while GCS/S3
         signed URLs are public hosts under system trust — one session
         cannot verify both."""
-        if self.orchestrator_url and url.startswith(self.orchestrator_url):
-            return self.http
+        if self.orchestrator_url:
+            from urllib.parse import urlsplit
+
+            # compare scheme://host:port, not a raw string prefix: an
+            # orchestrator at https://orch:80 must not capture
+            # https://orch:8090/... (which is a different, public origin).
+            # Ports normalized so an explicit :443/:80 matches the default.
+            def origin(s):
+                u = urlsplit(s)
+                default = {"https": 443, "http": 80}.get(u.scheme)
+                return (u.scheme, u.hostname, u.port or default)
+
+            if origin(self.orchestrator_url) == origin(url):
+                return self.http
         if self.public_http == "lazy":
             from protocol_tpu.utils.tls import public_client_session
 

@@ -58,20 +58,26 @@ DOCS_HTML = """<!doctype html>
   document.getElementById('desc').textContent = spec.info.description || '';
   const ops = document.getElementById('ops');
   ops.textContent = '';
+  // escape spec-derived strings: a route docstring (or parameter name)
+  // containing HTML must render as text, not inject into the page
+  const esc = (s) => String(s).replace(/[&<>"']/g, (c) => ({
+    '&': '&amp;', '<': '&lt;', '>': '&gt;', '"': '&quot;', "'": '&#39;',
+  }[c]));
   for (const [path, methods] of Object.entries(spec.paths)) {
     for (const [method, op] of Object.entries(methods)) {
       const d = document.createElement('details');
       d.className = 'op';
       const params = (op.parameters || []).map(p => p.name);
+      const mcls = /^[a-z]+$/.test(method) ? method : 'get';
       d.innerHTML = `
         <summary>
-          <span class="m ${method}">${method.toUpperCase()}</span>
-          <span class="path">${path}</span>
-          <span class="sum">${op.summary || ''}</span>
+          <span class="m ${mcls}">${esc(method.toUpperCase())}</span>
+          <span class="path">${esc(path)}</span>
+          <span class="sum">${esc(op.summary || '')}</span>
         </summary>
         <div class="body">
           ${params.map(p =>
-            `<label>${p}: <input data-param="${p}"></label>`).join('')}
+            `<label>${esc(p)}: <input data-param="${esc(p)}"></label>`).join('')}
           ${['post', 'put', 'patch'].includes(method)
             ? '<textarea data-body placeholder="JSON body"></textarea>' : ''}
           <button data-send>Send</button>

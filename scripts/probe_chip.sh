@@ -1,0 +1,8 @@
+#!/bin/bash
+# Gentle chip-health probe: subprocess-guarded, timeout-and-abandon (SIGTERM at
+# connect stage is safe: no kernel in flight until devices() returns).
+LOG=${1:-/tmp/chip_health.log}
+echo "=== probe $(date -u +%H:%M:%SZ) ===" >> "$LOG"
+timeout 240 python -u /tmp/probe_chip.py >> "$LOG" 2>&1
+echo "rc=$? at $(date -u +%H:%M:%SZ)" >> "$LOG"
+tail -3 "$LOG"

@@ -208,7 +208,7 @@ class TestStallDetection:
         # 3 tasks fighting over 2 providers: one permanent hole
         cand_p = jnp.asarray([[0, 1], [0, 1], [0, 1]], jnp.int32)
         cand_c = jnp.asarray([[1.0, 2.0], [1.1, 2.1], [1.2, 2.2]], jnp.float32)
-        state = _sparse_auction_phase(
+        state, stall = _sparse_auction_phase(
             cand_p, cand_c, 2, None, eps=0.5, max_iters=5000,
             frontier=4, retire=False, stall_limit=16,
         )
@@ -216,6 +216,7 @@ class TestStallDetection:
         assigned = int(np.asarray(state[3] >= 0).sum())
         assert assigned == 2  # both providers seated
         assert rounds < 200, f"phase should stall out early, ran {rounds}"
+        assert int(stall) >= 16  # the exit is observable, not silent
 
     def test_stall_disabled_by_default(self):
         """stall_limit=0 preserves the run-to-cap semantics the plain
@@ -224,7 +225,7 @@ class TestStallDetection:
 
         cand_p = jnp.asarray([[0, 1], [0, 1], [0, 1]], jnp.int32)
         cand_c = jnp.asarray([[1.0, 2.0], [1.1, 2.1], [1.2, 2.2]], jnp.float32)
-        state = _sparse_auction_phase(
+        state, _stall = _sparse_auction_phase(
             cand_p, cand_c, 2, None, eps=0.5, max_iters=300,
             frontier=4, retire=False, stall_limit=0,
         )
