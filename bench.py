@@ -28,7 +28,10 @@ import jax.numpy as jnp
 from protocol_tpu.ops.assign import assign_auction, assign_greedy
 from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
-from protocol_tpu.ops.sparse import assign_auction_sparse_scaled, candidates_topk
+from protocol_tpu.ops.sparse import (
+    assign_auction_sparse_scaled,
+    candidates_topk_bidir,
+)
 
 P, T = 32768, 32768
 TOPK = 64
@@ -102,12 +105,16 @@ def synth_requirements(rng: np.random.Generator, n: int) -> EncodedRequirements:
 
 
 def tpu_match(ep: EncodedProviders, er: EncodedRequirements):
-    """Full hot path: streaming top-K candidate generation over the
+    """Full hot path: streaming BIDIRECTIONAL candidate generation over the
     featurized cost tensor (never materializing [P, T]) + eps-scaled sparse
-    frontier auction with cleanup. Host loop over jitted phases — each phase
-    executable is cached after warmup."""
+    frontier auction with cleanup. Reverse (provider->task) edges guarantee
+    every provider appears in the candidate graph — forward-only top-k left
+    ~9% of providers unreachable at 32k (coverage-capped matching). Host
+    loop over jitted phases — each phase executable is cached after warmup."""
 
-    cand_p, cand_c = candidates_topk(ep, er, CostWeights(), k=TOPK, tile=TILE)
+    cand_p, cand_c = candidates_topk_bidir(
+        ep, er, CostWeights(), k=TOPK, tile=TILE, reverse_r=8, extra=16
+    )
     res = assign_auction_sparse_scaled(
         cand_p, cand_c, num_providers=ep.gpu_count.shape[0],
         eps_start=4.0, eps_end=0.05, max_iters_per_phase=400,

@@ -303,6 +303,51 @@ def main() -> None:
         f"({secs_cold / max(secs_warm, 1e-9):.1f}x)"
     )
 
+    # ---------------- stage B2: assignment completeness -------------------
+    # VERDICT r3 item 3's done-bar: >=99% assignment at T>=65k in bounded
+    # wall-clock. Forward-only top-k coverage-caps the matching (every
+    # task's window holds the same cheap providers; at 65k only 49,813 of
+    # 65,536 providers appear in ANY list -> 66.5% assigned no matter how
+    # long the auction runs). Bidirectional candidates (per-provider
+    # reverse edges, ops/sparse.candidates_topk_bidir) restore coverage
+    # and the eps-scaled solve completes: 99.98% measured at 65k.
+    from protocol_tpu.ops.sparse import candidates_topk_bidir
+
+    log(f"stage B2: completeness, forward vs bidir candidates T={T_AUCTION}")
+    cov_fwd = int(np.unique(np.asarray(cp)[np.asarray(cp) >= 0]).size)
+    res_fwd = assign_auction_sparse_scaled(cp, cc, num_providers=P_B)
+    a_fwd = int((np.asarray(res_fwd.provider_for_task) >= 0).sum())
+    t0 = time.perf_counter()
+    cpb, ccb = candidates_topk_bidir(
+        epb, erb, weights, k=K, tile=TILE, reverse_r=8, extra=16
+    )
+    jax.block_until_ready((cpb, ccb))
+    gen_bidir = time.perf_counter() - t0
+    cov_bd = int(np.unique(np.asarray(cpb)[np.asarray(cpb) >= 0]).size)
+    t0 = time.perf_counter()
+    res_bd = assign_auction_sparse_scaled(cpb, ccb, num_providers=P_B)
+    solve_bidir = time.perf_counter() - t0
+    a_bd = int((np.asarray(res_bd.provider_for_task) >= 0).sum())
+    rows.append(
+        {
+            "stage": "B2 completeness: forward vs bidir candidates",
+            "platform": platform,
+            "shape": f"T={T_AUCTION} K={K} reverse_r=8 extra=16",
+            "fwd_assigned": a_fwd,
+            "fwd_coverage": cov_fwd,
+            "bidir_assigned": a_bd,
+            "bidir_coverage": cov_bd,
+            "bidir_gen_s": round(gen_bidir, 2),
+            "bidir_solve_s": round(solve_bidir, 2),
+            "complete_pct": round(100.0 * a_bd / T_AUCTION, 2),
+        }
+    )
+    log(
+        f"  forward: {a_fwd}/{T_AUCTION} assigned (coverage {cov_fwd}) -> "
+        f"bidir: {a_bd}/{T_AUCTION} ({100.0 * a_bd / T_AUCTION:.2f}%, "
+        f"coverage {cov_bd})"
+    )
+
     # ---------------- stage D: ladder #5 vector bin-pack ------------------
     # BASELINE.md config #5: multi-resource capacity vectors + anti-affinity
     # (ops/binpack.py). Measured at the 10k-task test scale.

@@ -46,7 +46,7 @@ from protocol_tpu.ops.encoding import (
     EncodedRequirements,
     FeatureEncoder,
 )
-from protocol_tpu.ops.sparse import candidates_topk
+from protocol_tpu.ops.sparse import candidates_topk, candidates_topk_reverse
 
 _P_FIELDS = (
     "gpu_count", "gpu_mem_mb", "gpu_model_id", "has_gpu", "has_cpu",
@@ -140,6 +140,9 @@ class PreparedSolve:
     rebuilt: bool
     delta_tasks: int
     delta_rows: int
+    # valid provider rows that appeared in NO task's cached top-k list and
+    # were given reverse edges by the coverage repair (0 = full coverage)
+    uncovered_rows: int = 0
 
 
 class CandidateCache:
@@ -149,6 +152,8 @@ class CandidateCache:
         weights: CostWeights,
         k: int = 64,
         max_invalid_frac: float = 0.25,
+        reverse_r: int = 8,
+        extra: int = 16,
     ):
         self.encoder = encoder
         # candidate SELECTION is priority-free: the priority term shifts a
@@ -157,6 +162,12 @@ class CandidateCache:
         self._sel_weights = dataclasses.replace(weights, priority=0.0)
         self.k = k
         self.max_invalid_frac = max_invalid_frac
+        # coverage repair: rows absent from EVERY cached list get up to
+        # ``reverse_r`` reverse (provider->slot) edges, scattered into
+        # ``extra`` fixed extra candidate columns per slot (fixed so the
+        # auction executable shape stays bucket-stable across solves)
+        self.reverse_r = reverse_r
+        self.extra = extra
         self._clear()
 
     # ---------------- provider registry ----------------
@@ -392,6 +403,7 @@ class CandidateCache:
         s_pad = _pow2(S)
         cand_p = np.full((s_pad, self.k), -1, np.int32)
         cand_c = np.zeros((s_pad, self.k), np.float32)
+        slot_prio = np.zeros(s_pad, np.float32)
         valid_row = self.cols["valid"][: self.rows]
         wprio = self.weights.priority
         off = 0
@@ -406,7 +418,18 @@ class CandidateCache:
                 e.cand_static + base[np.maximum(cp, 0)] - wprio * t.prio,
                 0.0,
             )
+            slot_prio[off:off + t.take] = t.prio
             off += t.take
+
+        # ---- coverage repair: per-task top-k windows pile onto the same
+        # cheap providers (price-dominated costs), so at scale a fraction
+        # of valid rows appears in NO list — unreachable by the auction no
+        # matter how prices move, capping the warm matching exactly like
+        # the forward-only cold path (ops/sparse.candidates_topk_reverse
+        # docstring has the measurement). Give those rows reverse edges.
+        cand_p, cand_c, uncovered = self._repair_coverage(
+            cand_p, cand_c, tasks, valid_row, slot_prio, s_pad, wprio
+        )
 
         return PreparedSolve(
             ep=ep,
@@ -424,6 +447,86 @@ class CandidateCache:
             rebuilt=rebuilt,
             delta_tasks=len(delta_tasks),
             delta_rows=int(len(new_rows)),
+            uncovered_rows=uncovered,
+        )
+
+    def _sub_ep(self, rows: np.ndarray) -> EncodedProviders:
+        """Assemble an EncodedProviders view of a row subset (padded to a
+        pow2 bucket) — shared by the new-row merge and coverage repair."""
+        d_pad = _pow2(len(rows))
+        sub = {}
+        for name in _P_FIELDS:
+            col = self.cols[name][rows]
+            pad = np.zeros((d_pad - len(rows),) + col.shape[1:], col.dtype)
+            if name in _P_INT_FIELDS:
+                pad.fill(-1)
+            sub[name] = jnp.asarray(np.concatenate([col, pad]))
+        return EncodedProviders(**sub)
+
+    def _repair_coverage(
+        self,
+        cand_p: np.ndarray,
+        cand_c: np.ndarray,
+        tasks: list[TaskItem],
+        valid_row: np.ndarray,
+        slot_prio: np.ndarray,
+        s_pad: int,
+        wprio: float,
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Append ``self.extra`` candidate columns holding reverse edges
+        for valid rows that appear in no list. One [U x S] streamed pass
+        over only the uncovered rows — O(uncovered), not O(P) — then a
+        host scatter capped at ``extra`` per slot (cheapest win). Dedup
+        against forward lists is unnecessary: uncovered rows by definition
+        appear in none of them.
+
+        The pass re-runs each prepare (uncovered rows stay uncovered in
+        the forward lists). Like the forward selection, reverse selection
+        is price-drift-stable (base shifts a provider's whole row
+        uniformly), so these edges could be cached per-provider if the
+        [U x S] pass ever shows up in solve profiles."""
+        extra_p = np.full((s_pad, self.extra), -1, np.int32)
+        extra_c = np.zeros((s_pad, self.extra), np.float32)
+        covered = np.zeros(self.rows, bool)
+        flat = cand_p[cand_p >= 0]
+        if flat.size:
+            covered[flat] = True
+        uncovered = np.flatnonzero(valid_row & ~covered)
+        if uncovered.size and tasks:
+            sub_ep = self._sub_ep(uncovered)
+            rows_meta = [
+                (self.entries[t.task_id].er_row, t.take, 0.0) for t in tasks
+            ]
+            er = self._tile_er(rows_meta, s_pad)
+            r = min(self.reverse_r, s_pad)
+            _, _, rev_t, rev_c = candidates_topk_reverse(
+                sub_ep, er, self._sel_weights, k=1,
+                tile=min(1024, s_pad), reverse_r=r,
+                task_offset=self._jitter_cursor,
+            )
+            self._jitter_cursor += s_pad
+            U = uncovered.size
+            rt = np.asarray(rev_t)[:U]
+            rc = np.asarray(rev_c)[:U]
+            ok = rt >= 0
+            slot = rt[ok]
+            cost = rc[ok]
+            prov = np.broadcast_to(uncovered[:, None].astype(np.int32), rt.shape)[ok]
+            order = np.lexsort((cost, slot))
+            slot, cost, prov = slot[order], cost[order], prov[order]
+            idxs = np.arange(slot.size)
+            first = np.r_[True, slot[1:] != slot[:-1]] if slot.size else np.zeros(0, bool)
+            start = np.maximum.accumulate(np.where(first, idxs, 0))
+            rank = idxs - start
+            keep = rank < self.extra
+            extra_p[slot[keep], rank[keep]] = prov[keep]
+            extra_c[slot[keep], rank[keep]] = (
+                cost[keep] - wprio * slot_prio[slot[keep]]
+            )
+        return (
+            np.concatenate([cand_p, extra_p], axis=1),
+            np.concatenate([cand_c, extra_c], axis=1),
+            int(uncovered.size),
         )
 
     def _merge_new_rows(
@@ -436,14 +539,7 @@ class CandidateCache:
         """Fold newly-registered provider rows into cached candidate lists:
         one [delta-P x S] candidate pass + a host-side per-slot merge."""
         d_pad = _pow2(len(new_rows))
-        sub = {}
-        for name in _P_FIELDS:
-            col = self.cols[name][new_rows]
-            pad = np.zeros((d_pad - len(new_rows),) + col.shape[1:], col.dtype)
-            if name in _P_INT_FIELDS:
-                pad.fill(-1)
-            sub[name] = jnp.asarray(np.concatenate([col, pad]))
-        ep_d = EncodedProviders(**sub)
+        ep_d = self._sub_ep(new_rows)
 
         rows_meta = [
             (self.entries[t.task_id].er_row, t.take, 0.0) for t in tasks

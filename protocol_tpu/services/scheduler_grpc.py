@@ -156,7 +156,7 @@ class SchedulerBackendServicer:
             from protocol_tpu.ops.sparse import (
                 assign_auction_sparse_scaled,
                 assign_auction_sparse_warm,
-                candidates_topk,
+                candidates_topk_bidir,
             )
 
             # tile must divide the (padded, pow2) T
@@ -165,9 +165,12 @@ class SchedulerBackendServicer:
             while t_padded % tile != 0:
                 tile -= 1
             p_padded = int(np.asarray(ep.gpu_count).shape[0])
-            cand_p, cand_c = candidates_topk(
+            # bidirectional: same coverage-safe generator as the in-process
+            # matcher (_bounded_t4p_sparse) — remote/in-process parity
+            cand_p, cand_c = candidates_topk_bidir(
                 ep, er, weights,
                 k=max(int(request.top_k) or 64, 1), tile=tile,
+                reverse_r=8, extra=16,
             )
             if len(request.warm_price) == P and len(
                 request.seed_provider_for_task

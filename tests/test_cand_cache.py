@@ -170,3 +170,61 @@ class TestPrices:
         p2 = c.prepare(provs + [pitem("0xnew")], ts)
         assert p2.price0[p2.row_of_addr["0x1"]] == 3.5
         assert p2.price0[p2.row_of_addr["0xnew"]] == 0.0
+
+
+class TestCoverageRepair:
+    """Valid rows absent from every cached top-k list get reverse edges in
+    ``extra`` appended candidate columns (stage-B completeness on the warm
+    path — the cached lists coverage-cap exactly like the forward-only
+    cold generator; see ops/sparse.candidates_topk_reverse)."""
+
+    def test_priced_out_rows_get_reverse_edges(self):
+        c = mk_cache(k=2, reverse_r=4, extra=4)
+        # identical specs, distinct prices: every task's top-2 is the same
+        # two cheapest rows; the other six appear in no list
+        provs = [pitem(f"0x{i}", price=float(i)) for i in range(8)]
+        tasks = [titem(f"t{i}", 1) for i in range(8)]
+        prep = c.prepare(provs, tasks)
+        assert prep.uncovered_rows == 6
+        assert prep.cand_p.shape[1] == 2 + 4  # k + extra columns
+        covered = np.unique(prep.cand_p[prep.cand_p >= 0])
+        valid = np.flatnonzero(c.cols["valid"][: c.rows])
+        assert set(valid.tolist()) <= set(covered.tolist())
+
+    def test_full_coverage_emits_no_extras(self):
+        c = mk_cache(k=8, reverse_r=4, extra=4)
+        provs = [pitem(f"0x{i}", price=float(i)) for i in range(6)]
+        tasks = [titem("t0", 2)]
+        prep = c.prepare(provs, tasks)
+        # k=8 >= P: every row is in the task's list already
+        assert prep.uncovered_rows == 0
+        assert (prep.cand_p[:, 8:] == -1).all()
+
+    def test_repair_costs_are_current_and_priority_adjusted(self):
+        c = mk_cache(k=1, reverse_r=2, extra=2)
+        provs = [pitem("0xcheap", price=0.0), pitem("0xdear", price=5.0)]
+        t = titem("t0", 1)
+        t.prio = 2.0
+        prep = c.prepare(provs, [t])
+        row_dear = c.row_of_addr["0xdear"]
+        ex = prep.cand_p[0, 1:]
+        pos = np.flatnonzero(ex == row_dear)
+        assert pos.size == 1  # the priced-out row arrived via repair
+        got = float(prep.cand_c[0, 1 + pos[0]])
+        # exact current cost: base(price*w) + static - w_prio * prio,
+        # matching the forward column decomposition (jitter is sub-1e-4)
+        w = c.weights
+        expect = w.price * 5.0 - w.priority * 2.0
+        assert abs(got - expect) < 1e-3, (got, expect)
+
+    def test_warm_solve_keeps_coverage_under_churn(self):
+        c = mk_cache(k=2, reverse_r=4, extra=4)
+        provs = [pitem(f"0x{i}", price=float(i)) for i in range(8)]
+        tasks = [titem(f"t{i}", 1) for i in range(8)]
+        c.prepare(provs, tasks)
+        # churn: one cheap row departs, one expensive row joins
+        provs = provs[1:] + [pitem("0xnew", price=9.0)]
+        prep = c.prepare(provs, tasks)
+        covered = np.unique(prep.cand_p[prep.cand_p >= 0])
+        valid = np.flatnonzero(c.cols["valid"][: c.rows])
+        assert set(valid.tolist()) <= set(covered.tolist())

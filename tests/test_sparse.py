@@ -230,3 +230,136 @@ class TestStallDetection:
             frontier=4, retire=False, stall_limit=0,
         )
         assert int(state[0]) == 300  # ground to the cap, as before
+
+
+class TestBidirCandidates:
+    """Bidirectional candidate generation (stage-B completeness, VERDICT r3
+    item 3): forward top-k alone coverage-caps the matching when costs are
+    price-dominated — every task's window holds the same cheap providers
+    and expensive rows get NO edges. Reverse (provider->task) edges
+    guarantee every provider a path into the graph."""
+
+    @staticmethod
+    def _priced_marketplace(P, T, seed=0):
+        """Identical specs, wide price spread: the adversarial shape for
+        forward-only coverage (all tasks rank providers identically up to
+        tie jitter)."""
+        from protocol_tpu.models.node import (
+            ComputeRequirements, ComputeSpecs, CpuSpecs, GpuSpecs,
+        )
+
+        enc = FeatureEncoder()
+        spec = ComputeSpecs(
+            gpu=GpuSpecs(count=8, model="H100", memory_mb=80000),
+            cpu=CpuSpecs(cores=32), ram_mb=65536, storage_gb=1000,
+        )
+        rng = np.random.default_rng(seed)
+        prices = rng.uniform(0.1, 10.0, size=P).tolist()
+        ep = enc.encode_providers([spec] * P, prices=prices)
+        er = enc.encode_requirements(
+            [ComputeRequirements.parse("gpu:count=8;gpu:model=H100")] * T
+        )
+        return ep, er
+
+    def test_reverse_edges_match_bruteforce(self):
+        from protocol_tpu.ops.sparse import candidates_topk_reverse
+
+        ep, er = encode_random_marketplace(11, 24, 16)
+        _, _, rev_t, rev_c = candidates_topk_reverse(
+            ep, er, k=4, tile=8, reverse_r=3
+        )
+        cost = jittered_cost(np.asarray(cost_matrix(ep, er, CostWeights())[0]))
+        rev_t, rev_c = np.asarray(rev_t), np.asarray(rev_c)
+        for p in range(24):
+            order = np.argsort(cost[p], kind="stable")[:3]
+            expected = [
+                int(t) if cost[p, t] < INFEASIBLE * 0.5 else -1 for t in order
+            ]
+            assert rev_t[p].tolist() == expected, f"provider {p}"
+            feas = [i for i, t in enumerate(expected) if t >= 0]
+            np.testing.assert_allclose(
+                rev_c[p][feas], cost[p, order][feas], rtol=1e-6
+            )
+
+    def test_merge_scatter_exact_and_deduped(self):
+        """Per task, the merged extra columns hold the cheapest <=extra
+        reverse edges targeting it — minus edges duplicating a forward
+        candidate (a dup makes v1==v2 in the bid math, collapsing bid
+        increments to +eps; measured slower AND worse at 4k)."""
+        from protocol_tpu.ops.sparse import merge_reverse_candidates
+
+        T, K, P, r, extra = 6, 2, 8, 4, 2
+        rng = np.random.default_rng(3)
+        cand_p = rng.integers(0, P, size=(T, K)).astype(np.int32)
+        cand_c = rng.uniform(0, 1, size=(T, K)).astype(np.float32)
+        rev_t = rng.integers(-1, T, size=(P, r)).astype(np.int32)
+        rev_c = rng.uniform(0, 1, size=(P, r)).astype(np.float32)
+        mp, mc = merge_reverse_candidates(
+            jnp.asarray(cand_p), jnp.asarray(cand_c),
+            jnp.asarray(rev_t), jnp.asarray(rev_c), extra=extra,
+        )
+        mp, mc = np.asarray(mp), np.asarray(mc)
+        assert mp.shape == (T, K + extra)
+        np.testing.assert_array_equal(mp[:, :K], cand_p)
+        for t in range(T):
+            edges = sorted(
+                (float(rev_c[p, j]), int(p))
+                for p in range(P)
+                for j in range(r)
+                if rev_t[p, j] == t and p not in cand_p[t]
+            )[:extra]
+            got = [
+                (round(float(mc[t, K + i]), 6), int(mp[t, K + i]))
+                for i in range(extra)
+                if mp[t, K + i] >= 0
+            ]
+            expected = [(round(c, 6), p) for c, p in edges]
+            assert got == expected, f"task {t}: {got} vs {expected}"
+
+    def test_bidir_restores_coverage_and_completeness(self):
+        """P=T with k<<P and price-dominated costs: forward-only coverage
+        (and therefore assignment) caps at ~k; bidir restores full
+        coverage AND the auction achieves the graph's maximum matching
+        (100% here — production defaults at a production-sparse size;
+        below ~1k the matcher routes through the dense solver anyway).
+        Mirrors the measured 65k result: 99.98% vs forward-only 66.5%."""
+        import scipy.sparse as _sp
+        from scipy.sparse.csgraph import maximum_bipartite_matching
+
+        from protocol_tpu.ops.sparse import (
+            assign_auction_sparse_scaled,
+            candidates_topk,
+            candidates_topk_bidir,
+        )
+
+        P = T = 1024
+        k = 8
+        ep, er = self._priced_marketplace(P, T)
+        fp, _ = candidates_topk(ep, er, k=k, tile=256)
+        fwd_cov = np.unique(np.asarray(fp)[np.asarray(fp) >= 0]).size
+        assert fwd_cov < P * 0.25, f"forward coverage {fwd_cov} not capped"
+
+        bp, bc = candidates_topk_bidir(
+            ep, er, k=k, tile=256, reverse_r=8, extra=16
+        )
+        bpn = np.asarray(bp)
+        bidir_cov = np.unique(bpn[bpn >= 0]).size
+        assert bidir_cov == P, f"bidir coverage {bidir_cov} != {P}"
+
+        # graph capacity: the bidir candidate graph must admit a (near-)
+        # perfect matching — this is what reverse_r buys
+        rows, cols = np.nonzero(bpn >= 0)[0], bpn[bpn >= 0]
+        g = _sp.csr_matrix(
+            (np.ones(len(rows)), (rows, cols)), shape=(T, P)
+        )
+        maxm = int((maximum_bipartite_matching(g, perm_type="column") >= 0).sum())
+        assert maxm >= T * 0.99, f"graph max matching only {maxm}/{T}"
+
+        res = assign_auction_sparse_scaled(bp, bc, num_providers=P)
+        p4t = np.asarray(res.provider_for_task)
+        assigned = int((p4t >= 0).sum())
+        # the auction must realize the graph's capacity, not just beat a bar
+        assert assigned >= maxm - 2, f"auction {assigned} vs max {maxm}"
+        assert assigned >= T * 0.99, f"bidir assigned only {assigned}/{T}"
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size  # injective matching
