@@ -123,3 +123,24 @@ class Scheduler:
             if not tasks:
                 return None
         return expand_task_for_node(tasks[0], node_address)
+
+    def get_tasks_for_node(self, node_address: str) -> list[Task]:
+        """Multi-task resolution: colocated nodes (ladder #5 capacity
+        sharing, TpuBatchMatcher phase 0.5) hold SEVERAL tasks
+        concurrently; everyone else gets a one-element list. The first
+        element equals ``get_task_for_node``'s answer from the same
+        solve (best-effort under a concurrent re-solve)."""
+        first = self.get_task_for_node(node_address)
+        if first is None:
+            return []
+        if self.batch_matcher is None:
+            return [first]
+        # plain dict read — get_task_for_node above already refreshed and
+        # resolved this node; no second lookup on the heartbeat hot path
+        tids = self.batch_matcher.assigned_task_ids(node_address)
+        if len(tids) <= 1:
+            return [first]
+        found = (self.store.task_store.get_task(t) for t in tids)
+        return [
+            expand_task_for_node(t, node_address) for t in found if t is not None
+        ]

@@ -26,6 +26,7 @@ growth, not on every membership change.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import threading
@@ -51,6 +52,7 @@ from protocol_tpu.ops.sparse import (
     assign_auction_sparse_scaled,
     assign_auction_sparse_warm,
     candidates_topk,
+    candidates_topk_bidir,
 )
 from protocol_tpu.sched.cand_cache import CandidateCache, ProviderItem, TaskItem
 from protocol_tpu.store.context import StoreContext
@@ -107,6 +109,26 @@ def task_anti_affinity(task: Task) -> Optional[str]:
     return None
 
 
+def task_colocate(task: Task) -> bool:
+    """Capacity-sharing opt-in (BASELINE ladder #5's core semantics:
+    "several tasks land on one provider while capacity holds"). Colocated
+    task replicas route through the vector bin-pack (ops/binpack.py) over
+    the providers' real multi-resource capacity (GPU count, total VRAM,
+    cpu cores, ram, storage) instead of the one-task-per-provider auction
+    — a 2-GPU provider can hold two 1-GPU tasks concurrently. The
+    reference cannot express this at all (one node, one task:
+    crates/orchestrator/src/scheduler/mod.rs:26-74)."""
+    cfg = task.scheduling_config
+    if cfg and cfg.plugins:
+        vals = cfg.plugins.get("tpu_scheduler", {}).get("colocate")
+        if vals:
+            v = str(vals[0]).lower()
+            if v not in ("true", "false"):
+                raise ValueError(f"colocate must be true|false, got {vals[0]!r}")
+            return v == "true"
+    return False
+
+
 def validate_tpu_scheduler_config(task: Task) -> None:
     """Reject malformed tpu_scheduler plugin config at task-creation time so
     user input can never break the batch solve (raises ValueError)."""
@@ -118,6 +140,17 @@ def validate_tpu_scheduler_config(task: Task) -> None:
                 "anti_affinity requires a replicas bound (unbounded swarm "
                 "tasks have no replica set to spread)"
             )
+        if task_colocate(task):
+            if replicas is None:
+                raise ValueError(
+                    "colocate requires a replicas bound (the capacity "
+                    "bin-pack places a finite replica set)"
+                )
+            if task_anti_affinity(task) is not None:
+                raise ValueError(
+                    "colocate and anti_affinity are mutually exclusive "
+                    "(stacking vs spreading)"
+                )
     except Exception as e:
         raise ValueError(f"invalid tpu_scheduler config: {e}") from e
 
@@ -201,6 +234,10 @@ class TpuBatchMatcher:
         self._dirty = True
         self._last_solve = float("-inf")
         self._assignment: dict[str, str] = {}  # node address -> task id
+        # colocated nodes hold SEVERAL tasks concurrently (phase 0.5
+        # capacity bin-pack); _assignment keeps the first for the
+        # one-task lookup surface, this holds the full ordered list
+        self._assignment_multi: dict[str, list[str]] = {}
         self._covered: set[str] = set()  # addresses the last solve considered
         # heartbeats arrive from worker threads (asyncio.to_thread): one lock
         # serializes solves and makes (_assignment, _covered) swaps atomic
@@ -261,6 +298,26 @@ class TpuBatchMatcher:
     def task_for_node(self, node: OrchestratorNode) -> Optional[Task]:
         return self.lookup(node)[0]
 
+    def assigned_task_ids(self, address: str) -> list[str]:
+        """Multi-assignment ids from the LAST solve, no refresh — a plain
+        dict read for callers that already resolved the node this beat
+        (the heartbeat path calls get_task_for_node first). [] for
+        non-colocated nodes."""
+        return list(self._assignment_multi.get(address, ()))
+
+    def tasks_for_node(self, node: OrchestratorNode) -> list[Task]:
+        """ALL tasks assigned to this node in the last solve: one for
+        auction/unbounded nodes, several for colocated nodes (ladder #5
+        capacity sharing). Order is placement order — the first entry is
+        what the one-task ``lookup`` surface serves."""
+        self._ensure_fresh()
+        tids = self._assignment_multi.get(node.address)
+        if not tids:
+            task, _ = self.lookup(node)
+            return [task] if task is not None else []
+        found = (self.store.task_store.get_task(t) for t in tids)
+        return [t for t in found if t is not None]
+
     def _ensure_fresh(self) -> None:
         # Re-solve only when something changed, and never more often than
         # min_solve_interval — population churn must not turn back into a
@@ -312,8 +369,13 @@ class TpuBatchMatcher:
         ladder."""
         s_bucket = int(np.asarray(er.cpu_cores).shape[0])
         tile = min(1024, s_bucket)  # pow2 buckets: tile always divides
-        cand_p, cand_c = candidates_topk(
-            ep, er, self.weights, k=self.top_k, tile=tile
+        # bidirectional candidates: reverse (provider->slot) edges keep every
+        # provider reachable when forward top-k windows pile onto the same
+        # cheap providers (coverage-capped matchings at scale — see
+        # ops/sparse.py candidates_topk_reverse)
+        cand_p, cand_c = candidates_topk_bidir(
+            ep, er, self.weights, k=self.top_k, tile=tile,
+            reverse_r=8, extra=16,
         )
         num_providers = int(np.asarray(ep.gpu_count).shape[0])
         if warm:
@@ -439,6 +501,137 @@ class TpuBatchMatcher:
                 if 0 <= r_local < len(rows):
                     results[int(rows[r_local])] = slot_task[s]
         return results
+
+    def _solve_colocation(
+        self, ep, N: int, colo, tasks, prio, taken_rows
+    ) -> dict[int, list[int]]:
+        """Phase 0.5: capacity-sharing placement (ladder #5's core
+        semantics, live). Colocate-flagged task replicas route through the
+        vector bin-pack (ops/binpack.py) with the providers' REAL
+        multi-resource capacity — [gpu count, total VRAM, cpu cores, ram,
+        storage] from the encoded columns — so several replicas (of one or
+        several tasks) stack on one provider while capacity holds.
+
+        Cost stays bounded at scale the same way as the anti-affinity
+        phase: solve over the union of each slot's top-K candidates.
+        Returns {provider row -> [task idx, ...]} in placement order."""
+        import dataclasses as _dc
+
+        from protocol_tpu.ops.binpack import assign_binpack_ffd
+
+        slot_task: list[int] = []
+        for i, r in colo:
+            take = min(r, 4096)
+            if take < r:
+                self._colo_truncated += r - take
+                logging.getLogger(__name__).warning(
+                    "colocate replica demand for task %s capped at 4096 "
+                    "slots (%d dropped this solve)", tasks[i].id, r - take,
+                )
+            slot_task.extend([i] * take)
+        S = len(slot_task)
+        self._colo_requested = S
+        if S == 0:
+            return {}
+        s_pad = _pow2_bucket(S)
+        reqs = [task_requirements(tasks[i]) for i in slot_task]
+        # Compat relaxation for capacity sharing: the DSL's gpu count gate
+        # is EXACT (reference node.rs:445-459 parity) — a 1-GPU slice
+        # would never match a 2-GPU provider. Colocated slots claim a
+        # SLICE, so drop count (and the full-provider total-memory max)
+        # from the compat side; the bin-pack's demand vector (built from
+        # the ORIGINAL requirement below) enforces the real reservation
+        # against remaining capacity. Model/per-GPU-memory gates still
+        # bind unchanged.
+        relaxed = [
+            dataclasses.replace(
+                r,
+                gpu=[
+                    dataclasses.replace(
+                        g, count=None, total_memory_max=None
+                    )
+                    for g in r.gpu
+                ],
+            )
+            for r in reqs
+        ]
+        er = self.encoder.encode_requirements(
+            relaxed,
+            priorities=[float(prio[i]) for i in slot_task],
+            pad_to=s_pad,
+        )
+        # bidirectional selection: forward-only top-k would cap the row
+        # pool at ~k cheap providers on price-dominated fleets (the same
+        # coverage cap candidates_topk_reverse's docstring measures),
+        # stranding replicas while feasible providers idle
+        cand_p, _ = candidates_topk_bidir(
+            ep, er, self.weights, k=self.top_k, tile=min(1024, s_pad),
+            reverse_r=8, extra=16,
+        )
+        rows = np.unique(np.asarray(cand_p))
+        rows = rows[rows >= 0].astype(np.int64)
+        if taken_rows:
+            rows = rows[~np.isin(rows, np.fromiter(taken_rows, np.int64))]
+        if rows.size == 0:
+            return {}
+        rpad = _pow2_bucket(len(rows))
+        gather = np.concatenate([rows, np.zeros(rpad - len(rows), np.int64)])
+        sub_ep = jax.tree.map(
+            lambda a: jnp.take(a, jnp.asarray(gather), axis=0), ep
+        )
+        sub_valid = np.zeros(rpad, bool)
+        sub_valid[: len(rows)] = np.asarray(ep.valid)[rows]
+        sub_ep = _dc.replace(sub_ep, valid=jnp.asarray(sub_valid))
+        cost = np.asarray(_cost_only(sub_ep, er, self.weights))
+
+        # capacity from the encoded provider columns (-1 = unreported = 0:
+        # can't host what you don't report)
+        pg = np.maximum(np.asarray(sub_ep.gpu_count, np.float32)[:rpad], 0.0)
+        pvram = pg * np.maximum(
+            np.asarray(sub_ep.gpu_mem_mb, np.float32)[:rpad], 0.0
+        )
+        pc = np.maximum(np.asarray(sub_ep.cpu_cores, np.float32)[:rpad], 0.0)
+        pm = np.maximum(np.asarray(sub_ep.ram_mb, np.float32)[:rpad], 0.0)
+        ps = np.maximum(np.asarray(sub_ep.storage_gb, np.float32)[:rpad], 0.0)
+        capacity = np.stack([pg, pvram, pc, pm, ps], axis=1)
+
+        # demand from the ORIGINAL (unrelaxed) requirements: this is the
+        # reservation the bin-pack subtracts from remaining capacity.
+        # With GPU OR-alternatives, compat can match a provider via ANY
+        # option while the worker may run the largest — reserve the
+        # elementwise MAX across options (over-reserving blocks a
+        # placement; under-reserving oversubscribes a provider's GPUs,
+        # the strictly worse failure)
+        demand = np.zeros((s_pad, 5), np.float32)
+        for s, r in enumerate(reqs):
+            gcount = vram = 0.0
+            for g in r.gpu:
+                c = float(g.count or 0)
+                if g.total_memory_min is not None:
+                    v = float(g.total_memory_min)
+                else:
+                    v = c * float(g.memory_mb or g.memory_mb_min or 0)
+                gcount = max(gcount, c)
+                vram = max(vram, v)
+            demand[s] = (
+                gcount,
+                vram,
+                float(r.cpu.cores or 0) if r.cpu else 0.0,
+                float(r.ram_mb or 0),
+                float(r.storage_gb or 0),
+            )
+
+        res = assign_binpack_ffd(
+            jnp.asarray(cost),
+            jnp.asarray(demand),
+            jnp.asarray(capacity),
+        )
+        p4s = np.asarray(res.provider_for_task)[:S]
+        placed: dict[int, list[int]] = {}
+        for s, r_local in enumerate(p4s):
+            if 0 <= r_local < len(rows):
+                placed.setdefault(int(rows[r_local]), []).append(slot_task[s])
+        return placed
 
     def _location_classes(
         self, rows: np.ndarray, idx_addrs, loc_by_addr
@@ -700,6 +893,7 @@ class TpuBatchMatcher:
                 task_replicas(t)
                 task_requirements(t)
                 task_anti_affinity(t)
+                task_colocate(t)
             except Exception:
                 continue
             ok_tasks.append(t)
@@ -734,6 +928,7 @@ class TpuBatchMatcher:
         assignment: dict[str, str] = {}
         covered = {n.address for n in nodes}
         if not nodes or not tasks:
+            self._assignment_multi = {}
             self._assignment, self._covered = assignment, covered
             self._solve_seq += 1
             self.last_solve_stats = {
@@ -747,6 +942,7 @@ class TpuBatchMatcher:
         bounded: list[tuple[int, int]] = []  # (task idx, replicas)
         unbounded: list[int] = []
         aa: list[tuple[int, int, str]] = []  # (task idx, replicas, mode)
+        colo: list[tuple[int, int]] = []  # (task idx, replicas), capacity-sharing
         for i, t in enumerate(tasks):
             if t.allowed_topologies() and self._groups_plugin is not None:
                 # topology-restricted tasks are group-only when gang
@@ -758,6 +954,8 @@ class TpuBatchMatcher:
             r = task_replicas(t)
             if r is None:
                 unbounded.append(i)
+            elif task_colocate(t):
+                colo.append((i, r))
             else:
                 mode = task_anti_affinity(t)
                 if mode:
@@ -852,6 +1050,7 @@ class TpuBatchMatcher:
                 "cache_rebuilt": prepared.rebuilt,
                 "cache_delta_rows": prepared.delta_rows,
                 "cache_delta_tasks": prepared.delta_tasks,
+                "cache_uncovered_rows": prepared.uncovered_rows,
             }
         else:
             specs = [n.compute_specs for n in nodes]
@@ -874,6 +1073,7 @@ class TpuBatchMatcher:
         # providers are then excluded from the auction and phase 2.
         aa_assigned = 0
         self._aa_truncated = 0
+        claims: dict[int, int] = {}
         if aa:
             loc_by_addr = {n.address: n.location for n in nodes}
             claims = self._solve_anti_affinity(
@@ -883,24 +1083,51 @@ class TpuBatchMatcher:
                 assignment[idx_addrs[row]] = tasks[i].id
                 assigned[row] = True
             aa_assigned = len(claims)
-            if aa_assigned:
-                claimed = np.zeros(
-                    int(np.asarray(ep.valid).shape[0]), bool
-                )
-                claimed[list(claims.keys())] = True
-                # the auction must not re-assign a claimed provider: drop
-                # them from the compatibility domain (ep.valid gates
-                # compat_mask) and from any pre-assembled candidate lists
-                import dataclasses as _dc
 
-                ep = _dc.replace(
-                    ep, valid=jnp.asarray(np.asarray(ep.valid) & ~claimed)
+        # ---- phase 0.5: colocation -> capacity bin-pack (ladder #5 live:
+        # several replicas stack on one provider while its GPU/VRAM/cpu/
+        # ram/storage capacity holds — see _solve_colocation)
+        colo_slots = 0
+        self._colo_truncated = 0
+        self._colo_requested = 0
+        assignment_multi: dict[str, list[str]] = {}
+        placed: dict[int, list[int]] = {}
+        if colo:
+            placed = self._solve_colocation(
+                ep, N, colo, tasks, prio, set(claims)
+            )
+            for row, tidxs in placed.items():
+                addr = idx_addrs[row]
+                assignment[addr] = tasks[tidxs[0]].id
+                assignment_multi[addr] = [tasks[j].id for j in tidxs]
+                assigned[row] = True
+                colo_slots += len(tidxs)
+            if colo_slots < self._colo_requested:
+                # never a silent cap: unplaced colocated replicas are a
+                # capacity verdict the operator must see
+                logging.getLogger(__name__).warning(
+                    "colocation placed %d/%d replica slots (insufficient "
+                    "fleet capacity for the rest)",
+                    colo_slots, self._colo_requested,
                 )
-                if prepared is not None:
-                    cp = prepared.cand_p
-                    prepared.cand_p = np.where(
-                        (cp >= 0) & claimed[np.maximum(cp, 0)], -1, cp
-                    )
+
+        claimed_rows = list(claims) + list(placed)
+        if claimed_rows:
+            claimed = np.zeros(int(np.asarray(ep.valid).shape[0]), bool)
+            claimed[claimed_rows] = True
+            # the auction must not re-assign a claimed provider: drop
+            # them from the compatibility domain (ep.valid gates
+            # compat_mask) and from any pre-assembled candidate lists
+            import dataclasses as _dc
+
+            ep = _dc.replace(
+                ep, valid=jnp.asarray(np.asarray(ep.valid) & ~claimed)
+            )
+            if prepared is not None:
+                cp = prepared.cand_p
+                prepared.cand_p = np.where(
+                    (cp >= 0) & claimed[np.maximum(cp, 0)], -1, cp
+                )
 
         # ---- phase 1: bounded tasks -> replica slots -> auction
         if slot_task:
@@ -967,6 +1194,12 @@ class TpuBatchMatcher:
                 if not assigned[p_idx] and best[p_idx] >= 0 and best[p_idx] < len(unbounded):
                     assignment[idx_addrs[p_idx]] = tasks[unbounded[best[p_idx]]].id
 
+        # store order matters for lock-free readers (tasks_for_node checks
+        # _assignment_multi FIRST, then falls back to _assignment): writing
+        # multi before the main map means a reader racing the swap serves
+        # the previous solve wholesale — never a new-solve/old-multi mix
+        # that would hand a no-longer-colocated node a stale task list
+        self._assignment_multi = assignment_multi
         self._assignment, self._covered = assignment, covered
         self._solve_seq += 1
         self.last_solve_stats = {
@@ -974,6 +1207,9 @@ class TpuBatchMatcher:
             "tasks": len(tasks),
             "bounded_tasks": len(bounded),
             "assigned": len(assignment),
+            "colocated_slots": colo_slots,
+            "colocated_unplaced": self._colo_requested - colo_slots,
+            "truncated_colocate_slots": self._colo_truncated,
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
             "truncated_replica_slots": truncated_slots,
             "kernel": kernel_used,  # dense_auction | sparse_topk | native_cpu

@@ -353,7 +353,13 @@ class OrchestratorService:
 
         self.metrics.record_heartbeat(address)
         # the batch solve runs device work; keep it off the event loop
-        task = await asyncio.to_thread(self.scheduler.get_task_for_node, address)
+        multi = getattr(self.scheduler, "get_tasks_for_node", None)
+        if multi is not None:
+            assigned = await asyncio.to_thread(multi, address)
+        else:
+            t = await asyncio.to_thread(self.scheduler.get_task_for_node, address)
+            assigned = [t] if t is not None else []
+        task = assigned[0] if assigned else None
         matcher = getattr(self.scheduler, "batch_matcher", None)
         if matcher is not None and matcher.last_solve_stats:
             stats = matcher.last_solve_stats
@@ -364,12 +370,13 @@ class OrchestratorService:
                     backend=type(matcher).__name__,
                     pool_id=str(self.pool_id),
                 ).observe(stats["solve_ms"] / 1e3)
-        return web.json_response(
-            {
-                "success": True,
-                "data": {"current_task": task.to_dict() if task else None},
-            }
-        )
+        data: dict = {"current_task": task.to_dict() if task else None}
+        if len(assigned) > 1:
+            # colocated node (ladder #5): several tasks share this
+            # provider's capacity concurrently; multi-task-aware workers
+            # run them all, legacy workers run current_task only
+            data["assigned_tasks"] = [t.to_dict() for t in assigned]
+        return web.json_response({"success": True, "data": data})
 
     # ----- storage (api/routes/storage.rs:24-309) -----
 

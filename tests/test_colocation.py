@@ -1,0 +1,214 @@
+"""Ladder #5 capacity sharing, live in the matcher (VERDICT r3 item 5).
+
+BASELINE.md config #5's core semantics: several tasks land on ONE
+provider while its multi-resource capacity (GPU count, total VRAM, cpu,
+ram, storage) holds. Colocate-flagged tasks route through the vector
+bin-pack (ops/binpack.py) with the providers' REAL capacity vectors in
+TpuBatchMatcher phase 0.5 — not the one-task-per-provider auction. The
+reference cannot express this at all (one node, one task:
+crates/orchestrator/src/scheduler/mod.rs:26-74).
+"""
+
+import numpy as np
+import pytest
+
+from protocol_tpu.models import (
+    ComputeSpecs,
+    CpuSpecs,
+    GpuSpecs,
+    SchedulingConfig,
+    Task,
+    TaskState,
+)
+from protocol_tpu.sched import Scheduler, TpuBatchMatcher
+from protocol_tpu.sched.tpu_backend import (
+    task_colocate,
+    validate_tpu_scheduler_config,
+)
+from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+
+def mk_node(addr, gpu_count=2, cores=32, ram_mb=65536, model="H100"):
+    return OrchestratorNode(
+        address=addr,
+        status=NodeStatus.HEALTHY,
+        compute_specs=ComputeSpecs(
+            gpu=GpuSpecs(count=gpu_count, model=model, memory_mb=80000),
+            cpu=CpuSpecs(cores=cores),
+            ram_mb=ram_mb,
+            storage_gb=1000,
+        ),
+    )
+
+
+def mk_colo_task(name, created_at, replicas, requirements, colocate=True):
+    plugins = {
+        "tpu_scheduler": {
+            "replicas": [str(replicas)],
+            "compute_requirements": [requirements],
+        }
+    }
+    if colocate:
+        plugins["tpu_scheduler"]["colocate"] = ["true"]
+    return Task(
+        name=name,
+        image="img",
+        created_at=created_at,
+        state=TaskState.PENDING,
+        scheduling_config=SchedulingConfig(plugins=plugins),
+    )
+
+
+ONE_GPU = "gpu:count=1;gpu:model=H100"
+
+
+class TestColocationSolve:
+    def test_two_one_gpu_tasks_share_a_two_gpu_provider(self):
+        """THE ladder-#5 done-bar: a 2-GPU provider holds two 1-GPU tasks
+        concurrently through the real solve path."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xprov"))
+        t1 = mk_colo_task("a", 1.0, 1, ONE_GPU)
+        t2 = mk_colo_task("b", 2.0, 1, ONE_GPU)
+        ctx.task_store.add_task(t1)
+        ctx.task_store.add_task(t2)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        m.mark_dirty()
+
+        node = ctx.node_store.get_node("0xprov")
+        got = m.tasks_for_node(node)
+        assert {t.id for t in got} == {t1.id, t2.id}
+        assert m.last_solve_stats["colocated_slots"] == 2
+        # the one-task surface stays coherent: first of the list
+        assert m.task_for_node(node).id == got[0].id
+
+    def test_capacity_respected_across_providers(self):
+        """8 one-GPU replicas over a 2-GPU + 4-GPU fleet: exactly 6 seats
+        exist; GPU capacity bounds every provider's load."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xsmall", gpu_count=2))
+        ctx.node_store.add_node(mk_node("0xbig", gpu_count=4))
+        t = mk_colo_task("many", 1.0, 8, ONE_GPU)
+        ctx.task_store.add_task(t)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        m.mark_dirty()
+        m._ensure_fresh()
+
+        small = m.tasks_for_node(ctx.node_store.get_node("0xsmall"))
+        big = m.tasks_for_node(ctx.node_store.get_node("0xbig"))
+        assert len(small) == 2 and len(big) == 4
+        assert m.last_solve_stats["colocated_slots"] == 6
+
+    def test_vram_demand_bounds_stacking(self):
+        """Per-GPU memory demand 80 GB: total VRAM (2 x 80 GB) admits two
+        replicas even when gpu:count would admit more nominal slots."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xprov", gpu_count=2))
+        t = mk_colo_task(
+            "vram", 1.0, 4, "gpu:count=1;gpu:model=H100;gpu:memory_mb=80000"
+        )
+        ctx.task_store.add_task(t)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        m.mark_dirty()
+        m._ensure_fresh()
+        got = m.tasks_for_node(ctx.node_store.get_node("0xprov"))
+        assert len(got) == 2  # VRAM-bounded, not count-of-replicas
+
+    def test_colocated_provider_excluded_from_auction(self):
+        """A provider consumed by phase 0.5 must not also win a phase-1
+        auction task (one capacity model at a time)."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xprov", gpu_count=2))
+        ctx.node_store.add_node(mk_node("0xother", gpu_count=8, model="A100"))
+        colo = mk_colo_task("colo", 1.0, 2, ONE_GPU)  # H100-only slices
+        plain = mk_colo_task(
+            "plain", 2.0, 1, "gpu:count=8;gpu:model=A100", colocate=False
+        )
+        ctx.task_store.add_task(colo)
+        ctx.task_store.add_task(plain)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        m.mark_dirty()
+        m._ensure_fresh()
+
+        prov_tasks = m.tasks_for_node(ctx.node_store.get_node("0xprov"))
+        assert {t.id for t in prov_tasks} == {colo.id}
+        assert len(prov_tasks) == 2  # both replicas stacked
+        other = m.tasks_for_node(ctx.node_store.get_node("0xother"))
+        assert [t.id for t in other] == [plain.id]
+
+    def test_scheduler_and_heartbeat_surface(self):
+        """get_tasks_for_node serves the full list; get_task_for_node the
+        first — the legacy one-task surface stays intact."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xprov"))
+        t1 = mk_colo_task("a", 1.0, 1, ONE_GPU)
+        t2 = mk_colo_task("b", 2.0, 1, ONE_GPU)
+        ctx.task_store.add_task(t1)
+        ctx.task_store.add_task(t2)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        sched = Scheduler(ctx, batch_matcher=m)
+        m.mark_dirty()
+
+        multi = sched.get_tasks_for_node("0xprov")
+        assert {t.id for t in multi} == {t1.id, t2.id}
+        one = sched.get_task_for_node("0xprov")
+        assert one.id == multi[0].id
+
+    def test_unassigned_capacity_goes_to_phase2(self):
+        """Providers the bin-pack leaves untouched still flow to the
+        unbounded phase as before (no phase-0.5 over-exclusion)."""
+        ctx = StoreContext.new_test()
+        ctx.node_store.add_node(mk_node("0xprov", gpu_count=2))
+        ctx.node_store.add_node(mk_node("0xfree", gpu_count=8, model="A100"))
+        colo = mk_colo_task("colo", 1.0, 2, ONE_GPU)  # H100-only slices
+        swarm = Task(
+            name="swarm", image="img", created_at=2.0, state=TaskState.PENDING
+        )
+        ctx.task_store.add_task(colo)
+        ctx.task_store.add_task(swarm)
+        m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+        m.mark_dirty()
+        m._ensure_fresh()
+        free = m.tasks_for_node(ctx.node_store.get_node("0xfree"))
+        assert [t.id for t in free] == [swarm.id]
+
+
+class TestColocationConfig:
+    def test_colocate_requires_replicas(self):
+        t = Task(
+            name="x", image="img", created_at=1.0, state=TaskState.PENDING,
+            scheduling_config=SchedulingConfig(
+                plugins={"tpu_scheduler": {"colocate": ["true"]}}
+            ),
+        )
+        with pytest.raises(ValueError, match="replicas"):
+            validate_tpu_scheduler_config(t)
+
+    def test_colocate_excludes_anti_affinity(self):
+        t = Task(
+            name="x", image="img", created_at=1.0, state=TaskState.PENDING,
+            scheduling_config=SchedulingConfig(
+                plugins={"tpu_scheduler": {
+                    "colocate": ["true"],
+                    "replicas": ["2"],
+                    "anti_affinity": ["task"],
+                }}
+            ),
+        )
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            validate_tpu_scheduler_config(t)
+
+    def test_malformed_colocate_rejected(self):
+        t = Task(
+            name="x", image="img", created_at=1.0, state=TaskState.PENDING,
+            scheduling_config=SchedulingConfig(
+                plugins={"tpu_scheduler": {
+                    "colocate": ["maybe"], "replicas": ["2"],
+                }}
+            ),
+        )
+        with pytest.raises(ValueError, match="colocate"):
+            validate_tpu_scheduler_config(t)
+        assert task_colocate(
+            mk_colo_task("y", 1.0, 1, ONE_GPU, colocate=False)
+        ) is False
