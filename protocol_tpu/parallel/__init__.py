@@ -11,11 +11,17 @@ of replicated state).
 from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
 from protocol_tpu.parallel.auction import assign_auction_sharded
 from protocol_tpu.parallel.sinkhorn import sinkhorn_potentials_sharded
-from protocol_tpu.parallel.sparse import assign_auction_sparse_sharded
+from protocol_tpu.parallel.sparse import (
+    assign_auction_sparse_scaled_sharded,
+    assign_auction_sparse_sharded,
+    assign_auction_sparse_warm_sharded,
+)
 
 __all__ = [
     "assign_auction_sharded",
+    "assign_auction_sparse_scaled_sharded",
     "assign_auction_sparse_sharded",
+    "assign_auction_sparse_warm_sharded",
     "make_mesh",
     "pad_to_multiple",
     "sinkhorn_potentials_sharded",

@@ -36,36 +36,81 @@ from protocol_tpu.ops.sparse import frontier_bids
 _NEG = -1e18
 
 
+def assign_auction_sparse_sharded(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    mesh: Mesh,
+    eps: float = 0.01,
+    max_iters: int = 10000,
+    frontier: int = 4096,
+    retire: bool = True,
+    axis: str = "p",
+) -> AssignResult:
+    """Sparse auction with tasks sharded over ``mesh`` axis ``axis``.
+
+    cand_provider/cand_cost are [T, K] with T divisible by the mesh size.
+    Returns a replicated AssignResult. A thin wrapper over the state-
+    passing phase kernel with zero-initialized dual state — ONE shard_map
+    body serves this, the eps ladder, and the warm solve, so the
+    winner-resolution math the Jacobi parity guarantee rests on exists in
+    exactly one sharded copy.
+    """
+    T, K = cand_cost.shape
+    D = mesh.shape[axis]
+    if T % D != 0:
+        raise ValueError(f"T={T} not divisible by mesh size {D}; pad first")
+    Pn = num_providers
+    B = min(frontier, T // D)
+
+    sharding = NamedSharding(mesh, P(axis, None))
+    cand_provider = jax.device_put(cand_provider, sharding)
+    cand_cost = jax.device_put(cand_cost, sharding)
+
+    run = _build_sharded_phase(mesh, axis, Pn, B, int(max_iters), bool(retire))
+    _price, _owner, p4t, _stall = run(
+        cand_provider, cand_cost, jnp.float32(eps), jnp.int32(0),
+        jnp.zeros(Pn, jnp.float32), jnp.full(Pn, -1, jnp.int32),
+        jnp.full(T, -1, jnp.int32),
+    )
+    return AssignResult(p4t, _invert(p4t, Pn))
+
+
 @lru_cache(maxsize=64)
-def _build_sharded_auction(
+def _build_sharded_phase(
     mesh: Mesh,
     axis: str,
     Pn: int,
     B: int,
-    eps: float,
     max_iters: int,
     retire: bool,
 ):
-    # Built once per static config and cached: defining the shard_map'd
-    # closure inside the public entry point made every call a fresh Python
-    # callable, so jit/shard_map re-traced AND re-compiled the whole
-    # while_loop each solve (~9.5 s/call on the 8-dev CPU mesh vs ~ms
-    # steady-state once cached).
+    """The ONE sharded auction body: an eps PHASE that accepts carried
+    dual state (prices, owner, assignment) and returns it, so the plain
+    solve (zero state), the eps-scaling ladder, and the warm/incremental
+    solve all compose over the mesh exactly like their single-device
+    twins (ops/sparse._sparse_auction_phase). eps AND the stall limit
+    ride in as traced scalars — one cached executable serves every rung
+    of the ladder (limit <= 0 disables stall termination). Built once per
+    static config and cached: a fresh closure per call would re-trace and
+    re-compile the whole while_loop each solve (~9.5 s/call measured on
+    the 8-dev CPU mesh)."""
     D = mesh.shape[axis]
 
     @jax.jit
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None)),
-        out_specs=P(),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P()),
         check_vma=False,
     )
-    def run(cand_p_local: jax.Array, cand_c_local: jax.Array) -> jax.Array:
+    def run(cand_p_local, cand_c_local, eps, stall_limit, price0, owner0, p4t0):
         Tl, K = cand_p_local.shape
         T = Tl * D
         shard = lax.axis_index(axis)
         offset = (shard * Tl).astype(jnp.int32)
+        p4t_local = lax.dynamic_slice_in_dim(p4t0, offset, Tl)
 
         cand_valid = cand_p_local >= 0
         value_base = jnp.where(cand_valid, -cand_c_local, _NEG)  # [Tl, K]
@@ -76,14 +121,20 @@ def _build_sharded_auction(
         )
         give_up = -(2.0 * finite_max + 10.0) if retire else jnp.float32(_NEG)
 
-        def cond(state):
-            it, price, owner, p4t_local, retired = state
+        def n_assigned(p4t_l):
+            return lax.psum(jnp.sum(p4t_l >= 0), axis)
+
+        def cond(loop):
+            (it, price, owner, p4t_local, retired), best, stall = loop
             n_open = lax.psum(
                 jnp.sum((p4t_local < 0) & task_feasible & ~retired), axis
             )
-            return (it < max_iters) & (n_open > 0)
+            go = (it < max_iters) & (n_open > 0)
+            go &= (stall_limit <= 0) | (stall < stall_limit)
+            return go
 
-        def body(state):
+        def body(loop):
+            state, best, stall = loop
             it, price, owner, p4t_local, retired = state
             open_mask = (p4t_local < 0) & task_feasible & ~retired
 
@@ -106,13 +157,10 @@ def _build_sharded_auction(
             tgt = jnp.where(bidding, p1, Pn)
             gtask = offset + f_idx  # global task ids of the frontier
 
-            # local winner resolution
             win_bid_l = jnp.full(Pn, _NEG).at[tgt].max(
                 jnp.where(bidding, bid_amt, _NEG), mode="drop"
             )
-            # global max bid per provider
             win_bid = lax.pmax(win_bid_l, axis)
-            # global winner task: min global-task-id among global-max bidders
             is_winner = bidding & (bid_amt >= win_bid[p1])
             win_task_l = jnp.full(Pn, T, jnp.int32).at[tgt].min(
                 jnp.where(is_winner, gtask, T), mode="drop"
@@ -120,10 +168,7 @@ def _build_sharded_auction(
             win_task = lax.pmin(win_task_l, axis)
             got_bid = (win_bid > _NEG * 0.5) & (win_task < T)
 
-            # evictions + installs on the task rows this shard owns
-            # (explicit range masks: negative scatter indices are not
-            # reliably dropped, so map out-of-shard ids to Tl)
-            evict_g = jnp.where(got_bid & (owner >= 0), owner, T)  # global ids
+            evict_g = jnp.where(got_bid & (owner >= 0), owner, T)
             e_in = (evict_g >= offset) & (evict_g < offset + Tl)
             p4t_local = p4t_local.at[jnp.where(e_in, evict_g - offset, Tl)].set(
                 -1, mode="drop"
@@ -134,53 +179,151 @@ def _build_sharded_auction(
                 jnp.where(w_in, p_idx, -1), mode="drop"
             )
 
-            # replicated provider state
             owner = jnp.where(got_bid, win_task, owner)
             price = jnp.where(got_bid, win_bid, price)
-            return it + 1, price, owner, p4t_local, retired
+            n_now = n_assigned(p4t_local)
+            improved = n_now > best
+            best = jnp.maximum(best, n_now)
+            stall = jnp.where(improved, 0, stall + 1)
+            return (it + 1, price, owner, p4t_local, retired), best, stall
 
         state0 = (
             jnp.int32(0),
-            jnp.zeros(Pn, jnp.float32),
-            jnp.full(Pn, -1, jnp.int32),  # owner holds GLOBAL task ids
-            jnp.full(Tl, -1, jnp.int32),
+            jnp.asarray(price0, jnp.float32),
+            jnp.asarray(owner0, jnp.int32),  # GLOBAL task ids
+            p4t_local,
             jnp.zeros(Tl, bool),
         )
-        _, _, _, p4t_local, _ = lax.while_loop(cond, body, state0)
-        return lax.all_gather(p4t_local, axis).reshape(T)
+        loop0 = (state0, n_assigned(p4t_local), jnp.int32(0))
+        (_, price, owner, p4t_local, _), _best, stall = lax.while_loop(
+            cond, body, loop0
+        )
+        return price, owner, lax.all_gather(p4t_local, axis).reshape(T), stall
 
     return run
 
 
-def assign_auction_sparse_sharded(
+def assign_auction_sparse_scaled_sharded(
     cand_provider: jax.Array,
     cand_cost: jax.Array,
     num_providers: int,
     mesh: Mesh,
-    eps: float = 0.01,
-    max_iters: int = 10000,
+    eps_start: float = 4.0,
+    eps_end: float = 0.02,
+    scale: float = 0.25,
+    max_iters_per_phase: int = 4000,
     frontier: int = 4096,
-    retire: bool = True,
+    with_prices: bool = False,
+    stall_limit: int = 64,
     axis: str = "p",
-) -> AssignResult:
-    """Sparse auction with tasks sharded over ``mesh`` axis ``axis``.
+    stats_out: dict | None = None,
+):
+    """The eps-scaling ladder over the task-sharded phase kernel — the
+    multi-chip twin of ops.sparse.assign_auction_sparse_scaled with the
+    SAME phase discipline (disposable coarse phases whose retirements are
+    reversed, eps-CS repair between rungs, binding final phase with an 8x
+    stall budget, final greedy cleanup). Stage-B completeness at the 1M
+    ladder shape = bidirectional candidates + this ladder over v5e-8
+    (SCALING.md stage B2). The inter-phase repair and cleanup run on
+    replicated arrays (O(T*K) elementwise — negligible next to the
+    sharded while_loop they bracket)."""
+    from protocol_tpu.ops.sparse import (
+        _greedy_cleanup,
+        _report_stall,
+        _unassign_unhappy,
+    )
 
-    cand_provider/cand_cost are [T, K] with T divisible by the mesh size.
-    Returns a replicated AssignResult.
-    """
     T, K = cand_cost.shape
     D = mesh.shape[axis]
     if T % D != 0:
         raise ValueError(f"T={T} not divisible by mesh size {D}; pad first")
-    Pn = num_providers
     B = min(frontier, T // D)
+    sharding = NamedSharding(mesh, P(axis, None))
+    cand_p_dev = jax.device_put(cand_provider, sharding)
+    cand_c_dev = jax.device_put(cand_cost, sharding)
+
+    price = jnp.zeros(num_providers, jnp.float32)
+    owner = jnp.full(num_providers, -1, jnp.int32)
+    p4t = jnp.full(T, -1, jnp.int32)
+    run = _build_sharded_phase(
+        mesh, axis, num_providers, B, int(max_iters_per_phase), True
+    )
+    eps = eps_start
+    while True:
+        final = eps <= eps_end
+        # binding final phase gets 8x the disposable phases' stall budget
+        # (same discipline as the single-device ladder); traced scalar, so
+        # both variants share one compiled executable
+        limit = jnp.int32(stall_limit * (8 if final else 1))
+        price, owner, p4t, stall = run(
+            cand_p_dev, cand_c_dev, jnp.float32(eps), limit, price, owner, p4t
+        )
+        if final:
+            _report_stall("scaled-sharded", stall, stall_limit * 8, stats_out)
+            break
+        eps = max(eps * scale, eps_end)
+        owner, p4t = _unassign_unhappy(
+            cand_provider, cand_cost, price, owner, p4t, eps
+        )
+        # coarse-phase retirement was only a circuit breaker; the phase
+        # kernel starts each call with a fresh retired=0, so un-retire
+        # needs no explicit step here
+    p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
+    res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_prices:
+        return res, price
+    return res
+
+
+def assign_auction_sparse_warm_sharded(
+    cand_provider: jax.Array,
+    cand_cost: jax.Array,
+    num_providers: int,
+    mesh: Mesh,
+    price0: jax.Array,
+    p4t0: jax.Array,
+    eps: float = 0.02,
+    max_iters: int = 20000,
+    frontier: int = 4096,
+    stall_limit: int = 64,
+    axis: str = "p",
+    stats_out: dict | None = None,
+) -> tuple[AssignResult, jax.Array]:
+    """Incremental (delta-frontier) solve over the mesh: the multi-chip
+    twin of ops.sparse.assign_auction_sparse_warm — same seed hygiene
+    (candidate-less seeds dropped, carried prices capped below the
+    retirement floor), same eps-CS repair admission, one binding sharded
+    phase, greedy cleanup. Returns (AssignResult, final prices [P])."""
+    from protocol_tpu.ops.sparse import (
+        _greedy_cleanup,
+        _report_stall,
+        _unassign_unhappy,
+    )
+
+    T, K = cand_cost.shape
+    D = mesh.shape[axis]
+    if T % D != 0:
+        raise ValueError(f"T={T} not divisible by mesh size {D}; pad first")
+
+    task_has_cand = jnp.any(cand_provider >= 0, axis=1)
+    p4t0 = jnp.where(task_has_cand, jnp.asarray(p4t0, jnp.int32), -1)
+    finite_max = jnp.max(jnp.where(cand_provider >= 0, cand_cost, 0.0))
+    price0 = jnp.minimum(jnp.asarray(price0, jnp.float32), finite_max + 5.0)
+    owner0 = _invert(p4t0, num_providers)
+    owner0, p4t0 = _unassign_unhappy(
+        cand_provider, cand_cost, price0, owner0, p4t0, eps
+    )
 
     sharding = NamedSharding(mesh, P(axis, None))
-    cand_provider = jax.device_put(cand_provider, sharding)
-    cand_cost = jax.device_put(cand_cost, sharding)
-
-    run = _build_sharded_auction(
-        mesh, axis, Pn, B, float(eps), int(max_iters), bool(retire)
+    cand_p_dev = jax.device_put(cand_provider, sharding)
+    cand_c_dev = jax.device_put(cand_cost, sharding)
+    run = _build_sharded_phase(
+        mesh, axis, num_providers, min(frontier, T // D), int(max_iters), True
     )
-    p4t = run(cand_provider, cand_cost)
-    return AssignResult(p4t, _invert(p4t, Pn))
+    price, owner, p4t, stall = run(
+        cand_p_dev, cand_c_dev, jnp.float32(eps),
+        jnp.int32(stall_limit * 8), price0, owner0, p4t0
+    )
+    _report_stall("warm-sharded", stall, stall_limit * 8, stats_out)
+    p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
+    return AssignResult(p4t, _invert(p4t, num_providers)), price
