@@ -41,6 +41,9 @@ scale-tests:
 	PROTOCOL_TPU_SCALE_TESTS=1 $(PY) -m pytest tests/test_scale_matcher.py -v
 
 # regenerate protobuf messages for the gRPC shim
+lint:
+	python scripts/lint.py
+
 proto:
 	protoc --python_out=. protocol_tpu/proto/scheduler.proto
 

@@ -20,7 +20,7 @@ import sys
 
 import aiohttp
 
-from protocol_tpu.security import Wallet, sign_request
+from protocol_tpu.security import Wallet
 
 
 def _print(data) -> None:

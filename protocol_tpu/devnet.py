@@ -31,11 +31,7 @@ from protocol_tpu.security import Wallet, sign_request
 from protocol_tpu.services.discovery import DiscoveryService
 from protocol_tpu.services.ledger_api import LedgerApiService
 from protocol_tpu.services.orchestrator import OrchestratorService
-from protocol_tpu.services.validator import (
-    SyntheticDataValidator,
-    ToplocClient,
-    ValidatorService,
-)
+from protocol_tpu.services.validator import ValidatorService
 from protocol_tpu.services.worker import SubprocessRuntime, TaskBridge, WorkerAgent, detect_compute_specs
 from protocol_tpu.store import StoreContext
 from protocol_tpu.utils.storage import LocalDirStorageProvider

@@ -35,7 +35,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from protocol_tpu.ops.cost import EARTH_RADIUS_KM, INFEASIBLE, CostWeights
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements

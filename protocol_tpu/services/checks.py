@@ -35,7 +35,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from protocol_tpu.models.node import ComputeSpecs, CpuSpecs, GpuSpecs
+from protocol_tpu.models.node import GpuSpecs
 
 # filesystems that can never be the data volume (storage_path.rs scan)
 _PSEUDO_FS = {
@@ -269,7 +269,7 @@ def run_all_checks(
     (the probe proves an accelerator is reachable; the enumeration is what
     the marketplace matches on). Criticals gate startup; warnings print.
     """
-    from protocol_tpu.services.worker import IssueReport, detect_compute_specs
+    from protocol_tpu.services.worker import detect_compute_specs
 
     specs, report = detect_compute_specs(
         storage_path, probe_accelerator=probe_accelerator

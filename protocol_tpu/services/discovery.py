@@ -22,7 +22,6 @@ sync.rs:16,76-87,135-222); location enrichment via a pluggable resolver
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Awaitable, Callable, Optional

@@ -49,9 +49,9 @@ from aiohttp import web
 from protocol_tpu.chain import Ledger, LedgerError
 from protocol_tpu.chain.ledger import invite_digest
 from protocol_tpu.models.heartbeat import HeartbeatRequest
-from protocol_tpu.models.metric import MetricEntry, MetricKey
+from protocol_tpu.models.metric import MetricEntry
 from protocol_tpu.models.node import DiscoveryNode
-from protocol_tpu.models.task import Task, TaskRequest, TaskState
+from protocol_tpu.models.task import Task, TaskRequest
 from protocol_tpu.sched import Scheduler
 from protocol_tpu.sched.node_groups import NodeGroupsPlugin, UPLOAD_COUNTER_KEY
 from protocol_tpu.security.middleware import (

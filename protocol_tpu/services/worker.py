@@ -37,12 +37,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import hashlib
 import json
 import os
 import shutil
 import time
-import uuid
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import Optional

@@ -6,7 +6,7 @@ flow worker TaskBridge -> heartbeat -> here -> Prometheus sync.
 
 from __future__ import annotations
 
-from protocol_tpu.models.metric import MetricEntry, MetricKey
+from protocol_tpu.models.metric import MetricEntry
 from protocol_tpu.store.kv import KVStore
 
 METRIC_KEY = "orchestrator:metrics:{}:{}"  # task_id, label

@@ -7,7 +7,6 @@ plugin uses to enable/disable topologies on task create/delete (:11-55).
 
 from __future__ import annotations
 
-import json
 from typing import Callable, Optional
 
 from protocol_tpu.models.task import Task

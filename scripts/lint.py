@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Hermetic lint gate: syntax + unused-import check over the package and
+tests, runnable with no third-party linter installed (the CI `checks.yml`
+lint job additionally runs ruff with the matching rule set — E9,F63,F7,
+F82,F401 — the fail-the-build discipline of the reference's clippy
+`-D warnings`, .github/workflows/checks.yml:35-41 there)."""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+
+def unused_imports(path: pathlib.Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    imported: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    out = []
+    for name, line in imported.items():
+        # attribute roots and string references (docstring examples,
+        # __all__, fixtures) count as uses — cheap textual fallback
+        if name in used or f"{name}." in src or f'"{name}"' in src or f"'{name}'" in src:
+            continue
+        out.append(f"{path}:{line}: unused import {name}")
+    return out
+
+
+def main() -> int:
+    roots = sys.argv[1:] or ["protocol_tpu", "tests", "scripts"]
+    findings: list[str] = []
+    for root in roots:
+        p = pathlib.Path(root)
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            findings += unused_imports(f)
+    print("\n".join(findings) or f"lint clean ({', '.join(roots)})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

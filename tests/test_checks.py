@@ -7,12 +7,10 @@ the composed boot gate.
 """
 
 import json
-import os
 import socket
 import stat
 import textwrap
 
-import pytest
 
 from protocol_tpu.services.checks import (
     best_storage_path,

@@ -9,7 +9,6 @@ reference cannot express this at all (one node, one task:
 crates/orchestrator/src/scheduler/mod.rs:26-74).
 """
 
-import numpy as np
 import pytest
 
 from protocol_tpu.models import (

@@ -4,7 +4,6 @@ location resolvers."""
 import asyncio
 
 import aiohttp
-import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 

@@ -11,7 +11,7 @@ import sys
 
 import pytest
 
-from protocol_tpu.models.task import Task, TaskRequest, TaskState, VolumeMount
+from protocol_tpu.models.task import Task, TaskState, VolumeMount
 from protocol_tpu.services.docker_runtime import DockerRuntime
 
 

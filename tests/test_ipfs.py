@@ -3,7 +3,6 @@
 
 import asyncio
 import hashlib
-import json
 
 import aiohttp
 from aiohttp import web

@@ -3,7 +3,6 @@ kill-and-restart preserving nodes/tasks/groups (the reference's Redis
 outliving the process, orchestrator/src/store/core/redis.rs:38-72), and a
 SIGKILL'd writer process losing nothing that was journaled."""
 
-import json
 import os
 import signal
 import subprocess
@@ -15,7 +14,7 @@ from protocol_tpu.models.task import Task, TaskRequest
 from protocol_tpu.security import Wallet
 from protocol_tpu.sched.node_groups import NodeGroupConfiguration, NodeGroupsPlugin
 from protocol_tpu.services.orchestrator import OrchestratorService
-from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.store import NodeStatus, OrchestratorNode
 from protocol_tpu.store.kv import KVStore
 
 

@@ -6,7 +6,6 @@ import http.server
 import json
 import logging
 import threading
-import time
 
 from protocol_tpu.utils.logging import LokiHandler, setup_logging
 

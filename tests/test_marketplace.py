@@ -6,7 +6,6 @@ worker-reported host load (heartbeat) — not the identically-zero
 placeholders of round 2. Done-bar: a price change flips an assignment.
 """
 
-import numpy as np
 
 from protocol_tpu.models import (
     ComputeSpecs,

@@ -11,7 +11,7 @@ from protocol_tpu import native
 from protocol_tpu.ops.assign import assign_greedy
 from protocol_tpu.ops.cost import INFEASIBLE
 
-from tests.test_assign import greedy_oracle, matching_cost, random_cost
+from tests.test_assign import greedy_oracle, random_cost
 from tests.test_sparse import jittered_cost
 
 pytestmark = pytest.mark.skipif(

@@ -16,7 +16,6 @@ from protocol_tpu.models import (
 from protocol_tpu.sched.node_groups import (
     ENABLED_CONFIGS,
     GROUP_TASK_KEY,
-    NodeGroup,
     NodeGroupConfiguration,
     NodeGroupsPlugin,
     TaskSwitchingPolicy,

@@ -8,7 +8,6 @@ import jax
 import jax.numpy as jnp
 
 from protocol_tpu.ops.assign import assign_auction
-from protocol_tpu.ops.cost import INFEASIBLE
 from protocol_tpu.parallel import assign_auction_sharded, make_mesh
 
 from tests.test_assign import check_feasible, random_cost

@@ -6,7 +6,6 @@ import pytest
 
 from protocol_tpu.models import (
     ComputeSpecs,
-    CpuSpecs,
     GpuSpecs,
     NodeLocation,
     SchedulingConfig,
@@ -16,7 +15,7 @@ from protocol_tpu.models import (
 from protocol_tpu.models.node import ComputeRequirements, GpuRequirements
 from protocol_tpu.ops.encoding import FeatureEncoder, compat_mask
 from protocol_tpu.sched import Scheduler, TpuBatchMatcher
-from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.store import StoreContext
 
 from tests.test_scheduler import mk_node, mk_task
 

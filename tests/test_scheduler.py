@@ -1,7 +1,6 @@
 """Scheduler tests: greedy-chain parity with the reference's semantics and
 TPU batch-matcher behavior (bounded replicas + unbounded swarm tasks)."""
 
-import numpy as np
 
 from protocol_tpu.models import (
     ComputeSpecs,
@@ -13,7 +12,6 @@ from protocol_tpu.models import (
     VolumeMount,
 )
 from protocol_tpu.sched import Scheduler, TpuBatchMatcher, expand_task_for_node
-from protocol_tpu.sched.scheduler import NewestTaskPlugin
 from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
 
 

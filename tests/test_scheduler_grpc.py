@@ -4,9 +4,7 @@ kernel selection, and parity with the in-process kernels."""
 import numpy as np
 import pytest
 
-from protocol_tpu.models.node import ComputeRequirements
 from protocol_tpu.ops.encoding import FeatureEncoder
-from protocol_tpu.proto import scheduler_pb2 as pb
 from protocol_tpu.services.scheduler_grpc import (
     SchedulerBackendClient,
     encoded_to_proto,

@@ -5,7 +5,6 @@ mirroring the reference's middleware test coverage."""
 import asyncio
 import json
 
-import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 

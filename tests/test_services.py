@@ -5,7 +5,6 @@ import asyncio
 import json
 import time
 
-import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -21,7 +20,7 @@ from protocol_tpu.services.validator import (
     ToplocClient,
     ValidationResult,
 )
-from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+from protocol_tpu.store import NodeStatus, OrchestratorNode
 from protocol_tpu.utils.storage import MockStorageProvider
 
 

@@ -7,7 +7,6 @@ seam — and item 3: consecutive solves must warm-start from carried prices
 and the previous matching (the delta-frontier incremental path).
 """
 
-import numpy as np
 import pytest
 
 from protocol_tpu.models import (

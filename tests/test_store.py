@@ -1,9 +1,8 @@
 """KV engine + domain store tests (hermetic per-test stores, mirroring the
 reference's embedded-redis fixtures)."""
 
-import json
 
-from protocol_tpu.models import HeartbeatRequest, MetricEntry, MetricKey, Task, TaskState
+from protocol_tpu.models import HeartbeatRequest, MetricEntry, MetricKey, Task
 from protocol_tpu.store import (
     KVStore,
     NodeStatus,
