@@ -33,7 +33,7 @@ Selection staleness from base drift is bounded by periodic rebuilds.
 from __future__ import annotations
 
 import dataclasses
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -143,6 +143,10 @@ class PreparedSolve:
     # valid provider rows that appeared in NO task's cached top-k list and
     # were given reverse edges by the coverage repair (0 = full coverage)
     uncovered_rows: int = 0
+    # fraction of valid rows whose base (price/load) drifted beyond the
+    # selection tolerance since their candidates were chosen — the adaptive
+    # re-ground trigger (measured BEFORE any rebuild this prepare)
+    stale_frac: float = 0.0
 
 
 class CandidateCache:
@@ -154,6 +158,8 @@ class CandidateCache:
         max_invalid_frac: float = 0.25,
         reverse_r: int = 8,
         extra: int = 16,
+        stale_rel_tol: float = 0.25,
+        max_stale_frac: float | None = 0.10,
     ):
         self.encoder = encoder
         # candidate SELECTION is priority-free: the priority term shifts a
@@ -162,6 +168,16 @@ class CandidateCache:
         self._sel_weights = dataclasses.replace(weights, priority=0.0)
         self.k = k
         self.max_invalid_frac = max_invalid_frac
+        # Adaptive re-ground (replaces schedule-only cold solves): cached
+        # SELECTION was made under the base (price/load) vector at
+        # registration time; base drift re-ranks providers and silently
+        # degrades the cached top-k. A row is "stale" when its
+        # MEAN-CENTERED drift (uniform shifts preserve ranking) exceeds
+        # ``stale_rel_tol`` x the fleet's current base spread; a prepare
+        # that finds more than ``max_stale_frac`` stale rows rebuilds
+        # in place (None disables the trigger).
+        self.stale_rel_tol = stale_rel_tol
+        self.max_stale_frac = max_stale_frac
         # coverage repair: rows absent from EVERY cached list get up to
         # ``reverse_r`` reverse (provider->slot) edges, scattered into
         # ``extra`` fixed extra candidate columns per slot (fixed so the
@@ -179,6 +195,9 @@ class CandidateCache:
         self.fp_of_addr: dict[str, str] = {}
         self.cols: dict[str, np.ndarray] = {}
         self.prices = np.zeros(0, np.float32)
+        # base (price/load cost terms) as of each row's candidate
+        # SELECTION — the drift reference for the adaptive re-ground
+        self.sel_base = np.zeros(0, np.float32)
         self.entries: dict[str, _TaskEntry] = {}
         # persistent jitter cursor: delta batches must not restart the
         # tie-jitter's task index at 0, or tasks registered one per solve
@@ -198,6 +217,9 @@ class CandidateCache:
         new_cap = _pow2(need)
         self.prices = np.concatenate(
             [self.prices, np.zeros(new_cap - cap, np.float32)]
+        )
+        self.sel_base = np.concatenate(
+            [self.sel_base, np.zeros(new_cap - cap, np.float32)]
         )
         for name, arr in self.cols.items():
             pad = np.zeros((new_cap - cap,) + arr.shape[1:], arr.dtype)
@@ -228,6 +250,10 @@ class CandidateCache:
                 self.cols[name] = col
         for name in _P_FIELDS:
             self.cols[name][lo:lo + n] = np.asarray(getattr(enc, name))
+        w = self.weights
+        self.sel_base[lo:lo + n] = [
+            w.price * it.price + w.load * it.load for it in items
+        ]
         rows = np.arange(lo, lo + n, dtype=np.int32)
         for i, it in enumerate(items):
             old = self.row_of_addr.get(it.addr)
@@ -342,6 +368,21 @@ class CandidateCache:
             if delta_items
             else np.zeros(0, np.int32)
         )
+
+        # ---- adaptive re-ground: staleness bounded by MEASUREMENT, not
+        # schedule. If base drift has re-ranked too much of the fleet
+        # since selection, rebuild now (one recursion; the fresh cache
+        # reports rebuilt=True and skips this check).
+        stale_frac = self._stale_fraction()
+        if (
+            not rebuilt
+            and self.max_stale_frac is not None
+            and stale_frac > self.max_stale_frac
+        ):
+            self._clear()
+            prep = self.prepare(providers, tasks)
+            return dataclasses.replace(prep, stale_frac=stale_frac)
+
         p_bucket = _pow2(self.rows)
         ep = self._assemble_ep(p_bucket)
         base = self._base_now()
@@ -448,7 +489,26 @@ class CandidateCache:
             delta_tasks=len(delta_tasks),
             delta_rows=int(len(new_rows)),
             uncovered_rows=uncovered,
+            stale_frac=stale_frac,
         )
+
+    def _stale_fraction(self) -> float:
+        """Fraction of valid rows whose base drifted beyond the selection
+        tolerance. Drift is mean-centered (a uniform fleet-wide shift —
+        inflation — moves every row's cost equally and cannot re-rank) and
+        scaled by the current base SPREAD (the scale provider rankings
+        live on)."""
+        if self.rows == 0:
+            return 0.0
+        valid = self.cols["valid"][: self.rows]
+        if not valid.any():
+            return 0.0
+        now = self._base_now()[valid]
+        sel = self.sel_base[: self.rows][valid]
+        d = now - sel
+        d = d - d.mean()
+        scale = float(np.std(now)) + 1e-6
+        return float((np.abs(d) > self.stale_rel_tol * scale).mean())
 
     def _sub_ep(self, rows: np.ndarray) -> EncodedProviders:
         """Assemble an EncodedProviders view of a row subset (padded to a

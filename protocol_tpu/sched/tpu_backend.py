@@ -213,12 +213,16 @@ class TpuBatchMatcher:
         self.warm_start = warm_start
         self._warm_price_by_addr: dict[str, float] = {}
         # forward auctions never LOWER prices: carried prices ratchet
-        # within a warm chain. Two bounds keep that safe: the warm kernel
-        # caps entry prices below its retirement floor
-        # (ops/sparse.py assign_auction_sparse_warm), and every
-        # ``cold_every`` solves a cold re-solve re-grounds prices and
-        # candidate selection from scratch.
-        self.cold_every = 32
+        # within a warm chain. Three bounds keep that safe: the warm
+        # kernel caps entry prices below its retirement floor
+        # (ops/sparse.py assign_auction_sparse_warm), the CandidateCache
+        # rebuilds ADAPTIVELY when measured base drift has re-ranked more
+        # than max_stale_frac of the fleet (cand_cache._stale_fraction —
+        # staleness bounded by measurement, not schedule), and
+        # ``cold_every`` remains the schedule BACKSTOP for drift the
+        # measurement can't see (e.g. price ratchet on the uncached wire
+        # path, which has no selection cache to measure).
+        self.cold_every = 256
         self._warm_solves_since_cold = 0
         # degraded mode: solve with the native C++ engine instead of the
         # jitted kernels (for deployments whose accelerator is absent or
@@ -1051,6 +1055,7 @@ class TpuBatchMatcher:
                 "cache_delta_rows": prepared.delta_rows,
                 "cache_delta_tasks": prepared.delta_tasks,
                 "cache_uncovered_rows": prepared.uncovered_rows,
+                "cache_stale_frac": round(prepared.stale_frac, 4),
             }
         else:
             specs = [n.compute_specs for n in nodes]
