@@ -159,6 +159,7 @@ class CandidateCache:
         reverse_r: int = 8,
         extra: int = 16,
         stale_rel_tol: float = 0.25,
+        stale_abs_tol: float = 0.05,
         max_stale_frac: float | None = 0.10,
     ):
         self.encoder = encoder
@@ -175,8 +176,16 @@ class CandidateCache:
         # MEAN-CENTERED drift (uniform shifts preserve ranking) exceeds
         # ``stale_rel_tol`` x the fleet's current base spread; a prepare
         # that finds more than ``max_stale_frac`` stale rows rebuilds
-        # in place (None disables the trigger).
+        # in place (None disables the trigger). ``stale_abs_tol`` is the
+        # absolute floor in cost units: on a homogeneous fleet the base
+        # spread collapses to ~0 and, without the floor, load-average
+        # jitter (~0.01-0.02 in cost units) reads as "re-ranked" and
+        # rebuilds every solve (measured in the full-stack soak — warm
+        # never engaged). Re-ranking among near-ties is what the tie
+        # jitter randomizes anyway; only drift big enough to matter
+        # against real price/load differentiation should trigger.
         self.stale_rel_tol = stale_rel_tol
+        self.stale_abs_tol = stale_abs_tol
         self.max_stale_frac = max_stale_frac
         # coverage repair: rows absent from EVERY cached list get up to
         # ``reverse_r`` reverse (provider->slot) edges, scattered into
@@ -507,8 +516,8 @@ class CandidateCache:
         sel = self.sel_base[: self.rows][valid]
         d = now - sel
         d = d - d.mean()
-        scale = float(np.std(now)) + 1e-6
-        return float((np.abs(d) > self.stale_rel_tol * scale).mean())
+        tol = self.stale_rel_tol * float(np.std(now)) + self.stale_abs_tol
+        return float((np.abs(d) > tol).mean())
 
     def _sub_ep(self, rows: np.ndarray) -> EncodedProviders:
         """Assemble an EncodedProviders view of a row subset (padded to a

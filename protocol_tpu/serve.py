@@ -54,8 +54,15 @@ def _storage():
         from protocol_tpu.utils.tls import public_client_session
 
         # GCS/S3 are PUBLIC endpoints: their certs chain to system roots,
-        # not the pinned deployment CA, so they get their own session
-        return GcsStorageProvider(bucket, creds, public_client_session())
+        # not the pinned deployment CA, so they get their own session.
+        # STORAGE_ENDPOINT overrides the real GCS host (emulators, the
+        # signature-verifying fake bucket in full-stack drives).
+        endpoint = os.environ.get(
+            "STORAGE_ENDPOINT", "https://storage.googleapis.com"
+        )
+        return GcsStorageProvider(
+            bucket, creds, public_client_session(), endpoint=endpoint
+        )
     root = os.environ.get("STORAGE_DIR", "")
     if root:
         from protocol_tpu.utils.storage import LocalDirStorageProvider
@@ -238,6 +245,13 @@ async def serve_orchestrator(args) -> None:
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
             in ("1", "true", "yes"),
+            # deploy-time override of the dense/sparse cutover (cells =
+            # p_bucket * s_bucket). Small fleets land on the dense solver
+            # by default; soaks and staging set this low to exercise the
+            # production sparse + candidate-cache + warm path end to end.
+            dense_cell_budget=int(
+                os.environ.get("PROTOCOL_TPU_DENSE_CELL_BUDGET", 1 << 24)
+            ),
         )
     matcher.attach_observers()
     if groups_plugin is not None:

@@ -215,7 +215,7 @@ class OrchestratorService:
                 ),
                 api_key_middleware(
                     self.admin_api_key,
-                    ["/tasks", "/nodes", "/groups", "/metrics"],
+                    ["/tasks", "/nodes", "/groups", "/metrics", "/scheduler"],
                 ),
             ]
         )
@@ -235,6 +235,7 @@ class OrchestratorService:
         app.router.add_post("/groups/force-regroup", self.force_regroup)
         app.router.add_get("/metrics", self.get_metrics)
         app.router.add_get("/metrics/prometheus", self.get_prometheus)
+        app.router.add_get("/scheduler/stats", self.get_scheduler_stats)
         app.router.add_get("/health", self.health)
         app.router.add_get("/openapi.json", self.openapi)
         # interactive explorer over the spec (reference: Swagger UI at
@@ -814,6 +815,14 @@ class OrchestratorService:
         return web.json_response(
             {"success": True, "data": self.store.metrics_store.get_all_metrics()}
         )
+
+    async def get_scheduler_stats(self, request: web.Request) -> web.Response:
+        """Admin view of the batch matcher's last-solve stats (kernel,
+        warm usage, cache deltas, stall/truncation counters) — the
+        observability handle soak runs and operators assert against."""
+        matcher = getattr(self.scheduler, "batch_matcher", None)
+        stats = dict(matcher.last_solve_stats) if matcher is not None else {}
+        return web.json_response({"success": True, "data": stats})
 
     async def get_prometheus(self, request: web.Request) -> web.Response:
         """Prometheus exposition over the full metric-family registry

@@ -36,6 +36,7 @@ whole framework instead of two.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import json
 import os
@@ -351,10 +352,42 @@ class TaskBridge:
             sha = out.get("sha256")
             if sha and sha not in self.seen_shas:
                 self.seen_shas.add(sha)
+                # save_path names a file the workload wrote: read and ship
+                # the bytes through the signed-URL path (the reference's
+                # file_handler.rs:21-118 watches the output dir the same
+                # way). Integrity-gated: bytes that don't hash to the
+                # claimed sha are not uploaded — the work submission then
+                # follows the bodyless best-effort path unchanged.
+                data = None
+                save_path = out.get("save_path")
+                if save_path:
+
+                    def _read_verified(path=save_path, want=sha):
+                        # runs off the event loop: reading + hashing up
+                        # to 100 MB synchronously would stall heartbeats
+                        # and the control server for the whole window
+                        if os.path.getsize(path) > 100 * 1024 * 1024:
+                            return None, "exceeds the 100 MB upload cap"
+                        with open(path, "rb") as f:
+                            raw = f.read()
+                        if hashlib.sha256(raw).hexdigest() != want:
+                            return None, "does not hash to the claimed sha"
+                        return raw, None
+
+                    try:
+                        data, why = await asyncio.to_thread(_read_verified)
+                    except OSError as e:
+                        data, why = None, f"unreadable: {e}"
+                    if data is None and why:
+                        logging.getLogger(__name__).warning(
+                            "bridge output %s %s; uploading nothing",
+                            save_path, why,
+                        )
                 await self.agent.submit_output(
                     sha=sha,
                     flops=int(out.get("output_flops", 0)),
                     file_name=out.get("file_name") or out.get("save_path") or sha,
+                    data=data,
                 )
             return
         task_id = obj.get("task_id")
@@ -417,6 +450,7 @@ class WorkerAgent:
         self.orchestrator_url: Optional[str] = None
         self.current_task: Optional[Task] = None
         self.heartbeat_active = False
+        self._discovery_rejections: set[tuple] = set()
         self.known_orchestrators = [a.lower() for a in (known_orchestrators or [])]
         self.known_validators = [a.lower() for a in (known_validators or [])]
         self.p2p_id = f"worker-{node_wallet.address[:10]}"
@@ -490,7 +524,11 @@ class WorkerAgent:
 
     async def upload_to_discovery(self, urls: list[str]) -> bool:
         """Signed PUT /api/nodes with multi-URL failover
-        (services/discovery.rs:26-102)."""
+        (services/discovery.rs:26-102). Rejections are logged once per
+        distinct reason: a gate rejection (per-IP cap, whitelist, pool
+        membership) repeats every beat forever, and a silently-invisible
+        worker is an operator-hostile failure mode (a soak spent an hour
+        on exactly this)."""
         payload = self.discovery_node_payload()
         for url in urls:
             headers, body = sign_request("/api/nodes", self.node_wallet, payload)
@@ -500,6 +538,17 @@ class WorkerAgent:
                 ) as resp:
                     if resp.status == 200:
                         return True
+                    # dedup on (url, status) only: bodies can carry
+                    # per-request noise (timestamps, request ids) that
+                    # would defeat the dedup AND grow the set forever on
+                    # the every-beat retry loop
+                    key = (url, resp.status)
+                    if key not in self._discovery_rejections:
+                        self._discovery_rejections.add(key)
+                        logging.getLogger(__name__).warning(
+                            "discovery %s rejected registration (%d): %s",
+                            url, resp.status, (await resp.text())[:200],
+                        )
             except Exception:
                 continue
         return False

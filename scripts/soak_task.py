@@ -1,0 +1,28 @@
+"""Artifact-producing workload for the full-stack soak: writes random
+bytes to a temp file and reports them through the TaskBridge socket
+(the reference workload contract: docker/taskbridge/bridge.rs messages),
+driving the signed-URL upload + IPFS mirror + work submission path."""
+
+import hashlib
+import json
+import os
+import socket
+
+data = os.urandom(2048)
+path = f"/tmp/soak_art_{os.getpid()}.bin"
+with open(path, "wb") as f:
+    f.write(data)
+sha = hashlib.sha256(data).hexdigest()
+
+s = socket.socket(socket.AF_UNIX)
+s.connect(os.environ["SOCKET_PATH"])
+s.sendall(json.dumps({
+    "output": {
+        "sha256": sha,
+        "output_flops": 7,
+        "file_name": "out.bin",
+        "save_path": path,
+    }
+}).encode())
+s.close()
+print(f"soak task wrote {path} sha={sha}")

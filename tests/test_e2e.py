@@ -32,7 +32,7 @@ from protocol_tpu.utils.storage import MockStorageProvider
 
 from tests.test_services import make_toploc_app
 
-N_WORKERS = 4
+N_WORKERS = 8
 
 
 def specs():

@@ -1,0 +1,80 @@
+"""TaskBridge: the workload-facing unix-socket intake
+(docker/taskbridge/bridge.rs). Focus: the output message's save_path ->
+artifact-bytes path (reference file_handler.rs:21-118 semantics) with its
+integrity gate — bytes that don't hash to the claimed sha must never be
+uploaded, and the work submission still happens bodyless."""
+
+import asyncio
+import hashlib
+import json
+import os
+
+from protocol_tpu.services.worker import TaskBridge
+
+
+class StubAgent:
+    def __init__(self):
+        self.calls = []
+
+    async def submit_output(self, sha, flops, file_name, data=None):
+        self.calls.append(
+            {"sha": sha, "flops": flops, "file_name": file_name, "data": data}
+        )
+        return True
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def dispatch(msg):
+    agent = StubAgent()
+    bridge = TaskBridge("/tmp/unused.sock", agent)
+    run(bridge._dispatch(msg))
+    return agent.calls
+
+
+def output_msg(data: bytes, tmp_path, sha=None, **extra):
+    p = tmp_path / "artifact.bin"
+    p.write_bytes(data)
+    return {
+        "output": {
+            "sha256": sha or hashlib.sha256(data).hexdigest(),
+            "output_flops": 3,
+            "file_name": "artifact.bin",
+            "save_path": str(p),
+            **extra,
+        }
+    }
+
+
+def test_save_path_bytes_flow_to_submit(tmp_path):
+    data = os.urandom(512)
+    calls = dispatch(output_msg(data, tmp_path))
+    assert len(calls) == 1
+    assert calls[0]["data"] == data
+    assert calls[0]["sha"] == hashlib.sha256(data).hexdigest()
+
+
+def test_sha_mismatch_uploads_nothing_but_submits(tmp_path):
+    calls = dispatch(output_msg(os.urandom(512), tmp_path, sha="ab" * 32))
+    assert len(calls) == 1
+    assert calls[0]["data"] is None  # integrity gate held
+    assert calls[0]["sha"] == "ab" * 32  # bodyless legacy submission intact
+
+
+def test_missing_file_is_bodyless(tmp_path):
+    msg = output_msg(b"x", tmp_path)
+    os.unlink(msg["output"]["save_path"])
+    calls = dispatch(msg)
+    assert len(calls) == 1 and calls[0]["data"] is None
+
+
+def test_duplicate_sha_deduped(tmp_path):
+    data = os.urandom(64)
+    agent = StubAgent()
+    bridge = TaskBridge("/tmp/unused.sock", agent)
+    msg = output_msg(data, tmp_path)
+    run(bridge._dispatch(msg))
+    run(bridge._dispatch(json.loads(json.dumps(msg))))
+    assert len(agent.calls) == 1  # bridge.rs:150-156 dedup
