@@ -192,6 +192,18 @@ def candidates_topk_reverse(
 
     Returns (cand_p [T,k], cand_c [T,k], rev_t [P,r] i32 with -1 padding,
     rev_c [P,r]). Reverse costs carry the same tie jitter as forward ones.
+
+    Reverse selection is TILE-POOLED, not exact global top-r: each tile
+    contributes its per-provider top-``ceil(r / n_tiles)`` tasks and the
+    final edges are the best r of that pool. Exactness nobody needs is
+    traded for the dominant cost: an exact running top-r folds a
+    [P, r+tile] lax.top_k per tile (sort-shaped — measured +48% on the
+    whole generation pass at 65k), while the pooled fold is an argmin-
+    class reduction plus a [P, r+rt] merge. The properties completeness
+    rests on survive exactly: every provider still gets r feasible-if-
+    any edges into DISTINCT good tasks, and the single best edge per
+    provider is the true global best (every tile's minimum is in the
+    pool).
     """
     if weights is None:
         weights = CostWeights()
@@ -202,6 +214,7 @@ def candidates_topk_reverse(
     P = ep.gpu_count.shape[0]
     k = min(k, int(P))
     r = min(reverse_r, T)
+    rt = max(1, -(-r // n_tiles))  # per-tile contribution (ceil div)
 
     def step(carry, t0):
         rev_c0, rev_t0 = carry  # [P, r] running best (smallest) costs/tasks
@@ -211,15 +224,21 @@ def candidates_topk_reverse(
             ep, er, weights, t0, tile, k,
             provider_offset, task_offset, approx_recall,
         )
-        # reverse: fold this tile into each provider's running top-r tasks
-        tid = (t0 + jnp.arange(tile, dtype=jnp.int32))[None, :]
-        merged_c = jnp.concatenate([rev_c0, cost], axis=1)  # [P, r+tile]
-        merged_t = jnp.concatenate(
-            [rev_t0, jnp.broadcast_to(tid, cost.shape)], axis=1
-        )
-        neg_c, j = lax.top_k(-merged_c, r)
+        # reverse: this tile's per-provider top-rt, then a tiny merge
+        tid = t0 + jnp.arange(tile, dtype=jnp.int32)
+        if rt == 1:
+            j = jnp.argmin(cost, axis=1)
+            tile_c = jnp.take_along_axis(cost, j[:, None], axis=1)
+            tile_t = tid[j][:, None]
+        else:
+            neg, j = lax.top_k(-cost, rt)
+            tile_c = -neg
+            tile_t = tid[j]
+        merged_c = jnp.concatenate([rev_c0, tile_c], axis=1)  # [P, r+rt]
+        merged_t = jnp.concatenate([rev_t0, tile_t], axis=1)
+        neg_c, m = lax.top_k(-merged_c, r)
         rev_c1 = -neg_c
-        rev_t1 = jnp.take_along_axis(merged_t, j, axis=1)
+        rev_t1 = jnp.take_along_axis(merged_t, m, axis=1)
         return (rev_c1, rev_t1), (provider, cost_k)
 
     carry0 = (

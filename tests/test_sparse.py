@@ -262,24 +262,42 @@ class TestBidirCandidates:
         return ep, er
 
     def test_reverse_edges_match_bruteforce(self):
+        """Mirrors the tile-POOLED reverse semantics exactly: each tile
+        contributes its per-provider top-ceil(r/n_tiles), the final edges
+        are the best r of the pool (with the first edge therefore the
+        true global best)."""
         from protocol_tpu.ops.sparse import candidates_topk_reverse
 
-        ep, er = encode_random_marketplace(11, 24, 16)
+        P, T, tile, r = 24, 16, 8, 3
+        ep, er = encode_random_marketplace(11, P, T)
         _, _, rev_t, rev_c = candidates_topk_reverse(
-            ep, er, k=4, tile=8, reverse_r=3
+            ep, er, k=4, tile=tile, reverse_r=r
         )
         cost = jittered_cost(np.asarray(cost_matrix(ep, er, CostWeights())[0]))
         rev_t, rev_c = np.asarray(rev_t), np.asarray(rev_c)
-        for p in range(24):
-            order = np.argsort(cost[p], kind="stable")[:3]
+        n_tiles = T // tile
+        rt = -(-r // n_tiles)
+        for p in range(P):
+            pool = []
+            for g in range(n_tiles):
+                seg = cost[p, g * tile:(g + 1) * tile]
+                for j in np.argsort(seg, kind="stable")[:rt]:
+                    pool.append((float(seg[j]), g * tile + int(j)))
+            pool.sort(key=lambda e: e[0])
             expected = [
-                int(t) if cost[p, t] < INFEASIBLE * 0.5 else -1 for t in order
+                t if c < INFEASIBLE * 0.5 else -1 for c, t in pool[:r]
             ]
             assert rev_t[p].tolist() == expected, f"provider {p}"
             feas = [i for i, t in enumerate(expected) if t >= 0]
             np.testing.assert_allclose(
-                rev_c[p][feas], cost[p, order][feas], rtol=1e-6
+                rev_c[p][feas], [pool[i][0] for i in feas], rtol=1e-6
             )
+            # the first edge is the true global best (exactness property
+            # the pooling preserves)
+            if expected and expected[0] >= 0:
+                assert expected[0] == int(
+                    np.argsort(cost[p], kind="stable")[0]
+                )
 
     def test_merge_scatter_exact_and_deduped(self):
         """Per task, the merged extra columns hold the cheapest <=extra
