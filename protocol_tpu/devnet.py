@@ -298,20 +298,23 @@ async def main(argv=None) -> None:
         if args.runtime == "docker":
             from protocol_tpu.services.docker_runtime import DockerRuntime
 
-            runtime = DockerRuntime(socket_path=socket_path)
+            def runtime_factory(slot=None, sp=socket_path):
+                return DockerRuntime(socket_path=sp, slot=slot)
         else:
-            runtime = SubprocessRuntime(socket_path=socket_path)
+            def runtime_factory(slot=None, sp=socket_path):
+                return SubprocessRuntime(socket_path=sp)
         agent = WorkerAgent(
             provider_wallet=provider,
             node_wallet=node,
             ledger=ledger,
             pool_id=pid,
-            runtime=runtime,
+            runtime=runtime_factory(),
             compute_specs=specs,
             port=wport,
             http=session,
             known_orchestrators=[manager.address],
             known_validators=[validator_wallet.address],
+            runtime_factory=runtime_factory,
         )
         agent.register_on_ledger()
         ledger.whitelist_provider(provider.address)  # devnet auto-onboards

@@ -52,13 +52,16 @@ class HeartbeatRequest:
     # worker-reported host utilization 0..1 (external to this pool's own
     # assignment so the matcher's load term cannot feed back into itself)
     load: Optional[float] = None
+    # colocated extras (ladder #5): {task_id: state} for every assigned
+    # task running CONCURRENTLY beyond the primary current_task
+    extra_task_states: Optional[dict] = None
 
     def task_state_enum(self) -> Optional[TaskState]:
         return TaskState.parse(self.task_state) if self.task_state else None
 
     def to_dict(self) -> dict:
         d: dict = {"address": self.address}
-        for k in ("task_id", "task_state", "metrics", "version", "timestamp", "p2p_id", "p2p_addresses", "load"):
+        for k in ("task_id", "task_state", "metrics", "version", "timestamp", "p2p_id", "p2p_addresses", "load", "extra_task_states"):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -81,4 +84,5 @@ class HeartbeatRequest:
             if d.get("task_details")
             else None,
             load=float(d["load"]) if d.get("load") is not None else None,
+            extra_task_states=d.get("extra_task_states"),
         )

@@ -561,12 +561,17 @@ async def serve_worker(args) -> None:
         from protocol_tpu.services.docker_runtime import DockerRuntime
 
         # the SAME binary the boot gate just validated
-        runtime = DockerRuntime(
-            socket_path=args.socket_path,
-            docker_bin=os.environ.get("PROTOCOL_TPU_DOCKER_BIN", "docker"),
-        )
+        def runtime_factory(slot=None):
+            return DockerRuntime(
+                socket_path=args.socket_path,
+                docker_bin=os.environ.get("PROTOCOL_TPU_DOCKER_BIN", "docker"),
+                slot=slot,
+            )
     else:
-        runtime = SubprocessRuntime(socket_path=args.socket_path)
+        def runtime_factory(slot=None):
+            return SubprocessRuntime(socket_path=args.socket_path)
+
+    runtime = runtime_factory()
     ipfs = None
     if os.environ.get("IPFS_API_URL"):
         from protocol_tpu.utils.ipfs import IpfsMirror
@@ -590,6 +595,10 @@ async def serve_worker(args) -> None:
         # every orchestrator/validator dial
         control_scheme="https" if server_ssl is not None else "http",
         public_http="lazy",
+        # colocated assignments (ladder #5) run concurrently, one runtime
+        # per extra task (docker identities are per task id, so containers
+        # never collide)
+        runtime_factory=runtime_factory,
     )
     agent.register_on_ledger()
     bridge = TaskBridge(args.socket_path, agent)

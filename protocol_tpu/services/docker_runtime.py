@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import re
 import time
 from typing import Optional
 
@@ -158,9 +159,11 @@ class DockerRuntime(TaskRuntime):
         system_memory_mb: Optional[int] = None,
         gpu_device_ids: Optional[list[str]] = None,  # None = no GPU request
         host_network: bool = True,
+        slot: Optional[str] = None,  # colocation: per-runtime sub-namespace
     ):
         self.cli = DockerCli(docker_bin)
         self.socket_path = socket_path
+        self.slot = slot
         self.system_memory_mb = system_memory_mb
         self.gpu_device_ids = gpu_device_ids
         self.host_network = host_network
@@ -179,13 +182,31 @@ class DockerRuntime(TaskRuntime):
         self._current_name: Optional[str] = None
         self._last_task_state: Optional[TaskState] = None
 
-    # container identity: node scope + task id + config hash, so any
-    # env/cmd/image change is a different container (service.rs:69-74).
-    # The node scope keeps workers sharing one docker daemon (devnet) from
-    # reconciling away each other's containers — the reference assumes one
-    # worker per dockerd and needs no scope.
+    # container identity: node scope (+ colocation slot) + task id +
+    # config hash, so any env/cmd/image change is a different container
+    # (service.rs:69-74). The node scope keeps workers sharing one docker
+    # daemon (devnet) from reconciling away each other's containers — the
+    # reference assumes one worker per dockerd and needs no scope. The
+    # SLOT does the same between a node's own colocated runtimes: the
+    # stale-container sweep in reconcile_once removes everything under
+    # this runtime's prefix, so without a per-runtime slot the primary
+    # and each extra would destroy each other's containers every beat
+    # (and apply(None) on a departing extra would sweep the whole node).
+    # The slot segment is "s" + 8 hex, unambiguous against task-id
+    # segments (uuid hex never starts with "s"), so the slotless primary
+    # can recognize — and skip — foreign slotted containers.
     def _name_prefix(self) -> str:
-        return f"{TASK_PREFIX}-{self._scope}" if self._scope else TASK_PREFIX
+        parts = [TASK_PREFIX]
+        if self._scope:
+            parts.append(self._scope)
+        if self.slot:
+            parts.append(f"s{self.slot}")
+        return "-".join(parts)
+
+    @staticmethod
+    def _is_slotted(rest: str) -> bool:
+        """Does the post-prefix remainder start with a slot segment?"""
+        return bool(re.match(r"^s[0-9a-f]{8}-", rest))
 
     def container_name(self, task: Task) -> str:
         return f"{self._name_prefix()}-{task.id}-{task.generate_config_hash()[:16]}"
@@ -219,10 +240,14 @@ class DockerRuntime(TaskRuntime):
             self._compose_logs(None)
             return
 
-        prefix = self._name_prefix()
+        prefix = self._name_prefix() + "-"
         for name in names:
-            if name.startswith(prefix) and name != expected:
-                await self.cli.remove(name)
+            if not name.startswith(prefix) or name == expected:
+                continue
+            if self.slot is None and self._is_slotted(name[len(prefix):]):
+                # a colocated sibling's container, not this slot's stale
+                continue
+            await self.cli.remove(name)
 
         if task is None or expected is None:
             self._cached_state = (None, TaskState.UNKNOWN, None)

@@ -388,6 +388,11 @@ class TaskBridge:
                     flops=int(out.get("output_flops", 0)),
                     file_name=out.get("file_name") or out.get("save_path") or sha,
                     data=data,
+                    # colocated workloads share ONE bridge socket: the
+                    # message's own task id (either placement) keeps an
+                    # extra task's artifact from being attributed to the
+                    # primary; absent -> current_task (legacy workloads)
+                    task_id=out.get("task_id") or obj.get("task_id"),
                 )
             return
         task_id = obj.get("task_id")
@@ -427,6 +432,12 @@ class WorkerAgent:
         # system-trust session on first external PUT (serve.py) so a pinned
         # deployment CA can't break GCS uploads and a worker that never
         # uploads never holds the extra session
+        runtime_factory=None,  # (slot: str) -> TaskRuntime: enables
+        # CONCURRENT execution of colocated assignments (heartbeat
+        # assigned_tasks, ladder #5) — one runtime per extra task, slot
+        # is a stable 8-hex discriminator the DockerRuntime uses to keep
+        # sibling reconciles from sweeping each other's containers.
+        # None = legacy single-task behavior (extras ignored)
     ):
         self.ipfs = ipfs
         # advertised ask price (cost units/hour), carried through discovery
@@ -451,6 +462,8 @@ class WorkerAgent:
         self.current_task: Optional[Task] = None
         self.heartbeat_active = False
         self._discovery_rejections: set[tuple] = set()
+        self.runtime_factory = runtime_factory
+        self.extra_runtimes: dict[str, TaskRuntime] = {}  # task id -> runtime
         self.known_orchestrators = [a.lower() for a in (known_orchestrators or [])]
         self.known_validators = [a.lower() for a in (known_validators or [])]
         self.p2p_id = f"worker-{node_wallet.address[:10]}"
@@ -775,6 +788,14 @@ class WorkerAgent:
             "task_details": details.to_dict() if details else None,
             "load": self._host_load(),
         }
+        if self.extra_runtimes:
+            # colocated extras report alongside the primary task (additive
+            # field; the orchestrator's FSM keys off the primary)
+            states: dict[str, Optional[str]] = {}
+            for tid, rt in self.extra_runtimes.items():
+                _tid, st, _details = rt.state()
+                states[tid] = st.value if st else None
+            payload["extra_task_states"] = states
         headers, body = sign_request("/heartbeat", self.node_wallet, payload)
         try:
             async with self.http.post(
@@ -786,15 +807,56 @@ class WorkerAgent:
         except Exception:
             return None
 
-        task_dict = (data.get("data") or {}).get("current_task")
+        body_data = data.get("data") or {}
+        task_dict = body_data.get("current_task")
         new_task = Task.from_dict(task_dict) if task_dict else None
-        if (new_task.id if new_task else None) != (
-            self.current_task.id if self.current_task else None
-        ):
-            self.metrics.clear()  # metrics reset on task switch (:267-280)
+        old_id = self.current_task.id if self.current_task else None
+        if (new_task.id if new_task else None) != old_id:
+            # metrics reset on task switch (:267-280) — but ONLY the
+            # departing primary's entries: colocated extras are still
+            # running and their queued samples must survive the swap
+            for key in [k for k in self.metrics if k[0] == old_id]:
+                del self.metrics[key]
         self.current_task = new_task
         await self.runtime.apply(new_task, self.node_wallet.address)
+        if self.runtime_factory is not None:
+            # colocated extras (ladder #5): every assigned task beyond
+            # the primary runs CONCURRENTLY in its own runtime; without a
+            # factory, legacy single-task behavior (extras ignored)
+            primary_id = new_task.id if new_task else None
+            extras = [
+                Task.from_dict(d)
+                for d in body_data.get("assigned_tasks") or []
+                if d.get("id") != primary_id
+            ]
+            await self._apply_extra_tasks(extras)
         return new_task
+
+    async def _apply_extra_tasks(self, extras: list[Task]) -> None:
+        """Reconcile the per-task extra runtimes against the assignment:
+        new colocated tasks get a fresh runtime, departed ones are stopped
+        and their runtime dropped (same apply(None) semantics the primary
+        runtime uses for task switches)."""
+        want = {t.id: t for t in extras}
+        for tid in [t for t in self.extra_runtimes if t not in want]:
+            rt = self.extra_runtimes.pop(tid)
+            try:
+                await rt.apply(None, self.node_wallet.address)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "stopping colocated task %s failed", tid
+                )
+        for tid, task in want.items():
+            rt = self.extra_runtimes.get(tid)
+            if rt is None:
+                slot = hashlib.sha256(tid.encode()).hexdigest()[:8]
+                rt = self.extra_runtimes[tid] = self.runtime_factory(slot)
+            try:
+                await rt.apply(task, self.node_wallet.address)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "applying colocated task %s failed", tid
+                )
 
     # ----- bridge output -> upload + work submission -----
 
@@ -831,6 +893,7 @@ class WorkerAgent:
         file_name: str,
         data: Optional[bytes] = None,
         max_retries: int = 5,
+        task_id: Optional[str] = None,
     ) -> bool:
         """Upload the artifact then submit the work key on the ledger
         (docker/taskbridge/file_handler.rs:21-118): request a signed URL
@@ -846,7 +909,8 @@ class WorkerAgent:
                 "file_size": len(data) if data is not None else 0,
                 "file_type": "application/octet-stream",
                 "sha256": sha,
-                "task_id": self.current_task.id if self.current_task else None,
+                "task_id": task_id
+                or (self.current_task.id if self.current_task else None),
             }
 
             class _Fatal(Exception):

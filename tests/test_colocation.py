@@ -211,3 +211,130 @@ class TestColocationConfig:
         assert task_colocate(
             mk_colo_task("y", 1.0, 1, ONE_GPU, colocate=False)
         ) is False
+
+
+class RecordingRuntime:
+    """TaskRuntime test double: records applies, reports RUNNING."""
+
+    def __init__(self, log):
+        self.log = log
+        self.task = None
+
+    async def apply(self, task, node_address):
+        self.log.append((id(self), task.id if task else None))
+        self.task = task
+
+    def state(self):
+        if self.task is None:
+            return None, None, None
+        return self.task.id, TaskState.RUNNING, None
+
+
+class TestWorkerConcurrentExecution:
+    """The worker half of ladder #5: every colocated assignment beyond
+    the primary runs CONCURRENTLY in its own runtime, reconciled per
+    heartbeat (new task -> new runtime; departed -> apply(None))."""
+
+    def _agent(self, log):
+        import asyncio
+
+        from protocol_tpu.chain import Ledger
+        from protocol_tpu.security import Wallet
+        from protocol_tpu.services.worker import WorkerAgent
+
+        agent = WorkerAgent(
+            Wallet.from_seed(b"colo-p"), Wallet.from_seed(b"colo-n"),
+            Ledger(), 0,
+            runtime=RecordingRuntime(log),
+            runtime_factory=lambda slot=None: RecordingRuntime(log),
+        )
+        return agent, asyncio.new_event_loop()
+
+    def test_extras_start_and_reconcile(self):
+        log: list = []
+        agent, loop = self._agent(log)
+        t1 = mk_colo_task("a", 1.0, 1, ONE_GPU)
+        t2 = mk_colo_task("b", 2.0, 1, ONE_GPU)
+        t3 = mk_colo_task("c", 3.0, 1, ONE_GPU)
+
+        loop.run_until_complete(agent._apply_extra_tasks([t2, t3]))
+        assert set(agent.extra_runtimes) == {t2.id, t3.id}
+        running = {rt.task.id for rt in agent.extra_runtimes.values()}
+        assert running == {t2.id, t3.id}
+
+        # t3 departs, t1 arrives: t3's runtime stopped AND dropped
+        gone_rt = agent.extra_runtimes[t3.id]
+        loop.run_until_complete(agent._apply_extra_tasks([t2, t1]))
+        assert set(agent.extra_runtimes) == {t2.id, t1.id}
+        assert gone_rt.task is None  # apply(None) stopped it
+
+        # heartbeat payload reports every extra's state
+        states = {
+            tid: rt.state()[1] for tid, rt in agent.extra_runtimes.items()
+        }
+        assert all(s == TaskState.RUNNING for s in states.values())
+
+    def test_heartbeat_reply_drives_concurrent_runtimes(self):
+        """End to end through the REAL orchestrator heartbeat route: a
+        colocated 2-GPU node receives assigned_tasks and runs both."""
+        import asyncio
+
+        import aiohttp
+        from aiohttp.test_utils import TestServer
+
+        from protocol_tpu.chain import Ledger
+        from protocol_tpu.security import Wallet
+        from protocol_tpu.services.orchestrator import OrchestratorService
+        from protocol_tpu.services.worker import WorkerAgent
+        from protocol_tpu.utils.storage import MockStorageProvider
+
+        async def flow():
+            ledger = Ledger()
+            creator = Wallet.from_seed(b"cw")
+            manager = Wallet.from_seed(b"cm")
+            provider = Wallet.from_seed(b"cp")
+            nodew = Wallet.from_seed(b"cn")
+            ledger.mint(provider.address, 1000)
+            did = ledger.create_domain("d")
+            pid = ledger.create_pool(did, creator.address, manager.address, "")
+            ledger.start_pool(pid, creator.address)
+            ledger.register_provider(provider.address, 100)
+            ledger.whitelist_provider(provider.address)
+            ledger.add_compute_node(provider.address, nodew.address)
+
+            ctx = StoreContext.new_test()
+            m = TpuBatchMatcher(ctx, min_solve_interval=0.0)
+            svc = OrchestratorService(
+                ledger, pid, manager, store=ctx,
+                scheduler=Scheduler(ctx, batch_matcher=m),
+                storage=MockStorageProvider(),
+            )
+            ctx.node_store.add_node(mk_node(nodew.address, gpu_count=2))
+            t1 = mk_colo_task("a", 1.0, 1, ONE_GPU)
+            t2 = mk_colo_task("b", 2.0, 1, ONE_GPU)
+            ctx.task_store.add_task(t1)
+            ctx.task_store.add_task(t2)
+            m.mark_dirty()
+
+            server = TestServer(svc.make_app())
+            await server.start_server()
+            log: list = []
+            async with aiohttp.ClientSession() as session:
+                agent = WorkerAgent(
+                    provider, nodew, ledger, pid,
+                    runtime=RecordingRuntime(log),
+                    runtime_factory=lambda slot=None: RecordingRuntime(log),
+                    http=session,
+                )
+                agent.orchestrator_url = str(server.make_url("")).rstrip("/")
+                agent.heartbeat_active = True
+                got = await agent.heartbeat_once()
+                assert got is not None
+                primary = agent.runtime.task
+                assert primary is not None
+                assert len(agent.extra_runtimes) == 1
+                extra = next(iter(agent.extra_runtimes.values())).task
+                assert {primary.id, extra.id} == {t1.id, t2.id}
+            await server.close()
+
+        asyncio.new_event_loop().run_until_complete(flow())

@@ -264,3 +264,48 @@ def test_worker_agent_heartbeat_with_docker_runtime(fake_docker):
     tid, ts, details = agent.runtime.state()
     assert (tid, ts) == ("hb", TaskState.RUNNING)
     assert details.container_id.startswith("cid-")
+
+
+def test_colocated_slots_do_not_sweep_each_other(fake_docker):
+    """Ladder #5 on docker: a node's primary (slotless) and colocated
+    extra (slotted) runtimes share one scope; each one's stale-container
+    reconcile must never remove the sibling's container, and a departing
+    extra's apply(None) must clean ONLY its own slot."""
+    docker_bin, state = fake_docker
+    addr = "0xabcdef0123456789"
+    primary = DockerRuntime(docker_bin=docker_bin)
+    extra = DockerRuntime(docker_bin=docker_bin, slot="c0ffee12")
+    ta, tb = make_task(tid="aaaa1111"), make_task(tid="bbbb2222")
+
+    run(primary.apply(ta, addr))
+    run(extra.apply(tb, addr))
+    names = set(state()["containers"])
+    assert primary.container_name(ta) in names
+    assert extra.container_name(tb) in names
+    assert "s" + extra.slot + "-" in extra.container_name(tb)
+
+    # reconcile ticks on BOTH sides: nothing of the sibling's is removed
+    run(primary.reconcile_once(addr))
+    run(extra.reconcile_once(addr))
+    names = set(state()["containers"])
+    assert primary.container_name(ta) in names
+    assert extra.container_name(tb) in names
+
+    # departing extra: apply(None) sweeps its own slot only
+    run(extra.apply(None, addr))
+    names = set(state()["containers"])
+    assert extra.container_name(tb) not in names
+    assert primary.container_name(ta) in names
+
+    # primary task switch: its own old container goes, the (readded)
+    # extra's survives. Zero the restart backoff so the re-starts happen
+    # on THIS tick (the deferral is orthogonal to slot isolation).
+    extra.last_started = 0.0
+    run(extra.apply(tb, addr))
+    tc = make_task(tid="cccc3333")
+    primary.last_started = 0.0
+    run(primary.apply(tc, addr))
+    names = set(state()["containers"])
+    assert primary.container_name(ta) not in names
+    assert primary.container_name(tc) in names
+    assert extra.container_name(tb) in names

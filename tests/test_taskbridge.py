@@ -16,9 +16,10 @@ class StubAgent:
     def __init__(self):
         self.calls = []
 
-    async def submit_output(self, sha, flops, file_name, data=None):
+    async def submit_output(self, sha, flops, file_name, data=None, task_id=None):
         self.calls.append(
-            {"sha": sha, "flops": flops, "file_name": file_name, "data": data}
+            {"sha": sha, "flops": flops, "file_name": file_name,
+             "data": data, "task_id": task_id}
         )
         return True
 
@@ -78,3 +79,16 @@ def test_duplicate_sha_deduped(tmp_path):
     run(bridge._dispatch(msg))
     run(bridge._dispatch(json.loads(json.dumps(msg))))
     assert len(agent.calls) == 1  # bridge.rs:150-156 dedup
+
+
+def test_output_task_id_attribution(tmp_path):
+    """Colocated workloads share one bridge socket: the message's own
+    task_id must reach submit_output so an extra task's artifact is not
+    attributed to the primary."""
+    data = os.urandom(64)
+    msg = output_msg(data, tmp_path, task_id="task-b")
+    calls = dispatch(msg)
+    assert calls[0]["task_id"] == "task-b"
+    # absent task_id -> None (submit_output falls back to current_task)
+    msg2 = output_msg(os.urandom(64), tmp_path)
+    assert dispatch(msg2)[0]["task_id"] is None
