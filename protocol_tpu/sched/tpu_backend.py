@@ -195,6 +195,7 @@ class TpuBatchMatcher:
         top_k: int = 64,
         warm_start: bool = True,
         native_fallback: bool = False,
+        use_mesh: bool = False,
         time_fn=time.monotonic,
     ):
         self.store = store
@@ -229,11 +230,35 @@ class TpuBatchMatcher:
         # unreachable — the engine is this framework's CPU backend, not an
         # external dependency). Opt-in so tests keep covering the jax path.
         self.native_fallback = native_fallback
+        # multi-chip solves: route phase 1's eps-ladder / warm kernels
+        # through the task-sharded mesh variants (parallel/sparse.py, the
+        # v5e-8 path) when more than one device is visible. Opt-in
+        # (deploy sets PROTOCOL_TPU_USE_MESH=1 via serve): the sharded
+        # frontier schedule is a different — equally valid — auction
+        # order, and single-chip deployments gain nothing from it.
+        self.use_mesh = use_mesh
+        self._mesh = None
+        self._last_sharded = False
+        self._mesh_fallback_logged = False
         if native_fallback:
             # pin the process to the host platform NOW: the whole point is
             # an unreachable accelerator, and letting jax initialize the
-            # remote platform on first use would hang the solve path
+            # remote platform on first use would hang the solve path.
+            # MUST precede the mesh probe below — jax.devices() initializes
+            # the default backend, which is exactly the hang being avoided.
             jax.config.update("jax_platforms", "cpu")
+        if use_mesh and not native_fallback:
+            import jax as _jax
+
+            if len(_jax.devices()) > 1:
+                from protocol_tpu.parallel import make_mesh
+
+                self._mesh = make_mesh(len(_jax.devices()))
+            else:
+                logging.getLogger(__name__).warning(
+                    "use_mesh requested but only one device is visible; "
+                    "solving single-device"
+                )
         self._time = time_fn
         self._dirty = True
         self._last_solve = float("-inf")
@@ -382,16 +407,52 @@ class TpuBatchMatcher:
             reverse_r=8, extra=16,
         )
         num_providers = int(np.asarray(ep.gpu_count).shape[0])
-        if warm:
-            res, price = assign_auction_sparse_warm(
-                cand_p, cand_c, num_providers,
-                price0=jnp.asarray(price0), p4t0=jnp.asarray(p4s0),
-            )
-        else:
-            res, price = assign_auction_sparse_scaled(
-                cand_p, cand_c, num_providers, with_prices=True
-            )
+        res, price = self._sparse_solve(
+            cand_p, cand_c, num_providers, warm,
+            jnp.asarray(price0), jnp.asarray(p4s0),
+        )
         return np.asarray(res.task_for_provider), np.asarray(price)
+
+    def _sparse_solve(self, cand_p, cand_c, num_providers, warm, price0, p4t0,
+                      stats_out=None):
+        """Phase 1's solve dispatch: warm vs cold ladder, single-device vs
+        the task-sharded mesh twins (bit-identical phase discipline —
+        parallel/sparse.py) when ``use_mesh`` found devices."""
+        D = self._mesh.shape["p"] if self._mesh is not None else 0
+        self._last_sharded = D > 1 and cand_p.shape[0] % D == 0
+        if self._last_sharded:
+            from protocol_tpu.parallel import (
+                assign_auction_sparse_scaled_sharded,
+                assign_auction_sparse_warm_sharded,
+            )
+
+            if warm:
+                return assign_auction_sparse_warm_sharded(
+                    cand_p, cand_c, num_providers, self._mesh,
+                    price0=price0, p4t0=p4t0, stats_out=stats_out,
+                )
+            return assign_auction_sparse_scaled_sharded(
+                cand_p, cand_c, num_providers, self._mesh,
+                with_prices=True, stats_out=stats_out,
+            )
+        if D > 1 and not self._mesh_fallback_logged:
+            # a requested-but-never-engaging mesh must be observable, not
+            # indistinguishable from a working one
+            self._mesh_fallback_logged = True
+            logging.getLogger(__name__).warning(
+                "mesh solve requested but slot count %d is not divisible "
+                "by the %d-device mesh; solving single-device",
+                int(cand_p.shape[0]), D,
+            )
+        if warm:
+            return assign_auction_sparse_warm(
+                cand_p, cand_c, num_providers,
+                price0=price0, p4t0=p4t0, stats_out=stats_out,
+            )
+        return assign_auction_sparse_scaled(
+            cand_p, cand_c, num_providers, with_prices=True,
+            stats_out=stats_out,
+        )
 
     def _seed_slots(
         self, p4s0: np.ndarray, row_of_addr: dict, tasks, bounded, slot_range
@@ -837,18 +898,11 @@ class TpuBatchMatcher:
         cand_p = jnp.asarray(prepared.cand_p)
         cand_c = jnp.asarray(prepared.cand_c)
         stall_stats: dict = {}
-        if warm:
-            res, price = assign_auction_sparse_warm(
-                cand_p, cand_c, prepared.p_bucket,
-                price0=jnp.asarray(prepared.price0),
-                p4t0=jnp.asarray(p4s0),
-                stats_out=stall_stats,
-            )
-        else:
-            res, price = assign_auction_sparse_scaled(
-                cand_p, cand_c, prepared.p_bucket, with_prices=True,
-                stats_out=stall_stats,
-            )
+        res, price = self._sparse_solve(
+            cand_p, cand_c, prepared.p_bucket, warm,
+            jnp.asarray(prepared.price0), jnp.asarray(p4s0),
+            stats_out=stall_stats,
+        )
         self._cache.store_prices(np.asarray(price))
         self._last_warm_used = warm
         self._last_warm_seeded = seeded
@@ -1007,6 +1061,7 @@ class TpuBatchMatcher:
                     self.max_replica_slots,
                     truncated_slots,
                 )
+        self._last_sharded = False  # set by _sparse_solve when it engages
         s_bucket = _pow2_bucket(len(slot_task)) if slot_task else 0
         use_sparse = bool(slot_task) and (
             not self.native_fallback
@@ -1218,6 +1273,9 @@ class TpuBatchMatcher:
             "solve_ms": (time.perf_counter() - t_start) * 1e3,
             "truncated_replica_slots": truncated_slots,
             "kernel": kernel_used,  # dense_auction | sparse_topk | native_cpu
+            # True when phase 1 ran the task-sharded mesh kernels (the
+            # use_mesh path actually engaging, not merely requested)
+            "mesh_sharded": self._last_sharded,
             "warm": warm_used,
             "warm_seeded_slots": warm_seeded,
             # binding-phase stall circuit breaker (ops/sparse.py): True

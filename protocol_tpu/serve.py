@@ -252,6 +252,10 @@ async def serve_orchestrator(args) -> None:
             dense_cell_budget=int(
                 os.environ.get("PROTOCOL_TPU_DENSE_CELL_BUDGET", 1 << 24)
             ),
+            # multi-chip pods: solve phase 1 over the device mesh (the
+            # task-sharded eps-ladder/warm kernels, parallel/sparse.py)
+            use_mesh=os.environ.get("PROTOCOL_TPU_USE_MESH", "").lower()
+            in ("1", "true", "yes"),
         )
     matcher.attach_observers()
     if groups_plugin is not None:
