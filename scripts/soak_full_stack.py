@@ -401,6 +401,25 @@ def main() -> int:
         stack.cli("create-task", "--name", "anchor", "--image", "py",
                   "--cmd", "sleep,99999", "--replicas", "2",
                   orchestrator=True)
+        # ladder #5 under soak: colocated replicas stack onto one
+        # provider while RAM capacity holds (the hosts have no GPUs, so
+        # the demand vector is memory-shaped), each replica running
+        # CONCURRENTLY in its own worker runtime
+        import urllib.request as _rq
+        req = _rq.Request(
+            stack.url("orch") + "/tasks",
+            data=json.dumps({
+                "name": "colo", "image": "py",
+                "cmd": ["sleep", "99999"],
+                "scheduling_config": {"plugins": {"tpu_scheduler": {
+                    "replicas": ["4"], "colocate": ["true"],
+                    "compute_requirements": ["ram_mb=64"],
+                }}},
+            }).encode(),
+            headers={"Authorization": "Bearer admin",
+                     "Content-Type": "application/json"},
+        )
+        _rq.urlopen(req, timeout=10)
         art_n = 0
 
         def art_task():
@@ -488,6 +507,10 @@ def main() -> int:
         problems = []
         if not any(s.get("warm") for s in samples):
             problems.append("no warm solve observed")
+        if not any(s.get("colocated_slots", 0) >= 2 for s in samples):
+            problems.append(
+                "colocation never stacked >=2 replicas (ladder #5 silent)"
+            )
         if not any(
             s.get("_post_churn_in") and s.get("cache_delta_rows", 0) > 0
             for s in samples
@@ -534,6 +557,9 @@ def main() -> int:
             "problems": problems,
             "events": events,
             "warm_solves": sum(1 for s in samples if s.get("warm")),
+            "max_colocated_slots": max(
+                (s.get("colocated_slots", 0) for s in samples), default=0
+            ),
             "samples_total": len(samples),
             "bucket_objects": len(bucket.objects),
             "kubo_adds": len(kubo_adds),
