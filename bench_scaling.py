@@ -266,7 +266,10 @@ def main() -> None:
     # long the auction runs). Bidirectional candidates (per-provider
     # reverse edges, ops/sparse.candidates_topk_bidir) restore coverage
     # and the eps-scaled solve completes: 99.98% measured at 65k.
-    from protocol_tpu.ops.sparse import candidates_topk_bidir
+    from protocol_tpu.ops.sparse import (
+        assign_auction_sparse_scaled,
+        candidates_topk_bidir,
+    )
 
     log(f"stage B2: completeness, forward vs bidir candidates T={T_AUCTION}")
     cov_fwd = int(np.unique(np.asarray(cp)[np.asarray(cp) >= 0]).size)
@@ -310,7 +313,6 @@ def main() -> None:
     # warm win; the matcher-level win (which also skips candidate
     # regeneration via the CandidateCache) is larger — see
     # tests/test_scale_matcher.py.
-    from protocol_tpu.ops.sparse import assign_auction_sparse_scaled
     from protocol_tpu.ops.sparse import assign_auction_sparse_warm
 
     # bidir candidates from stage B2: the production path — forward-only
