@@ -396,6 +396,77 @@ def main() -> None:
     )
     log(f"  {secs_d:.3f}s, {packed}/{T_D} packed")
 
+    # ---------------- stage S: ladder #3 Sinkhorn-OT ----------------
+    # BASELINE config #3 (100k x 100k soft assignment, 1 chip): matrix-
+    # free log-domain potentials (ops/blocked.py — O(P*tile) peak, never
+    # [P, T]) + plan-guided candidate rounding.
+    from protocol_tpu.ops.blocked import (
+        assign_sinkhorn_blocked,
+        sinkhorn_potentials_blocked,
+    )
+
+    P_S = T_S = T_AUCTION
+    log(f"stage S: sinkhorn potentials + rounding P=T={P_S} (matrix-free)")
+    eps_sink, sink_iters = 0.05, 20
+    secs_pot, _ = measure(
+        lambda z: sinkhorn_potentials_blocked(
+            bench.salt_providers(jax.tree.map(jnp.asarray, epb), z),
+            erb, weights, eps=eps_sink, num_iters=sink_iters, tile=TILE,
+        )[0],
+        iters=1,
+    )
+    t0 = time.perf_counter()
+    res_s = assign_sinkhorn_blocked(
+        epb, erb, weights, eps=eps_sink, num_iters=sink_iters,
+        tile=TILE, k=32,
+    )
+    sink_assigned = int((np.asarray(res_s.provider_for_task) >= 0).sum())
+    secs_s_full = time.perf_counter() - t0
+    rows.append(
+        {
+            "stage": "S sinkhorn-OT potentials + rounding (measured)",
+            "platform": platform,
+            "shape": f"P=T={P_S} iters={sink_iters} tile={TILE}",
+            "potentials_s": round(secs_pot, 3),
+            "end_to_end_s": round(secs_s_full, 3),
+            "assigned": sink_assigned,
+        }
+    )
+    log(
+        f"  potentials {secs_pot:.3f}s; end-to-end {secs_s_full:.3f}s "
+        f"({sink_assigned}/{T_S} assigned)"
+    )
+    # ladder-#3 HBM envelope at the full 100k shape (compile-time)
+    try:
+        import dataclasses as _dc2
+
+        def _sds(obj, n):
+            out = {}
+            for f in _dc2.fields(obj):
+                a = np.asarray(getattr(obj, f.name))
+                out[f.name] = jax.ShapeDtypeStruct((n,) + a.shape[1:], a.dtype)
+            return _dc2.replace(obj, **out)
+
+        lowered = jax.jit(
+            lambda e, r: sinkhorn_potentials_blocked(
+                e, r, weights, eps=eps_sink, num_iters=sink_iters, tile=TILE
+            )
+        ).lower(_sds(epb, 100_000), _sds(erb, 100_000 // TILE * TILE))
+        ma = lowered.compile().memory_analysis()
+        hbm_gb = (ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 1e9
+        rows.append(
+            {
+                "stage": "S sinkhorn potentials (HBM envelope, compile-time)",
+                "platform": f"{platform} buffer assignment",
+                "shape": f"P=T~100k tile={TILE}",
+                "hbm_gb": round(hbm_gb, 2),
+                "fits_16gb": hbm_gb < 16,
+            }
+        )
+        log(f"  100k envelope: {hbm_gb:.2f} GB (fits 16 GB: {hbm_gb < 16})")
+    except Exception as e:
+        log(f"  sinkhorn envelope failed: {e}")
+
     print(json.dumps({"platform": platform, "devices": n_dev, "rows": rows}, indent=1))
 
 

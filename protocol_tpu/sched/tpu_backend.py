@@ -196,6 +196,7 @@ class TpuBatchMatcher:
         warm_start: bool = True,
         native_fallback: bool = False,
         use_mesh: bool = False,
+        approx_recall: Optional[float] = None,
         time_fn=time.monotonic,
     ):
         self.store = store
@@ -237,6 +238,10 @@ class TpuBatchMatcher:
         # frontier schedule is a different — equally valid — auction
         # order, and single-chip deployments gain nothing from it.
         self.use_mesh = use_mesh
+        # stage-A selection via lax.approx_max_k (TPU PartialReduce)
+        # instead of exact lax.top_k — the measured stage-A bottleneck's
+        # mitigation (SCALING.md); e.g. 0.95. None = exact.
+        self.approx_recall = approx_recall
         self._mesh = None
         self._last_sharded = False
         self._mesh_fallback_logged = False
@@ -404,7 +409,7 @@ class TpuBatchMatcher:
         # ops/sparse.py candidates_topk_reverse)
         cand_p, cand_c = candidates_topk_bidir(
             ep, er, self.weights, k=self.top_k, tile=tile,
-            reverse_r=8, extra=16,
+            reverse_r=8, extra=16, approx_recall=self.approx_recall,
         )
         num_providers = int(np.asarray(ep.gpu_count).shape[0])
         res, price = self._sparse_solve(
@@ -1159,7 +1164,14 @@ class TpuBatchMatcher:
             for row, tidxs in placed.items():
                 addr = idx_addrs[row]
                 assignment[addr] = tasks[tidxs[0]].id
-                assignment_multi[addr] = [tasks[j].id for j in tidxs]
+                # several replicas of the SAME task stacking on one
+                # provider reserve that many capacity slots, but execution
+                # is one instance per distinct task per node (the worker
+                # dedups by task id; reference semantics) — the wire list
+                # carries distinct ids only
+                assignment_multi[addr] = list(
+                    dict.fromkeys(tasks[j].id for j in tidxs)
+                )
                 assigned[row] = True
                 colo_slots += len(tidxs)
             if colo_slots < self._colo_requested:

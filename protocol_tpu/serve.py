@@ -256,6 +256,12 @@ async def serve_orchestrator(args) -> None:
             # task-sharded eps-ladder/warm kernels, parallel/sparse.py)
             use_mesh=os.environ.get("PROTOCOL_TPU_USE_MESH", "").lower()
             in ("1", "true", "yes"),
+            # stage-A approx_max_k selection (e.g. 0.95); empty = exact
+            approx_recall=(
+                float(os.environ["PROTOCOL_TPU_APPROX_RECALL"])
+                if os.environ.get("PROTOCOL_TPU_APPROX_RECALL")
+                else None
+            ),
         )
     matcher.attach_observers()
     if groups_plugin is not None:
