@@ -279,9 +279,31 @@ void fused_topk_candidates(const ProviderFeatures* pf,
                            const RequirementFeatures* rf, int32_t P, int32_t T,
                            int32_t K, int32_t W, int32_t k, float w_price,
                            float w_load, float w_proximity, float w_priority,
-                           int32_t* out_cand_provider, float* out_cand_cost) {
+                           int32_t* out_cand_provider, float* out_cand_cost,
+                           int32_t reverse_r, int32_t extra) {
+  // Bidirectional candidates (the degraded-mode twin of the JAX path's
+  // ops/sparse.candidates_topk_bidir): on price-dominated fleets every
+  // task's forward top-k holds the same cheap providers, capping the
+  // matching at the covered fraction (measured 79% at 32k). With
+  // reverse_r/extra > 0 the pass ALSO tracks EVERY provider's best-r
+  // tasks (one compare per cell against a cached worst key) and scatters
+  // them into ``extra`` appended candidate columns (cheapest-first per
+  // task, forward dups dropped) — repairing only fully-uncovered
+  // providers was measured insufficient (91.8% vs 100% assigned at 32k).
+  // Output stride becomes k + extra.
   if (k > P) k = P;
   if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
+  if (reverse_r < 0) reverse_r = 0;
+  if (extra < 0) extra = 0;
+  const bool do_rev = reverse_r > 0 && extra > 0;
+  const int32_t k_out = k + extra;
+  std::vector<uint64_t> rev;      // [P * r] packed (jittered cost, task)
+  std::vector<float> rev_worst;   // cached per-provider worst (root) cost
+  if (do_rev) {
+    rev.assign(static_cast<size_t>(P) * reverse_r,
+               pack_key(kInfeasible, 0xffffffffu));
+    rev_worst.assign(P, kInfeasible);
+  }
   // Per-solve provider precomputes: base cost term + trig for the
   // cos-product haversine form (sin^2(d/2) = (1-cos d)/2 expands into
   // products of per-side sin/cos — no per-cell trig).
@@ -521,6 +543,22 @@ void fused_topk_candidates(const ProviderFeatures* pf,
         scratch[p] = feas ? c : kInfeasible;
       }
     }
+    if (do_rev) {
+      // reverse tracking: fold task t into each provider's best-r. Hot
+      // path is one compare against the cached root; inserts are rare
+      // once the buffers warm up.
+      for (int32_t p = 0; p < P; ++p) {
+        const float c = scratch[p];
+        if (c >= rev_worst[p] || c >= kInfeasible * 0.5f) continue;
+        const float cj = c + jitter(p, t);
+        uint64_t* rb = rev.data() + static_cast<size_t>(p) * reverse_r;
+        const uint64_t key = pack_key(cj, static_cast<uint32_t>(t));
+        if (key < rb[reverse_r - 1]) {
+          sorted_insert(rb, reverse_r, key);
+          rev_worst[p] = unpack_key_cost(rb[reverse_r - 1]);
+        }
+      }
+    }
     // top-k select: vectorized reject + sorted insertion (same output
     // contract as topk_candidates on a dense row)
     uint64_t* buf = topbuf.data();
@@ -559,13 +597,58 @@ void fused_topk_candidates(const ProviderFeatures* pf,
       sorted_insert(buf, k, key);
       root = unpack_key_cost(buf[k - 1]);
     }
-    const int64_t out_base = static_cast<int64_t>(t) * k;
+    const int64_t out_base = static_cast<int64_t>(t) * k_out;
     for (int32_t j = 0; j < k; ++j) {
       const float c = unpack_key_cost(buf[j]);
       const bool feas = c < kInfeasible * 0.5f;
       out_cand_provider[out_base + j] =
           feas ? static_cast<int32_t>(buf[j] & 0xffffffffu) : -1;
       out_cand_cost[out_base + j] = c;
+    }
+    for (int32_t j = k; j < k_out; ++j) {
+      out_cand_provider[out_base + j] = -1;
+      out_cand_cost[out_base + j] = kInfeasible;
+    }
+  }
+
+  if (do_rev) {
+    // scatter EVERY provider's reverse edges into the extra columns
+    // (same guarantee as the JAX bidirectional merge: r routes into the
+    // graph per provider — repairing only fully-uncovered providers
+    // leaves single-list providers stranded, measured 91.8% vs ~100% at
+    // 32k). Sort by (task, cost) so each task keeps its cheapest
+    // ``extra``; edges duplicating a forward candidate are dropped (a
+    // dup makes v1 == v2 in the bid math — measured slower AND worse).
+    struct Edge {
+      int32_t t;
+      float c;
+      int32_t p;
+    };
+    std::vector<Edge> edges;
+    edges.reserve(static_cast<size_t>(P) * reverse_r);
+    for (int32_t p = 0; p < P; ++p) {
+      const uint64_t* rb = rev.data() + static_cast<size_t>(p) * reverse_r;
+      for (int32_t j = 0; j < reverse_r; ++j) {
+        const float c = unpack_key_cost(rb[j]);
+        if (c >= kInfeasible * 0.5f) break;  // sorted: rest infeasible
+        edges.push_back({static_cast<int32_t>(rb[j] & 0xffffffffu), c, p});
+      }
+    }
+    std::sort(edges.begin(), edges.end(), [](const Edge& a, const Edge& b) {
+      return a.t != b.t ? a.t < b.t : a.c < b.c;
+    });
+    std::vector<int32_t> fill(T, 0);
+    for (const Edge& e : edges) {
+      if (fill[e.t] >= extra) continue;
+      const int64_t row = static_cast<int64_t>(e.t) * k_out;
+      bool dup = false;
+      for (int32_t j = 0; j < k && !dup; ++j) {
+        dup = out_cand_provider[row + j] == e.p;
+      }
+      if (dup) continue;
+      const int32_t at = fill[e.t]++;
+      out_cand_provider[row + k + at] = e.p;
+      out_cand_cost[row + k + at] = e.c;
     }
   }
 }

@@ -109,7 +109,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32,
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
-        i32p, f32p,
+        i32p, f32p, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.fused_topk_candidates.restype = None
     _lib = lib
@@ -149,15 +149,21 @@ def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
 
 
 def fused_topk_candidates(
-    providers, requirements, weights=None, k: int = 64
+    providers, requirements, weights=None, k: int = 64,
+    reverse_r: int = 8, extra: int = 16,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Fused cost + per-task top-k straight from encoded features — the
-    degraded-mode twin of ops.sparse.candidates_topk (same jitter, same
-    output contract) that never materializes the [P, T] cost tensor.
+    degraded-mode twin of ops.sparse.candidates_topk_bidir (same jitter)
+    that never materializes the [P, T] cost tensor. ``reverse_r``/
+    ``extra`` enable the bidirectional completeness guarantee: EVERY
+    provider's best-``reverse_r`` tasks are scattered into ``extra``
+    appended columns (cheapest-first per task, forward dups dropped) so
+    each provider has routes into the graph no matter how forward top-k
+    windows pile up; 0 disables.
 
     ``providers`` / ``requirements`` are EncodedProviders /
     EncodedRequirements (numpy- or jax-backed); ``weights`` a CostWeights.
-    Returns (cand_provider [T, k] i32, cand_cost [T, k] f32).
+    Returns (cand_provider [T, k+extra] i32, cand_cost [T, k+extra] f32).
     """
     lib = load()
     if weights is None:
@@ -199,15 +205,20 @@ def fused_topk_candidates(
     K = ra[4].shape[1]
     W = ra[10].shape[2]
     k = min(k, P)
+    if reverse_r <= 0 or extra <= 0 or k <= 0 or T <= 0:
+        # degenerate shapes: the C++ pass early-returns without writing,
+        # so extras must not allocate (np.empty garbage would flow into
+        # the auction as out-of-range provider ids)
+        reverse_r = extra = 0
     pf = _ProviderFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in pa])
     rf = _RequirementFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in ra])
-    cand_p = np.empty((T, k), np.int32)
-    cand_c = np.empty((T, k), np.float32)
+    cand_p = np.empty((T, k + extra), np.int32)
+    cand_c = np.empty((T, k + extra), np.float32)
     lib.fused_topk_candidates(
         ctypes.byref(pf), ctypes.byref(rf), P, T, K, W, k,
         float(weights.price), float(weights.load),
         float(weights.proximity), float(weights.priority),
-        cand_p, cand_c,
+        cand_p, cand_c, reverse_r, extra,
     )
     return cand_p, cand_c
 
