@@ -84,8 +84,11 @@ class TestScaledSharded:
         res_sh, price_sh = assign_auction_sparse_scaled_sharded(
             jnp.asarray(cand_p), jnp.asarray(cand_c), mesh=mesh, **kw
         )
+        # frontier_ladder off: exact-Jacobi comparison against the
+        # fixed-frontier mesh kernel
         res_sg, price_sg = assign_auction_sparse_scaled(
-            jnp.asarray(cand_p), jnp.asarray(cand_c), **kw
+            jnp.asarray(cand_p), jnp.asarray(cand_c),
+            frontier_ladder=False, **kw
         )
         check_feasible(res_sh, cost)
         np.testing.assert_array_equal(
@@ -122,7 +125,8 @@ class TestScaledSharded:
             jnp.asarray(cand_p), jnp.asarray(cand_c), mesh=mesh, **kw
         )
         res_sg, price_sg = assign_auction_sparse_warm(
-            jnp.asarray(cand_p), jnp.asarray(cand_c), **kw
+            jnp.asarray(cand_p), jnp.asarray(cand_c),
+            frontier_ladder=False, **kw
         )
         check_feasible(res_sh, cost)
         np.testing.assert_array_equal(
