@@ -203,6 +203,52 @@ def _build_sharded_phase(
     return run
 
 
+def _run_phase_sharded(
+    mesh, axis, Pn, B0, max_iters, cand_p_dev, cand_c_dev,
+    task_feasible, eps, stall_limit, price, owner, p4t,
+    frontier_ladder,
+):
+    """One sharded eps phase, optionally in fixed-size segments with the
+    per-shard frontier executable direct-fit to the live open set — the
+    mesh twin of ops.sparse._phase_adaptive (same measured rationale:
+    most rounds are tail eviction chains with a small open set). The
+    per-B executables come from the lru_cache'd builder, so the ladder
+    costs at most a handful of compiles per config."""
+    D = mesh.shape[axis]
+    if not frontier_ladder:
+        run = _build_sharded_phase(mesh, axis, Pn, B0, int(max_iters), True)
+        return run(
+            cand_p_dev, cand_c_dev, jnp.float32(eps),
+            jnp.int32(stall_limit), price, owner, p4t,
+        )
+    seg_rounds = 256
+    iters_left = int(max_iters)
+    B = B0
+    carried = 0
+    floor = max(64, 512 // D)
+    while iters_left > 0:
+        run = _build_sharded_phase(mesh, axis, Pn, B, seg_rounds, True)
+        price, owner, p4t, stall = run(
+            cand_p_dev, cand_c_dev, jnp.float32(eps), jnp.int32(0),
+            price, owner, p4t,
+        )
+        # the segment kernel reports only its own trailing stall; rounds
+        # are bounded by seg_rounds so a whole-segment stall accumulates
+        s = int(stall)
+        carried = carried + seg_rounds if s >= seg_rounds else s
+        iters_left -= seg_rounds
+        open_count = int(jnp.sum((p4t < 0) & task_feasible))
+        if open_count == 0:
+            break
+        if stall_limit > 0 and carried >= int(stall_limit):
+            break
+        fit = floor
+        while fit * D < open_count and fit < B:
+            fit *= 2
+        B = min(B, fit)
+    return price, owner, p4t, jnp.int32(carried)
+
+
 def assign_auction_sparse_scaled_sharded(
     cand_provider: jax.Array,
     cand_cost: jax.Array,
@@ -217,6 +263,7 @@ def assign_auction_sparse_scaled_sharded(
     stall_limit: int = 64,
     axis: str = "p",
     stats_out: dict | None = None,
+    frontier_ladder: bool = False,
 ):
     """The eps-scaling ladder over the task-sharded phase kernel — the
     multi-chip twin of ops.sparse.assign_auction_sparse_scaled with the
@@ -245,18 +292,17 @@ def assign_auction_sparse_scaled_sharded(
     price = jnp.zeros(num_providers, jnp.float32)
     owner = jnp.full(num_providers, -1, jnp.int32)
     p4t = jnp.full(T, -1, jnp.int32)
-    run = _build_sharded_phase(
-        mesh, axis, num_providers, B, int(max_iters_per_phase), True
-    )
+    task_feasible = jnp.any(cand_provider >= 0, axis=1)
     eps = eps_start
     while True:
         final = eps <= eps_end
         # binding final phase gets 8x the disposable phases' stall budget
-        # (same discipline as the single-device ladder); traced scalar, so
-        # both variants share one compiled executable
-        limit = jnp.int32(stall_limit * (8 if final else 1))
-        price, owner, p4t, stall = run(
-            cand_p_dev, cand_c_dev, jnp.float32(eps), limit, price, owner, p4t
+        # (same discipline as the single-device ladder)
+        price, owner, p4t, stall = _run_phase_sharded(
+            mesh, axis, num_providers, B, max_iters_per_phase,
+            cand_p_dev, cand_c_dev, task_feasible, eps,
+            stall_limit * (8 if final else 1), price, owner, p4t,
+            frontier_ladder,
         )
         if final:
             _report_stall("scaled-sharded", stall, stall_limit * 8, stats_out)
@@ -288,6 +334,7 @@ def assign_auction_sparse_warm_sharded(
     stall_limit: int = 64,
     axis: str = "p",
     stats_out: dict | None = None,
+    frontier_ladder: bool = False,
 ) -> tuple[AssignResult, jax.Array]:
     """Incremental (delta-frontier) solve over the mesh: the multi-chip
     twin of ops.sparse.assign_auction_sparse_warm — same seed hygiene
@@ -317,12 +364,10 @@ def assign_auction_sparse_warm_sharded(
     sharding = NamedSharding(mesh, P(axis, None))
     cand_p_dev = jax.device_put(cand_provider, sharding)
     cand_c_dev = jax.device_put(cand_cost, sharding)
-    run = _build_sharded_phase(
-        mesh, axis, num_providers, min(frontier, T // D), int(max_iters), True
-    )
-    price, owner, p4t, stall = run(
-        cand_p_dev, cand_c_dev, jnp.float32(eps),
-        jnp.int32(stall_limit * 8), price0, owner0, p4t0
+    price, owner, p4t, stall = _run_phase_sharded(
+        mesh, axis, num_providers, min(frontier, T // D), max_iters,
+        cand_p_dev, cand_c_dev, jnp.any(cand_provider >= 0, axis=1), eps,
+        stall_limit * 8, price0, owner0, p4t0, frontier_ladder,
     )
     _report_stall("warm-sharded", stall, stall_limit * 8, stats_out)
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)

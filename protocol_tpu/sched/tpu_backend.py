@@ -435,10 +435,12 @@ class TpuBatchMatcher:
                 return assign_auction_sparse_warm_sharded(
                     cand_p, cand_c, num_providers, self._mesh,
                     price0=price0, p4t0=p4t0, stats_out=stats_out,
+                    frontier_ladder=True,
                 )
             return assign_auction_sparse_scaled_sharded(
                 cand_p, cand_c, num_providers, self._mesh,
                 with_prices=True, stats_out=stats_out,
+                frontier_ladder=True,
             )
         if D > 1 and not self._mesh_fallback_logged:
             # a requested-but-never-engaging mesh must be observable, not

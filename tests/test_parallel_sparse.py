@@ -159,3 +159,30 @@ class TestScaledSharded:
         assert assigned >= T * 0.99, f"sharded bidir assigned {assigned}/{T}"
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
+
+    def test_adaptive_ladder_sharded_matches_quality(self):
+        """frontier_ladder=True on the mesh: same assignment count as the
+        fixed-frontier schedule (a different, equally valid auction
+        order), full completeness on the bidir graph."""
+        from tests.test_sparse import TestBidirCandidates
+        from protocol_tpu.ops.sparse import candidates_topk_bidir
+        from protocol_tpu.parallel import assign_auction_sparse_scaled_sharded
+
+        P = T = 1024
+        ep, er = TestBidirCandidates._priced_marketplace(P, T)
+        bp, bc = candidates_topk_bidir(
+            ep, er, k=8, tile=256, reverse_r=8, extra=16
+        )
+        mesh = make_mesh(8)
+        counts = {}
+        for ladder in (False, True):
+            res = assign_auction_sparse_scaled_sharded(
+                bp, bc, num_providers=P, mesh=mesh, frontier=1024,
+                frontier_ladder=ladder,
+            )
+            p4t = np.asarray(res.provider_for_task)
+            counts[ladder] = int((p4t >= 0).sum())
+            pos = p4t[p4t >= 0]
+            assert np.unique(pos).size == pos.size
+        assert counts[True] >= T * 0.99
+        assert counts[True] >= counts[False] - 2
