@@ -381,3 +381,54 @@ class TestBidirCandidates:
         assert assigned >= T * 0.99, f"bidir assigned only {assigned}/{T}"
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size  # injective matching
+
+
+class TestAdaptiveFrontierLadder:
+    """_phase_adaptive: segment-wise frontier shrink with host-side stall
+    accounting (the per-segment stall_limit static would re-trace the
+    kernel every boundary)."""
+
+    def test_breaker_accumulates_across_segments(self):
+        """With retirement off, an unfillable hole stalls forever; the
+        host-side breaker must accumulate whole-segment stalls and trip
+        at a limit LARGER than one segment (a single 256-round segment
+        alone can never reach it), and report the ACCUMULATED count."""
+        from protocol_tpu.ops.sparse import _phase_adaptive
+
+        cand_p = jnp.asarray([[0, 1], [0, 1], [0, 1]], jnp.int32)
+        cand_c = jnp.asarray(
+            [[1.0, 2.0], [1.1, 2.1], [1.2, 2.2]], jnp.float32
+        )
+        state, stall = _phase_adaptive(
+            cand_p, cand_c, 2, None, eps=0.5, max_iters=100_000,
+            frontier=4, retire=False, stall_limit=600,
+        )
+        rounds = int(state[0])
+        assert int(np.asarray(state[3] >= 0).sum()) == 2  # seated
+        assert rounds < 100_000, "breaker must trip before the cap"
+        assert int(stall) >= 600, "accumulated (not per-segment) stall"
+
+    def test_quality_parity_with_fixed_frontier(self):
+        """The ladder is a schedule change, not a semantics change: same
+        near-optimal quality as the fixed-frontier path."""
+        from scipy.optimize import linear_sum_assignment
+
+        from protocol_tpu.ops.sparse import assign_auction_sparse_scaled
+
+        rng = np.random.default_rng(3)
+        n = 128
+        cost = rng.uniform(0, 10, size=(n, n)).astype(np.float32)
+        order = np.argsort(cost, axis=0, kind="stable").T
+        cand_c = np.take_along_axis(cost.T, order, axis=1).astype(np.float32)
+        cand_p = order.astype(np.int32)
+        ri, ci = linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        for ladder in (False, True):
+            res = assign_auction_sparse_scaled(
+                jnp.asarray(cand_p), jnp.asarray(cand_c), num_providers=n,
+                eps_end=0.005, frontier_ladder=ladder,
+            )
+            p4t = np.asarray(res.provider_for_task)
+            assert (p4t >= 0).all()
+            got = sum(cost[p4t[t], t] for t in range(n))
+            assert got <= opt + n * 0.006, f"ladder={ladder}: {got} vs {opt}"
