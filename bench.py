@@ -185,8 +185,11 @@ def main() -> None:
     if fallback:
         log("accelerator unreachable: falling back to CPU backend at reduced scale")
         jax.config.update("jax_platforms", "cpu")
-        P = T = 4096
-        TILE = 512
+        # 16k: large enough that the greedy baseline's O(P*T) scan and
+        # cost build bite, small enough that the whole fallback bench
+        # stays ~1 min (the fused native engine solves it COMPLETE in ~1 s)
+        P = T = 16384
+        TILE = 1024
     log(f"devices: {jax.devices()}")
     log(f"building synthetic marketplace P={P} T={T}")
     ep = synth_providers(rng, P)  # numpy-backed, host-side
