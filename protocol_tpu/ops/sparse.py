@@ -543,6 +543,15 @@ def assign_auction_sparse_scaled(
     cand_provider: jax.Array,
     cand_cost: jax.Array,
     num_providers: int,
+    # eps_start=0.5 is 2.1-2.5x faster at 16k-65k with equal aggregate
+    # quality — but BREAKS small-instance price semantics: a lone
+    # bidder's first bid pumps the winner's price by the full v1-v2 gap,
+    # and without enough coarser rungs the eps-CS repair leaves the task
+    # parked on the WRONG (pricier) provider
+    # (tests/test_marketplace.py::TestPriceFlipsAssignment). The coarse
+    # start buys repair rungs, not convergence speed. Callers solving
+    # large statistical marketplaces MAY pass a finer start; the default
+    # preserves the reference's cheapest-wins semantics.
     eps_start: float = 4.0,
     eps_end: float = 0.02,
     scale: float = 0.25,
