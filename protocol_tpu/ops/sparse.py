@@ -474,7 +474,16 @@ def _sparse_auction_phase(
 def _unassign_unhappy(cand_provider, cand_cost, price, owner, p4t, eps_next):
     """eps-CS repair between phases: holders whose assignment violates the
     tighter eps re-enter the auction; happy holders stay seated (avoids both
-    full-reset cost and the mass-retirement pathology of pumped prices)."""
+    full-reset cost and the mass-retirement pathology of pumped prices).
+
+    The comparison carries a float-dust tolerance: a winning bid lands a
+    task EXACTLY at the eps-CS boundary (its new value is v2 - eps, and v2
+    becomes the new v1), so after a converged phase roughly half the
+    matching sits at deficit == eps up to float32 rounding — measured at
+    65k: 33,264/65,524 pairs within 1e-4 of the boundary, none beyond
+    eps + 1e-3. Without the tolerance a warm restart at the SAME eps
+    evicts that entire boundary population (~32k seeds for 655 churned
+    tasks) and re-solves from scratch."""
     cand_valid = cand_provider >= 0
     cand_safe = jnp.where(cand_valid, cand_provider, 0)
     value = jnp.where(cand_valid, -cand_cost - price[cand_safe], _NEG)  # [T,K]
@@ -483,7 +492,9 @@ def _unassign_unhappy(cand_provider, cand_cost, price, owner, p4t, eps_next):
     vcur = jnp.max(
         jnp.where(cand_safe == jnp.maximum(held, 0)[:, None], value, _NEG), axis=1
     )
-    unhappy = (held >= 0) & (vcur < v1 - eps_next)
+    finite_max = jnp.max(jnp.where(cand_valid, cand_cost, 0.0))
+    tol = 1e-5 * (1.0 + finite_max + jnp.max(jnp.abs(price)))
+    unhappy = (held >= 0) & (vcur < v1 - eps_next - tol)
     P = owner.shape[0]
     owner = owner.at[jnp.where(unhappy, held, P)].set(-1, mode="drop")
     p4t = jnp.where(unhappy, -1, p4t)
@@ -561,6 +572,7 @@ def assign_auction_sparse_scaled(
     stall_limit: int = 64,
     stats_out: dict | None = None,
     frontier_ladder: bool = True,
+    with_state: bool = False,
 ):
     """eps-scaling auction: geometric eps ladder with warm-started prices
     (Bertsekas' eps-scaling — total bid events O(n log(1/eps)) instead of
@@ -587,7 +599,13 @@ def assign_auction_sparse_scaled(
 
     ``with_prices=True`` additionally returns the final price vector [P] —
     the warm-start state for the NEXT solve (see
-    :func:`assign_auction_sparse_warm`).
+    :func:`assign_auction_sparse_warm`). ``with_state=True`` returns
+    (result, prices, retired [T]) — the retirement mask is dual state too:
+    forward auctions never lower prices, so a task priced out of its whole
+    candidate list STAYS priced out until a cold re-ground, and a warm
+    chain that does not carry the mask re-fights the unfillable tail's
+    full stall budget on every solve (measured: 1792 vs 476 rounds at a
+    tail-heavy 2048).
     """
     state = None
     eps = eps_start
@@ -629,9 +647,13 @@ def assign_auction_sparse_scaled(
         retired = jnp.zeros_like(retired)
         state = (it, price, owner, p4t, retired)
 
-    _, price, owner, p4t, _ = state
+    _, price, owner, p4t, retired = state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
     res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_state:
+        # a retired task the greedy cleanup managed to seat is assigned,
+        # not priced out — clear its flag in the carried state
+        return res, price, retired & (p4t < 0)
     if with_prices:
         return res, price
     return res
@@ -742,6 +764,8 @@ def assign_auction_sparse_warm(
     stall_limit: int = 64,
     stats_out: dict | None = None,
     frontier_ladder: bool = True,
+    retired0: jax.Array | None = None,
+    with_state: bool = False,
 ) -> tuple[AssignResult, jax.Array]:
     """Incremental (delta-frontier) auction solve: SURVEY §7 hard part 4.
 
@@ -763,7 +787,18 @@ def assign_auction_sparse_warm(
     partial eps-CS assignment terminates eps-optimal (Bertsekas), so the
     warm path's solution quality matches the cold path's final phase.
 
-    Returns (AssignResult, final prices [P]).
+    ``retired0`` [T] carries the previous solve's retirement mask (third
+    element of a ``with_state=True`` return). Retirement is a statement
+    about PRICES ("best value below give-up"), and forward auctions never
+    lower prices, so it stays valid across warm solves: without the mask
+    every warm solve re-bids the unfillable tail until the stall breaker
+    trips (512 wasted rounds per solve in a chain). Rows whose costs or
+    candidates changed must be cleared by the caller (the CandidateCache
+    rebuild does this wholesale). Retired-but-now-seatable pairs are still
+    caught by the greedy cleanup, which ignores the mask.
+
+    Returns (AssignResult, final prices [P]), plus the final retirement
+    mask [T] when ``with_state=True``.
     """
     # a seed for a task with NO candidates would sail through the eps-CS
     # repair (vcur == v1 == -inf is not "unhappy") and emerge as an
@@ -772,23 +807,37 @@ def assign_auction_sparse_warm(
     p4t0 = jnp.where(task_has_cand, p4t0, -1)
     # Forward auctions only raise prices, and carried prices compound
     # across warm solves. The retirement floor is give_up =
-    # -(2*max_cost + 10); cap carried prices at max_cost + 5 so the
-    # worst seeded value -max_cost - cap = -(2*max_cost + 5) stays ABOVE
-    # the floor — a ratcheted price can slow a task down but can never
-    # spuriously retire it on entry. Relative order among capped prices
-    # is lost, but those providers were priced out of contention anyway.
+    # -(2*max_cost + 10); keep the worst seeded value -max_cost - price
+    # ABOVE the floor by SHIFTING all prices down uniformly until
+    # max(price) <= max_cost + 5. A constant shift changes no value
+    # difference, so it preserves the entire price landscape (who
+    # outbids whom, who is unhappy) — unlike a clamp, which flattens the
+    # top of the distribution, i.e. exactly the contended providers:
+    # measured at 65k, min-clamping capped 65,535/65,536 prices and the
+    # eps-CS repair then evicted 59,997 seeds for 655 churned tasks,
+    # making "warm" a from-scratch fine-eps solve (the r4 0.2x
+    # regression). Negative prices are fine: the auction only ever
+    # compares price DIFFERENCES (values -cost - price and bid
+    # increments), never absolute levels.
     finite_max = jnp.max(jnp.where(cand_provider >= 0, cand_cost, 0.0))
-    price0 = jnp.minimum(jnp.asarray(price0, jnp.float32), finite_max + 5.0)
+    price0 = jnp.asarray(price0, jnp.float32)
+    shift = jnp.maximum(jnp.max(price0) - (finite_max + 5.0), 0.0)
+    price0 = price0 - shift
     owner0 = _invert(p4t0, num_providers)
     owner0, p4t0 = _unassign_unhappy(
         cand_provider, cand_cost, price0, owner0, p4t0, eps
     )
+    if retired0 is None:
+        retired_seed = jnp.zeros(cand_cost.shape[0], bool)
+    else:
+        # a seeded assignment outranks a stale retirement flag
+        retired_seed = jnp.asarray(retired0, bool) & (p4t0 < 0)
     state = (
         jnp.int32(0),
         jnp.asarray(price0, jnp.float32),
         owner0,
         p4t0,
-        jnp.zeros(cand_cost.shape[0], bool),
+        retired_seed,
     )
     phase_fn = _phase_adaptive if frontier_ladder else _sparse_auction_phase
     state, stall = phase_fn(
@@ -800,9 +849,16 @@ def assign_auction_sparse_warm(
         stall_limit=stall_limit * 8,
     )
     _report_stall("warm", stall, stall_limit * 8, stats_out)
-    _, price, owner, p4t, _ = state
+    if stats_out is not None:
+        # same cost driver the cold ladder exposes: wall = rounds x
+        # per-round kernel cost (see assign_auction_sparse_scaled)
+        stats_out["rounds_total"] = int(state[0])
+    _, price, owner, p4t, retired = state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
-    return AssignResult(p4t, _invert(p4t, num_providers)), price
+    res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_state:
+        return res, price, retired & (p4t < 0)
+    return res, price
 
 
 def assign_topk(

@@ -68,10 +68,10 @@ def assign_auction_sparse_sharded(
     cand_cost = jax.device_put(cand_cost, sharding)
 
     run = _build_sharded_phase(mesh, axis, Pn, B, int(max_iters), bool(retire))
-    _price, _owner, p4t, _stall = run(
+    _price, _owner, p4t, _retired, _stall = run(
         cand_provider, cand_cost, jnp.float32(eps), jnp.int32(0),
         jnp.zeros(Pn, jnp.float32), jnp.full(Pn, -1, jnp.int32),
-        jnp.full(T, -1, jnp.int32),
+        jnp.full(T, -1, jnp.int32), jnp.zeros(T, bool),
     )
     return AssignResult(p4t, _invert(p4t, Pn))
 
@@ -101,16 +101,18 @@ def _build_sharded_phase(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(axis, None), P(axis, None), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    def run(cand_p_local, cand_c_local, eps, stall_limit, price0, owner0, p4t0):
+    def run(cand_p_local, cand_c_local, eps, stall_limit, price0, owner0, p4t0,
+            retired0):
         Tl, K = cand_p_local.shape
         T = Tl * D
         shard = lax.axis_index(axis)
         offset = (shard * Tl).astype(jnp.int32)
         p4t_local = lax.dynamic_slice_in_dim(p4t0, offset, Tl)
+        retired_local = lax.dynamic_slice_in_dim(retired0, offset, Tl)
 
         cand_valid = cand_p_local >= 0
         value_base = jnp.where(cand_valid, -cand_c_local, _NEG)  # [Tl, K]
@@ -192,13 +194,19 @@ def _build_sharded_phase(
             jnp.asarray(price0, jnp.float32),
             jnp.asarray(owner0, jnp.int32),  # GLOBAL task ids
             p4t_local,
-            jnp.zeros(Tl, bool),
+            retired_local,
         )
         loop0 = (state0, n_assigned(p4t_local), jnp.int32(0))
-        (_, price, owner, p4t_local, _), _best, stall = lax.while_loop(
+        (_, price, owner, p4t_local, retired_l), _best, stall = lax.while_loop(
             cond, body, loop0
         )
-        return price, owner, lax.all_gather(p4t_local, axis).reshape(T), stall
+        return (
+            price,
+            owner,
+            lax.all_gather(p4t_local, axis).reshape(T),
+            lax.all_gather(retired_l, axis).reshape(T),
+            stall,
+        )
 
     return run
 
@@ -206,20 +214,25 @@ def _build_sharded_phase(
 def _run_phase_sharded(
     mesh, axis, Pn, B0, max_iters, cand_p_dev, cand_c_dev,
     task_feasible, eps, stall_limit, price, owner, p4t,
-    frontier_ladder,
+    frontier_ladder, retired=None,
 ):
     """One sharded eps phase, optionally in fixed-size segments with the
     per-shard frontier executable direct-fit to the live open set — the
     mesh twin of ops.sparse._phase_adaptive (same measured rationale:
     most rounds are tail eviction chains with a small open set). The
     per-B executables come from the lru_cache'd builder, so the ladder
-    costs at most a handful of compiles per config."""
+    costs at most a handful of compiles per config. The retirement mask
+    threads through segments (and back to the caller) exactly like the
+    single-device state tuple — resetting it per segment would re-open
+    retired tasks mid-phase, a semantics drift from _phase_adaptive."""
     D = mesh.shape[axis]
+    if retired is None:
+        retired = jnp.zeros(p4t.shape[0], bool)
     if not frontier_ladder:
         run = _build_sharded_phase(mesh, axis, Pn, B0, int(max_iters), True)
         return run(
             cand_p_dev, cand_c_dev, jnp.float32(eps),
-            jnp.int32(stall_limit), price, owner, p4t,
+            jnp.int32(stall_limit), price, owner, p4t, retired,
         )
     seg_rounds = 256
     iters_left = int(max_iters)
@@ -228,16 +241,16 @@ def _run_phase_sharded(
     floor = max(64, 512 // D)
     while iters_left > 0:
         run = _build_sharded_phase(mesh, axis, Pn, B, seg_rounds, True)
-        price, owner, p4t, stall = run(
+        price, owner, p4t, retired, stall = run(
             cand_p_dev, cand_c_dev, jnp.float32(eps), jnp.int32(0),
-            price, owner, p4t,
+            price, owner, p4t, retired,
         )
         # the segment kernel reports only its own trailing stall; rounds
         # are bounded by seg_rounds so a whole-segment stall accumulates
         s = int(stall)
         carried = carried + seg_rounds if s >= seg_rounds else s
         iters_left -= seg_rounds
-        open_count = int(jnp.sum((p4t < 0) & task_feasible))
+        open_count = int(jnp.sum((p4t < 0) & task_feasible & ~retired))
         if open_count == 0:
             break
         if stall_limit > 0 and carried >= int(stall_limit):
@@ -246,7 +259,7 @@ def _run_phase_sharded(
         while fit * D < open_count and fit < B:
             fit *= 2
         B = min(B, fit)
-    return price, owner, p4t, jnp.int32(carried)
+    return price, owner, p4t, retired, jnp.int32(carried)
 
 
 def assign_auction_sparse_scaled_sharded(
@@ -264,6 +277,7 @@ def assign_auction_sparse_scaled_sharded(
     axis: str = "p",
     stats_out: dict | None = None,
     frontier_ladder: bool = False,
+    with_state: bool = False,
 ):
     """The eps-scaling ladder over the task-sharded phase kernel — the
     multi-chip twin of ops.sparse.assign_auction_sparse_scaled with the
@@ -298,7 +312,7 @@ def assign_auction_sparse_scaled_sharded(
         final = eps <= eps_end
         # binding final phase gets 8x the disposable phases' stall budget
         # (same discipline as the single-device ladder)
-        price, owner, p4t, stall = _run_phase_sharded(
+        price, owner, p4t, retired, stall = _run_phase_sharded(
             mesh, axis, num_providers, B, max_iters_per_phase,
             cand_p_dev, cand_c_dev, task_feasible, eps,
             stall_limit * (8 if final else 1), price, owner, p4t,
@@ -311,11 +325,14 @@ def assign_auction_sparse_scaled_sharded(
         owner, p4t = _unassign_unhappy(
             cand_provider, cand_cost, price, owner, p4t, eps
         )
-        # coarse-phase retirement was only a circuit breaker; the phase
-        # kernel starts each call with a fresh retired=0, so un-retire
-        # needs no explicit step here
+        # coarse-phase retirement was only a circuit breaker; each
+        # _run_phase_sharded call starts from a fresh retired=0 mask, so
+        # un-retire needs no explicit step here — only the binding
+        # phase's retirement survives into the returned state
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
     res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_state:
+        return res, price, retired & (p4t < 0)
     if with_prices:
         return res, price
     return res
@@ -335,12 +352,17 @@ def assign_auction_sparse_warm_sharded(
     axis: str = "p",
     stats_out: dict | None = None,
     frontier_ladder: bool = False,
+    retired0: jax.Array | None = None,
+    with_state: bool = False,
 ) -> tuple[AssignResult, jax.Array]:
     """Incremental (delta-frontier) solve over the mesh: the multi-chip
     twin of ops.sparse.assign_auction_sparse_warm — same seed hygiene
-    (candidate-less seeds dropped, carried prices capped below the
+    (candidate-less seeds dropped, carried prices downshifted below the
     retirement floor), same eps-CS repair admission, one binding sharded
-    phase, greedy cleanup. Returns (AssignResult, final prices [P])."""
+    phase, greedy cleanup, same optional retirement carry (``retired0`` /
+    ``with_state`` — see the single-device docstring for why retirement
+    is dual state). Returns (AssignResult, final prices [P]), plus the
+    final retirement mask when ``with_state=True``."""
     from protocol_tpu.ops.sparse import (
         _greedy_cleanup,
         _report_stall,
@@ -354,21 +376,33 @@ def assign_auction_sparse_warm_sharded(
 
     task_has_cand = jnp.any(cand_provider >= 0, axis=1)
     p4t0 = jnp.where(task_has_cand, jnp.asarray(p4t0, jnp.int32), -1)
+    # uniform downshift, NOT a clamp — must stay bit-identical to the
+    # single-device seed hygiene (see ops.sparse.assign_auction_sparse_warm
+    # for the measured clamp pathology)
     finite_max = jnp.max(jnp.where(cand_provider >= 0, cand_cost, 0.0))
-    price0 = jnp.minimum(jnp.asarray(price0, jnp.float32), finite_max + 5.0)
+    price0 = jnp.asarray(price0, jnp.float32)
+    price0 = price0 - jnp.maximum(jnp.max(price0) - (finite_max + 5.0), 0.0)
     owner0 = _invert(p4t0, num_providers)
     owner0, p4t0 = _unassign_unhappy(
         cand_provider, cand_cost, price0, owner0, p4t0, eps
     )
 
+    if retired0 is None:
+        retired_seed = jnp.zeros(T, bool)
+    else:
+        retired_seed = jnp.asarray(retired0, bool) & (p4t0 < 0)
     sharding = NamedSharding(mesh, P(axis, None))
     cand_p_dev = jax.device_put(cand_provider, sharding)
     cand_c_dev = jax.device_put(cand_cost, sharding)
-    price, owner, p4t, stall = _run_phase_sharded(
+    price, owner, p4t, retired, stall = _run_phase_sharded(
         mesh, axis, num_providers, min(frontier, T // D), max_iters,
         cand_p_dev, cand_c_dev, jnp.any(cand_provider >= 0, axis=1), eps,
         stall_limit * 8, price0, owner0, p4t0, frontier_ladder,
+        retired=retired_seed,
     )
     _report_stall("warm-sharded", stall, stall_limit * 8, stats_out)
     p4t = _greedy_cleanup(cand_provider, cand_cost, owner, p4t)
-    return AssignResult(p4t, _invert(p4t, num_providers)), price
+    res = AssignResult(p4t, _invert(p4t, num_providers))
+    if with_state:
+        return res, price, retired & (p4t < 0)
+    return res, price

@@ -54,7 +54,12 @@ from protocol_tpu.ops.sparse import (
     candidates_topk,
     candidates_topk_bidir,
 )
-from protocol_tpu.sched.cand_cache import CandidateCache, ProviderItem, TaskItem
+from protocol_tpu.sched.cand_cache import (
+    CandidateCache,
+    CandidateMemo,
+    ProviderItem,
+    TaskItem,
+)
 from protocol_tpu.store.context import StoreContext
 from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 
@@ -214,6 +219,10 @@ class TpuBatchMatcher:
         # part 4) instead of cold-solving the full population
         self.warm_start = warm_start
         self._warm_price_by_addr: dict[str, float] = {}
+        # retirement mask carried between warm solves, keyed to the slot
+        # layout it was computed under (see _solve_slots_cached)
+        self._warm_retired: np.ndarray | None = None
+        self._warm_retired_fp: tuple | None = None
         # forward auctions never LOWER prices: carried prices ratchet
         # within a warm chain. Three bounds keep that safe: the warm
         # kernel caps entry prices below its retirement floor
@@ -278,6 +287,8 @@ class TpuBatchMatcher:
         self._solve_lock = threading.Lock()
         self.encoder = FeatureEncoder()
         self._cache = CandidateCache(self.encoder, self.weights, k=top_k)
+        # content-hash memo for the UNCACHED wire path (stateless repeats)
+        self._cand_memo = CandidateMemo()
         self._last_warm_used = False
         self._last_warm_seeded = 0
         self._last_stall: dict = {}
@@ -406,23 +417,28 @@ class TpuBatchMatcher:
         # bidirectional candidates: reverse (provider->slot) edges keep every
         # provider reachable when forward top-k windows pile onto the same
         # cheap providers (coverage-capped matchings at scale — see
-        # ops/sparse.py candidates_topk_reverse)
-        cand_p, cand_c = candidates_topk_bidir(
+        # ops/sparse.py candidates_topk_reverse). Content-hash memoized:
+        # an unchanged fleet between heartbeats skips the O(P*T) pass
+        # (the wire path's delta-awareness, VERDICT r4 item 3)
+        cand_p, cand_c = self._cand_memo.get(
             ep, er, self.weights, k=self.top_k, tile=tile,
             reverse_r=8, extra=16, approx_recall=self.approx_recall,
         )
         num_providers = int(np.asarray(ep.gpu_count).shape[0])
-        res, price = self._sparse_solve(
+        res, price, _retired = self._sparse_solve(
             cand_p, cand_c, num_providers, warm,
             jnp.asarray(price0), jnp.asarray(p4s0),
         )
         return np.asarray(res.task_for_provider), np.asarray(price)
 
     def _sparse_solve(self, cand_p, cand_c, num_providers, warm, price0, p4t0,
-                      stats_out=None):
+                      stats_out=None, retired0=None):
         """Phase 1's solve dispatch: warm vs cold ladder, single-device vs
         the task-sharded mesh twins (bit-identical phase discipline —
-        parallel/sparse.py) when ``use_mesh`` found devices."""
+        parallel/sparse.py) when ``use_mesh`` found devices. Always
+        returns (result, prices, retired) — the full dual state, so
+        chained warm solves can skip re-fighting priced-out slots
+        (ops/sparse.py: retirement carry)."""
         D = self._mesh.shape["p"] if self._mesh is not None else 0
         self._last_sharded = D > 1 and cand_p.shape[0] % D == 0
         if self._last_sharded:
@@ -435,12 +451,12 @@ class TpuBatchMatcher:
                 return assign_auction_sparse_warm_sharded(
                     cand_p, cand_c, num_providers, self._mesh,
                     price0=price0, p4t0=p4t0, stats_out=stats_out,
-                    frontier_ladder=True,
+                    frontier_ladder=True, retired0=retired0,
+                    with_state=True,
                 )
             return assign_auction_sparse_scaled_sharded(
                 cand_p, cand_c, num_providers, self._mesh,
-                with_prices=True, stats_out=stats_out,
-                frontier_ladder=True,
+                stats_out=stats_out, frontier_ladder=True, with_state=True,
             )
         if D > 1 and not self._mesh_fallback_logged:
             # a requested-but-never-engaging mesh must be observable, not
@@ -455,10 +471,11 @@ class TpuBatchMatcher:
             return assign_auction_sparse_warm(
                 cand_p, cand_c, num_providers,
                 price0=price0, p4t0=p4t0, stats_out=stats_out,
+                retired0=retired0, with_state=True,
             )
         return assign_auction_sparse_scaled(
-            cand_p, cand_c, num_providers, with_prices=True,
-            stats_out=stats_out,
+            cand_p, cand_c, num_providers, stats_out=stats_out,
+            with_state=True,
         )
 
     def _seed_slots(
@@ -636,7 +653,7 @@ class TpuBatchMatcher:
         # pool at ~k cheap providers on price-dominated fleets (the same
         # coverage cap candidates_topk_reverse's docstring measures),
         # stranding replicas while feasible providers idle
-        cand_p, _ = candidates_topk_bidir(
+        cand_p, _ = self._cand_memo.get(
             ep, er, self.weights, k=self.top_k, tile=min(1024, s_pad),
             reverse_r=8, extra=16,
         )
@@ -904,13 +921,25 @@ class TpuBatchMatcher:
         warm = self._warm_gate(seeded, rebuilt=prepared.rebuilt)
         cand_p = jnp.asarray(prepared.cand_p)
         cand_c = jnp.asarray(prepared.cand_c)
+        # retirement carry: valid only while the slot layout (task ids ->
+        # slot ranges) and the cached candidate structure are unchanged —
+        # any rebuild or task churn invalidates the mask (slots renumber)
+        slot_fp = (
+            tuple(sorted((tasks[i].id,) + tuple(slot_range[i]) for i, _ in bounded)),
+            int(p4s0.shape[0]),
+        )
+        retired0 = None
+        if warm and self._warm_retired is not None and self._warm_retired_fp == slot_fp:
+            retired0 = jnp.asarray(self._warm_retired)
         stall_stats: dict = {}
-        res, price = self._sparse_solve(
+        res, price, retired = self._sparse_solve(
             cand_p, cand_c, prepared.p_bucket, warm,
             jnp.asarray(prepared.price0), jnp.asarray(p4s0),
-            stats_out=stall_stats,
+            stats_out=stall_stats, retired0=retired0,
         )
         self._cache.store_prices(np.asarray(price))
+        self._warm_retired = np.asarray(retired)
+        self._warm_retired_fp = slot_fp
         self._last_warm_used = warm
         self._last_warm_seeded = seeded
         self._last_stall = stall_stats

@@ -76,3 +76,62 @@ def test_100k_nodes_10k_slots_over_grpc():
         assert st["remote_calls"] >= 1
     finally:
         server.stop(grace=None)
+
+
+def test_16k_warm_solve_at_least_2x_faster_than_cold():
+    """VERDICT r4 item 2's done-bar at the kernel level: warm >= 2x faster
+    than the cold ladder at a contended bench-shaped 16k instance (r4 had
+    measured warm 5.5x SLOWER at 65k -- root causes and their always-on
+    mechanism tests live in test_sparse.TestWarmColdRegression)."""
+    import bench
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from protocol_tpu.ops.cost import CostWeights
+    from protocol_tpu.ops.sparse import (
+        assign_auction_sparse_scaled,
+        assign_auction_sparse_warm,
+        candidates_topk_bidir,
+    )
+
+    T = 16384
+    rng = np.random.default_rng(0)
+    ep = bench.synth_providers(rng, T)
+    er = bench.synth_requirements(rng, T)
+    bp, bc = candidates_topk_bidir(
+        ep, er, CostWeights(), k=64, tile=2048, reverse_r=8, extra=16
+    )
+    jax.block_until_ready((bp, bc))
+
+    def cold():
+        out = assign_auction_sparse_scaled(
+            bp, bc, num_providers=T, frontier=8192, with_state=True
+        )
+        jax.block_until_ready(out[1])
+        return out
+
+    res, price, retired = cold()  # compile
+    t0 = time.perf_counter(); res, price, retired = cold()
+    t_cold = time.perf_counter() - t0
+
+    p4t0 = jnp.asarray(res.provider_for_task).at[: T // 100].set(-1)
+
+    def warm():
+        r, p = assign_auction_sparse_warm(
+            bp, bc, num_providers=T, price0=price, p4t0=p4t0,
+            retired0=retired, frontier=8192,
+        )
+        jax.block_until_ready(p)
+        return r
+
+    warm()  # compile
+    t0 = time.perf_counter(); res_w = warm()
+    t_warm = time.perf_counter() - t0
+
+    a_cold = int(np.asarray(res.provider_for_task >= 0).sum())
+    a_warm = int(np.asarray(res_w.provider_for_task >= 0).sum())
+    assert a_warm >= a_cold - 2
+    assert t_warm * 2.0 <= t_cold, (
+        f"warm {t_warm:.2f}s not >= 2x faster than cold {t_cold:.2f}s"
+    )
