@@ -4,13 +4,21 @@ The reference authenticates every HTTP request between untrusted parties
 with an Ethereum wallet signature over ``endpoint + sorted-JSON body`` plus
 a nonce (crates/shared/src/security/). This package keeps that protocol
 shape — ``x-address`` / ``x-signature`` headers, nonce replay cache, rate
-limiting, body caps — over Ed25519 (cryptography package) instead of
-secp256k1: Ed25519 has no public-key recovery, so the signature value
-carries the public key and the verifier checks it hashes to the claimed
-address.
+limiting, body caps — over two interchangeable schemes behind one verifier:
+Ed25519 (:class:`Wallet`, the default) and secp256k1/keccak
+(:class:`EvmWallet`, the reference's exact scheme with real Ethereum
+addresses). Neither uses public-key recovery on the wire: the signature
+value carries the public key and the verifier checks it hashes to the
+claimed address.
 """
 
-from protocol_tpu.security.wallet import Wallet, verify_signature
+from protocol_tpu.security.wallet import EvmWallet, Wallet, verify_signature
 from protocol_tpu.security.signer import sign_request, verify_request
 
-__all__ = ["Wallet", "sign_request", "verify_request", "verify_signature"]
+__all__ = [
+    "EvmWallet",
+    "Wallet",
+    "sign_request",
+    "verify_request",
+    "verify_signature",
+]

@@ -113,10 +113,25 @@ def validate_signature_middleware(
                 {"success": False, "error": "stale timestamp"}, status=401
             )
 
+        # allowlist gate runs BEFORE verification on the CLAIMED address:
+        # rejecting a never-allowed address needs no crypto, and the
+        # secp/keccak verify path is CPU work an unauthenticated stranger
+        # should not get to purchase
+        claimed = (request.headers.get("x-address") or "").lower()
+        if allow is not None and claimed not in allow:
+            return web.json_response(
+                {"success": False, "error": "address not allowed"}, status=401
+            )
+
         # pass the CIMultiDict through: its .get is case-insensitive, so
         # clients sending X-Address/X-Signature (standard casing) still
-        # authenticate
-        address = verify_request(request.path, request.headers, body)
+        # authenticate. Verification runs in a thread: Ed25519 is
+        # C-speed, but the EvmWallet path keccaks the full message in
+        # Python (capped at EVM_MAX_MESSAGE_BYTES) — the event loop must
+        # not stall behind it
+        address = await asyncio.to_thread(
+            verify_request, request.path, request.headers, body
+        )
         if address is None:
             return web.json_response(
                 {"success": False, "error": "invalid signature"}, status=401
@@ -134,11 +149,6 @@ def validate_signature_middleware(
                 return web.json_response(
                     {"success": False, "error": "signature replay"}, status=401
                 )
-
-        if allow is not None and address not in allow:
-            return web.json_response(
-                {"success": False, "error": "address not allowed"}, status=401
-            )
 
         if not limiter.allow(address):
             return web.json_response(

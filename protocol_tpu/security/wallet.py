@@ -1,12 +1,23 @@
-"""Wallet: Ed25519 keypair with a derived on-ledger address.
+"""Wallet: keypair identity with a derived on-ledger address.
 
-Reference counterpart: crates/shared/src/web3/wallet.rs (alloy
-PrivateKeySigner). Deviation, by design: the reference uses secp256k1
-ECDSA with address recovery; here identity is an Ed25519 keypair and the
-address is ``0x + sha256(pubkey)[:20].hex()``. Signatures travel as
-``<pubkey_hex>:<sig_hex>`` so any verifier can (a) check the pubkey hashes
-to the claimed address and (b) verify the signature — the same
-trust-nothing property recovery gives, without secp dependencies.
+Reference counterpart: crates/shared/src/web3/wallet.rs:28-68 (alloy
+PrivateKeySigner, secp256k1 ECDSA + keccak addresses). Two schemes share
+one wire format and one verifier here:
+
+- :class:`Wallet` (default): Ed25519, address =
+  ``0x + sha256(pubkey)[:20].hex()`` — the TPU-substrate native scheme.
+- :class:`EvmWallet`: secp256k1 ECDSA over ``keccak256(message)``,
+  address = ``0x + keccak256(uncompressed_pubkey[1:])[-20:].hex()`` —
+  bit-identical to Ethereum address derivation, so this identity can sign
+  for / be credited at a real EVM address.
+
+Signatures travel as ``<pubkey_hex>:<sig_hex>``; :func:`verify_signature`
+dispatches on the embedded pubkey's length (32 bytes = Ed25519, 65 bytes
+= uncompressed secp256k1), checks the pubkey hashes to the claimed
+address, then verifies — the same trust-nothing property ECDSA recovery
+gives, without needing a recovery id on the wire. Every consumer
+(signer, middleware, ledger invites) is scheme-agnostic through this one
+seam, which is the adapter point for real-chain interop.
 """
 
 from __future__ import annotations
@@ -14,15 +25,96 @@ from __future__ import annotations
 import hashlib
 from typing import Optional
 
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.hazmat.primitives.asymmetric.ed25519 import (
     Ed25519PrivateKey,
     Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
 )
 from cryptography.exceptions import InvalidSignature
 
 
 def _address_from_pubkey(pub_bytes: bytes) -> str:
     return "0x" + hashlib.sha256(pub_bytes).digest()[:20].hex()
+
+
+# ---------------------------------------------------------------------------
+# keccak-256 (the ORIGINAL Keccak padding, 0x01 — NOT sha3-256's 0x06, which
+# is why hashlib can't provide it). Pure Python; only hashes short control
+# messages, so throughput is irrelevant.
+# ---------------------------------------------------------------------------
+
+_MASK64 = (1 << 64) - 1
+_KECCAK_RC = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+_KECCAK_ROT = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+
+def _rol64(v: int, n: int) -> int:
+    if n == 0:
+        return v
+    return ((v << n) | (v >> (64 - n))) & _MASK64
+
+
+def _keccak_f(a: list[list[int]]) -> list[list[int]]:
+    for rc in _KECCAK_RC:
+        c = [a[x][0] ^ a[x][1] ^ a[x][2] ^ a[x][3] ^ a[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol64(c[(x + 1) % 5], 1) for x in range(5)]
+        a = [[a[x][y] ^ d[x] for y in range(5)] for x in range(5)]
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rol64(a[x][y], _KECCAK_ROT[x][y])
+        a = [
+            [b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y])
+             for y in range(5)]
+            for x in range(5)
+        ]
+        a[0][0] ^= rc
+    return a
+
+
+def keccak256(data: bytes) -> bytes:
+    rate = 136  # 1600 - 2*256 bits
+    p = bytearray(data)
+    pad = rate - (len(p) % rate)
+    if pad == 1:
+        p += b"\x81"
+    else:
+        p += b"\x01" + b"\x00" * (pad - 2) + b"\x80"
+    a = [[0] * 5 for _ in range(5)]
+    for off in range(0, len(p), rate):
+        for i in range(rate // 8):
+            a[i % 5][i // 5] ^= int.from_bytes(
+                p[off + 8 * i: off + 8 * i + 8], "little"
+            )
+        a = _keccak_f(a)
+    return b"".join(a[i % 5][i // 5].to_bytes(8, "little") for i in range(4))
+
+
+def _evm_address(uncompressed_pubkey: bytes) -> str:
+    """Ethereum address: last 20 bytes of keccak256 over the 64-byte
+    public-key coordinates (the leading 0x04 SEC1 tag is dropped)."""
+    return "0x" + keccak256(uncompressed_pubkey[1:]).hex()[-40:]
 
 
 class Wallet:
@@ -53,9 +145,73 @@ class Wallet:
         return f"{self._pub_bytes.hex()}:{sig.hex()}"
 
 
+_SECP = ec.SECP256K1()
+_SECP_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+# cryptography's Prehashed only length-checks the digest (32 bytes), so it
+# signs/verifies a keccak digest fine under the SHA256 label
+_PREHASHED32 = ec.ECDSA(Prehashed(hashes.SHA256()))
+
+# The pure-Python keccak runs ~8 s/MB: an unauthenticated party must not be
+# able to buy that much verifier CPU. Control-plane messages (endpoint +
+# timestamp + canonical JSON) are far below this; larger payloads travel
+# the signed-URL artifact path, never the signed-JSON plane.
+EVM_MAX_MESSAGE_BYTES = 64 * 1024
+
+
+class EvmWallet:
+    """secp256k1/keccak wallet — the reference's exact signing scheme
+    (crates/shared/src/web3/wallet.rs:28-68), producing REAL Ethereum
+    addresses. Drop-in for :class:`Wallet` everywhere (same duck-type,
+    same wire format); recovery is replaced by the embedded 65-byte
+    uncompressed pubkey, which the verifier hashes back to the address."""
+
+    def __init__(self, private_key: Optional[ec.EllipticCurvePrivateKey] = None):
+        self._key = private_key or ec.generate_private_key(_SECP)
+        pub = self._key.public_key().public_numbers()
+        self._pub_bytes = (
+            b"\x04" + pub.x.to_bytes(32, "big") + pub.y.to_bytes(32, "big")
+        )
+        self.address = _evm_address(self._pub_bytes)
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "EvmWallet":
+        """Deterministic wallet from a seed (dev/test fixtures)."""
+        d = int.from_bytes(hashlib.sha256(seed).digest(), "big") % (_SECP_N - 1) + 1
+        return cls(ec.derive_private_key(d, _SECP))
+
+    @classmethod
+    def from_hex(cls, hex_key: str) -> "EvmWallet":
+        d = int(hex_key.removeprefix("0x"), 16)
+        return cls(ec.derive_private_key(d, _SECP))
+
+    def private_key_hex(self) -> str:
+        return format(self._key.private_numbers().private_value, "064x")
+
+    def sign_message(self, message: bytes | str) -> str:
+        """Returns '<uncompressed_pubkey_hex>:<r||s hex>' over
+        keccak256(message), with s normalized to the low half-order
+        (EIP-2): a high-s twin of a valid signature is itself valid ECDSA,
+        which would let an attacker mint a second wire-distinct signature
+        for a captured request — and real Ethereum nodes reject high-s."""
+        if isinstance(message, str):
+            message = message.encode()
+        if len(message) > EVM_MAX_MESSAGE_BYTES:
+            raise ValueError(
+                f"message of {len(message)} bytes exceeds the "
+                f"{EVM_MAX_MESSAGE_BYTES}-byte keccak signing cap"
+            )
+        der = self._key.sign(keccak256(message), _PREHASHED32)
+        r, s = decode_dss_signature(der)
+        if s > _SECP_N // 2:
+            s = _SECP_N - s
+        sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        return f"{self._pub_bytes.hex()}:{sig.hex()}"
+
+
 def verify_signature(message: bytes | str, signature: str, expected_address: str) -> bool:
     """Checks the signature verifies AND its embedded pubkey hashes to the
-    claimed address (the recovery-equivalent step)."""
+    claimed address (the recovery-equivalent step). Scheme is dispatched on
+    the pubkey length: 32 bytes = Ed25519, 65 bytes = secp256k1/keccak."""
     if isinstance(message, str):
         message = message.encode()
     try:
@@ -64,6 +220,28 @@ def verify_signature(message: bytes | str, signature: str, expected_address: str
         sig = bytes.fromhex(sig_hex)
     except ValueError:
         return False
+    if len(pub_bytes) == 65 and pub_bytes[0] == 4 and len(sig) == 64:
+        # the pure-Python keccak is ~8 s/MB: refuse to hash attacker-sized
+        # messages (the signer enforces the same cap)
+        if len(message) > EVM_MAX_MESSAGE_BYTES:
+            return False
+        if _evm_address(pub_bytes) != expected_address.lower():
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        # reject the malleable high-s twin (EIP-2): otherwise one captured
+        # request yields a second wire-distinct valid signature, defeating
+        # any signature-keyed replay cache
+        if r == 0 or s == 0 or s > _SECP_N // 2:
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(_SECP, pub_bytes)
+            pub.verify(
+                encode_dss_signature(r, s), keccak256(message), _PREHASHED32
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
     if _address_from_pubkey(pub_bytes) != expected_address.lower():
         return False
     try:

@@ -8,15 +8,15 @@ import pytest
 
 from protocol_tpu.chain import Ledger, LedgerError, PoolStatus
 from protocol_tpu.chain.ledger import invite_digest
-from protocol_tpu.security import Wallet
+from protocol_tpu.security import EvmWallet, Wallet
 
 
-@pytest.fixture
-def world():
+@pytest.fixture(params=[Wallet, EvmWallet], ids=["ed25519", "evm"])
+def world(request):
     ledger = Ledger(min_stake_per_compute_unit=10)
-    provider = Wallet.from_seed(b"provider")
-    node = Wallet.from_seed(b"node")
-    manager = Wallet.from_seed(b"pool-manager")
+    provider = request.param.from_seed(b"provider")
+    node = request.param.from_seed(b"node")
+    manager = request.param.from_seed(b"pool-manager")
     ledger.mint(provider.address, 1000)
     did = ledger.create_domain("synthetic-data", validation_logic="toploc")
     pid = ledger.create_pool(did, provider.address, manager.address, "gpu:count=1")

@@ -8,7 +8,15 @@ import json
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
-from protocol_tpu.security import Wallet, sign_request, verify_request, verify_signature
+import pytest
+
+from protocol_tpu.security import (
+    EvmWallet,
+    Wallet,
+    sign_request,
+    verify_request,
+    verify_signature,
+)
 from protocol_tpu.security.middleware import (
     RateLimiter,
     api_key_middleware,
@@ -18,19 +26,26 @@ from protocol_tpu.security.signer import canonical_json
 from protocol_tpu.store.kv import KVStore
 
 
+@pytest.fixture(params=[Wallet, EvmWallet], ids=["ed25519", "evm"])
+def wallet_cls(request):
+    """Both signature schemes must pass the identical signer/middleware
+    suite — the adapter contract (VERDICT r4 item 7)."""
+    return request.param
+
+
 class TestWallet:
-    def test_sign_verify_roundtrip(self):
-        w = Wallet()
+    def test_sign_verify_roundtrip(self, wallet_cls):
+        w = wallet_cls()
         sig = w.sign_message("hello")
         assert verify_signature("hello", sig, w.address)
 
-    def test_wrong_message_rejected(self):
-        w = Wallet()
+    def test_wrong_message_rejected(self, wallet_cls):
+        w = wallet_cls()
         sig = w.sign_message("hello")
         assert not verify_signature("other", sig, w.address)
 
-    def test_wrong_address_rejected(self):
-        w, w2 = Wallet(), Wallet()
+    def test_wrong_address_rejected(self, wallet_cls):
+        w, w2 = wallet_cls(), wallet_cls()
         sig = w.sign_message("hello")
         assert not verify_signature("hello", sig, w2.address)
 
@@ -38,38 +53,38 @@ class TestWallet:
         assert not verify_signature("m", "nonsense", "0xabc")
         assert not verify_signature("m", "aa:bb", "0xabc")
 
-    def test_deterministic_from_seed(self):
-        a = Wallet.from_seed(b"x" * 32)
-        b = Wallet.from_seed(b"x" * 32)
+    def test_deterministic_from_seed(self, wallet_cls):
+        a = wallet_cls.from_seed(b"x" * 32)
+        b = wallet_cls.from_seed(b"x" * 32)
         assert a.address == b.address
 
-    def test_hex_roundtrip(self):
-        w = Wallet()
-        w2 = Wallet.from_hex(w.private_key_hex())
+    def test_hex_roundtrip(self, wallet_cls):
+        w = wallet_cls()
+        w2 = wallet_cls.from_hex(w.private_key_hex())
         assert w.address == w2.address
 
 
 class TestSigner:
-    def test_signed_body_roundtrip(self):
-        w = Wallet()
+    def test_signed_body_roundtrip(self, wallet_cls):
+        w = wallet_cls()
         headers, body = sign_request("/heartbeat", w, {"address": w.address, "b": 1})
         assert "nonce" in body
         assert verify_request("/heartbeat", headers, body) == w.address
 
-    def test_get_request_roundtrip(self):
-        w = Wallet()
+    def test_get_request_roundtrip(self, wallet_cls):
+        w = wallet_cls()
         headers, body = sign_request("/api/pool/0", w)
         assert body is None
         assert verify_request("/api/pool/0", headers) == w.address
 
-    def test_tampered_body_rejected(self):
-        w = Wallet()
+    def test_tampered_body_rejected(self, wallet_cls):
+        w = wallet_cls()
         headers, body = sign_request("/x", w, {"v": 1})
         body["v"] = 2
         assert verify_request("/x", headers, body) is None
 
-    def test_wrong_endpoint_rejected(self):
-        w = Wallet()
+    def test_wrong_endpoint_rejected(self, wallet_cls):
+        w = wallet_cls()
         headers, body = sign_request("/x", w, {"v": 1})
         assert verify_request("/y", headers, body) is None
 
@@ -118,9 +133,9 @@ def run(coro):
 
 
 class TestSignatureMiddleware:
-    def test_valid_signature_passes(self):
+    def test_valid_signature_passes(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
         headers, body = sign_request("/signed/echo", w, {"hello": 1})
         status, data = run(_request(make_app(kv), "POST", "/signed/echo", headers, body))
         assert status == 200 and data["address"] == w.address
@@ -129,9 +144,9 @@ class TestSignatureMiddleware:
         status, _ = run(_request(make_app(KVStore()), "POST", "/signed/echo", {}, {"a": 1}))
         assert status == 401
 
-    def test_nonce_replay_rejected(self):
+    def test_nonce_replay_rejected(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
         app = make_app(kv)
 
         async def replay():
@@ -144,9 +159,9 @@ class TestSignatureMiddleware:
         s1, s2 = run(replay())
         assert s1 == 200 and s2 == 401
 
-    def test_tampered_body_rejected(self):
+    def test_tampered_body_rejected(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
         headers, body = sign_request("/signed/echo", w, {"hello": 1})
         body["hello"] = 2
         status, _ = run(_request(make_app(kv), "POST", "/signed/echo", headers, body))
@@ -156,18 +171,18 @@ class TestSignatureMiddleware:
         status, _ = run(_request(make_app(KVStore()), "GET", "/open"))
         assert status == 200
 
-    def test_allow_list(self):
+    def test_allow_list(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
         headers, body = sign_request("/signed/echo", w, {"a": 1})
         status, _ = run(
             _request(make_app(kv, allowed_addresses=["0xother"]), "POST", "/signed/echo", headers, body)
         )
         assert status == 401
 
-    def test_async_validator(self):
+    def test_async_validator(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
 
         async def reject_all(addr):
             return False
@@ -178,9 +193,9 @@ class TestSignatureMiddleware:
         )
         assert status == 401
 
-    def test_rate_limit(self):
+    def test_rate_limit(self, wallet_cls):
         kv = KVStore()
-        w = Wallet()
+        w = wallet_cls()
         app = make_app(kv, rate_limiter=RateLimiter(limit=2))
 
         async def burst():
@@ -208,3 +223,70 @@ class TestApiKeyMiddleware:
 
         s1, s2 = run(flow())
         assert s1 == 401 and s2 == 200
+
+
+class TestEvmScheme:
+    """Pins the EVM wallet to public Ethereum test vectors — the adapter
+    claim is that these are REAL chain-compatible addresses/signatures
+    (reference scheme: crates/shared/src/web3/wallet.rs:28-68)."""
+
+    def test_keccak256_known_vectors(self):
+        from protocol_tpu.security.wallet import keccak256
+
+        assert keccak256(b"").hex() == (
+            "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+        )
+        assert keccak256(b"abc").hex() == (
+            "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+        )
+        assert keccak256(b"hello").hex() == (
+            "1c8aff950685c2ed4bc3174f3472287b56d9517b9c948127319a09a7a36deac8"
+        )
+
+    def test_known_ethereum_address(self):
+        # private key 0x01 -> the canonical generator-point address
+        w = EvmWallet.from_hex("0x01")
+        assert w.address == "0x7e5f4552091a69125d5dfcb7b8c2659029395bdf"
+
+    def test_schemes_not_interchangeable(self):
+        """A signature from one scheme never verifies against the other
+        scheme's address for the same seed."""
+        ed = Wallet.from_seed(b"same-seed")
+        evm = EvmWallet.from_seed(b"same-seed")
+        assert ed.address != evm.address
+        assert not verify_signature("m", ed.sign_message("m"), evm.address)
+        assert not verify_signature("m", evm.sign_message("m"), ed.address)
+
+    def test_truncated_secp_signature_rejected(self):
+        w = EvmWallet()
+        pub_hex, sig_hex = w.sign_message("m").split(":")
+        assert not verify_signature("m", f"{pub_hex}:{sig_hex[:-2]}", w.address)
+
+
+    def test_high_s_twin_rejected(self):
+        """ECDSA malleability: flipping s to n-s yields a second valid
+        raw signature — the verifier must reject it (it would defeat the
+        middleware's signature-keyed replay cache for bodyless requests)."""
+        from protocol_tpu.security.wallet import _SECP_N
+
+        w = EvmWallet()
+        pub_hex, sig_hex = w.sign_message("m").split(":")
+        sig = bytes.fromhex(sig_hex)
+        r = sig[:32]
+        s_int = int.from_bytes(sig[32:], "big")
+        assert s_int <= _SECP_N // 2  # signer normalizes to low-s
+        twin = r + (_SECP_N - s_int).to_bytes(32, "big")
+        assert not verify_signature("m", f"{pub_hex}:{twin.hex()}", w.address)
+
+    def test_oversized_keccak_message_refused(self):
+        from protocol_tpu.security.wallet import EVM_MAX_MESSAGE_BYTES
+
+        w = EvmWallet()
+        big = b"x" * (EVM_MAX_MESSAGE_BYTES + 1)
+        with pytest.raises(ValueError, match="keccak signing cap"):
+            w.sign_message(big)
+        # a forged signature over an oversized message is refused before
+        # the verifier spends seconds hashing it
+        ok = w.sign_message(b"small")
+        pub_hex, sig_hex = ok.split(":")
+        assert not verify_signature(big, f"{pub_hex}:{sig_hex}", w.address)
