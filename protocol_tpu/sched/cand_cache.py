@@ -657,3 +657,60 @@ class CandidateCache:
         self.prices[: self.rows] = np.asarray(
             price[: self.rows], np.float32
         )
+
+
+class CandidateMemo:
+    """Content-hash memo for the UNCACHED candidate paths (VERDICT r4
+    item 3): the gRPC backend and the wire-path matcher regenerate full
+    bidirectional candidates every solve even when the fleet is
+    byte-identical to the previous heartbeat — an O(P*T) streamed pass
+    re-paid for a zero-delta input. This memo keys the generated
+    [T, K_eff] structure on a hash of the ENCODED inputs plus every
+    generation parameter: a changed price, spec, priority, or padding row
+    changes the bytes and misses (exactness preserved); the steady-state
+    heartbeat loop hits. Hashing is O(P + T) bytes (~ms at 65k) vs
+    generation's O(P*T) (~minutes at 65k CPU).
+
+    Unlike :class:`CandidateCache` (row-stable registry, O(churn)
+    incremental merge), this is a pure memo — it cannot exploit partial
+    overlap, only exact repeats — which is precisely the stateless wire
+    contract where the richer cache cannot live."""
+
+    def __init__(self, capacity: int = 4):
+        self.capacity = capacity
+        self._slots: dict[tuple, tuple] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _fingerprint(enc) -> bytes:
+        import hashlib
+
+        h = hashlib.sha1()
+        for f in dataclasses.fields(enc):
+            h.update(np.asarray(getattr(enc, f.name)).tobytes())
+        return h.digest()
+
+    def get(self, ep, er, weights, *, k, tile, reverse_r, extra,
+            approx_recall=None):
+        from protocol_tpu.ops.sparse import candidates_topk_bidir
+
+        key = (
+            self._fingerprint(ep), self._fingerprint(er),
+            dataclasses.astuple(weights), k, tile, reverse_r, extra,
+            approx_recall,
+        )
+        hit = self._slots.pop(key, None)
+        if hit is not None:
+            self.hits += 1
+            self._slots[key] = hit  # re-insert: LRU order
+            return hit
+        self.misses += 1
+        out = candidates_topk_bidir(
+            ep, er, weights, k=k, tile=tile, reverse_r=reverse_r,
+            extra=extra, approx_recall=approx_recall,
+        )
+        self._slots[key] = out
+        while len(self._slots) > self.capacity:
+            self._slots.pop(next(iter(self._slots)))
+        return out

@@ -104,6 +104,11 @@ def _pad_pow2(enc, n_real: int):
 
 
 class SchedulerBackendServicer:
+    def __init__(self):
+        from protocol_tpu.sched.cand_cache import CandidateMemo
+
+        self._cand_memo = CandidateMemo()
+
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
         t0 = time.perf_counter()
         ep = providers_from_proto(request.providers)
@@ -156,7 +161,6 @@ class SchedulerBackendServicer:
             from protocol_tpu.ops.sparse import (
                 assign_auction_sparse_scaled,
                 assign_auction_sparse_warm,
-                candidates_topk_bidir,
             )
 
             # tile must divide the (padded, pow2) T
@@ -166,8 +170,11 @@ class SchedulerBackendServicer:
                 tile -= 1
             p_padded = int(np.asarray(ep.gpu_count).shape[0])
             # bidirectional: same coverage-safe generator as the in-process
-            # matcher (_bounded_t4p_sparse) — remote/in-process parity
-            cand_p, cand_c = candidates_topk_bidir(
+            # matcher (_bounded_t4p_sparse) — remote/in-process parity.
+            # Content-hash memoized: the steady-state heartbeat loop sends
+            # a byte-identical fleet, and the stateless seam must not
+            # re-pay the O(P*T) generation for it (VERDICT r4 item 3)
+            cand_p, cand_c = self._cand_memo.get(
                 ep, er, weights,
                 k=max(int(request.top_k) or 64, 1), tile=tile,
                 reverse_r=8, extra=16,

@@ -302,3 +302,57 @@ class TestAdaptiveReGround:
         m = TpuBatchMatcher(StoreContext.new_test())
         assert m.cold_every == 256  # schedule is the backstop, not the policy
         assert m._cache.max_stale_frac == 0.10
+
+
+class TestCandidateMemo:
+    """Content-hash memo for the stateless candidate paths (gRPC backend +
+    wire-path matcher): exact repeats hit, any byte change misses."""
+
+    def _instance(self, seed=0, P=64, T=64):
+        from tests.test_sparse import encode_random_marketplace
+
+        return encode_random_marketplace(seed, P, T)
+
+    def test_repeat_hits_and_changed_input_misses(self):
+        import dataclasses
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from protocol_tpu.ops.cost import CostWeights
+        from protocol_tpu.sched.cand_cache import CandidateMemo
+
+        memo = CandidateMemo()
+        ep, er = self._instance()
+        kw = dict(k=8, tile=16, reverse_r=4, extra=4)
+        cp1, cc1 = memo.get(ep, er, CostWeights(), **kw)
+        cp2, cc2 = memo.get(ep, er, CostWeights(), **kw)
+        assert memo.hits == 1 and memo.misses == 1
+        assert cp1 is cp2 and cc1 is cc2
+        # one changed price byte -> miss, and the result reflects it
+        ep2 = dataclasses.replace(
+            ep, price=jnp.asarray(np.asarray(ep.price) + 1.0)
+        )
+        memo.get(ep2, er, CostWeights(), **kw)
+        assert memo.misses == 2
+        # different generation params are different keys
+        memo.get(ep, er, CostWeights(), k=8, tile=16, reverse_r=4, extra=8)
+        assert memo.misses == 3
+
+    def test_capacity_evicts_lru(self):
+        from protocol_tpu.ops.cost import CostWeights
+        from protocol_tpu.sched.cand_cache import CandidateMemo
+
+        memo = CandidateMemo(capacity=2)
+        kw = dict(k=8, tile=16, reverse_r=4, extra=4)
+        a = self._instance(1)
+        b = self._instance(2)
+        c = self._instance(3)
+        memo.get(*a, CostWeights(), **kw)
+        memo.get(*b, CostWeights(), **kw)
+        memo.get(*a, CostWeights(), **kw)  # refresh a
+        memo.get(*c, CostWeights(), **kw)  # evicts b (LRU)
+        memo.get(*a, CostWeights(), **kw)
+        assert memo.hits == 2  # a hit twice; b/c were misses
+        memo.get(*b, CostWeights(), **kw)  # b was evicted -> miss
+        assert memo.misses == 4
