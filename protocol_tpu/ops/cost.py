@@ -117,3 +117,38 @@ def cost_matrix(
     cost = cost - weights.priority * r.priority[None, :]
     cost = jnp.where(mask, cost, INFEASIBLE)
     return cost, mask
+
+
+@jax.jit
+def _cost_pairs_vmapped(p_rows, r, weights) -> jax.Array:
+    def pair(pr, rr):
+        c, _ = cost_matrix(
+            jax.tree.map(lambda a: a[None], pr),
+            jax.tree.map(lambda a: a[None], rr),
+            weights,
+        )
+        return c[0, 0]
+
+    return jax.vmap(pair)(p_rows, r)
+
+
+def cost_pairs(
+    p: EncodedProviders,
+    r: EncodedRequirements,
+    provider_for_task: jax.Array,
+    weights: CostWeights | None = None,
+) -> jax.Array:
+    """Per-pair cost of an assignment: [T] f32, INFEASIBLE where the task
+    is unassigned or the pair is incompatible.
+
+    Gathers the chosen provider rows and vmaps :func:`cost_matrix` over
+    the pairs — O(T) work, so assignment quality is measurable at shapes
+    where the [P, T] tensor cannot exist (the 100k/1M ladder rungs).
+    Reusing cost_matrix rather than a pairwise re-derivation means this
+    can never drift from what the solvers optimized."""
+    if weights is None:
+        weights = CostWeights()
+    p4t = jnp.asarray(provider_for_task, jnp.int32)
+    ep_rows = jax.tree.map(lambda a: jnp.take(a, jnp.maximum(p4t, 0), axis=0), p)
+    cost = _cost_pairs_vmapped(ep_rows, r, weights)
+    return jnp.where(p4t >= 0, cost, INFEASIBLE)

@@ -15,6 +15,7 @@ from protocol_tpu.parallel.sparse import (
     assign_auction_sparse_scaled_sharded,
     assign_auction_sparse_sharded,
     assign_auction_sparse_warm_sharded,
+    candidates_topk_bidir_sharded,
 )
 
 __all__ = [
@@ -22,6 +23,7 @@ __all__ = [
     "assign_auction_sparse_scaled_sharded",
     "assign_auction_sparse_sharded",
     "assign_auction_sparse_warm_sharded",
+    "candidates_topk_bidir_sharded",
     "make_mesh",
     "pad_to_multiple",
     "sinkhorn_potentials_sharded",

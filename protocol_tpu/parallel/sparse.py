@@ -22,6 +22,7 @@ virtual 8-device CPU mesh.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import lru_cache, partial
 
 import jax
@@ -406,3 +407,160 @@ def assign_auction_sparse_warm_sharded(
     if with_state:
         return res, price, retired & (p4t < 0)
     return res, price
+
+
+def candidates_topk_bidir_sharded(
+    ep,
+    er,
+    weights=None,
+    *,
+    mesh: Mesh,
+    k: int = 64,
+    tile: int = 1024,
+    reverse_r: int = 8,
+    extra: int = 16,
+    axis: str = "p",
+    approx_recall: float | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Task-sharded bidirectional candidate generation — the mesh twin of
+    ops.sparse.candidates_topk_bidir, and the stage where multi-chip
+    actually PAYS: generation is the measured wall-clock dominator of a
+    cold solve (793 s gen vs 32 s solve at 65k CPU, SCALING.md) and it is
+    embarrassingly parallel over task tiles. Each device streams its own
+    [P, tile] cost blocks (providers replicated: P x ~14 f32 columns,
+    megabytes at 1M) with ZERO per-round collectives; the only
+    communication in the whole pass is one all_gather of the [T, k]
+    forward lists and the [D, P, r] reverse pools at the end — so v5e-8
+    speedup on this stage is ~linear in D, unlike the solve kernel whose
+    every round all-reduces the [P] price/owner vectors (see the ICI cost
+    model in SCALING.md).
+
+    Parity: the forward tile step is ops.sparse._forward_tile_select
+    (shared verbatim — jitter offsets arranged so each shard computes the
+    exact global tile it would own single-device), and the reverse pools
+    keep the tile-pooled contract (per-tile top-ceil(r/n_tiles_GLOBAL),
+    best r of the pool). Pool merging is associative up to float ties,
+    which the tie jitter already decorrelates — asserted bit-exact in
+    tests/test_parallel_sparse.py.
+    """
+    from protocol_tpu.ops.cost import INFEASIBLE, CostWeights
+    from protocol_tpu.ops.sparse import (
+        _forward_tile_select,
+        merge_reverse_candidates,
+    )
+
+    if weights is None:
+        weights = CostWeights()
+    T = er.cpu_cores.shape[0]
+    D = mesh.shape[axis]
+    if T % D != 0:
+        raise ValueError(f"T={T} not divisible by mesh size {D}; pad first")
+    Tl = T // D
+    if Tl % tile != 0:
+        raise ValueError(
+            f"local task count {Tl} not divisible by tile={tile}"
+        )
+    n_tiles_global = T // tile
+    Pn = int(ep.gpu_count.shape[0])
+    k = min(k, Pn)
+    r = min(reverse_r, T)
+    rt = max(1, -(-r // n_tiles_global))  # per-tile pool contribution
+
+    er_sharded = jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), er
+    )
+    gen = _build_sharded_gen(
+        mesh, axis, dataclasses.astuple(weights), Pn, Tl, k, tile, r, rt,
+        approx_recall, jax.tree.structure(er),
+    )
+    cand_p, cand_c, rev_c_all, rev_t_all = gen(ep, er_sharded)
+    # final pool merge: best r of the D per-shard pools (associativity up
+    # to jitter-decorrelated ties; same multiset as the sequential fold)
+    rev_c_cat = jnp.moveaxis(rev_c_all, 0, 1).reshape(Pn, D * r)
+    rev_t_cat = jnp.moveaxis(rev_t_all, 0, 1).reshape(Pn, D * r)
+    neg_c, m = lax.top_k(-rev_c_cat, r)
+    rev_c = -neg_c
+    rev_t = jnp.take_along_axis(rev_t_cat, m, axis=1)
+    rev_t = jnp.where(rev_c < INFEASIBLE * 0.5, rev_t, -1)
+    return merge_reverse_candidates(cand_p, cand_c, rev_t, rev_c, extra=extra)
+
+
+@lru_cache(maxsize=32)
+def _build_sharded_gen(
+    mesh: Mesh,
+    axis: str,
+    weights_tuple: tuple,
+    Pn: int,
+    Tl: int,
+    k: int,
+    tile: int,
+    r: int,
+    rt: int,
+    approx_recall,
+    er_treedef,
+):
+    """Cached builder for the sharded generation executable (same
+    re-trace rationale as _build_sharded_phase: a fresh jit+shard_map
+    closure per call would recompile the whole scan each rebuild)."""
+    from protocol_tpu.ops.cost import INFEASIBLE, CostWeights
+    from protocol_tpu.ops.sparse import _forward_tile_select
+
+    weights = CostWeights(*weights_tuple)
+    D = mesh.shape[axis]
+    er_specs = jax.tree.unflatten(
+        er_treedef, [P(axis)] * er_treedef.num_leaves
+    )
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), er_specs),
+        out_specs=(P(axis, None), P(axis, None), P(axis, None, None),
+                   P(axis, None, None)),
+        check_vma=False,
+    )
+    def gen(ep_rep, er_local):
+        shard = lax.axis_index(axis)
+        offset = (shard * Tl).astype(jnp.uint32)
+
+        def step(carry, t0):
+            rev_c0, rev_t0 = carry
+            # shared forward step: jitter keyed on the GLOBAL task index
+            # via task_offset, so each shard produces exactly the columns
+            # the single-device scan would at its global tile
+            provider, cost_k, cost = _forward_tile_select(
+                ep_rep, er_local, weights, t0, tile, k,
+                None, offset, approx_recall,
+            )
+            tid = offset.astype(jnp.int32) + t0 + jnp.arange(tile, dtype=jnp.int32)
+            if rt == 1:
+                j = jnp.argmin(cost, axis=1)
+                tile_c = jnp.take_along_axis(cost, j[:, None], axis=1)
+                tile_t = tid[j][:, None]
+            else:
+                neg, j = lax.top_k(-cost, rt)
+                tile_c = -neg
+                tile_t = tid[j]
+            merged_c = jnp.concatenate([rev_c0, tile_c], axis=1)
+            merged_t = jnp.concatenate([rev_t0, tile_t], axis=1)
+            neg_c, m = lax.top_k(-merged_c, r)
+            return (-neg_c, jnp.take_along_axis(merged_t, m, axis=1)), (
+                provider, cost_k,
+            )
+
+        carry0 = (
+            jnp.full((Pn, r), jnp.float32(INFEASIBLE)),
+            jnp.full((Pn, r), -1, jnp.int32),
+        )
+        (rev_c_l, rev_t_l), (cand_p, cand_c) = lax.scan(
+            step, carry0, jnp.arange(Tl // tile, dtype=jnp.int32) * tile
+        )
+        return (
+            cand_p.reshape(Tl, k),
+            cand_c.reshape(Tl, k),
+            rev_c_l[None],  # [1, P, r] -> stacked [D, P, r] across shards
+            rev_t_l[None],
+        )
+
+    return gen

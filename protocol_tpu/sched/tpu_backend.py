@@ -52,7 +52,6 @@ from protocol_tpu.ops.sparse import (
     assign_auction_sparse_scaled,
     assign_auction_sparse_warm,
     candidates_topk,
-    candidates_topk_bidir,
 )
 from protocol_tpu.sched.cand_cache import (
     CandidateCache,

@@ -1,0 +1,124 @@
+"""BASELINE ladder #3 executed AT SHAPE: Sinkhorn-OT soft assignment at
+P = T = 100,000 (matrix-free blocked potentials + plan-guided rounding),
+with assignment quality compared against the eps-scaled auction on the
+SAME instance (VERDICT r4 item 5's done-bar).
+
+The [P, T] tensor would be 40 GB — both pipelines here are streaming
+(O(P * tile) peak), and quality is measured pairwise via ops.cost.cost_pairs
+for the same reason. Run:
+
+    python scripts/stage_s_100k.py [--cpu]
+
+Emits one JSON line per stage row (consumed by the r5 scaling artifact).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="force host CPU")
+    ap.add_argument("--size", type=int, default=100_000)
+    ap.add_argument("--tile", type=int, default=2500)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    if args.cpu:
+        from protocol_tpu.utils.platform import force_host_cpu
+
+        force_host_cpu(1)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from protocol_tpu.ops.blocked import (
+        assign_sinkhorn_blocked,
+        sinkhorn_potentials_blocked,
+    )
+    from protocol_tpu.ops.cost import INFEASIBLE, CostWeights, cost_pairs
+    from protocol_tpu.ops.sparse import (
+        assign_auction_sparse_scaled,
+        candidates_topk_bidir,
+    )
+
+    P = T = args.size
+    tile = args.tile
+    assert T % tile == 0, f"tile {tile} must divide T {T}"
+    platform = jax.devices()[0].platform
+    weights = CostWeights()
+    rng = np.random.default_rng(42)
+    print(f"# stage S at shape: P=T={P} tile={tile} platform={platform}",
+          file=sys.stderr, flush=True)
+    ep = jax.tree.map(jnp.asarray, bench.synth_providers(rng, P))
+    er = jax.tree.map(jnp.asarray, bench.synth_requirements(rng, T))
+
+    def quality(p4t) -> dict:
+        c = np.asarray(cost_pairs(ep, er, p4t, weights))
+        p4t = np.asarray(p4t)
+        ok = (p4t >= 0) & (c < INFEASIBLE * 0.5)
+        pos = p4t[p4t >= 0]
+        return {
+            "assigned": int((p4t >= 0).sum()),
+            "injective": bool(np.unique(pos).size == pos.size),
+            "infeasible_pairs": int((p4t >= 0).sum() - ok.sum()),
+            "mean_cost": round(float(c[ok].mean()), 4) if ok.any() else None,
+        }
+
+    # ---- Sinkhorn potentials alone (the OT solve) ----
+    t0 = time.perf_counter()
+    u, v = sinkhorn_potentials_blocked(
+        ep, er, weights, eps=0.05, num_iters=args.iters, tile=tile
+    )
+    jax.block_until_ready((u, v))
+    t_pot = time.perf_counter() - t0
+
+    # ---- full pipeline: potentials -> plan-guided candidates -> rounding
+    t0 = time.perf_counter()
+    res_s = assign_sinkhorn_blocked(
+        ep, er, weights, eps=0.05, num_iters=args.iters, tile=tile, k=32
+    )
+    jax.block_until_ready(res_s.provider_for_task)
+    t_sink = time.perf_counter() - t0
+    q_sink = quality(res_s.provider_for_task)
+    print(json.dumps({
+        "stage": "S sinkhorn-OT at shape (measured)",
+        "platform": platform,
+        "shape": f"P=T={P} iters={args.iters} tile={tile}",
+        "potentials_s": round(t_pot, 2),
+        "end_to_end_s": round(t_sink, 2),
+        **{f"sinkhorn_{k}": v for k, v in q_sink.items()},
+    }), flush=True)
+
+    # ---- the auction on the SAME instance (quality referee) ----
+    t0 = time.perf_counter()
+    cp, cc = candidates_topk_bidir(
+        ep, er, weights, k=64, tile=tile, reverse_r=8, extra=16
+    )
+    jax.block_until_ready((cp, cc))
+    t_gen = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res_a = assign_auction_sparse_scaled(
+        cp, cc, num_providers=P, frontier=8192
+    )
+    jax.block_until_ready(res_a.provider_for_task)
+    t_solve = time.perf_counter() - t0
+    q_auc = quality(res_a.provider_for_task)
+    print(json.dumps({
+        "stage": "S auction referee on the same instance (measured)",
+        "platform": platform,
+        "shape": f"P=T={P} k=64 bidir",
+        "gen_s": round(t_gen, 2),
+        "solve_s": round(t_solve, 2),
+        **{f"auction_{k}": v for k, v in q_auc.items()},
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

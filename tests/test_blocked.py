@@ -60,3 +60,34 @@ def test_tile_divisibility():
     ep, er = encode_random_marketplace(2, 8, 10)
     with pytest.raises(ValueError):
         sinkhorn_potentials_blocked(ep, er, tile=4)
+
+
+class TestCostPairs:
+    def test_matches_dense_cost_matrix(self):
+        """cost_pairs must agree with the dense tensor entry-for-entry,
+        including unassigned rows and the tail of a non-tile-multiple T
+        (it is the quality instrument for shapes where [P, T] cannot
+        exist)."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from protocol_tpu.ops.cost import (
+            INFEASIBLE,
+            CostWeights,
+            cost_matrix,
+            cost_pairs,
+        )
+        from tests.test_sparse import encode_random_marketplace
+
+        ep, er = encode_random_marketplace(3, 96, 100)
+        w = CostWeights()
+        dense, _ = cost_matrix(ep, er, w)
+        rng = np.random.default_rng(0)
+        p4t = rng.integers(-1, 96, size=100).astype(np.int32)
+        got = np.asarray(cost_pairs(ep, er, jnp.asarray(p4t), w))
+        want = np.where(
+            p4t >= 0,
+            np.asarray(dense)[np.maximum(p4t, 0), np.arange(100)],
+            INFEASIBLE,
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6)
