@@ -322,19 +322,21 @@ def main() -> None:
     secs_cold, out_cold = measure(
         lambda z: assign_auction_sparse_scaled(
             cpb, ccb + z * 0, num_providers=P_B, frontier=min(T_AUCTION, 8192),
-            with_prices=True,
+            with_state=True,
         )
     )
-    res_cold, price_cold = out_cold
+    res_cold, price_cold, retired_cold = out_cold
     # 1% churn: drop a contiguous 1% of the matching (freed providers /
-    # re-opened tasks) and re-solve warm from the carried prices
+    # re-opened tasks) and re-solve warm from the carried duals — prices
+    # AND the retirement mask (the production chain shape; without the
+    # mask the warm solve re-fights the priced-out tail every step)
     p4t0 = jnp.asarray(res_cold.provider_for_task)
     n_churn = max(T_AUCTION // 100, 1)
     p4t0 = p4t0.at[:n_churn].set(-1)
     secs_warm, _ = measure(
         lambda z: assign_auction_sparse_warm(
             cpb, ccb + z * 0, num_providers=P_B,
-            price0=price_cold, p4t0=p4t0,
+            price0=price_cold, p4t0=p4t0, retired0=retired_cold,
             frontier=min(T_AUCTION, 8192),
         )[0].provider_for_task
     )

@@ -692,7 +692,11 @@ class CandidateMemo:
         return h.digest()
 
     def get(self, ep, er, weights, *, k, tile, reverse_r, extra,
-            approx_recall=None):
+            approx_recall=None, gen=None):
+        """``gen`` overrides the generator (e.g. the task-sharded mesh
+        twin) — it shares the memo key because the sharded generator is
+        bit-identical to the single-device one (tested parity), so hits
+        are interchangeable across paths."""
         from protocol_tpu.ops.sparse import candidates_topk_bidir
 
         key = (
@@ -706,7 +710,8 @@ class CandidateMemo:
             self._slots[key] = hit  # re-insert: LRU order
             return hit
         self.misses += 1
-        out = candidates_topk_bidir(
+        gen_fn = gen or candidates_topk_bidir
+        out = gen_fn(
             ep, er, weights, k=k, tile=tile, reverse_r=reverse_r,
             extra=extra, approx_recall=approx_recall,
         )

@@ -437,11 +437,31 @@ class ValidatorService:
 
     async def challenge_node(self, control_url: str) -> bool:
         """Matmul round-trip: both sides compute on their accelerator; the
-        worker's answer must match within tolerance."""
+        worker's answer must match within tolerance.
+
+        Inputs travel as FixedF64 (utils/fixedf64.py, the reference's
+        deterministic wire format — hardware_challenge.rs:8-54), so both
+        sides hold bit-identical float64 inputs; the RESULT comparison
+        stays tolerance-based because validator and worker legitimately
+        run on different hardware (see PARITY.md)."""
+        from protocol_tpu.utils import fixedf64
+
         n = self.challenge_size
         a = self.rng.standard_normal((n, n), dtype=np.float32)
         b = self.rng.standard_normal((n, n), dtype=np.float32)
-        payload = {"matrix_a": a.tolist(), "matrix_b": b.tolist()}
+        # quantize locally FIRST so this side computes on exactly the
+        # values the worker will decode
+        a = fixedf64.roundtrip(a).astype(np.float32)
+        b = fixedf64.roundtrip(b).astype(np.float32)
+        # both wires during rollout: a pre-FixedF64 worker reads the float
+        # lists (Python json round-trips them exactly), a current one
+        # prefers the fixed ints
+        payload = {
+            "matrix_a_fixed": fixedf64.encode_array(a),
+            "matrix_b_fixed": fixedf64.encode_array(b),
+            "matrix_a": a.tolist(),
+            "matrix_b": b.tolist(),
+        }
         headers, body = sign_request("/control/challenge", self.wallet, payload)
         try:
             async with self.http.post(
@@ -460,7 +480,17 @@ class ValidatorService:
             return np.asarray(jnp.asarray(a) @ jnp.asarray(b))
 
         expected = await asyncio.to_thread(compute)
-        got = np.asarray(data.get("result", []), dtype=np.float32)
+        try:
+            if "result_fixed" in data:
+                got = fixedf64.decode_array(data["result_fixed"]).astype(
+                    np.float32
+                )
+            else:
+                got = np.asarray(data.get("result", []), dtype=np.float32)
+        except (ValueError, TypeError):
+            # worker-controlled payload: a malformed answer fails THIS
+            # challenge, it must not abort the whole validation tick
+            return False
         if got.shape != expected.shape:
             return False
         return bool(np.allclose(got, expected, atol=self.challenge_tolerance * n))

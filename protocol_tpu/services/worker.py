@@ -640,27 +640,46 @@ class WorkerAgent:
         accelerator via jnp (the reference's nalgebra calc_matrix,
         p2p/src/message/hardware_challenge.rs:74-89, made device-native)."""
         body = request.get("auth_body") or {}
+        import numpy as np
+
+        from protocol_tpu.utils import fixedf64
+
+        fixed_wire = "matrix_a_fixed" in body
         try:
-            a = body["matrix_a"]
-            b = body["matrix_b"]
-        except KeyError:
+            if fixed_wire:
+                # FixedF64 wire (hardware_challenge.rs:8-54): decode to the
+                # bit-exact float64s the validator encoded
+                a = fixedf64.decode_array(body["matrix_a_fixed"]).astype(np.float32)
+                b = fixedf64.decode_array(body["matrix_b_fixed"]).astype(np.float32)
+            else:  # legacy float-JSON wire
+                a = np.asarray(body["matrix_a"], np.float32)
+                b = np.asarray(body["matrix_b"], np.float32)
+        except (KeyError, ValueError, TypeError):
             return web.json_response(
                 {"success": False, "error": "missing matrices"}, status=400
             )
-        import numpy as np
 
         def compute():
             # device work off the event loop: jax calls are synchronous and
             # must not stall the control plane if the accelerator is slow
             import jax.numpy as jnp
 
-            out = jnp.asarray(np.asarray(a, np.float32)) @ jnp.asarray(
-                np.asarray(b, np.float32)
-            )
-            return np.asarray(out).tolist()
+            return np.asarray(jnp.asarray(a) @ jnp.asarray(b))
 
         result = await asyncio.to_thread(compute)
-        return web.json_response({"success": True, "result": result})
+        if fixed_wire:
+            try:
+                encoded = fixedf64.encode_array(result)
+            except ValueError:
+                # adversarially-huge (but decodable) inputs can overflow
+                # the float32 matmul to inf/nan — a clean rejection, not
+                # a 500
+                return web.json_response(
+                    {"success": False, "error": "non-finite result"},
+                    status=400,
+                )
+            return web.json_response({"success": True, "result_fixed": encoded})
+        return web.json_response({"success": True, "result": result.tolist()})
 
     async def handle_logs(self, request: web.Request) -> web.Response:
         fetch = getattr(self.runtime, "get_logs", None)

@@ -1,0 +1,60 @@
+"""Deterministic fixed-point float wire format for challenge payloads.
+
+Reference counterpart: crates/p2p/src/message/hardware_challenge.rs:8-54 —
+``FixedF64``, an i64 wrapper that serializes f64 challenge values as
+fixed-point integers so both sides of the wire hold BIT-IDENTICAL inputs
+regardless of the peer's float formatter/parser (a JSON round-trip through
+a different language's repr can perturb the last ulp, and a challenge
+that hashes or compares inputs must not depend on that).
+
+Same Q31.32 semantics here: ``encode(x) = round(x * 2^32)`` as a Python
+int (arbitrary precision — no i64 overflow concerns on this side),
+``decode`` the exact inverse onto float64. Challenge matrices travel
+encoded; each side decodes to the same float64s, so the only remaining
+divergence between validator and worker is the device matmul itself —
+which is compared under an explicit tolerance because the two sides
+legitimately run on DIFFERENT hardware (TPU accumulation order vs host
+BLAS; the reference compares exactly only because both of its sides run
+the same nalgebra CPU kernel — see PARITY.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SCALE_BITS = 32
+_SCALE = float(1 << SCALE_BITS)
+
+
+def encode_array(x) -> list:
+    """float array (any nesting) -> same-shape nested lists of ints.
+
+    Raises ValueError on non-finite values: inf/nan have no fixed-point
+    representation, and int(inf)/int(nan) would otherwise surface as an
+    unrelated OverflowError deep in a wire handler."""
+    arr = np.asarray(x, np.float64)
+    if not np.isfinite(arr).all():
+        raise ValueError("non-finite value cannot be FixedF64-encoded")
+    q = np.rint(arr * _SCALE)
+    # arbitrary-precision ints via Python objects: values beyond i64 are
+    # legal on this wire (challenge entries are ~N(0,1), so in practice
+    # they are tiny, but the codec must not silently wrap)
+    return np.vectorize(int, otypes=[object])(q).tolist()
+
+
+def decode_array(x) -> np.ndarray:
+    """nested lists of ints -> float64 ndarray (exact inverse of encode
+    up to the quantization done at encode time).
+
+    Wire input is untrusted: ragged shapes, strings, or ints beyond
+    float64 range all raise ValueError (never OverflowError/TypeError),
+    so handlers need exactly one except clause."""
+    try:
+        return np.asarray(x, np.float64) / _SCALE
+    except (OverflowError, TypeError, ValueError) as e:
+        raise ValueError(f"malformed FixedF64 payload: {e}") from e
+
+
+def roundtrip(x) -> np.ndarray:
+    """The values a peer will see after one wire crossing."""
+    return decode_array(encode_array(x))

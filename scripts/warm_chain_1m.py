@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Ladder-#4 steady state: a WARM CHAIN at the full 1M x 1M shape
+(VERDICT r4 item 6's done-bar).
+
+N consecutive churn -> warm-solve steps on the 8-device mesh, carrying
+the full dual state (prices + retirement mask) across solves, reporting
+per-step wall, rounds, and completeness — the evidence that steady-state
+warm cost stays BOUNDED across a chain (no price-ratchet drift, no
+per-step tail re-fight), which is the 10 s-cadence argument at 1M.
+
+Synthetic uniform candidates as in stageb_1m_smoke.py: execution
+evidence at shape (quality evidence lives in the 65k real-feature runs).
+
+    python scripts/warm_chain_1m.py [--steps 10] [--churn 0.01]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from protocol_tpu.utils.platform import force_host_cpu  # noqa: E402
+
+force_host_cpu(8)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from protocol_tpu.parallel import (  # noqa: E402
+    assign_auction_sparse_scaled_sharded,
+    assign_auction_sparse_warm_sharded,
+    make_mesh,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--churn", type=float, default=0.01)
+    ap.add_argument("--size", type=int, default=1_000_000)
+    args = ap.parse_args()
+
+    T = P = args.size
+    K = 80
+    EPS_END = 1.0  # matches the smoke's bounded cold ladder
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    cand_p = jnp.asarray(rng.integers(0, P, size=(T, K), dtype=np.int32))
+    cand_c = jnp.asarray(rng.uniform(0.0, 10.0, size=(T, K)).astype(np.float32))
+    print(f"# synth built {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+
+    mesh = make_mesh(8)
+    t0 = time.time()
+    res, price, retired = assign_auction_sparse_scaled_sharded(
+        cand_p, cand_c, num_providers=P, mesh=mesh,
+        eps_start=4.0, eps_end=EPS_END, max_iters_per_phase=512,
+        frontier=8192, frontier_ladder=True, with_state=True,
+    )
+    cold_wall = time.time() - t0
+    p4t = np.asarray(res.provider_for_task)
+    print(json.dumps({
+        "step": 0, "kind": "cold", "wall_s": round(cold_wall, 1),
+        "assigned": int((p4t >= 0).sum()),
+        "retired": int(np.asarray(retired).sum()),
+        "price_max": round(float(np.asarray(price).max()), 3),
+    }), flush=True)
+
+    n_churn = max(int(T * args.churn), 1)
+    churn_rng = np.random.default_rng(7)
+    for step in range(1, args.steps + 1):
+        # churn a RANDOM slice each step (a fixed prefix would re-churn
+        # the same tasks; random spread is the production shape). Churned
+        # tasks lose their seat AND their retirement flag (they are "new"
+        # work), mirroring the matcher's seed rebuild.
+        idx = churn_rng.choice(T, size=n_churn, replace=False)
+        p4t0 = jnp.asarray(p4t).at[idx].set(-1)
+        retired = jnp.asarray(retired).at[idx].set(False)
+        stats: dict = {}
+        t0 = time.time()
+        res, price, retired = assign_auction_sparse_warm_sharded(
+            cand_p, cand_c, num_providers=P, mesh=mesh,
+            price0=price, p4t0=p4t0, eps=EPS_END, max_iters=1024,
+            frontier=8192, frontier_ladder=True,
+            retired0=retired, with_state=True, stats_out=stats,
+        )
+        wall = time.time() - t0
+        p4t = np.asarray(res.provider_for_task)
+        pos = p4t[p4t >= 0]
+        print(json.dumps({
+            "step": step, "kind": "warm", "wall_s": round(wall, 1),
+            "assigned": int((p4t >= 0).sum()),
+            "injective": bool(np.unique(pos).size == pos.size),
+            "retired": int(np.asarray(retired).sum()),
+            "price_max": round(float(np.asarray(price).max()), 3),
+            "stall_exit": stats.get("stall_exit"),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -353,3 +353,27 @@ class TestMeshMatcher:
         # the sharded frontier order is a different, equally valid auction
         # schedule: counts must match even where the matching may differ
         assert solve(True) == solve(False) == 40
+
+
+    def test_mesh_wire_path_shards_generation(self):
+        """warm_start=False disables the candidate cache, sending the
+        solve down the wire path — with a mesh, candidate GENERATION
+        itself shards (candidates_topk_bidir_sharded; bit-identical to
+        the single-device generator, so counts must match exactly)."""
+        def solve(use_mesh):
+            ctx = StoreContext.new_test()
+            populate(ctx, 96, [
+                mk_bounded_task("a", 1.0, 40, "gpu:count=8;gpu:model=H100"),
+            ])
+            m = TpuBatchMatcher(
+                ctx, min_solve_interval=0.0, dense_cell_budget=1,
+                use_mesh=use_mesh, warm_start=False,
+            )
+            m.mark_dirty()
+            m._ensure_fresh()
+            s = m.last_solve_stats
+            assert s["kernel"] == "sparse_topk"
+            assert s["mesh_gen_sharded"] is use_mesh
+            return s["assigned"]
+
+        assert solve(True) == solve(False) == 40
