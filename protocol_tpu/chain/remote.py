@@ -36,24 +36,51 @@ class RemoteLedger:
         base_url: str,
         admin_api_key: str = "",
         timeout: float = 10.0,
+        max_tries: int = 3,
+        retry_delay: float = 2.0,
     ):
         self.base_url = base_url.rstrip("/")
         self.admin_api_key = admin_api_key
         self.timeout = timeout
+        self.max_tries = max_tries
+        self.retry_delay = retry_delay
         self._http = KeepAliveJsonClient(base_url, timeout, LedgerError)
 
     # ---- transport
 
     def _call(self, kind: str, op: str, params: dict):
+        """Transport with the reference's retry_call semantics
+        (crates/shared/src/web3/contracts/helpers/utils.rs:22-70): writes
+        retry up to ``max_tries`` with a delay, and a per-call ``tx_id``
+        makes the resend safe — if the earlier attempt actually landed
+        but its response was lost, the ledger API replays the recorded
+        outcome instead of double-applying (the receipt check's HTTP
+        analog). Application errors (LedgerError from the ledger itself)
+        never retry; only transport failures do."""
+        import time as _time
+        import uuid
+
         headers = {}
-        if kind == "write" and self.admin_api_key:
+        write = kind == "write"
+        if write and self.admin_api_key:
             headers["Authorization"] = f"Bearer {self.admin_api_key}"
-        payload = self._http.post(
-            f"/ledger/{kind}/{op}",
-            params,
-            headers=headers,
-            retry_response=(kind == "read"),
-        )
+        if write:
+            params = {**params, "tx_id": uuid.uuid4().hex}
+        tries = max(1, self.max_tries) if write else 1
+        for attempt in range(tries):
+            try:
+                payload = self._http.post(
+                    f"/ledger/{kind}/{op}",
+                    params,
+                    headers=headers,
+                    # tx_id dedup makes write resends safe end-to-end
+                    retry_response=True,
+                )
+                break
+            except LedgerError:
+                if attempt == tries - 1:
+                    raise
+                _time.sleep(self.retry_delay)
         if not payload.get("success"):
             raise LedgerError(payload.get("error", f"{op} failed"))
         return payload.get("data")

@@ -14,6 +14,7 @@ POST /ledger/{op} with a JSON params object; responses are
 from __future__ import annotations
 
 import json
+import time
 from typing import Any
 
 from aiohttp import web
@@ -93,9 +94,18 @@ def _jsonable(value: Any) -> Any:
 
 
 class LedgerApiService:
+    # tx_id -> (expiry, response payload). The HTTP analog of the
+    # reference's receipt check in retry_call
+    # (crates/shared/src/web3/contracts/helpers/utils.rs:22-70): a client
+    # retrying a write whose RESPONSE was lost must not double-apply the
+    # transaction, so writes carrying a tx_id are deduplicated and the
+    # recorded outcome is replayed.
+    _TX_TTL = 600.0
+
     def __init__(self, ledger: Ledger, admin_api_key: str = "admin"):
         self.ledger = ledger
         self.admin_api_key = admin_api_key
+        self._tx_seen: dict[str, tuple[float, dict]] = {}
 
     def make_app(self) -> web.Application:
         app = web.Application(
@@ -109,7 +119,10 @@ class LedgerApiService:
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
-    async def _call(self, op: str, allowed: set[str], request: web.Request) -> web.Response:
+    async def _call(
+        self, op: str, allowed: set[str], request: web.Request,
+        dedup: bool = False,
+    ) -> web.Response:
         if op not in allowed:
             return web.json_response(
                 {"success": False, "error": f"unknown op {op}"}, status=404
@@ -120,18 +133,44 @@ class LedgerApiService:
             return web.json_response(
                 {"success": False, "error": "invalid json"}, status=400
             )
+        if not isinstance(params, dict):
+            return web.json_response(
+                {"success": False, "error": "bad params: body must be an object"},
+                status=400,
+            )
+        # tx_id dedup is a WRITE-path facility (dedup=True): the write
+        # routes are admin-key gated, so only authenticated writers can
+        # populate the cache — reads accepting tx_id would hand
+        # unauthenticated callers an unbounded-memory lever
+        tx_id = params.pop("tx_id", None) if dedup else None
+        if tx_id is not None:
+            now = time.monotonic()
+            hit = self._tx_seen.get(str(tx_id))
+            if hit is not None and hit[0] > now:
+                payload, status = hit[1]
+                return web.json_response(payload, status=status)
         try:
             result = getattr(self.ledger, op)(**params)
+            payload, status = {"success": True, "data": _jsonable(result)}, 200
         except LedgerError as e:
-            return web.json_response({"success": False, "error": str(e)}, status=400)
+            payload, status = {"success": False, "error": str(e)}, 400
         except TypeError as e:
-            return web.json_response(
-                {"success": False, "error": f"bad params: {e}"}, status=400
-            )
-        return web.json_response({"success": True, "data": _jsonable(result)})
+            payload, status = {"success": False, "error": f"bad params: {e}"}, 400
+        if tx_id is not None:
+            # record the outcome (success OR application error: a retry of
+            # a rejected tx must replay the rejection, not re-run it) and
+            # sweep expired entries
+            now = time.monotonic()
+            self._tx_seen = {
+                k: v for k, v in self._tx_seen.items() if v[0] > now
+            }
+            self._tx_seen[str(tx_id)] = (now + self._TX_TTL, (payload, status))
+        return web.json_response(payload, status=status)
 
     async def write_op(self, request: web.Request) -> web.Response:
-        return await self._call(request.match_info["op"], WRITE_OPS, request)
+        return await self._call(
+            request.match_info["op"], WRITE_OPS, request, dedup=True
+        )
 
     async def read_op(self, request: web.Request) -> web.Response:
         return await self._call(request.match_info["op"], READ_OPS, request)

@@ -11,8 +11,12 @@ while SENDING never reached the server and is always safe to resend
 (including the stale kept-alive socket the server closed while idle). A
 failure while READING the response is ambiguous — the server may have
 applied the request — so it is retried only when the caller marks the
-operation response-retryable (reads). Non-idempotent writes therefore
-never double-apply.
+operation response-retryable. That flag is safe for reads always; for
+WRITES it is safe only when the caller makes the resend idempotent
+end-to-end (e.g. RemoteLedger attaches a per-call tx_id the ledger API
+deduplicates — a resent applied-but-response-lost write replays the
+recorded outcome). A write without such a peer-side guarantee must NOT
+set it, or a lost response can double-apply.
 """
 
 from __future__ import annotations
@@ -81,7 +85,7 @@ class KeepAliveJsonClient:
         """POST json, return the parsed body (also for error statuses —
         callers inspect {"success": ...}). ``retry_response=True`` marks
         the op safe to resend after a failure while reading the response
-        (reads only; see module docstring)."""
+        (reads, or writes the peer deduplicates — see module docstring)."""
         body = json.dumps(payload)
         hdrs = {"Content-Type": "application/json", **(headers or {})}
         full_path = f"{self._prefix}{path}"

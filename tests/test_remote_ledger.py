@@ -178,3 +178,139 @@ def test_services_accept_remote_ledger(ledger_api):
             return r.status
 
     assert asyncio.new_event_loop().run_until_complete(flow()) == 200
+
+
+class TestWriteRetry:
+    """The reference's retry_call semantics over HTTP
+    (web3/contracts/helpers/utils.rs:22-70): transport failures retry,
+    and the tx_id dedup guarantees a lost-response resend cannot
+    double-apply the write."""
+
+    def _flaky_proxy(self, upstream_port, fail_plan):
+        """A TCP proxy that, per connection index in ``fail_plan``,
+        forwards the request to the real ledger API but KILLS the client
+        connection before relaying the response — the applied-but-
+        response-lost failure mode."""
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(8)
+        port = srv.getsockname()[1]
+        seen = {"n": 0}
+
+        def pump():
+            while True:
+                try:
+                    cli, _ = srv.accept()
+                except OSError:
+                    return
+                i = seen["n"]
+                seen["n"] += 1
+                try:
+                    cli.settimeout(5)
+                    data = b""
+                    while b"\r\n\r\n" not in data:
+                        data += cli.recv(65536)
+                    head, _, body = data.partition(b"\r\n\r\n")
+                    length = 0
+                    for line in head.split(b"\r\n"):
+                        if line.lower().startswith(b"content-length:"):
+                            length = int(line.split(b":")[1])
+                    while len(body) < length:
+                        body += cli.recv(65536)
+                    up = socket.create_connection(("127.0.0.1", upstream_port), 5)
+                    up.sendall(data)
+                    resp = b""
+                    up.settimeout(5)
+                    try:
+                        while True:
+                            chunk = up.recv(65536)
+                            if not chunk:
+                                break
+                            resp += chunk
+                            if b"\r\n\r\n" in resp:
+                                # headers in; our API responds in one shot
+                                break
+                    except TimeoutError:
+                        pass
+                    up.close()
+                    if i in fail_plan:
+                        cli.close()  # response lost
+                    else:
+                        cli.sendall(resp)
+                        cli.close()
+                except Exception:
+                    cli.close()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        return port, srv
+
+    def test_lost_response_retry_applies_once(self, ledger_api):
+        local, remote = ledger_api
+        upstream_port = int(remote.base_url.rsplit(":", 1)[1])
+        # fail the FIRST proxied connection's response (after forwarding)
+        port, srv = self._flaky_proxy(upstream_port, fail_plan={0})
+        try:
+            flaky = RemoteLedger(
+                f"http://127.0.0.1:{port}", admin_api_key="adm",
+                retry_delay=0.05,
+            )
+            addr = "0xretry-once"
+            before = local.balance_of(addr)
+            flaky.mint(addr, 250)  # attempt 1 applies, response dies; retry dedups
+            assert local.balance_of(addr) == before + 250, (
+                "lost-response retry must apply the write exactly once"
+            )
+        finally:
+            srv.close()
+
+    def test_app_errors_do_not_retry(self, ledger_api):
+        _local, remote = ledger_api
+        calls = {"n": 0}
+        orig = remote._http.post
+
+        def counting(path, payload, **kw):
+            calls["n"] += 1
+            return orig(path, payload, **kw)
+
+        remote._http.post = counting
+        try:
+            with pytest.raises(LedgerError):
+                # transferring from an empty account is an APPLICATION
+                # error: exactly one wire call, no retries
+                remote.transfer("0xempty-src", "0xdst", 10**9)
+        finally:
+            remote._http.post = orig
+        assert calls["n"] == 1
+
+
+def test_read_path_ignores_tx_id_and_bad_bodies(ledger_api):
+    """tx_id dedup is a write-path facility: reads are unauthenticated,
+    so accepting tx_id there would hand strangers a memory lever. And
+    non-object bodies get a clean 400, not a 500."""
+    import json as _json
+    import urllib.request
+
+    _local, remote = ledger_api
+    base = remote.base_url
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path, data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read())
+
+    s1, d1 = post("/ledger/read/balance_of", {"address": "0xa", "tx_id": "x1"})
+    # tx_id is NOT stripped on reads -> unknown kwarg -> clean 400
+    assert s1 == 400 and "bad params" in d1["error"]
+    s2, d2 = post("/ledger/read/balance_of", [1, 2])
+    assert s2 == 400 and "object" in d2["error"]
+    s3, d3 = post("/ledger/read/balance_of", {"address": "0xa"})
+    assert s3 == 200 and d3["success"]
