@@ -4,18 +4,25 @@ The reference authenticates every HTTP request between untrusted parties
 with an Ethereum wallet signature over ``endpoint + sorted-JSON body`` plus
 a nonce (crates/shared/src/security/). This package keeps that protocol
 shape — ``x-address`` / ``x-signature`` headers, nonce replay cache, rate
-limiting, body caps — over two interchangeable schemes behind one verifier:
-Ed25519 (:class:`Wallet`, the default) and secp256k1/keccak
-(:class:`EvmWallet`, the reference's exact scheme with real Ethereum
-addresses). Neither uses public-key recovery on the wire: the signature
-value carries the public key and the verifier checks it hashes to the
-claimed address.
+limiting, body caps — over three interchangeable schemes behind one
+verifier: Ed25519 (:class:`Wallet`, the default), secp256k1/keccak with
+an embedded pubkey (:class:`EvmWallet` — real Ethereum addresses, cheap
+verification), and the reference's literal recovery wire
+(:class:`EvmRecoveryWallet` — 0x + r||s||v over the EIP-191 digest,
+verified by pure-Python public-key recovery, so signatures from alloy/
+MetaMask-style clients authenticate verbatim).
 """
 
-from protocol_tpu.security.wallet import EvmWallet, Wallet, verify_signature
+from protocol_tpu.security.wallet import (
+    EvmRecoveryWallet,
+    EvmWallet,
+    Wallet,
+    verify_signature,
+)
 from protocol_tpu.security.signer import sign_request, verify_request
 
 __all__ = [
+    "EvmRecoveryWallet",
     "EvmWallet",
     "Wallet",
     "sign_request",

@@ -157,6 +157,117 @@ _PREHASHED32 = ec.ECDSA(Prehashed(hashes.SHA256()))
 # the signed-URL artifact path, never the signed-JSON plane.
 EVM_MAX_MESSAGE_BYTES = 64 * 1024
 
+# ---------------------------------------------------------------------------
+# secp256k1 group math for ECDSA public-key RECOVERY — the reference's wire
+# carries a 65-byte r||s||v signature and derives the signer by recovery
+# (alloy recover_address_from_msg, auth_signature_middleware.rs:386), with
+# the EIP-191 personal-message digest. `cryptography` exposes no recovery,
+# so the few group operations live here (Jacobian coordinates, one field
+# inverse per recovery; ~ms per verify — control-plane rates, off the event
+# loop, and size-capped like every keccak path).
+# ---------------------------------------------------------------------------
+
+_FP = 2**256 - 2**32 - 977  # secp256k1 field prime
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _jac_double(p):
+    x, y, z = p
+    if y == 0:
+        return (0, 1, 0)
+    s = (4 * x * y * y) % _FP
+    m = (3 * x * x) % _FP  # a = 0 for secp256k1
+    x2 = (m * m - 2 * s) % _FP
+    y2 = (m * (s - x2) - 8 * pow(y, 4, _FP)) % _FP
+    z2 = (2 * y * z) % _FP
+    return (x2, y2, z2)
+
+
+def _jac_add(p, q):
+    if p[2] == 0:
+        return q
+    if q[2] == 0:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1s, z2s = (z1 * z1) % _FP, (z2 * z2) % _FP
+    u1, u2 = (x1 * z2s) % _FP, (x2 * z1s) % _FP
+    s1, s2 = (y1 * z2s * z2) % _FP, (y2 * z1s * z1) % _FP
+    if u1 == u2:
+        if s1 != s2:
+            return (0, 1, 0)  # inverse points
+        return _jac_double(p)
+    h = (u2 - u1) % _FP
+    r = (s2 - s1) % _FP
+    h2 = (h * h) % _FP
+    h3 = (h2 * h) % _FP
+    x3 = (r * r - h3 - 2 * u1 * h2) % _FP
+    y3 = (r * (u1 * h2 - x3) - s1 * h3) % _FP
+    z3 = (h * z1 * z2) % _FP
+    return (x3, y3, z3)
+
+
+def _jac_mul(k, point_affine):
+    acc = (0, 1, 0)
+    add = (point_affine[0], point_affine[1], 1)
+    while k:
+        if k & 1:
+            acc = _jac_add(acc, add)
+        add = _jac_double(add)
+        k >>= 1
+    return acc
+
+
+def _jac_to_affine(p):
+    if p[2] == 0:
+        return None
+    zinv = pow(p[2], _FP - 2, _FP)
+    zinv2 = (zinv * zinv) % _FP
+    return ((p[0] * zinv2) % _FP, (p[1] * zinv2 * zinv) % _FP)
+
+
+def ecrecover(digest: bytes, r: int, s: int, v: int) -> Optional[bytes]:
+    """Recover the uncompressed secp256k1 public key (65 bytes) from an
+    ECDSA signature over ``digest``; v is the recovery id (0/1, or the
+    Ethereum 27/28 form). Returns None for any invalid input."""
+    if v >= 27:
+        v -= 27
+    if v not in (0, 1) or not (1 <= r < _SECP_N and 1 <= s < _SECP_N):
+        return None
+    if len(digest) != 32:
+        return None
+    # R: the curve point whose x-coordinate is r (the r + n overflow case
+    # requires x in [n, p), a ~2^-128 sliver — rejected, as most verifiers do)
+    x = r
+    y_sq = (pow(x, 3, _FP) + 7) % _FP
+    y = pow(y_sq, (_FP + 1) // 4, _FP)
+    if (y * y) % _FP != y_sq:
+        return None
+    if y % 2 != v:
+        y = _FP - y
+    z = int.from_bytes(digest, "big")
+    rinv = pow(r, _SECP_N - 2, _SECP_N)
+    # Q = r^-1 * (s*R - z*G); _jac_mul takes an AFFINE base point, so the
+    # inner sum is normalized before the final scalar multiply
+    sR = _jac_mul(s, (x, y))
+    zG = _jac_mul(z, (_GX, _GY))
+    neg_zG = (zG[0], (-zG[1]) % _FP, zG[2])
+    inner = _jac_to_affine(_jac_add(sR, neg_zG))
+    if inner is None:
+        return None
+    q = _jac_to_affine(_jac_mul(rinv, inner))
+    if q is None:
+        return None
+    return b"\x04" + q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big")
+
+
+def eip191_digest(message: bytes) -> bytes:
+    """keccak256 of the EIP-191 personal-message envelope — what
+    alloy/MetaMask ``sign_message`` actually signs."""
+    prefix = b"\x19Ethereum Signed Message:\n" + str(len(message)).encode()
+    return keccak256(prefix + message)
+
 
 class EvmWallet:
     """secp256k1/keccak wallet — the reference's exact signing scheme
@@ -207,6 +318,46 @@ class EvmWallet:
         sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
         return f"{self._pub_bytes.hex()}:{sig.hex()}"
 
+    def sign_message_eth(self, message: bytes | str) -> str:
+        """The reference's EXACT wire: ``0x`` + 65-byte r||s||v over the
+        EIP-191 personal-message digest (what alloy's ``sign_message``
+        emits, request_signer.rs:55-63) — verifiable by any Ethereum
+        tool, and by :func:`verify_signature` via public-key recovery."""
+        if isinstance(message, str):
+            message = message.encode()
+        if len(message) > EVM_MAX_MESSAGE_BYTES:
+            raise ValueError(
+                f"message of {len(message)} bytes exceeds the "
+                f"{EVM_MAX_MESSAGE_BYTES}-byte keccak signing cap"
+            )
+        digest = eip191_digest(message)
+        der = self._key.sign(digest, _PREHASHED32)
+        r, s = decode_dss_signature(der)
+        if s > _SECP_N // 2:
+            s = _SECP_N - s
+        # recovery id: the v whose recovered key is ours
+        v = None
+        for cand in (0, 1):
+            if ecrecover(digest, r, s, cand) == self._pub_bytes:
+                v = cand
+                break
+        if v is None:  # unreachable for a signature we just made
+            raise ValueError("could not derive recovery id")
+        sig = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([27 + v])
+        return "0x" + sig.hex()
+
+
+class EvmRecoveryWallet(EvmWallet):
+    """An :class:`EvmWallet` whose DEFAULT wire is the reference's
+    recovery format (``0x`` + r||s||v over the EIP-191 digest) — i.e.
+    exactly what an alloy or MetaMask client sends
+    (request_signer.rs:55-63). Dropping this into the signer/middleware
+    suites proves the whole control plane authenticates reference-format
+    clients verbatim."""
+
+    def sign_message(self, message: bytes | str) -> str:
+        return self.sign_message_eth(message)
+
 
 def verify_signature(message: bytes | str, signature: str, expected_address: str) -> bool:
     """Checks the signature verifies AND its embedded pubkey hashes to the
@@ -214,6 +365,39 @@ def verify_signature(message: bytes | str, signature: str, expected_address: str
     the pubkey length: 32 bytes = Ed25519, 65 bytes = secp256k1/keccak."""
     if isinstance(message, str):
         message = message.encode()
+    if ":" not in signature:
+        # the reference's recovery wire: 0x + 65-byte r||s||v over the
+        # EIP-191 digest (auth_signature_middleware.rs:386 recovers the
+        # address instead of carrying a pubkey) — signatures from real
+        # Ethereum wallets verify here verbatim. STRICT canonical form
+        # only (mandatory 0x, lowercase hex, v in {27,28}): every
+        # accepted signature must have exactly one wire encoding, or a
+        # re-encoded capture (uppercased hex, v rewritten 27->0) would
+        # slip past the middleware's signature-string replay cache
+        if not signature.startswith("0x") or signature != signature.lower():
+            return False
+        try:
+            raw = bytes.fromhex(signature[2:])
+        except ValueError:
+            return False
+        if len(raw) != 65 or len(message) > EVM_MAX_MESSAGE_BYTES:
+            return False
+        if raw[64] not in (27, 28):
+            return False
+        s_int = int.from_bytes(raw[32:64], "big")
+        # low-s only (EIP-2): the high-s twin is an equally-valid ECDSA
+        # signature with a DIFFERENT wire encoding, which would defeat
+        # signature-keyed replay caches; alloy emits low-s, nothing legit
+        # is lost
+        if s_int > _SECP_N // 2:
+            return False
+        pub = ecrecover(
+            eip191_digest(message),
+            int.from_bytes(raw[:32], "big"),
+            s_int,
+            raw[64],
+        )
+        return pub is not None and _evm_address(pub) == expected_address.lower()
     try:
         pub_hex, sig_hex = signature.split(":", 1)
         pub_bytes = bytes.fromhex(pub_hex)

@@ -30,12 +30,25 @@ VERSION = os.environ.get("PROTOCOL_TPU_VERSION", "dev")
 
 
 def _wallet_from_env(var: str):
-    from protocol_tpu.security import Wallet
+    """Pod identity from ``var``; PROTOCOL_TPU_WALLET_SCHEME selects the
+    signature scheme (``ed25519`` default, ``evm`` = secp256k1/keccak
+    with embedded-pubkey wire, ``evm-recovery`` = the reference's literal
+    r||s||v EIP-191 wire) — all three verify through the same seam, so
+    pods of different schemes interoperate."""
+    from protocol_tpu.security import EvmRecoveryWallet, EvmWallet, Wallet
 
     key = os.environ.get(var, "")
     if not key:
         raise SystemExit(f"{var} env var required")
-    return Wallet.from_hex(key)
+    scheme = os.environ.get("PROTOCOL_TPU_WALLET_SCHEME", "ed25519")
+    cls = {
+        "ed25519": Wallet,
+        "evm": EvmWallet,
+        "evm-recovery": EvmRecoveryWallet,
+    }.get(scheme)
+    if cls is None:
+        raise SystemExit(f"unknown PROTOCOL_TPU_WALLET_SCHEME {scheme!r}")
+    return cls.from_hex(key)
 
 
 def _ledger(args):

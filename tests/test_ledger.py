@@ -8,10 +8,13 @@ import pytest
 
 from protocol_tpu.chain import Ledger, LedgerError, PoolStatus
 from protocol_tpu.chain.ledger import invite_digest
-from protocol_tpu.security import EvmWallet, Wallet
+from protocol_tpu.security import EvmRecoveryWallet, EvmWallet, Wallet
 
 
-@pytest.fixture(params=[Wallet, EvmWallet], ids=["ed25519", "evm"])
+@pytest.fixture(
+    params=[Wallet, EvmWallet, EvmRecoveryWallet],
+    ids=["ed25519", "evm", "evm-recovery"],
+)
 def world(request):
     ledger = Ledger(min_stake_per_compute_unit=10)
     provider = request.param.from_seed(b"provider")
