@@ -408,8 +408,23 @@ def main() -> None:
     )
 
     P_S = T_S = T_AUCTION
-    log(f"stage S: sinkhorn potentials + rounding P=T={P_S} (matrix-free)")
-    eps_sink, sink_iters = 0.05, 20
+    # Each Sinkhorn iteration streams 2 full [P, T] logsumexp passes:
+    # 20 iterations at 65k on the 1-core CPU host is ~8 h — the reason
+    # the r4 artifact died before emitting a stage-S row. The iteration
+    # budget is therefore platform-aware (overridable via
+    # PROTOCOL_TPU_SINKHORN_ITERS) and recorded in the row's shape
+    # string; quality at few iterations is measured separately against
+    # the auction referee (scripts/stage_s_100k.py: mean cost within
+    # 0.02% at iters=5).
+    default_iters = 20 if platform != "cpu" else 4
+    sink_iters = int(
+        os.environ.get("PROTOCOL_TPU_SINKHORN_ITERS", default_iters)
+    )
+    log(
+        f"stage S: sinkhorn potentials + rounding P=T={P_S} "
+        f"(matrix-free, iters={sink_iters})"
+    )
+    eps_sink = 0.05
     secs_pot, _ = measure(
         lambda z: sinkhorn_potentials_blocked(
             bench.salt_providers(jax.tree.map(jnp.asarray, epb), z),
