@@ -402,10 +402,7 @@ def main() -> None:
     # BASELINE config #3 (100k x 100k soft assignment, 1 chip): matrix-
     # free log-domain potentials (ops/blocked.py — O(P*tile) peak, never
     # [P, T]) + plan-guided candidate rounding.
-    from protocol_tpu.ops.blocked import (
-        assign_sinkhorn_blocked,
-        sinkhorn_potentials_blocked,
-    )
+    from protocol_tpu.ops.blocked import sinkhorn_potentials_blocked
 
     P_S = T_S = T_AUCTION
     # Each Sinkhorn iteration streams 2 full [P, T] logsumexp passes:
@@ -425,20 +422,25 @@ def main() -> None:
         f"(matrix-free, iters={sink_iters})"
     )
     eps_sink = 0.05
-    secs_pot, _ = measure(
-        lambda z: sinkhorn_potentials_blocked(
-            bench.salt_providers(jax.tree.map(jnp.asarray, epb), z),
-            erb, weights, eps=eps_sink, num_iters=sink_iters, tile=TILE,
-        )[0],
-        iters=1,
-    )
+    # potentials are computed ONCE and fed into the plan-guided rounding
+    # (assign_sinkhorn_blocked would recompute them, doubling the
+    # dominant O(P*T*iters) stage — the r4/early-r5 artifact deaths)
     t0 = time.perf_counter()
-    res_s = assign_sinkhorn_blocked(
-        epb, erb, weights, eps=eps_sink, num_iters=sink_iters,
-        tile=TILE, k=32,
+    u_s, _v_s = sinkhorn_potentials_blocked(
+        epb, erb, weights, eps=eps_sink, num_iters=sink_iters, tile=TILE
+    )
+    jax.block_until_ready(u_s)
+    secs_pot = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    offset_s = -eps_sink * jnp.where(u_s > -5e17, u_s, 0.0)
+    cand_sp, cand_sc2 = candidates_topk(
+        epb, erb, weights, k=32, tile=TILE, provider_offset=offset_s
+    )
+    res_s = assign_auction_sparse_scaled(
+        cand_sp, cand_sc2, num_providers=P_S, eps_start=1.0, eps_end=0.02
     )
     sink_assigned = int((np.asarray(res_s.provider_for_task) >= 0).sum())
-    secs_s_full = time.perf_counter() - t0
+    secs_s_full = secs_pot + (time.perf_counter() - t0)
     rows.append(
         {
             "stage": "S sinkhorn-OT potentials + rounding (measured)",
