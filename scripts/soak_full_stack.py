@@ -162,20 +162,29 @@ class Stack:
         self.creds = creds
         self.loki_url = loki_url
         self.kubo_url = kubo_url
-        from protocol_tpu.security import Wallet
+        from protocol_tpu.security import (
+            EvmRecoveryWallet,
+            EvmWallet,
+            Wallet,
+        )
 
+        wcls = {
+            "ed25519": Wallet,
+            "evm": EvmWallet,
+            "evm-recovery": EvmRecoveryWallet,
+        }[args.wallet_scheme]
         self.wallets = {
-            n: Wallet.from_seed(f"soak-{n}".encode())
+            n: wcls.from_seed(f"soak-{n}".encode())
             for n in ("manager", "creator", "validator")
         }
         # one provider per worker: each registration stakes for one node,
         # and a shared provider runs out of staked balance at N nodes
         self.node_keys = [
-            Wallet.from_seed(f"soak-node-{i}".encode())
+            wcls.from_seed(f"soak-node-{i}".encode())
             for i in range(args.workers + 4)  # spares for churn-ins
         ]
         self.provider_keys = [
-            Wallet.from_seed(f"soak-provider-{i}".encode())
+            wcls.from_seed(f"soak-provider-{i}".encode())
             for i in range(args.workers + 4)
         ]
         self.ports = {
@@ -189,6 +198,9 @@ class Stack:
             PROTOCOL_TPU_FORCE_PLATFORM="cpu",
             LEDGER_API_KEY="admin",
             KV_API_KEY="admin",
+            # pods derive their identity from hex keys under the SAME
+            # scheme the script-side wallets use, or addresses mismatch
+            PROTOCOL_TPU_WALLET_SCHEME=args.wallet_scheme,
         )
 
     def url(self, name):
@@ -376,6 +388,12 @@ def main() -> int:
     ap.add_argument("--duration", type=float, default=600.0)
     ap.add_argument("--workers", type=int, default=6)
     ap.add_argument("--artifact", default="artifacts/soak_run.json")
+    ap.add_argument(
+        "--wallet-scheme", default="ed25519",
+        choices=["ed25519", "evm", "evm-recovery"],
+        help="signature scheme for EVERY identity in the stack "
+             "(evm-recovery = the reference's literal r||s||v wire)",
+    )
     args = ap.parse_args()
 
     loki_srv, loki_url, loki_pushes = start_fake_loki()
@@ -554,6 +572,7 @@ def main() -> int:
             "ok": ok,
             "duration_s": round(time.time() - t0, 1),
             "workers": args.workers,
+            "wallet_scheme": args.wallet_scheme,
             "problems": problems,
             "events": events,
             "warm_solves": sum(1 for s in samples if s.get("warm")),
