@@ -12,9 +12,10 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
 
-# native CPU assignment engine (ctypes-loaded shared library)
+# native CPU assignment engine (ctypes-loaded shared library; -pthread
+# for the multi-threaded engine=native-mt variants)
 native:
-	g++ -O3 -march=native -shared -fPIC -o native/libassign_engine.so native/assign_engine.cpp
+	g++ -O3 -march=native -std=gnu++17 -pthread -shared -fPIC -o native/libassign_engine.so native/assign_engine.cpp
 
 # one-command local cluster: ledger API + discovery + orchestrator +
 # validator + workers. See python -m protocol_tpu.devnet --help.

@@ -36,6 +36,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--full", action="store_true")
     parser.add_argument("--cpu", action="store_true", help="pin the CPU backend")
+    parser.add_argument(
+        "--artifact",
+        default="artifacts/bench_scaling_rows.jsonl",
+        help="JSONL file each stage row is APPENDED to as it completes — "
+        "a timeout/kill preserves every finished stage's evidence "
+        "(VERDICT r5 'what's weak' #4). Empty string disables.",
+    )
     args = parser.parse_args()
 
     import os
@@ -82,6 +89,13 @@ def main() -> None:
 
     rows: list[dict] = []
 
+    from protocol_tpu.utils.artifacts import append_jsonl
+
+    def emit(row: dict) -> None:
+        # kill-proof evidence: every completed stage lands on disk NOW
+        rows.append(row)
+        append_jsonl(args.artifact, row)
+
     # Identity-bust helper: the axon remote-TPU client memoizes executions
     # on (executable, input buffer ids) AND content-dedups uploads, so
     # repeat calls on the same (or re-uploaded identical) inputs replay
@@ -126,7 +140,7 @@ def main() -> None:
         )
     )
     cells = P_MEAS * T_MEAS
-    rows.append(
+    emit(
         {
             "stage": "A candidates_topk (measured)",
             "platform": platform,
@@ -139,7 +153,7 @@ def main() -> None:
 
     # full ladder-#4 stage-A cost model: (P_shard x T) cells per chip
     ladder_cells = LADDER_P_SHARD * LADDER_T
-    rows.append(
+    emit(
         {
             "stage": "A candidates_topk (extrapolated per chip)",
             "platform": f"{platform} rate -> v5e-8 shard",
@@ -149,6 +163,32 @@ def main() -> None:
             "open factor (measure on-chip when healthy)",
         }
     )
+
+    # ---- stage-boundary overlap: stage B's BIDIRECTIONAL candidate
+    # generation (the wire-path default's dominant cost-build) starts on a
+    # worker thread NOW, while stage A's compile-time envelope analysis
+    # runs — the generation wall is still timed inside the thread and
+    # reported in its own row, but the artifact run's total wall-clock
+    # (the thing timeouts kill) no longer pays the two stages in sequence.
+    from concurrent.futures import ThreadPoolExecutor
+
+    from protocol_tpu.ops.sparse import candidates_topk_bidir
+
+    P_B = T_AUCTION
+    epb, erb = bench.synth_providers(rng, P_B), bench.synth_requirements(
+        rng, T_AUCTION
+    )
+
+    def _gen_bidir():
+        t0 = time.perf_counter()
+        cpb, ccb = candidates_topk_bidir(
+            epb, erb, weights, k=K, tile=TILE, reverse_r=8, extra=16
+        )
+        jax.block_until_ready((cpb, ccb))
+        return cpb, ccb, time.perf_counter() - t0
+
+    overlap_pool = ThreadPoolExecutor(max_workers=1)
+    bidir_future = overlap_pool.submit(_gen_bidir)
 
     # compile-time HBM envelope at FULL shard shape (no execution)
     log("stage A: HBM envelope via XLA buffer assignment at full shard shape")
@@ -170,7 +210,7 @@ def main() -> None:
         ).lower(ep_s, _struct_like(er_np, TILE * 2))
         ma = lowered.compile().memory_analysis()
         hbm_gb = (ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 1e9
-        rows.append(
+        emit(
             {
                 "stage": "A candidates_topk (HBM envelope, compile-time)",
                 "platform": f"{platform} buffer assignment",
@@ -184,49 +224,55 @@ def main() -> None:
         log(f"  envelope analysis failed: {e}")
 
     # ---------------- stage B: sparse frontier auction ----------------
-    log(f"stage B: sparse auction T={T_AUCTION} K={K} single-device")
-    P_B = T_AUCTION
-    epb, erb = bench.synth_providers(rng, P_B), bench.synth_requirements(
-        rng, T_AUCTION
+    # The BIDIRECTIONAL-candidate row comes first: it is the wire-path
+    # default (every production matcher path generates bidir candidates),
+    # so a run killed mid-stage-B leaves the row that matters on disk
+    # (VERDICT r5 "what's weak" #4's ordering half).
+    cpb, ccb, gen_bidir = bidir_future.result()
+    overlap_pool.shutdown(wait=False)
+    cov_bd = int(np.unique(np.asarray(cpb)[np.asarray(cpb) >= 0]).size)
+    log(
+        f"stage B: sparse auction T={T_AUCTION} K={K} single-device "
+        f"(bidir wire-path default; gen overlapped stage A: {gen_bidir:.2f}s)"
     )
-    cp, cc = candidates_topk(epb, erb, weights, k=K, tile=TILE)
-    jax.block_until_ready((cp, cc))
     secs_b, res = measure(
         lambda z: assign_auction_sparse(
-            cp, cc + z * 0, num_providers=P_B, eps=0.05, max_iters=2000,
+            cpb, ccb + z * 0, num_providers=P_B, eps=0.05, max_iters=2000,
             frontier=min(T_AUCTION, 8192), retire=True,
         ).provider_for_task
     )
     assigned = int((np.asarray(res) >= 0).sum())
-    rows.append(
+    emit(
         {
-            "stage": "B sparse auction (measured, 1 device)",
+            "stage": "B sparse auction (measured, 1 device, bidir wire-path default)",
             "platform": platform,
-            "shape": f"T={T_AUCTION} K={K}",
+            "shape": f"T={T_AUCTION} K={K} reverse_r=8 extra=16",
             "wall_s": round(secs_b, 3),
             "assignments_per_s": round(assigned / secs_b, 0),
             "assigned": assigned,
+            "bidir_gen_s": round(gen_bidir, 2),
+            "coverage": cov_bd,
         }
     )
     log(f"  {secs_b:.3f}s, {assigned}/{T_AUCTION} assigned "
         f"({assigned / secs_b:,.0f} assignments/s)")
 
-    # stage B sharded over the mesh
+    # stage B sharded over the mesh (same wire-path candidates)
     log(f"stage B: mesh-sharded auction over {n_dev} devices")
     mesh = make_mesh(n_dev)
     secs_s, res_s = measure(
         lambda z: assign_auction_sparse_sharded(
-            cp, cc + z * 0, num_providers=P_B, mesh=mesh,
+            cpb, ccb + z * 0, num_providers=P_B, mesh=mesh,
             eps=0.05, max_iters=2000, frontier=min(T_AUCTION, 8192),
             retire=True,
         ).provider_for_task
     )
     assigned_s = int((np.asarray(res_s) >= 0).sum())
-    rows.append(
+    emit(
         {
-            "stage": f"B sparse auction (measured, {n_dev}-device mesh)",
+            "stage": f"B sparse auction (measured, {n_dev}-device mesh, bidir)",
             "platform": platform,
-            "shape": f"T={T_AUCTION} K={K}",
+            "shape": f"T={T_AUCTION} K={K} reverse_r=8 extra=16",
             "wall_s": round(secs_s, 3),
             "assignments_per_s": round(assigned_s / secs_s, 0),
         }
@@ -245,7 +291,7 @@ def main() -> None:
         ).lower(cp_s, cc_s)
         ma = lowered.compile().memory_analysis()
         hbm_gb = (ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 1e9
-        rows.append(
+        emit(
             {
                 "stage": "B sparse auction (HBM envelope, compile-time)",
                 "platform": f"{platform} buffer assignment",
@@ -266,27 +312,19 @@ def main() -> None:
     # long the auction runs). Bidirectional candidates (per-provider
     # reverse edges, ops/sparse.candidates_topk_bidir) restore coverage
     # and the eps-scaled solve completes: 99.98% measured at 65k.
-    from protocol_tpu.ops.sparse import (
-        assign_auction_sparse_scaled,
-        candidates_topk_bidir,
-    )
+    from protocol_tpu.ops.sparse import assign_auction_sparse_scaled
 
     log(f"stage B2: completeness, forward vs bidir candidates T={T_AUCTION}")
+    cp, cc = candidates_topk(epb, erb, weights, k=K, tile=TILE)
+    jax.block_until_ready((cp, cc))
     cov_fwd = int(np.unique(np.asarray(cp)[np.asarray(cp) >= 0]).size)
     res_fwd = assign_auction_sparse_scaled(cp, cc, num_providers=P_B)
     a_fwd = int((np.asarray(res_fwd.provider_for_task) >= 0).sum())
     t0 = time.perf_counter()
-    cpb, ccb = candidates_topk_bidir(
-        epb, erb, weights, k=K, tile=TILE, reverse_r=8, extra=16
-    )
-    jax.block_until_ready((cpb, ccb))
-    gen_bidir = time.perf_counter() - t0
-    cov_bd = int(np.unique(np.asarray(cpb)[np.asarray(cpb) >= 0]).size)
-    t0 = time.perf_counter()
     res_bd = assign_auction_sparse_scaled(cpb, ccb, num_providers=P_B)
     solve_bidir = time.perf_counter() - t0
     a_bd = int((np.asarray(res_bd.provider_for_task) >= 0).sum())
-    rows.append(
+    emit(
         {
             "stage": "B2 completeness: forward vs bidir candidates",
             "platform": platform,
@@ -340,7 +378,7 @@ def main() -> None:
             frontier=min(T_AUCTION, 8192),
         )[0].provider_for_task
     )
-    rows.append(
+    emit(
         {
             "stage": "C warm vs cold solve (measured)",
             "platform": platform,
@@ -386,7 +424,7 @@ def main() -> None:
         ).provider_for_task
     )
     packed = int((np.asarray(res_d) >= 0).sum())
-    rows.append(
+    emit(
         {
             "stage": "D vector bin-pack + anti-affinity (measured)",
             "platform": platform,
@@ -441,7 +479,7 @@ def main() -> None:
     )
     sink_assigned = int((np.asarray(res_s.provider_for_task) >= 0).sum())
     secs_s_full = secs_pot + (time.perf_counter() - t0)
-    rows.append(
+    emit(
         {
             "stage": "S sinkhorn-OT potentials + rounding (measured)",
             "platform": platform,
@@ -473,7 +511,7 @@ def main() -> None:
         ).lower(_sds(epb, 100_000), _sds(erb, 100_000 // TILE * TILE))
         ma = lowered.compile().memory_analysis()
         hbm_gb = (ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 1e9
-        rows.append(
+        emit(
             {
                 "stage": "S sinkhorn potentials (HBM envelope, compile-time)",
                 "platform": f"{platform} buffer assignment",

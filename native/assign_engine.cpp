@@ -22,9 +22,13 @@
 #include <algorithm>
 #include <cfloat>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
+#include <mutex>
 #include <queue>
+#include <thread>
 #include <vector>
 
 #if defined(__AVX512F__)
@@ -41,6 +45,103 @@ inline float jitter(uint32_t p, uint32_t t) {
   uint32_t h = (p * 2654435761u) ^ (t * 40503u);
   return static_cast<float>(h & 1023u) * 1e-7f;
 }
+
+// ---- threading primitives for the -mt engine variants ----------------------
+//
+// The engine stays DETERMINISTIC under any thread count: parallel regions
+// only ever compute thread-private results from a shared read-only
+// snapshot, and every cross-thread combination step is a value-based
+// reduction (set selection / max-with-tie-rule) whose result is
+// independent of chunk boundaries. threads=1 runs the identical code path.
+
+inline int resolve_threads(int32_t threads, int64_t work_items) {
+  int n = threads;
+  if (n <= 0) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n = hw ? static_cast<int>(hw) : 1;
+  }
+  if (work_items < n) n = work_items > 0 ? static_cast<int>(work_items) : 1;
+  return n;
+}
+
+// Fork-join: fn(tid) on `threads` threads; the caller runs tid 0.
+inline void run_threads(int threads, const std::function<void(int)>& fn) {
+  if (threads <= 1) {
+    fn(0);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads - 1);
+  for (int i = 1; i < threads; ++i) pool.emplace_back(fn, i);
+  fn(0);
+  for (auto& t : pool) t.join();
+}
+
+// On-demand helper pool for round-synchronous loops (the -mt auction):
+// workers are spawned once per solve and engaged ONLY when a round is
+// large enough to amortize the wakeup (a condvar round-trip costs ~10 us;
+// late auction rounds have a handful of open tasks and run inline on the
+// caller, where the same code costs nanoseconds). Which thread computes a
+// bid never affects its value, so engagement thresholds cannot change
+// results.
+class HelperPool {
+ public:
+  explicit HelperPool(int helpers) {
+    threads_.reserve(helpers);
+    for (int i = 0; i < helpers; ++i) {
+      threads_.emplace_back([this, tid = i + 1] { worker(tid); });
+    }
+  }
+  ~HelperPool() {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      exit_ = true;
+    }
+    cv_work_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+  // fn(tid) runs on every thread (caller = tid 0); returns when all done.
+  void run(const std::function<void(int)>& fn) {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      job_ = &fn;
+      remaining_ = static_cast<int>(threads_.size());
+      ++gen_;
+    }
+    cv_work_.notify_all();
+    fn(0);
+    std::unique_lock<std::mutex> lk(m_);
+    cv_done_.wait(lk, [&] { return remaining_ == 0; });
+  }
+
+ private:
+  void worker(int tid) {
+    uint64_t seen = 0;
+    while (true) {
+      const std::function<void(int)>* job;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_work_.wait(lk, [&] { return exit_ || gen_ != seen; });
+        if (exit_) return;
+        seen = gen_;
+        job = job_;
+      }
+      (*job)(tid);
+      {
+        std::lock_guard<std::mutex> lk(m_);
+        if (--remaining_ == 0) cv_done_.notify_one();
+      }
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_work_, cv_done_;
+  std::vector<std::thread> threads_;
+  const std::function<void(int)>* job_ = nullptr;
+  uint64_t gen_ = 0;
+  int remaining_ = 0;
+  bool exit_ = false;
+};
 
 }  // namespace
 
@@ -275,53 +376,53 @@ struct RequirementFeatures {
   const uint8_t* valid;
 };
 
-void fused_topk_candidates(const ProviderFeatures* pf,
-                           const RequirementFeatures* rf, int32_t P, int32_t T,
-                           int32_t K, int32_t W, int32_t k, float w_price,
-                           float w_load, float w_proximity, float w_priority,
-                           int32_t* out_cand_provider, float* out_cand_cost,
-                           int32_t reverse_r, int32_t extra) {
-  // Bidirectional candidates (the degraded-mode twin of the JAX path's
-  // ops/sparse.candidates_topk_bidir): on price-dominated fleets every
-  // task's forward top-k holds the same cheap providers, capping the
-  // matching at the covered fraction (measured 79% at 32k). With
-  // reverse_r/extra > 0 the pass ALSO tracks EVERY provider's best-r
-  // tasks (one compare per cell against a cached worst key) and scatters
-  // them into ``extra`` appended candidate columns (cheapest-first per
-  // task, forward dups dropped) — repairing only fully-uncovered
-  // providers was measured insufficient (91.8% vs 100% assigned at 32k).
-  // Output stride becomes k + extra.
-  if (k > P) k = P;
-  if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
-  if (reverse_r < 0) reverse_r = 0;
-  if (extra < 0) extra = 0;
-  const bool do_rev = reverse_r > 0 && extra > 0;
-  const int32_t k_out = k + extra;
-  std::vector<uint64_t> rev;      // [P * r] packed (jittered cost, task)
-  std::vector<float> rev_worst;   // cached per-provider worst (root) cost
-  if (do_rev) {
-    rev.assign(static_cast<size_t>(P) * reverse_r,
-               pack_key(kInfeasible, 0xffffffffu));
-    rev_worst.assign(P, kInfeasible);
+namespace {
+
+// Per-solve provider precomputes shared by every task chunk: base cost
+// term + trig for the cos-product haversine form (sin^2(d/2) =
+// (1-cos d)/2 expands into products of per-side sin/cos — no per-cell
+// trig).
+struct ProviderPrecomp {
+  std::vector<float> base, slat, clat, slon, clon;
+  explicit ProviderPrecomp(const ProviderFeatures* pf, int32_t P,
+                           float w_price, float w_load)
+      : base(P), slat(P), clat(P), slon(P), clon(P) {
+    for (int32_t p = 0; p < P; ++p) {
+      base[p] = w_price * pf->price[p] + w_load * pf->load[p];
+      slat[p] = std::sin(pf->lat[p]);
+      clat[p] = std::cos(pf->lat[p]);
+      slon[p] = std::sin(pf->lon[p]);
+      clon[p] = std::cos(pf->lon[p]);
+    }
   }
-  // Per-solve provider precomputes: base cost term + trig for the
-  // cos-product haversine form (sin^2(d/2) = (1-cos d)/2 expands into
-  // products of per-side sin/cos — no per-cell trig).
-  std::vector<float> base(P), slat(P), clat(P), slon(P), clon(P);
+};
+
+// The fused per-task pass over [t_begin, t_end): feature->cost into an
+// L2-resident scratch row, vectorized top-k select, optional reverse
+// (provider->task) tracking into caller-provided buffers. Tasks are
+// independent, so chunking the range across threads reproduces the
+// single-range outputs bit-for-bit; the reverse buffers hold each
+// provider's best-r keys over the CHUNK — a set selection that a later
+// merge combines into the global best-r (also order-independent).
+void fused_process_tasks(const ProviderFeatures* pf,
+                         const RequirementFeatures* rf, int32_t P,
+                         int32_t t_begin, int32_t t_end, int32_t K, int32_t W,
+                         int32_t k, int32_t k_out, float w_proximity,
+                         float w_priority, const ProviderPrecomp& pre,
+                         int32_t reverse_r, uint64_t* rev, float* rev_worst,
+                         int32_t* out_cand_provider, float* out_cand_cost) {
+  const bool do_rev = rev != nullptr && reverse_r > 0;
+  const float* base = pre.base.data();
+  const float* slat = pre.slat.data();
+  const float* clat = pre.clat.data();
+  const float* slon = pre.slon.data();
+  const float* clon = pre.clon.data();
   std::vector<uint8_t> ok0(P);   // scalar (cpu/ram/storage/valid) gates
   std::vector<uint8_t> gany(P);  // any GPU option satisfied
   std::vector<float> scratch(P);
-  for (int32_t p = 0; p < P; ++p) {
-    base[p] = w_price * pf->price[p] + w_load * pf->load[p];
-    slat[p] = std::sin(pf->lat[p]);
-    clat[p] = std::cos(pf->lat[p]);
-    slon[p] = std::sin(pf->lon[p]);
-    clon[p] = std::cos(pf->lon[p]);
-  }
-
   std::vector<uint64_t> topbuf(k);  // sorted packed (cost, provider) keys
 
-  for (int32_t t = 0; t < T; ++t) {
+  for (int32_t t = t_begin; t < t_end; ++t) {
     const uint8_t t_valid = rf->valid[t];
     const uint8_t t_cpu_req = rf->cpu_required[t];
     const int32_t t_cores = rf->cpu_cores[t];
@@ -436,17 +537,17 @@ void fused_topk_candidates(const ProviderFeatures* pf,
           ok &= has_gpu & gany_m;
         }
         // ---- cost terms
-        __m512 c = _mm512_sub_ps(_mm512_loadu_ps(base.data() + p0),
+        __m512 c = _mm512_sub_ps(_mm512_loadu_ps(base + p0),
                                  _mm512_set1_ps(prio));
         if (t_has_loc) {
-          const __m512 pclat = _mm512_loadu_ps(clat.data() + p0);
+          const __m512 pclat = _mm512_loadu_ps(clat + p0);
           const __m512 cos_dlat = _mm512_fmadd_ps(
               pclat, _mm512_set1_ps(t_clat),
-              _mm512_mul_ps(_mm512_loadu_ps(slat.data() + p0),
+              _mm512_mul_ps(_mm512_loadu_ps(slat + p0),
                             _mm512_set1_ps(t_slat)));
           const __m512 cos_dlon = _mm512_fmadd_ps(
-              _mm512_loadu_ps(clon.data() + p0), _mm512_set1_ps(t_clon),
-              _mm512_mul_ps(_mm512_loadu_ps(slon.data() + p0),
+              _mm512_loadu_ps(clon + p0), _mm512_set1_ps(t_clon),
+              _mm512_mul_ps(_mm512_loadu_ps(slon + p0),
                             _mm512_set1_ps(t_slon)));
           const __m512 one = _mm512_set1_ps(1.0f);
           const __m512 half = _mm512_set1_ps(0.5f);
@@ -551,7 +652,7 @@ void fused_topk_candidates(const ProviderFeatures* pf,
         const float c = scratch[p];
         if (c >= rev_worst[p] || c >= kInfeasible * 0.5f) continue;
         const float cj = c + jitter(p, t);
-        uint64_t* rb = rev.data() + static_cast<size_t>(p) * reverse_r;
+        uint64_t* rb = rev + static_cast<size_t>(p) * reverse_r;
         const uint64_t key = pack_key(cj, static_cast<uint32_t>(t));
         if (key < rb[reverse_r - 1]) {
           sorted_insert(rb, reverse_r, key);
@@ -610,47 +711,171 @@ void fused_topk_candidates(const ProviderFeatures* pf,
       out_cand_cost[out_base + j] = kInfeasible;
     }
   }
+}
 
-  if (do_rev) {
-    // scatter EVERY provider's reverse edges into the extra columns
-    // (same guarantee as the JAX bidirectional merge: r routes into the
-    // graph per provider — repairing only fully-uncovered providers
-    // leaves single-list providers stranded, measured 91.8% vs ~100% at
-    // 32k). Sort by (task, cost) so each task keeps its cheapest
-    // ``extra``; edges duplicating a forward candidate are dropped (a
-    // dup makes v1 == v2 in the bid math — measured slower AND worse).
-    struct Edge {
-      int32_t t;
-      float c;
-      int32_t p;
-    };
-    std::vector<Edge> edges;
-    edges.reserve(static_cast<size_t>(P) * reverse_r);
-    for (int32_t p = 0; p < P; ++p) {
-      const uint64_t* rb = rev.data() + static_cast<size_t>(p) * reverse_r;
-      for (int32_t j = 0; j < reverse_r; ++j) {
-        const float c = unpack_key_cost(rb[j]);
-        if (c >= kInfeasible * 0.5f) break;  // sorted: rest infeasible
-        edges.push_back({static_cast<int32_t>(rb[j] & 0xffffffffu), c, p});
-      }
-    }
-    std::sort(edges.begin(), edges.end(), [](const Edge& a, const Edge& b) {
-      return a.t != b.t ? a.t < b.t : a.c < b.c;
-    });
-    std::vector<int32_t> fill(T, 0);
-    for (const Edge& e : edges) {
-      if (fill[e.t] >= extra) continue;
-      const int64_t row = static_cast<int64_t>(e.t) * k_out;
-      bool dup = false;
-      for (int32_t j = 0; j < k && !dup; ++j) {
-        dup = out_cand_provider[row + j] == e.p;
-      }
-      if (dup) continue;
-      const int32_t at = fill[e.t]++;
-      out_cand_provider[row + k + at] = e.p;
-      out_cand_cost[row + k + at] = e.c;
+// Scatter EVERY provider's reverse edges into the extra columns (same
+// guarantee as the JAX bidirectional merge: r routes into the graph per
+// provider — repairing only fully-uncovered providers leaves single-list
+// providers stranded, measured 91.8% vs ~100% at 32k). Sort by
+// (task, cost) so each task keeps its cheapest ``extra``; edges
+// duplicating a forward candidate are dropped (a dup makes v1 == v2 in
+// the bid math — measured slower AND worse).
+void scatter_reverse_edges(int32_t P, int32_t T, int32_t k, int32_t k_out,
+                           int32_t reverse_r, int32_t extra,
+                           const uint64_t* rev, int32_t* out_cand_provider,
+                           float* out_cand_cost) {
+  struct Edge {
+    int32_t t;
+    float c;
+    int32_t p;
+  };
+  std::vector<Edge> edges;
+  edges.reserve(static_cast<size_t>(P) * reverse_r);
+  for (int32_t p = 0; p < P; ++p) {
+    const uint64_t* rb = rev + static_cast<size_t>(p) * reverse_r;
+    for (int32_t j = 0; j < reverse_r; ++j) {
+      const float c = unpack_key_cost(rb[j]);
+      if (c >= kInfeasible * 0.5f) break;  // sorted: rest infeasible
+      edges.push_back({static_cast<int32_t>(rb[j] & 0xffffffffu), c, p});
     }
   }
+  std::sort(edges.begin(), edges.end(), [](const Edge& a, const Edge& b) {
+    return a.t != b.t ? a.t < b.t : a.c < b.c;
+  });
+  std::vector<int32_t> fill(T, 0);
+  for (const Edge& e : edges) {
+    if (fill[e.t] >= extra) continue;
+    const int64_t row = static_cast<int64_t>(e.t) * k_out;
+    bool dup = false;
+    for (int32_t j = 0; j < k && !dup; ++j) {
+      dup = out_cand_provider[row + j] == e.p;
+    }
+    if (dup) continue;
+    const int32_t at = fill[e.t]++;
+    out_cand_provider[row + k + at] = e.p;
+    out_cand_cost[row + k + at] = e.c;
+  }
+}
+
+// Shared driver: single-range when threads == 1 (bit-compatible with the
+// historical single-threaded pass), contiguous task chunks + reverse-edge
+// merge when threads > 1. The merged reverse structure equals the
+// single-range one exactly: each chunk keeps its r smallest (cost, task)
+// keys per provider, and the union's r smallest is the global best-r no
+// matter how tasks were chunked.
+void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
+                     int32_t P, int32_t T, int32_t K, int32_t W, int32_t k,
+                     float w_price, float w_load, float w_proximity,
+                     float w_priority, int32_t* out_cand_provider,
+                     float* out_cand_cost, int32_t reverse_r, int32_t extra,
+                     int32_t threads) {
+  // Bidirectional candidates (the degraded-mode twin of the JAX path's
+  // ops/sparse.candidates_topk_bidir): on price-dominated fleets every
+  // task's forward top-k holds the same cheap providers, capping the
+  // matching at the covered fraction (measured 79% at 32k). With
+  // reverse_r/extra > 0 the pass ALSO tracks EVERY provider's best-r
+  // tasks (one compare per cell against a cached worst key) and scatters
+  // them into ``extra`` appended candidate columns (cheapest-first per
+  // task, forward dups dropped). Output stride becomes k + extra.
+  if (k > P) k = P;
+  if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
+  if (reverse_r < 0) reverse_r = 0;
+  if (extra < 0) extra = 0;
+  const bool do_rev = reverse_r > 0 && extra > 0;
+  const int32_t k_out = k + extra;
+  const int nt = resolve_threads(threads, T);
+  const ProviderPrecomp pre(pf, P, w_price, w_load);
+  const uint64_t pad_key = pack_key(kInfeasible, 0xffffffffu);
+
+  if (nt <= 1) {
+    std::vector<uint64_t> rev;
+    std::vector<float> rev_worst;
+    if (do_rev) {
+      rev.assign(static_cast<size_t>(P) * reverse_r, pad_key);
+      rev_worst.assign(P, kInfeasible);
+    }
+    fused_process_tasks(pf, rf, P, 0, T, K, W, k, k_out, w_proximity,
+                        w_priority, pre, do_rev ? reverse_r : 0,
+                        do_rev ? rev.data() : nullptr,
+                        do_rev ? rev_worst.data() : nullptr,
+                        out_cand_provider, out_cand_cost);
+    if (do_rev) {
+      scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, rev.data(),
+                            out_cand_provider, out_cand_cost);
+    }
+    return;
+  }
+
+  // per-thread reverse buffers; forward outputs are disjoint by task row
+  std::vector<uint64_t> rev_all;
+  std::vector<float> rev_worst_all;
+  if (do_rev) {
+    rev_all.assign(static_cast<size_t>(nt) * P * reverse_r, pad_key);
+    rev_worst_all.assign(static_cast<size_t>(nt) * P, kInfeasible);
+  }
+  const int32_t chunk = (T + nt - 1) / nt;
+  run_threads(nt, [&](int tid) {
+    const int32_t t0 = std::min<int32_t>(tid * chunk, T);
+    const int32_t t1 = std::min<int32_t>(t0 + chunk, T);
+    if (t0 >= t1) return;
+    uint64_t* rev = do_rev
+        ? rev_all.data() + static_cast<size_t>(tid) * P * reverse_r
+        : nullptr;
+    float* worst = do_rev
+        ? rev_worst_all.data() + static_cast<size_t>(tid) * P
+        : nullptr;
+    fused_process_tasks(pf, rf, P, t0, t1, K, W, k, k_out, w_proximity,
+                        w_priority, pre, do_rev ? reverse_r : 0, rev, worst,
+                        out_cand_provider, out_cand_cost);
+  });
+  if (do_rev) {
+    // deterministic reduction: per provider, the r smallest keys of the
+    // union of all chunks' best-r sets == the global best-r set
+    std::vector<uint64_t> merged(static_cast<size_t>(P) * reverse_r);
+    std::vector<uint64_t> tmp(static_cast<size_t>(nt) * reverse_r);
+    for (int32_t p = 0; p < P; ++p) {
+      for (int tid = 0; tid < nt; ++tid) {
+        std::memcpy(
+            tmp.data() + static_cast<size_t>(tid) * reverse_r,
+            rev_all.data() +
+                (static_cast<size_t>(tid) * P + p) * reverse_r,
+            static_cast<size_t>(reverse_r) * 8);
+      }
+      std::sort(tmp.begin(), tmp.end());
+      std::memcpy(merged.data() + static_cast<size_t>(p) * reverse_r,
+                  tmp.data(), static_cast<size_t>(reverse_r) * 8);
+    }
+    scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, merged.data(),
+                          out_cand_provider, out_cand_cost);
+  }
+}
+
+}  // namespace
+
+void fused_topk_candidates(const ProviderFeatures* pf,
+                           const RequirementFeatures* rf, int32_t P, int32_t T,
+                           int32_t K, int32_t W, int32_t k, float w_price,
+                           float w_load, float w_proximity, float w_priority,
+                           int32_t* out_cand_provider, float* out_cand_cost,
+                           int32_t reverse_r, int32_t extra) {
+  fused_topk_impl(pf, rf, P, T, K, W, k, w_price, w_load, w_proximity,
+                  w_priority, out_cand_provider, out_cand_cost, reverse_r,
+                  extra, /*threads=*/1);
+}
+
+// Multi-threaded fused pass (engine=native-mt): contiguous task chunks in
+// parallel + a deterministic reverse-edge merge. threads <= 0 means "all
+// hardware threads". Output is bit-identical for every thread count.
+void fused_topk_candidates_mt(const ProviderFeatures* pf,
+                              const RequirementFeatures* rf, int32_t P,
+                              int32_t T, int32_t K, int32_t W, int32_t k,
+                              float w_price, float w_load, float w_proximity,
+                              float w_priority, int32_t* out_cand_provider,
+                              float* out_cand_cost, int32_t reverse_r,
+                              int32_t extra, int32_t threads) {
+  fused_topk_impl(pf, rf, P, T, K, W, k, w_price, w_load, w_proximity,
+                  w_priority, out_cand_provider, out_cand_cost, reverse_r,
+                  extra, threads);
 }
 
 // Gauss-Seidel auction on candidate lists with eps-scaling.
@@ -787,6 +1012,253 @@ int32_t auction_sparse(const int32_t* cand_provider, const float* cand_cost,
     out_provider_for_task[t] = p4t[t];
     if (p4t[t] >= 0) ++assigned;
   }
+  return assigned;
+}
+
+// ---------------------------------------------------------------------------
+// Multi-threaded auction (engine=native-mt): synchronous Jacobi bidding
+// rounds with per-thread bid computation and a deterministic sequential
+// merge. Unlike the Gauss-Seidel engine above (whose result depends on its
+// serial processing order), every round here computes ALL open tasks' bids
+// against the same price snapshot, then applies one winner per provider
+// (highest increment, ties -> lowest task index) — so the matching is a
+// pure function of the inputs, bit-identical for every thread count
+// including threads=1. Carries the FULL dual state (prices + retirement
+// mask + previous matching) in/out, which is what the persistent warm
+// arena (protocol_tpu/native/arena.py) chains between solves.
+//
+// price_io:   [P] f32 in/out — pass zeros for a cold solve.
+// retired_io: [T] u8 in/out  — pass zeros for a cold solve; the caller
+//             must clear flags for tasks whose candidates changed.
+// p4t_seed:   [T] i32 or null — previous matching to re-seat (must be
+//             injective over >= 0); seeds violating eps-CS are evicted by
+//             the repair pass at each phase start.
+// Returns the number of assigned tasks.
+int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
+                          int32_t P, int32_t T, int32_t K, float eps_start,
+                          float eps_end, float scale, int64_t max_events,
+                          int32_t threads, float* price_io, uint8_t* retired_io,
+                          const int32_t* p4t_seed,
+                          int32_t* out_provider_for_task) {
+  std::vector<float> price(price_io, price_io + P);
+  std::vector<int32_t> owner(P, -1);
+  std::vector<int32_t> p4t(T, -1);
+  std::vector<uint8_t> retired(retired_io, retired_io + T);
+  if (p4t_seed != nullptr) {
+    // NOTE: a seed does NOT clear a carried retirement flag (unlike the
+    // JAX warm kernel's retired0 & (p4t0 < 0)): a priced-out task that
+    // the cleanup pass seated stays seated-and-inert until its
+    // candidates change, instead of being evicted by the eps-CS repair
+    // and re-fighting (then re-retiring, then re-seating — a persistent
+    // ~13%-of-seats flap measured on an UNCHANGED marketplace).
+    for (int32_t t = 0; t < T; ++t) {
+      const int32_t p = p4t_seed[t];
+      if (p >= 0 && p < P && owner[p] < 0) {
+        owner[p] = t;
+        p4t[t] = p;
+      }
+    }
+  }
+
+  float max_cost = 0.0f;
+  for (int64_t i = 0; i < static_cast<int64_t>(T) * K; ++i) {
+    if (cand_provider[i] >= 0 && cand_cost[i] > max_cost) {
+      max_cost = cand_cost[i];
+    }
+  }
+  const float give_up = -(2.0f * max_cost + 10.0f);
+
+  const int nt = resolve_threads(threads, T);
+  // a condvar wakeup costs ~10 us; below this many items the round runs
+  // inline on the caller (same code, same values — only WHO computes
+  // changes, so the threshold cannot affect the matching)
+  constexpr int32_t kParMin = 8192;
+  HelperPool* pool = nullptr;
+  if (nt > 1 && T >= kParMin) pool = new HelperPool(nt - 1);
+
+  std::vector<int32_t> open;
+  open.reserve(T);
+  std::vector<int32_t> bid_p(T);     // per-open-slot bid provider / sentinel
+  std::vector<float> bid_inc(T);     // per-open-slot price increment
+  std::vector<uint8_t> release(T);   // repair pass: evict flag per task
+  std::vector<float> win_inc(P, 0.0f);
+  std::vector<int32_t> win_task(P, -1);
+  std::vector<int32_t> touched;
+  touched.reserve(P);
+  std::vector<int32_t> next_open;
+  next_open.reserve(T);
+
+  // chunked parallel-for over [0, n): helpers engaged only when n is
+  // large enough to amortize their wakeup
+  const auto par_for = [&](int32_t n, const std::function<void(int32_t, int32_t)>& body) {
+    if (pool == nullptr || n < kParMin) {
+      body(0, n);
+      return;
+    }
+    const int32_t chunk = (n + nt - 1) / nt;
+    pool->run([&](int tid) {
+      const int32_t lo = std::min<int32_t>(tid * chunk, n);
+      const int32_t hi = std::min<int32_t>(lo + chunk, n);
+      if (lo < hi) body(lo, hi);
+    });
+  };
+
+  int64_t events = 0;
+  float eps = eps_start;
+  while (true) {
+    const bool final_phase = eps <= eps_end;
+    const int64_t phase_budget =
+        final_phase ? max_events : events + 4 * static_cast<int64_t>(T);
+
+    // eps-CS repair (parallel mark, sequential apply): holders whose seat
+    // violates the phase eps re-enter the auction — keeps happy holders
+    // seated, evicts stale warm seeds. No-op on a cold start.
+    par_for(T, [&](int32_t lo, int32_t hi) {
+      for (int32_t t = lo; t < hi; ++t) {
+        release[t] = 0;
+        const int32_t held = p4t[t];
+        if (held < 0 || retired[t]) continue;
+        float v1 = kNeg, vcur = kNeg;
+        const int64_t row = static_cast<int64_t>(t) * K;
+        for (int32_t j = 0; j < K; ++j) {
+          const int32_t p = cand_provider[row + j];
+          if (p < 0) continue;
+          const float v = -cand_cost[row + j] - price[p];
+          if (v > v1) v1 = v;
+          if (p == held) vcur = v;
+        }
+        release[t] = vcur < v1 - eps;
+      }
+    });
+    for (int32_t t = 0; t < T; ++t) {
+      if (release[t]) {
+        owner[p4t[t]] = -1;
+        p4t[t] = -1;
+      }
+    }
+    open.clear();
+    for (int32_t t = 0; t < T; ++t) {
+      if (p4t[t] < 0 && !retired[t]) open.push_back(t);
+    }
+
+    // synchronous bidding rounds: all open tasks bid against the same
+    // price snapshot; one winner per provider (highest increment, ties to
+    // the lowest task index) — a pure function of the round state.
+    while (!open.empty() && events < phase_budget && events < max_events) {
+      const int32_t n_open = static_cast<int32_t>(open.size());
+      par_for(n_open, [&](int32_t lo, int32_t hi) {
+        for (int32_t i = lo; i < hi; ++i) {
+          const int32_t t = open[i];
+          float v1 = kNeg, v2 = kNeg;
+          int32_t p1 = -1;
+          const int64_t row = static_cast<int64_t>(t) * K;
+          for (int32_t j = 0; j < K; ++j) {
+            const int32_t p = cand_provider[row + j];
+            if (p < 0) continue;
+            const float v = -cand_cost[row + j] - price[p];
+            if (v > v1) {
+              v2 = v1;
+              v1 = v;
+              p1 = p;
+            } else if (v > v2) {
+              v2 = v;
+            }
+          }
+          if (p1 < 0) {
+            bid_p[i] = -2;  // no feasible candidates at all: retire
+          } else if (v1 < give_up) {
+            bid_p[i] = -3;  // priced out: park (retire in final phase)
+          } else {
+            if (v2 < -1e8f) v2 = -1e8f;  // single-option floor
+            bid_p[i] = p1;
+            bid_inc[i] = (v1 - v2) + eps;
+          }
+        }
+      });
+      // deterministic sequential merge
+      touched.clear();
+      for (int32_t i = 0; i < n_open; ++i) {
+        const int32_t t = open[i];
+        const int32_t p = bid_p[i];
+        if (p == -2) {
+          retired[t] = 1;
+          continue;
+        }
+        if (p == -3) {
+          if (final_phase) retired[t] = 1;
+          continue;  // parked: re-collected at the next phase
+        }
+        if (win_task[p] < 0) {
+          touched.push_back(p);
+          win_task[p] = t;
+          win_inc[p] = bid_inc[i];
+        } else if (bid_inc[i] > win_inc[p] ||
+                   (bid_inc[i] == win_inc[p] && t < win_task[p])) {
+          win_task[p] = t;
+          win_inc[p] = bid_inc[i];
+        }
+      }
+      next_open.clear();
+      for (const int32_t p : touched) {
+        const int32_t t = win_task[p];
+        price[p] += win_inc[p];
+        const int32_t evicted = owner[p];
+        owner[p] = t;
+        p4t[t] = p;
+        if (evicted >= 0) {
+          p4t[evicted] = -1;
+          next_open.push_back(evicted);
+        }
+        ++events;
+        win_task[p] = -1;  // reset for the next round
+      }
+      // losers (bid but did not win) stay open
+      for (int32_t i = 0; i < n_open; ++i) {
+        const int32_t t = open[i];
+        if (bid_p[i] >= 0 && p4t[t] < 0) next_open.push_back(t);
+      }
+      open.swap(next_open);
+    }
+
+    if (eps <= eps_end || events >= max_events) break;
+    eps = std::max(eps * scale, eps_end);
+  }
+  delete pool;
+
+  // Cleanup pass (same tail semantics as the Gauss-Seidel engine): a
+  // forward auction never lowers prices, so an unfillable tail can strand
+  // providers at pumped prices while feasible tasks sit retired. Seat the
+  // leftovers greedily; deterministic by task order.
+  for (int32_t t = 0; t < T; ++t) {
+    if (p4t[t] >= 0) continue;
+    float best = kInfeasible;
+    int32_t best_p = -1;
+    const int64_t row = static_cast<int64_t>(t) * K;
+    for (int32_t j = 0; j < K; ++j) {
+      const int32_t p = cand_provider[row + j];
+      if (p < 0 || owner[p] >= 0) continue;
+      const float c = cand_cost[row + j];
+      if (c < best) {
+        best = c;
+        best_p = p;
+      }
+    }
+    if (best_p >= 0 && best < kInfeasible * 0.5f) {
+      owner[best_p] = t;
+      p4t[t] = best_p;
+    }
+  }
+
+  int32_t assigned = 0;
+  for (int32_t t = 0; t < T; ++t) {
+    out_provider_for_task[t] = p4t[t];
+    if (p4t[t] >= 0) ++assigned;
+    // the RAW flag is carried (a cleanup-seated retired task stays
+    // retired): masking by seat here would launder the flag away and
+    // re-open the task every warm solve — see the seeding note above
+    retired_io[t] = retired[t];
+  }
+  std::memcpy(price_io, price.data(), static_cast<size_t>(P) * 4);
   return assigned;
 }
 

@@ -29,7 +29,10 @@ class NativeBuildError(RuntimeError):
 
 
 def _build() -> None:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC]
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=gnu++17", "-pthread",
+        "-shared", "-fPIC", "-o", _SO, _SRC,
+    ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
     except (subprocess.CalledProcessError, FileNotFoundError) as e:
@@ -112,6 +115,17 @@ def load() -> ctypes.CDLL:
         i32p, f32p, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.fused_topk_candidates.restype = None
+    lib.fused_topk_candidates_mt.argtypes = (
+        lib.fused_topk_candidates.argtypes + [ctypes.c_int32]
+    )
+    lib.fused_topk_candidates_mt.restype = None
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.auction_sparse_mt.argtypes = [
+        i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64,
+        ctypes.c_int32, f32p, u8p, ctypes.c_void_p, i32p,
+    ]
+    lib.auction_sparse_mt.restype = ctypes.c_int32
     _lib = lib
     return lib
 
@@ -150,7 +164,7 @@ def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
 
 def fused_topk_candidates(
     providers, requirements, weights=None, k: int = 64,
-    reverse_r: int = 8, extra: int = 16,
+    reverse_r: int = 8, extra: int = 16, threads: Optional[int] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Fused cost + per-task top-k straight from encoded features — the
     degraded-mode twin of ops.sparse.candidates_topk_bidir (same jitter)
@@ -164,6 +178,11 @@ def fused_topk_candidates(
     ``providers`` / ``requirements`` are EncodedProviders /
     EncodedRequirements (numpy- or jax-backed); ``weights`` a CostWeights.
     Returns (cand_provider [T, k+extra] i32, cand_cost [T, k+extra] f32).
+
+    ``threads``: None runs the historical single-threaded pass; an int
+    routes through the multi-threaded engine (0 = all hardware threads),
+    whose output is bit-identical for every thread count (contiguous task
+    chunks + a deterministic reverse-edge merge).
     """
     lib = load()
     if weights is None:
@@ -214,12 +233,16 @@ def fused_topk_candidates(
     rf = _RequirementFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in ra])
     cand_p = np.empty((T, k + extra), np.int32)
     cand_c = np.empty((T, k + extra), np.float32)
-    lib.fused_topk_candidates(
+    args = (
         ctypes.byref(pf), ctypes.byref(rf), P, T, K, W, k,
         float(weights.price), float(weights.load),
         float(weights.proximity), float(weights.priority),
         cand_p, cand_c, reverse_r, extra,
     )
+    if threads is None:
+        lib.fused_topk_candidates(*args)
+    else:
+        lib.fused_topk_candidates_mt(*args, int(threads))
     return cand_p, cand_c
 
 
@@ -242,3 +265,73 @@ def auction_sparse(
         eps_start, eps_end, scale, max_events, out,
     )
     return out
+
+
+def auction_sparse_mt(
+    cand_provider: np.ndarray,
+    cand_cost: np.ndarray,
+    num_providers: int,
+    eps_start: float = 4.0,
+    eps_end: float = 0.02,
+    scale: float = 0.25,
+    max_events: int = 50_000_000,
+    threads: int = 0,
+    price: Optional[np.ndarray] = None,
+    retired: Optional[np.ndarray] = None,
+    seed_provider_for_task: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic parallel auction (engine=native-mt): synchronous
+    Jacobi bidding rounds — per-thread bid buffers against a shared price
+    snapshot, merged by a deterministic reduction (highest increment wins,
+    ties to the lowest task index). The matching is bit-identical for
+    every thread count (threads=0 means all hardware threads).
+
+    Carries the full dual state for warm chains: ``price`` [P] and
+    ``retired`` [T] are consumed AND returned updated (pass None for a
+    cold solve); ``seed_provider_for_task`` re-seats a previous matching
+    (injective over >= 0 — duplicate seats keep the first). For a warm
+    single-phase solve pass ``eps_start == eps_end``. The caller must
+    clear ``retired`` flags for tasks whose candidates changed
+    (ops/sparse.py assign_auction_sparse_warm has the same contract).
+
+    Returns (provider_for_task [T] i32, price [P] f32, retired [T] bool).
+    """
+    lib = load()
+    cand_p = np.ascontiguousarray(cand_provider, np.int32)
+    cand_c = np.ascontiguousarray(cand_cost, np.float32)
+    T, K = cand_p.shape
+    price_io = (
+        np.zeros(num_providers, np.float32)
+        if price is None
+        else np.array(price, np.float32, copy=True)
+    )
+    if price_io.shape[0] != num_providers:
+        raise ValueError(
+            f"price has {price_io.shape[0]} rows, want {num_providers}"
+        )
+    retired_io = (
+        np.zeros(T, np.uint8)
+        if retired is None
+        else np.ascontiguousarray(np.asarray(retired, bool).astype(np.uint8))
+    )
+    if retired_io.shape[0] != T:
+        raise ValueError(f"retired has {retired_io.shape[0]} rows, want {T}")
+    seed_ptr = None
+    seed_arr = None
+    if seed_provider_for_task is not None:
+        seed_arr = np.ascontiguousarray(seed_provider_for_task, np.int32)
+        if seed_arr.shape[0] != T:
+            raise ValueError(f"seed has {seed_arr.shape[0]} rows, want {T}")
+        # clamp out-of-range seeds (same untrusted-input hygiene as the
+        # gRPC warm path); the engine keeps the first of any duplicates
+        seed_arr = np.where(
+            (seed_arr >= 0) & (seed_arr < num_providers), seed_arr, -1
+        ).astype(np.int32)
+        seed_ptr = seed_arr.ctypes.data_as(ctypes.c_void_p)
+    out = np.empty(T, np.int32)
+    lib.auction_sparse_mt(
+        cand_p, cand_c, num_providers, T, K,
+        eps_start, eps_end, scale, max_events, int(threads),
+        price_io, retired_io, seed_ptr, out,
+    )
+    return out, price_io, retired_io.astype(bool)

@@ -1,0 +1,335 @@
+"""Persistent warm-solve arena for the native CPU engine (engine=native-mt).
+
+The degraded-mode twin of the CandidateCache + warm-kernel pipeline
+(sched/cand_cache.py + ops/sparse.assign_auction_sparse_warm): repeated
+solves against an incrementally-churned marketplace reuse everything that
+survives between ticks instead of rebuilding it —
+
+  - **Candidate structure.** The fused cost+top-k pass is the dominant
+    stage (~90% of a cold native solve). The arena keeps the assembled
+    [T, k+extra] bidirectional candidate lists and, on churn, recomputes
+    only the rows that can have changed: dirty TASKS get a fresh fused
+    pass against the full fleet; dirty PROVIDERS are dropped from every
+    cached list and re-merged from one [dirty-P x T] delta pass (their
+    forward candidates AND their reverse edges) — never the full pass.
+  - **Auction dual state.** Prices per provider, the retirement mask per
+    task, and the previous matching are carried into a single-phase warm
+    auction (native.auction_sparse_mt), whose eps-CS repair evicts stale
+    seeds. Retirement flags are cleared for exactly the rows whose
+    candidates changed — the same caller contract the JAX warm kernel
+    documents ("rows whose costs or candidates changed must be cleared").
+
+Dirty detection is value-based: each provider/requirement feature column
+is compared row-wise against the previous solve's columns, so any change
+that can affect feasibility or cost (specs, price, load, validity, the
+requirement DSL fields) marks its row dirty and ONLY that row is
+recomputed. Two staleness backstops mirror the TPU path: a dirty fraction
+above ``max_dirty_frac`` triggers a full rebuild (the delta pass would
+cost more than it saves), and ``cold_every`` bounds tie-jitter drift from
+delta passes (delta candidates are jittered by their local indices, like
+the CandidateCache's merge batches) plus the warm chain's monotone price
+ratchet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu import native
+
+# canonical dtypes per encoded field (mirrors native.fused_topk_candidates'
+# coercions so comparing cached vs incoming columns is exact)
+_P_SPEC = (
+    ("gpu_count", np.int32), ("gpu_mem_mb", np.int32),
+    ("gpu_model_id", np.int32), ("has_gpu", np.uint8),
+    ("has_cpu", np.uint8), ("cpu_cores", np.int32), ("ram_mb", np.int32),
+    ("storage_gb", np.int32), ("lat", np.float32), ("lon", np.float32),
+    ("has_location", np.uint8), ("price", np.float32), ("load", np.float32),
+    ("valid", np.uint8),
+)
+_R_SPEC = (
+    ("cpu_required", np.uint8), ("cpu_cores", np.int32), ("ram_mb", np.int32),
+    ("storage_gb", np.int32), ("gpu_opt_valid", np.uint8),
+    ("gpu_count", np.int32), ("gpu_mem_min", np.int32),
+    ("gpu_mem_max", np.int32), ("gpu_total_mem_min", np.int32),
+    ("gpu_total_mem_max", np.int32), ("gpu_model_mask", np.uint32),
+    ("gpu_model_constrained", np.uint8), ("lat", np.float32),
+    ("lon", np.float32), ("has_location", np.uint8),
+    ("priority", np.float32), ("valid", np.uint8),
+)
+
+
+def _canon(enc, spec) -> dict[str, np.ndarray]:
+    return {
+        name: np.ascontiguousarray(np.asarray(getattr(enc, name)), dtype)
+        for name, dtype in spec
+    }
+
+
+def _dirty_rows(new: dict, old: dict, spec) -> np.ndarray:
+    """Row-wise OR of per-field inequality (trailing axes collapsed)."""
+    n = new[spec[0][0]].shape[0]
+    dirty = np.zeros(n, bool)
+    for name, _ in spec:
+        diff = new[name] != old[name]
+        dirty |= diff.reshape(n, -1).any(axis=1)
+    return dirty
+
+
+def _subset(fields: dict, idx: np.ndarray, spec) -> object:
+    """A namespace with the gathered rows of each field (duck-types the
+    Encoded* dataclasses for native.fused_topk_candidates)."""
+    ns = type("_Sub", (), {})()
+    for name, _ in spec:
+        setattr(ns, name, fields[name][idx])
+    return ns
+
+
+def _as_ns(fields: dict, spec) -> object:
+    ns = type("_Full", (), {})()
+    for name, _ in spec:
+        setattr(ns, name, fields[name])
+    return ns
+
+
+class NativeSolveArena:
+    def __init__(
+        self,
+        k: int = 64,
+        reverse_r: int = 8,
+        extra: int = 16,
+        threads: int = 0,
+        cold_every: int = 256,
+        max_dirty_frac: float = 0.25,
+        eps_start: float = 4.0,
+        eps_end: float = 0.02,
+    ):
+        self.k = k
+        self.reverse_r = reverse_r
+        self.extra = extra
+        self.threads = threads
+        self.cold_every = cold_every
+        self.max_dirty_frac = max_dirty_frac
+        self.eps_start = eps_start
+        self.eps_end = eps_end
+        self.last_stats: dict = {}
+        self.invalidate()
+
+    @property
+    def price(self) -> Optional[np.ndarray]:
+        """Carried auction prices [P] after the last solve (dual state)."""
+        return self._price
+
+    @property
+    def retired(self) -> Optional[np.ndarray]:
+        """Carried retirement mask [T] after the last solve."""
+        return self._retired
+
+    def invalidate(self) -> None:
+        """Drop all carried state: the next solve is cold."""
+        self._p_fields: Optional[dict] = None
+        self._r_fields: Optional[dict] = None
+        self._weights_key: Optional[tuple] = None
+        self._cand_p: Optional[np.ndarray] = None
+        self._cand_c: Optional[np.ndarray] = None
+        self._price: Optional[np.ndarray] = None
+        self._retired: Optional[np.ndarray] = None
+        self._p4t: Optional[np.ndarray] = None
+        self._warm_solves = 0
+
+    # ---------------- internals ----------------
+
+    @staticmethod
+    def _wkey(weights) -> tuple:
+        return (
+            float(weights.price), float(weights.load),
+            float(weights.proximity), float(weights.priority),
+        )
+
+    def _shapes_compatible(self, pf: dict, rf: dict) -> bool:
+        old_p, old_r = self._p_fields, self._r_fields
+        if old_p is None or old_r is None:
+            return False
+        return all(
+            pf[n].shape == old_p[n].shape for n, _ in _P_SPEC
+        ) and all(rf[n].shape == old_r[n].shape for n, _ in _R_SPEC)
+
+    def _cold(self, ep, er, weights, pf, rf, P, T) -> np.ndarray:
+        cand_p, cand_c = native.fused_topk_candidates(
+            ep, er, weights, k=self.k, reverse_r=self.reverse_r,
+            extra=self.extra, threads=self.threads,
+        )
+        p4t, price, retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=P,
+            eps_start=self.eps_start, eps_end=self.eps_end,
+            threads=self.threads,
+        )
+        self._p_fields, self._r_fields = pf, rf
+        self._weights_key = self._wkey(weights)
+        self._cand_p, self._cand_c = cand_p, cand_c
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves = 0
+        self.last_stats = {
+            "cold": True,
+            "dirty_providers": P,
+            "dirty_tasks": T,
+            "changed_rows": T,
+            "warm_solves_since_cold": 0,
+            "assigned": int((p4t >= 0).sum()),
+        }
+        return p4t
+
+    def _merge_delta(
+        self,
+        rows: np.ndarray,
+        dirty_p_idx: np.ndarray,
+        delta_p: np.ndarray,
+        delta_c: np.ndarray,
+    ) -> np.ndarray:
+        """For the task rows in ``rows``: drop dirty providers from the
+        cached row, fold the delta pass's candidates (forward + reverse,
+        global ids) back in by current cost, and return the changed mask
+        (aligned with ``rows``). Rows recomputed this solve are excluded
+        by the caller — re-merging them would duplicate dirty providers
+        inside one candidate list (a dup makes v1 == v2 in the bid math)."""
+        cand_p = self._cand_p[rows]
+        cand_c = self._cand_c[rows]
+        in_dirty = np.zeros(self._price.shape[0], bool)
+        in_dirty[dirty_p_idx] = True
+        stale = (cand_p >= 0) & in_dirty[np.maximum(cand_p, 0)]
+        masked_p = np.where(stale, -1, cand_p)
+
+        allp = np.concatenate([masked_p, delta_p[rows]], axis=1)
+        allc = np.concatenate([cand_c, delta_c[rows]], axis=1)
+        key = np.where(allp >= 0, allc, np.inf)
+        k_eff = cand_p.shape[1]
+        idx = np.argsort(key, axis=1, kind="stable")[:, :k_eff]
+        new_p = np.take_along_axis(allp, idx, axis=1).astype(np.int32)
+        new_c = np.take_along_axis(allc, idx, axis=1).astype(np.float32)
+        new_c[new_p < 0] = 0.0
+        # changed = provider set/order moved OR a kept candidate got
+        # materially CHEAPER (same row, lower cost — e.g. a price drop
+        # that doesn't re-rank): both can make a retired task viable
+        # again, so both must clear its carried flag. Increases cannot
+        # un-retire; the 0.05 floor matches the CandidateCache's
+        # stale_abs_tol ("drift big enough to matter").
+        changed = (new_p != cand_p).any(axis=1) | (
+            (cand_c - new_c) > 0.05
+        ).any(axis=1)
+        self._cand_p[rows] = new_p
+        self._cand_c[rows] = new_c
+        return changed
+
+    # ---------------- the solve ----------------
+
+    def solve(self, ep, er, weights) -> np.ndarray:
+        """One marketplace solve. ``ep``/``er`` are EncodedProviders /
+        EncodedRequirements (numpy- or jax-backed); returns
+        provider_for_task [T] i32. ``last_stats`` reports what was
+        recomputed.
+
+        Dirty detection compares against the arrays of the PREVIOUS call,
+        which the arena holds by reference (copying every feature column
+        per solve would cost ~150 MB/solve at 1M rows): callers must pass
+        freshly-built or copied arrays rather than mutating the previous
+        call's buffers in place (the matcher re-encodes per solve, and
+        jax-backed arrays are immutable, so both production paths are
+        safe by construction)."""
+        pf = _canon(ep, _P_SPEC)
+        rf = _canon(er, _R_SPEC)
+        P = pf["gpu_count"].shape[0]
+        T = rf["cpu_cores"].shape[0]
+        if P == 0 or T == 0:
+            self.last_stats = {"cold": True, "assigned": 0}
+            return np.full(T, -1, np.int32)
+
+        if (
+            not self._shapes_compatible(pf, rf)
+            # every carried cost and selection was computed under the old
+            # weights: a weight change invalidates the whole structure
+            or self._weights_key != self._wkey(weights)
+            or self._warm_solves >= self.cold_every
+        ):
+            return self._cold(ep, er, weights, pf, rf, P, T)
+
+        dirty_p = _dirty_rows(pf, self._p_fields, _P_SPEC)
+        dirty_t = _dirty_rows(rf, self._r_fields, _R_SPEC)
+        n_dp, n_dt = int(dirty_p.sum()), int(dirty_t.sum())
+        if (n_dp + n_dt) / (P + T) > self.max_dirty_frac:
+            return self._cold(ep, er, weights, pf, rf, P, T)
+        if n_dp == 0 and n_dt == 0:
+            # byte-identical marketplace: the carried matching IS the
+            # solve (prices/retirement already consistent with it)
+            self._warm_solves += 1
+            self.last_stats = {
+                "cold": False,
+                "dirty_providers": 0,
+                "dirty_tasks": 0,
+                "changed_rows": 0,
+                "warm_solves_since_cold": self._warm_solves,
+                "assigned": int((self._p4t >= 0).sum()),
+            }
+            return self._p4t.copy()
+
+        self._p_fields, self._r_fields = pf, rf
+        changed = dirty_t.copy()
+
+        # ---- dirty tasks: fresh fused pass against the full fleet
+        if n_dt:
+            t_idx = np.flatnonzero(dirty_t)
+            sub_er = _subset(rf, t_idx, _R_SPEC)
+            tp, tc = native.fused_topk_candidates(
+                _as_ns(pf, _P_SPEC), sub_er, weights, k=self.k,
+                reverse_r=self.reverse_r, extra=self.extra,
+                threads=self.threads,
+            )
+            self._cand_p[t_idx] = tp
+            self._cand_c[t_idx] = tc
+            # a dirty task's seat predates its new requirement: re-seat
+            # from scratch (the warm repair would keep a stale-but-eps-OK
+            # seat on candidates the task no longer declares)
+            self._p4t[t_idx] = -1
+
+        # ---- dirty providers: one [dirty-P x T] delta pass, merged into
+        # every row NOT already recomputed above
+        if n_dp:
+            p_idx = np.flatnonzero(dirty_p)
+            sub_ep = _subset(pf, p_idx, _P_SPEC)
+            kd = min(self.k, n_dp)
+            dp_local, dc = native.fused_topk_candidates(
+                sub_ep, _as_ns(rf, _R_SPEC), weights, k=kd,
+                reverse_r=self.reverse_r, extra=self.extra,
+                threads=self.threads,
+            )
+            # local -> global provider ids
+            dp = np.where(
+                dp_local >= 0, p_idx[np.maximum(dp_local, 0)], -1
+            ).astype(np.int32)
+            keep_rows = np.flatnonzero(~dirty_t)
+            if keep_rows.size:
+                changed[keep_rows] |= self._merge_delta(
+                    keep_rows, p_idx, dp, dc
+                )
+
+        # ---- warm auction over the carried dual state
+        retired = self._retired & ~changed
+        p4t, price, retired = native.auction_sparse_mt(
+            self._cand_p, self._cand_c, num_providers=P,
+            eps_start=self.eps_end, eps_end=self.eps_end,
+            threads=self.threads,
+            price=self._price, retired=retired,
+            seed_provider_for_task=self._p4t,
+        )
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves += 1
+        self.last_stats = {
+            "cold": False,
+            "dirty_providers": n_dp,
+            "dirty_tasks": n_dt,
+            "changed_rows": int(changed.sum()),
+            "warm_solves_since_cold": self._warm_solves,
+            "assigned": int((p4t >= 0).sum()),
+        }
+        return p4t

@@ -1,0 +1,19 @@
+"""jax version compatibility for the mesh kernels.
+
+``shard_map`` was promoted out of ``jax.experimental`` (and its
+replication-check kwarg renamed ``check_rep`` -> ``check_vma``) around
+jax 0.6; this repo targets the promoted API. One shim, imported by every
+``parallel/`` module, keeps older runtimes working.
+"""
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.6 keeps shard_map at its pre-promotion home
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_compat(*args, **kwargs)

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from protocol_tpu.parallel._compat import shard_map
 
 from protocol_tpu.ops.assign import AssignResult, _invert
 from protocol_tpu.ops.cost import INFEASIBLE

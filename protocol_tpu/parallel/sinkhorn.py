@@ -25,7 +25,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from protocol_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from protocol_tpu.ops.blocked import (

@@ -147,6 +147,13 @@ class PreparedSolve:
     # selection tolerance since their candidates were chosen — the adaptive
     # re-ground trigger (measured BEFORE any rebuild this prepare)
     stale_frac: float = 0.0
+    # [S_pad] bool: slots whose ASSEMBLED candidate lists differ from the
+    # previous prepare (fresh tasks, provider churn merges, departures,
+    # coverage-repair shifts). The warm kernel's contract says rows whose
+    # candidates changed must have their carried retirement flags cleared
+    # by the caller — this is that signal. None on the first prepare /
+    # after a rebuild (treat every slot as dirty).
+    dirty_slots: Optional[np.ndarray] = None
 
 
 class CandidateCache:
@@ -213,6 +220,9 @@ class CandidateCache:
         # on a homogeneous fleet would all cache the SAME k providers
         # (capping the matching at k) — see candidates_topk(task_offset=...)
         self._jitter_cursor = 0
+        # previous prepare's assembled lists: the reference for dirty_slots
+        self._prev_cand_p: Optional[np.ndarray] = None
+        self._prev_cand_c: Optional[np.ndarray] = None
 
     def invalidate(self) -> None:
         """Force a full rebuild on the next prepare (the periodic cold
@@ -481,6 +491,30 @@ class CandidateCache:
             cand_p, cand_c, tasks, valid_row, slot_prio, s_pad, wprio
         )
 
+        # dirty-slot tracking for the warm retirement carry: compare the
+        # fully-assembled lists (forward + repair extras) against the
+        # previous prepare — content comparison catches every source of
+        # change at once (fresh tasks, merges, departures, repair shifts)
+        if (
+            self._prev_cand_p is not None
+            and self._prev_cand_p.shape == cand_p.shape
+        ):
+            dirty_slots = (cand_p != self._prev_cand_p).any(axis=1)
+            # cost-only drift (price/load updated in place) changes cand_c
+            # without touching the provider ids. A retired task can only
+            # become viable again when something in its row got CHEAPER,
+            # so material decreases dirty the row too; increases cannot
+            # un-retire, and sub-tolerance load jitter must not break the
+            # carry (stale_abs_tol is the same floor the adaptive
+            # re-ground uses for "drift big enough to matter").
+            dirty_slots |= (
+                (self._prev_cand_c - cand_c) > self.stale_abs_tol
+            ).any(axis=1)
+        else:
+            dirty_slots = None  # first prepare / slot relayout: all dirty
+        self._prev_cand_p = cand_p.copy()
+        self._prev_cand_c = cand_c.copy()
+
         return PreparedSolve(
             ep=ep,
             cand_p=cand_p,
@@ -499,6 +533,7 @@ class CandidateCache:
             delta_rows=int(len(new_rows)),
             uncovered_rows=uncovered,
             stale_frac=stale_frac,
+            dirty_slots=dirty_slots,
         )
 
     def _stale_fraction(self) -> float:

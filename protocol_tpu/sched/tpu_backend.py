@@ -199,6 +199,8 @@ class TpuBatchMatcher:
         top_k: int = 64,
         warm_start: bool = True,
         native_fallback: bool = False,
+        native_engine: str = "native",
+        native_threads: int = 0,
         use_mesh: bool = False,
         approx_recall: Optional[float] = None,
         time_fn=time.monotonic,
@@ -222,6 +224,10 @@ class TpuBatchMatcher:
         # layout it was computed under (see _solve_slots_cached)
         self._warm_retired: np.ndarray | None = None
         self._warm_retired_fp: tuple | None = None
+        # claim-masked slot rows (anti-affinity/colocation) of the current
+        # and previous solve: both dirty the carried retirement mask
+        self._claim_rows_now: np.ndarray | None = None
+        self._claim_rows_prev: np.ndarray | None = None
         # forward auctions never LOWER prices: carried prices ratchet
         # within a warm chain. Three bounds keep that safe: the warm
         # kernel caps entry prices below its retirement floor
@@ -239,6 +245,20 @@ class TpuBatchMatcher:
         # unreachable — the engine is this framework's CPU backend, not an
         # external dependency). Opt-in so tests keep covering the jax path.
         self.native_fallback = native_fallback
+        # native engine selection: "native" is the single-threaded
+        # Gauss-Seidel engine; "native-mt" runs the multi-threaded fused
+        # pass + deterministic Jacobi auction THROUGH the persistent solve
+        # arena (protocol_tpu/native/arena.py), so steady-state solves
+        # recompute only churned rows. native_threads: 0 = all hardware
+        # threads.
+        if native_engine not in ("native", "native-mt"):
+            raise ValueError(
+                f"native_engine must be native|native-mt, got {native_engine!r}"
+            )
+        self.native_engine = native_engine
+        self.native_threads = int(native_threads)
+        self._native_arena = None
+        self._last_arena_stats: dict = {}
         # multi-chip solves: route phase 1's eps-ladder / warm kernels
         # through the task-sharded mesh variants (parallel/sparse.py, the
         # v5e-8 path) when more than one device is visible. Opt-in
@@ -388,13 +408,34 @@ class TpuBatchMatcher:
         if self.native_fallback:
             from protocol_tpu import native
 
-            # fused feature->cost->top-k: the [P, T] tensor never exists
-            # (same streaming shape as the sparse TPU path)
             n_providers = int(np.asarray(ep.gpu_count).shape[0])
-            cand_p, cand_c = native.fused_topk_candidates(
-                ep, er, self.weights, k=min(64, n_providers)
-            )
-            p4s = native.auction_sparse(cand_p, cand_c, num_providers=n_providers)
+            self._last_arena_stats = {}
+            if self.native_engine == "native-mt":
+                # persistent warm-solve arena: candidate structure, prices
+                # and the retirement mask survive between solves; only
+                # churned rows are recomputed (tentpole semantics of the
+                # CandidateCache, on the native path)
+                if self._native_arena is None:
+                    from protocol_tpu.native.arena import NativeSolveArena
+
+                    self._native_arena = NativeSolveArena(
+                        threads=self.native_threads,
+                        cold_every=self.cold_every,
+                    )
+                p4s = self._native_arena.solve(ep, er, self.weights)
+                self._last_arena_stats = {
+                    f"arena_{k}": v
+                    for k, v in self._native_arena.last_stats.items()
+                }
+            else:
+                # fused feature->cost->top-k: the [P, T] tensor never
+                # exists (same streaming shape as the sparse TPU path)
+                cand_p, cand_c = native.fused_topk_candidates(
+                    ep, er, self.weights, k=min(64, n_providers)
+                )
+                p4s = native.auction_sparse(
+                    cand_p, cand_c, num_providers=n_providers
+                )
             t4p = np.full(n_providers, -1, np.int32)
             for s_idx, p_idx in enumerate(p4s):
                 if p_idx >= 0:
@@ -953,7 +994,37 @@ class TpuBatchMatcher:
         )
         retired0 = None
         if warm and self._warm_retired is not None and self._warm_retired_fp == slot_fp:
-            retired0 = jnp.asarray(self._warm_retired)
+            carried = np.asarray(self._warm_retired)
+            if prepared.dirty_slots is None:
+                # unknown provenance (first prepare after a relayout the
+                # slot_fp missed): drop the whole mask rather than carry
+                # flags over changed candidates
+                carried = None
+            else:
+                # the warm kernel's contract: rows whose candidates changed
+                # must be cleared by the caller — otherwise a task stays
+                # retired after a newly-feasible provider churns into its
+                # list and sits unassigned until the next cold solve
+                # (ADVICE r5). dirty_slots is the cache-side signal;
+                # claim-masking (this solve's AND last solve's — a released
+                # claim restores candidates) edits lists after the cache
+                # compared, so those rows are dirty too.
+                dirty = prepared.dirty_slots.copy()
+                for claim_rows in (
+                    self._claim_rows_now, self._claim_rows_prev
+                ):
+                    if claim_rows is not None:
+                        if claim_rows.shape == dirty.shape:
+                            dirty |= claim_rows
+                        else:
+                            carried = None
+                if carried is not None and dirty.shape == carried.shape:
+                    carried = carried & ~dirty
+                else:
+                    carried = None
+            if carried is not None:
+                retired0 = jnp.asarray(carried)
+        self._claim_rows_prev = self._claim_rows_now
         stall_stats: dict = {}
         res, price, retired = self._sparse_solve(
             cand_p, cand_c, prepared.p_bucket, warm,
@@ -1121,6 +1192,8 @@ class TpuBatchMatcher:
                     truncated_slots,
                 )
         self._last_sharded = False  # set by _sparse_solve when it engages
+        self._last_arena_stats = {}  # set by _bounded_t4p on the native path
+        self._claim_rows_now = None  # set by the claim-masking block below
         s_bucket = _pow2_bucket(len(slot_task)) if slot_task else 0
         use_sparse = bool(slot_task) and (
             not self.native_fallback
@@ -1251,9 +1324,9 @@ class TpuBatchMatcher:
             )
             if prepared is not None:
                 cp = prepared.cand_p
-                prepared.cand_p = np.where(
-                    (cp >= 0) & claimed[np.maximum(cp, 0)], -1, cp
-                )
+                masked = (cp >= 0) & claimed[np.maximum(cp, 0)]
+                prepared.cand_p = np.where(masked, -1, cp)
+                self._claim_rows_now = masked.any(axis=1)
 
         # ---- phase 1: bounded tasks -> replica slots -> auction
         if slot_task:
@@ -1293,9 +1366,12 @@ class TpuBatchMatcher:
                         zip(addrs, np.asarray(price[:P], np.float64).tolist())
                     )
             else:
-                kernel_used = (
-                    "native_cpu" if self.native_fallback else "dense_auction"
-                )
+                if not self.native_fallback:
+                    kernel_used = "dense_auction"
+                elif self.native_engine == "native-mt":
+                    kernel_used = "native_cpu_mt"
+                else:
+                    kernel_used = "native_cpu"
                 er = self.encoder.encode_requirements(
                     [req_by_task[i] for i in slot_task],
                     priorities=[prio[i] for i in slot_task],
@@ -1353,4 +1429,6 @@ class TpuBatchMatcher:
             "group_assignments": len(self._group_assignment),
             "seq": self._solve_seq,  # monotone id for scrape-side dedup
             **cache_stats,
+            # native-mt only: what the persistent arena reused vs recomputed
+            **self._last_arena_stats,
         }

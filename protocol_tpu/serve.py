@@ -250,7 +250,22 @@ async def serve_orchestrator(args) -> None:
             # hold the reference or the grpc.Server is GC'd and stops
             addr = "127.0.0.1:50061"
             grpc_server = scheduler_grpc.serve(addr)
-        matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
+        matcher = scheduler_grpc.RemoteBatchMatcher(
+            store,
+            addr,
+            # the native-engine knobs ride the wire as the kernel string
+            # ("native-mt[:N]") when the control plane is in degraded mode
+            native_fallback=os.environ.get(
+                "PROTOCOL_TPU_NATIVE_FALLBACK", ""
+            ).lower()
+            in ("1", "true", "yes"),
+            native_engine=os.environ.get(
+                "PROTOCOL_TPU_NATIVE_ENGINE", "native"
+            ),
+            native_threads=int(
+                os.environ.get("PROTOCOL_TPU_NATIVE_THREADS") or 0
+            ),
+        )
     else:
         matcher = TpuBatchMatcher(
             store,
@@ -258,6 +273,15 @@ async def serve_orchestrator(args) -> None:
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
             in ("1", "true", "yes"),
+            # native | native-mt: the multi-threaded engine + persistent
+            # warm arena for degraded-mode deployments with cores to spare
+            native_engine=os.environ.get(
+                "PROTOCOL_TPU_NATIVE_ENGINE", "native"
+            ),
+            # 0 = all hardware threads
+            native_threads=int(
+                os.environ.get("PROTOCOL_TPU_NATIVE_THREADS") or 0
+            ),
             # deploy-time override of the dense/sparse cutover (cells =
             # p_bucket * s_bucket). Small fleets land on the dense solver
             # by default; soaks and staging set this low to exercise the

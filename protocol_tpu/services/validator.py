@@ -462,7 +462,16 @@ class ValidatorService:
             "matrix_a": a.tolist(),
             "matrix_b": b.tolist(),
         }
-        headers, body = sign_request("/control/challenge", self.wallet, payload)
+        try:
+            # digest-mode signing (security/signer.py) keeps the ~254 KB
+            # matrix body under the EVM wallets' 64 KB keccak cap; the
+            # guard stays because an oversized/unsignable body must fail
+            # THIS challenge, never abort the whole validation tick
+            headers, body = sign_request(
+                "/control/challenge", self.wallet, payload
+            )
+        except ValueError:
+            return False
         try:
             async with self.http.post(
                 f"{control_url}/challenge", json=body, headers=headers

@@ -1,0 +1,22 @@
+"""Kill-proof JSONL artifact appends for bench/measurement scripts.
+
+The r4/r5 scaling artifacts died to timeouts with everything buffered in
+memory (header-only logs on disk — VERDICT r5 "what's weak" #4). Every
+measurement row goes through one contract: open/append/close per row, so
+a SIGKILL can never erase a finished stage's evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def append_jsonl(path: str, row: dict) -> None:
+    """Append one JSON row to ``path`` immediately (no-op when ``path``
+    is empty/falsy — the scripts' artifact-disable convention)."""
+    if not path:
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as fh:
+        fh.write(json.dumps(row) + "\n")

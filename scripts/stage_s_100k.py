@@ -27,7 +27,20 @@ def main() -> None:
     ap.add_argument("--size", type=int, default=100_000)
     ap.add_argument("--tile", type=int, default=2500)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument(
+        "--artifact",
+        default="artifacts/stage_s_rows.jsonl",
+        help="JSONL file each stage row is APPENDED to as it completes "
+        "(a timeout cannot erase finished stages — the r4/r5 artifact "
+        "deaths left header-only logs). Empty string disables.",
+    )
     args = ap.parse_args()
+
+    from protocol_tpu.utils.artifacts import append_jsonl
+
+    def emit(row: dict) -> None:
+        print(json.dumps(row), flush=True)
+        append_jsonl(args.artifact, row)
 
     if args.cpu:
         from protocol_tpu.utils.platform import force_host_cpu
@@ -100,14 +113,14 @@ def main() -> None:
     jax.block_until_ready(res_s.provider_for_task)
     t_sink = t_pot + (time.perf_counter() - t0)
     q_sink = quality(res_s.provider_for_task)
-    print(json.dumps({
+    emit({
         "stage": "S sinkhorn-OT at shape (measured)",
         "platform": platform,
         "shape": f"P=T={P} iters={args.iters} tile={tile} (potentials reused for rounding)",
         "potentials_s": round(t_pot, 2),
         "end_to_end_s": round(t_sink, 2),
         **{f"sinkhorn_{k}": v for k, v in q_sink.items()},
-    }), flush=True)
+    })
 
     # ---- the auction on the SAME instance (quality referee) ----
     t0 = time.perf_counter()
@@ -123,14 +136,14 @@ def main() -> None:
     jax.block_until_ready(res_a.provider_for_task)
     t_solve = time.perf_counter() - t0
     q_auc = quality(res_a.provider_for_task)
-    print(json.dumps({
+    emit({
         "stage": "S auction referee on the same instance (measured)",
         "platform": platform,
         "shape": f"P=T={P} k=64 bidir",
         "gen_s": round(t_gen, 2),
         "solve_s": round(t_solve, 2),
         **{f"auction_{k}": v for k, v in q_auc.items()},
-    }), flush=True)
+    })
 
 
 if __name__ == "__main__":

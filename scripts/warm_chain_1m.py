@@ -36,21 +36,93 @@ from protocol_tpu.parallel import (  # noqa: E402
 )
 
 
+
+def run_native_chain(args, cand_p, cand_c, P, T, eps_end, emit) -> None:
+    """The chain on the multi-threaded native engine: one cold eps-ladder
+    solve, then churn -> single-phase warm solves carrying prices + the
+    retirement mask + the previous matching (the same dual-state shape the
+    jax chain carries across assign_auction_sparse_warm_sharded)."""
+    from protocol_tpu import native
+
+    t0 = time.time()
+    p4t, price, retired = native.auction_sparse_mt(
+        cand_p, cand_c, num_providers=P,
+        eps_start=4.0, eps_end=eps_end, threads=args.threads,
+    )
+    emit({
+        "step": 0, "kind": "cold", "engine": "native-mt",
+        "threads": args.threads, "wall_s": round(time.time() - t0, 1),
+        "assigned": int((p4t >= 0).sum()),
+        "retired": int(retired.sum()),
+        "price_max": round(float(price.max()), 3),
+    })
+
+    n_churn = max(int(T * args.churn), 1)
+    churn_rng = np.random.default_rng(7)
+    for step in range(1, args.steps + 1):
+        idx = churn_rng.choice(T, size=n_churn, replace=False)
+        seeds = p4t.copy()
+        seeds[idx] = -1
+        retired[idx] = False  # churned tasks are "new" work
+        t0 = time.time()
+        p4t, price, retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=P,
+            eps_start=eps_end, eps_end=eps_end, threads=args.threads,
+            price=price, retired=retired, seed_provider_for_task=seeds,
+        )
+        wall = time.time() - t0
+        pos = p4t[p4t >= 0]
+        emit({
+            "step": step, "kind": "warm", "engine": "native-mt",
+            "threads": args.threads, "wall_s": round(wall, 1),
+            "assigned": int((p4t >= 0).sum()),
+            "injective": bool(np.unique(pos).size == pos.size),
+            "retired": int(retired.sum()),
+            "price_max": round(float(price.max()), 3),
+        })
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--churn", type=float, default=0.01)
     ap.add_argument("--size", type=int, default=1_000_000)
+    ap.add_argument(
+        "--engine", choices=["jax", "native-mt"], default="jax",
+        help="native-mt runs the chain through the multi-threaded C++ "
+        "auction (auction_sparse_mt) carrying the same dual state — the "
+        "CPU-host answer to the 330-560 s/step jax-on-CPU chain",
+    )
+    ap.add_argument("--threads", type=int, default=0, help="0 = all cores")
+    ap.add_argument(
+        "--artifact", default="artifacts/warm_chain_rows.jsonl",
+        help="JSONL file each step row is APPENDED to as it completes "
+        "(kill-proof). Empty string disables.",
+    )
     args = ap.parse_args()
+
+    from protocol_tpu.utils.artifacts import append_jsonl
+
+    def emit(row: dict) -> None:
+        print(json.dumps(row), flush=True)
+        append_jsonl(args.artifact, row)
 
     T = P = args.size
     K = 80
     EPS_END = 1.0  # matches the smoke's bounded cold ladder
     rng = np.random.default_rng(0)
     t0 = time.time()
-    cand_p = jnp.asarray(rng.integers(0, P, size=(T, K), dtype=np.int32))
-    cand_c = jnp.asarray(rng.uniform(0.0, 10.0, size=(T, K)).astype(np.float32))
+    cand_p_np = rng.integers(0, P, size=(T, K), dtype=np.int32)
+    cand_c_np = rng.uniform(0.0, 10.0, size=(T, K)).astype(np.float32)
     print(f"# synth built {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+
+    if args.engine == "native-mt":
+        run_native_chain(args, cand_p_np, cand_c_np, P, T, EPS_END, emit)
+        return
+
+    cand_p = jnp.asarray(cand_p_np)
+    cand_c = jnp.asarray(cand_c_np)
+    del cand_p_np, cand_c_np
 
     mesh = make_mesh(8)
     t0 = time.time()
@@ -61,12 +133,13 @@ def main() -> None:
     )
     cold_wall = time.time() - t0
     p4t = np.asarray(res.provider_for_task)
-    print(json.dumps({
-        "step": 0, "kind": "cold", "wall_s": round(cold_wall, 1),
+    emit({
+        "step": 0, "kind": "cold", "engine": "jax",
+        "wall_s": round(cold_wall, 1),
         "assigned": int((p4t >= 0).sum()),
         "retired": int(np.asarray(retired).sum()),
         "price_max": round(float(np.asarray(price).max()), 3),
-    }), flush=True)
+    })
 
     n_churn = max(int(T * args.churn), 1)
     churn_rng = np.random.default_rng(7)
@@ -89,14 +162,15 @@ def main() -> None:
         wall = time.time() - t0
         p4t = np.asarray(res.provider_for_task)
         pos = p4t[p4t >= 0]
-        print(json.dumps({
-            "step": step, "kind": "warm", "wall_s": round(wall, 1),
+        emit({
+            "step": step, "kind": "warm", "engine": "jax",
+            "wall_s": round(wall, 1),
             "assigned": int((p4t >= 0).sum()),
             "injective": bool(np.unique(pos).size == pos.size),
             "retired": int(np.asarray(retired).sum()),
             "price_max": round(float(np.asarray(price).max()), 3),
             "stall_exit": stats.get("stall_exit"),
-        }), flush=True)
+        })
 
 
 if __name__ == "__main__":

@@ -356,3 +356,44 @@ class TestCandidateMemo:
         assert memo.hits == 2  # a hit twice; b/c were misses
         memo.get(*b, CostWeights(), **kw)  # b was evicted -> miss
         assert memo.misses == 4
+
+
+class TestDirtySlots:
+    """PreparedSolve.dirty_slots: the warm-retirement-carry invalidation
+    signal (ADVICE r5 — a task must not stay retired after churn changes
+    its candidate list)."""
+
+    def test_first_prepare_reports_none(self):
+        cache = mk_cache()
+        prep = cache.prepare([pitem("a"), pitem("b")], [titem("t", 2)])
+        assert prep.dirty_slots is None  # no reference yet: all-dirty
+
+    def test_unchanged_population_is_clean(self):
+        cache = mk_cache()
+        providers = [pitem("a"), pitem("b")]
+        tasks = [titem("t", 2)]
+        cache.prepare(providers, tasks)
+        prep = cache.prepare(providers, tasks)
+        assert prep.dirty_slots is not None
+        assert not prep.dirty_slots.any()
+
+    def test_new_provider_dirties_merged_slots(self):
+        cache = mk_cache()
+        tasks = [titem("t", 2)]
+        cache.prepare([pitem("a"), pitem("b")], tasks)
+        prep = cache.prepare([pitem("a"), pitem("b"), pitem("c")], tasks)
+        # k=8 > fleet: the newcomer enters every slot's list
+        assert prep.dirty_slots is not None
+        assert prep.dirty_slots[: prep.num_slots].all()
+
+    def test_departure_dirties_slots(self):
+        cache = mk_cache()
+        tasks = [titem("t", 2)]
+        fleet = [pitem(f"p{i}") for i in range(8)]
+        cache.prepare(fleet, tasks)
+        # one departure stays under the compaction threshold (no rebuild:
+        # dirty_slots must come from the content comparison, not a reset)
+        prep = cache.prepare(fleet[:-1], tasks)
+        assert not prep.rebuilt
+        assert prep.dirty_slots is not None
+        assert prep.dirty_slots[: prep.num_slots].all()

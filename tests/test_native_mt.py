@@ -1,0 +1,257 @@
+"""Multi-threaded native engine (engine=native-mt): thread-count
+invariance, quality parity with the Gauss-Seidel engine, and the
+persistent warm-solve arena's only-dirty-rows-recomputed contract.
+
+The -mt engine is DETERMINISTIC by construction (synchronous Jacobi
+bidding rounds merged by a value-based reduction): the matching must be
+bit-identical for every thread count, which is what makes a threads=4
+production deployment debuggable against a threads=1 repro.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.ops.cost import CostWeights
+
+from tests.test_sparse import encode_random_marketplace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+N = 512
+
+
+def _total_cost(cand_p, cand_c, p4t):
+    """Sum of each assigned task's cost on its provider (looked up in the
+    candidate list — the only cost surface the auction ever sees)."""
+    total = 0.0
+    for t, p in enumerate(p4t):
+        if p < 0:
+            continue
+        (j,) = np.where(cand_p[t] == p)[:1]
+        total += float(cand_c[t, j[0]])
+    return total
+
+
+def _dense_candidates():
+    rng = np.random.default_rng(0)
+    cost = rng.uniform(0.0, 10.0, size=(N, N)).astype(np.float32)
+    return native.topk_candidates(cost, k=64)
+
+
+def _sparse_candidates():
+    ep, er = encode_random_marketplace(7, N, N)
+    return native.fused_topk_candidates(
+        ep, er, CostWeights(), k=16, reverse_r=8, extra=16
+    )
+
+
+class TestThreadParity:
+    @pytest.mark.parametrize("threads", [1, 2, 4])
+    @pytest.mark.parametrize("case", ["dense", "sparse"])
+    def test_identical_assignments_and_cost(self, case, threads):
+        cand_p, cand_c = (
+            _dense_candidates() if case == "dense" else _sparse_candidates()
+        )
+        ref, ref_price, ref_retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=N, threads=1
+        )
+        got, price, retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=N, threads=threads
+        )
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(price, ref_price)
+        np.testing.assert_array_equal(retired, ref_retired)
+        assert _total_cost(cand_p, cand_c, got) == _total_cost(
+            cand_p, cand_c, ref
+        )
+
+    @pytest.mark.parametrize("case", ["dense", "sparse"])
+    def test_quality_parity_with_gauss_seidel_engine(self, case):
+        """The Jacobi engine is a different (deterministic) bidding
+        schedule, not a different problem: its matching must be as
+        complete as the Gauss-Seidel engine's and economically close."""
+        cand_p, cand_c = (
+            _dense_candidates() if case == "dense" else _sparse_candidates()
+        )
+        p4t_gs = native.auction_sparse(cand_p, cand_c, num_providers=N)
+        p4t_mt, _, _ = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=N, threads=2
+        )
+        n_gs = int((p4t_gs >= 0).sum())
+        n_mt = int((p4t_mt >= 0).sum())
+        assert n_mt >= n_gs - max(2, N // 100)
+        pos = p4t_mt[p4t_mt >= 0]
+        assert np.unique(pos).size == pos.size  # a matching, always
+        if n_gs == n_mt and n_gs > 0:
+            c_gs = _total_cost(cand_p, cand_c, p4t_gs)
+            c_mt = _total_cost(cand_p, cand_c, p4t_mt)
+            assert c_mt <= c_gs * 1.05 + 1.0
+
+    @pytest.mark.parametrize("threads", [2, 4])
+    def test_identical_above_parallel_threshold(self, threads):
+        """The engine only engages its helper pool when a round has
+        >= kParMin (8192) open tasks — the 512-row cases above all run the
+        inline path, which would let a race or chunk-boundary dependence
+        in the PARALLEL bid pass ship unnoticed. Synthetic candidate
+        lists (no generation cost) push T past the threshold so the pool
+        genuinely runs."""
+        rng = np.random.default_rng(1)
+        T = P = 16384
+        cand_p = rng.integers(0, P, size=(T, 16), dtype=np.int32)
+        cand_c = rng.uniform(0.0, 10.0, size=(T, 16)).astype(np.float32)
+        ref, ref_price, ref_retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=P, threads=1
+        )
+        got, price, retired = native.auction_sparse_mt(
+            cand_p, cand_c, num_providers=P, threads=threads
+        )
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(price, ref_price)
+        np.testing.assert_array_equal(retired, ref_retired)
+
+    @pytest.mark.parametrize("threads", [2, 4])
+    def test_fused_generation_identical(self, threads):
+        ep, er = encode_random_marketplace(3, N, N)
+        ref = native.fused_topk_candidates(
+            ep, er, CostWeights(), k=16, threads=1
+        )
+        st = native.fused_topk_candidates(ep, er, CostWeights(), k=16)
+        got = native.fused_topk_candidates(
+            ep, er, CostWeights(), k=16, threads=threads
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+        # and the mt engine reproduces the historical single-threaded pass
+        np.testing.assert_array_equal(got[0], st[0])
+        np.testing.assert_array_equal(got[1], st[1])
+
+
+class TestWarmArena:
+    def _marketplace(self, seed=0, n=256):
+        ep, er = encode_random_marketplace(seed, n, n)
+        return ep, er
+
+    def test_no_churn_reuses_everything(self, monkeypatch):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace()
+        arena = NativeSolveArena(threads=2)
+        p1 = arena.solve(ep, er, CostWeights())
+        calls = []
+        real = native.fused_topk_candidates
+        monkeypatch.setattr(
+            native, "fused_topk_candidates",
+            lambda *a, **kw: calls.append(a) or real(*a, **kw),
+        )
+        p2 = arena.solve(ep, er, CostWeights())
+        assert calls == []  # byte-identical marketplace: zero regeneration
+        np.testing.assert_array_equal(p1, p2)
+        assert arena.last_stats["changed_rows"] == 0
+        assert arena.last_stats["cold"] is False
+
+    def test_churn_recomputes_only_dirty_rows(self, monkeypatch):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace()
+        n = np.asarray(ep.price).shape[0]
+        arena = NativeSolveArena(threads=2)
+        arena.solve(ep, er, CostWeights())
+
+        # churn 5 providers' price and 3 tasks' priority
+        price = np.array(ep.price, copy=True)
+        price[[3, 50, 99, 120, 200]] += 0.5
+        ep2 = dataclasses.replace(ep, price=price)
+        prio = np.array(er.priority, copy=True)
+        prio[[7, 8, 9]] += 0.25
+        er2 = dataclasses.replace(er, priority=prio)
+
+        shapes = []
+        real = native.fused_topk_candidates
+        monkeypatch.setattr(
+            native, "fused_topk_candidates",
+            lambda p, r, *a, **kw: shapes.append(
+                (np.asarray(p.price).shape[0], np.asarray(r.priority).shape[0])
+            )
+            or real(p, r, *a, **kw),
+        )
+        p4t = arena.solve(ep2, er2, CostWeights())
+        stats = arena.last_stats
+        assert stats["cold"] is False
+        assert stats["dirty_providers"] == 5
+        assert stats["dirty_tasks"] == 3
+        # exactly two delta passes: [full-P x 3 dirty tasks] and
+        # [5 dirty providers x full-T] — never the full [P x T] pass
+        assert sorted(shapes) == sorted([(n, 3), (5, n)])
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size
+
+    def test_heavy_churn_falls_back_to_cold(self):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace()
+        arena = NativeSolveArena(threads=2, max_dirty_frac=0.1)
+        arena.solve(ep, er, CostWeights())
+        price = np.array(ep.price, copy=True)
+        price += 0.01  # every provider dirty
+        p4t = arena.solve(dataclasses.replace(ep, price=price), er, CostWeights())
+        assert arena.last_stats["cold"] is True
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size
+
+    def test_matcher_engages_arena(self):
+        """TpuBatchMatcher(native_engine='native-mt') routes phase 1
+        through the arena and reports its reuse stats."""
+        import random
+
+        from protocol_tpu.models.task import (
+            SchedulingConfig,
+            Task,
+            TaskRequest,
+        )
+        from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+        from protocol_tpu.store import (
+            NodeStatus,
+            OrchestratorNode,
+            StoreContext,
+        )
+        from tests.test_encoding import random_specs
+
+        rng = random.Random(5)
+        store = StoreContext.new_test()
+        for i in range(12):
+            store.node_store.add_node(
+                OrchestratorNode(
+                    address=f"0xmt{i:02d}",
+                    status=NodeStatus.HEALTHY,
+                    compute_specs=random_specs(rng),
+                )
+            )
+        store.task_store.add_task(
+            Task.from_request(
+                TaskRequest(
+                    name="mt-b",
+                    image="img",
+                    scheduling_config=SchedulingConfig(
+                        plugins={"tpu_scheduler": {"replicas": ["4"]}}
+                    ),
+                )
+            )
+        )
+        m = TpuBatchMatcher(
+            store, min_solve_interval=0.0, native_fallback=True,
+            native_engine="native-mt", native_threads=2,
+        )
+        m.refresh()
+        assert m.last_solve_stats["kernel"] == "native_cpu_mt"
+        assert m.last_solve_stats["arena_cold"] is True
+        first = dict(m._assignment)
+        m.mark_dirty()
+        m.refresh()
+        assert m.last_solve_stats["arena_cold"] is False
+        assert m.last_solve_stats["arena_changed_rows"] == 0
+        assert m._assignment == first  # steady state: no flapping

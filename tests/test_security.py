@@ -340,3 +340,101 @@ class TestEvmScheme:
         assert not verify_signature("m", "0x" + "00" * 65, w.address)
         assert not verify_signature("m", "0x" + "ff" * 65, w.address)
         assert not verify_signature("m", "0xzz", w.address)
+
+
+class TestChallengeSizedBodies:
+    """ADVICE r5: the hardware-challenge body (~254 KB of matrices at
+    challenge_size=64) exceeded the EVM schemes' 64 KB keccak signing cap,
+    so sign_request raised mid-tick and no node ever got validated under
+    PROTOCOL_TPU_WALLET_SCHEME=evm. Oversized bodies now sign a sha256
+    digest of the canonical JSON (x-body-digest header); every scheme must
+    round-trip a challenge-sized body through signer AND middleware."""
+
+    @staticmethod
+    def _challenge_payload():
+        import numpy as np
+
+        from protocol_tpu.utils import fixedf64
+
+        rng = np.random.default_rng(0)
+        n = 64
+        a = fixedf64.roundtrip(
+            rng.standard_normal((n, n), dtype=np.float32)
+        ).astype(np.float32)
+        b = fixedf64.roundtrip(
+            rng.standard_normal((n, n), dtype=np.float32)
+        ).astype(np.float32)
+        return {
+            "matrix_a_fixed": fixedf64.encode_array(a),
+            "matrix_b_fixed": fixedf64.encode_array(b),
+            "matrix_a": a.tolist(),
+            "matrix_b": b.tolist(),
+        }
+
+    def test_signer_roundtrip(self, wallet_cls):
+        from protocol_tpu.security.signer import (
+            BODY_DIGEST_HEADER,
+            BODY_DIGEST_THRESHOLD,
+        )
+
+        w = wallet_cls()
+        payload = self._challenge_payload()
+        assert len(canonical_json(payload)) > BODY_DIGEST_THRESHOLD
+        headers, body = sign_request("/control/challenge", w, payload)
+        assert headers.get(BODY_DIGEST_HEADER) == "sha256"
+        assert verify_request("/control/challenge", headers, body) == w.address.lower()
+
+    def test_tampered_digest_body_rejected(self, wallet_cls):
+        w = wallet_cls()
+        headers, body = sign_request(
+            "/control/challenge", w, self._challenge_payload()
+        )
+        body["matrix_a_fixed"][0][0] += 1
+        assert verify_request("/control/challenge", headers, body) is None
+
+    def test_stripped_digest_header_rejected(self, wallet_cls):
+        from protocol_tpu.security.signer import BODY_DIGEST_HEADER
+
+        w = wallet_cls()
+        headers, body = sign_request(
+            "/control/challenge", w, self._challenge_payload()
+        )
+        stripped = {k: v for k, v in headers.items() if k != BODY_DIGEST_HEADER}
+        assert verify_request("/control/challenge", stripped, body) is None
+
+    def test_small_bodies_keep_the_raw_json_wire(self, wallet_cls):
+        # wire compatibility: below the threshold nothing changes (an
+        # unupgraded peer's verifier still reconstructs endpoint+ts+json)
+        from protocol_tpu.security.signer import BODY_DIGEST_HEADER
+
+        w = wallet_cls()
+        headers, body = sign_request("/signed/echo", w, {"hello": 1})
+        assert BODY_DIGEST_HEADER not in headers
+        assert verify_request("/signed/echo", headers, body) == w.address.lower()
+
+    def test_middleware_passes_challenge_sized_body(self, wallet_cls):
+        # the worker-side verify path (middleware -> verify_request): a
+        # challenge-sized signed body authenticates end to end
+        kv = KVStore()
+        w = wallet_cls()
+        headers, body = sign_request("/signed/echo", w, self._challenge_payload())
+        status, data = run(
+            _request(make_app(kv), "POST", "/signed/echo", headers, body)
+        )
+        assert status == 200 and data["address"] == w.address
+
+    def test_unsignable_body_fails_challenge_not_tick(self):
+        # challenge_node catches a signing ValueError: one bad challenge
+        # returns False instead of aborting validation_loop_once
+        from protocol_tpu.chain import Ledger
+        from protocol_tpu.services.validator import ValidatorService
+
+        class RefusingWallet(Wallet):
+            def sign_message(self, message):
+                raise ValueError("over the signing cap")
+
+        svc = ValidatorService(
+            RefusingWallet(), Ledger(), pool_id=0, http=None
+        )
+        ok = run(svc.challenge_node("http://127.0.0.1:1"))
+        assert ok is False

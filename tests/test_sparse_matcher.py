@@ -7,6 +7,7 @@ seam — and item 3: consecutive solves must warm-start from carried prices
 and the previous matching (the delta-frontier incremental path).
 """
 
+import numpy as np
 import pytest
 
 from protocol_tpu.models import (
@@ -377,3 +378,66 @@ class TestMeshMatcher:
             return s["assigned"]
 
         assert solve(True) == solve(False) == 40
+
+
+class TestWarmRetirementInvalidation:
+    """ADVICE r5 (tpu_backend warm-retirement carry): incremental churn
+    updates cached candidate lists without renumbering slots, so the
+    carried retirement mask used to survive with stale flags — a task
+    stayed retired after a newly-feasible provider appeared, until the
+    next cold solve. The CandidateCache's dirty_slots now clears exactly
+    the churned rows. The carried mask is injected directly (organic
+    give-up retirement needs a long price war; the kernel's own
+    retirement behavior is covered by the sparse kernel tests) — what's
+    under test is the carry/invalidation plumbing."""
+
+    def _spy_retired0(self, m, captured):
+        orig = m._sparse_solve
+
+        def spy(*args, **kwargs):
+            captured.append(kwargs.get("retired0"))
+            return orig(*args, **kwargs)
+
+        m._sparse_solve = spy
+
+    def _cold_solved_matcher(self):
+        ctx = StoreContext.new_test()
+        # demand 4 replicas on a 2-node fleet: two slots stay unseated
+        populate(ctx, 2, [mk_bounded_task("t", 100, replicas=4)])
+        m = TpuBatchMatcher(ctx, dense_cell_budget=0, min_solve_interval=0)
+        m.refresh()
+        assert m.last_solve_stats["assigned"] == 2
+        return ctx, m
+
+    def test_unchanged_population_keeps_carried_retirement(self):
+        ctx, m = self._cold_solved_matcher()
+        m._warm_retired = np.ones_like(np.asarray(m._warm_retired))
+        captured = []
+        self._spy_retired0(m, captured)
+        m.mark_dirty()
+        m.refresh()
+        assert m.last_solve_stats["warm"] is True
+        # clean population: the carry is the whole point — flags survive
+        retired0 = captured[0]
+        assert retired0 is not None
+        assert bool(np.asarray(retired0).all())
+
+    def test_churn_clears_carried_retirement(self):
+        ctx, m = self._cold_solved_matcher()
+        m._warm_retired = np.ones_like(np.asarray(m._warm_retired))
+        # a new node churns into every slot's candidate list (k > fleet)
+        ctx.node_store.add_node(mk_node("0xnew"))
+        captured = []
+        self._spy_retired0(m, captured)
+        m.mark_dirty()
+        m.refresh()
+        assert m.last_solve_stats["warm"] is True
+        # the mask handed to the warm kernel must not carry flags over
+        # slots whose candidates changed (here: all of them) — pre-fix,
+        # slot_fp matched and the stale mask rode through unchanged
+        assert len(captured) == 1
+        retired0 = captured[0]
+        assert retired0 is None or not bool(np.asarray(retired0).any())
+        # and the newly-feasible node is assigned THIS solve, not after
+        # the next cold one
+        assert m.last_solve_stats["assigned"] == 3
