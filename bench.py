@@ -218,6 +218,183 @@ def bench_native_mt(ep, er, threads: int, iters: int, st_total: float) -> dict:
     }
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _churn_providers(p_cols, rng, churn: float) -> None:
+    """Mutate ~churn of the provider rows in place (price + load — the
+    per-heartbeat drift every real fleet reports)."""
+    n = p_cols["price"].shape[0]
+    rows = rng.choice(n, max(1, int(n * churn)), replace=False)
+    p_cols["price"][rows] = rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+    p_cols["load"][rows] = rng.uniform(0, 1, rows.size).astype(np.float32)
+
+
+def run_wire_bench(
+    P: int = 16384,
+    T: int = 16384,
+    churn: float = 0.01,
+    ticks: int = 5,
+    warmup: int = 3,
+    threads: int = 0,
+    seed: int = 0,
+    chunk_bytes: int = 1 << 20,
+    modes: tuple = ("v1", "v2"),
+) -> dict:
+    """Loopback wire-path benchmark: the scheduler seam end-to-end
+    (client serialize + RPC + server decode + warm native-mt solve) under
+    steady-state churn, v1 full-snapshot unary vs v2 delta sessions.
+
+    Both modes run against a FRESH server with the same synthetic
+    marketplace and the same churn sequence (same rng seeds): one untimed
+    cold tick, then ``warmup`` untimed churn ticks (the post-cold
+    adaptation transient, where contested near-tie seats price out), then
+    ``ticks`` timed steady-state ticks. The difference between modes is
+    pure wire protocol — the warm solve behind both is the same arena.
+    Returns per-tick wall/bytes/assigned per mode plus the v1/v2 speedup
+    and bytes ratio, and the server-side seam metrics scraped from
+    Health."""
+    from protocol_tpu.ops.cost import CostWeights
+    from protocol_tpu.proto import scheduler_pb2 as pbs
+    from protocol_tpu.proto import wire as wirelib
+    from protocol_tpu.services.scheduler_grpc import (
+        SchedulerBackendClient,
+        encoded_to_proto,
+        encoded_to_proto_v2,
+        serve,
+    )
+
+    kernel = f"native-mt:{threads}" if threads else "native-mt"
+    w = CostWeights()
+    out: dict = {
+        "P": P, "T": T, "churn": churn, "ticks": ticks,
+        "kernel": kernel, "modes": {},
+    }
+    for mode in modes:
+        port = _free_port()
+        server = serve(f"127.0.0.1:{port}")
+        client = SchedulerBackendClient(f"127.0.0.1:{port}")
+        rng = np.random.default_rng(seed)
+        ep = synth_providers(rng, P)
+        er = synth_requirements(rng, T)
+        p_cols = wirelib.canon_columns(ep, wirelib.P_WIRE_DTYPES)
+        r_cols = wirelib.canon_columns(er, wirelib.R_WIRE_DTYPES)
+        full = wirelib.take_rows  # ns view over all rows
+        churn_rng = np.random.default_rng(seed + 1)
+        tick_ms: list[float] = []
+        tick_bytes: list[int] = []
+        tick_assigned: list[int] = []
+        if mode == "v1":
+            # untimed cold tick: arena build + jit-free native warmup
+            req = encoded_to_proto(
+                full(p_cols, slice(None)), full(r_cols, slice(None)), w,
+                kernel=kernel, top_k=64, eps=0.02,
+            )
+            client.assign(req, timeout=600)
+            for i in range(warmup + ticks):
+                _churn_providers(p_cols, churn_rng, churn)
+                t0 = time.perf_counter()
+                req = encoded_to_proto(
+                    full(p_cols, slice(None)), full(r_cols, slice(None)),
+                    w, kernel=kernel, top_k=64, eps=0.02,
+                )
+                resp = client.assign(req, timeout=600)
+                if i < warmup:
+                    continue
+                tick_ms.append((time.perf_counter() - t0) * 1e3)
+                tick_bytes.append(req.ByteSize() + resp.ByteSize())
+                tick_assigned.append(int(resp.num_assigned))
+        else:
+            fp = wirelib.epoch_fingerprint(
+                p_cols, r_cols, w, kernel, 64, 0.02, 0
+            )
+            reqv2 = encoded_to_proto_v2(
+                full(p_cols, slice(None)), full(r_cols, slice(None)), w,
+                kernel=kernel, top_k=64, eps=0.02,
+            )
+            resp = client.open_session(
+                wirelib.chunk_snapshot(
+                    "bench", fp, reqv2, chunk_bytes=chunk_bytes
+                ),
+                timeout=600,
+            )
+            assert resp.ok, resp.error
+            prev = {k: v.copy() for k, v in p_cols.items()}
+            for tick in range(1, warmup + ticks + 1):
+                _churn_providers(p_cols, churn_rng, churn)
+                t0 = time.perf_counter()
+                # the timed tick includes the client-side churn scan: the
+                # column diff is part of what v2 pays that v1 does not
+                rows = wirelib.dirty_rows(p_cols, prev)
+                dreq = pbs.AssignDeltaRequest(
+                    session_id="bench", epoch_fingerprint=fp, tick=tick
+                )
+                if rows.size:
+                    dreq.provider_rows.CopyFrom(wirelib.blob(rows, np.int32))
+                    dreq.providers.CopyFrom(
+                        wirelib.encode_providers_v2(
+                            wirelib.take_rows(p_cols, rows)
+                        )
+                    )
+                dresp = client.assign_delta(dreq, timeout=600)
+                assert dresp.session_ok, dresp.error
+                prev = {k: v.copy() for k, v in p_cols.items()}
+                if tick <= warmup:
+                    continue
+                tick_ms.append((time.perf_counter() - t0) * 1e3)
+                tick_bytes.append(dreq.ByteSize() + dresp.ByteSize())
+                tick_assigned.append(int(dresp.result.num_assigned))
+        h = client.health()
+        seam = {s.name: s.value for s in h.seam_metrics}
+        out["modes"][mode] = {
+            "tick_ms": [round(x, 2) for x in tick_ms],
+            "mean_tick_ms": round(sum(tick_ms) / len(tick_ms), 2),
+            "median_tick_ms": round(float(np.median(tick_ms)), 2),
+            "min_tick_ms": round(min(tick_ms), 2),
+            "mean_tick_bytes": int(sum(tick_bytes) / len(tick_bytes)),
+            "tick_assigned": tick_assigned,
+            "server_seam": seam,
+        }
+        log(
+            f"wire={mode}: mean {out['modes'][mode]['mean_tick_ms']:.1f} "
+            f"ms/tick, {out['modes'][mode]['mean_tick_bytes']:,} B/tick"
+        )
+        client.close()
+        server.stop(grace=None)
+    if "v1" in out["modes"] and "v2" in out["modes"]:
+        # the headline (and CI-gated) speedup is MEDIAN tick vs median
+        # tick: the warm arena's dual-refresh cycle makes individual
+        # ticks bimodal (fast shielded ticks vs post-refresh adaptation
+        # ticks), and a mean over a short window is noisy about where
+        # the cycle landed. The mean-based number rides along.
+        v1md = out["modes"]["v1"]["median_tick_ms"]
+        v2md = out["modes"]["v2"]["median_tick_ms"]
+        out["v2_speedup"] = round(v1md / v2md, 2)
+        out["v2_speedup_mean"] = round(
+            out["modes"]["v1"]["mean_tick_ms"]
+            / out["modes"]["v2"]["mean_tick_ms"],
+            2,
+        )
+        out["v2_bytes_ratio"] = round(
+            out["modes"]["v1"]["mean_tick_bytes"]
+            / max(out["modes"]["v2"]["mean_tick_bytes"], 1),
+            1,
+        )
+        log(
+            f"wire v2 delta tick: {out['v2_speedup']}x faster (median; "
+            f"mean {out['v2_speedup_mean']}x), "
+            f"{out['v2_bytes_ratio']}x fewer bytes than v1 full snapshot"
+        )
+    return out
+
+
 def device_healthy(timeout: float = 120.0) -> bool:
     """Probe the default backend with a wall-clock bound, in a SUBPROCESS:
     the remote-TPU tunnel can wedge (ops hang indefinitely), and a hung
@@ -256,6 +433,52 @@ def parse_kv_args(argv: list[str]) -> dict[str, str]:
 def main() -> None:
     global P, T, TILE
     args = parse_kv_args(sys.argv[1:])
+    wire = args.get("wire")
+    if wire:
+        # wire=v1|v2|both: loopback wire-path bench (the scheduler seam
+        # itself, not the kernel) — steady-state churn ticks over gRPC
+        if wire not in ("v1", "v2", "both"):
+            raise SystemExit(f"unknown wire mode {wire!r} (want v1|v2|both)")
+        jax.config.update("jax_platforms", "cpu")
+        modes = ("v1", "v2") if wire == "both" else (wire,)
+        res = run_wire_bench(
+            P=int(args.get("p", "16384")),
+            T=int(args.get("t", "16384")),
+            churn=float(args.get("churn", "0.01")),
+            ticks=int(args.get("ticks", "5")),
+            warmup=int(args.get("warmup", "3")),
+            threads=int(args.get("threads", "0") or 0),
+            modes=modes,
+        )
+        out_path = args.get("out")
+        if out_path:
+            with open(out_path, "w") as fh:
+                json.dump(res, fh, indent=1)
+            log(f"wrote {out_path}")
+        if wire == "both":
+            print(json.dumps({
+                "metric": (
+                    f"wire_v2_delta_tick_speedup_{res['P']}x{res['T']}_"
+                    f"churn{res['churn']}"
+                ),
+                "value": res["v2_speedup"],
+                "unit": "x_vs_v1_full_snapshot",
+                "bytes_ratio": res["v2_bytes_ratio"],
+                "v1_mean_tick_ms": res["modes"]["v1"]["mean_tick_ms"],
+                "v2_mean_tick_ms": res["modes"]["v2"]["mean_tick_ms"],
+            }))
+        else:
+            m = res["modes"][wire]
+            print(json.dumps({
+                "metric": (
+                    f"wire_{wire}_tick_{res['P']}x{res['T']}_"
+                    f"churn{res['churn']}"
+                ),
+                "value": m["mean_tick_ms"],
+                "unit": "ms_per_tick",
+                "mean_tick_bytes": m["mean_tick_bytes"],
+            }))
+        return
     engine = args.get("engine", "native")
     if engine not in ("native", "native-mt"):
         raise SystemExit(f"unknown engine {engine!r} (want native|native-mt)")

@@ -1033,12 +1033,35 @@ int32_t auction_sparse(const int32_t* cand_provider, const float* cand_cost,
 // p4t_seed:   [T] i32 or null — previous matching to re-seat (must be
 //             injective over >= 0); seeds violating eps-CS are evicted by
 //             the repair pass at each phase start.
+// max_release: > 0 caps how many seated tasks the eps-CS repair may evict
+//             per repair pass — the WORST violators (largest eps-CS
+//             margin, ties to the lowest task index) go first, the rest
+//             keep their now-suboptimal seats until a later solve. This
+//             bounds the warm re-bidding wave under heavy drift (a mass
+//             eviction degenerates a warm solve into a fine-eps cold
+//             auction); the matching stays feasible and injective, and
+//             staleness is amortized: each repair re-ranks the
+//             violations it SCANS (all rows, or the repair_mask subset)
+//             and releases the current worst. A caller combining the cap
+//             with repair_mask must evict infeasible seats itself (a
+//             capped-out violator whose row stops churning leaves the
+//             mask — see arena.py's feasibility guard). <= 0 releases
+//             every violator (the historical behavior).
+// repair_mask: [T] u8 or null — rows the eps-CS repair may consider.
+//             Sound because forward-auction prices are monotone: a seat
+//             that was eps-happy at the last convergence can only become
+//             HAPPIER unless its own row's candidate costs changed (v1
+//             falls as rival prices rise; vcur is fixed while held), so
+//             warm callers pass the rows whose costs they touched and
+//             the repair skips the rest of the [T x K] scan. null scans
+//             everything (cold calls / callers without churn tracking).
 // Returns the number of assigned tasks.
 int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
                           int32_t P, int32_t T, int32_t K, float eps_start,
                           float eps_end, float scale, int64_t max_events,
                           int32_t threads, float* price_io, uint8_t* retired_io,
-                          const int32_t* p4t_seed,
+                          const int32_t* p4t_seed, int32_t max_release,
+                          const uint8_t* repair_mask,
                           int32_t* out_provider_for_task) {
   std::vector<float> price(price_io, price_io + P);
   std::vector<int32_t> owner(P, -1);
@@ -1081,6 +1104,9 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
   std::vector<int32_t> bid_p(T);     // per-open-slot bid provider / sentinel
   std::vector<float> bid_inc(T);     // per-open-slot price increment
   std::vector<uint8_t> release(T);   // repair pass: evict flag per task
+  std::vector<float> rel_margin(T);  // eps-CS violation margin (capped mode)
+  std::vector<int32_t> rel_list;     // violator ids for the capped select
+  rel_list.reserve(T);
   std::vector<float> win_inc(P, 0.0f);
   std::vector<int32_t> win_task(P, -1);
   std::vector<int32_t> touched;
@@ -1118,6 +1144,7 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
         release[t] = 0;
         const int32_t held = p4t[t];
         if (held < 0 || retired[t]) continue;
+        if (repair_mask != nullptr && repair_mask[t] == 0) continue;
         float v1 = kNeg, vcur = kNeg;
         const int64_t row = static_cast<int64_t>(t) * K;
         for (int32_t j = 0; j < K; ++j) {
@@ -1128,8 +1155,28 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
           if (p == held) vcur = v;
         }
         release[t] = vcur < v1 - eps;
+        rel_margin[t] = v1 - vcur;
       }
     });
+    if (max_release > 0) {
+      rel_list.clear();
+      for (int32_t t = 0; t < T; ++t) {
+        if (release[t]) rel_list.push_back(t);
+      }
+      if (static_cast<int32_t>(rel_list.size()) > max_release) {
+        // strict weak order with an id tiebreak: the released SET is
+        // deterministic regardless of nth_element's internal order
+        std::nth_element(
+            rel_list.begin(), rel_list.begin() + max_release,
+            rel_list.end(), [&](int32_t a, int32_t b) {
+              if (rel_margin[a] != rel_margin[b])
+                return rel_margin[a] > rel_margin[b];
+              return a < b;
+            });
+        for (size_t i = max_release; i < rel_list.size(); ++i)
+          release[rel_list[i]] = 0;
+      }
+    }
     for (int32_t t = 0; t < T; ++t) {
       if (release[t]) {
         owner[p4t[t]] = -1;

@@ -184,7 +184,10 @@ async def main(argv=None) -> None:
             addr = "127.0.0.1:50061"
             # hold the reference: a dropped grpc.Server is GC'd and stops
             grpc_server = scheduler_grpc.serve(addr)
-        matcher = scheduler_grpc.RemoteBatchMatcher(store, addr)
+        matcher = scheduler_grpc.RemoteBatchMatcher(
+            store, addr,
+            wire=os.environ.get("PROTOCOL_TPU_WIRE", "v2"),
+        )
         matcher.grpc_server = grpc_server
     else:
         matcher = TpuBatchMatcher(store)

@@ -123,7 +123,8 @@ def load() -> ctypes.CDLL:
     lib.auction_sparse_mt.argtypes = [
         i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64,
-        ctypes.c_int32, f32p, u8p, ctypes.c_void_p, i32p,
+        ctypes.c_int32, f32p, u8p, ctypes.c_void_p, ctypes.c_int32,
+        ctypes.c_void_p, i32p,
     ]
     lib.auction_sparse_mt.restype = ctypes.c_int32
     _lib = lib
@@ -279,6 +280,8 @@ def auction_sparse_mt(
     price: Optional[np.ndarray] = None,
     retired: Optional[np.ndarray] = None,
     seed_provider_for_task: Optional[np.ndarray] = None,
+    max_release: int = 0,
+    repair_mask: Optional[np.ndarray] = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic parallel auction (engine=native-mt): synchronous
     Jacobi bidding rounds — per-thread bid buffers against a shared price
@@ -293,6 +296,19 @@ def auction_sparse_mt(
     single-phase solve pass ``eps_start == eps_end``. The caller must
     clear ``retired`` flags for tasks whose candidates changed
     (ops/sparse.py assign_auction_sparse_warm has the same contract).
+
+    ``max_release`` > 0 caps how many seats the eps-CS repair may evict
+    per pass (the worst violators go first, deterministically): under
+    heavy price/load drift an uncapped repair releases thousands of
+    near-tie seats at once and the warm solve degenerates into a
+    fine-eps cold auction. Capped, the re-optimization is amortized
+    across solves while the matching stays feasible and injective.
+    0 keeps the historical release-everything behavior.
+
+    ``repair_mask`` [T] bool restricts the eps-CS repair to rows whose
+    candidate costs the caller changed since the last converged solve —
+    sound because prices are monotone (see the engine comment); None
+    scans every row.
 
     Returns (provider_for_task [T] i32, price [P] f32, retired [T] bool).
     """
@@ -328,10 +344,21 @@ def auction_sparse_mt(
             (seed_arr >= 0) & (seed_arr < num_providers), seed_arr, -1
         ).astype(np.int32)
         seed_ptr = seed_arr.ctypes.data_as(ctypes.c_void_p)
+    mask_ptr = None
+    mask_arr = None
+    if repair_mask is not None:
+        mask_arr = np.ascontiguousarray(
+            np.asarray(repair_mask, bool).astype(np.uint8)
+        )
+        if mask_arr.shape[0] != T:
+            raise ValueError(
+                f"repair_mask has {mask_arr.shape[0]} rows, want {T}"
+            )
+        mask_ptr = mask_arr.ctypes.data_as(ctypes.c_void_p)
     out = np.empty(T, np.int32)
     lib.auction_sparse_mt(
         cand_p, cand_c, num_providers, T, K,
         eps_start, eps_end, scale, max_events, int(threads),
-        price_io, retired_io, seed_ptr, out,
+        price_io, retired_io, seed_ptr, int(max_release), mask_ptr, out,
     )
     return out, price_io, retired_io.astype(bool)

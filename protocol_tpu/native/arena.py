@@ -105,6 +105,9 @@ class NativeSolveArena:
         max_dirty_frac: float = 0.25,
         eps_start: float = 4.0,
         eps_end: float = 0.02,
+        max_release: int = 64,
+        dual_refresh_every: int = 16,
+        warm_eps_start: float = 0.32,
     ):
         self.k = k
         self.reverse_r = reverse_r
@@ -114,6 +117,28 @@ class NativeSolveArena:
         self.max_dirty_frac = max_dirty_frac
         self.eps_start = eps_start
         self.eps_end = eps_end
+        # warm-solve eviction cap (native.auction_sparse_mt max_release):
+        # bounds the per-solve re-bidding wave under drift; re-ranked every
+        # solve so staleness is amortized, and cold_every re-grounds fully
+        self.max_release = max_release
+        # Dual refresh: the warm chain's price ratchet is monotone, so
+        # war losers retire and STAY retired while idle providers
+        # accumulate — measured ~14 lost assignments per tick at 16k
+        # under 1% churn, with no plateau. Every ``dual_refresh_every``
+        # warm solves the auction re-runs with fresh prices/retirement
+        # over the CACHED candidate structure (the expensive part is
+        # kept): cardinality snaps back to the cold solve's level and the
+        # amortized cost is a few tens of ms per tick. cold_every still
+        # re-grounds the structure itself.
+        self.dual_refresh_every = dual_refresh_every
+        # Warm solves open at a COARSE eps and scale down (0.32 -> 0.08 ->
+        # eps_end by the engine's 0.25 scale): evicted seats separate from
+        # rivals in a handful of coarse rounds instead of thousands of
+        # eps_end-increment bidding-war rounds. Measured at 16k/1% churn:
+        # 182 -> 107 ms mean tick at a ~1 point cardinality-floor cost
+        # (the dual refresh re-grounds the floor every cycle). Set to
+        # eps_end for the historical single-fine-phase behavior.
+        self.warm_eps_start = warm_eps_start
         self.last_stats: dict = {}
         self.invalidate()
 
@@ -138,6 +163,7 @@ class NativeSolveArena:
         self._retired: Optional[np.ndarray] = None
         self._p4t: Optional[np.ndarray] = None
         self._warm_solves = 0
+        self._dual_age = 0
 
     # ---------------- internals ----------------
 
@@ -171,6 +197,7 @@ class NativeSolveArena:
         self._cand_p, self._cand_c = cand_p, cand_c
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves = 0
+        self._dual_age = 0
         self.last_stats = {
             "cold": True,
             "dirty_providers": P,
@@ -190,8 +217,9 @@ class NativeSolveArena:
     ) -> np.ndarray:
         """For the task rows in ``rows``: drop dirty providers from the
         cached row, fold the delta pass's candidates (forward + reverse,
-        global ids) back in by current cost, and return the changed mask
-        (aligned with ``rows``). Rows recomputed this solve are excluded
+        global ids) back in by current cost, and return
+        ``(changed, touched)`` masks aligned with ``rows`` (``touched``
+        feeds the auction's repair_mask; ``changed`` clears retirement). Rows recomputed this solve are excluded
         by the caller — re-merging them would duplicate dirty providers
         inside one candidate list (a dup makes v1 == v2 in the bid math)."""
         cand_p = self._cand_p[rows]
@@ -199,28 +227,73 @@ class NativeSolveArena:
         in_dirty = np.zeros(self._price.shape[0], bool)
         in_dirty[dirty_p_idx] = True
         stale = (cand_p >= 0) & in_dirty[np.maximum(cand_p, 0)]
-        masked_p = np.where(stale, -1, cand_p)
+        dp = delta_p[rows]
+        dc = delta_c[rows]
+        # only rows that TOUCH a dirty provider (hold one in the cached
+        # list, or receive one from the delta pass) can change: merge and
+        # compare just those — at 1% churn that is a few percent of T,
+        # not all of it
+        touch = stale.any(axis=1) | (dp >= 0).any(axis=1)
+        changed = np.zeros(rows.size, bool)
+        t_idx = np.flatnonzero(touch)
+        if t_idx.size == 0:
+            return changed, touch
+        cand_p_t = cand_p[t_idx]
+        cand_c_t = cand_c[t_idx]
+        stale_t = stale[t_idx]
+        masked_p = np.where(stale_t, -1, cand_p_t)
 
-        allp = np.concatenate([masked_p, delta_p[rows]], axis=1)
-        allc = np.concatenate([cand_c, delta_c[rows]], axis=1)
+        allp = np.concatenate([masked_p, dp[t_idx]], axis=1)
+        allc = np.concatenate([cand_c_t, dc[t_idx]], axis=1)
         key = np.where(allp >= 0, allc, np.inf)
         k_eff = cand_p.shape[1]
         idx = np.argsort(key, axis=1, kind="stable")[:, :k_eff]
         new_p = np.take_along_axis(allp, idx, axis=1).astype(np.int32)
         new_c = np.take_along_axis(allc, idx, axis=1).astype(np.float32)
         new_c[new_p < 0] = 0.0
-        # changed = provider set/order moved OR a kept candidate got
-        # materially CHEAPER (same row, lower cost — e.g. a price drop
-        # that doesn't re-rank): both can make a retired task viable
-        # again, so both must clear its carried flag. Increases cannot
+
+        # Change detection is ORDER-INSENSITIVE. The merge's argsort
+        # reshuffles positions even when a row's candidate content is
+        # untouched (reverse-edge extras are appended unsorted, so the
+        # first merge re-sorts every row); a position-wise compare
+        # cleared ~100% of the retirement carry at 16k under 1% price
+        # churn and the warm auction degenerated to cold-solve work.
+        # What can make a retired task viable again is exactly: (a) a
+        # dirty provider ENTERING or moving within its candidate set
+        # (dirty membership differs), or (b) a kept candidate getting
+        # materially CHEAPER (aligned compare after sorting both lists by
+        # provider id). Pure cost increases and pure losses cannot
         # un-retire; the 0.05 floor matches the CandidateCache's
         # stale_abs_tol ("drift big enough to matter").
-        changed = (new_p != cand_p).any(axis=1) | (
-            (cand_c - new_c) > 0.05
+        big = np.int32(np.iinfo(np.int32).max)
+        old_dirty = np.where(stale_t, cand_p_t, big)
+        new_dirty = np.where(
+            (new_p >= 0) & in_dirty[np.maximum(new_p, 0)], new_p, big
+        )
+        old_dirty.sort(axis=1)
+        new_dirty.sort(axis=1)
+        member_changed = (old_dirty != new_dirty).any(axis=1)
+        # when dirty membership is unchanged the full membership is too
+        # (non-dirty entries only ever leave by being displaced by an
+        # entering dirty one), so the id-sorted aligned compare is exact
+        o_ord = np.lexsort((cand_c_t, cand_p_t), axis=1)
+        n_ord = np.lexsort((new_c, new_p), axis=1)
+        op = np.take_along_axis(cand_p_t, o_ord, axis=1)
+        oc = np.take_along_axis(cand_c_t, o_ord, axis=1)
+        npp = np.take_along_axis(new_p, n_ord, axis=1)
+        ncc = np.take_along_axis(new_c, n_ord, axis=1)
+        # op >= 0: empty slots carry sentinel costs (kInfeasible on fresh
+        # rows, 0.0 after a merge rewrite) — without the guard a -1==-1
+        # alignment reads as a 1e9 price drop and spuriously un-retires
+        # every touched row on its first merge
+        cheaper = (
+            (op == npp) & (op >= 0) & ((oc - ncc) > 0.05)
         ).any(axis=1)
-        self._cand_p[rows] = new_p
-        self._cand_c[rows] = new_c
-        return changed
+
+        self._cand_p[rows[t_idx]] = new_p
+        self._cand_c[rows[t_idx]] = new_c
+        changed[t_idx] = member_changed | cheaper
+        return changed, touch
 
     # ---------------- the solve ----------------
 
@@ -256,10 +329,26 @@ class NativeSolveArena:
 
         dirty_p = _dirty_rows(pf, self._p_fields, _P_SPEC)
         dirty_t = _dirty_rows(rf, self._r_fields, _R_SPEC)
-        n_dp, n_dt = int(dirty_p.sum()), int(dirty_t.sum())
+        # split provider churn by WHAT changed: price/load-only drift
+        # ("base churn" — the per-heartbeat common case) shifts a
+        # provider's whole cost column uniformly (cost = base + static,
+        # ops/cost.py invariant), so every cached candidate entry can be
+        # updated IN PLACE with one gather-add — no delta pass, no merge,
+        # no membership change. Only structural churn (specs, location,
+        # validity) needs the [dirty-P x T] regeneration. Base drift does
+        # leave candidate SELECTION stale (a repriced provider keeps its
+        # old edges); cold_every bounds that, same as the CandidateCache's
+        # periodic re-ground.
+        struct_dirty_p = _dirty_rows(
+            pf, self._p_fields,
+            [s for s in _P_SPEC if s[0] not in ("price", "load")],
+        )
+        base_only = dirty_p & ~struct_dirty_p
+        n_dp, n_dt = int(struct_dirty_p.sum()), int(dirty_t.sum())
+        n_base = int(base_only.sum())
         if (n_dp + n_dt) / (P + T) > self.max_dirty_frac:
             return self._cold(ep, er, weights, pf, rf, P, T)
-        if n_dp == 0 and n_dt == 0:
+        if n_dp == 0 and n_dt == 0 and n_base == 0:
             # byte-identical marketplace: the carried matching IS the
             # solve (prices/retirement already consistent with it)
             self._warm_solves += 1
@@ -273,8 +362,33 @@ class NativeSolveArena:
             }
             return self._p4t.copy()
 
+        old_price = self._p_fields["price"]
+        old_load = self._p_fields["load"]
         self._p_fields, self._r_fields = pf, rf
         changed = dirty_t.copy()
+        # rows whose candidate COSTS move this solve, in either direction:
+        # the only rows whose eps-CS happiness can degrade (prices are
+        # monotone), so the only rows the warm repair needs to scan
+        repair = dirty_t.copy()
+
+        # ---- base-only drift: shift cached costs in place (one gather)
+        if n_base:
+            db = np.zeros(P, np.float32)
+            b_idx = np.flatnonzero(base_only)
+            db[b_idx] = (
+                np.float32(weights.price) * (pf["price"][b_idx] - old_price[b_idx])
+                + np.float32(weights.load) * (pf["load"][b_idx] - old_load[b_idx])
+            )
+            cp_safe = np.maximum(self._cand_p, 0)
+            entry_db = np.where(self._cand_p >= 0, db[cp_safe], 0.0)
+            self._cand_c += entry_db
+            repair |= (entry_db != 0.0).any(axis=1)
+            # a provider that got materially CHEAPER can un-retire every
+            # task holding it as a candidate; pricier/flat drift cannot
+            cheap = db < -0.05
+            changed |= (
+                (self._cand_p >= 0) & cheap[cp_safe]
+            ).any(axis=1)
 
         # ---- dirty tasks: fresh fused pass against the full fleet
         if n_dt:
@@ -295,7 +409,7 @@ class NativeSolveArena:
         # ---- dirty providers: one [dirty-P x T] delta pass, merged into
         # every row NOT already recomputed above
         if n_dp:
-            p_idx = np.flatnonzero(dirty_p)
+            p_idx = np.flatnonzero(struct_dirty_p)
             sub_ep = _subset(pf, p_idx, _P_SPEC)
             kd = min(self.k, n_dp)
             dp_local, dc = native.fused_topk_candidates(
@@ -309,24 +423,64 @@ class NativeSolveArena:
             ).astype(np.int32)
             keep_rows = np.flatnonzero(~dirty_t)
             if keep_rows.size:
-                changed[keep_rows] |= self._merge_delta(
+                merge_changed, merge_touched = self._merge_delta(
                     keep_rows, p_idx, dp, dc
                 )
+                changed[keep_rows] |= merge_changed
+                repair[keep_rows] |= merge_touched
 
-        # ---- warm auction over the carried dual state
-        retired = self._retired & ~changed
-        p4t, price, retired = native.auction_sparse_mt(
-            self._cand_p, self._cand_c, num_providers=P,
-            eps_start=self.eps_end, eps_end=self.eps_end,
-            threads=self.threads,
-            price=self._price, retired=retired,
-            seed_provider_for_task=self._p4t,
+        # ---- feasibility guard: a seat whose provider left the row's
+        # candidate list (struct churn dropped it, or an entering cheaper
+        # provider displaced it in the merge) must be unseated HERE, not
+        # left to the auction's eps-CS repair — with max_release capping
+        # the repair, an over-cap infeasible seat would persist and then
+        # be skipped by later repair masks (its row no longer churns).
+        # Only rows whose lists moved this solve (repair mask) can have
+        # lost their seat; base-only drift never changes membership.
+        seat_check = np.flatnonzero(repair & (self._p4t >= 0))
+        if seat_check.size:
+            in_list = (
+                self._cand_p[seat_check]
+                == self._p4t[seat_check, None]
+            ).any(axis=1)
+            lost = seat_check[~in_list]
+            if lost.size:
+                self._p4t[lost] = -1
+                changed[lost] = True  # unseated: must be free to re-bid
+
+        # ---- auction over the (updated) cached candidate structure:
+        # warm dual carry on most ticks, a full dual refresh on schedule
+        dual_refresh = (
+            self.dual_refresh_every > 0
+            and self._dual_age >= self.dual_refresh_every
         )
+        if dual_refresh:
+            p4t, price, retired = native.auction_sparse_mt(
+                self._cand_p, self._cand_c, num_providers=P,
+                eps_start=self.eps_start, eps_end=self.eps_end,
+                threads=self.threads,
+            )
+            self._dual_age = 0
+        else:
+            retired = self._retired & ~changed
+            p4t, price, retired = native.auction_sparse_mt(
+                self._cand_p, self._cand_c, num_providers=P,
+                eps_start=max(self.warm_eps_start, self.eps_end),
+                eps_end=self.eps_end,
+                threads=self.threads,
+                price=self._price, retired=retired,
+                seed_provider_for_task=self._p4t,
+                max_release=self.max_release,
+                repair_mask=repair,
+            )
+            self._dual_age += 1
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves += 1
         self.last_stats = {
             "cold": False,
+            "dual_refresh": dual_refresh,
             "dirty_providers": n_dp,
+            "base_only_providers": n_base,
             "dirty_tasks": n_dt,
             "changed_rows": int(changed.sum()),
             "warm_solves_since_cold": self._warm_solves,

@@ -253,6 +253,9 @@ async def serve_orchestrator(args) -> None:
         matcher = scheduler_grpc.RemoteBatchMatcher(
             store,
             addr,
+            # wire protocol revision: v2 (tensor frames + delta sessions)
+            # falls back to v1 automatically against an old server
+            wire=os.environ.get("PROTOCOL_TPU_WIRE", "v2"),
             # the native-engine knobs ride the wire as the kernel string
             # ("native-mt[:N]") when the control plane is in degraded mode
             native_fallback=os.environ.get(

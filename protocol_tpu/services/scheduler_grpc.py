@@ -7,19 +7,34 @@ accelerator and returns the matching. Columnar fixed-width payloads keep the
 (de)serialization cost linear in P+T — no per-entity JSON on the hot path
 (SURVEY.md §7 hard part #6).
 
+Wire revisions (the fallback ladder, newest first):
+
+  v2 sessions  ``OpenSession`` (client-streamed snapshot) + ``AssignDelta``
+               (churned rows only): the server pins the warm arena behind a
+               ``(session_id, epoch_fingerprint)`` key and per-tick wire
+               cost is O(churn). Refused deltas (unknown session, epoch or
+               tick mismatch, evicted) drop the client one rung down.
+  v2 unary     ``AssignV2``: tensor-frame batches (``TensorBlob`` columns,
+               ``tobytes``/``frombuffer``, zero per-element Python work),
+               full snapshot per call, stateless.
+  v1 unary     ``Assign``: repeated-scalar proto fields. Frozen contract —
+               old clients keep working against new servers.
+
 Service stubs are hand-wired with grpc generic handlers (no protoc grpc
 plugin needed); messages come from protocol_tpu.proto.scheduler_pb2.
 
 Kernels: "greedy" (first-fit scan), "auction" (dense Bertsekas),
 "sinkhorn" (entropic OT + rounding), "topk" (streaming candidates + sparse
-frontier auction — the scale path).
+frontier auction — the scale path), "native"/"native-mt" (the C++ CPU
+engine; native-mt solves ride the servicer's persistent warm arena).
 """
 
 from __future__ import annotations
 
 import time
+import uuid
 from concurrent import futures
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import grpc
 import numpy as np
@@ -27,13 +42,39 @@ import numpy as np
 from protocol_tpu.ops.cost import CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto.wire import (
+    P_WIRE_DTYPES,
+    R_WIRE_DTYPES,
+    assemble_snapshot,
+    canon_columns,
+    chunk_snapshot,
+    decode_providers_v2,
+    decode_requirements_v2,
+    dirty_rows,
+    encode_providers_v2,
+    encode_requirements_v2,
+    epoch_fingerprint,
+    strip_padding,
+    take_rows,
+    unblob,
+    blob,
+)
 from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+from protocol_tpu.services.session_store import (
+    SessionStore,
+    SolveSession,
+    parse_native_threads,
+    _pad_cols,
+)
+from protocol_tpu.utils.metrics import SeamMetrics
 
 SERVICE_NAME = "protocol_tpu.scheduler.v1.SchedulerBackend"
 
 
 def _np(arr, dtype):
-    return np.asarray(list(arr), dtype=dtype)
+    # repeated-scalar containers support the sequence protocol: fromiter
+    # fills the destination buffer directly, no intermediate Python list
+    return np.fromiter(arr, dtype=dtype, count=len(arr))
 
 
 def providers_from_proto(msg: pb.ProviderBatch) -> EncodedProviders:
@@ -103,8 +144,19 @@ def _pad_pow2(enc, n_real: int):
     return dataclasses.replace(enc, **out)
 
 
+class _SolveOut(NamedTuple):
+    """Kernel output over the REAL (unpadded) row counts."""
+
+    p4t: np.ndarray  # [T] i32, -1 = unassigned
+    t4p: np.ndarray  # [P] i32, -1 = idle
+    num_assigned: int
+    price: Optional[np.ndarray]  # [P] f32 (sparse/native kernels)
+
+
 class SchedulerBackendServicer:
-    def __init__(self):
+    def __init__(
+        self, max_sessions: int = 8, session_ttl_s: float = 900.0
+    ):
         from protocol_tpu.sched.cand_cache import CandidateMemo
 
         self._cand_memo = CandidateMemo()
@@ -120,33 +172,36 @@ class SchedulerBackendServicer:
         import threading
 
         self._native_lock = threading.Lock()
+        self.sessions = SessionStore(
+            max_sessions=max_sessions, ttl_s=session_ttl_s
+        )
+        self.seam = SeamMetrics(role="server")
 
-    def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
-        t0 = time.perf_counter()
-        ep = providers_from_proto(request.providers)
-        er = requirements_from_proto(request.requirements)
-        if request.HasField("weights"):
-            # submessage presence is real in proto3: a set weights message
-            # is used verbatim, so a legitimate 0.0 weight survives the wire
-            weights = CostWeights(
-                price=request.weights.price,
-                load=request.weights.load,
-                proximity=request.weights.proximity,
-                priority=request.weights.priority,
-            )
-        else:
-            weights = CostWeights()
-        kernel = request.kernel or "auction"
+    # ---------------- shared kernel dispatch ----------------
 
+    def _solve(
+        self,
+        ep: EncodedProviders,
+        er: EncodedRequirements,
+        weights: CostWeights,
+        kernel: str,
+        top_k: int,
+        eps: float,
+        max_iters: int,
+        warm_price: Optional[np.ndarray],
+        seed_p4t: Optional[np.ndarray],
+        context,
+    ) -> _SolveOut:
+        """One solve over unpadded encoded batches: pads to the pow2
+        bucket, dispatches the kernel, slices back to real row counts.
+        Shared verbatim by the v1 and v2 surfaces — wire parity is a
+        property of the codec, never of the kernel path."""
         P = int(np.asarray(ep.gpu_count).shape[0])
         T = int(np.asarray(er.cpu_cores).shape[0])
         if P == 0 or T == 0:
             # degenerate batches are legal: nothing to match
-            return pb.AssignResponse(
-                provider_for_task=[-1] * T,
-                task_for_provider=[-1] * P,
-                num_assigned=0,
-                solve_ms=(time.perf_counter() - t0) * 1e3,
+            return _SolveOut(
+                np.full(T, -1, np.int32), np.full(P, -1, np.int32), 0, None
             )
         # bucket the batch (valid=False padding rows) so repeat calls reuse
         # the jit cache; replies are sliced back to the real row counts, and
@@ -161,12 +216,9 @@ class SchedulerBackendServicer:
             from protocol_tpu.sched.tpu_backend import _solve_unbounded
 
             best, _feas = _solve_unbounded(ep, er, weights)
-            t4p = np.asarray(best)[:P]
-            return pb.AssignResponse(
-                provider_for_task=[-1] * T,
-                task_for_provider=t4p.tolist(),
-                num_assigned=int((t4p >= 0).sum()),
-                solve_ms=(time.perf_counter() - t0) * 1e3,
+            t4p = np.asarray(best)[:P].astype(np.int32)
+            return _SolveOut(
+                np.full(T, -1, np.int32), t4p, int((t4p >= 0).sum()), None
             )
 
         if kernel == "native" or kernel.startswith("native-mt"):
@@ -177,27 +229,24 @@ class SchedulerBackendServicer:
             # suffix spelling keeps the wire message unchanged)
             from protocol_tpu import native as native_mod
 
-            P_real, T_real = P, T
             p_padded = int(np.asarray(ep.gpu_count).shape[0])
             if kernel == "native":
                 cand_p, cand_c = native_mod.fused_topk_candidates(
                     ep, er, weights,
-                    k=min(max(int(request.top_k) or 64, 1), p_padded),
+                    k=min(max(top_k or 64, 1), p_padded),
                 )
                 p4t_full = native_mod.auction_sparse(
                     cand_p, cand_c, num_providers=p_padded
                 )
                 price_full = np.zeros(p_padded, np.float32)
             else:
-                _, _, suffix = kernel.partition(":")
-                try:
-                    threads = int(suffix) if suffix else 0
-                except ValueError:
+                threads = parse_native_threads(kernel)
+                if threads is None:
                     context.abort(
                         grpc.StatusCode.INVALID_ARGUMENT,
                         f"bad native-mt thread suffix {kernel!r}",
                     )
-                requested_k = max(int(request.top_k) or 64, 1)
+                requested_k = max(top_k or 64, 1)
                 with self._native_lock:
                     if (
                         self._native_arena is None
@@ -216,17 +265,13 @@ class SchedulerBackendServicer:
                     self._native_arena.threads = threads
                     p4t_full = self._native_arena.solve(ep, er, weights)
                     price_full = self._native_arena.price
-            p4t = np.asarray(p4t_full)[:T_real]
-            t4p = np.full(P_real, -1, np.int32)
-            for s_idx, p_idx in enumerate(p4t):
-                if 0 <= p_idx < P_real:
-                    t4p[p_idx] = s_idx
-            return pb.AssignResponse(
-                provider_for_task=p4t.tolist(),
-                task_for_provider=t4p.tolist(),
-                num_assigned=int((p4t >= 0).sum()),
-                solve_ms=(time.perf_counter() - t0) * 1e3,
-                price=np.asarray(price_full)[:P_real].tolist(),
+            p4t = np.asarray(p4t_full)[:T]
+            t4p = np.full(P, -1, np.int32)
+            seated = np.flatnonzero((p4t >= 0) & (p4t < P))
+            t4p[p4t[seated]] = seated.astype(np.int32)
+            return _SolveOut(
+                p4t, t4p, int((p4t >= 0).sum()),
+                np.asarray(price_full)[:P].astype(np.float32),
             )
 
         if kernel == "topk":
@@ -248,12 +293,13 @@ class SchedulerBackendServicer:
             # re-pay the O(P*T) generation for it (VERDICT r4 item 3)
             cand_p, cand_c = self._cand_memo.get(
                 ep, er, weights,
-                k=max(int(request.top_k) or 64, 1), tile=tile,
+                k=max(top_k or 64, 1), tile=tile,
                 reverse_r=8, extra=16,
             )
-            if len(request.warm_price) == P and len(
-                request.seed_provider_for_task
-            ) == T:
+            if (
+                warm_price is not None and seed_p4t is not None
+                and len(warm_price) == P and len(seed_p4t) == T
+            ):
                 # stateless incremental solve: warm state rode the wire.
                 # Wire input is untrusted: clamp out-of-range seeds and
                 # drop duplicates (the warm kernel requires injectivity
@@ -261,11 +307,11 @@ class SchedulerBackendServicer:
                 # corrupt two-tasks-one-provider "matching").
                 price0 = np.zeros(p_padded, np.float32)
                 price0[:P] = np.nan_to_num(
-                    np.asarray(request.warm_price, np.float32),
+                    np.asarray(warm_price, np.float32),
                     nan=0.0, posinf=0.0, neginf=0.0,
                 )
                 p4t0 = np.full(t_padded, -1, np.int32)
-                seeds = np.asarray(request.seed_provider_for_task, np.int32)
+                seeds = np.asarray(seed_p4t, np.int32).copy()
                 seeds = np.where((seeds >= 0) & (seeds < P), seeds, -1)
                 pos = seeds >= 0
                 _, first = np.unique(seeds[pos], return_index=True)
@@ -276,76 +322,321 @@ class SchedulerBackendServicer:
                 res, price = assign_auction_sparse_warm(
                     cand_p, cand_c, p_padded,
                     price0=price0, p4t0=p4t0,
-                    eps=request.eps or 0.02,
-                    max_iters=int(request.max_iters) or 20000,
+                    eps=eps or 0.02,
+                    max_iters=max_iters or 20000,
                 )
             else:
                 res, price = assign_auction_sparse_scaled(
                     cand_p, cand_c, p_padded,
-                    eps_end=request.eps or 0.02,
-                    max_iters_per_phase=int(request.max_iters) or 4000,
+                    eps_end=eps or 0.02,
+                    max_iters_per_phase=max_iters or 4000,
                     with_prices=True,
                 )
             p4t = np.asarray(res.provider_for_task)[:T]
             t4p = np.asarray(res.task_for_provider)[:P]
-            return pb.AssignResponse(
-                provider_for_task=p4t.tolist(),
-                task_for_provider=t4p.tolist(),
-                num_assigned=int((p4t >= 0).sum()),
-                solve_ms=(time.perf_counter() - t0) * 1e3,
-                price=np.asarray(price)[:P].tolist(),
+            return _SolveOut(
+                p4t, t4p, int((p4t >= 0).sum()),
+                np.asarray(price)[:P].astype(np.float32),
+            )
+
+        from protocol_tpu.ops.assign import (
+            assign_auction,
+            assign_greedy,
+            assign_sinkhorn,
+        )
+
+        cost, _ = cost_matrix(ep, er, weights)
+        if kernel == "greedy":
+            res = assign_greedy(cost)
+        elif kernel == "sinkhorn":
+            res = assign_sinkhorn(
+                cost,
+                eps=eps or 0.05,
+                num_iters=max_iters or 200,
+            )
+        elif kernel == "auction":
+            from protocol_tpu.ops.cost import with_tie_jitter
+
+            # same degeneracy breaker as the in-process dense solve
+            # (sched/tpu_backend._solve_bounded) — identical jitter is
+            # what RemoteBatchMatcher's parity with TpuBatchMatcher
+            # rests on
+            res = assign_auction(
+                with_tie_jitter(cost),
+                eps=eps or 0.01,
+                max_iters=max_iters or 500,
             )
         else:
-            from protocol_tpu.ops.assign import (
-                assign_auction,
-                assign_greedy,
-                assign_sinkhorn,
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel {kernel!r}"
             )
-
-            cost, _ = cost_matrix(ep, er, weights)
-            if kernel == "greedy":
-                res = assign_greedy(cost)
-            elif kernel == "sinkhorn":
-                res = assign_sinkhorn(
-                    cost,
-                    eps=request.eps or 0.05,
-                    num_iters=int(request.max_iters) or 200,
-                )
-            elif kernel == "auction":
-                from protocol_tpu.ops.cost import with_tie_jitter
-
-                # same degeneracy breaker as the in-process dense solve
-                # (sched/tpu_backend._solve_bounded) — identical jitter is
-                # what RemoteBatchMatcher's parity with TpuBatchMatcher
-                # rests on
-                res = assign_auction(
-                    with_tie_jitter(cost),
-                    eps=request.eps or 0.01,
-                    max_iters=int(request.max_iters) or 500,
-                )
-            else:
-                context.abort(
-                    grpc.StatusCode.INVALID_ARGUMENT, f"unknown kernel {kernel!r}"
-                )
-
         p4t = np.asarray(res.provider_for_task)[:T]
         t4p = np.asarray(res.task_for_provider)[:P]
-        return pb.AssignResponse(
-            provider_for_task=p4t.tolist(),
-            task_for_provider=t4p.tolist(),
-            num_assigned=int((p4t >= 0).sum()),
+        return _SolveOut(p4t, t4p, int((p4t >= 0).sum()), None)
+
+    @staticmethod
+    def _weights_of(request) -> CostWeights:
+        if request.HasField("weights"):
+            # submessage presence is real in proto3: a set weights message
+            # is used verbatim, so a legitimate 0.0 weight survives the wire
+            return CostWeights(
+                price=request.weights.price,
+                load=request.weights.load,
+                proximity=request.weights.proximity,
+                priority=request.weights.priority,
+            )
+        return CostWeights()
+
+    # ---------------- v1 unary (frozen contract) ----------------
+
+    def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        t0 = time.perf_counter()
+        ep = providers_from_proto(request.providers)
+        er = requirements_from_proto(request.requirements)
+        t_dec = time.perf_counter()
+        warm = seeds = None
+        if len(request.warm_price) or len(request.seed_provider_for_task):
+            warm = _np(request.warm_price, np.float32)
+            seeds = _np(request.seed_provider_for_task, np.int32)
+        out = self._solve(
+            ep, er, self._weights_of(request), request.kernel or "auction",
+            int(request.top_k), request.eps, int(request.max_iters),
+            warm, seeds, context,
+        )
+        self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
+        self.seam.observe_ms(
+            "solve", (time.perf_counter() - t_dec) * 1e3
+        )
+        self.seam.add_bytes("in", request.ByteSize())
+        resp = pb.AssignResponse(
+            provider_for_task=out.p4t.astype(np.int32),
+            task_for_provider=out.t4p.astype(np.int32),
+            num_assigned=out.num_assigned,
             solve_ms=(time.perf_counter() - t0) * 1e3,
         )
+        if out.price is not None:
+            resp.price.extend(out.price)
+        self.seam.add_bytes("out", resp.ByteSize())
+        return resp
+
+    # ---------------- v2 unary: tensor frames ----------------
+
+    def AssignV2(
+        self, request: pb.AssignRequestV2, context
+    ) -> pb.AssignResponseV2:
+        t0 = time.perf_counter()
+        try:
+            ep = decode_providers_v2(request.providers)
+            er = decode_requirements_v2(request.requirements)
+            warm = (
+                unblob(request.warm_price, np.float32)
+                if request.HasField("warm_price") else None
+            )
+            seeds = (
+                unblob(request.seed_provider_for_task, np.int32)
+                if request.HasField("seed_provider_for_task") else None
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        t_dec = time.perf_counter()
+        out = self._solve(
+            ep, er, self._weights_of(request), request.kernel or "auction",
+            int(request.top_k), request.eps, int(request.max_iters),
+            warm, seeds, context,
+        )
+        t_solve = time.perf_counter()
+        self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
+        self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
+        self.seam.add_bytes("in", request.ByteSize())
+        resp = self._result_v2(out, t0, t_dec - t0)
+        self.seam.add_bytes("out", resp.ByteSize())
+        return resp
+
+    @staticmethod
+    def _result_v2(
+        out: _SolveOut, t0: float, decode_s: float
+    ) -> pb.AssignResponseV2:
+        resp = pb.AssignResponseV2(
+            provider_for_task=blob(out.p4t, np.int32),
+            task_for_provider=blob(out.t4p, np.int32),
+            num_assigned=out.num_assigned,
+            solve_ms=(time.perf_counter() - t0) * 1e3,
+            decode_ms=decode_s * 1e3,
+        )
+        if out.price is not None:
+            resp.price.CopyFrom(blob(out.price, np.float32))
+        return resp
+
+    # ---------------- v2 sessions: streamed snapshot + deltas ----------
+
+    def OpenSession(self, request_iterator, context) -> pb.OpenSessionResponse:
+        t0 = time.perf_counter()
+        try:
+            session_id, claimed_fp, req, wire_bytes = assemble_snapshot(
+                request_iterator
+            )
+        except ValueError as e:
+            return pb.OpenSessionResponse(ok=False, error=str(e))
+        self.seam.add_bytes("in", wire_bytes)
+        kernel = req.kernel or "native-mt"
+        threads = parse_native_threads(kernel)
+        if threads is None:
+            # the session protocol's warm state lives in the native arena;
+            # other kernels stay on the stateless unary rungs
+            return pb.OpenSessionResponse(
+                ok=False,
+                error=f"kernel {kernel!r} is not session-servable "
+                      "(want native-mt[:N])",
+            )
+        try:
+            ep = decode_providers_v2(req.providers)
+            er = decode_requirements_v2(req.requirements)
+        except ValueError as e:
+            return pb.OpenSessionResponse(ok=False, error=str(e))
+        weights = self._weights_of(req)
+        top_k = max(int(req.top_k) or 64, 1)
+        p_cols = canon_columns(ep, P_WIRE_DTYPES)
+        r_cols = canon_columns(er, R_WIRE_DTYPES)
+        fp = epoch_fingerprint(
+            p_cols, r_cols, weights, kernel, top_k, req.eps,
+            int(req.max_iters),
+        )
+        if claimed_fp and claimed_fp != fp:
+            self.seam.count("fingerprint_mismatch")
+            return pb.OpenSessionResponse(
+                ok=False,
+                error="epoch fingerprint mismatch between client and "
+                      "server codecs",
+            )
+        n_p = p_cols["gpu_count"].shape[0]
+        n_t = r_cols["cpu_cores"].shape[0]
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        session = SolveSession(
+            session_id=session_id or uuid.uuid4().hex,
+            fingerprint=fp,
+            weights=weights,
+            kernel=kernel,
+            threads=threads,
+            top_k=top_k,
+            p_cols=_pad_cols(p_cols, n_p),
+            r_cols=_pad_cols(r_cols, n_t),
+            n_providers=n_p,
+            n_tasks=n_t,
+            arena=NativeSolveArena(k=top_k, threads=threads),
+        )
+        t_dec = time.perf_counter()
+        with session.lock:
+            p4t, t4p, price = session.solve()
+        self.sessions.put(session)
+        self.seam.count("session_open")
+        self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
+        self.seam.observe_ms(
+            "solve", (time.perf_counter() - t_dec) * 1e3
+        )
+        out = _SolveOut(p4t, t4p, int((p4t >= 0).sum()), price)
+        resp = pb.OpenSessionResponse(
+            ok=True,
+            session_id=session.session_id,
+            epoch_fingerprint=fp,
+            result=self._result_v2(out, t0, t_dec - t0),
+        )
+        self.seam.add_bytes("out", resp.ByteSize())
+        return resp
+
+    def AssignDelta(
+        self, request: pb.AssignDeltaRequest, context
+    ) -> pb.AssignDeltaResponse:
+        t0 = time.perf_counter()
+        session, reason = self.sessions.get(
+            request.session_id, request.epoch_fingerprint
+        )
+        if session is None:
+            self.seam.count("session_miss")
+            return pb.AssignDeltaResponse(session_ok=False, error=reason)
+        self.seam.count("session_hit")
+        self.seam.add_bytes("in", request.ByteSize())
+        try:
+            prow = (
+                unblob(request.provider_rows, np.int32)
+                if request.HasField("provider_rows")
+                else np.zeros(0, np.int32)
+            )
+            trow = (
+                unblob(request.task_rows, np.int32)
+                if request.HasField("task_rows")
+                else np.zeros(0, np.int32)
+            )
+            p_delta = (
+                canon_columns(
+                    decode_providers_v2(request.providers), P_WIRE_DTYPES
+                )
+                if prow.size else {}
+            )
+            r_delta = (
+                canon_columns(
+                    decode_requirements_v2(request.requirements),
+                    R_WIRE_DTYPES,
+                )
+                if trow.size else {}
+            )
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        with session.lock:
+            if int(request.tick) != session.tick + 1:
+                # replayed or skipped tick: the client's shadow copy and
+                # this session's columns have diverged — refuse, never
+                # guess (the client re-opens from authoritative state)
+                self.seam.count("tick_mismatch")
+                return pb.AssignDeltaResponse(
+                    session_ok=False,
+                    error=f"tick cursor mismatch (have {session.tick}, "
+                          f"got {int(request.tick)})",
+                )
+            try:
+                session.apply_delta(prow, p_delta, trow, r_delta)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            t_dec = time.perf_counter()
+            p4t_out, t4p, price = session.solve()
+            session.tick += 1
+        self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
+        self.seam.observe_ms(
+            "solve", (time.perf_counter() - t_dec) * 1e3
+        )
+        del t4p, price  # session state: stays server-side
+        # SLIM response: p4t only. task_for_provider is derivable from it
+        # (the client scatters), and prices/retirement are session state —
+        # shipping them back every tick would spend O(P) wire bytes on
+        # data the delta protocol exists to keep off the wire
+        resp = pb.AssignDeltaResponse(
+            session_ok=True,
+            result=pb.AssignResponseV2(
+                provider_for_task=blob(p4t_out, np.int32),
+                num_assigned=int((p4t_out >= 0).sum()),
+                solve_ms=(time.perf_counter() - t0) * 1e3,
+                decode_ms=(t_dec - t0) * 1e3,
+            ),
+        )
+        self.seam.add_bytes("out", resp.ByteSize())
+        return resp
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
         devices = jax.devices()
-        return pb.HealthResponse(
+        resp = pb.HealthResponse(
             status="ok",
             platform=devices[0].platform if devices else "none",
             device_count=len(devices),
         )
+        seam = dict(self.seam.snapshot())
+        seam["sessions_active"] = float(len(self.sessions))
+        seam["session_evictions"] = float(self.sessions.evictions)
+        seam["session_expirations"] = float(self.sessions.expirations)
+        for name in sorted(seam):
+            resp.seam_metrics.add(name=name, value=seam[name])
+        return resp
 
 
 def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
@@ -356,6 +647,21 @@ def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
                 servicer.Assign,
                 request_deserializer=pb.AssignRequest.FromString,
                 response_serializer=pb.AssignResponse.SerializeToString,
+            ),
+            "AssignV2": grpc.unary_unary_rpc_method_handler(
+                servicer.AssignV2,
+                request_deserializer=pb.AssignRequestV2.FromString,
+                response_serializer=pb.AssignResponseV2.SerializeToString,
+            ),
+            "OpenSession": grpc.stream_unary_rpc_method_handler(
+                servicer.OpenSession,
+                request_deserializer=pb.SnapshotChunk.FromString,
+                response_serializer=pb.OpenSessionResponse.SerializeToString,
+            ),
+            "AssignDelta": grpc.unary_unary_rpc_method_handler(
+                servicer.AssignDelta,
+                request_deserializer=pb.AssignDeltaRequest.FromString,
+                response_serializer=pb.AssignDeltaResponse.SerializeToString,
             ),
             "Health": grpc.unary_unary_rpc_method_handler(
                 servicer.Health,
@@ -368,7 +674,9 @@ def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
 
 # Columnar batches scale with the population: ~60 B/provider means the
 # 4 MB gRPC default tops out near 70k providers. 1 GiB covers the 1M-scale
-# ladder with headroom; it is a cap, not an allocation.
+# ladder with headroom for the v1 unary path; it is a cap, not an
+# allocation. (v2 streams snapshots in bounded chunks, so only v1 and the
+# per-tick delta messages ever approach it.)
 MAX_MESSAGE_BYTES = 1 << 30
 _CHANNEL_OPTIONS = [
     ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
@@ -377,12 +685,16 @@ _CHANNEL_OPTIONS = [
 
 
 def serve(address: str = "127.0.0.1:50061", max_workers: int = 4) -> grpc.Server:
-    """Start the backend server (non-blocking; call .wait_for_termination())."""
+    """Start the backend server (non-blocking; call .wait_for_termination()).
+    The servicer rides on the returned server as ``.servicer`` (tests and
+    diagnostics reach the session store / seam metrics through it)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
     )
-    server.add_generic_rpc_handlers((_handlers(SchedulerBackendServicer()),))
+    servicer = SchedulerBackendServicer()
+    server.add_generic_rpc_handlers((_handlers(servicer),))
+    server.servicer = servicer
     server.add_insecure_port(address)
     server.start()
     return server
@@ -392,11 +704,27 @@ class SchedulerBackendClient:
     """Thin client stub (what a non-Python control plane would generate)."""
 
     def __init__(self, address: str = "127.0.0.1:50061"):
+        self.address = address
         self.channel = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
         self._assign = self.channel.unary_unary(
             f"/{SERVICE_NAME}/Assign",
             request_serializer=pb.AssignRequest.SerializeToString,
             response_deserializer=pb.AssignResponse.FromString,
+        )
+        self._assign_v2 = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/AssignV2",
+            request_serializer=pb.AssignRequestV2.SerializeToString,
+            response_deserializer=pb.AssignResponseV2.FromString,
+        )
+        self._open_session = self.channel.stream_unary(
+            f"/{SERVICE_NAME}/OpenSession",
+            request_serializer=pb.SnapshotChunk.SerializeToString,
+            response_deserializer=pb.OpenSessionResponse.FromString,
+        )
+        self._assign_delta = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/AssignDelta",
+            request_serializer=pb.AssignDeltaRequest.SerializeToString,
+            response_deserializer=pb.AssignDeltaResponse.FromString,
         )
         self._health = self.channel.unary_unary(
             f"/{SERVICE_NAME}/Health",
@@ -406,6 +734,21 @@ class SchedulerBackendClient:
 
     def assign(self, request: pb.AssignRequest, timeout: float = 60.0) -> pb.AssignResponse:
         return self._assign(request, timeout=timeout)
+
+    def assign_v2(
+        self, request: pb.AssignRequestV2, timeout: float = 60.0
+    ) -> pb.AssignResponseV2:
+        return self._assign_v2(request, timeout=timeout)
+
+    def open_session(
+        self, chunks, timeout: float = 300.0
+    ) -> pb.OpenSessionResponse:
+        return self._open_session(chunks, timeout=timeout)
+
+    def assign_delta(
+        self, request: pb.AssignDeltaRequest, timeout: float = 60.0
+    ) -> pb.AssignDeltaResponse:
+        return self._assign_delta(request, timeout=timeout)
 
     def health(self, timeout: float = 10.0) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=timeout)
@@ -418,45 +761,54 @@ def encoded_to_proto(
     ep: EncodedProviders, er: EncodedRequirements, weights: Optional[CostWeights] = None,
     kernel: str = "topk", top_k: int = 64, eps: float = 0.01, max_iters: int = 0,
 ) -> pb.AssignRequest:
-    """Host-side helper: pack numpy-backed encodings into an AssignRequest."""
+    """Host-side helper: pack numpy-backed encodings into an AssignRequest.
+
+    Columns go to protobuf as numpy arrays directly (upb consumes any
+    iterable of scalars): dtypes are asserted/narrowed ONCE here via an
+    ascontiguousarray cast, and the per-element Python list round-trip the
+    old ``.tolist()`` spelling paid on every column is gone."""
+
+    def _c(a, dtype):
+        return np.ascontiguousarray(np.asarray(a), dtype)
+
     w = weights or CostWeights()
     t, k = np.asarray(er.gpu_opt_valid).shape
     words = np.asarray(er.gpu_model_mask).shape[-1]
     return pb.AssignRequest(
         providers=pb.ProviderBatch(
-            gpu_count=np.asarray(ep.gpu_count).tolist(),
-            gpu_mem_mb=np.asarray(ep.gpu_mem_mb).tolist(),
-            gpu_model_id=np.asarray(ep.gpu_model_id).tolist(),
-            has_gpu=np.asarray(ep.has_gpu).tolist(),
-            has_cpu=np.asarray(ep.has_cpu).tolist(),
-            cpu_cores=np.asarray(ep.cpu_cores).tolist(),
-            ram_mb=np.asarray(ep.ram_mb).tolist(),
-            storage_gb=np.asarray(ep.storage_gb).tolist(),
-            lat=np.asarray(ep.lat).tolist(),
-            lon=np.asarray(ep.lon).tolist(),
-            has_location=np.asarray(ep.has_location).tolist(),
-            price=np.asarray(ep.price).tolist(),
-            load=np.asarray(ep.load).tolist(),
+            gpu_count=_c(ep.gpu_count, np.int32),
+            gpu_mem_mb=_c(ep.gpu_mem_mb, np.int32),
+            gpu_model_id=_c(ep.gpu_model_id, np.int32),
+            has_gpu=_c(ep.has_gpu, bool),
+            has_cpu=_c(ep.has_cpu, bool),
+            cpu_cores=_c(ep.cpu_cores, np.int32),
+            ram_mb=_c(ep.ram_mb, np.int32),
+            storage_gb=_c(ep.storage_gb, np.int32),
+            lat=_c(ep.lat, np.float32),
+            lon=_c(ep.lon, np.float32),
+            has_location=_c(ep.has_location, bool),
+            price=_c(ep.price, np.float32),
+            load=_c(ep.load, np.float32),
         ),
         requirements=pb.RequirementBatch(
-            cpu_required=np.asarray(er.cpu_required).tolist(),
-            cpu_cores=np.asarray(er.cpu_cores).tolist(),
-            ram_mb=np.asarray(er.ram_mb).tolist(),
-            storage_gb=np.asarray(er.storage_gb).tolist(),
+            cpu_required=_c(er.cpu_required, bool),
+            cpu_cores=_c(er.cpu_cores, np.int32),
+            ram_mb=_c(er.ram_mb, np.int32),
+            storage_gb=_c(er.storage_gb, np.int32),
             max_gpu_options=k,
             model_words=words,
-            gpu_opt_valid=np.asarray(er.gpu_opt_valid).reshape(-1).tolist(),
-            gpu_count=np.asarray(er.gpu_count).reshape(-1).tolist(),
-            gpu_mem_min=np.asarray(er.gpu_mem_min).reshape(-1).tolist(),
-            gpu_mem_max=np.asarray(er.gpu_mem_max).reshape(-1).tolist(),
-            gpu_total_mem_min=np.asarray(er.gpu_total_mem_min).reshape(-1).tolist(),
-            gpu_total_mem_max=np.asarray(er.gpu_total_mem_max).reshape(-1).tolist(),
-            gpu_model_mask=np.asarray(er.gpu_model_mask).reshape(-1).tolist(),
-            gpu_model_constrained=np.asarray(er.gpu_model_constrained).reshape(-1).tolist(),
-            lat=np.asarray(er.lat).tolist(),
-            lon=np.asarray(er.lon).tolist(),
-            has_location=np.asarray(er.has_location).tolist(),
-            priority=np.asarray(er.priority).tolist(),
+            gpu_opt_valid=_c(er.gpu_opt_valid, bool).reshape(-1),
+            gpu_count=_c(er.gpu_count, np.int32).reshape(-1),
+            gpu_mem_min=_c(er.gpu_mem_min, np.int32).reshape(-1),
+            gpu_mem_max=_c(er.gpu_mem_max, np.int32).reshape(-1),
+            gpu_total_mem_min=_c(er.gpu_total_mem_min, np.int32).reshape(-1),
+            gpu_total_mem_max=_c(er.gpu_total_mem_max, np.int32).reshape(-1),
+            gpu_model_mask=_c(er.gpu_model_mask, np.uint32).reshape(-1),
+            gpu_model_constrained=_c(er.gpu_model_constrained, bool).reshape(-1),
+            lat=_c(er.lat, np.float32),
+            lon=_c(er.lon, np.float32),
+            has_location=_c(er.has_location, bool),
+            priority=_c(er.priority, np.float32),
         ),
         weights=pb.CostWeights(
             price=float(w.price), load=float(w.load),
@@ -469,6 +821,72 @@ def encoded_to_proto(
     )
 
 
+def encoded_to_proto_v2(
+    ep: EncodedProviders, er: EncodedRequirements,
+    weights: Optional[CostWeights] = None,
+    kernel: str = "topk", top_k: int = 64, eps: float = 0.01,
+    max_iters: int = 0,
+) -> pb.AssignRequestV2:
+    """v2 twin of :func:`encoded_to_proto`: tensor-frame columns."""
+    w = weights or CostWeights()
+    return pb.AssignRequestV2(
+        providers=encode_providers_v2(ep),
+        requirements=encode_requirements_v2(er),
+        weights=pb.CostWeights(
+            price=float(w.price), load=float(w.load),
+            proximity=float(w.proximity), priority=float(w.priority),
+        ),
+        kernel=kernel,
+        top_k=top_k,
+        eps=eps,
+        max_iters=max_iters,
+    )
+
+
+class _WireResult(NamedTuple):
+    """Version-independent view of an assign response."""
+
+    p4t: np.ndarray
+    t4p: np.ndarray
+    price: Optional[np.ndarray]
+    solve_ms: float
+
+
+def _res_v1(resp: pb.AssignResponse) -> _WireResult:
+    return _WireResult(
+        _np(resp.provider_for_task, np.int32),
+        _np(resp.task_for_provider, np.int32),
+        _np(resp.price, np.float32) if len(resp.price) else None,
+        resp.solve_ms,
+    )
+
+
+def _res_v2(
+    resp: pb.AssignResponseV2, n_providers: Optional[int] = None
+) -> _WireResult:
+    p4t = unblob(resp.provider_for_task, np.int32)
+    if resp.HasField("task_for_provider"):
+        t4p = unblob(resp.task_for_provider, np.int32)
+    else:
+        # slim delta response: the inverse matching is a local scatter
+        t4p = np.full(int(n_providers), -1, np.int32)
+        seated = np.flatnonzero((p4t >= 0) & (p4t < int(n_providers)))
+        t4p[p4t[seated]] = seated.astype(np.int32)
+    return _WireResult(
+        p4t,
+        t4p,
+        unblob(resp.price, np.float32)
+        if resp.HasField("price") else None,
+        resp.solve_ms,
+    )
+
+
+_RETRYABLE = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+)
+
+
 class RemoteBatchMatcher(TpuBatchMatcher):
     """TpuBatchMatcher whose device solves go through the gRPC scheduler
     backend (``scheduler_backend=remote``): the control plane stays a thin
@@ -478,6 +896,19 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     jitted kernels are packed into AssignRequests instead, so control
     plane and backend can be scaled and deployed independently (the
     reference's Rust-orchestrator-calls-TPU-service shape).
+
+    ``wire="v1"`` speaks the frozen repeated-scalar contract.
+    ``wire="v2"`` speaks tensor frames, and for the native-mt engine runs
+    the session protocol: one streamed snapshot, then per-tick
+    ``AssignDelta`` messages carrying only rows whose encoded values
+    changed since the previous solve (a vectorized column diff against
+    the client's shadow copy — the wire twin of the CandidateCache /
+    arena dirty-row bookkeeping). A refused delta re-opens the session
+    from a fresh snapshot; an UNIMPLEMENTED v2 RPC (old server) drops the
+    client to v1 permanently. Transient transport failures
+    (UNAVAILABLE / DEADLINE_EXCEEDED) retry with bounded exponential
+    backoff and a channel reconnect — one flaky RPC must not fail a
+    whole scheduler tick.
 
     Round-trip cost shows up in ``last_solve_stats`` as
     ``remote_rtt_ms`` (client-observed) next to the backend-reported
@@ -505,70 +936,295 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         store,
         address: str = "127.0.0.1:50061",
         request_timeout: float = 300.0,
+        wire: str = "v1",
+        chunk_bytes: int = 1 << 20,
+        gzip_snapshots: bool = True,
+        retries: int = 3,
+        retry_base_s: float = 0.05,
         **kwargs,
     ):
         super().__init__(store, **kwargs)
+        if wire not in ("v1", "v2"):
+            raise ValueError(f"wire must be v1|v2, got {wire!r}")
         self.request_timeout = request_timeout
+        self.wire = wire
+        self.chunk_bytes = chunk_bytes
+        self.gzip_snapshots = gzip_snapshots
+        self.retries = retries
+        self.retry_base_s = retry_base_s
         self.client = SchedulerBackendClient(address)
+        self.seam = SeamMetrics(role="client")
         self._rtt_ms: list[float] = []
         self._backend_ms: list[float] = []
+        self._bytes_out = 0
+        self._bytes_in = 0
+        # client half of the session protocol: shadow columns of the last
+        # snapshot/delta the server acknowledged, keyed by solve params
+        self._session: Optional[dict] = None
+        self._session_uid = uuid.uuid4().hex
+        self._session_refused = False
 
     def refresh(self) -> None:
         self._rtt_ms, self._backend_ms = [], []
+        self._bytes_out = self._bytes_in = 0
         super().refresh()  # replaces last_solve_stats; re-attach remote cost
         if self._rtt_ms:
+            self.last_solve_stats["wire"] = self.wire
             self.last_solve_stats["remote_calls"] = len(self._rtt_ms)
             self.last_solve_stats["remote_rtt_ms"] = round(sum(self._rtt_ms), 3)
             self.last_solve_stats["remote_backend_ms"] = round(
                 sum(self._backend_ms), 3
             )
+            self.last_solve_stats["remote_bytes_out"] = self._bytes_out
+            self.last_solve_stats["remote_bytes_in"] = self._bytes_in
 
     @staticmethod
     def _strip_padding(enc):
-        """Drop the pow2-padding rows before serialization: the wire format
-        carries no valid mask, so padded rows would otherwise become real
-        (zero-cost, always-compatible) entities on the backend — and they
-        double the payload for nothing."""
-        import dataclasses
+        return strip_padding(enc)
 
-        n = int(np.asarray(enc.valid).sum())
-        return dataclasses.replace(
-            enc,
-            **{
-                f.name: np.asarray(getattr(enc, f.name))[:n]
-                for f in dataclasses.fields(enc)
-            },
-        )
+    # ---------------- transport: retry + reconnect ----------------
 
-    def _call(self, ep, er, kernel: str, eps: float, max_iters: int):
+    def _reconnect(self) -> None:
+        address = self.client.address
+        try:
+            self.client.close()
+        except Exception:
+            pass
+        self.client = SchedulerBackendClient(address)
+
+    def _rpc(self, make_call):
+        """Run ``make_call()`` (a zero-arg closure issuing one RPC) with
+        bounded exponential backoff on transient transport failures; each
+        retry reconnects the channel (a dead server that came back gets a
+        fresh HTTP/2 connection instead of a wedged one)."""
+        delay = self.retry_base_s
+        for attempt in range(self.retries + 1):
+            try:
+                return make_call()
+            except grpc.RpcError as e:
+                code = e.code()
+                if attempt >= self.retries or code not in _RETRYABLE:
+                    raise
+                self.seam.count("retry")
+                time.sleep(delay)
+                delay *= 2
+                self._reconnect()
+
+    # ---------------- v1/v2 unary ----------------
+
+    def _timed(self, make_call, bytes_out: int):
+        t0 = time.perf_counter()
+        resp = self._rpc(make_call)
+        self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
+        self._bytes_out += bytes_out
+        self._bytes_in += resp.ByteSize()
+        return resp
+
+    def _call(
+        self, ep, er, kernel: str, eps: float, max_iters: int,
+        warm_price=None, seed_p4t=None, top_k: int = 64,
+    ) -> _WireResult:
+        sp = self._strip_padding(ep)
+        sr = self._strip_padding(er)
+        if self.wire == "v2":
+            try:
+                return self._call_v2(
+                    sp, sr, kernel, eps, max_iters, warm_price, seed_p4t,
+                    top_k,
+                )
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+                    raise
+                # old server: drop to the frozen v1 contract for good
+                self.wire = "v1"
+                self.seam.count("fallback_v1")
+        t0 = time.perf_counter()
         req = encoded_to_proto(
-            self._strip_padding(ep),
-            self._strip_padding(er),
-            self.weights,
-            kernel=kernel,
-            eps=eps,
+            sp, sr, self.weights,
+            kernel=kernel, top_k=top_k, eps=eps, max_iters=max_iters,
+        )
+        if warm_price is not None and seed_p4t is not None:
+            req.warm_price.extend(np.asarray(warm_price, np.float32))
+            req.seed_provider_for_task.extend(
+                np.asarray(seed_p4t, np.int32)
+            )
+        self.seam.observe_ms(
+            "serialize", (time.perf_counter() - t0) * 1e3
+        )
+        resp = self._timed(
+            lambda: self.client.assign(req, timeout=self.request_timeout),
+            req.ByteSize(),
+        )
+        self._backend_ms.append(resp.solve_ms)
+        return _res_v1(resp)
+
+    def _call_v2(
+        self, sp, sr, kernel, eps, max_iters, warm_price, seed_p4t, top_k,
+    ) -> _WireResult:
+        if (
+            parse_native_threads(kernel) is not None
+            and not self._session_refused
+        ):
+            res = self._session_call(sp, sr, kernel, eps, max_iters, top_k)
+            if res is not None:
+                return res
+        t0 = time.perf_counter()
+        req = encoded_to_proto_v2(
+            sp, sr, self.weights,
+            kernel=kernel, top_k=top_k, eps=eps, max_iters=max_iters,
+        )
+        if warm_price is not None and seed_p4t is not None:
+            req.warm_price.CopyFrom(blob(warm_price, np.float32))
+            req.seed_provider_for_task.CopyFrom(blob(seed_p4t, np.int32))
+        self.seam.observe_ms(
+            "serialize", (time.perf_counter() - t0) * 1e3
+        )
+        resp = self._timed(
+            lambda: self.client.assign_v2(req, timeout=self.request_timeout),
+            req.ByteSize(),
+        )
+        self._backend_ms.append(resp.solve_ms)
+        return _res_v2(resp)
+
+    # ---------------- v2 session protocol (client half) ----------------
+
+    def _session_call(
+        self, sp, sr, kernel, eps, max_iters, top_k,
+    ) -> Optional[_WireResult]:
+        """Session-protocol solve: delta tick against the open session, or
+        a fresh streamed snapshot when there is none / the population
+        reshaped / the server lost it. Returns None when the server
+        refuses the session protocol (caller falls to unary v2)."""
+        t0 = time.perf_counter()
+        p_cols = canon_columns(sp, P_WIRE_DTYPES)
+        r_cols = canon_columns(sr, R_WIRE_DTYPES)
+        params = (
+            kernel, int(top_k), float(eps), int(max_iters),
+            float(self.weights.price), float(self.weights.load),
+            float(self.weights.proximity), float(self.weights.priority),
+            p_cols["gpu_count"].shape[0], r_cols["cpu_cores"].shape[0],
+        )
+        st = self._session
+        if st is None or st["params"] != params:
+            return self._open_session(
+                p_cols, r_cols, kernel, eps, max_iters, top_k, params, t0
+            )
+        prow = dirty_rows(p_cols, st["p_cols"])
+        trow = dirty_rows(r_cols, st["r_cols"])
+        n_total = params[-2] + params[-1]
+        if (prow.size + trow.size) > 0.5 * n_total:
+            # a mostly-new marketplace: the delta message would carry more
+            # than a snapshot's worth of rows — re-epoch instead
+            return self._open_session(
+                p_cols, r_cols, kernel, eps, max_iters, top_k, params, t0
+            )
+        req = pb.AssignDeltaRequest(
+            session_id=st["id"],
+            epoch_fingerprint=st["fp"],
+            tick=st["tick"] + 1,
+        )
+        if prow.size:
+            req.provider_rows.CopyFrom(blob(prow, np.int32))
+            req.providers.CopyFrom(
+                encode_providers_v2(take_rows(p_cols, prow))
+            )
+        if trow.size:
+            req.task_rows.CopyFrom(blob(trow, np.int32))
+            req.requirements.CopyFrom(
+                encode_requirements_v2(take_rows(r_cols, trow))
+            )
+        self.seam.observe_ms(
+            "serialize", (time.perf_counter() - t0) * 1e3
+        )
+        resp = self._timed(
+            lambda: self.client.assign_delta(
+                req, timeout=self.request_timeout
+            ),
+            req.ByteSize(),
+        )
+        if not resp.session_ok:
+            # evicted / expired / served by a replica that never saw the
+            # snapshot: re-open from our authoritative state, don't error
+            # the scheduler tick
+            self.seam.count("session_reopen")
+            self._session = None
+            return self._open_session(
+                p_cols, r_cols, kernel, eps, max_iters, top_k, params, t0
+            )
+        st["p_cols"], st["r_cols"] = p_cols, r_cols
+        st["tick"] += 1
+        self._backend_ms.append(resp.result.solve_ms)
+        return _res_v2(resp.result, n_providers=params[-2])
+
+    def _open_session(
+        self, p_cols, r_cols, kernel, eps, max_iters, top_k, params, t0,
+    ) -> Optional[_WireResult]:
+        fp = epoch_fingerprint(
+            p_cols, r_cols, self.weights, kernel, int(top_k), eps,
+            int(max_iters),
+        )
+        req = encoded_to_proto_v2(
+            take_rows(p_cols, slice(None)), take_rows(r_cols, slice(None)),
+            self.weights, kernel=kernel, top_k=top_k, eps=eps,
             max_iters=max_iters,
         )
-        t0 = time.perf_counter()
-        resp = self.client.assign(req, timeout=self.request_timeout)
-        self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
-        self._backend_ms.append(resp.solve_ms)
-        return resp
+        chunks = list(
+            chunk_snapshot(
+                self._session_uid, fp, req,
+                chunk_bytes=self.chunk_bytes,
+                use_gzip=self.gzip_snapshots,
+            )
+        )
+        n_bytes = sum(len(c.payload) for c in chunks)
+        self.seam.observe_ms(
+            "serialize", (time.perf_counter() - t0) * 1e3
+        )
+        resp = self._timed(
+            lambda: self.client.open_session(
+                iter(chunks), timeout=self.request_timeout
+            ),
+            n_bytes,
+        )
+        if not resp.ok:
+            # server-side refusal is a protocol answer, not a transport
+            # failure: remember it so every later tick goes straight to
+            # the unary rung
+            self.seam.count("session_refused")
+            self._session_refused = True
+            self._session = None
+            return None
+        self._session = {
+            "id": resp.session_id,
+            "fp": resp.epoch_fingerprint,
+            "tick": 0,
+            "p_cols": p_cols,
+            "r_cols": r_cols,
+            "params": params,
+        }
+        self._backend_ms.append(resp.result.solve_ms)
+        return _res_v2(resp.result)
+
+    # ---------------- matcher integration ----------------
+
+    def _native_kernel(self) -> str:
+        if self.native_engine == "native-mt":
+            return "native-mt" + (
+                f":{self.native_threads}" if self.native_threads else ""
+            )
+        return "native"
 
     def _bounded_t4p(self, ep, er) -> np.ndarray:
         if self.native_fallback:
             # engine=native-mt rides the wire as a kernel-string suffix so
-            # the backend's warm arena (and its thread pool) do the work
-            if self.native_engine == "native-mt":
-                kernel = "native-mt" + (
-                    f":{self.native_threads}" if self.native_threads else ""
-                )
-            else:
-                kernel = "native"
-            resp = self._call(ep, er, kernel, eps=0.02, max_iters=0)
-            return np.asarray(resp.task_for_provider, np.int32)
-        resp = self._call(ep, er, "auction", eps=0.05, max_iters=300)
-        return np.asarray(resp.task_for_provider, np.int32)
+            # the backend's warm arena (and its thread pool) do the work;
+            # on wire=v2 it rides the session protocol instead and only
+            # churned rows hit the wire
+            res = self._call(
+                ep, er, self._native_kernel(), eps=0.02, max_iters=0
+            )
+            return np.asarray(res.t4p, np.int32)
+        res = self._call(ep, er, "auction", eps=0.05, max_iters=300)
+        return np.asarray(res.t4p, np.int32)
 
     def _bounded_t4p_sparse(
         self, ep, er, price0: np.ndarray, p4s0: np.ndarray, warm: bool
@@ -578,28 +1234,23 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         request/response so the backend stays stateless across replicas."""
         n_p = int(np.asarray(ep.valid).sum())
         n_s = int(np.asarray(er.valid).sum())
-        req = encoded_to_proto(
-            self._strip_padding(ep),
-            self._strip_padding(er),
-            self.weights,
-            kernel="topk",
-            top_k=self.top_k,
-            eps=0.02,
-        )
+        warm_price = seed = None
         if warm:
-            req.warm_price.extend(np.asarray(price0[:n_p], np.float32).tolist())
-            req.seed_provider_for_task.extend(
-                np.asarray(p4s0[:n_s], np.int32).tolist()
-            )
-        t0 = time.perf_counter()
-        resp = self.client.assign(req, timeout=self.request_timeout)
-        self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
-        self._backend_ms.append(resp.solve_ms)
+            warm_price = np.asarray(price0[:n_p], np.float32)
+            seed = np.asarray(p4s0[:n_s], np.int32)
+        res = self._call(
+            ep, er, "topk", eps=0.02, max_iters=0,
+            warm_price=warm_price, seed_p4t=seed, top_k=self.top_k,
+        )
+        price = (
+            res.price if res.price is not None
+            else np.zeros(n_p, np.float32)
+        )
         return (
-            np.asarray(resp.task_for_provider, np.int32),
-            np.asarray(resp.price, np.float32),
+            np.asarray(res.t4p, np.int32),
+            np.asarray(price, np.float32),
         )
 
     def _unbounded_best(self, ep, er) -> np.ndarray:
-        resp = self._call(ep, er, "best", eps=0.0, max_iters=0)
-        return np.asarray(resp.task_for_provider, np.int32)
+        res = self._call(ep, er, "best", eps=0.0, max_iters=0)
+        return np.asarray(res.t4p, np.int32)

@@ -1,0 +1,198 @@
+"""Server-side session registry for wire protocol v2 (session epochs).
+
+A session pins the expensive per-marketplace state — the full columnar
+snapshot plus a persistent :class:`NativeSolveArena` — behind a
+``(session_id, epoch_fingerprint)`` key, so steady-state ticks ship only
+churned rows over the wire (``AssignDelta``) and the warm candidate
+structure + auction duals never leave the server. This is what turns
+PR 1's warm-solve win from a local-process property into an end-to-end
+RPC property: the wire cost per tick becomes O(churn), matching the
+solve cost.
+
+Any replica must be able to serve any solve: an ``AssignDelta`` against
+a session this process does not hold (or holds under a different epoch
+fingerprint / tick cursor) is REFUSED, never guessed at — the client
+falls back down the ladder (fresh snapshot stream -> stateless v1).
+Sessions are LRU-evicted beyond ``max_sessions`` and expire after
+``ttl_s`` idle seconds; eviction is always safe because the client can
+re-open from its own authoritative state.
+
+Delta application is copy-on-write per column: the arena's dirty
+detection holds the PREVIOUS tick's columns by reference (copying every
+column per solve would dominate at 1M rows), so a churned column is
+replaced, never mutated in place — untouched columns stay shared.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.proto.wire import P_WIRE_DTYPES, R_WIRE_DTYPES
+
+
+def parse_native_threads(kernel: str) -> Optional[int]:
+    """``native-mt`` / ``native-mt:N`` -> thread count (0 = all hardware
+    threads); any other kernel -> None (not session-servable)."""
+    if not kernel.startswith("native-mt"):
+        return None
+    _, _, suffix = kernel.partition(":")
+    try:
+        return int(suffix) if suffix else 0
+    except ValueError:
+        return None
+
+
+def _pad_cols(cols: dict[str, np.ndarray], n_real: int) -> dict[str, np.ndarray]:
+    """Pad columns to the next pow2 bucket with valid=False rows — the
+    same bucketing contract as scheduler_grpc._pad_pow2 (zero fill +
+    valid mask), so session solves and unary solves see bit-identical
+    padded inputs."""
+    if n_real <= 0:
+        return dict(cols)
+    target = 1 << (n_real - 1).bit_length()
+    if target == n_real:
+        return dict(cols)
+    out = {}
+    for name, a in cols.items():
+        pad = [(0, target - n_real)] + [(0, 0)] * (a.ndim - 1)
+        out[name] = np.pad(a, pad)
+    out["valid"] = np.concatenate(
+        [np.asarray(cols["valid"], bool)[:n_real],
+         np.zeros(target - n_real, bool)]
+    )
+    return out
+
+
+def _as_ns(cols: dict[str, np.ndarray]) -> object:
+    ns = type("_Cols", (), {})()
+    for name, arr in cols.items():
+        setattr(ns, name, arr)
+    return ns
+
+
+@dataclass
+class SolveSession:
+    session_id: str
+    fingerprint: str
+    weights: object  # CostWeights
+    kernel: str
+    threads: int
+    top_k: int
+    p_cols: dict  # padded, wire dtypes
+    r_cols: dict
+    n_providers: int  # real (unpadded) row counts
+    n_tasks: int
+    arena: object  # NativeSolveArena
+    tick: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    delta_rows_total: int = 0
+
+    def solve(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run the warm arena over the current columns; returns
+        (provider_for_task[T], task_for_provider[P], price[P]) over the
+        REAL row counts."""
+        p4t_full = self.arena.solve(
+            _as_ns(self.p_cols), _as_ns(self.r_cols), self.weights
+        )
+        p4t = np.asarray(p4t_full)[: self.n_tasks]
+        t4p = np.full(self.n_providers, -1, np.int32)
+        seated = np.flatnonzero((p4t >= 0) & (p4t < self.n_providers))
+        t4p[p4t[seated]] = seated.astype(np.int32)
+        price = np.asarray(self.arena.price)[: self.n_providers]
+        return p4t, t4p, price
+
+    def apply_delta(
+        self,
+        provider_rows: np.ndarray,
+        p_delta: dict[str, np.ndarray],
+        task_rows: np.ndarray,
+        r_delta: dict[str, np.ndarray],
+    ) -> int:
+        """Write churned rows into the session columns, copy-on-write per
+        column. Returns the number of rows actually applied. Row indices
+        are validated against the REAL row space — padding rows are the
+        server's own invention and never addressable from the wire."""
+        applied = 0
+        for rows, delta, cols, n_real, spec in (
+            (provider_rows, p_delta, self.p_cols, self.n_providers,
+             P_WIRE_DTYPES),
+            (task_rows, r_delta, self.r_cols, self.n_tasks, R_WIRE_DTYPES),
+        ):
+            if rows.size == 0:
+                continue
+            if rows.min() < 0 or rows.max() >= n_real:
+                raise ValueError(
+                    f"delta row index out of range [0, {n_real})"
+                )
+            for name in spec:
+                new_vals = delta[name]
+                if np.array_equal(cols[name][rows], new_vals):
+                    continue  # column untouched by this delta
+                col = cols[name].copy()
+                col[rows] = new_vals
+                cols[name] = col
+            applied += int(rows.size)
+        self.delta_rows_total += applied
+        return applied
+
+
+class SessionStore:
+    """LRU + TTL registry of :class:`SolveSession`."""
+
+    def __init__(self, max_sessions: int = 8, ttl_s: float = 900.0):
+        self.max_sessions = max_sessions
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, SolveSession] = OrderedDict()
+        self.evictions = 0
+        self.expirations = 0
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        dead = [
+            sid for sid, s in self._sessions.items()
+            if now - s.last_used > self.ttl_s
+        ]
+        for sid in dead:
+            del self._sessions[sid]
+            self.expirations += 1
+
+    def put(self, session: SolveSession) -> None:
+        with self._lock:
+            self._expire_locked()
+            self._sessions.pop(session.session_id, None)
+            self._sessions[session.session_id] = session
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+                self.evictions += 1
+
+    def get(
+        self, session_id: str, fingerprint: str
+    ) -> tuple[Optional[SolveSession], str]:
+        """Look up a session for a delta tick. Returns (session, "") on
+        hit or (None, reason) — reason is wire-safe text the client logs."""
+        with self._lock:
+            self._expire_locked()
+            s = self._sessions.get(session_id)
+            if s is None:
+                return None, "unknown session"
+            if s.fingerprint != fingerprint:
+                return None, "epoch fingerprint mismatch"
+            self._sessions.move_to_end(session_id)
+            s.last_used = time.monotonic()
+            return s, ""
+
+    def drop(self, session_id: str) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)

@@ -16,13 +16,22 @@ solve-duration histogram (the hot path this framework moves on-device).
 
 from __future__ import annotations
 
-from prometheus_client import (
-    CollectorRegistry,
-    Counter,
-    Gauge,
-    Histogram,
-    generate_latest,
-)
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+except ImportError:  # pragma: no cover - minimal envs (CI perf gate)
+    # SeamMetrics degrades to its plain-dict mirror; the orchestrator /
+    # validator registries (which only run in full deployments) raise at
+    # construction time instead of at import time.
+    CollectorRegistry = Counter = Gauge = Histogram = None
+
+    def generate_latest(registry):
+        raise ImportError("prometheus_client is not installed")
 
 _STATUS_BUCKETS = [
     0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 15.0, 30.0, 45.0,
@@ -206,6 +215,96 @@ class OrchestratorMetrics:
                     ).set(value)
 
     def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+_WIRE_MS_BUCKETS = [
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0,
+]
+
+
+class SeamMetrics:
+    """Per-phase instrumentation for the scheduler gRPC seam (wire v2).
+
+    Phases: ``serialize`` (client-side pack), ``decode`` (server-side
+    unpack), ``solve`` (kernel), ``rpc`` (client-observed round trip) —
+    histograms in milliseconds. Byte counters per direction and event
+    counters for the session ladder (hit / miss / evict / expired /
+    mismatch / reopen / retry / fallback_v1).
+
+    A plain-dict mirror is authoritative for :meth:`snapshot` (what rides
+    in ``HealthResponse.seam_metrics`` and what the bench scrapes), with
+    an optional prometheus registry for scrape endpoints — the seam must
+    stay measurable in environments without prometheus_client."""
+
+    def __init__(self, role: str = "server"):
+        self.role = role
+        self._lock = __import__("threading").Lock()
+        self._ms_sum: dict[str, float] = {}
+        self._ms_count: dict[str, int] = {}
+        self._bytes: dict[str, int] = {}
+        self._events: dict[str, int] = {}
+        try:
+            self.registry = CollectorRegistry()
+            self._h_phase = Histogram(
+                "scheduler_seam_phase_ms",
+                "Wire-seam per-phase latency (ms)",
+                ["role", "phase"],
+                buckets=_WIRE_MS_BUCKETS,
+                registry=self.registry,
+            )
+            self._c_bytes = Counter(
+                "scheduler_seam_wire_bytes",
+                "Wire bytes through the scheduler seam",
+                ["role", "direction"],
+                registry=self.registry,
+            )
+            self._c_events = Counter(
+                "scheduler_seam_session_events",
+                "Session-protocol events at the scheduler seam",
+                ["role", "event"],
+                registry=self.registry,
+            )
+        except Exception:  # pragma: no cover - prometheus_client absent
+            self.registry = None
+
+    def observe_ms(self, phase: str, ms: float) -> None:
+        with self._lock:
+            self._ms_sum[phase] = self._ms_sum.get(phase, 0.0) + float(ms)
+            self._ms_count[phase] = self._ms_count.get(phase, 0) + 1
+        if self.registry is not None:
+            self._h_phase.labels(role=self.role, phase=phase).observe(ms)
+
+    def add_bytes(self, direction: str, n: int) -> None:
+        with self._lock:
+            self._bytes[direction] = self._bytes.get(direction, 0) + int(n)
+        if self.registry is not None:
+            self._c_bytes.labels(role=self.role, direction=direction).inc(n)
+
+    def count(self, event: str, n: int = 1) -> None:
+        with self._lock:
+            self._events[event] = self._events.get(event, 0) + int(n)
+        if self.registry is not None:
+            self._c_events.labels(role=self.role, event=event).inc(n)
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat name->value view: ``<phase>_ms_sum`` / ``<phase>_count``,
+        ``bytes_<direction>``, ``session_<event>``."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for phase, s in self._ms_sum.items():
+                out[f"{phase}_ms_sum"] = round(s, 3)
+                out[f"{phase}_count"] = float(self._ms_count[phase])
+            for direction, n in self._bytes.items():
+                out[f"bytes_{direction}"] = float(n)
+            for event, n in self._events.items():
+                out[f"session_{event}"] = float(n)
+            return out
+
+    def render(self) -> bytes:
+        if self.registry is None:  # pragma: no cover
+            return b""
         return generate_latest(self.registry)
 
 

@@ -162,10 +162,14 @@ class TestWarmArena:
         arena = NativeSolveArena(threads=2)
         arena.solve(ep, er, CostWeights())
 
-        # churn 5 providers' price and 3 tasks' priority
+        # churn 5 providers' SPECS (structural: candidate regeneration),
+        # 2 more providers' price (base-only: in-place cost shift), and
+        # 3 tasks' priority
+        mem = np.array(ep.gpu_mem_mb, copy=True)
+        mem[[3, 50, 99, 120, 200]] += 8000
         price = np.array(ep.price, copy=True)
-        price[[3, 50, 99, 120, 200]] += 0.5
-        ep2 = dataclasses.replace(ep, price=price)
+        price[[10, 11]] += 0.5
+        ep2 = dataclasses.replace(ep, gpu_mem_mb=mem, price=price)
         prio = np.array(er.priority, copy=True)
         prio[[7, 8, 9]] += 0.25
         er2 = dataclasses.replace(er, priority=prio)
@@ -183,12 +187,42 @@ class TestWarmArena:
         stats = arena.last_stats
         assert stats["cold"] is False
         assert stats["dirty_providers"] == 5
+        assert stats["base_only_providers"] == 2
         assert stats["dirty_tasks"] == 3
         # exactly two delta passes: [full-P x 3 dirty tasks] and
-        # [5 dirty providers x full-T] — never the full [P x T] pass
+        # [5 struct-dirty providers x full-T] — never the full [P x T]
+        # pass, and NO pass for the price-only providers (their cached
+        # costs shift in place)
         assert sorted(shapes) == sorted([(n, 3), (5, n)])
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
+
+    def test_base_only_churn_shifts_costs_in_place(self, monkeypatch):
+        """Price/load drift must NOT regenerate candidates: cached costs
+        shift by exactly the base delta (cost = base + static)."""
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace()
+        arena = NativeSolveArena(threads=2)
+        arena.solve(ep, er, CostWeights())
+        before_p = arena._cand_p.copy()
+        before_c = arena._cand_c.copy()
+
+        price = np.array(ep.price, copy=True)
+        price[7] += 0.25
+        monkeypatch.setattr(
+            native, "fused_topk_candidates",
+            lambda *a, **kw: pytest.fail("base-only churn ran a delta pass"),
+        )
+        arena.solve(dataclasses.replace(ep, price=price), er, CostWeights())
+        np.testing.assert_array_equal(arena._cand_p, before_p)
+        mask = before_p == 7
+        np.testing.assert_allclose(
+            arena._cand_c[mask], before_c[mask] + 0.25, rtol=1e-6
+        )
+        np.testing.assert_array_equal(arena._cand_c[~mask], before_c[~mask])
+        assert arena.last_stats["base_only_providers"] == 1
+        assert arena.last_stats["dirty_providers"] == 0
 
     def test_heavy_churn_falls_back_to_cold(self):
         from protocol_tpu.native.arena import NativeSolveArena
@@ -196,10 +230,30 @@ class TestWarmArena:
         ep, er = self._marketplace()
         arena = NativeSolveArena(threads=2, max_dirty_frac=0.1)
         arena.solve(ep, er, CostWeights())
-        price = np.array(ep.price, copy=True)
-        price += 0.01  # every provider dirty
-        p4t = arena.solve(dataclasses.replace(ep, price=price), er, CostWeights())
+        cores = np.array(ep.cpu_cores, copy=True)
+        cores += 1  # every provider STRUCT dirty
+        p4t = arena.solve(
+            dataclasses.replace(ep, cpu_cores=cores), er, CostWeights()
+        )
         assert arena.last_stats["cold"] is True
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size
+
+    def test_fleetwide_price_drift_stays_warm(self):
+        """A fleet-wide reprice is base-only churn: handled in place, no
+        cold rebuild even above max_dirty_frac."""
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace()
+        arena = NativeSolveArena(threads=2, max_dirty_frac=0.1)
+        arena.solve(ep, er, CostWeights())
+        price = np.array(ep.price, copy=True)
+        price += 0.01
+        p4t = arena.solve(
+            dataclasses.replace(ep, price=price), er, CostWeights()
+        )
+        assert arena.last_stats["cold"] is False
+        assert arena.last_stats["base_only_providers"] == len(price)
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
 

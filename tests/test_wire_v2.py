@@ -1,0 +1,501 @@
+"""Wire protocol v2: tensor frames, session epochs, streaming snapshots.
+
+Covers the ISSUE 2 contract: v1<->v2 wire parity (bit-identical
+matchings for the dense, sparse and warm kernels), a delta-session churn
+sequence (add/remove/mutate provider rows across >= 3 AssignDelta ticks
+checked against a full-snapshot reference arena), fingerprint-mismatch
+fallback, snapshots larger than one stream chunk, transport retry, and
+the session-loss recovery path. tests/test_scheduler_grpc.py stays
+UNMODIFIED — old v1 clients against the new server are proven there.
+"""
+
+import numpy as np
+import pytest
+
+import grpc
+
+import bench
+from protocol_tpu import native
+from protocol_tpu.ops.cost import CostWeights
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto import wire
+from protocol_tpu.services.scheduler_grpc import (
+    RemoteBatchMatcher,
+    SchedulerBackendClient,
+    encoded_to_proto,
+    encoded_to_proto_v2,
+    serve,
+)
+
+ADDR = "127.0.0.1:50975"
+NATIVE = native.available()
+
+
+@pytest.fixture(scope="module")
+def backend():
+    server = serve(address=ADDR)
+    client = SchedulerBackendClient(ADDR)
+    yield server, client
+    client.close()
+    server.stop(grace=None)
+
+
+def _market(seed=0, P=96, T=64):
+    rng = np.random.default_rng(seed)
+    return bench.synth_providers(rng, P), bench.synth_requirements(rng, T)
+
+
+# ---------------- tensor frames ----------------
+
+
+def test_blob_roundtrip():
+    for arr in (
+        np.arange(7, dtype=np.int32),
+        np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32),
+        np.array([True, False, True]),
+        np.zeros((2, 3, 4), np.uint32),
+    ):
+        out = wire.unblob(wire.blob(arr))
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_unblob_rejects_mismatch():
+    b = wire.blob(np.arange(4, dtype=np.int32))
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        wire.unblob(b, np.float32)
+    b2 = wire.blob(np.arange(4, dtype=np.int32))
+    b2.shape[:] = [5]
+    with pytest.raises(ValueError, match="size mismatch"):
+        wire.unblob(b2)
+
+
+def test_encode_decode_batches_roundtrip():
+    ep, er = _market()
+    ep2 = wire.decode_providers_v2(wire.encode_providers_v2(ep))
+    er2 = wire.decode_requirements_v2(wire.encode_requirements_v2(er))
+    for name in wire.P_WIRE_DTYPES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ep, name)), np.asarray(getattr(ep2, name)),
+            err_msg=name,
+        )
+    for name in wire.R_WIRE_DTYPES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(er, name)), np.asarray(getattr(er2, name)),
+            err_msg=name,
+        )
+
+
+# ---------------- v1 <-> v2 unary parity ----------------
+
+
+@pytest.mark.parametrize(
+    "kernel",
+    ["greedy", "auction", "sinkhorn", "topk"]
+    + (["native-mt:2"] if NATIVE else []),
+)
+def test_unary_wire_parity(backend, kernel):
+    """The codec must be invisible: same kernel, same matching, bit for
+    bit, whichever wire carried the batch."""
+    _, client = backend
+    ep, er = _market(seed=1)
+    r1 = client.assign(encoded_to_proto(ep, er, kernel=kernel, top_k=16))
+    r2 = client.assign_v2(
+        encoded_to_proto_v2(ep, er, kernel=kernel, top_k=16)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r1.provider_for_task, np.int32),
+        wire.unblob(r2.provider_for_task, np.int32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r1.task_for_provider, np.int32),
+        wire.unblob(r2.task_for_provider, np.int32),
+    )
+    assert r1.num_assigned == r2.num_assigned
+
+
+def test_warm_topk_wire_parity(backend):
+    """The stateless warm path (prices + seeds riding the wire) must be
+    codec-independent too."""
+    _, client = backend
+    ep, er = _market(seed=2)
+    cold1 = client.assign(encoded_to_proto(ep, er, kernel="topk", top_k=16))
+    warm_price = np.asarray(cold1.price, np.float32)
+    seeds = np.asarray(cold1.provider_for_task, np.int32)
+
+    req1 = encoded_to_proto(ep, er, kernel="topk", top_k=16)
+    req1.warm_price.extend(warm_price)
+    req1.seed_provider_for_task.extend(seeds)
+    warm1 = client.assign(req1)
+
+    req2 = encoded_to_proto_v2(ep, er, kernel="topk", top_k=16)
+    req2.warm_price.CopyFrom(wire.blob(warm_price, np.float32))
+    req2.seed_provider_for_task.CopyFrom(wire.blob(seeds, np.int32))
+    warm2 = client.assign_v2(req2)
+
+    np.testing.assert_array_equal(
+        np.asarray(warm1.provider_for_task, np.int32),
+        wire.unblob(warm2.provider_for_task, np.int32),
+    )
+
+
+# ---------------- session epochs ----------------
+
+
+def _open(client, p_cols, r_cols, kernel="native-mt:2", top_k=16,
+          session_id="s-test", chunk_bytes=1 << 20, fp=None):
+    w = CostWeights()
+    if fp is None:
+        fp = wire.epoch_fingerprint(p_cols, r_cols, w, kernel, top_k, 0.02, 0)
+    req = encoded_to_proto_v2(
+        wire.take_rows(p_cols, slice(None)),
+        wire.take_rows(r_cols, slice(None)),
+        w, kernel=kernel, top_k=top_k, eps=0.02,
+    )
+    chunks = list(
+        wire.chunk_snapshot(session_id, fp, req, chunk_bytes=chunk_bytes)
+    )
+    return client.open_session(iter(chunks)), fp, chunks
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestSessionProtocol:
+    def test_delta_churn_sequence_matches_full_snapshot_reference(
+        self, backend
+    ):
+        """>= 3 AssignDelta ticks with add/remove/mutate provider rows:
+        every tick's matching must be BIT-IDENTICAL to a reference warm
+        arena fed the same sequence as full snapshots — the delta codec
+        reconstructs the same server-side state, so the same solver sees
+        the same inputs."""
+        from protocol_tpu.native.arena import NativeSolveArena
+        from protocol_tpu.services.session_store import _as_ns, _pad_cols
+
+        _, client = backend
+        P, T = 96, 64
+        ep, er = _market(seed=3, P=P, T=T)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, fp, _ = _open(client, p_cols, r_cols, session_id="s-churn")
+        assert resp.ok, resp.error
+
+        ref = NativeSolveArena(k=16, threads=2)
+        w = CostWeights()
+        r_pad = _pad_cols(r_cols, T)
+        ref_p4t = ref.solve(
+            _as_ns(_pad_cols(p_cols, P)), _as_ns(r_pad), w
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref_p4t)[:T],
+            wire.unblob(resp.result.provider_for_task, np.int32),
+        )
+
+        rng = np.random.default_rng(7)
+        cur = {k: v.copy() for k, v in p_cols.items()}
+        for tick in range(1, 4):
+            rows = [tick, 10 + tick, 40 + tick]
+            # mutate: reprice one row; remove: invalidate one row;
+            # add (rejoin): revalidate a previously-removed row with
+            # fresh specs — the three churn classes of a live fleet
+            cur["price"][rows[0]] = np.float32(rng.uniform(0.5, 4.0))
+            cur["valid"][rows[1]] = False
+            cur["valid"][rows[2]] = True
+            cur["gpu_mem_mb"][rows[2]] = np.int32(80000)
+            idx = np.asarray(rows, np.int32)
+            dreq = pb.AssignDeltaRequest(
+                session_id="s-churn", epoch_fingerprint=fp, tick=tick
+            )
+            dreq.provider_rows.CopyFrom(wire.blob(idx, np.int32))
+            dreq.providers.CopyFrom(
+                wire.encode_providers_v2(wire.take_rows(cur, idx))
+            )
+            dresp = client.assign_delta(dreq)
+            assert dresp.session_ok, dresp.error
+            got = wire.unblob(dresp.result.provider_for_task, np.int32)
+
+            ref_pad = _pad_cols(cur, P)
+            ref_p4t = ref.solve(
+                _as_ns({k: v.copy() for k, v in ref_pad.items()}),
+                _as_ns(r_pad), w,
+            )
+            np.testing.assert_array_equal(np.asarray(ref_p4t)[:T], got)
+            # the matching must be injective and never seat a removed row
+            pos = got[got >= 0]
+            assert np.unique(pos).size == pos.size
+            assert not np.isin(pos, np.flatnonzero(~cur["valid"])).any()
+
+    def test_fingerprint_mismatch_refused(self, backend):
+        _, client = backend
+        ep, er = _market(seed=4)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, fp, _ = _open(client, p_cols, r_cols, session_id="s-fp")
+        assert resp.ok
+        bad = pb.AssignDeltaRequest(
+            session_id="s-fp", epoch_fingerprint="deadbeef", tick=1
+        )
+        r = client.assign_delta(bad)
+        assert not r.session_ok
+        assert "fingerprint" in r.error
+
+    def test_unknown_session_refused(self, backend):
+        _, client = backend
+        r = client.assign_delta(
+            pb.AssignDeltaRequest(
+                session_id="never-opened", epoch_fingerprint="x", tick=1
+            )
+        )
+        assert not r.session_ok
+        assert "unknown" in r.error
+
+    def test_tick_replay_refused(self, backend):
+        _, client = backend
+        ep, er = _market(seed=5)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, fp, _ = _open(client, p_cols, r_cols, session_id="s-tick")
+        assert resp.ok
+        ok = client.assign_delta(pb.AssignDeltaRequest(
+            session_id="s-tick", epoch_fingerprint=fp, tick=1
+        ))
+        assert ok.session_ok
+        replay = client.assign_delta(pb.AssignDeltaRequest(
+            session_id="s-tick", epoch_fingerprint=fp, tick=1
+        ))
+        assert not replay.session_ok
+        assert "tick" in replay.error
+
+    def test_client_claimed_fingerprint_is_verified(self, backend):
+        """A client whose codec disagrees with the server must be told at
+        OPEN time, not drift silently."""
+        _, client = backend
+        ep, er = _market(seed=6)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, _, _ = _open(
+            client, p_cols, r_cols, session_id="s-bad", fp="not-the-hash"
+        )
+        assert not resp.ok
+        assert "fingerprint" in resp.error
+
+    def test_non_native_kernel_refused_falls_to_unary(self, backend):
+        _, client = backend
+        ep, er = _market(seed=7)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, _, _ = _open(
+            client, p_cols, r_cols, kernel="topk", session_id="s-topk"
+        )
+        assert not resp.ok
+        assert "session-servable" in resp.error
+
+    def test_snapshot_streams_in_multiple_chunks(self, backend):
+        """A snapshot larger than one chunk must reassemble exactly
+        (gzip on, 512-byte chunks -> many frames)."""
+        _, client = backend
+        ep, er = _market(seed=8, P=128, T=96)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        resp, fp, chunks = _open(
+            client, p_cols, r_cols, session_id="s-chunks", chunk_bytes=512
+        )
+        assert len(chunks) > 3
+        assert chunks[0].codec in ("", "gzip")
+        assert chunks[0].total_bytes == sum(len(c.payload) for c in chunks)
+        assert resp.ok, resp.error
+        assert resp.epoch_fingerprint == fp
+
+    def test_truncated_snapshot_rejected(self, backend):
+        _, client = backend
+        ep, er = _market(seed=9)
+        p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
+        r_cols = wire.canon_columns(er, wire.R_WIRE_DTYPES)
+        w = CostWeights()
+        fp = wire.epoch_fingerprint(
+            p_cols, r_cols, w, "native-mt:2", 16, 0.02, 0
+        )
+        req = encoded_to_proto_v2(
+            wire.take_rows(p_cols, slice(None)),
+            wire.take_rows(r_cols, slice(None)),
+            w, kernel="native-mt:2", top_k=16, eps=0.02,
+        )
+        chunks = list(wire.chunk_snapshot("s-trunc", fp, req, chunk_bytes=512))
+        resp = client.open_session(iter(chunks[:-1]))  # drop the tail
+        assert not resp.ok
+        assert "truncated" in resp.error
+
+
+# ---------------- the matcher client half ----------------
+
+
+def _pool_world(n_nodes=12, n_tasks=5):
+    import random
+
+    from tests.test_encoding import random_specs
+    from protocol_tpu.models.task import SchedulingConfig, Task, TaskRequest
+    from protocol_tpu.store import NodeStatus, OrchestratorNode, StoreContext
+
+    rng = random.Random(7)
+    store = StoreContext.new_test()
+    for i in range(n_nodes):
+        store.node_store.add_node(
+            OrchestratorNode(
+                address=f"0xnode{i:02d}",
+                status=NodeStatus.HEALTHY,
+                ip_address=f"10.0.0.{i}",
+                port=9000 + i,
+                compute_specs=random_specs(rng),
+            )
+        )
+    for i in range(n_tasks):
+        cfg = None
+        if i % 2 == 0:
+            cfg = SchedulingConfig(plugins={"tpu_scheduler": {"replicas": ["2"]}})
+        store.task_store.add_task(
+            Task.from_request(
+                TaskRequest(name=f"task-{i}", image="img", scheduling_config=cfg)
+            )
+        )
+    return store
+
+
+def test_remote_matcher_v2_parity_with_v1(backend):
+    """wire=v2 is a codec change, not a scheduler change: the assignment
+    must match wire=v1 exactly."""
+    store = _pool_world()
+    m1 = RemoteBatchMatcher(store, ADDR, min_solve_interval=0.0, wire="v1")
+    m2 = RemoteBatchMatcher(store, ADDR, min_solve_interval=0.0, wire="v2")
+    m1.refresh()
+    m2.refresh()
+    assert m1._assignment == m2._assignment
+    assert m2._assignment, "v2 matcher assigned nothing"
+    assert m2.last_solve_stats["wire"] == "v2"
+    assert m2.last_solve_stats["remote_bytes_out"] > 0
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+def test_remote_matcher_session_reuse_and_recovery(backend):
+    """The native-mt matcher rides the session protocol: repeat
+    refreshes advance the session tick (deltas, not snapshots), and a
+    server-side session loss re-opens transparently."""
+    server, _ = backend
+    store = _pool_world()
+    m = RemoteBatchMatcher(
+        store, ADDR, min_solve_interval=0.0, wire="v2",
+        native_fallback=True, native_engine="native-mt", native_threads=2,
+    )
+    m.refresh()
+    assert m._session is not None and m._session["tick"] == 0
+    m.refresh()
+    assert m._session["tick"] == 1  # delta tick, not a new snapshot
+
+    # evict server-side (replica restart / LRU): next refresh must
+    # re-open from client state instead of erroring the scheduler tick
+    server.servicer.sessions.drop(m._session["id"])
+    m.refresh()
+    assert m._session["tick"] == 0
+    assert m.seam.snapshot().get("session_session_reopen", 0) >= 1
+    assert m._assignment
+
+
+class _FlakyClient:
+    """Wraps a real client; fails the first N calls of each RPC with a
+    retryable code."""
+
+    def __init__(self, real, fail_n=1,
+                 code=grpc.StatusCode.UNAVAILABLE, only=None):
+        self._real = real
+        self._fails = {"assign": fail_n, "assign_v2": fail_n,
+                       "assign_delta": fail_n, "open_session": fail_n}
+        self._code = code
+        self._only = only
+        self.address = real.address
+
+    def _maybe_fail(self, name):
+        if self._only is not None and name not in self._only:
+            return
+        if self._fails[name] > 0:
+            self._fails[name] -= 1
+            err = grpc.RpcError()
+            err.code = lambda: self._code
+            raise err
+
+    def assign(self, *a, **k):
+        self._maybe_fail("assign")
+        return self._real.assign(*a, **k)
+
+    def assign_v2(self, *a, **k):
+        self._maybe_fail("assign_v2")
+        return self._real.assign_v2(*a, **k)
+
+    def assign_delta(self, *a, **k):
+        self._maybe_fail("assign_delta")
+        return self._real.assign_delta(*a, **k)
+
+    def open_session(self, *a, **k):
+        self._maybe_fail("open_session")
+        return self._real.open_session(*a, **k)
+
+    def health(self, *a, **k):
+        return self._real.health(*a, **k)
+
+    def close(self):
+        pass
+
+
+def test_transient_unavailable_is_retried(backend):
+    """One flaky RPC must not fail a scheduler tick: bounded backoff +
+    reconnect, then success."""
+    store = _pool_world(n_nodes=6, n_tasks=2)
+    m = RemoteBatchMatcher(
+        store, ADDR, min_solve_interval=0.0, wire="v1",
+        retries=2, retry_base_s=0.01,
+    )
+    real = m.client
+    m.client = _FlakyClient(real, fail_n=1)
+    m._reconnect = lambda: None  # keep the flaky wrapper through retries
+    m.refresh()
+    assert m._assignment
+    assert m.seam.snapshot().get("session_retry", 0) >= 1
+    real.close()
+
+
+def test_retry_budget_exhausted_raises(backend):
+    store = _pool_world(n_nodes=4, n_tasks=2)
+    m = RemoteBatchMatcher(
+        store, ADDR, min_solve_interval=0.0, wire="v1",
+        retries=1, retry_base_s=0.01,
+    )
+    real = m.client
+    m.client = _FlakyClient(real, fail_n=5)
+    m._reconnect = lambda: None
+    with pytest.raises(grpc.RpcError):
+        m.refresh()
+    real.close()
+
+
+def test_unimplemented_v2_falls_back_to_v1(backend):
+    """Against an old server (no v2 RPCs) the matcher must drop to the
+    frozen v1 contract permanently, not error."""
+    store = _pool_world(n_nodes=6, n_tasks=2)
+    m = RemoteBatchMatcher(store, ADDR, min_solve_interval=0.0, wire="v2")
+    real = m.client
+    m.client = _FlakyClient(
+        real, fail_n=99, code=grpc.StatusCode.UNIMPLEMENTED,
+        only={"assign_v2", "assign_delta", "open_session"},
+    )
+    m._reconnect = lambda: None
+    m.refresh()
+    assert m.wire == "v1"
+    assert m._assignment
+    assert m.seam.snapshot().get("session_fallback_v1", 0) >= 1
+    real.close()
+
+
+def test_health_exposes_seam_metrics(backend):
+    _, client = backend
+    h = client.health()
+    assert h.status == "ok"
+    names = {s.name for s in h.seam_metrics}
+    assert "sessions_active" in names
+    assert any(n.startswith("solve_") for n in names)
