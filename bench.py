@@ -579,15 +579,21 @@ def main() -> None:
             f"({n_assigned / total:,.0f} assignments/s; greedy end-to-end "
             f"{baseline_total * 1e3:.1f} ms)"
         )
+        # Platform provenance rides in a dedicated "platform" field, NOT
+        # in the metric name: a provenance-suffixed name made the same
+        # measurement land under different metric keys depending on the
+        # host's accelerator health, corrupting cross-round joins over
+        # the BENCH_r0*.json series. The metric NAME is stable.
         if engine == "native-mt":
             mt = bench_native_mt(ep, er, threads, iters, total)
             print(
                 json.dumps(
                     {
                         "metric": (
-                            f"sparse_top{TOPK}_{P}x{T}_native_mt_engine_match_"
-                            "throughput_NATIVE_CPU_ENGINE_REQUESTED"
+                            f"sparse_top{TOPK}_{P}x{T}_native_mt_engine_"
+                            "match_throughput"
                         ),
+                        "platform": "native_cpu_engine_requested",
                         "value": round(mt["assigned"] / mt["wall_s"], 1),
                         "unit": "assignments/sec",
                         "vs_baseline": round(baseline_total / mt["wall_s"], 2),
@@ -603,8 +609,9 @@ def main() -> None:
                 {
                     "metric": (
                         f"sparse_top{TOPK}_{P}x{T}_native_engine_match_"
-                        "throughput_NATIVE_CPU_FALLBACK_accelerator_unreachable"
+                        "throughput"
                     ),
+                    "platform": "native_cpu_fallback_accelerator_unreachable",
                     "value": round(n_assigned / total, 1),
                     "unit": "assignments/sec",
                     "vs_baseline": round(baseline_total / total, 2),
@@ -637,11 +644,16 @@ def main() -> None:
     log(f"tpu full-match wall: {tpu_time * 1e3:.1f} ms  ({n_assigned / tpu_time:,.0f} assignments/s)")
 
     value = n_assigned / tpu_time
-    suffix = "_CPU_FALLBACK_accelerator_unreachable" if fallback else ""
+    # stable metric name; provenance in the "platform" field (see the
+    # degraded-mode emitters above for why)
+    platform = jax.devices()[0].platform + (
+        "_fallback_accelerator_unreachable" if fallback else ""
+    )
     print(
         json.dumps(
             {
-                "metric": f"sparse_top{TOPK}_{P}x{T}_auction_match_throughput{suffix}",
+                "metric": f"sparse_top{TOPK}_{P}x{T}_auction_match_throughput",
+                "platform": platform,
                 "value": round(value, 1),
                 "unit": "assignments/sec",
                 "vs_baseline": round(cpu_time / tpu_time, 2),

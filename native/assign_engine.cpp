@@ -1309,4 +1309,207 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
   return assigned;
 }
 
+// ---------------------------------------------------------------------------
+// Sparse multi-threaded Sinkhorn (engine=sinkhorn-mt): log-domain entropic
+// OT restricted to the top-K candidate edges. The blocked JAX kernel
+// (ops/blocked.py sinkhorn_potentials_blocked) pays O(P*T) dense tile work
+// per iteration — ~10^10 cell updates per sweep at 100k x 100k, which is
+// what killed the round-5 ladder-#3 attempt (rc=143). This engine iterates
+// ONLY over the nnz = T*K candidate edges (~8M at 100k with K_eff=80):
+//
+//   row (task) update      g_t = eps*(log_b - lse_j((f_{p_tj} - c_tj)/eps))
+//                          task-chunked across threads; each task's K-entry
+//                          logsumexp is computed serially by one thread.
+//   column (provider) update  over a CSR transpose (provider-major edge
+//                          lists, built once per call by a counting sort in
+//                          ascending edge order): provider-chunked across
+//                          threads, each provider's reduction serial.
+//
+// DETERMINISM: every row/column is reduced start-to-finish by exactly one
+// thread in a fixed (ascending-edge) order, so chunk boundaries — and
+// therefore the thread count — cannot change a single bit of the result.
+// Math is double internally with potentials stored f32 after each update
+// (the same rounding schedule as the NumPy reference in ops/sparse.py, so
+// parity is exact up to libm exp/log ulps).
+//
+// Potentials f[P] (providers), g[T] (tasks) are DUAL potentials in cost
+// units: they carry unchanged across eps-annealing phases and across warm
+// re-solves after churn (the plan exp((f+g-c)/eps) is invariant under the
+// uniform shift (f-s, g+s), mirroring the warm auction's price-downshift
+// soundness argument). Marginals are the balanced uniform marginals of
+// ops/blocked.py: a_p = m/np_valid, b_t = m/nt_valid, m = min(np, nt)
+// over rows/columns with at least one feasible edge.
+//
+// One eps phase per call: iterate until the provider-marginal drift
+// max_p |sum_t pi_pt - a_p| / a_p falls below tol or max_iters runs out
+// (task marginals are exact after every g update by construction). The
+// caller loops the anneal schedule (native.sinkhorn_sparse_anneal), which
+// also gives per-phase wall-clock for free. Returns iterations run.
+int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
+                           const float* cand_cost, int32_t P, int32_t T,
+                           int32_t K, float eps, int32_t max_iters, float tol,
+                           int32_t threads, float* f_io, float* g_io,
+                           float* out_err) {
+  const int64_t slots = static_cast<int64_t>(T) * K;
+  // CSR transpose: provider-major edge lists in ascending edge order
+  // (counting sort with a sequential fill — the fill order is what makes
+  // the per-provider reduction order thread-count independent).
+  std::vector<int64_t> col_ptr(static_cast<size_t>(P) + 1, 0);
+  std::vector<uint8_t> col_any(T, 0);
+  for (int64_t e = 0; e < slots; ++e) {
+    const int32_t p = cand_provider[e];
+    if (p < 0 || p >= P || cand_cost[e] >= kInfeasible * 0.5f) continue;
+    ++col_ptr[p + 1];
+    col_any[e / K] = 1;
+  }
+  for (int32_t p = 0; p < P; ++p) col_ptr[p + 1] += col_ptr[p];
+  std::vector<int64_t> col_edge(col_ptr[P]);
+  std::vector<int32_t> col_task(col_ptr[P]);  // task id per CSR slot:
+  // hoists the e / K division out of the O(nnz * iters) hot loops (the
+  // counting sort visits every edge anyway)
+  {
+    std::vector<int64_t> fill(col_ptr.begin(), col_ptr.end() - 1);
+    for (int64_t e = 0; e < slots; ++e) {
+      const int32_t p = cand_provider[e];
+      if (p < 0 || p >= P || cand_cost[e] >= kInfeasible * 0.5f) continue;
+      col_task[fill[p]] = static_cast<int32_t>(e / K);
+      col_edge[fill[p]++] = e;
+    }
+  }
+  int64_t np_valid = 0, nt_valid = 0;
+  for (int32_t p = 0; p < P; ++p) np_valid += col_ptr[p + 1] > col_ptr[p];
+  for (int32_t t = 0; t < T; ++t) nt_valid += col_any[t];
+  if (np_valid == 0 || nt_valid == 0) {
+    if (out_err != nullptr) *out_err = 0.0f;
+    return 0;
+  }
+  const double m = static_cast<double>(std::min(np_valid, nt_valid));
+  const double log_a = std::log(m / static_cast<double>(np_valid));
+  const double log_b = std::log(m / static_cast<double>(nt_valid));
+  const double a_mass = m / static_cast<double>(np_valid);
+  const double inv_eps = 1.0 / static_cast<double>(eps);
+  const double deps = static_cast<double>(eps);
+
+  const int nt = resolve_threads(threads, std::max(P, T));
+  // same wakeup-amortization threshold family as the -mt auction: tiny
+  // instances run inline on the caller (identical values either way)
+  constexpr int32_t kParMinRows = 4096;
+  HelperPool* pool = nullptr;
+  if (nt > 1 && std::max(P, T) >= kParMinRows) pool = new HelperPool(nt - 1);
+  const auto par_rows = [&](int32_t n,
+                            const std::function<void(int, int32_t, int32_t)>&
+                                body) {
+    if (pool == nullptr || n < kParMinRows) {
+      body(0, 0, n);
+      return;
+    }
+    const int32_t chunk = (n + nt - 1) / nt;
+    pool->run([&](int tid) {
+      const int32_t lo = std::min<int32_t>(tid * chunk, n);
+      const int32_t hi = std::min<int32_t>(lo + chunk, n);
+      if (lo < hi) body(tid, lo, hi);
+    });
+  };
+
+  std::vector<double> err_tid(nt, 0.0);
+  int32_t it = 0;
+  double err = 0.0, prev_err = HUGE_VAL;
+  int stall = 0;
+  while (it < max_iters) {
+    ++it;
+    // ---- f (provider/column) update over the CSR transpose
+    par_rows(P, [&](int, int32_t lo, int32_t hi) {
+      for (int32_t p = lo; p < hi; ++p) {
+        const int64_t b = col_ptr[p], e_end = col_ptr[p + 1];
+        if (b == e_end) continue;  // no edges: potential untouched
+        double mx = -HUGE_VAL;
+        for (int64_t i = b; i < e_end; ++i) {
+          const double v = (static_cast<double>(g_io[col_task[i]]) -
+                            static_cast<double>(cand_cost[col_edge[i]])) *
+                           inv_eps;
+          if (v > mx) mx = v;
+        }
+        double s = 0.0;
+        for (int64_t i = b; i < e_end; ++i) {
+          const double v = (static_cast<double>(g_io[col_task[i]]) -
+                            static_cast<double>(cand_cost[col_edge[i]])) *
+                           inv_eps;
+          s += std::exp(v - mx);
+        }
+        f_io[p] = static_cast<float>(deps * (log_a - (mx + std::log(s))));
+      }
+    });
+    // ---- g (task/row) update over the [T, K] slot layout
+    par_rows(T, [&](int, int32_t lo, int32_t hi) {
+      for (int32_t t = lo; t < hi; ++t) {
+        if (!col_any[t]) continue;
+        const int64_t row = static_cast<int64_t>(t) * K;
+        double mx = -HUGE_VAL;
+        for (int32_t j = 0; j < K; ++j) {
+          const int32_t p = cand_provider[row + j];
+          // same edge filter as the CSR build: p >= P guards the f_io
+          // read against out-of-range provider ids (caller mismatch
+          // between padded candidate lists and an unpadded P)
+          if (p < 0 || p >= P ||
+              cand_cost[row + j] >= kInfeasible * 0.5f) continue;
+          const double v = (static_cast<double>(f_io[p]) -
+                            static_cast<double>(cand_cost[row + j])) * inv_eps;
+          if (v > mx) mx = v;
+        }
+        double s = 0.0;
+        for (int32_t j = 0; j < K; ++j) {
+          const int32_t p = cand_provider[row + j];
+          if (p < 0 || p >= P ||
+              cand_cost[row + j] >= kInfeasible * 0.5f) continue;
+          const double v = (static_cast<double>(f_io[p]) -
+                            static_cast<double>(cand_cost[row + j])) * inv_eps;
+          s += std::exp(v - mx);
+        }
+        g_io[t] = static_cast<float>(deps * (log_b - (mx + std::log(s))));
+      }
+    });
+    // ---- provider-marginal drift (task marginals are exact after g):
+    // per-thread maxima merged by max — order-independent, deterministic
+    for (int i = 0; i < nt; ++i) err_tid[i] = 0.0;
+    par_rows(P, [&](int tid, int32_t lo, int32_t hi) {
+      double worst = 0.0;
+      for (int32_t p = lo; p < hi; ++p) {
+        const int64_t b = col_ptr[p], e_end = col_ptr[p + 1];
+        if (b == e_end) continue;
+        double s = 0.0;
+        const double fp = static_cast<double>(f_io[p]);
+        for (int64_t i = b; i < e_end; ++i) {
+          s += std::exp((fp + static_cast<double>(g_io[col_task[i]]) -
+                         static_cast<double>(cand_cost[col_edge[i]])) *
+                        inv_eps);
+        }
+        const double d = std::fabs(s - a_mass) / a_mass;
+        if (d > worst) worst = d;
+      }
+      if (worst > err_tid[tid]) err_tid[tid] = worst;
+    });
+    err = 0.0;
+    for (int i = 0; i < nt; ++i) err = std::max(err, err_tid[i]);
+    if (err <= static_cast<double>(tol)) break;
+    // Stagnation exit: on a candidate support whose uniform marginals are
+    // INFEASIBLE (a provider pocket that cannot absorb its share — common
+    // on sparse top-K graphs), the potentials drift without bound while
+    // the marginal error plateaus above tol. Two consecutive <0.5%-
+    // improvement checks (after a settling window — early iterations are
+    // legitimately non-monotonic) stop the burn; the plan's argmax
+    // structure has long stabilized by then, which is all the rounding
+    // referee consumes. Deterministic: err is a pure function of the
+    // iteration state.
+    if (it >= 8 && err >= 0.995 * prev_err) {
+      if (++stall >= 2) break;
+    } else {
+      stall = 0;
+    }
+    prev_err = err;
+  }
+  delete pool;
+  if (out_err != nullptr) *out_err = static_cast<float>(err);
+  return it;
+}
+
 }  // extern "C"

@@ -127,6 +127,12 @@ def load() -> ctypes.CDLL:
         ctypes.c_void_p, i32p,
     ]
     lib.auction_sparse_mt.restype = ctypes.c_int32
+    lib.sinkhorn_sparse_mt.argtypes = [
+        i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_int32, ctypes.c_float, ctypes.c_int32,
+        f32p, f32p, ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.sinkhorn_sparse_mt.restype = ctypes.c_int32
     _lib = lib
     return lib
 
@@ -362,3 +368,179 @@ def auction_sparse_mt(
         price_io, retired_io, seed_ptr, int(max_release), mask_ptr, out,
     )
     return out, price_io, retired_io.astype(bool)
+
+
+def sinkhorn_sparse_mt(
+    cand_provider: np.ndarray,
+    cand_cost: np.ndarray,
+    num_providers: int,
+    eps: float = 0.05,
+    max_iters: int = 100,
+    tol: float = 1e-3,
+    threads: int = 0,
+    f: Optional[np.ndarray] = None,
+    g: Optional[np.ndarray] = None,
+) -> tuple[np.ndarray, np.ndarray, int, float]:
+    """One eps phase of the sparse multi-threaded Sinkhorn engine
+    (engine=sinkhorn-mt): log-domain entropic OT restricted to the top-K
+    candidate edges — O(nnz) per iteration instead of the blocked JAX
+    kernel's O(P*T) dense tile sweeps (the 100k x 100k rc=143 killer).
+
+    ``f`` [P] / ``g`` [T] are DUAL potentials in cost units, consumed AND
+    returned updated (pass None for a cold start): they carry unchanged
+    across eps-annealing phases and across warm re-solves after churn —
+    the plan exp((f+g-c)/eps) is invariant under the uniform shift
+    (f-s, g+s), the same soundness argument as the warm auction's price
+    downshift. The result is BIT-IDENTICAL for every thread count (each
+    row/column is reduced serially by one thread in a fixed edge order)
+    and matches :func:`protocol_tpu.ops.sparse.sinkhorn_potentials_sparse_np`
+    up to libm ulps.
+
+    Iterates until the provider-marginal drift falls below ``tol`` or
+    ``max_iters`` runs out (task marginals are exact after every update).
+    Returns (f, g, iterations_run, final_marginal_err).
+    """
+    lib = load()
+    if not float(eps) > 0.0:
+        # eps = 0 turns the engine's 1/eps into inf and fills the
+        # potentials with NaN; refuse at the seam
+        raise ValueError(f"eps must be > 0, got {eps}")
+    cand_p = np.ascontiguousarray(cand_provider, np.int32)
+    cand_c = np.ascontiguousarray(cand_cost, np.float32)
+    T, K = cand_p.shape
+    f_io = (
+        np.zeros(num_providers, np.float32)
+        if f is None
+        else np.array(f, np.float32, copy=True)
+    )
+    if f_io.shape[0] != num_providers:
+        raise ValueError(f"f has {f_io.shape[0]} rows, want {num_providers}")
+    g_io = (
+        np.zeros(T, np.float32)
+        if g is None
+        else np.array(g, np.float32, copy=True)
+    )
+    if g_io.shape[0] != T:
+        raise ValueError(f"g has {g_io.shape[0]} rows, want {T}")
+    err = ctypes.c_float(0.0)
+    iters = lib.sinkhorn_sparse_mt(
+        cand_p, cand_c, num_providers, T, K,
+        float(eps), int(max_iters), float(tol), int(threads),
+        f_io, g_io, ctypes.byref(err),
+    )
+    return f_io, g_io, int(iters), float(err.value)
+
+
+def sinkhorn_sparse_anneal(
+    cand_provider: np.ndarray,
+    cand_cost: np.ndarray,
+    num_providers: int,
+    eps_start: float = 1.0,
+    eps_end: float = 0.05,
+    scale: float = 0.25,
+    iters_per_phase: int = 50,
+    tol: float = 1e-3,
+    threads: int = 0,
+    f: Optional[np.ndarray] = None,
+    g: Optional[np.ndarray] = None,
+    phase_stats: Optional[list] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Epsilon-annealing ladder over :func:`sinkhorn_sparse_mt`: geometric
+    eps descent (eps_start -> eps_end by ``scale``) with the dual
+    potentials carried across phases verbatim — coarse phases place the
+    bulk of the mass in a handful of cheap iterations, fine phases only
+    sharpen it (the entropic twin of the auction's eps-scaling).
+
+    ``phase_stats`` (a list, appended in place) records per-phase
+    ``{"eps", "iters", "err", "wall_s"}`` — the ladder-#3 artifact's
+    wall-clock-per-anneal-phase evidence. Returns (f, g)."""
+    import time as _time
+
+    if not (float(eps_end) > 0.0 and float(eps_start) > 0.0):
+        # eps_end <= 0 is unreachable by geometric descent: the ladder
+        # would burn ~1200 futile phases until eps underflows to exactly
+        # 0.0 and the engine's 1/eps goes inf (NaN potentials) — refuse
+        # up front, like sinkhorn_sparse_mt itself
+        raise ValueError(
+            f"eps_start/eps_end must be > 0, got {eps_start}/{eps_end}"
+        )
+    if eps_start < eps_end:
+        # an ascending pair would silently run ONE phase at eps_start and
+        # return un-annealed potentials — a swapped-argument bug, not a
+        # configuration; refuse like the other misconfigurations
+        raise ValueError(
+            f"eps_start ({eps_start}) must be >= eps_end ({eps_end})"
+        )
+    if eps_start > eps_end and not (0.0 < scale < 1.0):
+        # the ladder only terminates by eps DESCENDING to eps_end: a
+        # non-contracting scale would spin phases forever (and in the
+        # gRPC servicer, forever while holding a session lock and a
+        # thread-budget grant)
+        raise ValueError(
+            f"scale must be in (0, 1) when eps_start > eps_end, got {scale}"
+        )
+    eps = float(eps_start)
+    while True:
+        t0 = _time.perf_counter()
+        f, g, iters, err = sinkhorn_sparse_mt(
+            cand_provider, cand_cost, num_providers,
+            eps=eps, max_iters=iters_per_phase, tol=tol, threads=threads,
+            f=f, g=g,
+        )
+        if phase_stats is not None:
+            phase_stats.append({
+                "eps": round(eps, 6),
+                "iters": iters,
+                "err": round(err, 6),
+                "wall_s": round(_time.perf_counter() - t0, 4),
+            })
+        if eps <= eps_end:
+            return f, g
+        eps = max(eps * scale, float(eps_end))
+
+
+def sinkhorn_referee_prices(
+    f: np.ndarray,
+    cand_provider: np.ndarray,
+    cand_cost: np.ndarray,
+) -> np.ndarray:
+    """Auction-referee seed prices from the Sinkhorn provider duals:
+    ``price = max(f) - f``, capped at ``max_cost + 5``.
+
+    The plan prefers exactly the edges maximizing f_p - c, which is the
+    auction's value ordering under price = -f; the uniform downshift by
+    max(f) keeps prices nonnegative without changing a single price
+    DIFFERENCE (shift invariance — the same soundness argument as the
+    warm auction's price downshift). The CAP keeps every provider
+    biddable: on a support whose uniform marginals are infeasible, the
+    duals of unreachable provider pockets diverge toward -inf, and an
+    uncapped spread pushes their tasks past the referee's give-up floor
+    (-(2*max_cost + 10)) before a single bid — measured ~10% assignment
+    loss at 512. With the cap at max_cost + 5, every feasible edge's
+    value stays above give-up, so retirement can only come from real
+    bidding, never from the seed. (Unlike the r5 warm-price-clamp
+    pathology this flattens only the DIVERGED tail — converged duals
+    live within the cost scale and keep their differences.)
+
+    This is the ONE home of the seeding formula — the arena, the perf
+    gate, the stage-S script, and bench_scaling all call it, so a change
+    to the give-up floor or the cap can never leave a gate measuring a
+    stale seeding."""
+    # lazy import: ops.cost pulls in jax, which this module must not do
+    # at import time (control-plane processes load it with no backend)
+    from protocol_tpu.ops.cost import INFEASIBLE
+
+    f = np.asarray(f, np.float32)
+    if f.size == 0:
+        return np.zeros(0, np.float32)
+    cand_p = np.asarray(cand_provider)
+    cand_c = np.asarray(cand_cost)
+    # the SAME feasibility cutoff the engine and the auction use
+    # (kInfeasible * 0.5): a narrower cutoff would compute max_cost over
+    # fewer edges than the referee bids on and the cap would clamp
+    # converged duals it promises to preserve
+    feas = (cand_p >= 0) & (cand_c < INFEASIBLE * 0.5)
+    max_cost = float(cand_c[feas].max()) if feas.any() else 0.0
+    return np.minimum(
+        np.float32(f.max()) - f, np.float32(max_cost + 5.0)
+    )

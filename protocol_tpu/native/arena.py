@@ -108,7 +108,21 @@ class NativeSolveArena:
         max_release: int = 64,
         dual_refresh_every: int = 16,
         warm_eps_start: float = 0.32,
+        engine: str = "auction",
+        sink_eps_start: float = 1.0,
+        sink_eps_end: float = 0.05,
+        sink_scale: float = 0.25,
+        sink_iters: int = 50,
+        # marginal-drift tolerance: the rounding referee consumes the
+        # plan's ARGMAX structure, which stabilizes one to two orders
+        # before the marginals polish — 1e-2 halves the iteration bill
+        # with no measured effect on the rounded matching
+        sink_tol: float = 1e-2,
     ):
+        if engine not in ("auction", "sinkhorn"):
+            raise ValueError(
+                f"engine must be auction|sinkhorn, got {engine!r}"
+            )
         self.k = k
         self.reverse_r = reverse_r
         self.extra = extra
@@ -117,6 +131,23 @@ class NativeSolveArena:
         self.max_dirty_frac = max_dirty_frac
         self.eps_start = eps_start
         self.eps_end = eps_end
+        # Solve engine over the (shared) candidate structure:
+        #   "auction"   the eps-scaled Jacobi auction with full dual carry
+        #               (prices + retirement + matching) — the PR-1 path.
+        #   "sinkhorn"  sparse entropic OT (native.sinkhorn_sparse_mt):
+        #               O(nnz) log-domain potentials annealed over an eps
+        #               ladder, warm (f, g) carry across churn (uniform-
+        #               shift invariant, so carried potentials are sound),
+        #               then INJECTIVE rounding by the sparse auction as
+        #               referee — seeded with price = max(f) - f, so the
+        #               referee starts from the entropic solution's global
+        #               prices and converges in a handful of rounds.
+        self.engine = engine
+        self.sink_eps_start = sink_eps_start
+        self.sink_eps_end = sink_eps_end
+        self.sink_scale = sink_scale
+        self.sink_iters = sink_iters
+        self.sink_tol = sink_tol
         # warm-solve eviction cap (native.auction_sparse_mt max_release):
         # bounds the per-solve re-bidding wave under drift; re-ranked every
         # solve so staleness is amortized, and cold_every re-grounds fully
@@ -152,6 +183,12 @@ class NativeSolveArena:
         """Carried retirement mask [T] after the last solve."""
         return self._retired
 
+    @property
+    def potentials(self) -> tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Carried Sinkhorn dual potentials (f [P], g [T]) after the last
+        solve — (None, None) on the auction engine / before any solve."""
+        return self._f, self._g
+
     def invalidate(self) -> None:
         """Drop all carried state: the next solve is cold."""
         self._p_fields: Optional[dict] = None
@@ -162,6 +199,9 @@ class NativeSolveArena:
         self._price: Optional[np.ndarray] = None
         self._retired: Optional[np.ndarray] = None
         self._p4t: Optional[np.ndarray] = None
+        self._f: Optional[np.ndarray] = None  # sinkhorn provider duals
+        self._g: Optional[np.ndarray] = None  # sinkhorn task duals
+        self._sink_stats: dict = {}
         self._warm_solves = 0
         self._dual_age = 0
 
@@ -182,29 +222,110 @@ class NativeSolveArena:
             pf[n].shape == old_p[n].shape for n, _ in _P_SPEC
         ) and all(rf[n].shape == old_r[n].shape for n, _ in _R_SPEC)
 
+    def _sinkhorn_round(
+        self,
+        P: int,
+        warm: bool,
+        retired: Optional[np.ndarray] = None,
+        seed: Optional[np.ndarray] = None,
+        max_release: int = 0,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The sinkhorn engine's solve stage over the CURRENT cached
+        candidate structure: entropic potentials (cold: the full anneal
+        ladder from zero duals; warm: one fine-eps phase from the carried
+        (f, g) — churn only perturbs the fixed point, so a handful of
+        O(nnz) iterations re-converge it), then injective rounding by the
+        sparse auction referee seeded with price = max(f) - f. The uniform
+        downshift keeps referee prices nonnegative and far from the
+        give-up floor without changing a single price DIFFERENCE — the
+        same soundness argument as the warm auction's price downshift.
+
+        The referee's eps-CS repair runs over ALL rows (repair_mask=None):
+        unlike the auction engine's carried prices, referee prices are
+        re-derived from the (globally shifted) potentials each solve, so
+        "only churned rows can have degraded" does not hold; the full
+        [T x K] repair scan is one pass over the candidate structure —
+        noise next to the potential iterations. ``max_release`` still caps
+        the eviction wave.
+        """
+        phase_stats: list = []
+        carried = (
+            warm
+            and self._f is not None
+            and self._f.shape[0] == P
+            and self._g is not None
+            and self._g.shape[0] == self._cand_p.shape[0]
+        )
+        if carried:
+            f, g, iters, err = native.sinkhorn_sparse_mt(
+                self._cand_p, self._cand_c, P,
+                eps=self.sink_eps_end, max_iters=self.sink_iters,
+                tol=self.sink_tol, threads=self.threads,
+                f=self._f, g=self._g,
+            )
+            phase_stats.append({
+                "eps": self.sink_eps_end, "iters": iters,
+                "err": round(err, 6), "warm": True,
+            })
+        else:
+            f, g = native.sinkhorn_sparse_anneal(
+                self._cand_p, self._cand_c, P,
+                eps_start=self.sink_eps_start, eps_end=self.sink_eps_end,
+                scale=self.sink_scale, iters_per_phase=self.sink_iters,
+                tol=self.sink_tol, threads=self.threads,
+                phase_stats=phase_stats,
+            )
+        self._f, self._g = f, g
+        self._sink_stats = {
+            "sinkhorn_phases": len(phase_stats),
+            "sinkhorn_iters": int(sum(s["iters"] for s in phase_stats)),
+            "sinkhorn_err": phase_stats[-1]["err"] if phase_stats else None,
+        }
+        # Referee seed prices from the provider duals — downshifted and
+        # capped below the give-up floor; the formula and its soundness
+        # argument live in native.sinkhorn_referee_prices (the one home
+        # shared with the perf gate, stage-S script, and bench)
+        price0 = native.sinkhorn_referee_prices(
+            f, self._cand_p, self._cand_c
+        )
+        return native.auction_sparse_mt(
+            self._cand_p, self._cand_c, num_providers=P,
+            eps_start=max(self.warm_eps_start, self.eps_end),
+            eps_end=self.eps_end,
+            threads=self.threads,
+            price=price0, retired=retired,
+            seed_provider_for_task=seed, max_release=max_release,
+        )
+
     def _cold(self, ep, er, weights, pf, rf, P, T) -> np.ndarray:
         cand_p, cand_c = native.fused_topk_candidates(
             ep, er, weights, k=self.k, reverse_r=self.reverse_r,
             extra=self.extra, threads=self.threads,
         )
-        p4t, price, retired = native.auction_sparse_mt(
-            cand_p, cand_c, num_providers=P,
-            eps_start=self.eps_start, eps_end=self.eps_end,
-            threads=self.threads,
-        )
+        self._cand_p, self._cand_c = cand_p, cand_c
+        if self.engine == "sinkhorn":
+            self._f = self._g = None
+            p4t, price, retired = self._sinkhorn_round(P, warm=False)
+        else:
+            p4t, price, retired = native.auction_sparse_mt(
+                cand_p, cand_c, num_providers=P,
+                eps_start=self.eps_start, eps_end=self.eps_end,
+                threads=self.threads,
+            )
         self._p_fields, self._r_fields = pf, rf
         self._weights_key = self._wkey(weights)
-        self._cand_p, self._cand_c = cand_p, cand_c
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves = 0
         self._dual_age = 0
         self.last_stats = {
             "cold": True,
+            "engine": self.engine,
             "dirty_providers": P,
             "dirty_tasks": T,
             "changed_rows": T,
             "warm_solves_since_cold": 0,
             "assigned": int((p4t >= 0).sum()),
+            **(self._sink_stats if self.engine == "sinkhorn" else {}),
         }
         return p4t
 
@@ -448,13 +569,30 @@ class NativeSolveArena:
                 self._p4t[lost] = -1
                 changed[lost] = True  # unseated: must be free to re-bid
 
-        # ---- auction over the (updated) cached candidate structure:
+        # ---- solve over the (updated) cached candidate structure:
         # warm dual carry on most ticks, a full dual refresh on schedule
         dual_refresh = (
             self.dual_refresh_every > 0
             and self._dual_age >= self.dual_refresh_every
         )
-        if dual_refresh:
+        if self.engine == "sinkhorn":
+            # entropic potentials re-converge from the carried (f, g) —
+            # the dual refresh re-grounds only the REFEREE's retirement/
+            # seeding (the cardinality-bleed half), never the potentials:
+            # sinkhorn duals are a fixed point recomputed in full every
+            # solve, so they cannot ratchet the way auction prices do
+            if dual_refresh:
+                p4t, price, retired = self._sinkhorn_round(P, warm=True)
+                self._dual_age = 0
+            else:
+                p4t, price, retired = self._sinkhorn_round(
+                    P, warm=True,
+                    retired=self._retired & ~changed,
+                    seed=self._p4t,
+                    max_release=self.max_release,
+                )
+                self._dual_age += 1
+        elif dual_refresh:
             p4t, price, retired = native.auction_sparse_mt(
                 self._cand_p, self._cand_c, num_providers=P,
                 eps_start=self.eps_start, eps_end=self.eps_end,
@@ -478,6 +616,7 @@ class NativeSolveArena:
         self._warm_solves += 1
         self.last_stats = {
             "cold": False,
+            "engine": self.engine,
             "dual_refresh": dual_refresh,
             "dirty_providers": n_dp,
             "base_only_providers": n_base,
@@ -485,5 +624,6 @@ class NativeSolveArena:
             "changed_rows": int(changed.sum()),
             "warm_solves_since_cold": self._warm_solves,
             "assigned": int((p4t >= 0).sum()),
+            **(self._sink_stats if self.engine == "sinkhorn" else {}),
         }
         return p4t

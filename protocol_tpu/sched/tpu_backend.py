@@ -249,11 +249,15 @@ class TpuBatchMatcher:
         # Gauss-Seidel engine; "native-mt" runs the multi-threaded fused
         # pass + deterministic Jacobi auction THROUGH the persistent solve
         # arena (protocol_tpu/native/arena.py), so steady-state solves
-        # recompute only churned rows. native_threads: 0 = all hardware
-        # threads.
-        if native_engine not in ("native", "native-mt"):
+        # recompute only churned rows; "sinkhorn-mt" rides the same arena
+        # but solves with the O(nnz) sparse entropic engine (warm (f, g)
+        # potential carry + auction-referee rounding) — the soft/
+        # relaxation twin the combinatorial solver is refereed against.
+        # native_threads: 0 = all hardware threads.
+        if native_engine not in ("native", "native-mt", "sinkhorn-mt"):
             raise ValueError(
-                f"native_engine must be native|native-mt, got {native_engine!r}"
+                "native_engine must be native|native-mt|sinkhorn-mt, "
+                f"got {native_engine!r}"
             )
         self.native_engine = native_engine
         self.native_threads = int(native_threads)
@@ -410,17 +414,23 @@ class TpuBatchMatcher:
 
             n_providers = int(np.asarray(ep.gpu_count).shape[0])
             self._last_arena_stats = {}
-            if self.native_engine == "native-mt":
-                # persistent warm-solve arena: candidate structure, prices
-                # and the retirement mask survive between solves; only
-                # churned rows are recomputed (tentpole semantics of the
-                # CandidateCache, on the native path)
+            if self.native_engine in ("native-mt", "sinkhorn-mt"):
+                # persistent warm-solve arena: candidate structure, solver
+                # duals (auction prices+retirement, or sinkhorn potentials)
+                # survive between solves; only churned rows are recomputed
+                # (tentpole semantics of the CandidateCache, on the native
+                # path)
                 if self._native_arena is None:
                     from protocol_tpu.native.arena import NativeSolveArena
 
                     self._native_arena = NativeSolveArena(
                         threads=self.native_threads,
                         cold_every=self.cold_every,
+                        engine=(
+                            "sinkhorn"
+                            if self.native_engine == "sinkhorn-mt"
+                            else "auction"
+                        ),
                     )
                 p4s = self._native_arena.solve(ep, er, self.weights)
                 self._last_arena_stats = {
@@ -1368,6 +1378,8 @@ class TpuBatchMatcher:
             else:
                 if not self.native_fallback:
                     kernel_used = "dense_auction"
+                elif self.native_engine == "sinkhorn-mt":
+                    kernel_used = "native_cpu_sinkhorn_mt"
                 elif self.native_engine == "native-mt":
                     kernel_used = "native_cpu_mt"
                 else:

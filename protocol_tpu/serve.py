@@ -257,7 +257,8 @@ async def serve_orchestrator(args) -> None:
             # falls back to v1 automatically against an old server
             wire=os.environ.get("PROTOCOL_TPU_WIRE", "v2"),
             # the native-engine knobs ride the wire as the kernel string
-            # ("native-mt[:N]") when the control plane is in degraded mode
+            # ("native-mt[:N]" / "sinkhorn-mt[:N]") when the control
+            # plane is in degraded mode
             native_fallback=os.environ.get(
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
@@ -276,8 +277,10 @@ async def serve_orchestrator(args) -> None:
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
             in ("1", "true", "yes"),
-            # native | native-mt: the multi-threaded engine + persistent
-            # warm arena for degraded-mode deployments with cores to spare
+            # native | native-mt | sinkhorn-mt: the multi-threaded
+            # engines + persistent warm arena for degraded-mode
+            # deployments with cores to spare (sinkhorn-mt = the O(nnz)
+            # entropic solver with auction-referee rounding)
             native_engine=os.environ.get(
                 "PROTOCOL_TPU_NATIVE_ENGINE", "native"
             ),

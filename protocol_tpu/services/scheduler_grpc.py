@@ -61,9 +61,11 @@ from protocol_tpu.proto.wire import (
 )
 from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
 from protocol_tpu.services.session_store import (
+    EngineThreadBudget,
     SessionStore,
     SolveSession,
     parse_native_threads,
+    parse_session_kernel,
     _pad_cols,
 )
 from protocol_tpu.utils.metrics import SeamMetrics
@@ -160,18 +162,28 @@ class SchedulerBackendServicer:
         from protocol_tpu.sched.cand_cache import CandidateMemo
 
         self._cand_memo = CandidateMemo()
-        # persistent warm arena for the "native-mt" kernel: steady-state
-        # Assign repeats (the heartbeat loop's byte-identical or lightly
-        # churned fleets) reuse the candidate structure + auction duals and
-        # recompute only dirty rows — the native twin of _cand_memo's
-        # delta-awareness, but incremental rather than exact-repeat-only.
-        # One lock: serve() runs a thread pool, and the arena mutates its
-        # carried state in place (concurrent solves would corrupt the warm
-        # structure that every later solve builds on)
+        # persistent warm arena for the unary "native-mt"/"sinkhorn-mt"
+        # kernels: steady-state Assign repeats (the heartbeat loop's
+        # byte-identical or lightly churned fleets) reuse the candidate
+        # structure + solver duals and recompute only dirty rows — the
+        # native twin of _cand_memo's delta-awareness, but incremental
+        # rather than exact-repeat-only.
+        #
+        # Locking is SHARDED, not global: this lock guards only the unary
+        # path's shared arena (which mutates carried state in place — one
+        # arena, necessarily serialized). Session solves take their OWN
+        # ``session.lock`` (services/session_store.py), so two delta
+        # sessions never serialize each other; what they share instead is
+        # the bounded EngineThreadBudget below, which keeps N concurrent
+        # solves from oversubscribing the host by N x "all hardware
+        # threads" (grants are thread-count invariant by the engines'
+        # determinism contract, so borrowing fewer threads never changes
+        # a matching).
         self._native_arena = None
         import threading
 
-        self._native_lock = threading.Lock()
+        self._unary_arena_lock = threading.Lock()
+        self._engine_budget = EngineThreadBudget()
         self.sessions = SessionStore(
             max_sessions=max_sessions, ttl_s=session_ttl_s
         )
@@ -221,12 +233,16 @@ class SchedulerBackendServicer:
                 np.full(T, -1, np.int32), t4p, int((t4p >= 0).sum()), None
             )
 
-        if kernel == "native" or kernel.startswith("native-mt"):
+        if kernel == "native" or kernel.startswith(
+            ("native-mt", "sinkhorn-mt")
+        ):
             # the C++ CPU engine behind the seam: "native" is the
             # single-threaded Gauss-Seidel solve, "native-mt[:N]" the
-            # multi-threaded engine through the servicer's persistent warm
-            # arena (N threads; absent/0 = all hardware threads — the
-            # suffix spelling keeps the wire message unchanged)
+            # multi-threaded auction engine and "sinkhorn-mt[:N]" the
+            # sparse entropic engine, both through the servicer's
+            # persistent warm arena (N threads; absent/0 = all hardware
+            # threads — the suffix spelling keeps the wire message
+            # unchanged)
             from protocol_tpu import native as native_mod
 
             p_padded = int(np.asarray(ep.gpu_count).shape[0])
@@ -240,31 +256,47 @@ class SchedulerBackendServicer:
                 )
                 price_full = np.zeros(p_padded, np.float32)
             else:
-                threads = parse_native_threads(kernel)
-                if threads is None:
+                parsed = parse_session_kernel(kernel)
+                if parsed is None:
                     context.abort(
                         grpc.StatusCode.INVALID_ARGUMENT,
-                        f"bad native-mt thread suffix {kernel!r}",
+                        f"bad native engine thread suffix {kernel!r}",
                     )
+                engine, threads = parsed
                 requested_k = max(top_k or 64, 1)
-                with self._native_lock:
+                # thread grant is borrowed INSIDE the arena lock: the
+                # unary arena is one serialized resource, so a request
+                # parked on the lock must hold NOTHING — a pre-lock grant
+                # would reserve idle threads for the whole duration of
+                # the running solve, starving concurrent session solves
+                # (which draw on the same budget from their own locks).
+                # No deadlock: budget holders never need this lock.
+                with self._unary_arena_lock:
                     if (
                         self._native_arena is None
                         or self._native_arena.k != requested_k
+                        or self._native_arena.engine != engine
                     ):
-                        # a changed k changes the whole candidate
-                        # structure: a fresh arena (cold solve) is the
-                        # only honest answer
+                        # a changed k or engine changes the whole
+                        # carried structure: a fresh arena (cold
+                        # solve) is the only honest answer
                         from protocol_tpu.native.arena import (
                             NativeSolveArena,
                         )
 
                         self._native_arena = NativeSolveArena(
-                            k=requested_k, threads=threads
+                            k=requested_k, threads=threads,
+                            engine=engine,
                         )
-                    self._native_arena.threads = threads
-                    p4t_full = self._native_arena.solve(ep, er, weights)
-                    price_full = self._native_arena.price
+                    grant = self._engine_budget.acquire(threads)
+                    try:
+                        self._native_arena.threads = grant
+                        p4t_full = self._native_arena.solve(
+                            ep, er, weights
+                        )
+                        price_full = self._native_arena.price
+                    finally:
+                        self._engine_budget.release(grant)
             p4t = np.asarray(p4t_full)[:T]
             t4p = np.full(P, -1, np.int32)
             seated = np.flatnonzero((p4t >= 0) & (p4t < P))
@@ -479,15 +511,16 @@ class SchedulerBackendServicer:
             return pb.OpenSessionResponse(ok=False, error=str(e))
         self.seam.add_bytes("in", wire_bytes)
         kernel = req.kernel or "native-mt"
-        threads = parse_native_threads(kernel)
-        if threads is None:
+        parsed = parse_session_kernel(kernel)
+        if parsed is None:
             # the session protocol's warm state lives in the native arena;
             # other kernels stay on the stateless unary rungs
             return pb.OpenSessionResponse(
                 ok=False,
                 error=f"kernel {kernel!r} is not session-servable "
-                      "(want native-mt[:N])",
+                      "(want native-mt[:N] | sinkhorn-mt[:N])",
             )
+        engine, threads = parsed
         try:
             ep = decode_providers_v2(req.providers)
             er = decode_requirements_v2(req.requirements)
@@ -523,7 +556,8 @@ class SchedulerBackendServicer:
             r_cols=_pad_cols(r_cols, n_t),
             n_providers=n_p,
             n_tasks=n_t,
-            arena=NativeSolveArena(k=top_k, threads=threads),
+            arena=NativeSolveArena(k=top_k, threads=threads, engine=engine),
+            budget=self._engine_budget,
         )
         t_dec = time.perf_counter()
         with session.lock:
@@ -582,7 +616,23 @@ class SchedulerBackendServicer:
             )
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        # decode ends HERE: with sharded session locks and a shared thread
+        # budget, a delta can legitimately park on the lock — stamping
+        # decode after it would misattribute contention to the codec and
+        # point seam tuning at the wrong phase (lock/budget wait + delta
+        # apply land in "solve" instead, where the contention actually is)
+        t_dec = time.perf_counter()
         with session.lock:
+            if session.evicted:
+                # lost the race with LRU/TTL eviction (or a same-id
+                # re-open) between the store lookup and this lock: refuse
+                # rather than solve against — and advance the tick of — an
+                # arena the store no longer owns. The client re-opens from
+                # its authoritative state (the standard fallback ladder).
+                self.seam.count("session_evicted_inflight")
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error="session evicted"
+                )
             if int(request.tick) != session.tick + 1:
                 # replayed or skipped tick: the client's shadow copy and
                 # this session's columns have diverged — refuse, never
@@ -597,9 +647,19 @@ class SchedulerBackendServicer:
                 session.apply_delta(prow, p_delta, trow, r_delta)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            t_dec = time.perf_counter()
             p4t_out, t4p, price = session.solve()
             session.tick += 1
+            if session.evicted:
+                # eviction landed DURING the solve (the store flags
+                # without taking session.lock — coupling store eviction
+                # to a potentially long solve would be worse): the solve
+                # ran against a disowned arena, so do not ack it. The
+                # pre-lock check above catches the common race; this one
+                # closes the in-solve window.
+                self.seam.count("session_evicted_inflight")
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error="session evicted"
+                )
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms(
             "solve", (time.perf_counter() - t_dec) * 1e3
@@ -1207,8 +1267,8 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     # ---------------- matcher integration ----------------
 
     def _native_kernel(self) -> str:
-        if self.native_engine == "native-mt":
-            return "native-mt" + (
+        if self.native_engine in ("native-mt", "sinkhorn-mt"):
+            return self.native_engine + (
                 f":{self.native_threads}" if self.native_threads else ""
             )
         return "native"

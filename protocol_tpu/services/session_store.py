@@ -25,6 +25,7 @@ replaced, never mutated in place — untouched columns stay shared.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -35,17 +36,74 @@ import numpy as np
 
 from protocol_tpu.proto.wire import P_WIRE_DTYPES, R_WIRE_DTYPES
 
+# session-servable kernel strings -> the arena engine behind them
+_SESSION_ENGINES = {"native-mt": "auction", "sinkhorn-mt": "sinkhorn"}
 
-def parse_native_threads(kernel: str) -> Optional[int]:
-    """``native-mt`` / ``native-mt:N`` -> thread count (0 = all hardware
-    threads); any other kernel -> None (not session-servable)."""
-    if not kernel.startswith("native-mt"):
+
+def parse_session_kernel(kernel: str) -> Optional[tuple[str, int]]:
+    """``native-mt[:N]`` / ``sinkhorn-mt[:N]`` -> (arena engine, thread
+    count; 0 = all hardware threads). Any other kernel -> None (not
+    session-servable: the session protocol's warm state lives in the
+    native arena)."""
+    base, _, suffix = kernel.partition(":")
+    engine = _SESSION_ENGINES.get(base)
+    if engine is None:
         return None
-    _, _, suffix = kernel.partition(":")
     try:
-        return int(suffix) if suffix else 0
+        return engine, (int(suffix) if suffix else 0)
     except ValueError:
         return None
+
+
+def parse_native_threads(kernel: str) -> Optional[int]:
+    """Thread count of a session-servable kernel string, None otherwise
+    (back-compat shim over :func:`parse_session_kernel`)."""
+    parsed = parse_session_kernel(kernel)
+    return None if parsed is None else parsed[1]
+
+
+class EngineThreadBudget:
+    """Bounded native-engine thread budget shared across concurrent
+    solves. The gRPC servicer runs a thread pool, and every session holds
+    its own arena behind its own lock — without a shared budget, two
+    concurrent solves each asking for "all hardware threads" oversubscribe
+    the host 2x (and N sessions, Nx).
+
+    Each solve acquires a grant of min(requested, available) threads and
+    releases it when done. ``acquire`` NEVER BLOCKS: a fully-drained pool
+    degrades the grant to a single thread instead of parking the caller —
+    blocking would re-create exactly the solve serialization the
+    per-session locks removed (the default kernel string requests "all
+    hardware threads", so the first solve would drain the pool and every
+    concurrent session would queue behind it). The worst case is a
+    bounded oversubscription of one thread per concurrent solve (capped
+    by the server's worker pool), not Nx total. The native engines are
+    bit-identical for every thread count, so a degraded grant can change
+    wall-clock but never a result."""
+
+    def __init__(self, total: Optional[int] = None):
+        self.total = int(total) if total else (os.cpu_count() or 1)
+        self._avail = self.total
+        self._lock = threading.Lock()
+
+    def acquire(self, want: int) -> int:
+        """Returns the grant size (>= 1, never blocks)."""
+        want = self.total if want <= 0 else min(int(want), self.total)
+        with self._lock:
+            grant = max(1, min(want, self._avail))
+            self._avail -= grant
+            return grant
+
+    def release(self, grant: int) -> None:
+        with self._lock:
+            self._avail += int(grant)
+
+    @property
+    def available(self) -> int:
+        """Uncommitted thread capacity (negative under the bounded
+        oversubscription a contended 1-thread floor grant allows)."""
+        with self._lock:
+            return self._avail
 
 
 def _pad_cols(cols: dict[str, np.ndarray], n_real: int) -> dict[str, np.ndarray]:
@@ -93,14 +151,35 @@ class SolveSession:
     last_used: float = field(default_factory=time.monotonic)
     lock: threading.Lock = field(default_factory=threading.Lock)
     delta_rows_total: int = 0
+    # set (under the store lock) when the store lets go of this session —
+    # LRU eviction, TTL expiry, drop, or same-id replacement. An in-flight
+    # AssignDelta that already looked the session up must REFUSE after
+    # seeing this instead of solving against (and advancing the tick of)
+    # an arena the store no longer owns: the client's next delta would be
+    # refused anyway ("unknown session"), but its shadow columns would
+    # have silently diverged from a solve nobody can replay.
+    evicted: bool = False
+    # shared EngineThreadBudget (None = unbudgeted, use arena.threads)
+    budget: object = None
 
     def solve(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the warm arena over the current columns; returns
         (provider_for_task[T], task_for_provider[P], price[P]) over the
-        REAL row counts."""
-        p4t_full = self.arena.solve(
-            _as_ns(self.p_cols), _as_ns(self.r_cols), self.weights
-        )
+        REAL row counts. With a ``budget`` attached, the solve borrows a
+        bounded thread grant so concurrent sessions share the host's
+        cores instead of oversubscribing them (results are thread-count
+        invariant, so the grant size never changes the matching)."""
+        grant = None
+        if self.budget is not None:
+            grant = self.budget.acquire(self.threads)
+            self.arena.threads = grant
+        try:
+            p4t_full = self.arena.solve(
+                _as_ns(self.p_cols), _as_ns(self.r_cols), self.weights
+            )
+        finally:
+            if grant is not None:
+                self.budget.release(grant)
         p4t = np.asarray(p4t_full)[: self.n_tasks]
         t4p = np.full(self.n_providers, -1, np.int32)
         seated = np.flatnonzero((p4t >= 0) & (p4t < self.n_providers))
@@ -119,18 +198,37 @@ class SolveSession:
         column. Returns the number of rows actually applied. Row indices
         are validated against the REAL row space — padding rows are the
         server's own invention and never addressable from the wire."""
-        applied = 0
-        for rows, delta, cols, n_real, spec in (
+        groups = (
             (provider_rows, p_delta, self.p_cols, self.n_providers,
              P_WIRE_DTYPES),
             (task_rows, r_delta, self.r_cols, self.n_tasks, R_WIRE_DTYPES),
-        ):
+        )
+        # validate EVERYTHING before the first write: a mid-application
+        # raise would leave the session half-mutated with an unadvanced
+        # tick — state matching no client's shadow copy anywhere
+        for rows, delta, _cols, n_real, spec in groups:
             if rows.size == 0:
                 continue
             if rows.min() < 0 or rows.max() >= n_real:
                 raise ValueError(
                     f"delta row index out of range [0, {n_real})"
                 )
+            for name in spec:
+                if np.asarray(delta[name]).shape[0] != rows.size:
+                    # without this, numpy BROADCASTS a 1-row payload into
+                    # every indexed row and the server acks a delta whose
+                    # columns silently diverged from the client's shadow
+                    # copy — the exact divergence the tick/fingerprint
+                    # machinery exists to refuse
+                    raise ValueError(
+                        f"delta column {name!r} has "
+                        f"{np.asarray(delta[name]).shape[0]} rows for "
+                        f"{rows.size} row indices"
+                    )
+        applied = 0
+        for rows, delta, cols, _n_real, spec in groups:
+            if rows.size == 0:
+                continue
             for name in spec:
                 new_vals = delta[name]
                 if np.array_equal(cols[name][rows], new_vals):
@@ -161,16 +259,20 @@ class SessionStore:
             if now - s.last_used > self.ttl_s
         ]
         for sid in dead:
+            self._sessions[sid].evicted = True
             del self._sessions[sid]
             self.expirations += 1
 
     def put(self, session: SolveSession) -> None:
         with self._lock:
             self._expire_locked()
-            self._sessions.pop(session.session_id, None)
+            replaced = self._sessions.pop(session.session_id, None)
+            if replaced is not None:
+                replaced.evicted = True
             self._sessions[session.session_id] = session
             while len(self._sessions) > self.max_sessions:
-                self._sessions.popitem(last=False)
+                _, lru = self._sessions.popitem(last=False)
+                lru.evicted = True
                 self.evictions += 1
 
     def get(
@@ -191,7 +293,9 @@ class SessionStore:
 
     def drop(self, session_id: str) -> None:
         with self._lock:
-            self._sessions.pop(session_id, None)
+            dropped = self._sessions.pop(session_id, None)
+            if dropped is not None:
+                dropped.evicted = True
 
     def __len__(self) -> int:
         with self._lock:

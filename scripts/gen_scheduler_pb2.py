@@ -251,10 +251,38 @@ _builder.BuildTopDescriptorsAndMessages(
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--check", action="store_true",
+        help="verify the committed pb2 matches this generator (CI drift "
+        "gate): exit 1 without writing anything if they differ",
+    )
+    args = ap.parse_args()
+
     fd = build_file()
     blob = fd.SerializeToString()
+    content = TEMPLATE.format(blob=repr(blob))
+    if args.check:
+        try:
+            with open(OUT) as fh:
+                committed = fh.read()
+        except FileNotFoundError:
+            committed = ""
+        if committed != content:
+            print(
+                f"DRIFT: {OUT} does not match scripts/gen_scheduler_pb2.py "
+                "— someone edited the generated file by hand, or changed "
+                "the generator without regenerating. Run "
+                "`python scripts/gen_scheduler_pb2.py` and commit.",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"{OUT} is in sync with the generator")
+        return 0
     with open(OUT, "w") as fh:
-        fh.write(TEMPLATE.format(blob=repr(blob)))
+        fh.write(content)
     print(f"wrote {OUT} ({len(blob)} descriptor bytes)")
     # import-check in a clean interpreter (this process's descriptor pool
     # may already hold the previous revision of the file)

@@ -24,7 +24,16 @@ tasks assigned. A wire regression (a chatty codec, a session-protocol
 break, a warm-solve regression behind the seam) cannot merge on green
 unit tests alone.
 
-Usage: python scripts/perf_gate.py [--update-floor] [--wire]
+With ``--sinkhorn`` it runs the sparse-Sinkhorn engine smoke (ISSUE 3):
+a 4k x 4k parity + quality gate — the sinkhorn-mt potentials must be
+bit-identical between threads=1 and threads=2, the auction-referee
+rounding must assign >= 97% of what the plain auction assigns on the
+same candidate structure at <= 102% of its mean cost — plus the 16k x 16k
+warm-potential-carry floor: a 1% churn warm re-solve through the sinkhorn
+arena must be >= 2x faster than the cold solve. A solver or warm-carry
+regression cannot merge on green unit tests alone.
+
+Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
 (--update-floor rewrites perf_floor.json to 25% of this machine's
 measured rate — run on the slowest supported host class, then commit.)
 """
@@ -85,14 +94,151 @@ def wire_gate() -> int:
     return 0
 
 
+def sinkhorn_gate() -> int:
+    """Sparse-Sinkhorn engine smoke (the ISSUE 3 acceptance bar): 4k x 4k
+    thread-invariance + referee quality vs the plain auction on shared
+    candidates, and the 16k x 16k warm-potential-carry speedup floor."""
+    import dataclasses
+    import time as _time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu import native
+    from protocol_tpu.native.arena import NativeSolveArena
+    from protocol_tpu.ops.cost import CostWeights
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    w = CostWeights()
+    rng = np.random.default_rng(0)
+    n = 4096
+    ep = bench.synth_providers(rng, n)
+    er = bench.synth_requirements(rng, n)
+    cand_p, cand_c = native.fused_topk_candidates(
+        ep, er, w, k=64, reverse_r=8, extra=16, threads=0
+    )
+
+    # ---- thread invariance (the -mt determinism contract)
+    f1, g1, it1, _ = native.sinkhorn_sparse_mt(
+        cand_p, cand_c, n, eps=0.1, max_iters=30, tol=1e-3, threads=1
+    )
+    f2, g2, it2, _ = native.sinkhorn_sparse_mt(
+        cand_p, cand_c, n, eps=0.1, max_iters=30, tol=1e-3, threads=2
+    )
+    invariant = (
+        np.array_equal(f1, f2) and np.array_equal(g1, g2) and it1 == it2
+    )
+    print(f"sinkhorn gate: thread-invariant {invariant} ({it1} iters)")
+    if not invariant:
+        failures.append(
+            "sinkhorn-mt potentials differ between threads=1 and threads=2"
+        )
+
+    # ---- quality: anneal + referee vs the plain auction, same candidates
+    phase_stats: list = []
+    t0 = _time.perf_counter()
+    f, _g = native.sinkhorn_sparse_anneal(
+        cand_p, cand_c, n, eps_start=1.0, eps_end=0.05,
+        iters_per_phase=50, tol=1e-2, threads=0, phase_stats=phase_stats,
+    )
+    t_pot = _time.perf_counter() - t0
+    price0 = native.sinkhorn_referee_prices(f, cand_p, cand_c)
+    p4t_s, _, _ = native.auction_sparse_mt(
+        cand_p, cand_c, num_providers=n, eps_start=0.32, eps_end=0.02,
+        threads=0, price=price0,
+    )
+    p4t_a, _, _ = native.auction_sparse_mt(
+        cand_p, cand_c, num_providers=n, threads=0
+    )
+
+    def mean_cost(p4t):
+        m = (cand_p == p4t[:, None]) & (p4t[:, None] >= 0)
+        has = m.any(axis=1)
+        j = m.argmax(axis=1)
+        return float(cand_c[np.arange(n), j][has].mean())
+
+    n_s, n_a = int((p4t_s >= 0).sum()), int((p4t_a >= 0).sum())
+    c_s, c_a = mean_cost(p4t_s), mean_cost(p4t_a)
+    pos = p4t_s[p4t_s >= 0]
+    print(
+        f"sinkhorn gate: rounding {n_s}/{n} vs auction {n_a}/{n}, "
+        f"mean cost {c_s:.4f} vs {c_a:.4f} "
+        f"({t_pot:.1f}s potentials, {sum(s['iters'] for s in phase_stats)} "
+        "iters)"
+    )
+    if np.unique(pos).size != pos.size:
+        failures.append("sinkhorn-mt rounding is not injective")
+    if n_s < floors["sinkhorn_mt_min_assigned_vs_auction"] * n_a:
+        failures.append(
+            f"sinkhorn-mt rounding assigned {n_s} < "
+            f"{floors['sinkhorn_mt_min_assigned_vs_auction']:.2f}x of "
+            f"auction {n_a}"
+        )
+    if c_s > c_a * floors["sinkhorn_mt_cost_ratio_max"] + 1e-6:
+        failures.append(
+            f"sinkhorn-mt mean cost {c_s:.4f} exceeds "
+            f"{floors['sinkhorn_mt_cost_ratio_max']:.2f}x of auction "
+            f"{c_a:.4f}"
+        )
+
+    # ---- warm-potential carry: 1% churn warm re-solve >= 2x over cold
+    # at 16k x 16k (the arena's candidate + dual carry, end to end)
+    n16 = 16384
+    ep16 = bench.synth_providers(np.random.default_rng(2), n16)
+    er16 = bench.synth_requirements(np.random.default_rng(3), n16)
+    arena = NativeSolveArena(engine="sinkhorn", threads=0)
+    t0 = _time.perf_counter()
+    arena.solve(ep16, er16, w)
+    t_cold = _time.perf_counter() - t0
+    churn_rng = np.random.default_rng(4)
+    rows = churn_rng.choice(n16, n16 // 100, replace=False)
+    price = np.array(ep16.price, copy=True)
+    price[rows] = churn_rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+    ep16b = dataclasses.replace(ep16, price=price)
+    t0 = _time.perf_counter()
+    p4t_w = arena.solve(ep16b, er16, w)
+    t_warm = _time.perf_counter() - t0
+    speedup = t_cold / max(t_warm, 1e-9)
+    frac = int((p4t_w >= 0).sum()) / n16
+    print(
+        f"sinkhorn gate: 16k warm {t_warm:.2f}s vs cold {t_cold:.2f}s "
+        f"({speedup:.1f}x, floor "
+        f"{floors['sinkhorn_mt_warm_speedup_floor']}x); warm assigned "
+        f"frac {frac:.3f}"
+    )
+    if speedup < floors["sinkhorn_mt_warm_speedup_floor"]:
+        failures.append(
+            f"sinkhorn warm re-solve only {speedup:.2f}x faster than cold "
+            f"(floor {floors['sinkhorn_mt_warm_speedup_floor']}x)"
+        )
+    if frac < floors["sinkhorn_mt_min_assigned_frac"]:
+        failures.append(
+            f"sinkhorn warm assigned fraction {frac:.3f} below "
+            f"{floors['sinkhorn_mt_min_assigned_frac']}"
+        )
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("sinkhorn perf gate OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update-floor", action="store_true")
     ap.add_argument("--wire", action="store_true")
+    ap.add_argument("--sinkhorn", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
         return wire_gate()
+    if args.sinkhorn:
+        return sinkhorn_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

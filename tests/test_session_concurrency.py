@@ -1,0 +1,249 @@
+"""Scheduler-seam concurrency: sharded session locking (two delta
+sessions must progress concurrently without serializing on any global
+lock), the shared engine-thread budget, and the eviction-vs-in-flight-
+delta race (a delta that loses the race to LRU/TTL eviction must be
+REFUSED, never solved against an arena the store no longer owns).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.ops.cost import CostWeights
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto import wire
+from protocol_tpu.services.scheduler_grpc import (
+    SchedulerBackendClient,
+    encoded_to_proto_v2,
+    serve,
+)
+from protocol_tpu.services.session_store import EngineThreadBudget
+
+from tests.test_sparse import encode_random_marketplace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+ADDR = "127.0.0.1:50078"
+
+
+@pytest.fixture()
+def backend():
+    server = serve(address=ADDR)
+    client = SchedulerBackendClient(ADDR)
+    yield server.servicer, client
+    client.close()
+    server.stop(grace=None)
+
+
+def _cols(seed, P=96, T=64):
+    ep, er = encode_random_marketplace(seed, P, T)
+    return (
+        wire.canon_columns(ep, wire.P_WIRE_DTYPES),
+        wire.canon_columns(er, wire.R_WIRE_DTYPES),
+    )
+
+
+def _open(client, p_cols, r_cols, session_id, kernel="native-mt:2",
+          top_k=16):
+    w = CostWeights()
+    fp = wire.epoch_fingerprint(p_cols, r_cols, w, kernel, top_k, 0.02, 0)
+    req = encoded_to_proto_v2(
+        wire.take_rows(p_cols, slice(None)),
+        wire.take_rows(r_cols, slice(None)),
+        w, kernel=kernel, top_k=top_k, eps=0.02,
+    )
+    chunks = list(wire.chunk_snapshot(session_id, fp, req))
+    resp = client.open_session(iter(chunks))
+    assert resp.ok, resp.error
+    return fp
+
+
+def _delta(client, session_id, fp, tick, p_cols, rows):
+    idx = np.asarray(rows, np.int32)
+    dreq = pb.AssignDeltaRequest(
+        session_id=session_id, epoch_fingerprint=fp, tick=tick
+    )
+    dreq.provider_rows.CopyFrom(wire.blob(idx, np.int32))
+    dreq.providers.CopyFrom(
+        wire.encode_providers_v2(wire.take_rows(p_cols, idx))
+    )
+    return client.assign_delta(dreq)
+
+
+def _run_session_ticks(client, sid, seed, n_ticks=3, kernel="native-mt:2"):
+    """Open a session and run ``n_ticks`` churn deltas; returns the
+    per-tick matchings. The churn sequence is a pure function of
+    ``seed``, so a serialized rerun reproduces the identical inputs."""
+    p_cols, r_cols = _cols(seed)
+    fp = _open(client, p_cols, r_cols, sid, kernel=kernel)
+    rng = np.random.default_rng(seed + 100)
+    results = []
+    for tick in range(1, n_ticks + 1):
+        rows = [int(tick), int(10 + tick)]
+        p_cols["price"] = p_cols["price"].copy()
+        p_cols["price"][rows] = rng.uniform(0.5, 4.0, 2).astype(np.float32)
+        dresp = _delta(client, sid, fp, tick, p_cols, rows)
+        assert dresp.session_ok, dresp.error
+        results.append(
+            wire.unblob(dresp.result.provider_for_task, np.int32)
+        )
+    return results
+
+
+class TestConcurrentSessions:
+    @pytest.mark.parametrize("kernel", ["native-mt:2", "sinkhorn-mt:2"])
+    def test_two_sessions_progress_and_match_serialized(
+        self, backend, kernel
+    ):
+        """Two delta sessions ticking CONCURRENTLY (separate threads,
+        separate session locks, shared thread budget) must both make
+        progress and produce tick-for-tick the same matchings as the
+        same sequences run serially on a fresh server — per-session
+        arena state is isolated, and the budget only changes who
+        computes, never what (the engines' thread-invariance
+        contract)."""
+        servicer, client = backend
+        out: dict = {}
+        errs: list = []
+
+        def run(sid, seed):
+            try:
+                # each thread gets its own channel: gRPC channels are
+                # thread-safe, but separate channels remove any client-
+                # side serialization from the measurement
+                c = SchedulerBackendClient(ADDR)
+                try:
+                    out[sid] = _run_session_ticks(
+                        c, sid, seed, n_ticks=3, kernel=kernel
+                    )
+                finally:
+                    c.close()
+            except Exception as e:  # surfaced below
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=("s-a", 21)),
+            threading.Thread(target=run, args=("s-b", 22)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs
+        assert set(out) == {"s-a", "s-b"}
+        assert all(len(v) == 3 for v in out.values())
+        # the budget must be fully returned once the dust settles
+        assert (
+            servicer._engine_budget.available
+            == servicer._engine_budget.total
+        )
+
+        # serialized reference on a fresh server: bit-identical ticks
+        ref_server = serve(address="127.0.0.1:50079")
+        ref_client = SchedulerBackendClient("127.0.0.1:50079")
+        try:
+            for sid, seed in (("s-a", 21), ("s-b", 22)):
+                ref = _run_session_ticks(
+                    ref_client, sid, seed, n_ticks=3, kernel=kernel
+                )
+                for got, want in zip(out[sid], ref):
+                    np.testing.assert_array_equal(got, want)
+        finally:
+            ref_client.close()
+            ref_server.stop(grace=None)
+
+
+class TestEvictionRace:
+    def test_inflight_delta_refused_after_eviction(self, backend):
+        """An AssignDelta that looked its session up, then lost the race
+        to eviction before acquiring the session lock, must be REFUSED
+        (fallback ladder) — solving would advance the tick of an arena
+        the store no longer owns, silently diverging the client's shadow
+        columns from a solve nobody can replay."""
+        servicer, client = backend
+        p_cols, r_cols = _cols(31)
+        fp = _open(client, p_cols, r_cols, "s-race")
+        session, reason = servicer.sessions.get("s-race", fp)
+        assert session is not None, reason
+
+        # hold the session lock (simulating another in-flight solve) so
+        # the delta blocks between its store lookup and its solve
+        session.lock.acquire()
+        result: dict = {}
+
+        def delta():
+            p_cols["price"] = p_cols["price"].copy()
+            p_cols["price"][3] = np.float32(2.5)
+            result["resp"] = _delta(client, "s-race", fp, 1, p_cols, [3])
+
+        t = threading.Thread(target=delta)
+        t.start()
+        # evict while the delta is parked on the lock
+        import time as _time
+
+        _time.sleep(0.2)
+        servicer.sessions.drop("s-race")
+        assert session.evicted is True
+        session.lock.release()
+        t.join(timeout=30)
+        resp = result["resp"]
+        assert resp.session_ok is False
+        assert "evicted" in resp.error
+        assert session.tick == 0  # the arena was never advanced
+
+    def test_lru_and_ttl_eviction_mark_sessions(self, backend):
+        from protocol_tpu.services.session_store import (
+            SessionStore,
+            SolveSession,
+        )
+
+        def mk(sid):
+            return SolveSession(
+                session_id=sid, fingerprint="fp", weights=None,
+                kernel="native-mt", threads=1, top_k=16, p_cols={},
+                r_cols={}, n_providers=0, n_tasks=0, arena=None,
+            )
+
+        store = SessionStore(max_sessions=2, ttl_s=900.0)
+        a, b, c = mk("a"), mk("b"), mk("c")
+        store.put(a)
+        store.put(b)
+        store.put(c)  # LRU-evicts a
+        assert a.evicted and not b.evicted and not c.evicted
+        # same-id replacement marks the replaced object
+        b2 = mk("b")
+        store.put(b2)
+        assert b.evicted and not b2.evicted
+        # TTL expiry
+        c.last_used -= 10_000.0
+        store.put(mk("d"))  # triggers _expire_locked
+        assert c.evicted
+
+
+class TestEngineThreadBudget:
+    def test_drained_pool_degrades_instead_of_blocking(self):
+        """The anti-serialization contract: a want=all request (threads=0,
+        the DEFAULT kernel string) must not park concurrent solves behind
+        the first — a drained pool hands out a 1-thread floor grant
+        (bounded oversubscription) and the books balance after release."""
+        budget = EngineThreadBudget(total=4)
+        g1 = budget.acquire(0)  # "all threads"
+        assert g1 == 4 and budget.available == 0
+        g2 = budget.acquire(0)  # drained: floor grant, NO blocking
+        assert g2 == 1 and budget.available == -1
+        budget.release(g1)
+        budget.release(g2)
+        assert budget.available == 4
+
+    def test_partial_grant_under_contention(self):
+        budget = EngineThreadBudget(total=4)
+        g1 = budget.acquire(3)
+        g2 = budget.acquire(4)  # only 1 left: partial grant
+        assert (g1, g2) == (3, 1)
+        budget.release(g1)
+        budget.release(g2)
+        assert budget.available == 4

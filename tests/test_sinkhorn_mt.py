@@ -1,0 +1,329 @@
+"""Sparse multi-threaded Sinkhorn engine (engine=sinkhorn-mt): NumPy
+reference parity, thread-count invariance, uniform-shift invariance of the
+warm potential carry, the arena integration (only dirty rows recomputed),
+and the auction-referee rounding contract (injective, auction-grade).
+
+The engine is DETERMINISTIC by construction — every row/column logsumexp
+is reduced serially by one thread in a fixed edge order — so the
+potentials must be bit-identical for every thread count, which is what
+makes a threads=4 production deployment debuggable against a threads=1
+repro (the same contract as auction_sparse_mt).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.ops.cost import CostWeights
+from protocol_tpu.ops.sparse import sinkhorn_potentials_sparse_np
+
+from tests.test_sparse import encode_random_marketplace
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+
+def _synthetic_candidates(seed, T, P, K=24, invalid_frac=0.1):
+    """Random candidate lists (no generation cost): the sinkhorn engine
+    consumes any [T, K] slot layout, so structure-free inputs are enough
+    for numerics tests and let T exceed the helper-pool threshold."""
+    rng = np.random.default_rng(seed)
+    cand_p = rng.integers(0, P, size=(T, K), dtype=np.int32)
+    cand_p[rng.random((T, K)) < invalid_frac] = -1
+    cand_c = rng.uniform(0.5, 10.0, size=(T, K)).astype(np.float32)
+    return cand_p, cand_c
+
+
+class TestNumpyParity:
+    def test_matches_reference_at_2k(self):
+        """The acceptance bar: native potentials match the pure-NumPy
+        reference to <= 1e-6 at 2k x 2k, on REAL marketplace candidates
+        (the fused generator's output, infeasible padding included)."""
+        ep, er = encode_random_marketplace(11, 2048, 2048)
+        cand_p, cand_c = native.fused_topk_candidates(
+            ep, er, CostWeights(), k=16, reverse_r=4, extra=8
+        )
+        for eps, f0, g0 in [(0.2, None, None)]:
+            f, g, it, err = native.sinkhorn_sparse_mt(
+                cand_p, cand_c, 2048, eps=eps, max_iters=30, tol=1e-4,
+                threads=2, f=f0, g=g0,
+            )
+            fr, gr, itr, errr = sinkhorn_potentials_sparse_np(
+                cand_p, cand_c, 2048, eps=eps, max_iters=30, tol=1e-4,
+                f0=f0, g0=g0,
+            )
+            assert it == itr
+            np.testing.assert_allclose(f, fr, rtol=1e-6, atol=1e-6)
+            np.testing.assert_allclose(g, gr, rtol=1e-6, atol=1e-6)
+        # second phase warm from the first's duals (the anneal step):
+        # the carried-potential path must track the reference too
+        f2, g2, it2, _ = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 2048, eps=0.05, max_iters=20, tol=1e-4,
+            threads=2, f=f, g=g,
+        )
+        fr2, gr2, itr2, _ = sinkhorn_potentials_sparse_np(
+            cand_p, cand_c, 2048, eps=0.05, max_iters=20, tol=1e-4,
+            f0=fr, g0=gr,
+        )
+        assert it2 == itr2
+        np.testing.assert_allclose(f2, fr2, rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(g2, gr2, rtol=1e-6, atol=1e-6)
+
+
+class TestThreadInvariance:
+    @pytest.mark.parametrize("threads", [2, 4])
+    def test_bit_identical_small(self, threads):
+        cand_p, cand_c = _synthetic_candidates(0, 512, 512)
+        ref = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 512, eps=0.1, max_iters=25, tol=1e-4, threads=1
+        )
+        got = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 512, eps=0.1, max_iters=25, tol=1e-4,
+            threads=threads,
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+        assert got[2] == ref[2] and got[3] == ref[3]
+
+    @pytest.mark.parametrize("threads", [2, 4])
+    def test_bit_identical_above_parallel_threshold(self, threads):
+        """The engine engages its helper pool only when max(P, T) >=
+        kParMinRows (4096): the small cases above run the inline path,
+        which would let a chunk-boundary dependence in the parallel
+        passes ship unnoticed. 16k rows push past the threshold so the
+        pool genuinely runs."""
+        cand_p, cand_c = _synthetic_candidates(1, 16384, 16384, K=16)
+        ref = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 16384, eps=0.1, max_iters=12, tol=0.0, threads=1
+        )
+        got = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 16384, eps=0.1, max_iters=12, tol=0.0,
+            threads=threads,
+        )
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+        assert got[2] == ref[2] and got[3] == ref[3]
+
+
+class TestShiftInvariance:
+    def test_uniform_shift_preserves_the_plan(self):
+        """The warm-carry soundness argument: the plan exp((f+g-c)/eps)
+        is invariant under (f - s, g + s), so a carried potential pair is
+        as good a warm start as any of its shifts — one update from
+        shifted duals lands exactly one shift away from the unshifted
+        run (the f update re-pins the gauge)."""
+        cand_p, cand_c = _synthetic_candidates(2, 1024, 1024)
+        f0, g0, _, _ = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 1024, eps=0.1, max_iters=10, tol=0.0, threads=2
+        )
+        shift = np.float32(3.5)
+        fa, ga, ita, _ = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 1024, eps=0.1, max_iters=5, tol=0.0, threads=2,
+            f=f0, g=g0,
+        )
+        fb, gb, itb, _ = native.sinkhorn_sparse_mt(
+            cand_p, cand_c, 1024, eps=0.1, max_iters=5, tol=0.0, threads=2,
+            f=f0 - shift, g=g0 + shift,
+        )
+        assert ita == itb
+        # f depends on g only through (g - c)/eps: the shifted run's f is
+        # the unshifted f minus the shift, g re-converges on top of it
+        np.testing.assert_allclose(fb + shift, fa, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(gb - shift, ga, rtol=2e-5, atol=2e-5)
+
+
+class TestArenaSinkhorn:
+    def _marketplace(self, seed=0, n=256):
+        return encode_random_marketplace(seed, n, n)
+
+    def test_cold_solve_injective_and_auction_grade(self):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace(7, 512)
+        w = CostWeights()
+        a_sink = NativeSolveArena(threads=2, engine="sinkhorn")
+        a_auc = NativeSolveArena(threads=2)
+        p_s = a_sink.solve(ep, er, w)
+        p_a = a_auc.solve(ep, er, w)
+        assert a_sink.last_stats["engine"] == "sinkhorn"
+        assert a_sink.last_stats["sinkhorn_iters"] > 0
+        pos = p_s[p_s >= 0]
+        assert np.unique(pos).size == pos.size
+        n_s, n_a = int((p_s >= 0).sum()), int((p_a >= 0).sum())
+        # referee rounding must not lose matchings the plain auction finds
+        assert n_s >= n_a - max(2, 512 // 100)
+        f, g = a_sink.potentials
+        assert f is not None and f.shape == (512,)
+        assert g is not None and g.shape == (512,)
+
+    def test_warm_recomputes_only_dirty_rows(self, monkeypatch):
+        """The tentpole's warm contract on the sinkhorn path: churn flows
+        through the SAME arena delta machinery as the auction engine —
+        dirty tasks get one fused pass, dirty providers one delta pass,
+        and the potentials re-converge from the carried (f, g) instead of
+        a cold anneal."""
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace(3, 256)
+        w = CostWeights()
+        arena = NativeSolveArena(threads=2, engine="sinkhorn")
+        arena.solve(ep, er, w)
+        f_before = arena.potentials[0].copy()
+
+        mem = np.array(ep.gpu_mem_mb, copy=True)
+        mem[[5, 60]] += 8000
+        ep2 = dataclasses.replace(ep, gpu_mem_mb=mem)
+        shapes = []
+        real = native.fused_topk_candidates
+        monkeypatch.setattr(
+            native, "fused_topk_candidates",
+            lambda p, r, *a, **kw: shapes.append(
+                (np.asarray(p.price).shape[0], np.asarray(r.priority).shape[0])
+            )
+            or real(p, r, *a, **kw),
+        )
+        p4t = arena.solve(ep2, er, w)
+        stats = arena.last_stats
+        assert stats["cold"] is False
+        assert stats["engine"] == "sinkhorn"
+        assert stats["dirty_providers"] == 2
+        # exactly one [2 dirty providers x full-T] delta pass — never a
+        # full regeneration, never a cold anneal
+        assert shapes == [(2, 256)]
+        assert stats["sinkhorn_phases"] == 1  # warm: single fine phase
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size
+        # potentials were carried and re-converged, not reset to zero
+        f_after = arena.potentials[0]
+        assert not np.array_equal(f_after, np.zeros_like(f_after))
+        assert np.abs(f_after - f_before).max() < 10.0
+
+    def test_no_churn_short_circuits(self, monkeypatch):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._marketplace(5, 256)
+        w = CostWeights()
+        arena = NativeSolveArena(threads=2, engine="sinkhorn")
+        p1 = arena.solve(ep, er, w)
+        monkeypatch.setattr(
+            native, "fused_topk_candidates",
+            lambda *a, **kw: pytest.fail("byte-identical solve regenerated"),
+        )
+        monkeypatch.setattr(
+            native, "sinkhorn_sparse_mt",
+            lambda *a, **kw: pytest.fail("byte-identical solve re-iterated"),
+        )
+        p2 = arena.solve(ep, er, w)
+        np.testing.assert_array_equal(p1, p2)
+        assert arena.last_stats["changed_rows"] == 0
+
+    def test_matcher_engages_sinkhorn_arena(self):
+        """TpuBatchMatcher(native_engine='sinkhorn-mt') routes phase 1
+        through the sinkhorn arena and reports its stats."""
+        import random
+
+        from protocol_tpu.models.task import (
+            SchedulingConfig,
+            Task,
+            TaskRequest,
+        )
+        from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+        from protocol_tpu.store import (
+            NodeStatus,
+            OrchestratorNode,
+            StoreContext,
+        )
+        from tests.test_encoding import random_specs
+
+        rng = random.Random(9)
+        store = StoreContext.new_test()
+        for i in range(12):
+            store.node_store.add_node(
+                OrchestratorNode(
+                    address=f"0xsk{i:02d}",
+                    status=NodeStatus.HEALTHY,
+                    compute_specs=random_specs(rng),
+                )
+            )
+        store.task_store.add_task(
+            Task.from_request(
+                TaskRequest(
+                    name="sk-b",
+                    image="img",
+                    scheduling_config=SchedulingConfig(
+                        plugins={"tpu_scheduler": {"replicas": ["4"]}}
+                    ),
+                )
+            )
+        )
+        m = TpuBatchMatcher(
+            store, min_solve_interval=0.0, native_fallback=True,
+            native_engine="sinkhorn-mt", native_threads=2,
+        )
+        m.refresh()
+        assert m.last_solve_stats["kernel"] == "native_cpu_sinkhorn_mt"
+        assert m.last_solve_stats["arena_cold"] is True
+        assert m.last_solve_stats["arena_engine"] == "sinkhorn"
+        first = dict(m._assignment)
+        m.mark_dirty()
+        m.refresh()
+        assert m.last_solve_stats["arena_cold"] is False
+        assert m._assignment == first  # steady state: no flapping
+
+    def test_rejects_unknown_engine(self):
+        from protocol_tpu.native.arena import NativeSolveArena
+        from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
+        from protocol_tpu.store import StoreContext
+
+        with pytest.raises(ValueError):
+            NativeSolveArena(engine="simplex")
+        with pytest.raises(ValueError):
+            TpuBatchMatcher(
+                StoreContext.new_test(), native_engine="sinkhorn"
+            )
+
+
+class TestGrpcKernel:
+    def test_unary_assign_with_sinkhorn_kernel(self):
+        """kernel='sinkhorn-mt:2' through the v1 Assign surface: the
+        servicer's unary arena solves with the sinkhorn engine, and a
+        repeat call rides the warm path (same matching, no flapping)."""
+        from protocol_tpu.services.scheduler_grpc import (
+            SchedulerBackendServicer,
+            encoded_to_proto,
+        )
+
+        ep, er = encode_random_marketplace(13, 96, 64)
+        servicer = SchedulerBackendServicer()
+        req = encoded_to_proto(
+            ep, er, CostWeights(), kernel="sinkhorn-mt:2", top_k=16
+        )
+        resp1 = servicer.Assign(req, context=None)
+        assert servicer._native_arena is not None
+        assert servicer._native_arena.engine == "sinkhorn"
+        p4t = np.asarray(resp1.provider_for_task, np.int32)
+        pos = p4t[p4t >= 0]
+        assert np.unique(pos).size == pos.size
+        assert resp1.num_assigned == int((p4t >= 0).sum())
+        resp2 = servicer.Assign(req, context=None)
+        np.testing.assert_array_equal(
+            np.asarray(resp2.provider_for_task, np.int32), p4t
+        )
+
+    def test_parse_session_kernel(self):
+        from protocol_tpu.services.session_store import (
+            parse_native_threads,
+            parse_session_kernel,
+        )
+
+        assert parse_session_kernel("native-mt") == ("auction", 0)
+        assert parse_session_kernel("native-mt:4") == ("auction", 4)
+        assert parse_session_kernel("sinkhorn-mt") == ("sinkhorn", 0)
+        assert parse_session_kernel("sinkhorn-mt:2") == ("sinkhorn", 2)
+        assert parse_session_kernel("topk") is None
+        assert parse_session_kernel("sinkhorn-mt:x") is None
+        assert parse_native_threads("sinkhorn-mt:3") == 3
+        assert parse_native_threads("auction") is None
