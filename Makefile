@@ -4,7 +4,17 @@
 
 PY ?= python
 
-.PHONY: test test-fast native devnet devnet-persistent bench bench-scaling clean lint
+# Native engine codegen flags. -march=x86-64-v2 (not -march=native): the
+# .so must load on any CI/prod host, and sanitizer stacks want a stable
+# ISA. Override for tuned local builds: make native NATIVE_CFLAGS="-O3 -march=native"
+# (protocol_tpu/native/__init__.py honors the same env var).
+NATIVE_CFLAGS ?= -O3 -march=x86-64-v2
+NATIVE_BASE = -std=gnu++17 -pthread -shared -fPIC
+# sanitizer builds: -O1 -g keeps symbols/line numbers in reports and the
+# slowdown usable; separate .so names so they never clobber the prod build
+NATIVE_SAN_CFLAGS ?= -O1 -g -march=x86-64-v2
+
+.PHONY: test test-fast native native-tsan native-asan sanitize devnet devnet-persistent bench bench-scaling clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -15,7 +25,21 @@ test-fast:
 # native CPU assignment engine (ctypes-loaded shared library; -pthread
 # for the multi-threaded engine=native-mt variants)
 native:
-	g++ -O3 -march=native -std=gnu++17 -pthread -shared -fPIC -o native/libassign_engine.so native/assign_engine.cpp
+	g++ $(NATIVE_CFLAGS) $(NATIVE_BASE) -o native/libassign_engine.so native/assign_engine.cpp
+
+# sanitizer-instrumented variants (selected at runtime via
+# PROTOCOL_TPU_NATIVE_SANITIZE=tsan|asan; driven end-to-end by
+# scripts/sanitize_native.py, which LD_PRELOADs the matching runtime)
+native-tsan:
+	g++ $(NATIVE_SAN_CFLAGS) -fsanitize=thread $(NATIVE_BASE) -o native/libassign_engine.tsan.so native/assign_engine.cpp
+
+native-asan:
+	g++ $(NATIVE_SAN_CFLAGS) -fsanitize=address,undefined -fno-sanitize-recover=all $(NATIVE_BASE) -o native/libassign_engine.asan.so native/assign_engine.cpp
+
+# TSan stress gate over all three -mt kernels (threads 1/2/4/8, churned
+# warm-arena re-solves); add --sanitizer asan for the memory/UB pass
+sanitize:
+	$(PY) scripts/sanitize_native.py --sanitizer tsan
 
 # one-command local cluster: ledger API + discovery + orchestrator +
 # validator + workers. See python -m protocol_tpu.devnet --help.
@@ -41,12 +65,16 @@ bench-scaling:
 scale-tests:
 	PROTOCOL_TPU_SCALE_TESTS=1 $(PY) -m pytest tests/test_scale_matcher.py -v
 
-# regenerate protobuf messages for the gRPC shim
+# fail-the-build lint discipline: the hermetic unused-import gate plus
+# the project rule engine (determinism / lock / dtype / dense-alloc
+# contracts — scripts/lints/)
 lint:
-	python scripts/lint.py
+	$(PY) scripts/lint.py
+	$(PY) -m scripts.lints
 
 proto:
 	protoc --python_out=. protocol_tpu/proto/scheduler.proto
 
 clean:
-	rm -rf native/libassign_engine.so **/__pycache__ .pytest_cache
+	rm -rf native/libassign_engine.so native/libassign_engine.tsan.so \
+	  native/libassign_engine.asan.so **/__pycache__ .pytest_cache

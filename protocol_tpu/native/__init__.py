@@ -19,25 +19,103 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "assign_engine.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "libassign_engine.so")
 
-_lib: Optional[ctypes.CDLL] = None
+# One shared library per build variant. The production build takes
+# NATIVE_CFLAGS verbatim (same knob the Makefile honors); sanitizer
+# variants pin -O1 -g so reports keep symbols/line numbers and the
+# slowdown stays usable, and live in their own .so files so a sanitizer
+# run never clobbers (or reuses) the production artifact.
+_SO_VARIANTS = {
+    "": "libassign_engine.so",
+    "tsan": "libassign_engine.tsan.so",
+    "asan": "libassign_engine.asan.so",
+}
+_SANITIZE_FLAGS = {
+    "tsan": ["-fsanitize=thread"],
+    "asan": ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
+}
+# -march=x86-64-v2 (SSE4.2/POPCNT baseline, 2009+ hardware) instead of
+# -march=native: a .so built on a dev box must load on any CI/prod host,
+# and sanitizer builds want a stable ISA so reports reproduce across
+# machines. Override via NATIVE_CFLAGS for tuned local builds.
+_DEFAULT_CFLAGS = "-O3 -march=x86-64-v2"
+
+_libs: dict[str, ctypes.CDLL] = {}
 
 
 class NativeBuildError(RuntimeError):
     pass
 
 
-def _build() -> None:
-    cmd = [
-        "g++", "-O3", "-march=native", "-std=gnu++17", "-pthread",
-        "-shared", "-fPIC", "-o", _SO, _SRC,
-    ]
+def sanitize_variant() -> str:
+    """Active build variant from PROTOCOL_TPU_NATIVE_SANITIZE
+    ("" | "tsan" | "asan"). Read per load() call, not at import, so the
+    stress harness can select a variant for its child processes."""
+    v = os.environ.get("PROTOCOL_TPU_NATIVE_SANITIZE", "").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return ""
+    if v not in _SANITIZE_FLAGS:
+        raise NativeBuildError(
+            f"PROTOCOL_TPU_NATIVE_SANITIZE must be tsan|asan, got {v!r}"
+        )
+    return v
+
+
+def so_path(variant: str = "") -> str:
+    return os.path.join(_REPO_ROOT, "native", _SO_VARIANTS[variant])
+
+
+def _cflags(variant: str) -> list[str]:
+    flags = os.environ.get("NATIVE_CFLAGS", _DEFAULT_CFLAGS).split()
+    if variant:
+        # sanitizer builds: drop the opt level (and any -march=native a
+        # local override smuggled in) for -O1 -g + the sanitizer flags
+        flags = [
+            f for f in flags
+            if not f.startswith("-O") and f != "-march=native"
+        ]
+        flags = ["-O1", "-g", *_SANITIZE_FLAGS[variant], *flags]
+    return flags
+
+
+def _build(variant: str = "") -> None:
+    base = ["-std=gnu++17", "-pthread", "-shared", "-fPIC"]
+    flags = _cflags(variant)
+    cmd = ["g++", *flags, *base, "-o", so_path(variant), _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        detail = getattr(e, "stderr", str(e))
-        raise NativeBuildError(f"native engine build failed: {detail}") from e
+    except FileNotFoundError as e:
+        raise NativeBuildError(f"native engine build failed: {e}") from e
+    except subprocess.CalledProcessError as e:
+        march = [f for f in flags if f.startswith("-march=")]
+        if not march:
+            raise NativeBuildError(
+                f"native engine build failed: {e.stderr}"
+            ) from e
+        # toolchains older than GCC 11 / Clang 12 may not know the
+        # x86-64-v2 level name: retry portable (plain -O level)
+        cmd = [
+            "g++", *[f for f in flags if not f.startswith("-march=")],
+            *base, "-o", so_path(variant), _SRC,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e2:
+            raise NativeBuildError(
+                f"native engine build failed: {e2.stderr}"
+            ) from e2
+
+
+def build(variant: str = "") -> str:
+    """Build one variant unconditionally; returns the .so path (the
+    sanitizer harness and Makefile parity entry point)."""
+    if variant not in _SO_VARIANTS:
+        raise NativeBuildError(
+            f"unknown build variant {variant!r} "
+            f"(want one of {sorted(_SO_VARIANTS)})"
+        )
+    _build(variant)
+    return so_path(variant)
 
 
 class _ProviderFeatures(ctypes.Structure):
@@ -83,13 +161,18 @@ class _RequirementFeatures(ctypes.Structure):
 
 def load() -> ctypes.CDLL:
     """Build (if stale) and load the engine. Raises NativeBuildError if no
-    toolchain is available — callers fall back to the numpy/JAX paths."""
-    global _lib
-    if _lib is not None:
-        return _lib
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-        _build()
-    lib = ctypes.CDLL(_SO)
+    toolchain is available — callers fall back to the numpy/JAX paths.
+    PROTOCOL_TPU_NATIVE_SANITIZE=tsan|asan selects the instrumented
+    variant (run under the matching LD_PRELOADed runtime — see
+    scripts/sanitize_native.py, which drives exactly that)."""
+    variant = sanitize_variant()
+    cached = _libs.get(variant)
+    if cached is not None:
+        return cached
+    so = so_path(variant)
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(_SRC):
+        _build(variant)
+    lib = ctypes.CDLL(so)
 
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -133,7 +216,7 @@ def load() -> ctypes.CDLL:
         f32p, f32p, ctypes.POINTER(ctypes.c_float),
     ]
     lib.sinkhorn_sparse_mt.restype = ctypes.c_int32
-    _lib = lib
+    _libs[variant] = lib
     return lib
 
 

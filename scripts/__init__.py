@@ -1,0 +1,1 @@
+"""Repo tooling package (makes ``python -m scripts.lints`` importable)."""

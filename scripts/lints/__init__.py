@@ -1,0 +1,21 @@
+"""Project lint engine: machine-checked versions of the contracts the
+codebase only documents. See base.py for the framework and the rule
+modules for the catalog:
+
+  determinism     no ambient nondeterminism in native/ops solver paths
+  lock-discipline session/arena state only under its lock (services)
+  dtype-contract  one canonical dtype table across wire/arena/encoding
+  dense-alloc     no O(P*T) numpy allocations outside ops/blocked.py
+
+Run: ``python -m scripts.lints`` (exit 1 on any finding — the clippy
+``-D warnings`` discipline of the reference CI, applied to the
+invariants clippy cannot see).
+"""
+
+from scripts.lints import densealloc, determinism, dtype_contract, lockdiscipline  # noqa: F401
+from scripts.lints.base import RULES, Finding, Rule, Source, register, run_rules
+
+__all__ = [
+    "RULES", "Finding", "Rule", "Source", "register", "run_rules",
+    "determinism", "lockdiscipline", "dtype_contract", "densealloc",
+]

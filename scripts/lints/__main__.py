@@ -1,0 +1,47 @@
+"""CLI for the project lint engine: ``python -m scripts.lints [roots...]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from scripts.lints import RULES, run_rules
+from scripts.lints.base import DEFAULT_ROOTS
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.lints",
+        description="project rule engine (determinism / lock / dtype / "
+                    "dense-alloc contracts)",
+    )
+    ap.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="files or directories to lint (default: %(default)s)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list", action="store_true", help="list rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for r in RULES:
+            print(f"{r.name:16s} escape: # lint: {r.suppress_token}")
+        return 0
+    rules = None
+    if args.rule:
+        known = {r.name: r for r in RULES}
+        unknown = [n for n in args.rule if n not in known]
+        if unknown:
+            print(f"unknown rule(s): {unknown}; have {sorted(known)}")
+            return 2
+        rules = [known[n] for n in args.rule]
+    findings = run_rules(roots=args.roots, rules=rules)
+    for f in findings:
+        print(f)
+    if not findings:
+        names = ", ".join(r.name for r in (rules or RULES))
+        print(f"lints clean ({names}) over {', '.join(args.roots)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""Rule framework for the project lint engine (``python -m scripts.lints``).
+
+The reference repo enforces correctness statically — clippy ``-D
+warnings`` fails its build. This port's equivalents are *project*
+contracts no off-the-shelf linter knows about: bit-identical solver
+results (no ambient nondeterminism in kernel paths), lock-held access to
+shared session/arena state, canonical wire dtypes, and no dense O(P*T)
+allocations outside the blocked kernels. Each rule here is one AST
+visitor with a fixture-driven test (tests/test_lints.py): the fixture
+seeds violations the rule must catch 100% of, and the real tree must
+come back clean — so a refactor that breaks a contract fails CI the same
+push, not three perf PRs later.
+
+Writing a rule:
+
+    @register
+    class MyRule(Rule):
+        name = "my-rule"
+        suppress_token = "my-rule-ok"       # escape: `# lint: my-rule-ok`
+        def applies(self, rel): ...          # repo-relative path filter
+        def check(self, src): ...            # per-file AST pass
+        def check_repo(self): ...            # optional cross-file pass
+
+Suppression: a finding on a line containing ``# lint: <token>`` (or the
+blanket ``# lint: ok``) is dropped — the annotation is the audit trail
+for every deliberate exemption, like clippy's ``#[allow(...)]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Optional
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+
+# default tree the engine walks (fixtures hold deliberate violations and
+# are only ever linted explicitly, by the tests)
+DEFAULT_ROOTS = ("protocol_tpu",)
+SKIP_PARTS = {"__pycache__", "fixtures"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Source:
+    """One parsed file handed to rules: text, line list, AST (with parent
+    back-links so visitors can ask about enclosing scopes)."""
+
+    def __init__(self, path: pathlib.Path):
+        self.path = path
+        try:
+            self.rel = str(path.resolve().relative_to(REPO))
+        except ValueError:
+            self.rel = str(path)
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=str(path))
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._lint_parent = node  # type: ignore[attr-defined]
+
+    def ancestors(self, node: ast.AST):
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_lint_parent", None)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[str]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc.name
+        return None
+
+    def suppressed(self, line: int, token: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            text = self.lines[line - 1]
+            return f"lint: {token}" in text or "lint: ok" in text
+        return False
+
+
+class Rule:
+    name: str = ""
+    suppress_token: str = ""
+
+    def applies(self, rel: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, src: Source) -> list[Finding]:
+        return []
+
+    def check_repo(self) -> list[Finding]:
+        """Cross-file invariants (dtype contracts span three modules);
+        run once per engine invocation, not per file."""
+        return []
+
+    def finding(self, src: Source, node, message: str) -> list[Finding]:
+        line = getattr(node, "lineno", 0)
+        if self.suppress_token and src.suppressed(line, self.suppress_token):
+            return []
+        return [Finding(self.name, src.rel, line, message)]
+
+
+RULES: list[Rule] = []
+
+
+def register(cls):
+    RULES.append(cls())
+    return cls
+
+
+def iter_files(roots=DEFAULT_ROOTS) -> list[pathlib.Path]:
+    out = []
+    for root in roots:
+        p = REPO / root if not pathlib.Path(root).is_absolute() else pathlib.Path(root)
+        if p.is_file():
+            out.append(p)
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if not SKIP_PARTS.intersection(f.parts):
+                out.append(f)
+    return out
+
+
+def run_rules(
+    roots=DEFAULT_ROOTS, rules: Optional[list[Rule]] = None
+) -> list[Finding]:
+    """The engine: parse each file once, dispatch to every applicable
+    rule, then run the cross-file passes. Returns all findings (empty ==
+    the build may proceed)."""
+    active = RULES if rules is None else rules
+    findings: list[Finding] = []
+    for path in iter_files(roots):
+        resolved = path.resolve()
+        rel = (
+            str(resolved.relative_to(REPO))
+            if resolved.is_relative_to(REPO) else str(path)
+        )
+        # an explicitly-named file is linted by every rule — "lint this
+        # file" beats path scoping (fixture tests and spot checks)
+        explicit = str(path) in map(str, roots) or rel in roots
+        applicable = [r for r in active if explicit or r.applies(rel)]
+        if not applicable:
+            continue
+        try:
+            src = Source(path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "syntax", rel, e.lineno or 0, f"syntax error: {e.msg}"
+            ))
+            continue
+        for rule in applicable:
+            findings.extend(rule.check(src))
+    for rule in active:
+        findings.extend(rule.check_repo())
+    return findings

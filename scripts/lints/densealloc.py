@@ -1,0 +1,82 @@
+"""Dense-allocation rule: no O(P*T) numpy tensors outside ops/blocked.py.
+
+The whole scale story (SCALING.md ladder) is that nothing materializes
+the [P, T] plane on the host: candidates are top-K sparse, the wire
+ships columns, the arena diffs rows. One careless ``np.zeros((P, T))``
+in a 1M x 1M code path is a 4 TB allocation — it OOMs in production
+after sailing through every 2k-row test. The blocked JAX kernels
+(ops/blocked.py) are the single audited home of dense tiles and are
+exempt.
+
+Detection: calls to ``np.zeros/ones/empty/full`` whose shape tuple has
+two or more population-scale dimensions — identifier names the codebase
+uses for provider/task row counts (``P``, ``T``, ``t_pad``,
+``num_providers``, ...). Bounded dims (``k``, ``extra``, group counts)
+never match, so [T, k] candidate buffers stay legal.
+
+Escape: ``# lint: dense-ok`` for an audited dense allocation (with the
+bound argued in a comment, like blocked.py's tiles).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from scripts.lints.base import Finding, Rule, Source, register
+
+_ALLOC_FNS = {"zeros", "ones", "empty", "full"}
+_NP_ROOTS = {"np", "numpy"}
+# identifiers this codebase uses for population-scale row counts
+_POP_DIMS = {
+    "P", "T", "Pn", "Pl", "p_pad", "t_pad", "s_pad", "r_pad", "rpad",
+    "p_padded", "t_padded", "n_providers", "num_providers", "n_tasks",
+    "num_tasks", "n_p", "n_t", "n_real", "P_pad", "T_pad",
+}
+
+
+def _dim_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+@register
+class DenseAllocRule(Rule):
+    name = "dense-alloc"
+    suppress_token = "dense-ok"
+
+    def applies(self, rel: str) -> bool:
+        return (
+            rel.startswith("protocol_tpu/")
+            and not rel.endswith("ops/blocked.py")
+        )
+
+    def check(self, src: Source) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _ALLOC_FNS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in _NP_ROOTS
+            ):
+                continue
+            shape = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "shape"), None
+            )
+            if not isinstance(shape, (ast.Tuple, ast.List)):
+                continue
+            pop = [d for d in map(_dim_name, shape.elts) if d in _POP_DIMS]
+            if len(pop) >= 2:
+                out += self.finding(
+                    src, node,
+                    f"dense np.{fn.attr} over population-scale dims "
+                    f"{pop} — O(P*T) host allocations live only in "
+                    "ops/blocked.py (4 TB at the 1M ladder)",
+                )
+        return out

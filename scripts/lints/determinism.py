@@ -1,0 +1,145 @@
+"""Determinism rule: no ambient nondeterminism in solver paths.
+
+The native/ops kernels promise bit-identical results for every thread
+count and every process (PR 1/3's warm chains, the session protocol's
+tick parity, the perf gate's thread-invariance floor all rest on it).
+Three ambient-nondeterminism classes can silently break that promise:
+
+  * iteration over sets (``for x in {...}`` / ``set(...)`` /
+    ``frozenset(...)``): ordering depends on PYTHONHASHSEED, so two
+    replicas iterate differently. Wrap in ``sorted(...)`` instead.
+    (Dict iteration is insertion-ordered in CPython >= 3.7 and allowed;
+    iterating ``vars()``/``globals()``/``locals()`` is not — attribute
+    insertion order is an implementation detail of unrelated code.)
+  * wall-clock reads (``time.time()`` / ``time.time_ns()``) feeding
+    solver state. ``perf_counter`` for *stats* is fine — stats ride next
+    to results, never into them.
+  * ``random`` / ``np.random`` in kernel code: even seeded generators
+    drift across numpy versions; jitter must come from the hash-based
+    tie-breakers the kernels already share.
+
+Scope: ``protocol_tpu/native/`` and ``protocol_tpu/ops/``.
+Escape: ``# lint: determinism-ok`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from scripts.lints.base import Finding, Rule, Source, register
+
+_SET_BUILTINS = {"set", "frozenset"}
+_NONDET_MAPPINGS = {"vars", "globals", "locals"}
+_RANDOM_ROOTS = {"np", "numpy"}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _SET_BUILTINS | _NONDET_MAPPINGS
+    return False
+
+
+@register
+class DeterminismRule(Rule):
+    name = "determinism"
+    suppress_token = "determinism-ok"
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(("protocol_tpu/native/", "protocol_tpu/ops/"))
+
+    @staticmethod
+    def _time_bindings(tree: ast.AST) -> tuple[set[str], set[str]]:
+        """(aliases the time MODULE is bound to, local names bound to
+        time.time/time_ns themselves) — so `import time as clock` and
+        `from time import time` can't dodge the wall-clock check."""
+        mod_aliases: set[str] = set()
+        fn_names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mod_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in ("time", "time_ns"):
+                        fn_names.add(a.asname or a.name)
+        return mod_aliases, fn_names
+
+    def check(self, src: Source) -> list[Finding]:
+        out: list[Finding] = []
+        self._time_mods, self._time_fns = self._time_bindings(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                out += self._check_iter(src, node.iter)
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                for gen in node.generators:
+                    out += self._check_iter(src, gen.iter)
+            elif isinstance(node, ast.Call):
+                out += self._check_call(src, node)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                out += self._check_import(src, node)
+        return out
+
+    def _check_iter(self, src: Source, it: ast.AST) -> list[Finding]:
+        if _is_set_expr(it):
+            return self.finding(
+                src, it,
+                "iteration over an unsorted set/mapping view — hash-order "
+                "varies per process; wrap in sorted(...)",
+            )
+        return []
+
+    def _check_call(self, src: Source, call: ast.Call) -> list[Finding]:
+        fn = call.func
+        # from time import time [as t]; t()
+        if isinstance(fn, ast.Name) and fn.id in self._time_fns:
+            return self.finding(
+                src, call,
+                "wall-clock read in a solver path — results must not "
+                "depend on when the solve ran",
+            )
+        if not isinstance(fn, ast.Attribute):
+            return []
+        # <any alias of the time module>.time()/.time_ns()
+        if fn.attr in ("time", "time_ns") and isinstance(fn.value, ast.Name):
+            if fn.value.id in self._time_mods:
+                return self.finding(
+                    src, call,
+                    "wall-clock read in a solver path — results must not "
+                    "depend on when the solve ran",
+                )
+        # random.X(...) / np.random.X(...)
+        root = fn.value
+        if isinstance(root, ast.Name) and root.id == "random":
+            return self.finding(
+                src, call, "random module call in a solver path"
+            )
+        if (
+            isinstance(root, ast.Attribute)
+            and root.attr == "random"
+            and isinstance(root.value, ast.Name)
+            and root.value.id in _RANDOM_ROOTS
+        ):
+            return self.finding(
+                src, call,
+                "np.random in a solver path — jitter must come from the "
+                "shared hash-based tie-breakers",
+            )
+        return []
+
+    def _check_import(self, src: Source, node: ast.AST) -> list[Finding]:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "random" or a.name.startswith("random."):
+                    return self.finding(
+                        src, node, "random import in a solver module"
+                    )
+        elif isinstance(node, ast.ImportFrom) and node.module == "random":
+            return self.finding(
+                src, node, "random import in a solver module"
+            )
+        return []

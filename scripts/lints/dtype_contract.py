@@ -1,0 +1,223 @@
+"""Dtype-contract rule: one canonical dtype per column, everywhere.
+
+The zero-copy wire (PR 2) rests on a single dtype table: a column rides
+``TensorBlob`` frames as raw bytes, is asserted ONCE at decode against
+``proto/wire.py``'s ``P_WIRE_DTYPES``/``R_WIRE_DTYPES``, and then flows
+unchecked into the arena, whose own ``_P_SPEC``/``_R_SPEC`` drive the
+value-based dirty diffing and the C++ engine's pointer casts. Three
+places hold that table today; nothing cross-checks them — a new column
+added to one with a different width corrupts the seam silently (the C++
+side reads raw pointers at the dtype it was told).
+
+This rule makes the contract mechanical:
+
+  * ``P_WIRE_DTYPES``/``R_WIRE_DTYPES`` (wire) and ``_P_SPEC``/``_R_SPEC``
+    (arena) must list the SAME columns in the SAME order with
+    width-compatible dtypes (``bool_`` on the wire and ``uint8`` in the
+    arena are the same byte — the documented numpy<->ctypes seam).
+  * the wire specs must cover exactly the ``EncodedProviders`` /
+    ``EncodedRequirements`` dataclass fields (ops/encoding.py) — a field
+    added to the encoding but not the wire would vanish at the seam.
+  * every ``blob(...)``/``unblob(...)`` call site must pass an explicit
+    dtype (second argument): an un-annotated encode/decode reintroduces
+    exactly the silent-coercion class the seam's single-assert design
+    removed. Escape: ``# lint: dtype-ok``.
+
+Everything is read via AST — the rule never imports the modules it
+audits (ops/encoding.py pulls jax; lint must run on a bare host).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Optional
+
+from scripts.lints.base import REPO, Finding, Rule, Source, register
+
+# 1-byte equivalence across the numpy<->wire<->ctypes seam
+_EQUIV = {"bool_": "u1", "bool": "u1", "uint8": "u1"}
+
+_WIRE = "protocol_tpu/proto/wire.py"
+_ARENA = "protocol_tpu/native/arena.py"
+_ENCODING = "protocol_tpu/ops/encoding.py"
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """``np.dtype(np.int32)`` / ``np.int32`` / ``"int32"`` -> "int32"."""
+    if isinstance(node, ast.Call) and node.args:
+        return _dtype_name(node.args[0])
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _canon(name: str) -> str:
+    return _EQUIV.get(name, name)
+
+
+def _dict_spec(tree: ast.AST, var: str) -> Optional[list[tuple[str, str, int]]]:
+    """Extract ``VAR = {"col": np.dtype(np.int32), ...}`` as
+    [(name, dtype, line)]."""
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == var and isinstance(value, ast.Dict):
+                out = []
+                for k, v in zip(value.keys, value.values):
+                    if isinstance(k, ast.Constant):
+                        out.append((k.value, _dtype_name(v) or "?", k.lineno))
+                return out
+    return None
+
+
+def _tuple_spec(tree: ast.AST, var: str) -> Optional[list[tuple[str, str, int]]]:
+    """Extract ``VAR = (("col", np.int32), ...)`` as [(name, dtype, line)]."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == var and isinstance(
+                node.value, (ast.Tuple, ast.List)
+            ):
+                out = []
+                for elt in node.value.elts:
+                    if isinstance(elt, (ast.Tuple, ast.List)) and len(elt.elts) == 2:
+                        k, v = elt.elts
+                        if isinstance(k, ast.Constant):
+                            out.append((k.value, _dtype_name(v) or "?", k.lineno))
+                return out
+    return None
+
+
+def _dataclass_fields(tree: ast.AST, cls: str) -> Optional[list[str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls:
+            return [
+                stmt.target.id
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ]
+    return None
+
+
+@register
+class DtypeContractRule(Rule):
+    name = "dtype-contract"
+    suppress_token = "dtype-ok"
+
+    def __init__(
+        self,
+        wire: str = _WIRE,
+        arena: str = _ARENA,
+        encoding: Optional[str] = _ENCODING,
+    ):
+        self.wire = wire
+        self.arena = arena
+        self.encoding = encoding
+
+    def applies(self, rel: str) -> bool:
+        # call-site pass: anywhere blob/unblob travel
+        return rel.startswith("protocol_tpu/")
+
+    # ---------------- per-file: encode/decode call sites ----------------
+
+    def check(self, src: Source) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if fname not in ("blob", "unblob"):
+                continue
+            has_dtype = len(node.args) >= 2 or any(
+                kw.arg in ("dtype", "expect") for kw in node.keywords
+            )
+            if not has_dtype:
+                out += self.finding(
+                    src, node,
+                    f"{fname}() without an explicit dtype — the seam "
+                    "asserts dtypes exactly once, at this boundary",
+                )
+        return out
+
+    # ---------------- cross-file: the canonical tables ----------------
+
+    def _parse(self, rel: str) -> Optional[ast.AST]:
+        path = pathlib.Path(rel)
+        if not path.is_absolute():
+            path = REPO / rel
+        if not path.exists():
+            return None
+        return ast.parse(path.read_text(), filename=str(path))
+
+    def check_repo(self) -> list[Finding]:
+        out: list[Finding] = []
+        wire_tree = self._parse(self.wire)
+        arena_tree = self._parse(self.arena)
+        if wire_tree is None or arena_tree is None:
+            return [Finding(
+                self.name, self.wire, 0,
+                "cannot locate the wire/arena dtype tables to cross-check",
+            )]
+        enc_tree = self._parse(self.encoding) if self.encoding else None
+        for wire_var, arena_var, enc_cls in (
+            ("P_WIRE_DTYPES", "_P_SPEC", "EncodedProviders"),
+            ("R_WIRE_DTYPES", "_R_SPEC", "EncodedRequirements"),
+        ):
+            wspec = _dict_spec(wire_tree, wire_var)
+            aspec = _tuple_spec(arena_tree, arena_var)
+            if wspec is None or aspec is None:
+                out.append(Finding(
+                    self.name, self.wire if wspec is None else self.arena, 0,
+                    f"missing dtype table {wire_var if wspec is None else arena_var}",
+                ))
+                continue
+            wnames = [n for n, _, _ in wspec]
+            anames = [n for n, _, _ in aspec]
+            if wnames != anames:
+                extra_w = [n for n in wnames if n not in anames]
+                extra_a = [n for n in anames if n not in wnames]
+                detail = (
+                    f"wire-only={extra_w} arena-only={extra_a}"
+                    if (extra_w or extra_a) else "same columns, different order"
+                )
+                out.append(Finding(
+                    self.name, self.arena,
+                    aspec[0][2] if aspec else 0,
+                    f"{arena_var} columns disagree with {wire_var} "
+                    f"({detail}) — diffing and pointer casts follow this "
+                    "order",
+                ))
+            for (wn, wd, wl), (an, ad, al) in zip(wspec, aspec):
+                if wn == an and _canon(wd) != _canon(ad):
+                    out.append(Finding(
+                        self.name, self.arena, al,
+                        f"column {an!r}: arena dtype {ad} vs wire dtype "
+                        f"{wd} — the engine reads raw pointers at the "
+                        "declared width",
+                    ))
+            if enc_tree is not None:
+                fields = _dataclass_fields(enc_tree, enc_cls)
+                if fields is not None and set(fields) != set(wnames):
+                    missing = sorted(set(fields) - set(wnames))
+                    stray = sorted(set(wnames) - set(fields))
+                    out.append(Finding(
+                        self.name, self.wire, wspec[0][2] if wspec else 0,
+                        f"{wire_var} does not cover {enc_cls} exactly "
+                        f"(missing={missing} stray={stray}) — un-listed "
+                        "columns vanish at the seam",
+                    ))
+        return out

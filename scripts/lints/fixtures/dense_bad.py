@@ -1,0 +1,18 @@
+"""Seeded violations for the dense-alloc rule."""
+
+import numpy as np
+
+
+def build_cost_plane(P, T, num_providers, n_tasks, t_pad):
+    cost = np.zeros((P, T), np.float32)  # SEED: dense-alloc
+    mask = np.ones([num_providers, n_tasks], bool)  # SEED: dense-alloc
+    bids = np.full((t_pad, num_providers), -1.0)  # SEED: dense-alloc
+    scratch = np.empty((P, 4, T), np.float32)  # SEED: dense-alloc
+    kw_form = np.zeros(shape=(P, T), dtype=np.float32)  # SEED: dense-alloc
+    return cost, mask, bids, scratch, kw_form
+
+
+def audited_tile(P, T):
+    # audited exemption: bounded tile, argued in the escape annotation
+    tile = np.zeros((P, T), np.float32)  # lint: dense-ok
+    return tile

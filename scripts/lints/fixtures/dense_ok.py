@@ -1,0 +1,14 @@
+"""Clean twin of dense_bad.py: bounded-shape allocations the dense-alloc
+rule must NOT flag."""
+
+import numpy as np
+
+
+def build_sparse_structures(P, T, k, extra, g_pad):
+    cand_p = np.empty((T, k), np.int32)  # [T, k]: k is bounded
+    cand_c = np.empty((T, k + extra), np.float32)
+    price = np.zeros(P, np.float32)  # 1-D over one population dim
+    retired = np.zeros(T, np.uint8)
+    group_mask = np.zeros((g_pad, T), bool)  # groups are bounded
+    demand = np.zeros((T, 5), np.float32)
+    return cand_p, cand_c, price, retired, group_mask, demand

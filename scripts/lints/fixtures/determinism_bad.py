@@ -1,0 +1,41 @@
+"""Seeded violations for the determinism rule. Each line carrying a
+seed marker must produce exactly one finding (tests/test_lints.py
+asserts the line sets match)."""
+
+import random  # SEED: determinism
+import time
+import time as clock
+from time import time as wall
+
+import numpy as np
+
+EDGES = {3, 1, 2}
+
+
+def solver_order():
+    out = []
+    for e in EDGES:
+        out.append(e)
+    for e in {9, 4, 7}:  # SEED: determinism
+        out.append(e)
+    for e in set(out):  # SEED: determinism
+        out.append(e)
+    picked = [e for e in frozenset(out)]  # SEED: determinism
+    for k in vars(np):  # SEED: determinism
+        _ = k
+    return out + picked
+
+
+def stamped_solve():
+    seed = time.time()  # SEED: determinism
+    aliased = clock.time_ns()  # SEED: determinism
+    from_import = wall()  # SEED: determinism
+    jitter = random.random()  # SEED: determinism
+    noise = np.random.normal(0.0, 1.0)  # SEED: determinism
+    return seed + jitter + noise + aliased + from_import
+
+
+def escaped_solve():
+    # audited exemption: the escape comment must drop the finding
+    blessed = time.time()  # lint: determinism-ok
+    return blessed

@@ -1,0 +1,30 @@
+"""Clean twin of determinism_bad.py: every deterministic spelling the
+rule must NOT flag."""
+
+import time
+
+import numpy as np
+
+EDGES = {3, 1, 2}
+
+
+def solver_order():
+    out = []
+    for e in sorted(EDGES):  # sorted set iteration: deterministic
+        out.append(e)
+    for e in sorted({9, 4, 7}):
+        out.append(e)
+    if 3 in EDGES:  # membership, not iteration
+        out.append(3)
+    table = {"a": 1, "b": 2}
+    for k in table:  # dict iteration is insertion-ordered (py3.7+)
+        out.append(table[k])
+    for k, v in table.items():
+        out.append(v)
+    return out
+
+
+def stats_only():
+    t0 = time.perf_counter()  # timing stats never feed results
+    rng = np.arange(8)  # np.arange is not np.random
+    return time.perf_counter() - t0, rng

@@ -1,0 +1,15 @@
+"""Mini arena module for dtype-contract seeds: price is int32 here but
+float32 on the wire (width clash -> pointer-cast corruption), and
+_R_SPEC drops the wire's ram_mb column (diff order divergence)."""
+
+import numpy as np
+
+_P_SPEC = (
+    ("gpu_count", np.int32),
+    ("price", np.int32),
+    ("valid", np.uint8),
+)
+_R_SPEC = (
+    ("cpu_cores", np.int32),
+    ("valid", np.uint8),
+)

@@ -1,0 +1,15 @@
+"""Consistent arena spec: same columns, same order, width-compatible
+dtypes (wire bool_ == arena uint8, the documented 1-byte seam)."""
+
+import numpy as np
+
+_P_SPEC = (
+    ("gpu_count", np.int32),
+    ("price", np.float32),
+    ("valid", np.uint8),
+)
+_R_SPEC = (
+    ("cpu_cores", np.int32),
+    ("ram_mb", np.int32),
+    ("valid", np.uint8),
+)

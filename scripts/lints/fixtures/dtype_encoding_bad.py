@@ -1,0 +1,22 @@
+"""Mini encoding module for dtype-contract seeds: EncodedProviders
+declares extra_col, which the paired wire table does not carry — the
+column would vanish at the seam."""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class EncodedProviders:
+    gpu_count: np.ndarray
+    price: np.ndarray
+    valid: np.ndarray
+    extra_col: np.ndarray
+
+
+@dataclass
+class EncodedRequirements:
+    cpu_cores: np.ndarray
+    ram_mb: np.ndarray
+    valid: np.ndarray

@@ -1,0 +1,20 @@
+"""Consistent encoding: dataclass fields covered exactly by the paired
+wire table."""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class EncodedProviders:
+    gpu_count: np.ndarray
+    price: np.ndarray
+    valid: np.ndarray
+
+
+@dataclass
+class EncodedRequirements:
+    cpu_cores: np.ndarray
+    ram_mb: np.ndarray
+    valid: np.ndarray

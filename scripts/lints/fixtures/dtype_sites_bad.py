@@ -1,0 +1,17 @@
+"""Seeded violations for the dtype-contract call-site pass: blob/unblob
+without an explicit dtype reintroduce silent coercion at the seam."""
+
+import numpy as np
+
+from dtype_helpers import blob, unblob  # fixture-local stand-ins
+
+
+def decode(msg, arr):
+    cols = unblob(msg)  # SEED: dtype-contract
+    frame = blob(arr)  # SEED: dtype-contract
+    good_cols = unblob(msg, np.int32)
+    good_kw = unblob(msg, expect=np.float32)
+    good_frame = blob(arr, np.float32)
+    good_frame_kw = blob(arr, dtype=np.int32)
+    annotated = unblob(msg)  # lint: dtype-ok
+    return cols, frame, good_cols, good_kw, good_frame, good_frame_kw, annotated

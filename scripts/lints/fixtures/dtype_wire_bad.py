@@ -1,0 +1,17 @@
+"""Mini wire module for dtype-contract seeds: R_WIRE_DTYPES lists a
+column (ram_mb) the paired arena spec (dtype_arena_bad.py) lacks, and
+the paired encoding (dtype_encoding_bad.py) declares a field
+(extra_col) this table does not cover."""
+
+import numpy as np
+
+P_WIRE_DTYPES = {
+    "gpu_count": np.dtype(np.int32),
+    "price": np.dtype(np.float32),
+    "valid": np.dtype(np.bool_),
+}
+R_WIRE_DTYPES = {
+    "cpu_cores": np.dtype(np.int32),
+    "ram_mb": np.dtype(np.int32),
+    "valid": np.dtype(np.bool_),
+}

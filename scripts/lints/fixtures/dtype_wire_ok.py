@@ -1,0 +1,16 @@
+"""Consistent wire table (paired with dtype_arena_ok.py /
+dtype_encoding_ok.py): the dtype-contract cross-check must come back
+clean on this trio."""
+
+import numpy as np
+
+P_WIRE_DTYPES = {
+    "gpu_count": np.dtype(np.int32),
+    "price": np.dtype(np.float32),
+    "valid": np.dtype(np.bool_),
+}
+R_WIRE_DTYPES = {
+    "cpu_cores": np.dtype(np.int32),
+    "ram_mb": np.dtype(np.int32),
+    "valid": np.dtype(np.bool_),
+}

@@ -1,0 +1,50 @@
+"""Seeded violations for the lock-discipline rule (shapes mirror
+services/session_store.py + scheduler_grpc.py)."""
+
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}  # __init__ is exempt: not shared yet
+        self._native_arena = None
+
+    def lookup(self, sid):
+        return self._sessions.get(sid)  # SEED: lock-discipline
+
+    def lookup_locked(self, sid):
+        # *_locked naming convention: caller holds the lock
+        return self._sessions.get(sid)
+
+    def lookup_properly(self, sid):
+        with self._lock:
+            return self._sessions.get(sid)
+
+    def unary_solve(self, ep, er, w):
+        arena = self._native_arena  # SEED: lock-discipline
+        return arena
+
+    def unary_solve_properly(self, ep, er, w):
+        with self._unary_arena_lock:
+            return self._native_arena.solve(ep, er, w)
+
+
+def delta_tick(session, request):
+    if session.evicted:  # SEED: lock-discipline
+        return None
+    cursor = session.tick + 1  # SEED: lock-discipline
+    session.apply_delta(request)  # SEED: lock-discipline
+    out = session.solve()  # SEED: lock-discipline
+    with session.lock:
+        if session.evicted:
+            return None
+        session.apply_delta(request)
+        out = session.solve()
+        session.tick += 1
+    return out, cursor
+
+
+def annotated_tick(session):
+    # audited exemption: single-threaded test harness, lock not needed
+    return session.tick  # lint: unlocked-ok

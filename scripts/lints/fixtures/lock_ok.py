@@ -1,0 +1,44 @@
+"""Clean twin of lock_bad.py: every locked (or legitimately exempt)
+spelling the lock-discipline rule must NOT flag."""
+
+import threading
+
+
+class Session:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tick = 0
+        self.arena = None
+
+    def solve(self):
+        # self.X inside the owning class: callers hold the lock by the
+        # documented contract; the rule audits call sites
+        self.tick += 1
+        return self.arena
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+
+    def get(self, sid):
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is not None:
+                s.last_used = 0.0
+            return s
+
+    def _expire_locked(self):
+        for sid in list(self._sessions):
+            self._sessions.pop(sid)
+
+
+def delta_tick(session, request):
+    with session.lock:
+        if session.evicted:
+            return None
+        session.apply_delta(request)
+        out = session.solve()
+        session.tick += 1
+    return out

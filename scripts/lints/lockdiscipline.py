@@ -1,0 +1,113 @@
+"""Lock-discipline rule: session/arena state is touched lock-held only.
+
+The scheduler seam's concurrency story (PR 3) is lock SHARDING: each
+``SolveSession`` carries its own ``lock`` guarding its tick cursor,
+columns, and arena; the servicer's shared unary arena hides behind
+``_unary_arena_lock``; the ``SessionStore`` registry behind its ``_lock``.
+Nothing re-checks that at runtime — a refactor that reads
+``session.tick`` before taking ``session.lock`` races eviction and ships
+a matching nobody can replay. This rule makes the convention mechanical:
+
+  * attribute access to guarded session state (``tick``, ``arena``,
+    ``p_cols``, ``r_cols``, ``evicted``, ``last_used``,
+    ``delta_rows_total``) or guarded calls (``solve``, ``apply_delta``)
+    on a NON-``self`` receiver must sit lexically inside a ``with``
+    whose context expression is lock-shaped (an attribute chain ending
+    in a name containing "lock"). ``self.X`` inside the owning class is
+    the locked region's body — the caller holds the lock by the class's
+    documented contract, and call sites are what this rule audits.
+  * ``_sessions`` (the store registry) and ``_native_arena`` (the unary
+    arena) are guarded on ANY receiver, including ``self``.
+
+Escapes: methods named ``*_locked`` (the repo's called-under-lock naming
+convention), ``__init__``/``__post_init__`` (object not yet shared), and
+``# lint: unlocked-ok`` on the line for audited exceptions.
+
+Scope: ``protocol_tpu/services/session_store.py`` and
+``protocol_tpu/services/scheduler_grpc.py`` (where the sharded-lock
+protocol lives).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from scripts.lints.base import Finding, Rule, Source, register
+
+GUARDED_SESSION_ATTRS = {
+    "tick", "arena", "p_cols", "r_cols", "evicted", "last_used",
+    "delta_rows_total",
+}
+GUARDED_SESSION_CALLS = {"solve", "apply_delta"}
+GUARDED_ANY_RECEIVER = {"_sessions", "_native_arena"}
+EXEMPT_FUNCS = {"__init__", "__post_init__"}
+
+
+def _attr_root(node: ast.Attribute):
+    cur = node.value
+    while isinstance(cur, ast.Attribute):
+        cur = cur.value
+    return cur
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """True for with-items shaped like ``x.lock`` / ``self._lock`` /
+    ``self._unary_arena_lock`` (optionally wrapped in a call)."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    if isinstance(expr, ast.Attribute):
+        return "lock" in expr.attr.lower()
+    if isinstance(expr, ast.Name):
+        return "lock" in expr.id.lower()
+    return False
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    suppress_token = "unlocked-ok"
+
+    def applies(self, rel: str) -> bool:
+        return rel.endswith(("session_store.py", "scheduler_grpc.py"))
+
+    def _inside_lock(self, src: Source, node: ast.AST) -> bool:
+        for anc in src.ancestors(node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)) and any(
+                _is_lock_expr(item.context_expr) for item in anc.items
+            ):
+                return True
+        return False
+
+    def _exempt_scope(self, src: Source, node: ast.AST) -> bool:
+        fn = src.enclosing_function(node)
+        return fn is not None and (
+            fn in EXEMPT_FUNCS or fn.endswith("_locked")
+        )
+
+    def check(self, src: Source) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            attr = node.attr
+            if attr in GUARDED_ANY_RECEIVER:
+                guarded, why = True, f"{attr} (guarded on any receiver)"
+            elif attr in GUARDED_SESSION_ATTRS or attr in GUARDED_SESSION_CALLS:
+                root = _attr_root(node)
+                if isinstance(root, ast.Name) and root.id == "self":
+                    # the owning class's own body: the caller holds the
+                    # lock by contract; this rule audits the call sites
+                    continue
+                guarded, why = True, f"session state .{attr}"
+            else:
+                continue
+            if guarded and not self._inside_lock(src, node):
+                if self._exempt_scope(src, node):
+                    continue
+                out += self.finding(
+                    src, node,
+                    f"access to {why} outside a `with <lock>` block "
+                    "(annotate `# lint: unlocked-ok` if the lock is held "
+                    "by documented contract)",
+                )
+        return out

@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""Sanitizer stress harness for the native engine (TSan / ASan+UBSan).
+
+The multi-threaded engine's whole soundness story is an *unchecked*
+invariant: bit-identical results for every thread count, from chunked
+serial reductions and deterministic merges (native/assign_engine.cpp).
+This harness makes that invariant machine-checked with the tool built for
+the job — it builds the instrumented variant of the engine
+(``libassign_engine.{tsan,asan}.so``), re-executes itself as a child
+process under the matching LD_PRELOADed runtime with
+``PROTOCOL_TPU_NATIVE_SANITIZE`` selecting the variant, drives all three
+-mt kernels (``fused_topk_candidates_mt``, ``auction_sparse_mt``,
+``sinkhorn_sparse_mt``) across thread counts {1, 2, 4, 8} through churned
+warm re-solves (including the full ``NativeSolveArena`` dirty-row
+pipeline), and FAILS on any sanitizer report (parsed from the
+``log_path`` files TSAN_OPTIONS/ASAN_OPTIONS point at, plus the
+``exitcode=66`` backstop).
+
+The child deliberately imports only numpy + the native bindings — no jax:
+the sanitizer runtimes slow everything ~10x and instrument nothing
+outside the engine, so the control plane's import graph would be pure
+drag (and its thread pools pure report noise).
+
+Usage:
+    python scripts/sanitize_native.py --sanitizer tsan
+    python scripts/sanitize_native.py --sanitizer asan --ticks 5
+
+When clang-tidy is on PATH the harness also runs the repo's .clang-tidy
+profile (bugprone-* / concurrency-* / performance-*) over the engine
+source — the static half of the same discipline. Absent clang-tidy, that
+step is skipped with a note (the container image does not ship it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# one report fingerprint per sanitizer family — any hit fails the run
+_REPORT_MARKERS = (
+    "WARNING: ThreadSanitizer",
+    "ERROR: AddressSanitizer",
+    "ERROR: LeakSanitizer",
+    "runtime error:",  # UBSan
+)
+_SAN_EXITCODE = 66
+
+
+# ---------------------------------------------------------------- child
+
+def _synth_marketplace(rng, P: int, T: int):
+    """Duck-typed EncodedProviders / EncodedRequirements namespaces built
+    from plain numpy (no jax import; native.fused_topk_candidates only
+    reads attributes). Same distributions as bench.py's
+    synth_providers/synth_requirements — -1 sentinels for unconstrained
+    requirement fields, radians for locations, production CostWeights
+    scale — so the stress drives the engine through the bench's branch
+    mix rather than an accidentally-adversarial population."""
+    import numpy as np
+
+    MODEL_CLASSES, K_OPT, W = 12, 2, 8
+
+    def ns(**fields):
+        o = type("_Enc", (), {})()
+        for k, v in fields.items():
+            setattr(o, k, v)
+        return o
+
+    providers = ns(
+        gpu_count=rng.choice([1, 2, 4, 8], P).astype(np.int32),
+        gpu_mem_mb=rng.choice([16000, 24000, 40000, 80000], P).astype(np.int32),
+        gpu_model_id=rng.integers(0, MODEL_CLASSES, P).astype(np.int32),
+        has_gpu=np.ones(P, bool),
+        has_cpu=np.ones(P, bool),
+        cpu_cores=rng.choice([8, 16, 32, 64], P).astype(np.int32),
+        ram_mb=rng.choice([32768, 65536, 131072], P).astype(np.int32),
+        storage_gb=rng.choice([500, 1000, 4000], P).astype(np.int32),
+        lat=np.radians(rng.uniform(-60, 60, P)).astype(np.float32),
+        lon=np.radians(rng.uniform(-180, 180, P)).astype(np.float32),
+        has_location=np.ones(P, bool),
+        price=rng.uniform(0.5, 4.0, P).astype(np.float32),
+        load=rng.uniform(0, 1, P).astype(np.float32),
+        valid=np.ones(P, bool),
+    )
+    mask = np.zeros((T, K_OPT, W), np.uint32)
+    accept = rng.random((T, MODEL_CLASSES)) < 0.4
+    accept[np.arange(T), rng.integers(0, MODEL_CLASSES, T)] = True
+    for c in range(MODEL_CLASSES):
+        mask[:, 0, c >> 5] |= np.where(
+            accept[:, c], np.uint32(1) << np.uint32(c & 31), 0
+        ).astype(np.uint32)
+    opt_valid = np.zeros((T, K_OPT), bool)
+    opt_valid[:, 0] = True
+    count = np.full((T, K_OPT), -1, np.int32)
+    count[:, 0] = rng.choice([-1, 1, 2, 4, 8], T, p=[0.4, 0.15, 0.15, 0.15, 0.15])
+    mem_min = np.full((T, K_OPT), -1, np.int32)
+    mem_min[:, 0] = rng.choice([-1, 16000, 40000], T, p=[0.5, 0.3, 0.2])
+    requirements = ns(
+        cpu_required=np.zeros(T, bool),
+        cpu_cores=rng.choice([-1, 8, 16], T, p=[0.5, 0.3, 0.2]).astype(np.int32),
+        ram_mb=rng.choice([-1, 32768], T, p=[0.6, 0.4]).astype(np.int32),
+        storage_gb=rng.choice([-1, 500], T, p=[0.7, 0.3]).astype(np.int32),
+        gpu_opt_valid=opt_valid,
+        gpu_count=count,
+        gpu_mem_min=mem_min,
+        gpu_mem_max=np.full((T, K_OPT), -1, np.int32),
+        gpu_total_mem_min=np.full((T, K_OPT), -1, np.int32),
+        gpu_total_mem_max=np.full((T, K_OPT), -1, np.int32),
+        gpu_model_mask=mask,
+        gpu_model_constrained=opt_valid.copy(),
+        lat=np.radians(rng.uniform(-60, 60, T)).astype(np.float32),
+        lon=np.radians(rng.uniform(-180, 180, T)).astype(np.float32),
+        has_location=np.ones(T, bool),
+        priority=np.zeros(T, np.float32),
+        valid=np.ones(T, bool),
+    )
+    weights = ns(price=1.0, load=1.0, proximity=0.001, priority=0.0)
+    return providers, requirements, weights
+
+
+def _churn(rng, providers, requirements, frac: float):
+    """One churn tick, mirroring production churn classes: price/load
+    drift on a slice of providers (the arena's base-only fast path), a
+    few structural provider edits, and a few re-posted tasks."""
+    import numpy as np
+
+    P = providers.price.shape[0]
+    T = requirements.cpu_cores.shape[0]
+    drift = rng.choice(P, max(1, int(P * frac)), replace=False)
+    price = providers.price.copy()
+    load = providers.load.copy()
+    price[drift] = np.maximum(0, price[drift] + rng.normal(0, 0.3, drift.size)).astype(np.float32)
+    load[drift] = np.clip(load[drift] + rng.normal(0, 0.1, drift.size), 0, 1).astype(np.float32)
+    providers.price, providers.load = price, load
+    struct = rng.choice(P, max(1, int(P * frac / 4)), replace=False)
+    cores = providers.cpu_cores.copy()
+    cores[struct] = rng.choice([8, 16, 32, 64], struct.size)
+    providers.cpu_cores = cores
+    tasks = rng.choice(T, max(1, int(T * frac / 4)), replace=False)
+    ram = requirements.ram_mb.copy()
+    ram[tasks] = rng.choice([-1, 32768], tasks.size)
+    requirements.ram_mb = ram
+    return drift, struct, tasks
+
+
+def _assert_identical(results: dict, what: str) -> None:
+    import numpy as np
+
+    threads = sorted(results)
+    ref = results[threads[0]]
+    for t in threads[1:]:
+        for i, (a, b) in enumerate(zip(ref, results[t])):
+            if not np.array_equal(a, b):
+                raise SystemExit(
+                    f"THREAD-INVARIANCE BROKEN: {what} output {i} differs "
+                    f"between threads={threads[0]} and threads={t}"
+                )
+    print(f"  [child] {what}: bit-identical across threads={threads}")
+
+
+def _child(args) -> int:
+    import numpy as np
+
+    from protocol_tpu import native
+
+    variant = native.sanitize_variant()
+    print(f"[child] engine variant={variant or 'plain'} "
+          f"so={os.path.basename(native.so_path(variant))}")
+    native.load()
+    threads = [int(t) for t in args.threads.split(",")]
+    P, T, K = args.providers, args.tasks, args.top_k
+
+    # --- stress 1: fused cost+top-k (the task-chunked MT pass + the
+    # deterministic reverse-edge merge), fresh inputs per thread count
+    rng = np.random.default_rng(7)
+    ep, er, w = _synth_marketplace(rng, P, T)
+    fused = {}
+    for t in threads:
+        cp, cc = native.fused_topk_candidates(ep, er, w, k=K, threads=t)
+        fused[t] = (cp.copy(), cc.copy())
+    _assert_identical(fused, "fused_topk_candidates_mt")
+    cand_p, cand_c = fused[threads[0]]
+
+    # --- stress 2: warm auction chain (Jacobi bidding rounds, per-thread
+    # bid buffers, eps-CS repair, seat eviction caps) with churned costs
+    chains = {}
+    for t in threads:
+        crng = np.random.default_rng(11)
+        cc_t = cand_c.copy()
+        p4t, price, retired = native.auction_sparse_mt(
+            cand_p, cc_t, num_providers=P, threads=t
+        )
+        trace = [p4t.copy(), price.copy()]
+        for _ in range(args.ticks):
+            rows = crng.choice(T, max(1, T // 50), replace=False)
+            cc_t[rows] *= (0.8 + 0.4 * crng.random((rows.size, 1))).astype(np.float32)
+            retired = retired.copy()
+            retired[rows] = False
+            mask = np.zeros(T, bool)
+            mask[rows] = True
+            p4t, price, retired = native.auction_sparse_mt(
+                cand_p, cc_t, num_providers=P,
+                eps_start=0.32, eps_end=0.02, threads=t,
+                price=price, retired=retired,
+                seed_provider_for_task=p4t,
+                max_release=64, repair_mask=mask,
+            )
+            trace += [p4t.copy(), price.copy()]
+        chains[t] = trace
+    _assert_identical(chains, "auction_sparse_mt warm chain")
+
+    # --- stress 3: sparse Sinkhorn potentials (row updates + CSR-transpose
+    # column updates), cold anneal then churned warm single-phase
+    sink = {}
+    for t in threads:
+        crng = np.random.default_rng(13)
+        cc_t = cand_c.copy()
+        f, g = native.sinkhorn_sparse_anneal(
+            cand_p, cc_t, P, eps_start=1.0, eps_end=0.05,
+            iters_per_phase=30, threads=t,
+        )
+        trace = [f.copy(), g.copy()]
+        for _ in range(args.ticks):
+            rows = crng.choice(T, max(1, T // 50), replace=False)
+            cc_t[rows] *= (0.8 + 0.4 * crng.random((rows.size, 1))).astype(np.float32)
+            f, g, iters, err = native.sinkhorn_sparse_mt(
+                cand_p, cc_t, P, eps=0.05, max_iters=40, threads=t, f=f, g=g,
+            )
+            trace += [f.copy(), g.copy()]
+        sink[t] = trace
+    _assert_identical(sink, "sinkhorn_sparse_mt warm chain")
+
+    # --- stress 4: the full NativeSolveArena dirty-row pipeline (delta
+    # candidate passes, merge change-detection, dual carry, dual refresh)
+    from protocol_tpu.native.arena import NativeSolveArena
+
+    arena_runs = {}
+    for t in threads:
+        crng = np.random.default_rng(17)
+        ep_t, er_t, w_t = _synth_marketplace(np.random.default_rng(7), P, T)
+        arena = NativeSolveArena(k=K, threads=t, dual_refresh_every=2)
+        trace = [arena.solve(ep_t, er_t, w_t).copy()]
+        for _ in range(args.ticks):
+            _churn(crng, ep_t, er_t, frac=0.02)
+            trace.append(arena.solve(ep_t, er_t, w_t).copy())
+        arena_runs[t] = trace
+    _assert_identical(arena_runs, "NativeSolveArena warm churn")
+
+    print(f"[child] OK: all kernels thread-invariant over threads={threads}")
+    return 0
+
+
+# --------------------------------------------------------------- parent
+
+def _runtime_so(variant_so: str, name: str) -> str:
+    """Resolve the sanitizer runtime the instrumented .so links against
+    (``ldd`` output line ``libtsan.so.0 => /path (...)``) — the LD_PRELOAD
+    value that puts the runtime first in the child's link order."""
+    out = subprocess.run(
+        ["ldd", variant_so], capture_output=True, text=True, check=True
+    ).stdout
+    for line in out.splitlines():
+        if name in line and "=>" in line:
+            path = line.split("=>")[1].split("(")[0].strip()
+            if path and os.path.exists(path):
+                return path
+    raise SystemExit(
+        f"cannot resolve {name} runtime from ldd {variant_so}; "
+        "is the sanitizer toolchain installed?"
+    )
+
+
+def _scan_reports(log_dir: str) -> tuple[int, list[str]]:
+    hits, excerpts = 0, []
+    for fn in sorted(os.listdir(log_dir)):
+        path = os.path.join(log_dir, fn)
+        text = open(path, errors="replace").read()
+        n = sum(text.count(m) for m in _REPORT_MARKERS)
+        if n:
+            hits += n
+            excerpts.append(f"--- {fn} ({n} report(s)) ---\n{text[:4000]}")
+    return hits, excerpts
+
+
+def _clang_tidy(log) -> bool:
+    tidy = shutil.which("clang-tidy")
+    if tidy is None:
+        log("clang-tidy: not on PATH, static pass skipped")
+        return True
+    proc = subprocess.run(
+        [tidy, os.path.join(_REPO, "native", "assign_engine.cpp"),
+         "--quiet", "--", "-std=gnu++17", "-pthread"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    log(f"clang-tidy: rc={proc.returncode}")
+    if proc.stdout.strip():
+        log(proc.stdout[-6000:])
+    return proc.returncode == 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--sanitizer", choices=("tsan", "asan"), default="tsan")
+    ap.add_argument("--threads", default="1,2,4,8",
+                    help="comma-separated thread counts to sweep")
+    ap.add_argument("--providers", type=int, default=1024)
+    ap.add_argument("--tasks", type=int, default=1024)
+    ap.add_argument("--top-k", type=int, default=24)
+    ap.add_argument("--ticks", type=int, default=3,
+                    help="churned warm re-solves per thread count")
+    ap.add_argument("--artifact", default=None,
+                    help="write the run log here (e.g. artifacts/sanitize_tsan.log)")
+    ap.add_argument("--skip-clang-tidy", action="store_true")
+    ap.add_argument("--rebuild", action="store_true",
+                    help="force a fresh sanitizer build even if current")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        return _child(args)
+
+    from protocol_tpu import native
+
+    lines: list[str] = []
+
+    def log(msg: str) -> None:
+        print(msg)
+        lines.append(msg)
+
+    t0 = time.time()
+    log(f"sanitize_native: sanitizer={args.sanitizer} "
+        f"threads={args.threads} P={args.providers} T={args.tasks} "
+        f"k={args.top_k} ticks={args.ticks}")
+    so = native.so_path(args.sanitizer)
+    if (
+        args.rebuild
+        or not os.path.exists(so)
+        or os.path.getmtime(so) < os.path.getmtime(native._SRC)
+    ):
+        # same staleness rule as native.load(); CI restores a cached .so
+        # keyed on the source hash and touches it fresh, so steady-state
+        # runs skip the compile
+        native.build(args.sanitizer)
+    log(f"using {os.path.relpath(so, _REPO)} "
+        f"({os.path.getsize(so)} bytes, flags: "
+        f"{' '.join(native._cflags(args.sanitizer))})")
+
+    runtime_name = {"tsan": "libtsan", "asan": "libasan"}[args.sanitizer]
+    runtime = _runtime_so(so, runtime_name)
+    log(f"LD_PRELOAD runtime: {runtime}")
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="sanitize_native_") as log_dir:
+        prefix = os.path.join(log_dir, "report")
+        env = dict(os.environ)
+        env["PROTOCOL_TPU_NATIVE_SANITIZE"] = args.sanitizer
+        env["LD_PRELOAD"] = runtime
+        common = f"log_path={prefix}:exitcode={_SAN_EXITCODE}"
+        env["TSAN_OPTIONS"] = f"{common}:second_deadlock_stack=1"
+        # detect_leaks=0: CPython "leaks" by design (interned objects,
+        # static allocations); leak noise would bury real engine reports
+        env["ASAN_OPTIONS"] = f"{common}:detect_leaks=0"
+        env["UBSAN_OPTIONS"] = f"{common}:print_stacktrace=1"
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--child",
+            "--sanitizer", args.sanitizer, "--threads", args.threads,
+            "--providers", str(args.providers), "--tasks", str(args.tasks),
+            "--top-k", str(args.top_k), "--ticks", str(args.ticks),
+        ]
+        proc = subprocess.run(
+            cmd, env=env, cwd=_REPO, capture_output=True, text=True
+        )
+        for stream in (proc.stdout, proc.stderr):
+            if stream.strip():
+                log(stream.rstrip())
+        hits, excerpts = _scan_reports(log_dir)
+        log(f"child rc={proc.returncode}, sanitizer reports={hits}, "
+            f"wall={time.time() - t0:.1f}s")
+        for e in excerpts:
+            log(e)
+        if proc.returncode != 0 or hits:
+            ok = False
+
+    if not args.skip_clang_tidy and not _clang_tidy(log):
+        ok = False
+
+    log(f"VERDICT: {'PASS' if ok else 'FAIL'} ({args.sanitizer})")
+    if args.artifact:
+        path = os.path.join(_REPO, args.artifact)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        print(f"artifact written: {args.artifact}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

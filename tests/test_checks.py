@@ -153,6 +153,22 @@ class TestPort:
             s.close()
 
 
+
+import importlib.util
+
+import pytest
+
+# Environment guard for the marked tests below: their code paths reach
+# protocol_tpu.chain / protocol_tpu.security (wallet signing), which
+# need the third-party `cryptography` package. Without it they skip —
+# the rest of this module runs everywhere.
+_HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed (signing/TLS dependency)",
+)
+
+@requires_crypto
 class TestComposedGate:
     def test_run_all_checks_with_fakes(self, tmp_path):
         smi = fake_bin(tmp_path, "nvidia-smi", 'printf "0, H100, 80000\\n"')

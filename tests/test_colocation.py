@@ -9,6 +9,7 @@ reference cannot express this at all (one node, one task:
 crates/orchestrator/src/scheduler/mod.rs:26-74).
 """
 
+import importlib.util
 import pytest
 
 from protocol_tpu.models import (
@@ -234,6 +235,18 @@ class RecordingRuntime:
         return self.task.id, TaskState.RUNNING, None
 
 
+
+# Environment guard for the marked tests below: their code paths reach
+# protocol_tpu.chain / protocol_tpu.security (wallet signing), which
+# need the third-party `cryptography` package. Without it they skip —
+# the rest of this module runs everywhere.
+_HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed (signing/TLS dependency)",
+)
+
+@requires_crypto
 class TestWorkerConcurrentExecution:
     """The worker half of ladder #5: every colocated assignment beyond
     the primary runs CONCURRENTLY in its own runtime, reconciled per

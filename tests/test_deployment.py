@@ -6,6 +6,7 @@ import re
 import subprocess
 import sys
 
+import importlib.util
 import pytest
 import yaml
 
@@ -72,6 +73,18 @@ def test_serve_cli_surface():
     assert "ledger-url" in out2.stderr.lower()
 
 
+
+# Environment guard for the marked tests below: their code paths reach
+# protocol_tpu.chain / protocol_tpu.security (wallet signing), which
+# need the third-party `cryptography` package. Without it they skip —
+# the rest of this module runs everywhere.
+_HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed (signing/TLS dependency)",
+)
+
+@requires_crypto
 def test_serve_discovery_boots_against_live_ledger_api(tmp_path):
     """Multi-process shape: ledger API in-process, discovery booted via
     the serve entry point in a SUBPROCESS (the pod shape), health-checked

@@ -63,6 +63,22 @@ def test_down_daemon_is_best_effort():
     assert cid is None and m.failed == 1
 
 
+
+import importlib.util
+
+import pytest
+
+# Environment guard for the marked tests below: their code paths reach
+# protocol_tpu.chain / protocol_tpu.security (wallet signing), which
+# need the third-party `cryptography` package. Without it they skip —
+# the rest of this module runs everywhere.
+_HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed (signing/TLS dependency)",
+)
+
+@requires_crypto
 def test_worker_upload_mirrors_to_ipfs():
     """submit_output mirrors the artifact after the primary signed-URL
     upload; a dead IPFS daemon never fails the work submission."""

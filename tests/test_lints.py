@@ -1,0 +1,148 @@
+"""Fixture-driven tests for the project lint engine (scripts/lints).
+
+Contract (the tentpole's acceptance bar): each rule catches 100% of the
+violations seeded in its fixture (`# SEED: <rule>` marks the expected
+finding lines — the fixture is its own oracle), flags NOTHING in the
+clean twin fixture, honors its escape annotation, and the whole engine
+exits clean on the real tree (the fail-the-build gate CI runs)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from scripts.lints import RULES, run_rules
+from scripts.lints.base import Source, iter_files
+from scripts.lints.densealloc import DenseAllocRule
+from scripts.lints.determinism import DeterminismRule
+from scripts.lints.dtype_contract import DtypeContractRule
+from scripts.lints.lockdiscipline import LockDisciplineRule
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "scripts" / "lints" / "fixtures"
+
+
+def seeded_lines(path: pathlib.Path, rule_name: str) -> set[int]:
+    return {
+        i
+        for i, line in enumerate(path.read_text().splitlines(), 1)
+        if f"SEED: {rule_name}" in line
+    }
+
+
+def run_on(rule, fname: str):
+    return rule.check(Source(FIXTURES / fname))
+
+
+class TestRulesFireExactlyOnSeeds:
+    @pytest.mark.parametrize(
+        "rule_cls,bad,ok",
+        [
+            (DeterminismRule, "determinism_bad.py", "determinism_ok.py"),
+            (LockDisciplineRule, "lock_bad.py", "lock_ok.py"),
+            (DenseAllocRule, "dense_bad.py", "dense_ok.py"),
+        ],
+        ids=["determinism", "lock-discipline", "dense-alloc"],
+    )
+    def test_seeds_and_clean_twin(self, rule_cls, bad, ok):
+        rule = rule_cls()
+        expected = seeded_lines(FIXTURES / bad, rule.name)
+        assert expected, f"fixture {bad} has no SEED markers"
+        findings = run_on(rule, bad)
+        assert {f.line for f in findings} == expected
+        # exactly one finding per seeded line — a rule double-reporting
+        # the same violation would bury real findings in noise
+        assert len(findings) == len(expected)
+        assert all(f.rule == rule.name for f in findings)
+        assert run_on(rule, ok) == []
+
+    def test_dtype_call_sites(self):
+        rule = DtypeContractRule()
+        bad = FIXTURES / "dtype_sites_bad.py"
+        findings = rule.check(Source(bad))
+        assert {f.line for f in findings} == seeded_lines(bad, rule.name)
+
+
+class TestDtypeCrossCheck:
+    def test_seeded_trio_yields_all_three_mismatch_classes(self):
+        rule = DtypeContractRule(
+            wire=str(FIXTURES / "dtype_wire_bad.py"),
+            arena=str(FIXTURES / "dtype_arena_bad.py"),
+            encoding=str(FIXTURES / "dtype_encoding_bad.py"),
+        )
+        findings = rule.check_repo()
+        msgs = "\n".join(f.message for f in findings)
+        assert len(findings) == 3
+        assert "'price'" in msgs  # width clash wire float32 vs arena int32
+        assert "ram_mb" in msgs  # column dropped from the arena spec
+        assert "extra_col" in msgs  # encoding field the wire never carries
+
+    def test_consistent_trio_is_clean(self):
+        rule = DtypeContractRule(
+            wire=str(FIXTURES / "dtype_wire_ok.py"),
+            arena=str(FIXTURES / "dtype_arena_ok.py"),
+            encoding=str(FIXTURES / "dtype_encoding_ok.py"),
+        )
+        assert rule.check_repo() == []
+
+    def test_missing_table_is_a_finding_not_a_crash(self):
+        rule = DtypeContractRule(
+            wire=str(FIXTURES / "dtype_encoding_ok.py"),  # no dtype dicts
+            arena=str(FIXTURES / "dtype_arena_ok.py"),
+        )
+        findings = rule.check_repo()
+        assert findings and all(f.rule == "dtype-contract" for f in findings)
+
+
+class TestEngine:
+    def test_real_tree_is_clean(self):
+        """The acceptance bar: `python -m scripts.lints` exits 0 on the
+        repo. Any finding here is either a real contract violation (fix
+        it) or a rule false positive (fix the rule — never loosen the
+        fixture)."""
+        assert run_rules() == []
+
+    def test_fixtures_are_excluded_from_the_default_walk(self):
+        files = iter_files()
+        assert not any("fixtures" in f.parts for f in files)
+
+    def test_rule_registry_covers_the_catalog(self):
+        names = {r.name for r in RULES}
+        assert {
+            "determinism", "lock-discipline", "dtype-contract", "dense-alloc"
+        } <= names
+
+    def test_cli_exit_codes(self):
+        ok = subprocess.run(
+            [sys.executable, "-m", "scripts.lints", "--list"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert ok.returncode == 0 and "determinism" in ok.stdout
+        bad = subprocess.run(
+            [sys.executable, "-m", "scripts.lints",
+             str(FIXTURES / "dense_bad.py")],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        assert bad.returncode == 1
+        assert "dense-alloc" in bad.stdout
+
+
+class TestSuppression:
+    def test_escape_annotation_drops_the_finding(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text(
+            "import numpy as np\n"
+            "def solve(P, T):\n"
+            "    return np.zeros((P, T))  # lint: dense-ok\n"
+        )
+        assert DenseAllocRule().check(Source(f)) == []
+
+    def test_blanket_ok_also_escapes(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text(
+            "import time\n"
+            "def solve():\n"
+            "    return time.time()  # lint: ok\n"
+        )
+        assert DeterminismRule().check(Source(f)) == []

@@ -98,6 +98,22 @@ class TestPriceFlipsAssignment:
         assert "0xidle" in m._assignment
 
 
+
+import importlib.util
+
+import pytest
+
+# Environment guard for the marked tests below: their code paths reach
+# protocol_tpu.chain / protocol_tpu.security (wallet signing), which
+# need the third-party `cryptography` package. Without it they skip —
+# the rest of this module runs everywhere.
+_HAS_CRYPTO = importlib.util.find_spec("cryptography") is not None
+requires_crypto = pytest.mark.skipif(
+    not _HAS_CRYPTO,
+    reason="cryptography not installed (signing/TLS dependency)",
+)
+
+@requires_crypto
 class TestPropagation:
     def test_node_price_survives_discovery_payload(self):
         n = Node(id="0xw", price=2.5, compute_specs=specs())

@@ -1,0 +1,118 @@
+"""Sanitizer-build plumbing tests (hermetic — nothing here runs under an
+actual sanitizer; the TSan/ASan stress itself is scripts/sanitize_native.py,
+gated in CI and too slow for tier-1)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from scripts.sanitize_native import _REPORT_MARKERS, _synth_marketplace
+
+
+class TestVariantSelection:
+    def test_default_is_plain(self, monkeypatch):
+        monkeypatch.delenv("PROTOCOL_TPU_NATIVE_SANITIZE", raising=False)
+        assert native.sanitize_variant() == ""
+
+    @pytest.mark.parametrize("value,expect", [
+        ("tsan", "tsan"), ("asan", "asan"), ("TSAN", "tsan"),
+        ("", ""), ("off", ""), ("none", ""), ("0", ""),
+    ])
+    def test_env_values(self, monkeypatch, value, expect):
+        monkeypatch.setenv("PROTOCOL_TPU_NATIVE_SANITIZE", value)
+        assert native.sanitize_variant() == expect
+
+    def test_garbage_value_is_refused(self, monkeypatch):
+        monkeypatch.setenv("PROTOCOL_TPU_NATIVE_SANITIZE", "msan")
+        with pytest.raises(native.NativeBuildError):
+            native.sanitize_variant()
+
+    def test_variant_so_names_are_distinct(self):
+        paths = {native.so_path(v) for v in ("", "tsan", "asan")}
+        assert len(paths) == 3
+        assert all(p.endswith(".so") for p in paths)
+
+
+class TestBuildFlags:
+    def test_production_flags_honor_native_cflags(self, monkeypatch):
+        monkeypatch.setenv("NATIVE_CFLAGS", "-O2 -funroll-loops")
+        assert native._cflags("") == ["-O2", "-funroll-loops"]
+
+    def test_default_is_portable_not_march_native(self, monkeypatch):
+        monkeypatch.delenv("NATIVE_CFLAGS", raising=False)
+        flags = native._cflags("")
+        assert "-march=native" not in flags
+        assert "-march=x86-64-v2" in flags
+
+    @pytest.mark.parametrize("variant,needle", [
+        ("tsan", "-fsanitize=thread"),
+        ("asan", "-fsanitize=address,undefined"),
+    ])
+    def test_sanitizer_flags(self, monkeypatch, variant, needle):
+        monkeypatch.delenv("NATIVE_CFLAGS", raising=False)
+        flags = native._cflags(variant)
+        assert needle in flags
+        # -O1 -g replaces the production opt level: reports need symbols
+        assert "-O1" in flags and "-g" in flags
+        assert "-O3" not in flags
+
+    def test_sanitizer_flags_strip_march_native_from_overrides(self, monkeypatch):
+        monkeypatch.setenv("NATIVE_CFLAGS", "-O3 -march=native")
+        flags = native._cflags("tsan")
+        assert "-march=native" not in flags and "-O3" not in flags
+
+    def test_unknown_variant_is_refused(self):
+        with pytest.raises(native.NativeBuildError):
+            native.build("msan")
+
+
+class TestStressHarnessInputs:
+    def test_synth_marketplace_duck_types_the_encoder_columns(self):
+        rng = np.random.default_rng(0)
+        ep, er, w = _synth_marketplace(rng, 64, 48)
+        # every column the C++ feature structs dereference must exist
+        # with population-length leading axes
+        from protocol_tpu.native.arena import _P_SPEC, _R_SPEC
+
+        for name, _ in _P_SPEC:
+            assert getattr(ep, name).shape[0] == 64, name
+        for name, _ in _R_SPEC:
+            assert getattr(er, name).shape[0] == 48, name
+        assert er.gpu_model_mask.ndim == 3
+        for attr in ("price", "load", "proximity", "priority"):
+            assert isinstance(getattr(w, attr), float)
+
+    @pytest.mark.skipif(
+        not native.available(), reason="no native toolchain in this env"
+    )
+    def test_synth_marketplace_is_solvable(self):
+        """The stress population must be bench-shaped (mostly feasible):
+        an accidentally-adversarial population burns the sanitizer budget
+        on give-up bidding wars instead of kernel coverage."""
+        rng = np.random.default_rng(7)
+        ep, er, w = _synth_marketplace(rng, 256, 256)
+        cp, cc = native.fused_topk_candidates(ep, er, w, k=24, threads=1)
+        p4t, _, _ = native.auction_sparse_mt(cp, cc, num_providers=256, threads=1)
+        assert int((p4t >= 0).sum()) >= 250
+
+    def test_report_markers_cover_all_sanitizer_families(self):
+        text = "\n".join(_REPORT_MARKERS)
+        for fam in ("ThreadSanitizer", "AddressSanitizer", "LeakSanitizer",
+                    "runtime error"):
+            assert fam in text
+
+
+class TestMakefileParity:
+    def test_makefile_clean_removes_sanitizer_variants(self):
+        mk = open(os.path.join(os.path.dirname(__file__), "..", "Makefile")).read()
+        assert "libassign_engine.tsan.so" in mk.split("clean:")[1]
+        assert "libassign_engine.asan.so" in mk.split("clean:")[1]
+
+    def test_makefile_native_flags_match_python_builder(self):
+        """Makefile and protocol_tpu.native must agree on the portable
+        default — a drifted recipe ships a .so the other half would not
+        reproduce."""
+        mk = open(os.path.join(os.path.dirname(__file__), "..", "Makefile")).read()
+        assert "NATIVE_CFLAGS ?= " + native._DEFAULT_CFLAGS in mk

@@ -13,6 +13,15 @@ import ssl
 
 import pytest
 
+# Environment guard: every test here mints TLS material via
+# protocol_tpu.utils.tls.generate_self_signed, which needs the
+# third-party `cryptography` package at call time (the module itself
+# imports lazily, so collection succeeds and the failure would otherwise
+# surface as per-test fixture errors). Skip the module honestly instead.
+pytest.importorskip(
+    "cryptography", reason="cryptography not installed (signing/TLS dependency)"
+)
+
 from protocol_tpu.utils.tls import (
     client_ssl_context,
     generate_self_signed,
