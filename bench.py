@@ -47,72 +47,22 @@ from protocol_tpu.ops.sparse import (
 P, T = 32768, 32768
 TOPK = 64
 TILE = 2048
-MODEL_CLASSES = 12
-MODEL_WORDS = 8
-MAX_GPU_OPTS = 2
+
+# The synthetic marketplace generators live in the flight-recorder
+# subsystem (the single source of synthetic populations); re-exported
+# here because every bench/script/test historically reaches them as
+# ``bench.synth_providers``.
+from protocol_tpu.trace.synth import (  # noqa: E402
+    MAX_GPU_OPTS,
+    MODEL_CLASSES,
+    MODEL_WORDS,
+    synth_providers,
+    synth_requirements,
+)
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
-
-
-def synth_providers(rng: np.random.Generator, n: int) -> EncodedProviders:
-    """Vectorized synthetic provider encodings, numpy-backed (host-side);
-    device_put the tree to place it on an accelerator."""
-    model = rng.integers(0, MODEL_CLASSES, n).astype(np.int32)
-    count = rng.choice([1, 2, 4, 8], n).astype(np.int32)
-    mem = rng.choice([16000, 24000, 40000, 80000], n).astype(np.int32)
-    return EncodedProviders(
-        gpu_count=count,
-        gpu_mem_mb=mem,
-        gpu_model_id=model,
-        has_gpu=np.ones(n, bool),
-        has_cpu=np.ones(n, bool),
-        cpu_cores=rng.choice([8, 16, 32, 64], n).astype(np.int32),
-        ram_mb=rng.choice([32768, 65536, 131072], n).astype(np.int32),
-        storage_gb=rng.choice([500, 1000, 4000], n).astype(np.int32),
-        lat=np.radians(rng.uniform(-60, 60, n)).astype(np.float32),
-        lon=np.radians(rng.uniform(-180, 180, n)).astype(np.float32),
-        has_location=np.ones(n, bool),
-        price=rng.uniform(0.5, 4.0, n).astype(np.float32),
-        load=rng.uniform(0, 1, n).astype(np.float32),
-        valid=np.ones(n, bool),
-    )
-
-
-def synth_requirements(rng: np.random.Generator, n: int) -> EncodedRequirements:
-    k, w = MAX_GPU_OPTS, MODEL_WORDS
-    # each task accepts a random subset of model classes (OR alternatives)
-    mask = np.zeros((n, k, w), np.uint32)
-    accept = rng.random((n, MODEL_CLASSES)) < 0.4
-    accept[np.arange(n), rng.integers(0, MODEL_CLASSES, n)] = True  # >=1 class
-    for c in range(MODEL_CLASSES):
-        mask[:, 0, c >> 5] |= np.where(accept[:, c], np.uint32(1) << np.uint32(c & 31), 0).astype(np.uint32)
-    opt_valid = np.zeros((n, k), bool)
-    opt_valid[:, 0] = True
-    count = np.full((n, k), -1, np.int32)
-    count[:, 0] = rng.choice([-1, 1, 2, 4, 8], n, p=[0.4, 0.15, 0.15, 0.15, 0.15])
-    mem_min = np.full((n, k), -1, np.int32)
-    mem_min[:, 0] = rng.choice([-1, 16000, 40000], n, p=[0.5, 0.3, 0.2])
-    return EncodedRequirements(
-        cpu_required=np.zeros(n, bool),
-        cpu_cores=rng.choice([-1, 8, 16], n, p=[0.5, 0.3, 0.2]).astype(np.int32),
-        ram_mb=rng.choice([-1, 32768], n, p=[0.6, 0.4]).astype(np.int32),
-        storage_gb=rng.choice([-1, 500], n, p=[0.7, 0.3]).astype(np.int32),
-        gpu_opt_valid=opt_valid,
-        gpu_count=count,
-        gpu_mem_min=mem_min,
-        gpu_mem_max=np.full((n, k), -1, np.int32),
-        gpu_total_mem_min=np.full((n, k), -1, np.int32),
-        gpu_total_mem_max=np.full((n, k), -1, np.int32),
-        gpu_model_mask=mask,
-        gpu_model_constrained=opt_valid.copy(),
-        lat=np.radians(rng.uniform(-60, 60, n)).astype(np.float32),
-        lon=np.radians(rng.uniform(-180, 180, n)).astype(np.float32),
-        has_location=np.ones(n, bool),
-        priority=np.zeros(n, np.float32),
-        valid=np.ones(n, bool),
-    )
 
 
 def tpu_match(ep: EncodedProviders, er: EncodedRequirements):
@@ -247,6 +197,7 @@ def run_wire_bench(
     seed: int = 0,
     chunk_bytes: int = 1 << 20,
     modes: tuple = ("v1", "v2"),
+    trace_path: str = "",
 ) -> dict:
     """Loopback wire-path benchmark: the scheduler seam end-to-end
     (client serialize + RPC + server decode + warm native-mt solve) under
@@ -260,7 +211,12 @@ def run_wire_bench(
     pure wire protocol — the warm solve behind both is the same arena.
     Returns per-tick wall/bytes/assigned per mode plus the v1/v2 speedup
     and bytes ratio, and the server-side seam metrics scraped from
-    Health."""
+    Health.
+
+    With ``trace_path`` set, the population AND the per-tick churn come
+    from a recorded/synthetic flight-recorder trace instead of the
+    inline generator — the same captured workload both modes (and every
+    future bench run) consume, instead of an unshareable rng sequence."""
     from protocol_tpu.ops.cost import CostWeights
     from protocol_tpu.proto import scheduler_pb2 as pbs
     from protocol_tpu.proto import wire as wirelib
@@ -273,19 +229,62 @@ def run_wire_bench(
 
     kernel = f"native-mt:{threads}" if threads else "native-mt"
     w = CostWeights()
+    trace_deltas = None
+    if trace_path:
+        from protocol_tpu.trace import format as tfmt
+
+        tr = tfmt.read_trace(trace_path)
+        if tr.snapshot is None:
+            raise SystemExit(f"{trace_path}: no snapshot frame")
+        P, T = tr.snapshot.n_providers, tr.snapshot.n_tasks
+        trace_deltas = tr.deltas
+        if not trace_deltas:
+            raise SystemExit(
+                f"{trace_path} holds no delta ticks (snapshot only) — "
+                "the wire bench measures steady-state ticks; synth a "
+                "trace with --ticks >= 1"
+            )
+        if warmup + ticks > len(trace_deltas):
+            ticks = max(len(trace_deltas) - warmup, 1)
+            warmup = max(min(warmup, len(trace_deltas) - ticks), 0)
+            log(
+                f"trace holds {len(trace_deltas)} ticks: clamped to "
+                f"warmup={warmup} ticks={ticks}"
+            )
     out: dict = {
         "P": P, "T": T, "churn": churn, "ticks": ticks,
         "kernel": kernel, "modes": {},
     }
+    if trace_path:
+        out["trace"] = trace_path
+
+    def _apply_tick(i: int, p_cols, r_cols, churn_rng) -> None:
+        """Mutate the columns for tick i (1-based): the trace's recorded
+        delta when one is loaded, else synthetic price/load churn."""
+        if trace_deltas is not None:
+            d = trace_deltas[i - 1]
+            for rows, delta, cols in (
+                (d.provider_rows, d.p_cols, p_cols),
+                (d.task_rows, d.r_cols, r_cols),
+            ):
+                for name, vals in delta.items():
+                    cols[name][rows] = vals
+        else:
+            _churn_providers(p_cols, churn_rng, churn)
+
     for mode in modes:
         port = _free_port()
         server = serve(f"127.0.0.1:{port}")
         client = SchedulerBackendClient(f"127.0.0.1:{port}")
-        rng = np.random.default_rng(seed)
-        ep = synth_providers(rng, P)
-        er = synth_requirements(rng, T)
-        p_cols = wirelib.canon_columns(ep, wirelib.P_WIRE_DTYPES)
-        r_cols = wirelib.canon_columns(er, wirelib.R_WIRE_DTYPES)
+        if trace_deltas is not None:
+            p_cols = {k: v.copy() for k, v in tr.snapshot.p_cols.items()}
+            r_cols = {k: v.copy() for k, v in tr.snapshot.r_cols.items()}
+        else:
+            rng = np.random.default_rng(seed)
+            ep = synth_providers(rng, P)
+            er = synth_requirements(rng, T)
+            p_cols = wirelib.canon_columns(ep, wirelib.P_WIRE_DTYPES)
+            r_cols = wirelib.canon_columns(er, wirelib.R_WIRE_DTYPES)
         full = wirelib.take_rows  # ns view over all rows
         churn_rng = np.random.default_rng(seed + 1)
         tick_ms: list[float] = []
@@ -299,7 +298,7 @@ def run_wire_bench(
             )
             client.assign(req, timeout=600)
             for i in range(warmup + ticks):
-                _churn_providers(p_cols, churn_rng, churn)
+                _apply_tick(i + 1, p_cols, r_cols, churn_rng)
                 t0 = time.perf_counter()
                 req = encoded_to_proto(
                     full(p_cols, slice(None)), full(r_cols, slice(None)),
@@ -327,12 +326,14 @@ def run_wire_bench(
             )
             assert resp.ok, resp.error
             prev = {k: v.copy() for k, v in p_cols.items()}
+            prev_r = {k: v.copy() for k, v in r_cols.items()}
             for tick in range(1, warmup + ticks + 1):
-                _churn_providers(p_cols, churn_rng, churn)
+                _apply_tick(tick, p_cols, r_cols, churn_rng)
                 t0 = time.perf_counter()
                 # the timed tick includes the client-side churn scan: the
                 # column diff is part of what v2 pays that v1 does not
                 rows = wirelib.dirty_rows(p_cols, prev)
+                trows = wirelib.dirty_rows(r_cols, prev_r)
                 dreq = pbs.AssignDeltaRequest(
                     session_id="bench", epoch_fingerprint=fp, tick=tick
                 )
@@ -343,9 +344,17 @@ def run_wire_bench(
                             wirelib.take_rows(p_cols, rows)
                         )
                     )
+                if trows.size:
+                    dreq.task_rows.CopyFrom(wirelib.blob(trows, np.int32))
+                    dreq.requirements.CopyFrom(
+                        wirelib.encode_requirements_v2(
+                            wirelib.take_rows(r_cols, trows)
+                        )
+                    )
                 dresp = client.assign_delta(dreq, timeout=600)
                 assert dresp.session_ok, dresp.error
                 prev = {k: v.copy() for k, v in p_cols.items()}
+                prev_r = {k: v.copy() for k, v in r_cols.items()}
                 if tick <= warmup:
                     continue
                 tick_ms.append((time.perf_counter() - t0) * 1e3)
@@ -449,6 +458,9 @@ def main() -> None:
             warmup=int(args.get("warmup", "3")),
             threads=int(args.get("threads", "0") or 0),
             modes=modes,
+            # trace=<path>: consume a flight-recorder trace (population +
+            # churn sequence) instead of generating inline
+            trace_path=args.get("trace", ""),
         )
         out_path = args.get("out")
         if out_path:

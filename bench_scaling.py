@@ -43,6 +43,13 @@ def main() -> None:
         "a timeout/kill preserves every finished stage's evidence "
         "(VERDICT r5 'what's weak' #4). Empty string disables.",
     )
+    parser.add_argument(
+        "--trace",
+        default="",
+        help="flight-recorder trace whose snapshot supplies the measured "
+        "populations (sliced to each stage's shape) instead of the "
+        "inline generator — the same captured fleet every run measures",
+    )
     args = parser.parse_args()
 
     import os
@@ -87,6 +94,40 @@ def main() -> None:
     enc = FeatureEncoder()
     weights = CostWeights()
 
+    # population source: the shared generators (trace/synth.py), or a
+    # recorded trace's snapshot sliced to each stage's measurement shape
+    if args.trace:
+        from protocol_tpu.ops.encoding import (
+            EncodedProviders,
+            EncodedRequirements,
+        )
+        from protocol_tpu.trace import format as tfmt
+
+        snap = tfmt.read_trace(args.trace).snapshot
+        if snap is None:
+            raise SystemExit(f"{args.trace}: no snapshot frame")
+
+        def population(rng_, n_p, n_t):
+            if n_p > snap.n_providers or n_t > snap.n_tasks:
+                raise SystemExit(
+                    f"{args.trace} holds {snap.n_providers}x{snap.n_tasks} "
+                    f"rows; stage needs {n_p}x{n_t}"
+                )
+            return (
+                EncodedProviders(
+                    **{k: v[:n_p] for k, v in snap.p_cols.items()}
+                ),
+                EncodedRequirements(
+                    **{k: v[:n_t] for k, v in snap.r_cols.items()}
+                ),
+            )
+    else:
+        def population(rng_, n_p, n_t):
+            return (
+                bench.synth_providers(rng_, n_p),
+                bench.synth_requirements(rng_, n_t),
+            )
+
     rows: list[dict] = []
 
     from protocol_tpu.utils.artifacts import append_jsonl
@@ -129,9 +170,7 @@ def main() -> None:
 
     # ---------------- stage A: candidate generation ----------------
     log(f"stage A: candidates_topk P={P_MEAS} T={T_MEAS} K={K} tile={TILE}")
-    ep_np, er_np = bench.synth_providers(rng, P_MEAS), bench.synth_requirements(
-        rng, T_MEAS
-    )
+    ep_np, er_np = population(rng, P_MEAS, T_MEAS)
     ep_dev = jax.tree.map(jnp.asarray, ep_np)
     er_dev = jax.tree.map(jnp.asarray, er_np)
     secs, (cand_p, cand_c) = measure(
@@ -175,9 +214,7 @@ def main() -> None:
     from protocol_tpu.ops.sparse import candidates_topk_bidir
 
     P_B = T_AUCTION
-    epb, erb = bench.synth_providers(rng, P_B), bench.synth_requirements(
-        rng, T_AUCTION
-    )
+    epb, erb = population(rng, P_B, T_AUCTION)
 
     def _gen_bidir():
         t0 = time.perf_counter()

@@ -316,6 +316,16 @@ class TpuBatchMatcher:
         self._last_warm_used = False
         self._last_warm_seeded = 0
         self._last_stall: dict = {}
+        # flight recorder (PROTOCOL_TPU_TRACE=<path>): the native-arena
+        # solve path records its exact encoded inputs + matching, so any
+        # live or bench run yields a replayable trace
+        # (protocol_tpu/trace/). Lazy: the trace package (and its pb2
+        # import) loads only when capture is requested.
+        self.trace_recorder = None
+        if os.environ.get("PROTOCOL_TPU_TRACE"):
+            from protocol_tpu.trace.recorder import TraceRecorder
+
+            self.trace_recorder = TraceRecorder.from_env("matcher")
         self._groups_plugin = None
         self._group_assignment: dict[str, str] = {}  # group id -> task id
         self._group_covered: set[str] = set()
@@ -437,6 +447,22 @@ class TpuBatchMatcher:
                     f"arena_{k}": v
                     for k, v in self._native_arena.last_stats.items()
                 }
+                if self.trace_recorder is not None:
+                    from protocol_tpu.trace.recorder import (
+                        safe as _trace_safe,
+                    )
+
+                    kernel = self.native_engine + (
+                        f":{self.native_threads}"
+                        if self.native_threads else ""
+                    )
+                    _trace_safe(
+                        self.trace_recorder.record_solve, ep, er,
+                        self.weights, kernel, self._native_arena.k,
+                        self._native_arena.eps_end, 0, p4s,
+                        self._native_arena.price,
+                        metrics=dict(self._last_arena_stats),
+                    )
             else:
                 # fused feature->cost->top-k: the [P, T] tensor never
                 # exists (same streaming shape as the sparse TPU path)

@@ -31,6 +31,7 @@ engine; native-mt solves ride the servicer's persistent warm arena).
 
 from __future__ import annotations
 
+import os
 import time
 import uuid
 from concurrent import futures
@@ -188,6 +189,16 @@ class SchedulerBackendServicer:
             max_sessions=max_sessions, ttl_s=session_ttl_s
         )
         self.seam = SeamMetrics(role="server")
+        # flight recorder (PROTOCOL_TPU_TRACE=<path>): any solve served by
+        # this backend records its exact inputs + outcomes — unary calls
+        # via the column differ, the session protocol via its own wire
+        # frames (see protocol_tpu/trace/recorder.py). Best-effort: a
+        # capture failure never fails an RPC.
+        self.trace = None
+        if os.environ.get("PROTOCOL_TPU_TRACE"):
+            from protocol_tpu.trace.recorder import TraceRecorder
+
+            self.trace = TraceRecorder.from_env("server")
 
     # ---------------- shared kernel dispatch ----------------
 
@@ -435,10 +446,9 @@ class SchedulerBackendServicer:
             int(request.top_k), request.eps, int(request.max_iters),
             warm, seeds, context,
         )
+        t_solve = time.perf_counter()
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
-        self.seam.observe_ms(
-            "solve", (time.perf_counter() - t_dec) * 1e3
-        )
+        self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
         self.seam.add_bytes("in", request.ByteSize())
         resp = pb.AssignResponse(
             provider_for_task=out.p4t.astype(np.int32),
@@ -449,6 +459,21 @@ class SchedulerBackendServicer:
         if out.price is not None:
             resp.price.extend(out.price)
         self.seam.add_bytes("out", resp.ByteSize())
+        if self.trace is not None:
+            from protocol_tpu.trace.recorder import safe as _trace_safe
+
+            _trace_safe(
+                self.trace.record_solve, ep, er, self._weights_of(request),
+                request.kernel or "auction", int(request.top_k),
+                request.eps, int(request.max_iters), out.p4t, out.price,
+                metrics={
+                    "decode_ms": round((t_dec - t0) * 1e3, 3),
+                    "solve_ms": round((t_solve - t_dec) * 1e3, 3),
+                    "bytes_in": request.ByteSize(),
+                    "bytes_out": resp.ByteSize(),
+                    "wire": "v1",
+                },
+            )
         return resp
 
     # ---------------- v2 unary: tensor frames ----------------
@@ -482,6 +507,21 @@ class SchedulerBackendServicer:
         self.seam.add_bytes("in", request.ByteSize())
         resp = self._result_v2(out, t0, t_dec - t0)
         self.seam.add_bytes("out", resp.ByteSize())
+        if self.trace is not None:
+            from protocol_tpu.trace.recorder import safe as _trace_safe
+
+            _trace_safe(
+                self.trace.record_solve, ep, er, self._weights_of(request),
+                request.kernel or "auction", int(request.top_k),
+                request.eps, int(request.max_iters), out.p4t, out.price,
+                metrics={
+                    "decode_ms": round((t_dec - t0) * 1e3, 3),
+                    "solve_ms": round((t_solve - t_dec) * 1e3, 3),
+                    "bytes_in": request.ByteSize(),
+                    "bytes_out": resp.ByteSize(),
+                    "wire": "v2",
+                },
+            )
         return resp
 
     @staticmethod
@@ -562,12 +602,37 @@ class SchedulerBackendServicer:
         t_dec = time.perf_counter()
         with session.lock:
             p4t, t4p, price = session.solve()
+        t_solve = time.perf_counter()
         self.sessions.put(session)
         self.seam.count("session_open")
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
-        self.seam.observe_ms(
-            "solve", (time.perf_counter() - t_dec) * 1e3
-        )
+        self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
+        if self.trace is not None:
+            # flight recorder, session mode: the snapshot frame is the
+            # session's own wire message, deltas land from apply_delta
+            # (one session claims the stream; later sessions are not
+            # recorded — one trace, one session)
+            try:
+                if self.trace.record_session_open(
+                    session.session_id, fp, req
+                ):
+                    session.trace = self.trace
+                    self.trace.record_outcome(
+                        0, p4t, price,
+                        metrics={
+                            "decode_ms": round((t_dec - t0) * 1e3, 3),
+                            "solve_ms": round((t_solve - t_dec) * 1e3, 3),
+                            "bytes_in": wire_bytes,
+                            "wire": "v2-session",
+                        },
+                        session_id=session.session_id,
+                    )
+            except Exception:  # pragma: no cover - capture must not fail RPCs
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "trace capture failed at OpenSession", exc_info=True
+                )
         out = _SolveOut(p4t, t4p, int((p4t >= 0).sum()), price)
         resp = pb.OpenSessionResponse(
             ok=True,
@@ -659,6 +724,26 @@ class SchedulerBackendServicer:
                 self.seam.count("session_evicted_inflight")
                 return pb.AssignDeltaResponse(
                     session_ok=False, error="session evicted"
+                )
+            if session.trace is not None:
+                from protocol_tpu.trace.recorder import safe as _trace_safe
+
+                # outcome for the tick whose delta apply_delta recorded;
+                # inside the lock so tick/outcome numbering can't race a
+                # concurrent delta on the same session
+                _trace_safe(
+                    session.trace.record_outcome, session.tick, p4t_out,
+                    price,
+                    metrics={
+                        "decode_ms": round((t_dec - t0) * 1e3, 3),
+                        "solve_ms": round(
+                            (time.perf_counter() - t_dec) * 1e3, 3
+                        ),
+                        "bytes_in": request.ByteSize(),
+                        "delta_rows": int(prow.size + trow.size),
+                        "wire": "v2-session",
+                    },
+                    session_id=session.session_id,
                 )
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms(

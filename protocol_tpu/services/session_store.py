@@ -161,6 +161,10 @@ class SolveSession:
     evicted: bool = False
     # shared EngineThreadBudget (None = unbudgeted, use arena.threads)
     budget: object = None
+    # flight recorder (trace.recorder.TraceRecorder) when this session
+    # claimed the PROTOCOL_TPU_TRACE stream: every APPLIED delta lands
+    # its exact wire rows from apply_delta (refused deltas never record)
+    trace: object = None
 
     def solve(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the warm arena over the current columns; returns
@@ -238,6 +242,17 @@ class SolveSession:
                 cols[name] = col
             applied += int(rows.size)
         self.delta_rows_total += applied
+        if self.trace is not None:
+            from protocol_tpu.trace.recorder import safe as _trace_safe
+
+            # the delta for the tick the caller is about to advance to
+            # (callers hold self.lock here, so tick+1 cannot race);
+            # empty deltas record too — a no-churn tick still solves,
+            # and replay regenerates the tick sequence from these frames
+            _trace_safe(
+                self.trace.record_session_delta, self.session_id,
+                self.tick + 1, provider_rows, p_delta, task_rows, r_delta,
+            )
         return applied
 
 

@@ -1,0 +1,35 @@
+"""Cluster flight recorder: deterministic trace capture & replay for the
+scheduler seam.
+
+  format.py    versioned, gzip-framed, append-only trace format (wire-v2
+               TensorBlob column codecs; torn tails tolerated)
+  recorder.py  capture hooks behind PROTOCOL_TPU_TRACE=<path> (matcher,
+               gRPC servicer, session delta application)
+  replay.py    deterministic replayer — any engine, any transport,
+               bit-for-bit outcome verification + divergence localization
+  synth.py     parameterized workload generators (the single source of
+               synthetic populations) and the trace factory
+
+CLI: ``python -m protocol_tpu.trace {synth,record,replay,info}``.
+"""
+
+from protocol_tpu.trace.format import (  # noqa: F401
+    P_TRACE_DTYPES,
+    R_TRACE_DTYPES,
+    Trace,
+    TraceWriter,
+    read_trace,
+)
+from protocol_tpu.trace.recorder import TraceRecorder  # noqa: F401
+from protocol_tpu.trace.replay import compare, replay  # noqa: F401
+
+__all__ = [
+    "P_TRACE_DTYPES",
+    "R_TRACE_DTYPES",
+    "Trace",
+    "TraceWriter",
+    "read_trace",
+    "TraceRecorder",
+    "compare",
+    "replay",
+]

@@ -1,0 +1,265 @@
+"""Flight-recorder capture hooks: ``PROTOCOL_TPU_TRACE=<path>`` makes any
+live or bench run record its exact solve inputs and outcomes.
+
+Three seam sites record (all behind the same env knob, all producing the
+same trace/format.py frames):
+
+  * **TpuBatchMatcher** (in-process / degraded-mode solves): the native
+    arena path records the encoded columns it solves, diffing against its
+    own shadow copy to emit O(churn) delta frames — the recorder is the
+    wire protocol's column differ pointed at disk instead of a socket.
+  * **the gRPC servicer** (unary v1/v2): same column-mode capture of the
+    decoded request.
+  * **SessionStore delta application** (the v2 session protocol): the
+    recorder rides the session — ``OpenSession`` lands the epoch snapshot
+    frame verbatim and every applied ``AssignDelta`` lands its exact wire
+    rows, so the trace IS the session's wire history.
+
+One trace file holds ONE epoch (one population shape + solve-parameter
+set). When the recorded workload re-epochs (shape or params change), the
+recorder rolls to ``<path>.e1``, ``<path>.e2``, ... — each file replays
+independently. When several capture sites are live in one process, the
+first ``from_env`` claim gets the bare path and later claimants get
+``<path>.<role>`` (a recorder never multiplexes writers onto one file).
+
+Recording is best-effort by design: a raise inside a capture hook must
+never fail a scheduler tick, so hook call sites wrap in try/except and
+surface failures as one warning.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto import wire
+from protocol_tpu.trace import format as tfmt
+
+ENV_VAR = "PROTOCOL_TPU_TRACE"
+
+_claim_lock = threading.Lock()
+_claimed: set[str] = set()
+
+log = logging.getLogger(__name__)
+
+
+def _claim(path: str, role: str) -> str:
+    with _claim_lock:
+        if path not in _claimed:
+            _claimed.add(path)
+            return path
+        alt = f"{path}.{role or 'alt'}"
+        n = 1
+        while alt in _claimed:
+            alt = f"{path}.{role or 'alt'}{n}"
+            n += 1
+        _claimed.add(alt)
+        return alt
+
+
+class TraceRecorder:
+    """One capture stream -> one (or, across epochs, a family of) trace
+    file(s). Thread-safe; frames land fully flushed (kill-proof tails)."""
+
+    def __init__(self, path: str, role: str = "", meta: Optional[dict] = None):
+        self.path = path
+        self.role = role
+        self.meta = dict(meta or {})
+        self._lock = threading.Lock()
+        self._writer: Optional[tfmt.TraceWriter] = None
+        self._epoch = 0
+        self._tick = 0
+        # column-mode shadow state (matcher / unary servicer capture)
+        self._params: Optional[tuple] = None
+        self._shadow_p: Optional[dict] = None
+        self._shadow_r: Optional[dict] = None
+        # wire-mode session claim (one session per trace stream)
+        self._session_id: Optional[str] = None
+
+    @classmethod
+    def from_env(cls, role: str = "",
+                 meta: Optional[dict] = None) -> Optional["TraceRecorder"]:
+        path = os.environ.get(ENV_VAR, "")
+        if not path:
+            return None
+        m = {"role": role}
+        m.update(meta or {})
+        return cls(_claim(path, role), role=role, meta=m)
+
+    # ---------------- internals ----------------
+
+    def _epoch_path(self) -> str:
+        return self.path if self._epoch == 0 else f"{self.path}.e{self._epoch}"
+
+    def _open_writer(self) -> tfmt.TraceWriter:
+        if self._writer is None:
+            self._writer = tfmt.TraceWriter(self._epoch_path(), meta=self.meta)
+        return self._writer
+
+    def _roll_epoch(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+            self._epoch += 1
+        self._tick = 0
+
+    # ---------------- column-mode capture (matcher / unary) ----------------
+
+    def record_solve(
+        self,
+        ep,
+        er,
+        weights,
+        kernel: str,
+        top_k: int,
+        eps: float,
+        max_iters: int,
+        p4t: np.ndarray,
+        price: Optional[np.ndarray] = None,
+        metrics: Optional[dict] = None,
+        events: Optional[list] = None,
+    ) -> None:
+        """Capture one full solve: first call (or any epoch change) writes
+        the snapshot frame; steady-state calls diff against the shadow
+        columns and write O(churn) delta frames; every call writes the
+        outcome frame. ``ep``/``er`` are Encoded* batches (numpy- or
+        jax-backed)."""
+        p_cols = wire.canon_columns(ep, tfmt.P_TRACE_DTYPES)
+        r_cols = wire.canon_columns(er, tfmt.R_TRACE_DTYPES)
+        params = (
+            kernel, int(top_k), np.float32(eps).item(), int(max_iters),
+            float(weights.price), float(weights.load),
+            float(weights.proximity), float(weights.priority),
+            p_cols["gpu_count"].shape[0], r_cols["cpu_cores"].shape[0],
+        )
+        with self._lock:
+            if self._session_id is not None:
+                return  # session mode owns this stream
+            if self._params != params or self._shadow_p is None:
+                if self._params is not None:
+                    self._roll_epoch()
+                self._params = params
+                fp = wire.epoch_fingerprint(
+                    p_cols, r_cols, weights, kernel, top_k, eps, max_iters
+                )
+                req = pb.AssignRequestV2(
+                    providers=wire.encode_providers_v2(tfmt._as_ns(p_cols)),
+                    requirements=wire.encode_requirements_v2(
+                        tfmt._as_ns(r_cols)
+                    ),
+                    weights=pb.CostWeights(
+                        price=float(weights.price), load=float(weights.load),
+                        proximity=float(weights.proximity),
+                        priority=float(weights.priority),
+                    ),
+                    kernel=kernel, top_k=top_k, eps=eps, max_iters=max_iters,
+                )
+                self._open_writer().write_snapshot(
+                    f"{self.role or 'live'}-e{self._epoch}", fp, req
+                )
+            else:
+                self._tick += 1
+                prow = wire.dirty_rows(p_cols, self._shadow_p)
+                trow = wire.dirty_rows(r_cols, self._shadow_r)
+                self._open_writer().write_delta_cols(
+                    self._tick,
+                    prow,
+                    {n: a[prow] for n, a in p_cols.items()}
+                    if prow.size else None,
+                    trow,
+                    {n: a[trow] for n, a in r_cols.items()}
+                    if trow.size else None,
+                    events=events,
+                )
+            self._shadow_p, self._shadow_r = p_cols, r_cols
+            self._writer.write_outcome(
+                self._tick, np.asarray(p4t, np.int32),
+                price=None if price is None else np.asarray(
+                    price, np.float32
+                ),
+                metrics=metrics,
+            )
+
+    # ---------------- wire-mode capture (session protocol) ----------------
+
+    def record_session_open(
+        self, session_id: str, fingerprint: str, req: pb.AssignRequestV2
+    ) -> bool:
+        """Claim the session for this stream and land its snapshot frame
+        verbatim. Returns False (and records nothing) when another
+        session already owns the stream — one trace, one session."""
+        with self._lock:
+            if self._params is not None:
+                return False  # column-mode capture owns this stream
+            if self._session_id is not None and self._session_id != session_id:
+                return False
+            if self._session_id == session_id:
+                # same id re-opened: a fresh epoch of the same stream
+                self._roll_epoch()
+            self._session_id = session_id
+            self._open_writer().write_snapshot(session_id, fingerprint, req)
+            return True
+
+    def record_session_delta(
+        self,
+        session_id: str,
+        tick: int,
+        provider_rows: np.ndarray,
+        p_delta: dict,
+        task_rows: np.ndarray,
+        r_delta: dict,
+        events: Optional[list] = None,
+    ) -> None:
+        """Land one APPLIED AssignDelta's exact rows (called from
+        SolveSession.apply_delta, under the session lock — refused deltas
+        never reach it, so the trace holds only ticks that solved)."""
+        with self._lock:
+            if self._session_id != session_id:
+                return
+            self._tick = int(tick)
+            self._open_writer().write_delta_cols(
+                int(tick),
+                provider_rows,
+                p_delta if provider_rows.size else None,
+                task_rows,
+                r_delta if task_rows.size else None,
+                events=events,
+            )
+
+    def record_outcome(
+        self,
+        tick: int,
+        p4t: np.ndarray,
+        price: Optional[np.ndarray] = None,
+        metrics: Optional[dict] = None,
+        session_id: Optional[str] = None,
+    ) -> None:
+        with self._lock:
+            if session_id is not None and self._session_id != session_id:
+                return
+            self._open_writer().write_outcome(
+                int(tick), np.asarray(p4t, np.int32),
+                price=None if price is None else np.asarray(
+                    price, np.float32
+                ),
+                metrics=metrics,
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+
+
+def safe(fn, *args, **kwargs) -> None:
+    """Run one capture hook, never letting it fail the solve path."""
+    try:
+        fn(*args, **kwargs)
+    except Exception:  # pragma: no cover - defensive seam
+        log.warning("trace capture hook failed", exc_info=True)

@@ -18,6 +18,10 @@ This rule makes the contract mechanical:
   * the wire specs must cover exactly the ``EncodedProviders`` /
     ``EncodedRequirements`` dataclass fields (ops/encoding.py) — a field
     added to the encoding but not the wire would vanish at the seam.
+  * ``P_TRACE_DTYPES``/``R_TRACE_DTYPES`` (the flight-recorder frame
+    codec, trace/format.py) must mirror the wire tables exactly: trace
+    frames PERSIST on disk, so a drifted column silently reinterprets
+    every archived trace at the wrong width on the next replay.
   * every ``blob(...)``/``unblob(...)`` call site must pass an explicit
     dtype (second argument): an un-annotated encode/decode reintroduces
     exactly the silent-coercion class the seam's single-assert design
@@ -41,6 +45,7 @@ _EQUIV = {"bool_": "u1", "bool": "u1", "uint8": "u1"}
 _WIRE = "protocol_tpu/proto/wire.py"
 _ARENA = "protocol_tpu/native/arena.py"
 _ENCODING = "protocol_tpu/ops/encoding.py"
+_TRACE = "protocol_tpu/trace/format.py"
 
 
 def _dtype_name(node: ast.AST) -> Optional[str]:
@@ -120,10 +125,12 @@ class DtypeContractRule(Rule):
         wire: str = _WIRE,
         arena: str = _ARENA,
         encoding: Optional[str] = _ENCODING,
+        trace: Optional[str] = _TRACE,
     ):
         self.wire = wire
         self.arena = arena
         self.encoding = encoding
+        self.trace = trace
 
     def applies(self, rel: str) -> bool:
         # call-site pass: anywhere blob/unblob travel
@@ -219,5 +226,61 @@ class DtypeContractRule(Rule):
                         f"{wire_var} does not cover {enc_cls} exactly "
                         f"(missing={missing} stray={stray}) — un-listed "
                         "columns vanish at the seam",
+                    ))
+        out += self._check_trace(wire_tree)
+        return out
+
+    def _check_trace(self, wire_tree: ast.AST) -> list[Finding]:
+        """Third dtype site: the flight-recorder frame codec. Trace files
+        persist across code revisions, so its tables must mirror the wire
+        tables EXACTLY (names, order, dtype) — drift silently reinterprets
+        every archived trace's raw bytes at the wrong width on replay."""
+        if not self.trace:
+            return []
+        out: list[Finding] = []
+        trace_tree = self._parse(self.trace)
+        if trace_tree is None:
+            return [Finding(
+                self.name, self.trace, 0,
+                "cannot locate the trace dtype tables to cross-check",
+            )]
+        for wire_var, trace_var in (
+            ("P_WIRE_DTYPES", "P_TRACE_DTYPES"),
+            ("R_WIRE_DTYPES", "R_TRACE_DTYPES"),
+        ):
+            wspec = _dict_spec(wire_tree, wire_var)
+            if wspec is None:
+                continue  # already reported by the wire/arena pass
+            tspec = _dict_spec(trace_tree, trace_var)
+            if tspec is None:
+                out.append(Finding(
+                    self.name, self.trace, 0,
+                    f"missing dtype table {trace_var}",
+                ))
+                continue
+            wnames = [n for n, _, _ in wspec]
+            tnames = [n for n, _, _ in tspec]
+            if wnames != tnames:
+                extra_w = [n for n in wnames if n not in tnames]
+                extra_t = [n for n in tnames if n not in wnames]
+                detail = (
+                    f"wire-only={extra_w} trace-only={extra_t}"
+                    if (extra_w or extra_t)
+                    else "same columns, different order"
+                )
+                out.append(Finding(
+                    self.name, self.trace,
+                    tspec[0][2] if tspec else 0,
+                    f"{trace_var} columns disagree with {wire_var} "
+                    f"({detail}) — archived trace frames decode by this "
+                    "table",
+                ))
+            for (wn, wd, _wl), (tn, td, tl) in zip(wspec, tspec):
+                if wn == tn and _canon(wd) != _canon(td):
+                    out.append(Finding(
+                        self.name, self.trace, tl,
+                        f"column {tn!r}: trace dtype {td} vs wire dtype "
+                        f"{wd} — archived traces would reinterpret raw "
+                        "bytes at the wrong width on replay",
                     ))
         return out

@@ -1,0 +1,16 @@
+"""Seeded trace-codec drift for the dtype-contract cross-check (paired
+with dtype_wire_bad.py): P drifts a column WIDTH (price recorded f64 vs
+the wire's f32 — archived frames reinterpret on replay), R drops a
+column (ram_mb) the wire table carries."""
+
+import numpy as np
+
+P_TRACE_DTYPES = {
+    "gpu_count": np.dtype(np.int32),
+    "price": np.dtype(np.float64),
+    "valid": np.dtype(np.bool_),
+}
+R_TRACE_DTYPES = {
+    "cpu_cores": np.dtype(np.int32),
+    "valid": np.dtype(np.bool_),
+}

@@ -1,0 +1,15 @@
+"""Consistent trace frame-codec tables (paired with dtype_wire_ok.py):
+the dtype-contract trace cross-check must come back clean on this pair."""
+
+import numpy as np
+
+P_TRACE_DTYPES = {
+    "gpu_count": np.dtype(np.int32),
+    "price": np.dtype(np.float32),
+    "valid": np.dtype(np.bool_),
+}
+R_TRACE_DTYPES = {
+    "cpu_cores": np.dtype(np.int32),
+    "ram_mb": np.dtype(np.int32),
+    "valid": np.dtype(np.bool_),
+}

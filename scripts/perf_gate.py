@@ -33,9 +33,19 @@ warm-potential-carry floor: a 1% churn warm re-solve through the sinkhorn
 arena must be >= 2x faster than the cold solve. A solver or warm-carry
 regression cannot merge on green unit tests alone.
 
+With ``--trace`` it runs the golden-trace replay gate (ISSUE 5): the
+committed flight-recorder trace (artifacts/golden_trace_512x512.trace)
+replayed through native-mt at threads {1, 2} and through the v2 wire
+loopback must reproduce the recorded assignments BIT-FOR-BIT (empty
+divergence report), the steady-state assigned fraction must hold, and
+the warm ticks must beat the cold tick by the stored floor — so a
+solver, codec, or warm-path regression shows up as a named divergent
+tick/row set, not a vague bench delta.
+
 Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
-(--update-floor rewrites perf_floor.json to 25% of this machine's
-measured rate — run on the slowest supported host class, then commit.)
+[--trace] (--update-floor rewrites perf_floor.json to 25% of this
+machine's measured rate — run on the slowest supported host class, then
+commit.)
 """
 
 import argparse
@@ -228,17 +238,105 @@ def sinkhorn_gate() -> int:
     return 0
 
 
+GOLDEN_TRACE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "artifacts", "golden_trace_512x512.trace",
+)
+
+
+def trace_gate() -> int:
+    """Golden-trace replay gate (the ISSUE 5 acceptance bar): bit-for-bit
+    replay identity at threads {1, 2} + the v2 wire loopback, plus the
+    warm-solve floor measured on the replay's own tick walls."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from protocol_tpu.trace.replay import replay
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    warm_rep = None
+    for threads in (1, 2):
+        rep = replay(GOLDEN_TRACE, engine="native-mt", threads=threads)
+        print(
+            f"trace gate: native-mt:{threads} verified "
+            f"{rep['verified_ticks']}/{rep['ticks']} ticks, divergence "
+            f"{rep['divergence']}"
+        )
+        if rep["divergence"] is not None:
+            d = rep["divergence"]
+            failures.append(
+                f"native-mt:{threads} replay diverged at tick {d['tick']} "
+                f"({d['n_rows']} rows, first {d['rows'][:8]})"
+            )
+        if rep["verified_ticks"] != rep["ticks"]:
+            failures.append(
+                f"native-mt:{threads} verified only "
+                f"{rep['verified_ticks']}/{rep['ticks']} ticks"
+            )
+        warm_rep = rep
+    repw = replay(
+        GOLDEN_TRACE, engine="native-mt", threads=2, transport="wire-v2"
+    )
+    print(
+        f"trace gate: wire-v2 verified {repw['verified_ticks']}/"
+        f"{repw['ticks']} ticks, divergence {repw['divergence']}"
+    )
+    if repw["divergence"] is not None:
+        d = repw["divergence"]
+        failures.append(
+            f"wire-v2 replay diverged at tick {d['tick']} "
+            f"({d['n_rows']} rows)"
+        )
+    # warm-solve floor on the inproc replay's own tick walls. A replay
+    # that diverged at the cold tick has no warm walls — skip the floor
+    # math so the DIVERGENCE failures above surface, not a KeyError.
+    if "warm_median_ms" in warm_rep:
+        speedup = warm_rep["cold_ms"] / max(
+            warm_rep["warm_median_ms"], 1e-9
+        )
+        frac = min(warm_rep["assigned"]) / warm_rep["tasks"]
+        print(
+            f"trace gate: warm median {warm_rep['warm_median_ms']}ms vs "
+            f"cold {warm_rep['cold_ms']}ms ({speedup:.1f}x, floor "
+            f"{floors['trace_warm_speedup_floor']}x); min assigned frac "
+            f"{frac:.3f}"
+        )
+        if speedup < floors["trace_warm_speedup_floor"]:
+            failures.append(
+                f"golden-trace warm tick only {speedup:.1f}x faster than "
+                f"cold (floor {floors['trace_warm_speedup_floor']}x)"
+            )
+        if frac < floors["trace_min_assigned_frac"]:
+            failures.append(
+                f"golden-trace assigned fraction {frac:.3f} below "
+                f"{floors['trace_min_assigned_frac']}"
+            )
+    elif not failures:
+        failures.append(
+            "golden-trace replay produced no warm ticks to gate"
+        )
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("trace perf gate OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update-floor", action="store_true")
     ap.add_argument("--wire", action="store_true")
     ap.add_argument("--sinkhorn", action="store_true")
+    ap.add_argument("--trace", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
         return wire_gate()
     if args.sinkhorn:
         return sinkhorn_gate()
+    if args.trace:
+        return trace_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

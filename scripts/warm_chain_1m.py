@@ -99,6 +99,13 @@ def main() -> None:
         help="JSONL file each step row is APPENDED to as it completes "
         "(kill-proof). Empty string disables.",
     )
+    ap.add_argument(
+        "--trace", default="",
+        help="flight-recorder trace whose snapshot supplies the chain's "
+        "candidate structure (real features -> fused top-K lists) "
+        "instead of the uniform synthetic candidates; --size is then "
+        "taken from the trace",
+    )
     args = ap.parse_args()
 
     from protocol_tpu.utils.artifacts import append_jsonl
@@ -110,11 +117,38 @@ def main() -> None:
     T = P = args.size
     K = 80
     EPS_END = 1.0  # matches the smoke's bounded cold ladder
-    rng = np.random.default_rng(0)
     t0 = time.time()
-    cand_p_np = rng.integers(0, P, size=(T, K), dtype=np.int32)
-    cand_c_np = rng.uniform(0.0, 10.0, size=(T, K)).astype(np.float32)
-    print(f"# synth built {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+    if args.trace:
+        # real-feature candidates from a recorded population: the trace
+        # snapshot's encodings through the fused native pass (the chain
+        # then measures warm-solve behavior on a shareable fleet)
+        from protocol_tpu import native
+        from protocol_tpu.ops.cost import CostWeights
+        from protocol_tpu.trace import format as tfmt
+
+        snap = tfmt.read_trace(args.trace).snapshot
+        if snap is None:
+            raise SystemExit(f"{args.trace}: no snapshot frame")
+        P, T = snap.n_providers, snap.n_tasks
+        cand_p_np, cand_c_np = native.fused_topk_candidates(
+            tfmt._as_ns(snap.p_cols), tfmt._as_ns(snap.r_cols),
+            CostWeights(*snap.weights), k=K, threads=args.threads,
+        )
+        print(
+            f"# trace candidates built {time.time()-t0:.1f}s "
+            f"(P={P} T={T})", file=sys.stderr, flush=True,
+        )
+    else:
+        # uniform synthetic candidates (trace/synth.py — the shared home
+        # of every synthetic population): execution evidence at shape
+        from protocol_tpu.trace.synth import synth_uniform_candidates
+
+        rng = np.random.default_rng(0)
+        cand_p_np, cand_c_np = synth_uniform_candidates(rng, T, P, k=K)
+        print(
+            f"# synth built {time.time()-t0:.1f}s", file=sys.stderr,
+            flush=True,
+        )
 
     if args.engine == "native-mt":
         run_native_chain(args, cand_p_np, cand_c_np, P, T, EPS_END, emit)

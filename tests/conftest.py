@@ -22,3 +22,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 from protocol_tpu.utils.platform import force_host_cpu  # noqa: E402
 
 force_host_cpu(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (tier-1 runs with -m 'not slow')",
+    )

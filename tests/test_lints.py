@@ -65,24 +65,30 @@ class TestRulesFireExactlyOnSeeds:
 
 
 class TestDtypeCrossCheck:
-    def test_seeded_trio_yields_all_three_mismatch_classes(self):
+    def test_seeded_trio_yields_all_mismatch_classes(self):
         rule = DtypeContractRule(
             wire=str(FIXTURES / "dtype_wire_bad.py"),
             arena=str(FIXTURES / "dtype_arena_bad.py"),
             encoding=str(FIXTURES / "dtype_encoding_bad.py"),
+            trace=str(FIXTURES / "dtype_trace_bad.py"),
         )
         findings = rule.check_repo()
         msgs = "\n".join(f.message for f in findings)
-        assert len(findings) == 3
+        assert len(findings) == 5
         assert "'price'" in msgs  # width clash wire float32 vs arena int32
         assert "ram_mb" in msgs  # column dropped from the arena spec
         assert "extra_col" in msgs  # encoding field the wire never carries
+        # the trace codec (third site): a recorded-width drift and a
+        # dropped column, each its own finding
+        trace_msgs = [f for f in findings if "trace" in f.message.lower()]
+        assert len(trace_msgs) == 2
 
     def test_consistent_trio_is_clean(self):
         rule = DtypeContractRule(
             wire=str(FIXTURES / "dtype_wire_ok.py"),
             arena=str(FIXTURES / "dtype_arena_ok.py"),
             encoding=str(FIXTURES / "dtype_encoding_ok.py"),
+            trace=str(FIXTURES / "dtype_trace_ok.py"),
         )
         assert rule.check_repo() == []
 
@@ -90,9 +96,21 @@ class TestDtypeCrossCheck:
         rule = DtypeContractRule(
             wire=str(FIXTURES / "dtype_encoding_ok.py"),  # no dtype dicts
             arena=str(FIXTURES / "dtype_arena_ok.py"),
+            trace=str(FIXTURES / "dtype_trace_ok.py"),
         )
         findings = rule.check_repo()
         assert findings and all(f.rule == "dtype-contract" for f in findings)
+
+    def test_missing_trace_table_is_a_finding(self):
+        rule = DtypeContractRule(
+            wire=str(FIXTURES / "dtype_wire_ok.py"),
+            arena=str(FIXTURES / "dtype_arena_ok.py"),
+            encoding=str(FIXTURES / "dtype_encoding_ok.py"),
+            trace=str(FIXTURES / "dtype_encoding_ok.py"),  # no trace dicts
+        )
+        findings = rule.check_repo()
+        assert findings
+        assert all("TRACE_DTYPES" in f.message for f in findings)
 
 
 class TestEngine:
