@@ -362,18 +362,32 @@ def run_wire_bench(
                 tick_assigned.append(int(dresp.result.num_assigned))
         h = client.health()
         seam = {s.name: s.value for s in h.seam_metrics}
+        # latency DISTRIBUTION per tick, not just means: headline p50/p99
+        # are exact (the raw walls are in hand — np.percentile), and the
+        # obs LatencyHistogram snapshot rides alongside (the same
+        # estimator the per-session registries use at fleet scale, where
+        # raw samples can't be kept)
+        from protocol_tpu.obs.metrics import percentiles_ms
+
+        pct = percentiles_ms(tick_ms)
+        p50 = round(float(np.percentile(tick_ms, 50)), 2)
+        p99 = round(float(np.percentile(tick_ms, 99)), 2)
         out["modes"][mode] = {
             "tick_ms": [round(x, 2) for x in tick_ms],
             "mean_tick_ms": round(sum(tick_ms) / len(tick_ms), 2),
             "median_tick_ms": round(float(np.median(tick_ms)), 2),
             "min_tick_ms": round(min(tick_ms), 2),
+            "p50_tick_ms": p50,
+            "p99_tick_ms": p99,
+            "tick_percentiles": pct,
             "mean_tick_bytes": int(sum(tick_bytes) / len(tick_bytes)),
             "tick_assigned": tick_assigned,
             "server_seam": seam,
         }
         log(
             f"wire={mode}: mean {out['modes'][mode]['mean_tick_ms']:.1f} "
-            f"ms/tick, {out['modes'][mode]['mean_tick_bytes']:,} B/tick"
+            f"ms/tick (p50 {p50}, p99 {p99}), "
+            f"{out['modes'][mode]['mean_tick_bytes']:,} B/tick"
         )
         client.close()
         server.stop(grace=None)
@@ -478,6 +492,10 @@ def main() -> None:
                 "bytes_ratio": res["v2_bytes_ratio"],
                 "v1_mean_tick_ms": res["modes"]["v1"]["mean_tick_ms"],
                 "v2_mean_tick_ms": res["modes"]["v2"]["mean_tick_ms"],
+                "v1_p50_tick_ms": res["modes"]["v1"]["p50_tick_ms"],
+                "v1_p99_tick_ms": res["modes"]["v1"]["p99_tick_ms"],
+                "v2_p50_tick_ms": res["modes"]["v2"]["p50_tick_ms"],
+                "v2_p99_tick_ms": res["modes"]["v2"]["p99_tick_ms"],
             }))
         else:
             m = res["modes"][wire]
@@ -488,6 +506,8 @@ def main() -> None:
                 ),
                 "value": m["mean_tick_ms"],
                 "unit": "ms_per_tick",
+                "p50_tick_ms": m["p50_tick_ms"],
+                "p99_tick_ms": m["p99_tick_ms"],
                 "mean_tick_bytes": m["mean_tick_bytes"],
             }))
         return

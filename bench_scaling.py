@@ -154,6 +154,11 @@ def main() -> None:
         leaf = jnp.ravel(jax.tree.leaves(out)[0])[:1]
         jax.device_get(leaf)
 
+    # per-iteration walls of the most recent measure() call — the obs
+    # histograms turn them into p50/p99 fields on the artifact rows
+    # (distribution numbers instead of means only)
+    last_walls_s: list[float] = []
+
     def measure(fn, warmup=1, iters=3):
         # the salt is passed with a DISTINCT value (content-dedup would
         # collapse identical 0.0 uploads); lambdas neutralize it on device
@@ -161,12 +166,27 @@ def main() -> None:
         for _ in range(warmup):
             _salt_counter[0] += 1
             _force(fn(jnp.float32(_salt_counter[0])))
-        t0 = time.perf_counter()
+        last_walls_s.clear()
+        t_all = time.perf_counter()
         for _ in range(iters):
             _salt_counter[0] += 1
+            t0 = time.perf_counter()
             out = fn(jnp.float32(_salt_counter[0]))
             _force(out)
-        return (time.perf_counter() - t0) / iters, out
+            last_walls_s.append(time.perf_counter() - t0)
+        return (time.perf_counter() - t_all) / iters, out
+
+    def tick_pct() -> dict:
+        """p50/p99 (seconds) of the most recent measure()'s iterations —
+        exact (np.percentile over the retained walls; the obs histograms
+        are for streams whose samples can't be kept)."""
+        if not last_walls_s:
+            return {"p50_s": 0.0, "p99_s": 0.0, "iters": 0}
+        return {
+            "p50_s": round(float(np.percentile(last_walls_s, 50)), 4),
+            "p99_s": round(float(np.percentile(last_walls_s, 99)), 4),
+            "iters": len(last_walls_s),
+        }
 
     # ---------------- stage A: candidate generation ----------------
     log(f"stage A: candidates_topk P={P_MEAS} T={T_MEAS} K={K} tile={TILE}")
@@ -186,6 +206,7 @@ def main() -> None:
             "shape": f"P={P_MEAS} T={T_MEAS} K={K} tile={TILE}",
             "wall_s": round(secs, 3),
             "cells_per_s": round(cells / secs / 1e9, 3),  # Gcell/s
+            **tick_pct(),
         }
     )
     log(f"  {secs:.3f}s  ({cells / secs / 1e9:.2f} Gcells/s)")
@@ -400,6 +421,7 @@ def main() -> None:
             with_state=True,
         )
     )
+    cold_pct = tick_pct()
     res_cold, price_cold, retired_cold = out_cold
     # 1% churn: drop a contiguous 1% of the matching (freed providers /
     # re-opened tasks) and re-solve warm from the carried duals — prices
@@ -415,6 +437,7 @@ def main() -> None:
             frontier=min(T_AUCTION, 8192),
         )[0].provider_for_task
     )
+    warm_pct = tick_pct()
     emit(
         {
             "stage": "C warm vs cold solve (measured)",
@@ -423,6 +446,10 @@ def main() -> None:
             "cold_s": round(secs_cold, 4),
             "warm_s": round(secs_warm, 4),
             "speedup": round(secs_cold / max(secs_warm, 1e-9), 1),
+            "cold_p50_s": cold_pct["p50_s"],
+            "cold_p99_s": cold_pct["p99_s"],
+            "warm_p50_s": warm_pct["p50_s"],
+            "warm_p99_s": warm_pct["p99_s"],
         }
     )
     log(
@@ -469,6 +496,7 @@ def main() -> None:
             "wall_s": round(secs_d, 3),
             "tasks_per_s": round(packed / max(secs_d, 1e-9), 0),
             "packed": packed,
+            **tick_pct(),
         }
     )
     log(f"  {secs_d:.3f}s, {packed}/{T_D} packed")

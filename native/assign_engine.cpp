@@ -21,6 +21,7 @@
 
 #include <algorithm>
 #include <cfloat>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -44,6 +45,25 @@ inline float jitter(uint32_t p, uint32_t t) {
   // must match protocol_tpu/ops/sparse.py candidates_topk
   uint32_t h = (p * 2654435761u) ^ (t * 40503u);
   return static_cast<float>(h & 1023u) * 1e-7f;
+}
+
+// ---- engine phase stats (the observability plane's native layer) ----------
+//
+// Every -mt kernel takes a trailing nullable `int64_t* stats_out` pointing
+// at ENGINE_STATS_SLOTS i64 slots (the ctypes wrapper documents the per-
+// kernel slot layout). Stats are counters + steady_clock phase timings
+// accumulated ON THE CALLING THREAD ONLY (helper threads never touch the
+// array — no new shared state, TSan-clean by construction), and a null
+// stats_out skips every clock read, so the uninstrumented path is
+// byte-for-byte the historical one. Stats NEVER feed solver state: the
+// matching is bit-identical with or without them (the replay-identity CI
+// job runs with instrumentation on).
+constexpr int kEngineStatsSlots = 16;
+
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
 }
 
 // ---- threading primitives for the -mt engine variants ----------------------
@@ -768,7 +788,7 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                      float w_price, float w_load, float w_proximity,
                      float w_priority, int32_t* out_cand_provider,
                      float* out_cand_cost, int32_t reverse_r, int32_t extra,
-                     int32_t threads) {
+                     int32_t threads, int64_t* stats_out = nullptr) {
   // Bidirectional candidates (the degraded-mode twin of the JAX path's
   // ops/sparse.candidates_topk_bidir): on price-dominated fleets every
   // task's forward top-k holds the same cheap providers, capping the
@@ -786,6 +806,12 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
   const int nt = resolve_threads(threads, T);
   const ProviderPrecomp pre(pf, P, w_price, w_load);
   const uint64_t pad_key = pack_key(kInfeasible, 0xffffffffu);
+  const bool st = stats_out != nullptr;
+  int64_t t0 = st ? now_ns() : 0;
+  if (st) {
+    std::memset(stats_out, 0, kEngineStatsSlots * 8);
+    stats_out[3] = nt;
+  }
 
   if (nt <= 1) {
     std::vector<uint64_t> rev;
@@ -799,9 +825,14 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                         do_rev ? rev.data() : nullptr,
                         do_rev ? rev_worst.data() : nullptr,
                         out_cand_provider, out_cand_cost);
+    if (st) {
+      stats_out[0] = now_ns() - t0;
+      t0 = now_ns();
+    }
     if (do_rev) {
       scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, rev.data(),
                             out_cand_provider, out_cand_cost);
+      if (st) stats_out[2] = now_ns() - t0;
     }
     return;
   }
@@ -828,6 +859,10 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                         w_priority, pre, do_rev ? reverse_r : 0, rev, worst,
                         out_cand_provider, out_cand_cost);
   });
+  if (st) {
+    stats_out[0] = now_ns() - t0;
+    t0 = now_ns();
+  }
   if (do_rev) {
     // deterministic reduction: per provider, the r smallest keys of the
     // union of all chunks' best-r sets == the global best-r set
@@ -845,8 +880,13 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
       std::memcpy(merged.data() + static_cast<size_t>(p) * reverse_r,
                   tmp.data(), static_cast<size_t>(reverse_r) * 8);
     }
+    if (st) {
+      stats_out[1] = now_ns() - t0;
+      t0 = now_ns();
+    }
     scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, merged.data(),
                           out_cand_provider, out_cand_cost);
+    if (st) stats_out[2] = now_ns() - t0;
   }
 }
 
@@ -866,16 +906,19 @@ void fused_topk_candidates(const ProviderFeatures* pf,
 // Multi-threaded fused pass (engine=native-mt): contiguous task chunks in
 // parallel + a deterministic reverse-edge merge. threads <= 0 means "all
 // hardware threads". Output is bit-identical for every thread count.
+// stats_out (nullable, kEngineStatsSlots i64): [0] fused-pass ns,
+// [1] reverse-merge ns, [2] scatter ns, [3] threads used.
 void fused_topk_candidates_mt(const ProviderFeatures* pf,
                               const RequirementFeatures* rf, int32_t P,
                               int32_t T, int32_t K, int32_t W, int32_t k,
                               float w_price, float w_load, float w_proximity,
                               float w_priority, int32_t* out_cand_provider,
                               float* out_cand_cost, int32_t reverse_r,
-                              int32_t extra, int32_t threads) {
+                              int32_t extra, int32_t threads,
+                              int64_t* stats_out) {
   fused_topk_impl(pf, rf, P, T, K, W, k, w_price, w_load, w_proximity,
                   w_priority, out_cand_provider, out_cand_cost, reverse_r,
-                  extra, threads);
+                  extra, threads, stats_out);
 }
 
 // Gauss-Seidel auction on candidate lists with eps-scaling.
@@ -1055,6 +1098,12 @@ int32_t auction_sparse(const int32_t* cand_provider, const float* cand_cost,
 //             warm callers pass the rows whose costs they touched and
 //             the repair skips the rest of the [T x K] scan. null scans
 //             everything (cold calls / callers without churn tracking).
+// stats_out: nullable, kEngineStatsSlots i64 slots —
+//   [0] bidding rounds   [1] bids placed     [2] seats evicted (repair)
+//   [3] repair passes that evicted >= 1 seat [4] eps phases
+//   [5] repair ns        [6] bid ns          [7] merge ns
+//   [8] cleanup ns       [9] tasks retired at exit
+// Accumulated on the calling thread only; null skips every clock read.
 // Returns the number of assigned tasks.
 int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
                           int32_t P, int32_t T, int32_t K, float eps_start,
@@ -1062,7 +1111,11 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
                           int32_t threads, float* price_io, uint8_t* retired_io,
                           const int32_t* p4t_seed, int32_t max_release,
                           const uint8_t* repair_mask,
-                          int32_t* out_provider_for_task) {
+                          int32_t* out_provider_for_task,
+                          int64_t* stats_out) {
+  const bool st = stats_out != nullptr;
+  if (st) std::memset(stats_out, 0, kEngineStatsSlots * 8);
+  int64_t t_phase = 0;
   std::vector<float> price(price_io, price_io + P);
   std::vector<int32_t> owner(P, -1);
   std::vector<int32_t> p4t(T, -1);
@@ -1139,6 +1192,10 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
     // eps-CS repair (parallel mark, sequential apply): holders whose seat
     // violates the phase eps re-enter the auction — keeps happy holders
     // seated, evicts stale warm seeds. No-op on a cold start.
+    if (st) {
+      ++stats_out[4];
+      t_phase = now_ns();
+    }
     par_for(T, [&](int32_t lo, int32_t hi) {
       for (int32_t t = lo; t < hi; ++t) {
         release[t] = 0;
@@ -1177,22 +1234,31 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
           release[rel_list[i]] = 0;
       }
     }
+    bool released_any = false;
     for (int32_t t = 0; t < T; ++t) {
       if (release[t]) {
+        if (st) ++stats_out[2];
+        released_any = true;
         owner[p4t[t]] = -1;
         p4t[t] = -1;
       }
     }
+    if (st && released_any) ++stats_out[3];
     open.clear();
     for (int32_t t = 0; t < T; ++t) {
       if (p4t[t] < 0 && !retired[t]) open.push_back(t);
     }
+    if (st) stats_out[5] += now_ns() - t_phase;
 
     // synchronous bidding rounds: all open tasks bid against the same
     // price snapshot; one winner per provider (highest increment, ties to
     // the lowest task index) — a pure function of the round state.
     while (!open.empty() && events < phase_budget && events < max_events) {
       const int32_t n_open = static_cast<int32_t>(open.size());
+      if (st) {
+        ++stats_out[0];
+        t_phase = now_ns();
+      }
       par_for(n_open, [&](int32_t lo, int32_t hi) {
         for (int32_t i = lo; i < hi; ++i) {
           const int32_t t = open[i];
@@ -1222,11 +1288,16 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
           }
         }
       });
+      if (st) {
+        stats_out[6] += now_ns() - t_phase;
+        t_phase = now_ns();
+      }
       // deterministic sequential merge
       touched.clear();
       for (int32_t i = 0; i < n_open; ++i) {
         const int32_t t = open[i];
         const int32_t p = bid_p[i];
+        if (st && p >= 0) ++stats_out[1];
         if (p == -2) {
           retired[t] = 1;
           continue;
@@ -1265,12 +1336,14 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
         if (bid_p[i] >= 0 && p4t[t] < 0) next_open.push_back(t);
       }
       open.swap(next_open);
+      if (st) stats_out[7] += now_ns() - t_phase;
     }
 
     if (eps <= eps_end || events >= max_events) break;
     eps = std::max(eps * scale, eps_end);
   }
   delete pool;
+  if (st) t_phase = now_ns();
 
   // Cleanup pass (same tail semantics as the Gauss-Seidel engine): a
   // forward auction never lowers prices, so an unfillable tail can strand
@@ -1304,8 +1377,10 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
     // retired): masking by seat here would launder the flag away and
     // re-open the task every warm solve — see the seeding note above
     retired_io[t] = retired[t];
+    if (st && retired[t]) ++stats_out[9];
   }
   std::memcpy(price_io, price.data(), static_cast<size_t>(P) * 4);
+  if (st) stats_out[8] = now_ns() - t_phase;
   return assigned;
 }
 
@@ -1345,11 +1420,18 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
 // (task marginals are exact after every g update by construction). The
 // caller loops the anneal schedule (native.sinkhorn_sparse_anneal), which
 // also gives per-phase wall-clock for free. Returns iterations run.
+// stats_out: nullable, kEngineStatsSlots i64 slots —
+//   [0] iterations   [1] CSR-transpose build ns   [2] f-update ns
+//   [3] g-update ns  [4] marginal-drift check ns  [5] nnz edges
+// Accumulated on the calling thread only; null skips every clock read.
 int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
                            const float* cand_cost, int32_t P, int32_t T,
                            int32_t K, float eps, int32_t max_iters, float tol,
                            int32_t threads, float* f_io, float* g_io,
-                           float* out_err) {
+                           float* out_err, int64_t* stats_out) {
+  const bool st = stats_out != nullptr;
+  if (st) std::memset(stats_out, 0, kEngineStatsSlots * 8);
+  int64_t t_phase = st ? now_ns() : 0;
   const int64_t slots = static_cast<int64_t>(T) * K;
   // CSR transpose: provider-major edge lists in ascending edge order
   // (counting sort with a sequential fill — the fill order is what makes
@@ -1379,6 +1461,10 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
   int64_t np_valid = 0, nt_valid = 0;
   for (int32_t p = 0; p < P; ++p) np_valid += col_ptr[p + 1] > col_ptr[p];
   for (int32_t t = 0; t < T; ++t) nt_valid += col_any[t];
+  if (st) {
+    stats_out[1] = now_ns() - t_phase;
+    stats_out[5] = col_ptr[P];
+  }
   if (np_valid == 0 || nt_valid == 0) {
     if (out_err != nullptr) *out_err = 0.0f;
     return 0;
@@ -1417,6 +1503,7 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
   int stall = 0;
   while (it < max_iters) {
     ++it;
+    if (st) t_phase = now_ns();
     // ---- f (provider/column) update over the CSR transpose
     par_rows(P, [&](int, int32_t lo, int32_t hi) {
       for (int32_t p = lo; p < hi; ++p) {
@@ -1439,6 +1526,10 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
         f_io[p] = static_cast<float>(deps * (log_a - (mx + std::log(s))));
       }
     });
+    if (st) {
+      stats_out[2] += now_ns() - t_phase;
+      t_phase = now_ns();
+    }
     // ---- g (task/row) update over the [T, K] slot layout
     par_rows(T, [&](int, int32_t lo, int32_t hi) {
       for (int32_t t = lo; t < hi; ++t) {
@@ -1468,6 +1559,10 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
         g_io[t] = static_cast<float>(deps * (log_b - (mx + std::log(s))));
       }
     });
+    if (st) {
+      stats_out[3] += now_ns() - t_phase;
+      t_phase = now_ns();
+    }
     // ---- provider-marginal drift (task marginals are exact after g):
     // per-thread maxima merged by max — order-independent, deterministic
     for (int i = 0; i < nt; ++i) err_tid[i] = 0.0;
@@ -1490,6 +1585,7 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
     });
     err = 0.0;
     for (int i = 0; i < nt; ++i) err = std::max(err, err_tid[i]);
+    if (st) stats_out[4] += now_ns() - t_phase;
     if (err <= static_cast<double>(tol)) break;
     // Stagnation exit: on a candidate support whose uniform marginals are
     // INFEASIBLE (a provider pocket that cannot absorb its share — common
@@ -1509,6 +1605,7 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
   }
   delete pool;
   if (out_err != nullptr) *out_err = static_cast<float>(err);
+  if (st) stats_out[0] = it;
   return it;
 }
 

@@ -198,8 +198,12 @@ def load() -> ctypes.CDLL:
         i32p, f32p, ctypes.c_int32, ctypes.c_int32,
     ]
     lib.fused_topk_candidates.restype = None
+    # the -mt variants take a trailing nullable EngineStats pointer
+    # (ENGINE_STATS_SLOTS i64 slots — the observability plane's native
+    # layer; see the per-kernel slot tables in assign_engine.cpp)
     lib.fused_topk_candidates_mt.argtypes = (
-        lib.fused_topk_candidates.argtypes + [ctypes.c_int32]
+        lib.fused_topk_candidates.argtypes
+        + [ctypes.c_int32, ctypes.c_void_p]
     )
     lib.fused_topk_candidates_mt.restype = None
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
@@ -207,17 +211,65 @@ def load() -> ctypes.CDLL:
         i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64,
         ctypes.c_int32, f32p, u8p, ctypes.c_void_p, ctypes.c_int32,
-        ctypes.c_void_p, i32p,
+        ctypes.c_void_p, i32p, ctypes.c_void_p,
     ]
     lib.auction_sparse_mt.restype = ctypes.c_int32
     lib.sinkhorn_sparse_mt.argtypes = [
         i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_float, ctypes.c_int32, ctypes.c_float, ctypes.c_int32,
-        f32p, f32p, ctypes.POINTER(ctypes.c_float),
+        f32p, f32p, ctypes.POINTER(ctypes.c_float), ctypes.c_void_p,
     ]
     lib.sinkhorn_sparse_mt.restype = ctypes.c_int32
     _libs[variant] = lib
     return lib
+
+
+# --------------- engine phase stats (observability plane) ---------------
+
+# must match kEngineStatsSlots in assign_engine.cpp
+ENGINE_STATS_SLOTS = 16
+
+# per-kernel slot layouts: name -> slot index; *_ns slots are converted
+# to *_ms float keys by _parse_stats
+_FUSED_STATS = {
+    "gen_fused_ns": 0, "gen_rev_merge_ns": 1, "gen_scatter_ns": 2,
+    "gen_threads": 3,
+}
+_AUCTION_STATS = {
+    "rounds": 0, "bids": 1, "evicted": 2, "repair_passes": 3,
+    "eps_phases": 4, "repair_ns": 5, "bid_ns": 6, "merge_ns": 7,
+    "cleanup_ns": 8, "retired": 9,
+}
+_SINKHORN_STATS = {
+    "sink_iters": 0, "sink_csr_ns": 1, "sink_f_ns": 2, "sink_g_ns": 3,
+    "sink_err_ns": 4, "sink_nnz": 5,
+}
+
+
+def _stats_buf(stats) -> tuple:
+    """(ndarray or None, ctypes pointer or None) for a stats dict."""
+    if stats is None:
+        return None, None
+    buf = np.zeros(ENGINE_STATS_SLOTS, np.int64)
+    return buf, buf.ctypes.data_as(ctypes.c_void_p)
+
+
+def _parse_stats(stats: dict, buf, layout: dict) -> None:
+    """Fold a filled slot buffer into the caller's dict: ``*_ns`` slots
+    become ``*_ms`` floats (rounded to µs), counters stay ints. Repeat
+    calls into the same dict ACCUMULATE (the arena's delta passes run
+    the fused kernel more than once per solve)."""
+    if buf is None:
+        return
+    for name, slot in layout.items():
+        v = int(buf[slot])
+        if name.endswith("_ns"):
+            key = name[:-3] + "_ms"
+            stats[key] = round(stats.get(key, 0.0) + v / 1e6, 3)
+        elif name.endswith("_threads"):
+            stats[name] = v  # a setting, not a counter: last write wins
+        else:
+            stats[name] = stats.get(name, 0) + v
 
 
 def available() -> bool:
@@ -255,6 +307,7 @@ def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
 def fused_topk_candidates(
     providers, requirements, weights=None, k: int = 64,
     reverse_r: int = 8, extra: int = 16, threads: Optional[int] = None,
+    stats: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Fused cost + per-task top-k straight from encoded features — the
     degraded-mode twin of ops.sparse.candidates_topk_bidir (same jitter)
@@ -273,6 +326,14 @@ def fused_topk_candidates(
     routes through the multi-threaded engine (0 = all hardware threads),
     whose output is bit-identical for every thread count (contiguous task
     chunks + a deterministic reverse-edge merge).
+
+    ``stats``: optional dict the call fills with engine phase stats
+    (``gen_fused_ms`` / ``gen_rev_merge_ms`` / ``gen_scatter_ms`` /
+    ``gen_threads``). Stats never feed solver state — results are
+    bit-identical with or without them. Requesting stats routes through
+    the -mt engine (at ``threads=1`` when none was asked for, which is
+    bit-compatible with the single-threaded pass by the determinism
+    contract).
     """
     lib = load()
     if weights is None:
@@ -329,10 +390,15 @@ def fused_topk_candidates(
         float(weights.proximity), float(weights.priority),
         cand_p, cand_c, reverse_r, extra,
     )
-    if threads is None:
+    if threads is None and stats is None:
         lib.fused_topk_candidates(*args)
     else:
-        lib.fused_topk_candidates_mt(*args, int(threads))
+        buf, ptr = _stats_buf(stats)
+        lib.fused_topk_candidates_mt(
+            *args, int(1 if threads is None else threads), ptr
+        )
+        if stats is not None:
+            _parse_stats(stats, buf, _FUSED_STATS)
     return cand_p, cand_c
 
 
@@ -371,6 +437,7 @@ def auction_sparse_mt(
     seed_provider_for_task: Optional[np.ndarray] = None,
     max_release: int = 0,
     repair_mask: Optional[np.ndarray] = None,
+    stats: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic parallel auction (engine=native-mt): synchronous
     Jacobi bidding rounds — per-thread bid buffers against a shared price
@@ -398,6 +465,11 @@ def auction_sparse_mt(
     candidate costs the caller changed since the last converged solve —
     sound because prices are monotone (see the engine comment); None
     scans every row.
+
+    ``stats``: optional dict filled with engine phase stats (``rounds``,
+    ``bids``, ``evicted``, ``repair_passes``, ``eps_phases``,
+    ``retired``, and ``repair_ms``/``bid_ms``/``merge_ms``/
+    ``cleanup_ms`` phase walls). Stats never feed solver state.
 
     Returns (provider_for_task [T] i32, price [P] f32, retired [T] bool).
     """
@@ -445,11 +517,15 @@ def auction_sparse_mt(
             )
         mask_ptr = mask_arr.ctypes.data_as(ctypes.c_void_p)
     out = np.empty(T, np.int32)
+    buf, stats_ptr = _stats_buf(stats)
     lib.auction_sparse_mt(
         cand_p, cand_c, num_providers, T, K,
         eps_start, eps_end, scale, max_events, int(threads),
         price_io, retired_io, seed_ptr, int(max_release), mask_ptr, out,
+        stats_ptr,
     )
+    if stats is not None:
+        _parse_stats(stats, buf, _AUCTION_STATS)
     return out, price_io, retired_io.astype(bool)
 
 
@@ -463,6 +539,7 @@ def sinkhorn_sparse_mt(
     threads: int = 0,
     f: Optional[np.ndarray] = None,
     g: Optional[np.ndarray] = None,
+    stats: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray, int, float]:
     """One eps phase of the sparse multi-threaded Sinkhorn engine
     (engine=sinkhorn-mt): log-domain entropic OT restricted to the top-K
@@ -506,11 +583,14 @@ def sinkhorn_sparse_mt(
     if g_io.shape[0] != T:
         raise ValueError(f"g has {g_io.shape[0]} rows, want {T}")
     err = ctypes.c_float(0.0)
+    buf, stats_ptr = _stats_buf(stats)
     iters = lib.sinkhorn_sparse_mt(
         cand_p, cand_c, num_providers, T, K,
         float(eps), int(max_iters), float(tol), int(threads),
-        f_io, g_io, ctypes.byref(err),
+        f_io, g_io, ctypes.byref(err), stats_ptr,
     )
+    if stats is not None:
+        _parse_stats(stats, buf, _SINKHORN_STATS)
     return f_io, g_io, int(iters), float(err.value)
 
 
@@ -527,6 +607,7 @@ def sinkhorn_sparse_anneal(
     f: Optional[np.ndarray] = None,
     g: Optional[np.ndarray] = None,
     phase_stats: Optional[list] = None,
+    stats: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Epsilon-annealing ladder over :func:`sinkhorn_sparse_mt`: geometric
     eps descent (eps_start -> eps_end by ``scale``) with the dual
@@ -568,7 +649,7 @@ def sinkhorn_sparse_anneal(
         f, g, iters, err = sinkhorn_sparse_mt(
             cand_provider, cand_cost, num_providers,
             eps=eps, max_iters=iters_per_phase, tol=tol, threads=threads,
-            f=f, g=g,
+            f=f, g=g, stats=stats,
         )
         if phase_stats is not None:
             phase_stats.append({

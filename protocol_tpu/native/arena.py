@@ -33,11 +33,13 @@ ratchet.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
 
-from protocol_tpu import native
+from protocol_tpu import native, obs
+from protocol_tpu.obs.spans import TRACER as _tracer
 
 # canonical dtypes per encoded field (mirrors native.fused_topk_candidates'
 # coercions so comparing cached vs incoming columns is exact)
@@ -229,6 +231,7 @@ class NativeSolveArena:
         retired: Optional[np.ndarray] = None,
         seed: Optional[np.ndarray] = None,
         max_release: int = 0,
+        eng: Optional[dict] = None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """The sinkhorn engine's solve stage over the CURRENT cached
         candidate structure: entropic potentials (cold: the full anneal
@@ -261,7 +264,7 @@ class NativeSolveArena:
                 self._cand_p, self._cand_c, P,
                 eps=self.sink_eps_end, max_iters=self.sink_iters,
                 tol=self.sink_tol, threads=self.threads,
-                f=self._f, g=self._g,
+                f=self._f, g=self._g, stats=eng,
             )
             phase_stats.append({
                 "eps": self.sink_eps_end, "iters": iters,
@@ -273,7 +276,7 @@ class NativeSolveArena:
                 eps_start=self.sink_eps_start, eps_end=self.sink_eps_end,
                 scale=self.sink_scale, iters_per_phase=self.sink_iters,
                 tol=self.sink_tol, threads=self.threads,
-                phase_stats=phase_stats,
+                phase_stats=phase_stats, stats=eng,
             )
         self._f, self._g = f, g
         self._sink_stats = {
@@ -295,23 +298,35 @@ class NativeSolveArena:
             threads=self.threads,
             price=price0, retired=retired,
             seed_provider_for_task=seed, max_release=max_release,
+            stats=eng,
         )
 
     def _cold(self, ep, er, weights, pf, rf, P, T) -> np.ndarray:
-        cand_p, cand_c = native.fused_topk_candidates(
-            ep, er, weights, k=self.k, reverse_r=self.reverse_r,
-            extra=self.extra, threads=self.threads,
-        )
-        self._cand_p, self._cand_c = cand_p, cand_c
-        if self.engine == "sinkhorn":
-            self._f = self._g = None
-            p4t, price, retired = self._sinkhorn_round(P, warm=False)
-        else:
-            p4t, price, retired = native.auction_sparse_mt(
-                cand_p, cand_c, num_providers=P,
-                eps_start=self.eps_start, eps_end=self.eps_end,
-                threads=self.threads,
+        # engine phase stats (the obs plane's native layer): one dict
+        # accumulates across every kernel call of this solve; timings
+        # ride NEXT TO the result, never into it
+        eng: Optional[dict] = {} if obs.enabled() else None
+        t0 = time.perf_counter()
+        with _tracer.span("arena.candidates", cold=True, tasks=T):
+            cand_p, cand_c = native.fused_topk_candidates(
+                ep, er, weights, k=self.k, reverse_r=self.reverse_r,
+                extra=self.extra, threads=self.threads, stats=eng,
             )
+        t_gen = time.perf_counter()
+        self._cand_p, self._cand_c = cand_p, cand_c
+        with _tracer.span("arena.engine", engine=self.engine, cold=True):
+            if self.engine == "sinkhorn":
+                self._f = self._g = None
+                p4t, price, retired = self._sinkhorn_round(
+                    P, warm=False, eng=eng
+                )
+            else:
+                p4t, price, retired = native.auction_sparse_mt(
+                    cand_p, cand_c, num_providers=P,
+                    eps_start=self.eps_start, eps_end=self.eps_end,
+                    threads=self.threads, stats=eng,
+                )
+        t_solve = time.perf_counter()
         self._p_fields, self._r_fields = pf, rf
         self._weights_key = self._wkey(weights)
         self._price, self._retired, self._p4t = price, retired, p4t
@@ -320,12 +335,16 @@ class NativeSolveArena:
         self.last_stats = {
             "cold": True,
             "engine": self.engine,
+            "rows": T,
             "dirty_providers": P,
             "dirty_tasks": T,
             "changed_rows": T,
             "warm_solves_since_cold": 0,
             "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t0) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
             **(self._sink_stats if self.engine == "sinkhorn" else {}),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
         }
         return p4t
 
@@ -422,7 +441,10 @@ class NativeSolveArena:
         """One marketplace solve. ``ep``/``er`` are EncodedProviders /
         EncodedRequirements (numpy- or jax-backed); returns
         provider_for_task [T] i32. ``last_stats`` reports what was
-        recomputed.
+        recomputed (plus, with the obs plane on, ``gen_ms``/``solve_ms``
+        stage walls and flattened ``eng_*`` native engine phase stats —
+        bidding rounds, eviction counts, per-phase ns — which ride
+        OUTCOME frames and the obs report).
 
         Dirty detection compares against the arrays of the PREVIOUS call,
         which the arena holds by reference (copying every feature column
@@ -431,6 +453,10 @@ class NativeSolveArena:
         call's buffers in place (the matcher re-encodes per solve, and
         jax-backed arrays are immutable, so both production paths are
         safe by construction)."""
+        with _tracer.span("arena.solve", engine=self.engine):
+            return self._solve_impl(ep, er, weights)
+
+    def _solve_impl(self, ep, er, weights) -> np.ndarray:
         pf = _canon(ep, _P_SPEC)
         rf = _canon(er, _R_SPEC)
         P = pf["gpu_count"].shape[0]
@@ -475,6 +501,7 @@ class NativeSolveArena:
             self._warm_solves += 1
             self.last_stats = {
                 "cold": False,
+                "rows": T,
                 "dirty_providers": 0,
                 "dirty_tasks": 0,
                 "changed_rows": 0,
@@ -483,6 +510,8 @@ class NativeSolveArena:
             }
             return self._p4t.copy()
 
+        eng: Optional[dict] = {} if obs.enabled() else None
+        t_start = time.perf_counter()
         old_price = self._p_fields["price"]
         old_load = self._p_fields["load"]
         self._p_fields, self._r_fields = pf, rf
@@ -518,7 +547,7 @@ class NativeSolveArena:
             tp, tc = native.fused_topk_candidates(
                 _as_ns(pf, _P_SPEC), sub_er, weights, k=self.k,
                 reverse_r=self.reverse_r, extra=self.extra,
-                threads=self.threads,
+                threads=self.threads, stats=eng,
             )
             self._cand_p[t_idx] = tp
             self._cand_c[t_idx] = tc
@@ -536,7 +565,7 @@ class NativeSolveArena:
             dp_local, dc = native.fused_topk_candidates(
                 sub_ep, _as_ns(rf, _R_SPEC), weights, k=kd,
                 reverse_r=self.reverse_r, extra=self.extra,
-                threads=self.threads,
+                threads=self.threads, stats=eng,
             )
             # local -> global provider ids
             dp = np.where(
@@ -569,6 +598,12 @@ class NativeSolveArena:
                 self._p4t[lost] = -1
                 changed[lost] = True  # unseated: must be free to re-bid
 
+        t_gen = time.perf_counter()
+        _tracer.record_span(
+            "arena.candidates", int(t_start * 1e9),
+            int((t_gen - t_start) * 1e9), cold=False,
+            dirty_providers=n_dp, dirty_tasks=n_dt, base_only=n_base,
+        )
         # ---- solve over the (updated) cached candidate structure:
         # warm dual carry on most ticks, a full dual refresh on schedule
         dual_refresh = (
@@ -582,7 +617,9 @@ class NativeSolveArena:
             # sinkhorn duals are a fixed point recomputed in full every
             # solve, so they cannot ratchet the way auction prices do
             if dual_refresh:
-                p4t, price, retired = self._sinkhorn_round(P, warm=True)
+                p4t, price, retired = self._sinkhorn_round(
+                    P, warm=True, eng=eng
+                )
                 self._dual_age = 0
             else:
                 p4t, price, retired = self._sinkhorn_round(
@@ -590,13 +627,14 @@ class NativeSolveArena:
                     retired=self._retired & ~changed,
                     seed=self._p4t,
                     max_release=self.max_release,
+                    eng=eng,
                 )
                 self._dual_age += 1
         elif dual_refresh:
             p4t, price, retired = native.auction_sparse_mt(
                 self._cand_p, self._cand_c, num_providers=P,
                 eps_start=self.eps_start, eps_end=self.eps_end,
-                threads=self.threads,
+                threads=self.threads, stats=eng,
             )
             self._dual_age = 0
         else:
@@ -610,13 +648,20 @@ class NativeSolveArena:
                 seed_provider_for_task=self._p4t,
                 max_release=self.max_release,
                 repair_mask=repair,
+                stats=eng,
             )
             self._dual_age += 1
+        t_solve = time.perf_counter()
+        _tracer.record_span(
+            "arena.engine", int(t_gen * 1e9),
+            int((t_solve - t_gen) * 1e9), engine=self.engine, cold=False,
+        )
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves += 1
         self.last_stats = {
             "cold": False,
             "engine": self.engine,
+            "rows": T,
             "dual_refresh": dual_refresh,
             "dirty_providers": n_dp,
             "base_only_providers": n_base,
@@ -624,6 +669,9 @@ class NativeSolveArena:
             "changed_rows": int(changed.sum()),
             "warm_solves_since_cold": self._warm_solves,
             "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t_start) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
             **(self._sink_stats if self.engine == "sinkhorn" else {}),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
         }
         return p4t

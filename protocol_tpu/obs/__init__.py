@@ -1,0 +1,63 @@
+"""Seam-wide observability plane (spans + per-session metrics + report).
+
+Everything the fleet/streaming roadmap items will be measured against
+lives here, spanning all four layers of the scheduler seam:
+
+  * ``obs.spans`` — a deterministic-safe structured span tracer
+    (monotonic-clock ring buffer, explicit counter-allocated IDs, no
+    wall-clock or randomness), propagated across the gRPC seam via
+    request metadata so a client tick stitches into one causal trace.
+  * ``obs.metrics`` — HDR-style latency histograms (true p50/p99/p999,
+    not sums/means) plus the per-session/per-tenant registry: tick
+    latency, assigned fraction, arena reuse ratio, EngineThreadBudget
+    saturation. The plain-dict snapshot is AUTHORITATIVE; prometheus is
+    an optional export, same degradation contract as ``SeamMetrics``.
+  * ``obs.endpoint`` — one consolidated ``/metrics`` scrape endpoint on
+    the servicer merging SeamMetrics, SessionStore occupancy, and the
+    new arena/budget gauges (503s cleanly when prometheus_client is
+    absent; ``/metrics.json`` serves the authoritative snapshot always).
+  * ``obs.report`` — ``python -m protocol_tpu.obs report <trace>``: a
+    text flame/phase breakdown + per-tick percentile table from any
+    recorded or replayed flight-recorder trace, including the native
+    engine's INTERNAL phases (bidding rounds, eps sweeps, dirty-row
+    repair) that ride OUTCOME frames.
+
+Determinism contract: instrumentation reads monotonic clocks and
+appends to ring buffers — it never feeds solver state, so the
+replay-identity gate passes bit-for-bit with tracing enabled (CI proves
+it, the obs-overhead gate bounds its cost). ``PROTOCOL_TPU_OBS=0``
+turns the whole plane off.
+"""
+
+from __future__ import annotations
+
+import os
+
+from protocol_tpu.obs import spans
+from protocol_tpu.obs.metrics import LatencyHistogram, ObsRegistry
+from protocol_tpu.obs.spans import SpanTracer, tracer
+
+__all__ = [
+    "LatencyHistogram", "ObsRegistry", "SpanTracer", "enabled",
+    "set_enabled", "spans", "tracer",
+]
+
+# the ONE owner of the PROTOCOL_TPU_OBS flag: the tracer's enabled bit
+# is derived from this parse (set_enabled keeps them in lockstep)
+_ENABLED = os.environ.get("PROTOCOL_TPU_OBS", "1").strip().lower() not in (
+    "0", "off", "false", "no",
+)
+spans.TRACER.enabled = _ENABLED
+
+
+def enabled() -> bool:
+    """Whether the observability plane is on (default yes; the
+    obs-overhead CI gate bounds its cost to a few percent)."""
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> None:
+    """Toggle the plane at runtime (the overhead gate's A/B switch)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+    spans.TRACER.enabled = bool(flag)

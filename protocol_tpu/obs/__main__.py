@@ -1,0 +1,44 @@
+"""Observability CLI: ``python -m protocol_tpu.obs <verb>``.
+
+  report   text flame/phase breakdown + per-tick percentile table from a
+           flight-recorder trace (--json for the structured form)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _cmd_report(args) -> int:
+    from protocol_tpu.obs.report import render, report_dict
+
+    if args.json:
+        print(json.dumps(report_dict(args.trace), indent=1))
+    else:
+        print(render(args.trace))
+    return 0
+
+
+def main(argv=None) -> int:
+    # report reads frames only, but the trace codec imports the wire
+    # module; keep any ambient accelerator plugin out of the way
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(prog="python -m protocol_tpu.obs")
+    sub = ap.add_subparsers(dest="verb", required=True)
+
+    rp = sub.add_parser(
+        "report", help="flame/phase report from a trace file"
+    )
+    rp.add_argument("trace")
+    rp.add_argument("--json", action="store_true")
+    rp.set_defaults(fn=_cmd_report)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Consolidated ``/metrics`` scrape endpoint for the scheduler servicer.
+
+One HTTP listener merges every prometheus-renderable source on the
+servicer — the existing ``SeamMetrics`` registry, the per-session
+:class:`~protocol_tpu.obs.metrics.ObsRegistry` (which folds in
+SessionStore occupancy and EngineThreadBudget gauges at scrape time) —
+into a single text exposition, so one Prometheus scrape job covers the
+whole seam.
+
+Degradation contract (same as SeamMetrics): without prometheus_client
+the sources still MEASURE (their dict snapshots stay authoritative and
+ride ``/metrics.json`` + the Health RPC); only the prometheus text
+endpoint degrades, answering **503** with a plain-text pointer instead
+of crashing or half-rendering.
+
+Routes::
+
+    /metrics       prometheus text (200) | 503 when prometheus is absent
+    /metrics.json  the authoritative dict snapshots (always 200)
+    /healthz       liveness probe
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from protocol_tpu.obs.metrics import prometheus_available
+
+
+class MetricsEndpoint:
+    """Daemon-threaded scrape server over a set of metric sources.
+
+    ``prom_sources``: objects with ``render() -> bytes`` (prometheus
+    text; may raise ImportError when prometheus_client is absent).
+    ``json_sources``: name -> object with ``snapshot() -> dict``.
+    """
+
+    def __init__(
+        self,
+        prom_sources: Optional[list] = None,
+        json_sources: Optional[dict] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.prom_sources = list(prom_sources or [])
+        self.json_sources = dict(json_sources or {})
+        endpoint = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: scrapes are periodic
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    if not prometheus_available():
+                        self._send(
+                            503,
+                            b"prometheus_client is not installed; the "
+                            b"authoritative snapshot is at /metrics.json\n",
+                            "text/plain; charset=utf-8",
+                        )
+                        return
+                    chunks = []
+                    for src in endpoint.prom_sources:
+                        try:
+                            chunks.append(src.render())
+                        except ImportError:  # pragma: no cover
+                            continue
+                    self._send(
+                        200, b"".join(chunks),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif path == "/metrics.json":
+                    body = json.dumps(
+                        {
+                            name: src.snapshot()
+                            for name, src in endpoint.json_sources.items()
+                        },
+                        sort_keys=True,
+                    ).encode()
+                    self._send(200, body, "application/json")
+                elif path == "/healthz":
+                    self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_for_servicer(
+    servicer, host: str = "127.0.0.1", port: int = 0
+) -> MetricsEndpoint:
+    """Wire a servicer's seam + obs registries into one endpoint."""
+    prom = []
+    if getattr(servicer.seam, "registry", None) is not None:
+        prom.append(servicer.seam)
+    prom.append(servicer.obs)
+    return MetricsEndpoint(
+        prom_sources=prom,
+        json_sources={"seam": servicer.seam, "obs": servicer.obs},
+        host=host,
+        port=port,
+    )

@@ -1,0 +1,376 @@
+"""Per-session/per-tenant seam metrics: HDR-style latency histograms and
+the registry behind the consolidated ``/metrics`` scrape.
+
+``SeamMetrics`` (utils/metrics.py) keeps per-phase SUMS and COUNTS —
+enough for a mean, useless for the latency distributions every ROADMAP
+frontier is gated on (p50/p99 tick latency at hundreds of concurrent
+sessions, per-event p99 µs). :class:`LatencyHistogram` fixes that with
+an HdrHistogram-style log2 bucket layout (16 linear sub-buckets per
+power of two => <= ~6% relative quantile error across nine decades,
+O(1) record, a few KB per histogram) — true p50/p99/p999 without
+storing samples.
+
+:class:`ObsRegistry` keys histograms + gauges per session (tenant =
+the session-id prefix before ``@``), tracking per tick: latency,
+assigned fraction, arena reuse ratio (fraction of candidate rows NOT
+recomputed — the warm-path health number), delta rows, and
+EngineThreadBudget saturation. The plain-dict :meth:`snapshot` is
+AUTHORITATIVE — prometheus is an optional render-time export with the
+same degradation contract as SeamMetrics (no prometheus_client => the
+registry still measures, only the scrape endpoint 503s).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+try:
+    from prometheus_client import CollectorRegistry, Gauge, generate_latest
+except ImportError:  # pragma: no cover - minimal envs
+    CollectorRegistry = Gauge = None
+
+    def generate_latest(registry):
+        raise ImportError("prometheus_client is not installed")
+
+
+def prometheus_available() -> bool:
+    return CollectorRegistry is not None
+
+
+_SUB = 16  # linear sub-buckets per power of two
+
+
+class LatencyHistogram:
+    """HDR-style histogram over nanoseconds.
+
+    Bucket index = (exponent, linear sub-bucket of the mantissa): values
+    are first scaled by ``lowest_ns`` (everything below lands in bucket
+    0), then ``frexp`` splits off the power of two and the mantissa's
+    top bits pick one of 16 linear sub-buckets — so relative error is
+    bounded by 1/16 at every magnitude, unlike fixed linear buckets.
+    Quantiles come back as the sub-bucket midpoint."""
+
+    __slots__ = ("lowest_ns", "_counts", "count", "sum_ns", "max_ns")
+
+    def __init__(self, lowest_ns: float = 1000.0, decades: int = 9):
+        # default resolution floor 1 µs, range ~1 µs .. ~18 min
+        self.lowest_ns = float(lowest_ns)
+        n_buckets = int(decades * math.log2(10)) * _SUB + _SUB
+        self._counts = [0] * n_buckets
+        self.count = 0
+        self.sum_ns = 0.0
+        self.max_ns = 0.0
+
+    def _index(self, ns: float) -> int:
+        v = ns / self.lowest_ns
+        if v < 1.0:
+            return 0
+        m, e = math.frexp(v)  # v = m * 2**e, 0.5 <= m < 1
+        idx = (e - 1) * _SUB + int((m - 0.5) * 2 * _SUB)
+        return min(idx, len(self._counts) - 1)
+
+    def _value(self, idx: int) -> float:
+        # inverse of _index: bucket (e, sub) covers
+        # [2^e * (1 + sub/16), 2^e * (1 + (sub+1)/16)) * lowest_ns;
+        # report the midpoint
+        e, sub = divmod(idx, _SUB)
+        return self.lowest_ns * (2.0 ** e) * (1.0 + (sub + 0.5) / _SUB)
+
+    def observe_ns(self, ns: float) -> None:
+        ns = float(ns)
+        self._counts[self._index(ns)] += 1
+        self.count += 1
+        self.sum_ns += ns
+        if ns > self.max_ns:
+            self.max_ns = ns
+
+    def observe_ms(self, ms: float) -> None:
+        self.observe_ns(ms * 1e6)
+
+    def quantile_ns(self, q: float) -> float:
+        """Value at quantile ``q`` (0..1); 0.0 on an empty histogram."""
+        if self.count == 0:
+            return 0.0
+        # rank per the HdrHistogram convention: ceil(q * count), clamped
+        rank = max(1, min(self.count, math.ceil(q * self.count)))
+        seen = 0
+        for idx, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                # bucket midpoints can overshoot the true sample; cap at
+                # the recorded max so no quantile ever exceeds max_ms
+                # (the HdrHistogram convention)
+                return min(self._value(idx), self.max_ns)
+        return self.max_ns  # pragma: no cover - unreachable
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        if other.lowest_ns != self.lowest_ns or (
+            len(other._counts) != len(self._counts)
+        ):
+            raise ValueError("histogram layouts differ")
+        for i, c in enumerate(other._counts):
+            self._counts[i] += c
+        self.count += other.count
+        self.sum_ns += other.sum_ns
+        self.max_ns = max(self.max_ns, other.max_ns)
+
+    def snapshot_ms(self) -> dict:
+        """{count, mean, p50, p90, p99, p999, max} in milliseconds."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean_ms": round(self.sum_ns / self.count / 1e6, 3),
+            "p50_ms": round(self.quantile_ns(0.50) / 1e6, 3),
+            "p90_ms": round(self.quantile_ns(0.90) / 1e6, 3),
+            "p99_ms": round(self.quantile_ns(0.99) / 1e6, 3),
+            "p999_ms": round(self.quantile_ns(0.999) / 1e6, 3),
+            "max_ms": round(self.max_ns / 1e6, 3),
+        }
+
+
+def percentiles_ms(walls_ms) -> dict:
+    """One-shot helper for bench emitters: feed a list of wall-clock ms
+    through a histogram and return its snapshot (p50/p99/... keys)."""
+    h = LatencyHistogram()
+    for w in walls_ms:
+        h.observe_ms(float(w))
+    return h.snapshot_ms()
+
+
+def tenant_of(session_id: str) -> str:
+    """Tenant key of a session id: the prefix before ``@`` (sessions are
+    free-form ids today; the fleet roadmap will mint ``tenant@pool``
+    ids, and the registry is already keyed for it)."""
+    head, sep, _ = (session_id or "").partition("@")
+    return head if sep else (session_id or "unknown")
+
+
+class _SessionObs:
+    __slots__ = (
+        "ticks", "cold_ticks", "assigned_frac", "min_assigned_frac",
+        "rows_total", "rows_changed", "delta_rows",
+    )
+
+    def __init__(self):
+        self.ticks = LatencyHistogram()
+        self.cold_ticks = LatencyHistogram()
+        self.assigned_frac = 0.0
+        self.min_assigned_frac = 1.0
+        self.rows_total = 0
+        self.rows_changed = 0
+        self.delta_rows = 0
+
+    def reuse_ratio(self) -> float:
+        """Fraction of candidate rows the warm path did NOT recompute."""
+        if self.rows_total == 0:
+            return 0.0
+        return min(1.0, max(0.0, 1.0 - self.rows_changed / self.rows_total))
+
+
+class ObsRegistry:
+    """Per-session/per-tenant metrics + scrape-time gauges.
+
+    The dict :meth:`snapshot` is authoritative and always available;
+    :meth:`render` produces prometheus text when prometheus_client is
+    installed (gauges rebuilt from the snapshot at scrape time, the
+    sync_service.rs store->registry pattern) and raises ImportError
+    otherwise — the endpoint turns that into a clean 503."""
+
+    def __init__(self, role: str = "server", max_sessions: int = 512):
+        self.role = role
+        # LRU-bounded: session ids are client-minted (often per-process
+        # uuids) and the SessionStore evicts without telling the
+        # registry, so an unbounded dict would grow one _SessionObs
+        # (two histograms) per uuid ever seen AND explode prometheus
+        # label cardinality on every scrape. Recency-evicted at
+        # ``max_sessions`` instead.
+        self.max_sessions = int(max_sessions)
+        self._lock = threading.Lock()
+        self._sessions: OrderedDict[str, _SessionObs] = OrderedDict()
+        # scrape-time sources attached by the servicer
+        self._budget = None  # EngineThreadBudget
+        self._store = None  # SessionStore
+        self._registry = None
+
+    def attach(self, budget=None, store=None) -> None:
+        if budget is not None:
+            self._budget = budget
+        if store is not None:
+            self._store = store
+
+    # ---------------- recording ----------------
+
+    def _session(self, session_id: str) -> _SessionObs:
+        s = self._sessions.get(session_id)
+        if s is None:
+            s = self._sessions[session_id] = _SessionObs()
+            while len(self._sessions) > self.max_sessions:
+                self._sessions.popitem(last=False)
+        else:
+            self._sessions.move_to_end(session_id)
+        return s
+
+    def observe_tick(
+        self,
+        session_id: str,
+        wall_ms: float,
+        n_tasks: int,
+        num_assigned: int,
+        arena_stats: Optional[dict] = None,
+        delta_rows: int = 0,
+        cold: Optional[bool] = None,
+    ) -> None:
+        """One solve tick for one session: latency, assigned fraction,
+        and (from the arena's ``last_stats``) the reuse ratio inputs.
+
+        No ``arena_stats`` means a STATELESS kernel (auction/topk/...):
+        every such tick is a full solve — classified cold, and excluded
+        from the reuse ratio (a path with no warm carry must not read
+        as perfectly warm)."""
+        stats = arena_stats or {}
+        if cold is None:
+            cold = bool(stats.get("cold", True)) if stats else True
+        with self._lock:
+            s = self._session(session_id)
+            (s.cold_ticks if cold else s.ticks).observe_ms(wall_ms)
+            if n_tasks > 0:
+                # clamp: the one-to-many "best" kernel counts assigned
+                # PROVIDERS, which can exceed the task count — the gauge
+                # stays a fraction
+                s.assigned_frac = min(1.0, num_assigned / n_tasks)
+                s.min_assigned_frac = min(
+                    s.min_assigned_frac, s.assigned_frac
+                )
+            if stats:
+                # the arena reports row counts over its PADDED (pow2)
+                # batch; mixing them with the real n_tasks would push
+                # the ratio out of [0, 1] on non-pow2 batches
+                rows = int(stats.get("rows", n_tasks))
+                if rows > 0:
+                    s.rows_total += rows
+                    s.rows_changed += int(
+                        stats.get("changed_rows", rows if cold else 0)
+                    )
+            s.delta_rows += int(delta_rows)
+
+    def forget(self, session_id: str) -> None:
+        """Drop one session's metrics (optional — the LRU cap already
+        bounds the registry; use when a tenant's history must go now)."""
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    # ---------------- export ----------------
+
+    def snapshot(self) -> dict:
+        """Authoritative nested snapshot: per-session histograms +
+        fleet-level gauges. Works with or without prometheus."""
+        with self._lock:
+            sessions = {
+                sid: {
+                    "tenant": tenant_of(sid),
+                    "tick": s.ticks.snapshot_ms(),
+                    "cold_tick": s.cold_ticks.snapshot_ms(),
+                    "assigned_frac": round(s.assigned_frac, 4),
+                    "min_assigned_frac": round(s.min_assigned_frac, 4),
+                    "arena_reuse_ratio": round(s.reuse_ratio(), 4),
+                    "delta_rows": s.delta_rows,
+                }
+                for sid, s in self._sessions.items()
+            }
+        out: dict = {"role": self.role, "sessions": sessions}
+        budget = self._budget
+        if budget is not None:
+            avail = budget.available
+            out["budget"] = {
+                "total": budget.total,
+                "available": avail,
+                "saturation": round(
+                    1.0 - max(avail, 0) / max(budget.total, 1), 4
+                ),
+                "grants": getattr(budget, "grants", 0),
+                "degraded_grants": getattr(budget, "degraded_grants", 0),
+                "min_avail": getattr(budget, "min_avail", avail),
+            }
+        store = self._store
+        if store is not None:
+            out["session_store"] = {
+                "active": len(store),
+                "max_sessions": store.max_sessions,
+                "evictions": store.evictions,
+                "expirations": store.expirations,
+            }
+        return out
+
+    def render(self) -> bytes:
+        """Prometheus text exposition, rebuilt from the snapshot at
+        scrape time. Raises ImportError when prometheus_client is
+        absent (the endpoint's 503 path)."""
+        if CollectorRegistry is None:
+            raise ImportError("prometheus_client is not installed")
+        reg = CollectorRegistry()
+        role = self.role
+        g_tick = Gauge(
+            "scheduler_obs_tick_latency_ms",
+            "Per-session tick latency quantiles (warm ticks)",
+            ["role", "session", "tenant", "quantile"],
+            registry=reg,
+        )
+        g_ticks = Gauge(
+            "scheduler_obs_ticks_total",
+            "Warm ticks observed per session",
+            ["role", "session", "tenant"],
+            registry=reg,
+        )
+        g_frac = Gauge(
+            "scheduler_obs_assigned_frac",
+            "Assigned fraction at the last tick",
+            ["role", "session", "tenant"],
+            registry=reg,
+        )
+        g_reuse = Gauge(
+            "scheduler_obs_arena_reuse_ratio",
+            "Fraction of candidate rows NOT recomputed (warm health)",
+            ["role", "session", "tenant"],
+            registry=reg,
+        )
+        snap = self.snapshot()
+        for sid, s in snap["sessions"].items():
+            labels = dict(role=role, session=sid, tenant=s["tenant"])
+            tick = s["tick"]
+            if tick.get("count"):
+                for q in ("p50", "p90", "p99", "p999"):
+                    g_tick.labels(**labels, quantile=q).set(
+                        tick[f"{q}_ms"]
+                    )
+                g_ticks.labels(**labels).set(tick["count"])
+            g_frac.labels(**labels).set(s["assigned_frac"])
+            g_reuse.labels(**labels).set(s["arena_reuse_ratio"])
+        if "budget" in snap:
+            b = snap["budget"]
+            g_sat = Gauge(
+                "scheduler_obs_thread_budget_saturation",
+                "EngineThreadBudget in-use fraction", ["role"],
+                registry=reg,
+            )
+            g_sat.labels(role=role).set(b["saturation"])
+            g_deg = Gauge(
+                "scheduler_obs_thread_budget_degraded_grants",
+                "Grants smaller than requested", ["role"], registry=reg,
+            )
+            g_deg.labels(role=role).set(b["degraded_grants"])
+        if "session_store" in snap:
+            st = snap["session_store"]
+            g_occ = Gauge(
+                "scheduler_obs_session_store_occupancy",
+                "SessionStore state", ["role", "state"], registry=reg,
+            )
+            g_occ.labels(role=role, state="active").set(st["active"])
+            g_occ.labels(role=role, state="evictions").set(st["evictions"])
+            g_occ.labels(role=role, state="expirations").set(
+                st["expirations"]
+            )
+        return generate_latest(reg)

@@ -564,8 +564,15 @@ def serve_scheduler(args) -> None:
     """The gRPC kernel backend — the pod that actually holds the TPU."""
     from protocol_tpu.services.scheduler_grpc import serve
 
-    server = serve(address=args.address, max_workers=args.max_workers)
+    server = serve(
+        address=args.address, max_workers=args.max_workers,
+        metrics_port=args.metrics_port,
+    )
     print(f"scheduler backend on {args.address} (version {VERSION})", flush=True)
+    if server.metrics is not None:
+        print(
+            f"obs /metrics on 127.0.0.1:{server.metrics.port}", flush=True
+        )
     server.wait_for_termination()
 
 
@@ -842,6 +849,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     p = sub.add_parser("scheduler")
     p.add_argument("--address", default="0.0.0.0:50061")
     p.add_argument("--max-workers", type=int, default=4)
+    p.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="consolidated /metrics scrape endpoint (obs plane); also "
+             "via PROTOCOL_TPU_METRICS_PORT",
+    )
 
     p = sub.add_parser("ledger-api")
     p.add_argument("--port", type=int, default=8095)

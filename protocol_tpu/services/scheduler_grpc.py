@@ -40,6 +40,8 @@ from typing import NamedTuple, Optional
 import grpc
 import numpy as np
 
+from protocol_tpu.obs.metrics import ObsRegistry
+from protocol_tpu.obs.spans import TRACER as _tracer, span_dicts_compact
 from protocol_tpu.ops.cost import CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
 from protocol_tpu.proto import scheduler_pb2 as pb
@@ -154,6 +156,9 @@ class _SolveOut(NamedTuple):
     t4p: np.ndarray  # [P] i32, -1 = idle
     num_assigned: int
     price: Optional[np.ndarray]  # [P] f32 (sparse/native kernels)
+    # the warm arena's last_stats, COPIED under the arena lock (reading
+    # it later would race the next unary solve) — obs/trace provenance
+    arena_stats: Optional[dict] = None
 
 
 class SchedulerBackendServicer:
@@ -189,6 +194,12 @@ class SchedulerBackendServicer:
             max_sessions=max_sessions, ttl_s=session_ttl_s
         )
         self.seam = SeamMetrics(role="server")
+        # observability plane: per-session tick histograms (true
+        # p50/p99/p999), assigned fraction, arena reuse ratio, plus
+        # budget/store gauges read at scrape time. The dict snapshot is
+        # authoritative; /metrics is wired by serve(metrics_port=...).
+        self.obs = ObsRegistry(role="server")
+        self.obs.attach(budget=self._engine_budget, store=self.sessions)
         # flight recorder (PROTOCOL_TPU_TRACE=<path>): any solve served by
         # this backend records its exact inputs + outcomes — unary calls
         # via the column differ, the session protocol via its own wire
@@ -306,8 +317,11 @@ class SchedulerBackendServicer:
                             ep, er, weights
                         )
                         price_full = self._native_arena.price
+                        arena_stats = dict(self._native_arena.last_stats)
                     finally:
                         self._engine_budget.release(grant)
+            if kernel == "native":
+                arena_stats = None
             p4t = np.asarray(p4t_full)[:T]
             t4p = np.full(P, -1, np.int32)
             seated = np.flatnonzero((p4t >= 0) & (p4t < P))
@@ -315,6 +329,7 @@ class SchedulerBackendServicer:
             return _SolveOut(
                 p4t, t4p, int((p4t >= 0).sum()),
                 np.asarray(price_full)[:P].astype(np.float32),
+                arena_stats,
             )
 
         if kernel == "topk":
@@ -430,49 +445,124 @@ class SchedulerBackendServicer:
             )
         return CostWeights()
 
+    # ---------------- observability helpers ----------------
+
+    def _rpc_span(self, name: str, context, **attrs):
+        """Root span for one RPC, adopting the client's trace context
+        from the ``x-pt-span`` metadata header so a client tick stitches
+        into one causal trace across the seam. Tolerates a None/bare
+        context (tests drive servicer methods directly)."""
+        md = (
+            context.invocation_metadata()
+            if context is not None
+            and hasattr(context, "invocation_metadata")
+            else None
+        )
+        return _tracer.span(
+            name, remote_parent=_tracer.extract(md), **attrs,
+        )
+
+    @staticmethod
+    def _enrich_metrics(
+        base: dict, arena_stats: Optional[dict], mark: int, root,
+    ) -> dict:
+        """Outcome-frame metrics: the base phase numbers plus the
+        arena's scalar stats (incl. the flattened ``eng_*`` native
+        phase stats) and the spans this RPC completed — what the obs
+        report renders offline."""
+        m = dict(base)
+        if arena_stats:
+            for k, v in arena_stats.items():
+                # base keys (the RPC-level decode/solve walls) win over
+                # arena keys of the same name (stage-level walls): the
+                # stage split still rides in gen_ms + the eng_* phases
+                if k not in m and isinstance(v, (int, float, bool, str)):
+                    m[k] = v
+        if root is not None:
+            sp = _tracer.since(mark, trace=root["trace"])
+            if sp:
+                m["trace_id"] = root["trace"]
+                m["spans"] = span_dicts_compact(sp)
+        return m
+
+    def _observe_tick(
+        self,
+        session_id: str,
+        t0: float,
+        n_tasks: int,
+        num_assigned: int,
+        arena_stats: Optional[dict] = None,
+        delta_rows: int = 0,
+    ) -> None:
+        from protocol_tpu import obs
+
+        if not obs.enabled():
+            # PROTOCOL_TPU_OBS=0 turns the WHOLE plane off — per-session
+            # registries included, not just spans and engine stats
+            return
+        self.obs.observe_tick(
+            session_id, (time.perf_counter() - t0) * 1e3, n_tasks,
+            num_assigned, arena_stats=arena_stats, delta_rows=delta_rows,
+        )
+
     # ---------------- v1 unary (frozen contract) ----------------
 
     def Assign(self, request: pb.AssignRequest, context) -> pb.AssignResponse:
+        mark = _tracer.mark()
+        with self._rpc_span("rpc.Assign", context, wire="v1") as root:
+            return self._assign_v1(request, context, mark, root)
+
+    def _assign_v1(
+        self, request: pb.AssignRequest, context, mark: int, root
+    ) -> pb.AssignResponse:
         t0 = time.perf_counter()
-        ep = providers_from_proto(request.providers)
-        er = requirements_from_proto(request.requirements)
+        with _tracer.span("wire.decode", wire="v1"):
+            ep = providers_from_proto(request.providers)
+            er = requirements_from_proto(request.requirements)
         t_dec = time.perf_counter()
         warm = seeds = None
         if len(request.warm_price) or len(request.seed_provider_for_task):
             warm = _np(request.warm_price, np.float32)
             seeds = _np(request.seed_provider_for_task, np.int32)
-        out = self._solve(
-            ep, er, self._weights_of(request), request.kernel or "auction",
-            int(request.top_k), request.eps, int(request.max_iters),
-            warm, seeds, context,
-        )
+        kernel = request.kernel or "auction"
+        with _tracer.span("engine.solve", kernel=kernel):
+            out = self._solve(
+                ep, er, self._weights_of(request), kernel,
+                int(request.top_k), request.eps, int(request.max_iters),
+                warm, seeds, context,
+            )
         t_solve = time.perf_counter()
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
         self.seam.add_bytes("in", request.ByteSize())
-        resp = pb.AssignResponse(
-            provider_for_task=out.p4t.astype(np.int32),
-            task_for_provider=out.t4p.astype(np.int32),
-            num_assigned=out.num_assigned,
-            solve_ms=(time.perf_counter() - t0) * 1e3,
-        )
-        if out.price is not None:
-            resp.price.extend(out.price)
+        with _tracer.span("wire.encode", wire="v1"):
+            resp = pb.AssignResponse(
+                provider_for_task=out.p4t.astype(np.int32),
+                task_for_provider=out.t4p.astype(np.int32),
+                num_assigned=out.num_assigned,
+                solve_ms=(time.perf_counter() - t0) * 1e3,
+            )
+            if out.price is not None:
+                resp.price.extend(out.price)
         self.seam.add_bytes("out", resp.ByteSize())
+        arena_stats = out.arena_stats
+        self._observe_tick(
+            "unary:v1", t0, out.p4t.shape[0], out.num_assigned, arena_stats
+        )
         if self.trace is not None:
             from protocol_tpu.trace.recorder import safe as _trace_safe
 
             _trace_safe(
                 self.trace.record_solve, ep, er, self._weights_of(request),
-                request.kernel or "auction", int(request.top_k),
+                kernel, int(request.top_k),
                 request.eps, int(request.max_iters), out.p4t, out.price,
-                metrics={
+                metrics=self._enrich_metrics({
                     "decode_ms": round((t_dec - t0) * 1e3, 3),
                     "solve_ms": round((t_solve - t_dec) * 1e3, 3),
                     "bytes_in": request.ByteSize(),
                     "bytes_out": resp.ByteSize(),
                     "wire": "v1",
-                },
+                }, arena_stats, mark, root),
             )
         return resp
 
@@ -481,46 +571,61 @@ class SchedulerBackendServicer:
     def AssignV2(
         self, request: pb.AssignRequestV2, context
     ) -> pb.AssignResponseV2:
+        mark = _tracer.mark()
+        with self._rpc_span("rpc.AssignV2", context, wire="v2") as root:
+            return self._assign_v2(request, context, mark, root)
+
+    def _assign_v2(
+        self, request: pb.AssignRequestV2, context, mark: int, root
+    ) -> pb.AssignResponseV2:
         t0 = time.perf_counter()
         try:
-            ep = decode_providers_v2(request.providers)
-            er = decode_requirements_v2(request.requirements)
-            warm = (
-                unblob(request.warm_price, np.float32)
-                if request.HasField("warm_price") else None
-            )
-            seeds = (
-                unblob(request.seed_provider_for_task, np.int32)
-                if request.HasField("seed_provider_for_task") else None
-            )
+            with _tracer.span("wire.decode", wire="v2"):
+                ep = decode_providers_v2(request.providers)
+                er = decode_requirements_v2(request.requirements)
+                warm = (
+                    unblob(request.warm_price, np.float32)
+                    if request.HasField("warm_price") else None
+                )
+                seeds = (
+                    unblob(request.seed_provider_for_task, np.int32)
+                    if request.HasField("seed_provider_for_task") else None
+                )
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t_dec = time.perf_counter()
-        out = self._solve(
-            ep, er, self._weights_of(request), request.kernel or "auction",
-            int(request.top_k), request.eps, int(request.max_iters),
-            warm, seeds, context,
-        )
+        kernel = request.kernel or "auction"
+        with _tracer.span("engine.solve", kernel=kernel):
+            out = self._solve(
+                ep, er, self._weights_of(request), kernel,
+                int(request.top_k), request.eps, int(request.max_iters),
+                warm, seeds, context,
+            )
         t_solve = time.perf_counter()
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
         self.seam.add_bytes("in", request.ByteSize())
-        resp = self._result_v2(out, t0, t_dec - t0)
+        with _tracer.span("wire.encode", wire="v2"):
+            resp = self._result_v2(out, t0, t_dec - t0)
         self.seam.add_bytes("out", resp.ByteSize())
+        arena_stats = out.arena_stats
+        self._observe_tick(
+            "unary:v2", t0, out.p4t.shape[0], out.num_assigned, arena_stats
+        )
         if self.trace is not None:
             from protocol_tpu.trace.recorder import safe as _trace_safe
 
             _trace_safe(
                 self.trace.record_solve, ep, er, self._weights_of(request),
-                request.kernel or "auction", int(request.top_k),
+                kernel, int(request.top_k),
                 request.eps, int(request.max_iters), out.p4t, out.price,
-                metrics={
+                metrics=self._enrich_metrics({
                     "decode_ms": round((t_dec - t0) * 1e3, 3),
                     "solve_ms": round((t_solve - t_dec) * 1e3, 3),
                     "bytes_in": request.ByteSize(),
                     "bytes_out": resp.ByteSize(),
                     "wire": "v2",
-                },
+                }, arena_stats, mark, root),
             )
         return resp
 
@@ -542,11 +647,19 @@ class SchedulerBackendServicer:
     # ---------------- v2 sessions: streamed snapshot + deltas ----------
 
     def OpenSession(self, request_iterator, context) -> pb.OpenSessionResponse:
+        mark = _tracer.mark()
+        with self._rpc_span("rpc.OpenSession", context) as root:
+            return self._open_session(request_iterator, context, mark, root)
+
+    def _open_session(
+        self, request_iterator, context, mark: int, root
+    ) -> pb.OpenSessionResponse:
         t0 = time.perf_counter()
         try:
-            session_id, claimed_fp, req, wire_bytes = assemble_snapshot(
-                request_iterator
-            )
+            with _tracer.span("wire.decode", wire="v2-session"):
+                session_id, claimed_fp, req, wire_bytes = assemble_snapshot(
+                    request_iterator
+                )
         except ValueError as e:
             return pb.OpenSessionResponse(ok=False, error=str(e))
         self.seam.add_bytes("in", wire_bytes)
@@ -600,13 +713,19 @@ class SchedulerBackendServicer:
             budget=self._engine_budget,
         )
         t_dec = time.perf_counter()
-        with session.lock:
-            p4t, t4p, price = session.solve()
+        with _tracer.span("engine.solve", kernel=kernel, cold=True):
+            with session.lock:
+                p4t, t4p, price = session.solve()
+                arena_stats = dict(session.arena.last_stats)
         t_solve = time.perf_counter()
         self.sessions.put(session)
         self.seam.count("session_open")
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
+        self._observe_tick(
+            session.session_id, t0, session.n_tasks,
+            int((p4t >= 0).sum()), arena_stats,
+        )
         if self.trace is not None:
             # flight recorder, session mode: the snapshot frame is the
             # session's own wire message, deltas land from apply_delta
@@ -619,12 +738,12 @@ class SchedulerBackendServicer:
                     session.trace = self.trace
                     self.trace.record_outcome(
                         0, p4t, price,
-                        metrics={
+                        metrics=self._enrich_metrics({
                             "decode_ms": round((t_dec - t0) * 1e3, 3),
                             "solve_ms": round((t_solve - t_dec) * 1e3, 3),
                             "bytes_in": wire_bytes,
                             "wire": "v2-session",
-                        },
+                        }, arena_stats, mark, root),
                         session_id=session.session_id,
                     )
             except Exception:  # pragma: no cover - capture must not fail RPCs
@@ -646,6 +765,17 @@ class SchedulerBackendServicer:
     def AssignDelta(
         self, request: pb.AssignDeltaRequest, context
     ) -> pb.AssignDeltaResponse:
+        mark = _tracer.mark()
+        with self._rpc_span(
+            "rpc.AssignDelta", context,
+            session=request.session_id,
+            tick=int(request.tick),  # lint: unlocked-ok (wire message field, not session state)
+        ) as root:
+            return self._assign_delta(request, context, mark, root)
+
+    def _assign_delta(
+        self, request: pb.AssignDeltaRequest, context, mark: int, root
+    ) -> pb.AssignDeltaResponse:
         t0 = time.perf_counter()
         session, reason = self.sessions.get(
             request.session_id, request.epoch_fingerprint
@@ -656,29 +786,31 @@ class SchedulerBackendServicer:
         self.seam.count("session_hit")
         self.seam.add_bytes("in", request.ByteSize())
         try:
-            prow = (
-                unblob(request.provider_rows, np.int32)
-                if request.HasField("provider_rows")
-                else np.zeros(0, np.int32)
-            )
-            trow = (
-                unblob(request.task_rows, np.int32)
-                if request.HasField("task_rows")
-                else np.zeros(0, np.int32)
-            )
-            p_delta = (
-                canon_columns(
-                    decode_providers_v2(request.providers), P_WIRE_DTYPES
+            with _tracer.span("wire.decode", wire="v2-session"):
+                prow = (
+                    unblob(request.provider_rows, np.int32)
+                    if request.HasField("provider_rows")
+                    else np.zeros(0, np.int32)
                 )
-                if prow.size else {}
-            )
-            r_delta = (
-                canon_columns(
-                    decode_requirements_v2(request.requirements),
-                    R_WIRE_DTYPES,
+                trow = (
+                    unblob(request.task_rows, np.int32)
+                    if request.HasField("task_rows")
+                    else np.zeros(0, np.int32)
                 )
-                if trow.size else {}
-            )
+                p_delta = (
+                    canon_columns(
+                        decode_providers_v2(request.providers),
+                        P_WIRE_DTYPES,
+                    )
+                    if prow.size else {}
+                )
+                r_delta = (
+                    canon_columns(
+                        decode_requirements_v2(request.requirements),
+                        R_WIRE_DTYPES,
+                    )
+                    if trow.size else {}
+                )
         except ValueError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         # decode ends HERE: with sharded session locks and a shared thread
@@ -687,7 +819,10 @@ class SchedulerBackendServicer:
         # point seam tuning at the wrong phase (lock/budget wait + delta
         # apply land in "solve" instead, where the contention actually is)
         t_dec = time.perf_counter()
-        with session.lock:
+        with _tracer.span(
+            "engine.solve", kernel=session.kernel,
+            delta_rows=int(prow.size + trow.size),
+        ), session.lock:
             if session.evicted:
                 # lost the race with LRU/TTL eviction (or a same-id
                 # re-open) between the store lookup and this lock: refuse
@@ -713,6 +848,7 @@ class SchedulerBackendServicer:
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             p4t_out, t4p, price = session.solve()
+            arena_stats = dict(session.arena.last_stats)
             session.tick += 1
             if session.evicted:
                 # eviction landed DURING the solve (the store flags
@@ -734,7 +870,7 @@ class SchedulerBackendServicer:
                 _trace_safe(
                     session.trace.record_outcome, session.tick, p4t_out,
                     price,
-                    metrics={
+                    metrics=self._enrich_metrics({
                         "decode_ms": round((t_dec - t0) * 1e3, 3),
                         "solve_ms": round(
                             (time.perf_counter() - t_dec) * 1e3, 3
@@ -742,12 +878,17 @@ class SchedulerBackendServicer:
                         "bytes_in": request.ByteSize(),
                         "delta_rows": int(prow.size + trow.size),
                         "wire": "v2-session",
-                    },
+                    }, arena_stats, mark, root),
                     session_id=session.session_id,
                 )
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms(
             "solve", (time.perf_counter() - t_dec) * 1e3
+        )
+        self._observe_tick(
+            session.session_id, t0, session.n_tasks,
+            int((p4t_out >= 0).sum()), arena_stats,
+            delta_rows=int(prow.size + trow.size),
         )
         del t4p, price  # session state: stays server-side
         # SLIM response: p4t only. task_for_provider is derivable from it
@@ -829,10 +970,23 @@ _CHANNEL_OPTIONS = [
 ]
 
 
-def serve(address: str = "127.0.0.1:50061", max_workers: int = 4) -> grpc.Server:
+def serve(
+    address: str = "127.0.0.1:50061",
+    max_workers: int = 4,
+    metrics_port: Optional[int] = None,
+) -> grpc.Server:
     """Start the backend server (non-blocking; call .wait_for_termination()).
     The servicer rides on the returned server as ``.servicer`` (tests and
-    diagnostics reach the session store / seam metrics through it)."""
+    diagnostics reach the session store / seam metrics through it).
+
+    ``metrics_port`` starts the consolidated observability scrape
+    endpoint (``/metrics`` prometheus text merging SeamMetrics + the
+    per-session obs registry + store/budget gauges; ``/metrics.json``
+    the authoritative snapshot) on that port (0 = ephemeral; the bound
+    endpoint rides on the server as ``.metrics`` with its ``.port``).
+    ``PROTOCOL_TPU_METRICS_PORT`` enables it from the environment. None
+    and no env var: no HTTP listener (the Health RPC still serves the
+    seam snapshot)."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
@@ -841,6 +995,13 @@ def serve(address: str = "127.0.0.1:50061", max_workers: int = 4) -> grpc.Server
     server.add_generic_rpc_handlers((_handlers(servicer),))
     server.servicer = servicer
     server.add_insecure_port(address)
+    if metrics_port is None and os.environ.get("PROTOCOL_TPU_METRICS_PORT"):
+        metrics_port = int(os.environ["PROTOCOL_TPU_METRICS_PORT"])
+    server.metrics = None
+    if metrics_port is not None:
+        from protocol_tpu.obs.endpoint import start_for_servicer
+
+        server.metrics = start_for_servicer(servicer, port=metrics_port)
     server.start()
     return server
 
@@ -877,23 +1038,43 @@ class SchedulerBackendClient:
             response_deserializer=pb.HealthResponse.FromString,
         )
 
-    def assign(self, request: pb.AssignRequest, timeout: float = 60.0) -> pb.AssignResponse:
-        return self._assign(request, timeout=timeout)
+    @staticmethod
+    def _md(metadata):
+        """Outgoing metadata with the caller's span context injected
+        (``x-pt-span``), so the servicer's RPC spans stitch into the
+        client tick's trace. No open span / tracing off: pass-through."""
+        return _tracer.inject(metadata)
+
+    def assign(
+        self, request: pb.AssignRequest, timeout: float = 60.0,
+        metadata=None,
+    ) -> pb.AssignResponse:
+        return self._assign(
+            request, timeout=timeout, metadata=self._md(metadata)
+        )
 
     def assign_v2(
-        self, request: pb.AssignRequestV2, timeout: float = 60.0
+        self, request: pb.AssignRequestV2, timeout: float = 60.0,
+        metadata=None,
     ) -> pb.AssignResponseV2:
-        return self._assign_v2(request, timeout=timeout)
+        return self._assign_v2(
+            request, timeout=timeout, metadata=self._md(metadata)
+        )
 
     def open_session(
-        self, chunks, timeout: float = 300.0
+        self, chunks, timeout: float = 300.0, metadata=None
     ) -> pb.OpenSessionResponse:
-        return self._open_session(chunks, timeout=timeout)
+        return self._open_session(
+            chunks, timeout=timeout, metadata=self._md(metadata)
+        )
 
     def assign_delta(
-        self, request: pb.AssignDeltaRequest, timeout: float = 60.0
+        self, request: pb.AssignDeltaRequest, timeout: float = 60.0,
+        metadata=None,
     ) -> pb.AssignDeltaResponse:
-        return self._assign_delta(request, timeout=timeout)
+        return self._assign_delta(
+            request, timeout=timeout, metadata=self._md(metadata)
+        )
 
     def health(self, timeout: float = 10.0) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=timeout)
@@ -1112,7 +1293,11 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     def refresh(self) -> None:
         self._rtt_ms, self._backend_ms = [], []
         self._bytes_out = self._bytes_in = 0
-        super().refresh()  # replaces last_solve_stats; re-attach remote cost
+        # one causal trace per scheduler tick: every RPC this refresh
+        # issues injects this span's context, and the servicer's spans
+        # adopt it — "where did the tick go" is answerable end to end
+        with _tracer.span("seam.tick", wire=self.wire):
+            super().refresh()  # replaces last_solve_stats; re-attach remote cost
         if self._rtt_ms:
             self.last_solve_stats["wire"] = self.wire
             self.last_solve_stats["remote_calls"] = len(self._rtt_ms)
@@ -1159,7 +1344,8 @@ class RemoteBatchMatcher(TpuBatchMatcher):
 
     def _timed(self, make_call, bytes_out: int):
         t0 = time.perf_counter()
-        resp = self._rpc(make_call)
+        with _tracer.span("seam.rpc", wire=self.wire):
+            resp = self._rpc(make_call)
         self._rtt_ms.append((time.perf_counter() - t0) * 1e3)
         self._bytes_out += bytes_out
         self._bytes_in += resp.ByteSize()
@@ -1193,9 +1379,12 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             req.seed_provider_for_task.extend(
                 np.asarray(seed_p4t, np.int32)
             )
-        self.seam.observe_ms(
-            "serialize", (time.perf_counter() - t0) * 1e3
+        _t_ser = time.perf_counter()
+        _tracer.record_span(
+            "wire.encode", int(t0 * 1e9), int((_t_ser - t0) * 1e9),
+            wire=self.wire,
         )
+        self.seam.observe_ms("serialize", (_t_ser - t0) * 1e3)
         resp = self._timed(
             lambda: self.client.assign(req, timeout=self.request_timeout),
             req.ByteSize(),
@@ -1221,9 +1410,12 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         if warm_price is not None and seed_p4t is not None:
             req.warm_price.CopyFrom(blob(warm_price, np.float32))
             req.seed_provider_for_task.CopyFrom(blob(seed_p4t, np.int32))
-        self.seam.observe_ms(
-            "serialize", (time.perf_counter() - t0) * 1e3
+        _t_ser = time.perf_counter()
+        _tracer.record_span(
+            "wire.encode", int(t0 * 1e9), int((_t_ser - t0) * 1e9),
+            wire=self.wire,
         )
+        self.seam.observe_ms("serialize", (_t_ser - t0) * 1e3)
         resp = self._timed(
             lambda: self.client.assign_v2(req, timeout=self.request_timeout),
             req.ByteSize(),
@@ -1278,9 +1470,12 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             req.requirements.CopyFrom(
                 encode_requirements_v2(take_rows(r_cols, trow))
             )
-        self.seam.observe_ms(
-            "serialize", (time.perf_counter() - t0) * 1e3
+        _t_ser = time.perf_counter()
+        _tracer.record_span(
+            "wire.encode", int(t0 * 1e9), int((_t_ser - t0) * 1e9),
+            wire=self.wire,
         )
+        self.seam.observe_ms("serialize", (_t_ser - t0) * 1e3)
         resp = self._timed(
             lambda: self.client.assign_delta(
                 req, timeout=self.request_timeout
@@ -1321,9 +1516,12 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             )
         )
         n_bytes = sum(len(c.payload) for c in chunks)
-        self.seam.observe_ms(
-            "serialize", (time.perf_counter() - t0) * 1e3
+        _t_ser = time.perf_counter()
+        _tracer.record_span(
+            "wire.encode", int(t0 * 1e9), int((_t_ser - t0) * 1e9),
+            wire=self.wire,
         )
+        self.seam.observe_ms("serialize", (_t_ser - t0) * 1e3)
         resp = self._timed(
             lambda: self.client.open_session(
                 iter(chunks), timeout=self.request_timeout

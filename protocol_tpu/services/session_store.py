@@ -34,6 +34,7 @@ from typing import Optional
 
 import numpy as np
 
+from protocol_tpu.obs.spans import TRACER as _tracer
 from protocol_tpu.proto.wire import P_WIRE_DTYPES, R_WIRE_DTYPES
 
 # session-servable kernel strings -> the arena engine behind them
@@ -85,6 +86,13 @@ class EngineThreadBudget:
         self.total = int(total) if total else (os.cpu_count() or 1)
         self._avail = self.total
         self._lock = threading.Lock()
+        # obs plane counters (read by ObsRegistry's budget gauges):
+        # cumulative grants, grants smaller than requested (the
+        # saturation signal the fleet roadmap gates on), and the lowest
+        # availability ever observed
+        self.grants = 0
+        self.degraded_grants = 0
+        self.min_avail = self.total
 
     def acquire(self, want: int) -> int:
         """Returns the grant size (>= 1, never blocks)."""
@@ -92,7 +100,13 @@ class EngineThreadBudget:
         with self._lock:
             grant = max(1, min(want, self._avail))
             self._avail -= grant
-            return grant
+            self.grants += 1
+            if grant < want:
+                self.degraded_grants += 1
+            if self._avail < self.min_avail:
+                self.min_avail = self._avail
+        _tracer.point("budget.grant", want=want, grant=grant)
+        return grant
 
     def release(self, grant: int) -> None:
         with self._lock:
@@ -277,6 +291,7 @@ class SessionStore:
             self._sessions[sid].evicted = True
             del self._sessions[sid]
             self.expirations += 1
+            _tracer.point("session.evict", session=sid, reason="ttl")
 
     def put(self, session: SolveSession) -> None:
         with self._lock:
@@ -286,31 +301,36 @@ class SessionStore:
                 replaced.evicted = True
             self._sessions[session.session_id] = session
             while len(self._sessions) > self.max_sessions:
-                _, lru = self._sessions.popitem(last=False)
+                sid, lru = self._sessions.popitem(last=False)
                 lru.evicted = True
                 self.evictions += 1
+                _tracer.point("session.evict", session=sid, reason="lru")
 
     def get(
         self, session_id: str, fingerprint: str
     ) -> tuple[Optional[SolveSession], str]:
         """Look up a session for a delta tick. Returns (session, "") on
         hit or (None, reason) — reason is wire-safe text the client logs."""
-        with self._lock:
-            self._expire_locked()
-            s = self._sessions.get(session_id)
-            if s is None:
-                return None, "unknown session"
-            if s.fingerprint != fingerprint:
-                return None, "epoch fingerprint mismatch"
-            self._sessions.move_to_end(session_id)
-            s.last_used = time.monotonic()
-            return s, ""
+        with _tracer.span("session.lookup", session=session_id):
+            with self._lock:
+                self._expire_locked()
+                s = self._sessions.get(session_id)
+                if s is None:
+                    return None, "unknown session"
+                if s.fingerprint != fingerprint:
+                    return None, "epoch fingerprint mismatch"
+                self._sessions.move_to_end(session_id)
+                s.last_used = time.monotonic()
+                return s, ""
 
     def drop(self, session_id: str) -> None:
         with self._lock:
             dropped = self._sessions.pop(session_id, None)
             if dropped is not None:
                 dropped.evicted = True
+                _tracer.point(
+                    "session.evict", session=session_id, reason="drop"
+                )
 
     def __len__(self) -> int:
         with self._lock:

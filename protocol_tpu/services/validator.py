@@ -439,11 +439,15 @@ class ValidatorService:
         """Matmul round-trip: both sides compute on their accelerator; the
         worker's answer must match within tolerance.
 
-        Inputs travel as FixedF64 (utils/fixedf64.py, the reference's
-        deterministic wire format — hardware_challenge.rs:8-54), so both
-        sides hold bit-identical float64 inputs; the RESULT comparison
-        stays tolerance-based because validator and worker legitimately
-        run on different hardware (see PARITY.md)."""
+        Inputs travel as FixedF64 (utils/fixedf64.py) — the same
+        DETERMINISM PROPERTY as the reference's FixedF64
+        (hardware_challenge.rs:8-54) but a deliberately DIFFERENT wire:
+        Q31.32 integers under ``matrix_*_fixed`` keys, where the
+        reference ships 12-decimal strings in a ``data_a``/``rows_a``
+        schema — the two wires are not mutually parseable (see
+        PARITY.md). Either way both sides hold bit-identical float64
+        inputs; the RESULT comparison stays tolerance-based because
+        validator and worker legitimately run on different hardware."""
         from protocol_tpu.utils import fixedf64
 
         n = self.challenge_size

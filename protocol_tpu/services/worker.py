@@ -647,8 +647,10 @@ class WorkerAgent:
         fixed_wire = "matrix_a_fixed" in body
         try:
             if fixed_wire:
-                # FixedF64 wire (hardware_challenge.rs:8-54): decode to the
-                # bit-exact float64s the validator encoded
+                # FixedF64 wire (utils/fixedf64.py — a deliberate Q31.32
+                # deviation from hardware_challenge.rs's decimal-string
+                # wire, equivalent determinism; see PARITY.md): decode to
+                # the bit-exact float64s the validator encoded
                 a = fixedf64.decode_array(body["matrix_a_fixed"]).astype(np.float32)
                 b = fixedf64.decode_array(body["matrix_b_fixed"]).astype(np.float32)
             else:  # legacy float-JSON wire

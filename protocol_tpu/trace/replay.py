@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from protocol_tpu.obs.spans import TRACER as _tracer, span_dicts_compact
 from protocol_tpu.proto import scheduler_pb2 as pb
 from protocol_tpu.proto import wire
 from protocol_tpu.trace import format as tfmt
@@ -383,10 +384,15 @@ def replay(
             if max_ticks is not None and tick >= max_ticks:
                 break
             t0 = time.perf_counter()
-            if isinstance(backend, _WireTransport):
-                p4t, stats = backend.solve(snap, p_cols, r_cols, delta)
-            else:
-                p4t, stats = backend.solve(snap, p_cols, r_cols)
+            # root span per tick: the arena/servicer/client spans this
+            # solve produces stitch under it, and a recording replay
+            # lands them in the OUTCOME frame for the obs report
+            mark = _tracer.mark()
+            with _tracer.span("replay.tick", tick=tick) as root:
+                if isinstance(backend, _WireTransport):
+                    p4t, stats = backend.solve(snap, p_cols, r_cols, delta)
+                else:
+                    p4t, stats = backend.solve(snap, p_cols, r_cols)
             wall_ms = (time.perf_counter() - t0) * 1e3
             report["ticks"] += 1
             report["tick_wall_ms"].append(round(wall_ms, 3))
@@ -404,6 +410,11 @@ def replay(
                     {k: v for k, v in (stats or {}).items()
                      if isinstance(v, (int, float, bool, str))}
                 )
+                if root is not None:
+                    spans = _tracer.since(mark, trace=root["trace"])
+                    if spans:
+                        metrics["trace_id"] = root["trace"]
+                        metrics["spans"] = span_dicts_compact(spans)
                 writer.write_outcome(tick, p4t, metrics=metrics)
             if verify:
                 rec = trace.outcome_for(tick)
@@ -434,6 +445,11 @@ def replay(
             report["warm_median_ms"] = round(
                 float(np.median(walls[1:])), 3
             )
+            # true distribution numbers (obs plane): what the fleet/
+            # streaming gates will hold, not just means
+            from protocol_tpu.obs.metrics import percentiles_ms
+
+            report["warm_percentiles"] = percentiles_ms(walls[1:])
     if isinstance(backend, _WireTransport):
         report["wire_bytes_out"] = backend.bytes_out
         report["wire_bytes_in"] = backend.bytes_in

@@ -42,10 +42,18 @@ the warm ticks must beat the cold tick by the stored floor — so a
 solver, codec, or warm-path regression shows up as a named divergent
 tick/row set, not a vague bench delta.
 
+With ``--obs`` it runs the observability-overhead gate (ISSUE 6): a 4k
+arena chain (cold + warm churn + short-circuit tick) with spans +
+native EngineStats ON must stay within ``obs_overhead_max_frac`` of the
+same chain with the plane OFF (interleaved min-of-5), the two matchings
+must be bit-identical, and the consolidated /metrics scrape endpoint
+must honor the prometheus-optional degradation contract (200 with
+prometheus_client, clean 503 without; /metrics.json always 200).
+
 Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
-[--trace] (--update-floor rewrites perf_floor.json to 25% of this
-machine's measured rate — run on the slowest supported host class, then
-commit.)
+[--trace] [--obs] (--update-floor rewrites perf_floor.json to 25% of
+this machine's measured rate — run on the slowest supported host class,
+then commit.)
 """
 
 import argparse
@@ -238,6 +246,136 @@ def sinkhorn_gate() -> int:
     return 0
 
 
+def obs_gate() -> int:
+    """Observability-plane gate (ISSUE 6): (a) overhead — an
+    instrumented 4k arena chain (cold + warm + short-circuit tick, spans
+    and native EngineStats on) must stay within
+    ``obs_overhead_max_frac`` of the uninstrumented chain, interleaved
+    min-of-N so host jitter cannot false-fail; (b) the instrumented and
+    uninstrumented matchings must be BIT-IDENTICAL (observability must
+    observe, never perturb); (c) the consolidated /metrics scrape
+    endpoint must answer 200 with prometheus_client installed and a
+    clean 503 without it (the degradation contract), with
+    /metrics.json always 200."""
+    import dataclasses
+    import urllib.error
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu import obs
+    from protocol_tpu.native.arena import NativeSolveArena
+    from protocol_tpu.obs.metrics import prometheus_available
+    from protocol_tpu.ops.cost import CostWeights
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    n = 4096
+    rng = np.random.default_rng(0)
+    ep = bench.synth_providers(rng, n)
+    er = bench.synth_requirements(rng, n)
+    w = CostWeights()
+    churn_rng = np.random.default_rng(1)
+    rows = churn_rng.choice(n, n // 100, replace=False)
+    price = np.array(ep.price, copy=True)
+    price[rows] = churn_rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+    ep_b = dataclasses.replace(ep, price=price)
+
+    def run(instrumented: bool):
+        obs.set_enabled(instrumented)
+        try:
+            arena = NativeSolveArena(threads=0)
+            t0 = time.perf_counter()
+            p1 = arena.solve(ep, er, w)       # cold
+            p2 = arena.solve(ep_b, er, w)     # 1% warm churn tick
+            p3 = arena.solve(ep_b, er, w)     # byte-identical short-circuit
+            return time.perf_counter() - t0, (p1, p2, p3)
+        finally:
+            obs.set_enabled(True)
+
+    run(False)  # warm the native build/load + allocator
+    walls: dict = {True: [], False: []}
+    results: dict = {}
+    for _ in range(5):
+        # interleaved A/B: both configs see the same host-noise regime
+        for flag in (True, False):
+            wall, res = run(flag)
+            walls[flag].append(wall)
+            results.setdefault(flag, res)
+    identical = all(
+        np.array_equal(a, b)
+        for a, b in zip(results[True], results[False])
+    )
+    on, off = min(walls[True]), min(walls[False])
+    overhead = on / off - 1.0
+    max_frac = floors["obs_overhead_max_frac"]
+    print(
+        f"obs gate: instrumented {on * 1e3:.1f} ms vs uninstrumented "
+        f"{off * 1e3:.1f} ms (min-of-5) — overhead {overhead:+.2%} "
+        f"(max {max_frac:.0%}); bit-identical {identical}"
+    )
+    if not identical:
+        failures.append(
+            "instrumented matching differs from uninstrumented — "
+            "observability must never perturb results"
+        )
+    if overhead > max_frac:
+        failures.append(
+            f"obs instrumentation overhead {overhead:.2%} exceeds "
+            f"{max_frac:.0%} of the uninstrumented 4k solve chain"
+        )
+
+    # ---- /metrics scrape smoke (degradation contract)
+    from protocol_tpu.services.scheduler_grpc import serve
+
+    server = serve("127.0.0.1:0", metrics_port=0)
+    try:
+        base = f"http://127.0.0.1:{server.metrics.port}"
+        try:
+            body = urllib.request.urlopen(base + "/metrics", timeout=10)
+            code, text = body.status, body.read().decode()
+        except urllib.error.HTTPError as e:
+            code, text = e.code, e.read().decode()
+        if prometheus_available():
+            ok = code == 200 and "scheduler_obs" in text
+            print(f"obs gate: /metrics {code} (prometheus present)")
+            if not ok:
+                failures.append(
+                    f"/metrics answered {code} without the obs families "
+                    "despite prometheus_client being installed"
+                )
+        else:
+            print(f"obs gate: /metrics {code} (prometheus absent)")
+            if code != 503:
+                failures.append(
+                    f"/metrics answered {code} without prometheus_client "
+                    "— the degradation contract promises a clean 503"
+                )
+        jr = urllib.request.urlopen(base + "/metrics.json", timeout=10)
+        jbody = jr.read().decode()
+        if jr.status != 200 or "obs" not in jbody:
+            failures.append(
+                "/metrics.json must always serve the authoritative "
+                f"snapshot (got {jr.status})"
+            )
+        else:
+            print("obs gate: /metrics.json 200 (authoritative snapshot)")
+    finally:
+        if server.metrics is not None:
+            server.metrics.stop()
+        server.stop(grace=None)
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("obs perf gate OK")
+    return 0
+
+
 GOLDEN_TRACE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "artifacts", "golden_trace_512x512.trace",
@@ -329,6 +467,7 @@ def main() -> int:
     ap.add_argument("--wire", action="store_true")
     ap.add_argument("--sinkhorn", action="store_true")
     ap.add_argument("--trace", action="store_true")
+    ap.add_argument("--obs", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
@@ -337,6 +476,8 @@ def main() -> int:
         return sinkhorn_gate()
     if args.trace:
         return trace_gate()
+    if args.obs:
+        return obs_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

@@ -309,3 +309,99 @@ class TestWarmArena:
         assert m.last_solve_stats["arena_cold"] is False
         assert m.last_solve_stats["arena_changed_rows"] == 0
         assert m._assignment == first  # steady state: no flapping
+
+
+class TestStaleRetirementClearedOnChurn:
+    """ADVICE r5 (stale-retirement starvation), native-arena twin of the
+    tpu_backend fix: a carried retirement flag must be cleared for
+    exactly the rows whose candidates churned — otherwise a task that
+    retired for want of a feasible provider stays starved until the
+    next cold solve (cold_every beats) even after a provider it can use
+    appears. Regression: a churned warm chain where the row must become
+    re-biddable AND actually seat."""
+
+    def _scarce_marketplace(self):
+        """256x256 marketplace where task 0 has NO feasible provider
+        (cpu demand beyond every spec): the cold solve organically
+        retires it (no-candidates retirement, not an injected flag)."""
+        ep, er = encode_random_marketplace(11, 256, 256)
+        req_cores = np.array(er.cpu_cores, copy=True)
+        req_cpu = np.array(er.cpu_required, copy=True)
+        req_ram = np.array(er.ram_mb, copy=True)
+        req_storage = np.array(er.storage_gb, copy=True)
+        gpu_opt = np.array(er.gpu_opt_valid, copy=True)
+        req_cores[0] = 1_000_000
+        req_cpu[0] = True
+        # the cpu demand is the ONLY constraint on task 0: the upgraded
+        # provider must fail/pass on exactly that axis
+        req_ram[0] = -1
+        req_storage[0] = -1
+        gpu_opt[0, :] = False
+        er = dataclasses.replace(
+            er, cpu_cores=req_cores, cpu_required=req_cpu,
+            ram_mb=req_ram, storage_gb=req_storage, gpu_opt_valid=gpu_opt,
+        )
+        return ep, er
+
+    def test_churned_row_is_rebiddable(self):
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._scarce_marketplace()
+        w = CostWeights()
+        arena = NativeSolveArena(threads=2, cold_every=1_000_000)
+        p1 = arena.solve(ep, er, w)
+        assert p1[0] == -1
+        assert bool(np.asarray(arena.retired)[0])
+
+        # warm tick with UNRELATED churn (another task's priority): task
+        # 0's candidates did not change, so the carried flag must
+        # SURVIVE — the carry is the point; clearing everything would
+        # re-fight the priced-out tail every tick
+        prio = np.array(er.priority, copy=True)
+        prio[200] += 0.25
+        er2 = dataclasses.replace(er, priority=prio)
+        p2 = arena.solve(ep, er2, w)
+        assert p2[0] == -1
+        assert bool(np.asarray(arena.retired)[0])
+        assert arena.last_stats["cold"] is False
+
+        # churned warm chain: ONE provider upgrades to satisfy task 0
+        # (structural churn -> delta pass folds it into row 0) — the
+        # flag must clear and the row must be re-biddable, seating task
+        # 0 in the SAME warm solve instead of starving until cold
+        cores = np.array(ep.cpu_cores, copy=True)
+        has_cpu = np.array(ep.has_cpu, copy=True)
+        cores[42] = 1_000_000
+        has_cpu[42] = True
+        ep3 = dataclasses.replace(ep, cpu_cores=cores, has_cpu=has_cpu)
+        p3 = arena.solve(ep3, er2, w)
+        assert arena.last_stats["cold"] is False
+        assert arena.last_stats["dirty_providers"] == 1
+        assert not bool(np.asarray(arena.retired)[0])
+        assert p3[0] >= 0, (
+            "task 0 stayed starved after a feasible provider churned in "
+            "— stale carried retirement (ADVICE r5)"
+        )
+        pos = p3[p3 >= 0]
+        assert np.unique(pos).size == pos.size  # matching stays injective
+
+    def test_sinkhorn_arena_rebids_churned_row(self):
+        """Same chain through the sinkhorn engine's referee (shares the
+        candidate machinery; retirement carry rides the referee seed)."""
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        ep, er = self._scarce_marketplace()
+        w = CostWeights()
+        arena = NativeSolveArena(
+            threads=2, engine="sinkhorn", cold_every=1_000_000
+        )
+        p1 = arena.solve(ep, er, w)
+        assert p1[0] == -1
+        cores = np.array(ep.cpu_cores, copy=True)
+        has_cpu = np.array(ep.has_cpu, copy=True)
+        cores[42] = 1_000_000
+        has_cpu[42] = True
+        ep2 = dataclasses.replace(ep, cpu_cores=cores, has_cpu=has_cpu)
+        p2 = arena.solve(ep2, er, w)
+        assert arena.last_stats["cold"] is False
+        assert p2[0] >= 0
