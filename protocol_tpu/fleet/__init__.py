@@ -1,0 +1,45 @@
+"""Multi-tenant scheduler fleet: sharded session fabric, admission
+control, weighted-fair thread scheduling, and the concurrent-trace load
+harness (``python -m protocol_tpu.fleet.loadgen``).
+
+The scheduler seam serves one session well; this package makes it a
+*fleet* service (ROADMAP item 2 — many pools x heavy churn, not one
+giant matrix):
+
+  * :class:`SessionFabric` — consistent-hash session->shard mapping
+    over N ``SessionStore`` shards (each its own lock domain) with a
+    fleet-wide arena byte budget and cross-shard LRU eviction pressure.
+  * :class:`TenantAdmission` — per-tenant token-bucket admission on
+    OpenSession/AssignDelta; refusals ride the protocol's existing
+    ``ok=false`` shapes (RESOURCE_EXHAUSTED-style), which the client
+    fallback ladder already handles.
+  * :class:`FairThreadBudget` — weighted-fair grant ordering on the
+    engine thread budget (never blocks, 1-thread floor preserved).
+  * ``loadgen`` — replays H recorded/synthetic traces concurrently over
+    real gRPC against one servicer and reports per-tenant p50/p99 tick
+    latency, assigned fraction, fairness, and a core-count scaling
+    model (imported lazily: it pulls in the servicer).
+
+Tenancy is encoded in the session id: ``tenant@session`` (the
+``tenant_of`` convention the obs plane already keys on).
+"""
+
+from protocol_tpu.fleet.admission import (  # noqa: F401
+    FairThreadBudget,
+    TenantAdmission,
+    TokenBucket,
+)
+from protocol_tpu.fleet.fabric import (  # noqa: F401
+    FleetConfig,
+    SessionFabric,
+    estimate_arena_bytes,
+)
+
+__all__ = [
+    "FairThreadBudget",
+    "TenantAdmission",
+    "TokenBucket",
+    "FleetConfig",
+    "SessionFabric",
+    "estimate_arena_bytes",
+]

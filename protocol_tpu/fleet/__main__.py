@@ -1,0 +1,8 @@
+"""``python -m protocol_tpu.fleet`` — alias for the load harness
+(``python -m protocol_tpu.fleet.loadgen``)."""
+
+import sys
+
+from protocol_tpu.fleet.loadgen import main
+
+sys.exit(main())

@@ -1,0 +1,261 @@
+"""Admission control and fair thread scheduling for the fleet layer.
+
+Three pieces, all non-blocking (the seam's concurrency story forbids
+parking an RPC worker on a fairness decision):
+
+``TokenBucket``/``TenantAdmission`` rate-limit session opens and delta
+ticks per tenant (tenant = the ``tenant_of`` prefix of the session id).
+An over-rate call gets a ``RESOURCE_EXHAUSTED``-style refusal on the
+existing protocol surface (``ok=false`` / ``session_ok=false``), which
+the client's fallback ladder already handles — refusal is a protocol
+answer, never an exception.
+
+``FairThreadBudget`` extends :class:`EngineThreadBudget` with weighted
+max-min fairness over tenants: when more than one tenant holds engine
+threads, a tenant's grant is capped at its weighted share of the pool
+minus what it already holds — so a tenant hammering 50 sessions cannot
+starve a tenant with 1. The base contract is untouched: ``acquire``
+NEVER blocks, a drained pool degrades to the 1-thread floor, and grants
+are sound because the engines are bit-identical at every thread count
+(a smaller grant changes wall-clock, never a matching). With a single
+active tenant the cap vanishes and grants are bit-compatible with the
+base class — single-session behavior is unchanged by construction.
+
+Clocks are injectable (``clock=``) so tests drive refill deterministically;
+the defaults read ``time.monotonic`` exactly like the session TTLs.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from protocol_tpu.services.session_store import EngineThreadBudget
+
+
+def jain_index(xs) -> float:
+    """Jain's fairness index: 1.0 = perfectly even, 1/n = one winner.
+    Zeros are KEPT — a fully-starved participant must drag the index
+    down (that is the starvation signal the fleet gate floors on);
+    dropping zeros would compute fairness over the healthy survivors
+    only and report ~1.0 on exactly the regression this measures."""
+    xs = [max(0.0, float(x)) for x in xs]
+    if not xs or sum(xs) <= 0:
+        return 1.0  # vacuous: nobody did (or wanted) any work
+    s = sum(xs)
+    return round((s * s) / (len(xs) * sum(x * x for x in xs)), 4)
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``.
+    ``try_take`` is non-blocking — admission refuses, it never queues."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+class TenantAdmission:
+    """Per-tenant token-bucket admission for OpenSession/AssignDelta.
+
+    ``rate=None`` admits everything (the single-tenant default — the
+    fleet knobs must not change standalone behavior) but still counts,
+    so the obs plane's per-tenant admitted/refused counters work in
+    both modes. ``per_tenant`` overrides (rate, burst) for named
+    tenants."""
+
+    def __init__(
+        self,
+        rate: Optional[float] = None,
+        burst: float = 16.0,
+        per_tenant: Optional[dict] = None,
+        clock: Callable[[], float] = time.monotonic,
+        max_tenants: int = 512,
+    ):
+        self.rate = rate
+        self.burst = float(burst)
+        self.per_tenant = dict(per_tenant or {})
+        self._clock = clock
+        self._lock = threading.Lock()
+        # LRU-bounded: tenant keys are derived from client-minted
+        # session ids (a bare uuid's "tenant" is the whole uuid — the
+        # production RemoteBatchMatcher mints exactly those), so an
+        # unbounded dict would grow one bucket + counter entry per
+        # session ever seen and explode the per-tenant /metrics
+        # cardinality. Same recency-eviction contract as ObsRegistry.
+        self.max_tenants = int(max_tenants)
+        # tenant -> {"bucket": TokenBucket|None, "admitted": n, "refused": n}
+        self._tenants: OrderedDict[str, dict] = OrderedDict()
+
+    def _entry_locked(self, tenant: str) -> dict:
+        e = self._tenants.get(tenant)
+        if e is not None:
+            self._tenants.move_to_end(tenant)
+        else:
+            spec = self.per_tenant.get(tenant)
+            if spec is not None:
+                bucket = TokenBucket(spec[0], spec[1], clock=self._clock)
+            elif self.rate is not None:
+                bucket = TokenBucket(self.rate, self.burst, clock=self._clock)
+            else:
+                bucket = None
+            e = self._tenants[tenant] = {
+                "bucket": bucket, "admitted": 0, "refused": 0,
+            }
+            while len(self._tenants) > self.max_tenants:
+                self._tenants.popitem(last=False)
+        return e
+
+    def admit(self, tenant: str) -> bool:
+        """True = proceed; False = refuse this call (the caller answers
+        with the protocol's refusal shape, not an exception)."""
+        with self._lock:
+            e = self._entry_locked(tenant)
+            bucket = e["bucket"]
+        # the bucket has its own lock; taking a token outside the
+        # registry lock keeps tenants from serializing on each other
+        ok = bucket is None or bucket.try_take()
+        with self._lock:
+            e["admitted" if ok else "refused"] += 1
+        return ok
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "rate": self.rate,
+                "burst": self.burst,
+                "tenants": {
+                    t: {"admitted": e["admitted"], "refused": e["refused"]}
+                    for t, e in self._tenants.items()
+                },
+            }
+
+
+class FairThreadBudget(EngineThreadBudget):
+    """Weighted-fair :class:`EngineThreadBudget`.
+
+    Grant ordering is max-min over the tenants currently holding
+    threads: with >1 active tenant, tenant ``t`` (weight ``w_t``,
+    default 1.0) is capped at ``ceil(total * w_t / sum(active
+    weights)) - in_use_t``, floored at the never-blocking 1-thread
+    grant. A sole tenant sees exactly the base-class behavior —
+    ``min(want, available)`` with the same floor — so the fleet layer
+    being "on" never perturbs single-session grants.
+
+    ``fairness_index`` is Jain's index over cumulative granted threads
+    per tenant: 1.0 = perfectly even service, 1/n = one tenant took
+    everything. It is a *supply* gauge (what the budget handed out), so
+    under deliberately skewed demand it reports that skew honestly —
+    the loadgen computes the demand-normalized per-session index on
+    top of it."""
+
+    def __init__(
+        self,
+        total: Optional[int] = None,
+        weights: Optional[dict] = None,
+        max_tenants: int = 512,
+    ):
+        super().__init__(total)
+        self.weights = dict(weights or {})
+        # LRU-bounded like TenantAdmission._tenants: uuid-session
+        # "tenants" would otherwise accumulate one books entry per
+        # session ever served. Tenants still HOLDING threads are never
+        # pruned (their in_use books must balance on release).
+        self.max_tenants = int(max_tenants)
+        self._in_use: dict[str, int] = {}
+        self._granted: OrderedDict[str, int] = OrderedDict()
+
+    def _weight(self, tenant: str) -> float:
+        return max(float(self.weights.get(tenant, 1.0)), 1e-9)
+
+    def acquire(self, want: int, tenant: str = "-") -> int:
+        want = self.total if want <= 0 else min(int(want), self.total)
+        with self._lock:
+            active = {t for t, n in self._in_use.items() if n > 0}
+            active.add(tenant)
+            capped = want
+            if len(active) > 1:
+                wsum = sum(self._weight(t) for t in active)
+                share = int(
+                    math.ceil(self.total * self._weight(tenant) / wsum)
+                )
+                capped = min(
+                    want, max(1, share - self._in_use.get(tenant, 0))
+                )
+            grant = max(1, min(capped, self._avail))
+            self._avail -= grant
+            self._in_use[tenant] = self._in_use.get(tenant, 0) + grant
+            self._granted[tenant] = self._granted.get(tenant, 0) + grant
+            self._granted.move_to_end(tenant)
+            if len(self._granted) > self.max_tenants:
+                # prune oldest idle tenants (never one holding threads)
+                for t in list(self._granted):
+                    if len(self._granted) <= self.max_tenants:
+                        break
+                    if self._in_use.get(t, 0) <= 0:
+                        self._granted.pop(t)
+                        self._in_use.pop(t, None)
+            self.grants += 1
+            if grant < want:
+                self.degraded_grants += 1
+            if self._avail < self.min_avail:
+                self.min_avail = self._avail
+        self._point(want, grant)
+        return grant
+
+    @staticmethod
+    def _point(want: int, grant: int) -> None:
+        from protocol_tpu.obs.spans import TRACER
+
+        TRACER.point("budget.grant", want=want, grant=grant)
+
+    def release(self, grant: int, tenant: str = "-") -> None:
+        with self._lock:
+            self._avail += int(grant)
+            self._in_use[tenant] = self._in_use.get(tenant, 0) - int(grant)
+
+    def fairness_index(self) -> float:
+        """Jain's fairness index over cumulative granted threads."""
+        with self._lock:
+            xs = list(self._granted.values())
+        return jain_index(xs)
+
+    def tenant_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                t: {
+                    "in_use": self._in_use.get(t, 0),
+                    "granted_total": g,
+                    "weight": self._weight(t),
+                }
+                for t, g in self._granted.items()
+            }

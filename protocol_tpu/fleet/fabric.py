@@ -1,0 +1,352 @@
+"""Sharded session fabric: the multi-tenant registry behind the seam.
+
+One :class:`~protocol_tpu.services.session_store.SessionStore` is one
+lock domain — fine for a handful of sessions, a serialization point for
+a fleet. :class:`SessionFabric` spreads sessions over N stores by
+consistent hashing (sha1 ring with virtual nodes, so adding a shard
+moves ~1/N of the keys) and presents the SAME api surface
+(``put``/``get``/``drop``/``__len__``/``evictions``/``expirations``),
+so the servicer, tests, and the obs plane's occupancy gauges are
+shard-count agnostic.
+
+On top of the shards sits the **arena memory budget**. Every session's
+pinned bytes are estimated ONCE at open from rows x dtype widths
+(:func:`estimate_arena_bytes` — the wire specs already fix every
+column's width) and rolled up per tenant and fleet-wide under a single
+leaf lock. Crossing ``max_bytes`` (or a tenant crossing
+``tenant_max_bytes``, or the fleet crossing the global ``max_sessions``
+count) triggers eviction PRESSURE: expired sessions are swept first,
+then the globally least-recently-used victim (chosen across all
+shards, per-shard LRU candidates compared by ``last_used``) is evicted
+with the PR 3 evicted-flag semantics — an in-flight delta that already
+looked the victim up refuses instead of solving against a disowned
+arena, and the client re-opens from its authoritative state.
+
+Lock ordering (deadlock freedom): shard locks never nest, and the
+fabric's ``_budget_lock`` is a LEAF — stores invoke the accounting
+callback under their own lock and the callback takes only the budget
+lock; the fabric never calls into a shard while holding it.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.obs.metrics import tenant_of
+from protocol_tpu.services.session_store import SessionStore
+
+
+def _h(key: str) -> int:
+    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+
+
+# bound for per-tenant counter dicts whose keys derive from client-minted
+# session ids (same rationale as TenantAdmission.max_tenants)
+_MAX_TENANT_KEYS = 512
+
+
+def estimate_arena_bytes(
+    p_cols: dict, r_cols: dict, top_k: int
+) -> int:
+    """Byte estimate of one session's pinned server-side state, from
+    rows x dtype widths: the padded columns (held twice — the session's
+    copy plus the arena's canonical previous-tick copy for dirty
+    detection), the [T, K] candidate structure (i32 provider + f32
+    cost), and the solver duals/flags (price f32 + retired u8 over P,
+    potentials f32 over P and T). An estimate, not an audit — the
+    budget needs a deterministic, O(columns) number at open time, not a
+    heap walk."""
+    pb = sum(int(np.asarray(a).nbytes) for a in p_cols.values())
+    rb = sum(int(np.asarray(a).nbytes) for a in r_cols.values())
+    p_pad = int(np.asarray(p_cols["gpu_count"]).shape[0])
+    t_pad = int(np.asarray(r_cols["cpu_cores"]).shape[0])
+    k = min(max(int(top_k), 1), max(p_pad, 1))
+    cand = t_pad * k * 8  # cand_p i32 + cand_c f32
+    duals = p_pad * (4 + 1 + 4) + t_pad * 4
+    return 2 * (pb + rb) + cand + duals
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet knobs, separate from the servicer's per-store arguments.
+    The defaults keep standalone behavior identical: unlimited
+    admission, no byte budget, and a fabric whose global
+    ``max_sessions`` pressure reproduces the single-store LRU exactly.
+
+    ``PROTOCOL_TPU_FLEET_*`` environment variables configure a served
+    process without code changes (``from_env``)."""
+
+    shards: int = 4
+    vnodes: int = 64
+    max_bytes: Optional[int] = None
+    tenant_max_bytes: Optional[int] = None
+    admit_rate: Optional[float] = None  # tokens/s per tenant; None = off
+    admit_burst: float = 16.0
+    tenant_weights: Optional[dict] = None
+    delta_queue_depth: int = 8  # <= 0 disables backpressure
+
+    @classmethod
+    def from_env(cls) -> "FleetConfig":
+        env = os.environ.get
+
+        def _opt(name, cast):
+            raw = env(name)
+            return cast(raw) if raw else None
+
+        return cls(
+            shards=int(env("PROTOCOL_TPU_FLEET_SHARDS", "4")),
+            max_bytes=_opt("PROTOCOL_TPU_FLEET_MAX_BYTES", int),
+            tenant_max_bytes=_opt(
+                "PROTOCOL_TPU_FLEET_TENANT_MAX_BYTES", int
+            ),
+            admit_rate=_opt("PROTOCOL_TPU_FLEET_ADMIT_RATE", float),
+            admit_burst=float(env("PROTOCOL_TPU_FLEET_ADMIT_BURST", "16")),
+            delta_queue_depth=int(
+                env("PROTOCOL_TPU_FLEET_QUEUE_DEPTH", "8")
+            ),
+        )
+
+
+class SessionFabric:
+    """Consistent-hash sharded SessionStore fleet with a global arena
+    memory budget. See the module docstring for the design contract."""
+
+    def __init__(
+        self,
+        shards: int = 4,
+        max_sessions: int = 8,
+        ttl_s: float = 900.0,
+        max_bytes: Optional[int] = None,
+        tenant_max_bytes: Optional[int] = None,
+        vnodes: int = 64,
+    ):
+        self.n_shards = max(1, int(shards))
+        # GLOBAL cap: each shard could hold the whole fleet; the fabric
+        # enforces the fleet-wide count itself via global-LRU pressure,
+        # which reproduces the single-store LRU semantics exactly (the
+        # victim is the least-recently-used session anywhere)
+        self.max_sessions = int(max_sessions)
+        self.max_bytes = max_bytes
+        self.tenant_max_bytes = tenant_max_bytes
+        self.shards = [
+            SessionStore(
+                max_sessions=self.max_sessions,
+                ttl_s=ttl_s,
+                on_evict=self._on_store_evict,
+            )
+            for _ in range(self.n_shards)
+        ]
+        # consistent-hash ring: vnodes per shard, immutable after init
+        ring = sorted(
+            (_h(f"shard-{i}/vnode-{j}"), i)
+            for i in range(self.n_shards)
+            for j in range(max(1, int(vnodes)))
+        )
+        self._ring_keys = [k for k, _ in ring]
+        self._ring_shards = [s for _, s in ring]
+        # ---- arena budget accounting (LEAF lock: callbacks land here
+        # from under shard locks; never call a shard while holding it)
+        self._budget_lock = threading.Lock()
+        self._by_session: dict[str, tuple] = {}  # sid -> (session, tenant, bytes)
+        self._tenant_bytes: dict[str, int] = {}
+        self._total_bytes = 0
+        self._pressure_evictions = 0
+        self._evictions_by_tenant: dict[str, int] = {}
+
+    # ---------------- shard map ----------------
+
+    def shard_index(self, session_id: str) -> int:
+        i = bisect.bisect_right(self._ring_keys, _h(session_id))
+        return self._ring_shards[i % len(self._ring_shards)]
+
+    def shard_of(self, session_id: str) -> SessionStore:
+        return self.shards[self.shard_index(session_id)]
+
+    # ---------------- SessionStore-compatible surface ----------------
+
+    def put(self, session) -> None:
+        self.shard_of(session.session_id).put(session)
+        self._account(session)
+        self._apply_pressure(protect=session.session_id)
+
+    def get(self, session_id: str, fingerprint: str):
+        return self.shard_of(session_id).get(session_id, fingerprint)
+
+    def drop(self, session_id: str) -> None:
+        self.shard_of(session_id).drop(session_id)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    @property
+    def evictions(self) -> int:
+        return sum(s.evictions for s in self.shards)
+
+    @property
+    def expirations(self) -> int:
+        return sum(s.expirations for s in self.shards)
+
+    # ---------------- fleet surface ----------------
+
+    def sweep(self) -> int:
+        """Deterministic TTL sweep over every shard (satellite of the
+        fleet issue: idle expired sessions release their arena bytes
+        without waiting for the next access-path touch). The eviction
+        callbacks release the byte accounting as a side effect."""
+        return sum(shard.sweep() for shard in self.shards)
+
+    @property
+    def total_bytes(self) -> int:
+        with self._budget_lock:
+            return self._total_bytes
+
+    def tenant_bytes(self, tenant: str) -> int:
+        with self._budget_lock:
+            return self._tenant_bytes.get(tenant, 0)
+
+    def snapshot(self) -> dict:
+        """Occupancy + budget gauges for the obs plane (rendered on the
+        existing /metrics endpoint via ObsRegistry.attach(fleet=...))."""
+        with self._budget_lock:
+            tenant_bytes = {
+                t: b for t, b in self._tenant_bytes.items() if b
+            }
+            total = self._total_bytes
+            pressure = self._pressure_evictions
+            by_tenant = dict(self._evictions_by_tenant)
+        return {
+            "shards": [len(s) for s in self.shards],
+            "sessions": len(self),
+            "max_sessions": self.max_sessions,
+            "total_bytes": total,
+            "max_bytes": self.max_bytes,
+            "tenant_bytes": tenant_bytes,
+            "tenant_max_bytes": self.tenant_max_bytes,
+            "pressure_evictions": pressure,
+            "evictions": self.evictions,
+            "expirations": self.expirations,
+            "evictions_by_tenant": by_tenant,
+        }
+
+    # ---------------- budget accounting ----------------
+
+    def _account(self, session) -> None:
+        with self._budget_lock:
+            if session.evicted:
+                # lost the open-vs-pressure race before accounting: the
+                # store already flagged it (flag is set BEFORE the
+                # eviction callback fires), so adding bytes now would
+                # leak them forever
+                return
+            tenant = tenant_of(session.session_id)
+            est = int(session.arena_bytes)
+            self._by_session[session.session_id] = (session, tenant, est)
+            self._tenant_bytes[tenant] = (
+                self._tenant_bytes.get(tenant, 0) + est
+            )
+            self._total_bytes += est
+
+    def _on_store_evict(self, session, reason: str) -> None:
+        # store callback: runs under the owning shard's lock; only the
+        # leaf budget lock may be taken here
+        with self._budget_lock:
+            entry = self._by_session.get(session.session_id)
+            if entry is None or entry[0] is not session:
+                # never accounted (lost the open race) or already
+                # superseded by a same-id re-open — nothing to release
+                return
+            del self._by_session[session.session_id]
+            _, tenant, est = entry
+            remaining = self._tenant_bytes.get(tenant, 0) - est
+            if remaining > 0:
+                self._tenant_bytes[tenant] = remaining
+            else:
+                # prune zeroed tenants: tenant keys derive from
+                # client-minted session ids (a bare uuid's tenant is
+                # the whole uuid), so keeping dead entries would grow
+                # this dict — and the _over_budget scan of it — by one
+                # per client ever connected
+                self._tenant_bytes.pop(tenant, None)
+            self._total_bytes -= est
+            if reason in ("lru", "pressure"):
+                # only involuntary capacity evictions count here —
+                # client-initiated drop/replace and TTL expiry have
+                # their own store counters, and folding them in would
+                # make the per-tenant pressure signal unusable
+                self._evictions_by_tenant[tenant] = (
+                    self._evictions_by_tenant.get(tenant, 0) + 1
+                )
+                while len(self._evictions_by_tenant) > _MAX_TENANT_KEYS:
+                    self._evictions_by_tenant.pop(
+                        next(iter(self._evictions_by_tenant))
+                    )
+            if reason == "pressure":
+                self._pressure_evictions += 1
+
+    # ---------------- eviction pressure ----------------
+
+    def _over_budget(self) -> tuple[bool, Optional[str]]:
+        with self._budget_lock:
+            if self.max_bytes is not None and (
+                self._total_bytes > self.max_bytes
+            ):
+                return True, None
+            if self.tenant_max_bytes is not None:
+                for t, b in self._tenant_bytes.items():
+                    if b > self.tenant_max_bytes:
+                        return True, t
+        if len(self) > self.max_sessions:
+            return True, None
+        return False, None
+
+    def _global_lru(
+        self, exclude=(), tenant: Optional[str] = None
+    ) -> Optional[tuple[int, str]]:
+        """Globally least-recently-used session: each shard nominates
+        its local LRU (under its own lock), the fabric picks the oldest
+        ``last_used`` (ties broken by session id for determinism)."""
+        best = None
+        for i, shard in enumerate(self.shards):
+            cand = shard.lru_candidate(exclude=exclude, tenant=tenant)
+            if cand is None:
+                continue
+            sid, last_used = cand
+            key = (last_used, sid)
+            if best is None or key < best[0]:
+                best = (key, i, sid)
+        if best is None:
+            return None
+        return best[1], best[2]
+
+    def _apply_pressure(self, protect: str) -> None:
+        """Evict until the fleet is back under its count/byte budgets.
+        ``protect`` (the session just opened) is never the victim — it
+        is the most recently used by definition, but a same-timestamp
+        tie must not evict the session whose open triggered the
+        pressure. Expired sessions go first (their memory is free);
+        then global LRU victims. Bounded: each round evicts exactly one
+        session or stops."""
+        swept = False
+        for _ in range(self.max_sessions + len(self) + 8):
+            over, tenant = self._over_budget()
+            if not over:
+                return
+            if not swept:
+                swept = True
+                if self.sweep():
+                    continue
+            victim = self._global_lru(exclude=(protect,), tenant=tenant)
+            if victim is None:
+                # nothing evictable (the protected session alone is
+                # over budget): admission/estimation should have
+                # refused upstream; never evict the session mid-open
+                return
+            shard_i, sid = victim
+            self.shards[shard_i].evict(sid, reason="pressure")

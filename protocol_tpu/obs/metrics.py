@@ -191,27 +191,43 @@ class ObsRegistry:
         self.max_sessions = int(max_sessions)
         self._lock = threading.Lock()
         self._sessions: OrderedDict[str, _SessionObs] = OrderedDict()
+        # per-tenant roll-up, recorded in the SAME observe_tick pass:
+        # tenant histograms are true merged distributions (p50/p99 over
+        # every tick the tenant's sessions ran), not an after-the-fact
+        # merge of per-session quantiles — the fleet gates read these
+        self._tenants: OrderedDict[str, _SessionObs] = OrderedDict()
         # scrape-time sources attached by the servicer
         self._budget = None  # EngineThreadBudget
         self._store = None  # SessionStore
+        self._fleet = None  # fleet.fabric.SessionFabric
+        self._admission = None  # fleet.admission.TenantAdmission
         self._registry = None
 
-    def attach(self, budget=None, store=None) -> None:
+    def attach(
+        self, budget=None, store=None, fleet=None, admission=None
+    ) -> None:
         if budget is not None:
             self._budget = budget
         if store is not None:
             self._store = store
+        if fleet is not None:
+            self._fleet = fleet
+        if admission is not None:
+            self._admission = admission
 
     # ---------------- recording ----------------
 
-    def _session(self, session_id: str) -> _SessionObs:
-        s = self._sessions.get(session_id)
+    def _entry(self, store: OrderedDict, key: str) -> _SessionObs:
+        """Get-or-create with LRU bounding — one policy for both the
+        per-session and per-tenant registries (keys are client-minted,
+        so both need the recency cap)."""
+        s = store.get(key)
         if s is None:
-            s = self._sessions[session_id] = _SessionObs()
-            while len(self._sessions) > self.max_sessions:
-                self._sessions.popitem(last=False)
+            s = store[key] = _SessionObs()
+            while len(store) > self.max_sessions:
+                store.popitem(last=False)
         else:
-            self._sessions.move_to_end(session_id)
+            store.move_to_end(key)
         return s
 
     def observe_tick(
@@ -235,27 +251,31 @@ class ObsRegistry:
         if cold is None:
             cold = bool(stats.get("cold", True)) if stats else True
         with self._lock:
-            s = self._session(session_id)
-            (s.cold_ticks if cold else s.ticks).observe_ms(wall_ms)
-            if n_tasks > 0:
-                # clamp: the one-to-many "best" kernel counts assigned
-                # PROVIDERS, which can exceed the task count — the gauge
-                # stays a fraction
-                s.assigned_frac = min(1.0, num_assigned / n_tasks)
-                s.min_assigned_frac = min(
-                    s.min_assigned_frac, s.assigned_frac
-                )
-            if stats:
-                # the arena reports row counts over its PADDED (pow2)
-                # batch; mixing them with the real n_tasks would push
-                # the ratio out of [0, 1] on non-pow2 batches
-                rows = int(stats.get("rows", n_tasks))
-                if rows > 0:
-                    s.rows_total += rows
-                    s.rows_changed += int(
-                        stats.get("changed_rows", rows if cold else 0)
+            for s in (
+                self._entry(self._sessions, session_id),
+                self._entry(self._tenants, tenant_of(session_id)),
+            ):
+                (s.cold_ticks if cold else s.ticks).observe_ms(wall_ms)
+                if n_tasks > 0:
+                    # clamp: the one-to-many "best" kernel counts
+                    # assigned PROVIDERS, which can exceed the task
+                    # count — the gauge stays a fraction
+                    s.assigned_frac = min(1.0, num_assigned / n_tasks)
+                    s.min_assigned_frac = min(
+                        s.min_assigned_frac, s.assigned_frac
                     )
-            s.delta_rows += int(delta_rows)
+                if stats:
+                    # the arena reports row counts over its PADDED
+                    # (pow2) batch; mixing them with the real n_tasks
+                    # would push the ratio out of [0, 1] on non-pow2
+                    # batches
+                    rows = int(stats.get("rows", n_tasks))
+                    if rows > 0:
+                        s.rows_total += rows
+                        s.rows_changed += int(
+                            stats.get("changed_rows", rows if cold else 0)
+                        )
+                s.delta_rows += int(delta_rows)
 
     def forget(self, session_id: str) -> None:
         """Drop one session's metrics (optional — the LRU cap already
@@ -268,20 +288,27 @@ class ObsRegistry:
     def snapshot(self) -> dict:
         """Authoritative nested snapshot: per-session histograms +
         fleet-level gauges. Works with or without prometheus."""
+        def _one(s: _SessionObs, key: str) -> dict:
+            return {
+                "tenant": tenant_of(key),
+                "tick": s.ticks.snapshot_ms(),
+                "cold_tick": s.cold_ticks.snapshot_ms(),
+                "assigned_frac": round(s.assigned_frac, 4),
+                "min_assigned_frac": round(s.min_assigned_frac, 4),
+                "arena_reuse_ratio": round(s.reuse_ratio(), 4),
+                "delta_rows": s.delta_rows,
+            }
+
         with self._lock:
             sessions = {
-                sid: {
-                    "tenant": tenant_of(sid),
-                    "tick": s.ticks.snapshot_ms(),
-                    "cold_tick": s.cold_ticks.snapshot_ms(),
-                    "assigned_frac": round(s.assigned_frac, 4),
-                    "min_assigned_frac": round(s.min_assigned_frac, 4),
-                    "arena_reuse_ratio": round(s.reuse_ratio(), 4),
-                    "delta_rows": s.delta_rows,
-                }
-                for sid, s in self._sessions.items()
+                sid: _one(s, sid) for sid, s in self._sessions.items()
             }
-        out: dict = {"role": self.role, "sessions": sessions}
+            tenants = {
+                t: _one(s, t) for t, s in self._tenants.items()
+            }
+        out: dict = {
+            "role": self.role, "sessions": sessions, "tenants": tenants,
+        }
         budget = self._budget
         if budget is not None:
             avail = budget.available
@@ -295,6 +322,10 @@ class ObsRegistry:
                 "degraded_grants": getattr(budget, "degraded_grants", 0),
                 "min_avail": getattr(budget, "min_avail", avail),
             }
+        if budget is not None and hasattr(budget, "fairness_index"):
+            # FairThreadBudget: the fairness gauge + per-tenant grants
+            out["budget"]["fairness_index"] = budget.fairness_index()
+            out["budget"]["tenants"] = budget.tenant_snapshot()
         store = self._store
         if store is not None:
             out["session_store"] = {
@@ -303,6 +334,12 @@ class ObsRegistry:
                 "evictions": store.evictions,
                 "expirations": store.expirations,
             }
+        fleet = self._fleet
+        if fleet is not None:
+            out["fleet"] = fleet.snapshot()
+        admission = self._admission
+        if admission is not None:
+            out["admission"] = admission.snapshot()
         return out
 
     def render(self) -> bytes:
@@ -373,4 +410,71 @@ class ObsRegistry:
             g_occ.labels(role=role, state="expirations").set(
                 st["expirations"]
             )
+        if snap.get("tenants"):
+            g_ten = Gauge(
+                "scheduler_obs_tenant_tick_latency_ms",
+                "Per-tenant tick latency quantiles (warm ticks, merged "
+                "over the tenant's sessions)",
+                ["role", "tenant", "quantile"], registry=reg,
+            )
+            g_ten_frac = Gauge(
+                "scheduler_obs_tenant_assigned_frac",
+                "Per-tenant minimum assigned fraction",
+                ["role", "tenant"], registry=reg,
+            )
+            for t, s in snap["tenants"].items():
+                tick = s["tick"]
+                if tick.get("count"):
+                    for q in ("p50", "p90", "p99", "p999"):
+                        g_ten.labels(
+                            role=role, tenant=t, quantile=q
+                        ).set(tick[f"{q}_ms"])
+                g_ten_frac.labels(role=role, tenant=t).set(
+                    s["min_assigned_frac"]
+                )
+        if "fleet" in snap:
+            fl = snap["fleet"]
+            g_shard = Gauge(
+                "scheduler_obs_fleet_shard_sessions",
+                "Sessions pinned per fabric shard",
+                ["role", "shard"], registry=reg,
+            )
+            for i, n in enumerate(fl["shards"]):
+                g_shard.labels(role=role, shard=str(i)).set(n)
+            g_bytes = Gauge(
+                "scheduler_obs_fleet_arena_bytes",
+                "Estimated pinned arena bytes", ["role", "tenant"],
+                registry=reg,
+            )
+            g_bytes.labels(role=role, tenant="_total").set(
+                fl["total_bytes"]
+            )
+            for t, b in fl["tenant_bytes"].items():
+                g_bytes.labels(role=role, tenant=t).set(b)
+            g_prs = Gauge(
+                "scheduler_obs_fleet_pressure_evictions",
+                "Sessions evicted by cross-shard memory pressure",
+                ["role"], registry=reg,
+            )
+            g_prs.labels(role=role).set(fl["pressure_evictions"])
+        if "admission" in snap:
+            g_adm = Gauge(
+                "scheduler_obs_fleet_admission_total",
+                "Per-tenant admission decisions",
+                ["role", "tenant", "outcome"], registry=reg,
+            )
+            for t, c in snap["admission"]["tenants"].items():
+                g_adm.labels(role=role, tenant=t, outcome="admitted").set(
+                    c["admitted"]
+                )
+                g_adm.labels(role=role, tenant=t, outcome="refused").set(
+                    c["refused"]
+                )
+        if snap.get("budget", {}).get("fairness_index") is not None:
+            g_fair = Gauge(
+                "scheduler_obs_thread_budget_fairness_index",
+                "Jain fairness index over per-tenant granted threads",
+                ["role"], registry=reg,
+            )
+            g_fair.labels(role=role).set(snap["budget"]["fairness_index"])
         return generate_latest(reg)

@@ -40,7 +40,7 @@ from typing import NamedTuple, Optional
 import grpc
 import numpy as np
 
-from protocol_tpu.obs.metrics import ObsRegistry
+from protocol_tpu.obs.metrics import ObsRegistry, tenant_of
 from protocol_tpu.obs.spans import TRACER as _tracer, span_dicts_compact
 from protocol_tpu.ops.cost import CostWeights, cost_matrix
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
@@ -64,8 +64,6 @@ from protocol_tpu.proto.wire import (
 )
 from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
 from protocol_tpu.services.session_store import (
-    EngineThreadBudget,
-    SessionStore,
     SolveSession,
     parse_native_threads,
     parse_session_kernel,
@@ -163,7 +161,10 @@ class _SolveOut(NamedTuple):
 
 class SchedulerBackendServicer:
     def __init__(
-        self, max_sessions: int = 8, session_ttl_s: float = 900.0
+        self,
+        max_sessions: int = 8,
+        session_ttl_s: float = 900.0,
+        fleet=None,
     ):
         from protocol_tpu.sched.cand_cache import CandidateMemo
 
@@ -189,9 +190,34 @@ class SchedulerBackendServicer:
         import threading
 
         self._unary_arena_lock = threading.Lock()
-        self._engine_budget = EngineThreadBudget()
-        self.sessions = SessionStore(
-            max_sessions=max_sessions, ttl_s=session_ttl_s
+        # ---- fleet layer (always on; the defaults are transparent):
+        # sessions live in a consistent-hash sharded fabric (each shard
+        # its own lock domain, global count/byte budgets enforced by
+        # cross-shard LRU pressure), engine threads come from the
+        # weighted-fair budget (bit-compatible with the base budget for
+        # a sole tenant), and per-tenant token buckets gate admission
+        # (rate=None admits everything but still counts). ``fleet`` is
+        # a FleetConfig; None reads PROTOCOL_TPU_FLEET_* from the env.
+        from protocol_tpu.fleet import (
+            FairThreadBudget,
+            FleetConfig,
+            SessionFabric,
+            TenantAdmission,
+        )
+
+        cfg = fleet if fleet is not None else FleetConfig.from_env()
+        self.fleet_config = cfg
+        self._engine_budget = FairThreadBudget(weights=cfg.tenant_weights)
+        self.sessions = SessionFabric(
+            shards=cfg.shards,
+            max_sessions=max_sessions,
+            ttl_s=session_ttl_s,
+            max_bytes=cfg.max_bytes,
+            tenant_max_bytes=cfg.tenant_max_bytes,
+            vnodes=cfg.vnodes,
+        )
+        self.admission = TenantAdmission(
+            rate=cfg.admit_rate, burst=cfg.admit_burst
         )
         self.seam = SeamMetrics(role="server")
         # observability plane: per-session tick histograms (true
@@ -199,7 +225,12 @@ class SchedulerBackendServicer:
         # budget/store gauges read at scrape time. The dict snapshot is
         # authoritative; /metrics is wired by serve(metrics_port=...).
         self.obs = ObsRegistry(role="server")
-        self.obs.attach(budget=self._engine_budget, store=self.sessions)
+        self.obs.attach(
+            budget=self._engine_budget,
+            store=self.sessions,
+            fleet=self.sessions,
+            admission=self.admission,
+        )
         # flight recorder (PROTOCOL_TPU_TRACE=<path>): any solve served by
         # this backend records its exact inputs + outcomes — unary calls
         # via the column differ, the session protocol via its own wire
@@ -310,7 +341,7 @@ class SchedulerBackendServicer:
                             k=requested_k, threads=threads,
                             engine=engine,
                         )
-                    grant = self._engine_budget.acquire(threads)
+                    grant = self._engine_budget.acquire(threads, "unary")
                     try:
                         self._native_arena.threads = grant
                         p4t_full = self._native_arena.solve(
@@ -319,7 +350,7 @@ class SchedulerBackendServicer:
                         price_full = self._native_arena.price
                         arena_stats = dict(self._native_arena.last_stats)
                     finally:
-                        self._engine_budget.release(grant)
+                        self._engine_budget.release(grant, "unary")
             if kernel == "native":
                 arena_stats = None
             p4t = np.asarray(p4t_full)[:T]
@@ -512,9 +543,25 @@ class SchedulerBackendServicer:
         with self._rpc_span("rpc.Assign", context, wire="v1") as root:
             return self._assign_v1(request, context, mark, root)
 
+    def _admit_unary(self, context) -> None:
+        """Admission gate for the stateless rungs. Without this, a
+        tenant refused on the session protocol would fall to unary and
+        run UNTHROTTLED — the fallback ladder would bypass admission.
+        Unary carries no session id, so all unary traffic shares one
+        "unary" bucket (coarse by design; rate=None, the default, is a
+        no-op). Refusal is a gRPC RESOURCE_EXHAUSTED status — an
+        explicit throttle the caller sees, never a silent drop."""
+        if not self.admission.admit("unary"):
+            self.seam.count("admission_refused")
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "unary admission rate exceeded",
+            )
+
     def _assign_v1(
         self, request: pb.AssignRequest, context, mark: int, root
     ) -> pb.AssignResponse:
+        self._admit_unary(context)
         t0 = time.perf_counter()
         with _tracer.span("wire.decode", wire="v1"):
             ep = providers_from_proto(request.providers)
@@ -578,6 +625,7 @@ class SchedulerBackendServicer:
     def _assign_v2(
         self, request: pb.AssignRequestV2, context, mark: int, root
     ) -> pb.AssignResponseV2:
+        self._admit_unary(context)
         t0 = time.perf_counter()
         try:
             with _tracer.span("wire.decode", wire="v2"):
@@ -663,6 +711,18 @@ class SchedulerBackendServicer:
         except ValueError as e:
             return pb.OpenSessionResponse(ok=False, error=str(e))
         self.seam.add_bytes("in", wire_bytes)
+        # tenant admission BEFORE the expensive decode + cold solve: an
+        # over-rate tenant costs the server one token-bucket check, not
+        # a snapshot decode. The refusal is a protocol answer on the
+        # existing surface — the client's ladder falls to unary v2.
+        tenant = tenant_of(session_id) if session_id else "unknown"
+        if not self.admission.admit(tenant):
+            self.seam.count("admission_refused")
+            return pb.OpenSessionResponse(
+                ok=False,
+                error=f"RESOURCE_EXHAUSTED: tenant {tenant!r} over "
+                      "admission rate (OpenSession)",
+            )
         kernel = req.kernel or "native-mt"
         parsed = parse_session_kernel(kernel)
         if parsed is None:
@@ -696,8 +756,11 @@ class SchedulerBackendServicer:
             )
         n_p = p_cols["gpu_count"].shape[0]
         n_t = r_cols["cpu_cores"].shape[0]
+        from protocol_tpu.fleet import estimate_arena_bytes
         from protocol_tpu.native.arena import NativeSolveArena
 
+        padded_p = _pad_cols(p_cols, n_p)
+        padded_r = _pad_cols(r_cols, n_t)
         session = SolveSession(
             session_id=session_id or uuid.uuid4().hex,
             fingerprint=fp,
@@ -705,12 +768,14 @@ class SchedulerBackendServicer:
             kernel=kernel,
             threads=threads,
             top_k=top_k,
-            p_cols=_pad_cols(p_cols, n_p),
-            r_cols=_pad_cols(r_cols, n_t),
+            p_cols=padded_p,
+            r_cols=padded_r,
             n_providers=n_p,
             n_tasks=n_t,
             arena=NativeSolveArena(k=top_k, threads=threads, engine=engine),
             budget=self._engine_budget,
+            # fleet arena budget: rows x dtype widths, estimated once
+            arena_bytes=estimate_arena_bytes(padded_p, padded_r, top_k),
         )
         t_dec = time.perf_counter()
         with _tracer.span("engine.solve", kernel=kernel, cold=True):
@@ -777,12 +842,48 @@ class SchedulerBackendServicer:
         self, request: pb.AssignDeltaRequest, context, mark: int, root
     ) -> pb.AssignDeltaResponse:
         t0 = time.perf_counter()
+        # tenant admission first (cheapest check): an over-rate tenant
+        # is refused before it costs a store lookup or a decode
+        if not self.admission.admit(tenant_of(request.session_id)):
+            self.seam.count("admission_refused")
+            return pb.AssignDeltaResponse(
+                session_ok=False,
+                error="RESOURCE_EXHAUSTED: tenant over admission rate "
+                      "(AssignDelta)",
+            )
         session, reason = self.sessions.get(
             request.session_id, request.epoch_fingerprint
         )
         if session is None:
             self.seam.count("session_miss")
             return pb.AssignDeltaResponse(session_ok=False, error=reason)
+        # delta-stream backpressure: the queued-tick depth bound must be
+        # checked BEFORE parking on the session lock — over-depth means
+        # this session is already stacked with waiting ticks, and
+        # admitting one more would just grow the invisible lock queue
+        if not session.enter_tick(self.fleet_config.delta_queue_depth):
+            self.seam.count("backpressure_refused")
+            return pb.AssignDeltaResponse(
+                session_ok=False,
+                error="RESOURCE_EXHAUSTED: session delta queue over "
+                      f"depth {self.fleet_config.delta_queue_depth}",
+            )
+        try:
+            return self._assign_delta_admitted(
+                request, context, mark, root, t0, session
+            )
+        finally:
+            session.exit_tick()
+
+    def _assign_delta_admitted(
+        self,
+        request: pb.AssignDeltaRequest,
+        context,
+        mark: int,
+        root,
+        t0: float,
+        session: SolveSession,
+    ) -> pb.AssignDeltaResponse:
         self.seam.count("session_hit")
         self.seam.add_bytes("in", request.ByteSize())
         try:
@@ -910,6 +1011,11 @@ class SchedulerBackendServicer:
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
 
+        # deterministic fleet sweep: health probes are the periodic
+        # traffic every deployment already has, so idle expired sessions
+        # release their arena bytes here instead of waiting for the next
+        # data-path touch (the fabric also sweeps under budget pressure)
+        self.sessions.sweep()
         devices = jax.devices()
         resp = pb.HealthResponse(
             status="ok",
@@ -974,10 +1080,18 @@ def serve(
     address: str = "127.0.0.1:50061",
     max_workers: int = 4,
     metrics_port: Optional[int] = None,
+    max_sessions: int = 8,
+    session_ttl_s: float = 900.0,
+    fleet=None,
 ) -> grpc.Server:
     """Start the backend server (non-blocking; call .wait_for_termination()).
     The servicer rides on the returned server as ``.servicer`` (tests and
     diagnostics reach the session store / seam metrics through it).
+
+    ``fleet`` is a :class:`~protocol_tpu.fleet.FleetConfig` (shard
+    count, arena byte budgets, admission rate, delta queue depth);
+    None reads ``PROTOCOL_TPU_FLEET_*`` from the environment, and the
+    defaults are transparent for single-session use.
 
     ``metrics_port`` starts the consolidated observability scrape
     endpoint (``/metrics`` prometheus text merging SeamMetrics + the
@@ -991,7 +1105,11 @@ def serve(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
     )
-    servicer = SchedulerBackendServicer()
+    servicer = SchedulerBackendServicer(
+        max_sessions=max_sessions,
+        session_ttl_s=session_ttl_s,
+        fleet=fleet,
+    )
     server.add_generic_rpc_handlers((_handlers(servicer),))
     server.servicer = servicer
     server.add_insecure_port(address)
@@ -1267,6 +1385,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         gzip_snapshots: bool = True,
         retries: int = 3,
         retry_base_s: float = 0.05,
+        retry_max_s: float = 2.0,
         **kwargs,
     ):
         super().__init__(store, **kwargs)
@@ -1278,6 +1397,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         self.gzip_snapshots = gzip_snapshots
         self.retries = retries
         self.retry_base_s = retry_base_s
+        self.retry_max_s = retry_max_s
         self.client = SchedulerBackendClient(address)
         self.seam = SeamMetrics(role="client")
         self._rtt_ms: list[float] = []
@@ -1322,22 +1442,51 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             pass
         self.client = SchedulerBackendClient(address)
 
+    def _backoff_s(self, attempt: int) -> float:
+        """Bounded exponential backoff with deterministic jitter for
+        retry ``attempt`` (0-based): ``retry_base_s * 2^attempt`` capped
+        at ``retry_max_s``, scaled into [0.5x, 1.5x) by a hash of this
+        client's session uid + the attempt number. H clients restarting
+        against a recovered server therefore spread their retries over
+        the backoff window instead of thundering-herding it in lockstep
+        — and the schedule is a pure function of (uid, attempt), so
+        tests replay it exactly (no ``random``: the determinism lint's
+        spirit holds even off the kernel paths)."""
+        base = min(self.retry_base_s * (2.0 ** attempt), self.retry_max_s)
+        import hashlib
+
+        digest = hashlib.sha1(
+            f"{self._session_uid}:{attempt}".encode()
+        ).digest()
+        frac = int.from_bytes(digest[:8], "big") / 2.0 ** 64
+        return min(base * (0.5 + frac), self.retry_max_s)
+
     def _rpc(self, make_call):
         """Run ``make_call()`` (a zero-arg closure issuing one RPC) with
-        bounded exponential backoff on transient transport failures; each
-        retry reconnects the channel (a dead server that came back gets a
-        fresh HTTP/2 connection instead of a wedged one)."""
-        delay = self.retry_base_s
+        bounded, jittered exponential backoff on transient transport
+        failures (see :meth:`_backoff_s`); each retry reconnects the
+        channel (a dead server that came back gets a fresh HTTP/2
+        connection instead of a wedged one). A RESOURCE_EXHAUSTED abort
+        (the fleet's unary admission gate) backs off the same way but
+        WITHOUT reconnecting — the server is healthy, its token bucket
+        is just empty, and the refill is what the wait buys. Sustained
+        throttle past the retry budget surfaces as the explicit error
+        it is."""
         for attempt in range(self.retries + 1):
             try:
                 return make_call()
             except grpc.RpcError as e:
                 code = e.code()
-                if attempt >= self.retries or code not in _RETRYABLE:
+                if attempt >= self.retries:
+                    raise
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    self.seam.count("throttled_retry")
+                    time.sleep(self._backoff_s(attempt))
+                    continue
+                if code not in _RETRYABLE:
                     raise
                 self.seam.count("retry")
-                time.sleep(delay)
-                delay *= 2
+                time.sleep(self._backoff_s(attempt))
                 self._reconnect()
 
     # ---------------- v1/v2 unary ----------------
@@ -1482,10 +1631,31 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             ),
             req.ByteSize(),
         )
+        if not resp.session_ok and "RESOURCE_EXHAUSTED" in resp.error:
+            # fleet admission/backpressure throttle: the session is
+            # still alive server-side, so retry the SAME delta after a
+            # bounded jittered backoff (the token bucket refills at
+            # admit_rate) — re-opening here would AMPLIFY an over-rate
+            # tenant's load into full snapshot solves, the opposite of
+            # what the refusal asked for
+            for attempt in range(self.retries):
+                self.seam.count("throttled_retry")
+                time.sleep(self._backoff_s(attempt))
+                resp = self._timed(
+                    lambda: self.client.assign_delta(
+                        req, timeout=self.request_timeout
+                    ),
+                    req.ByteSize(),
+                )
+                if resp.session_ok or (
+                    "RESOURCE_EXHAUSTED" not in resp.error
+                ):
+                    break
         if not resp.session_ok:
             # evicted / expired / served by a replica that never saw the
-            # snapshot: re-open from our authoritative state, don't error
-            # the scheduler tick
+            # snapshot (or still throttled after the bounded retries):
+            # re-open from our authoritative state, don't error the
+            # scheduler tick
             self.seam.count("session_reopen")
             self._session = None
             return self._open_session(
@@ -1529,9 +1699,18 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             n_bytes,
         )
         if not resp.ok:
-            # server-side refusal is a protocol answer, not a transport
-            # failure: remember it so every later tick goes straight to
-            # the unary rung
+            if "RESOURCE_EXHAUSTED" in resp.error:
+                # admission throttle, NOT a capability refusal: this
+                # tick degrades to the unary rung, but the session
+                # protocol stays available — setting _session_refused
+                # here would demote a briefly-throttled tenant to
+                # unthrottled full-snapshot unary solves FOREVER
+                self.seam.count("session_throttled")
+                self._session = None
+                return None
+            # server-side capability refusal is a protocol answer, not
+            # a transport failure: remember it so every later tick goes
+            # straight to the unary rung
             self.seam.count("session_refused")
             self._session_refused = True
             self._session = None

@@ -94,8 +94,12 @@ class EngineThreadBudget:
         self.degraded_grants = 0
         self.min_avail = self.total
 
-    def acquire(self, want: int) -> int:
-        """Returns the grant size (>= 1, never blocks)."""
+    def acquire(self, want: int, tenant: str = "-") -> int:
+        """Returns the grant size (>= 1, never blocks). ``tenant`` is
+        accepted for signature parity with the fleet layer's
+        :class:`~protocol_tpu.fleet.admission.FairThreadBudget` (which
+        caps grants at the tenant's weighted share); the base budget
+        ignores it."""
         want = self.total if want <= 0 else min(int(want), self.total)
         with self._lock:
             grant = max(1, min(want, self._avail))
@@ -108,7 +112,7 @@ class EngineThreadBudget:
         _tracer.point("budget.grant", want=want, grant=grant)
         return grant
 
-    def release(self, grant: int) -> None:
+    def release(self, grant: int, tenant: str = "-") -> None:
         with self._lock:
             self._avail += int(grant)
 
@@ -179,6 +183,34 @@ class SolveSession:
     # claimed the PROTOCOL_TPU_TRACE stream: every APPLIED delta lands
     # its exact wire rows from apply_delta (refused deltas never record)
     trace: object = None
+    # delta-stream backpressure (fleet layer): ticks currently inside
+    # the servicer for this session (parked on ``lock`` included). The
+    # depth check must happen BEFORE parking on the session lock — a
+    # client re-sending into a slow session would otherwise stack RPC
+    # workers on the lock, which is exactly the queue the bound exists
+    # to refuse. Guarded by its own tiny lock so the check never
+    # contends with a running solve.
+    inflight: int = 0
+    inflight_lock: threading.Lock = field(default_factory=threading.Lock)
+    # fleet arena-budget accounting: byte estimate of this session's
+    # pinned state (padded columns + candidate structure + duals),
+    # computed once at open from rows x dtype widths
+    # (fleet.fabric.estimate_arena_bytes) — never re-measured
+    arena_bytes: int = 0
+
+    def enter_tick(self, max_depth: int) -> bool:
+        """Claim one queued-tick slot; False = over ``max_depth``
+        (refuse with the RESOURCE_EXHAUSTED shape). ``max_depth <= 0``
+        disables the bound."""
+        with self.inflight_lock:
+            if max_depth > 0 and self.inflight >= max_depth:
+                return False
+            self.inflight += 1
+            return True
+
+    def exit_tick(self) -> None:
+        with self.inflight_lock:
+            self.inflight -= 1
 
     def solve(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the warm arena over the current columns; returns
@@ -189,7 +221,13 @@ class SolveSession:
         invariant, so the grant size never changes the matching)."""
         grant = None
         if self.budget is not None:
-            grant = self.budget.acquire(self.threads)
+            # tenant-tagged grant: the fleet's FairThreadBudget caps it
+            # at the tenant's weighted share under contention; the base
+            # budget ignores the tag (signature parity)
+            from protocol_tpu.obs.metrics import tenant_of
+
+            tenant = tenant_of(self.session_id)
+            grant = self.budget.acquire(self.threads, tenant)
             self.arena.threads = grant
         try:
             p4t_full = self.arena.solve(
@@ -197,7 +235,7 @@ class SolveSession:
             )
         finally:
             if grant is not None:
-                self.budget.release(grant)
+                self.budget.release(grant, tenant)
         p4t = np.asarray(p4t_full)[: self.n_tasks]
         t4p = np.full(self.n_providers, -1, np.int32)
         seated = np.flatnonzero((p4t >= 0) & (p4t < self.n_providers))
@@ -271,15 +309,35 @@ class SolveSession:
 
 
 class SessionStore:
-    """LRU + TTL registry of :class:`SolveSession`."""
+    """LRU + TTL registry of :class:`SolveSession`.
 
-    def __init__(self, max_sessions: int = 8, ttl_s: float = 900.0):
+    ``on_evict(session, reason)`` is the fleet fabric's accounting hook:
+    invoked for EVERY path that lets go of a session (lru / ttl / drop /
+    replace / pressure), always AFTER ``evicted`` is set, and always
+    under this store's lock — so the callback must touch only leaf state
+    (the fabric's budget lock) and never call back into a store."""
+
+    def __init__(
+        self,
+        max_sessions: int = 8,
+        ttl_s: float = 900.0,
+        on_evict=None,
+    ):
         self.max_sessions = max_sessions
         self.ttl_s = ttl_s
         self._lock = threading.Lock()
         self._sessions: OrderedDict[str, SolveSession] = OrderedDict()
         self.evictions = 0
         self.expirations = 0
+        self._on_evict = on_evict
+
+    def _let_go_locked(self, session: SolveSession, reason: str) -> None:
+        session.evicted = True
+        _tracer.point(
+            "session.evict", session=session.session_id, reason=reason
+        )
+        if self._on_evict is not None:
+            self._on_evict(session, reason)
 
     def _expire_locked(self) -> None:
         now = time.monotonic()
@@ -288,23 +346,32 @@ class SessionStore:
             if now - s.last_used > self.ttl_s
         ]
         for sid in dead:
-            self._sessions[sid].evicted = True
-            del self._sessions[sid]
+            s = self._sessions.pop(sid)
             self.expirations += 1
-            _tracer.point("session.evict", session=sid, reason="ttl")
+            self._let_go_locked(s, "ttl")
+
+    def sweep(self) -> int:
+        """Deterministic TTL sweep — the fleet layer's hook for
+        releasing idle expired sessions' arena memory WITHOUT waiting
+        for the next access-path touch (before this, an idle expired
+        session pinned its arena until some other call happened to
+        enter ``put``/``get``). Returns the number expired."""
+        with self._lock:
+            before = self.expirations
+            self._expire_locked()
+            return self.expirations - before
 
     def put(self, session: SolveSession) -> None:
         with self._lock:
             self._expire_locked()
             replaced = self._sessions.pop(session.session_id, None)
             if replaced is not None:
-                replaced.evicted = True
+                self._let_go_locked(replaced, "replace")
             self._sessions[session.session_id] = session
             while len(self._sessions) > self.max_sessions:
-                sid, lru = self._sessions.popitem(last=False)
-                lru.evicted = True
+                _sid, lru = self._sessions.popitem(last=False)
                 self.evictions += 1
-                _tracer.point("session.evict", session=sid, reason="lru")
+                self._let_go_locked(lru, "lru")
 
     def get(
         self, session_id: str, fingerprint: str
@@ -327,10 +394,39 @@ class SessionStore:
         with self._lock:
             dropped = self._sessions.pop(session_id, None)
             if dropped is not None:
-                dropped.evicted = True
-                _tracer.point(
-                    "session.evict", session=session_id, reason="drop"
-                )
+                self._let_go_locked(dropped, "drop")
+
+    def evict(self, session_id: str, reason: str = "pressure") -> bool:
+        """Targeted eviction (the fabric's cross-shard memory-pressure
+        path). Same evicted-flag semantics as LRU/TTL: an in-flight
+        delta that already looked the session up refuses after seeing
+        the flag. False = the session was already gone (lost a race to
+        another eviction path — fine, the memory is released either
+        way)."""
+        with self._lock:
+            s = self._sessions.pop(session_id, None)
+            if s is None:
+                return False
+            self.evictions += 1
+            self._let_go_locked(s, reason)
+            return True
+
+    def lru_candidate(self, exclude=(), tenant=None):
+        """(session_id, last_used) of the least-recently-used session —
+        the fabric's per-shard input to GLOBAL victim selection. The
+        OrderedDict is access-ordered (``get`` moves to end), so the
+        first entry is the shard-local LRU. ``tenant`` filters victims
+        to one tenant (per-tenant budget pressure)."""
+        from protocol_tpu.obs.metrics import tenant_of
+
+        with self._lock:
+            for sid, s in self._sessions.items():
+                if sid in exclude:
+                    continue
+                if tenant is not None and tenant_of(sid) != tenant:
+                    continue
+                return sid, s.last_used
+        return None
 
     def __len__(self) -> int:
         with self._lock:

@@ -1,0 +1,70 @@
+"""Seeded violations for the lock-discipline rule's FLEET scope
+(shapes mirror fleet/fabric.py + fleet/admission.py: shard/budget state
+mutated outside its shard/fleet locks)."""
+
+import threading
+
+
+class Fabric:
+    def __init__(self):
+        self._budget_lock = threading.Lock()
+        self._by_session = {}  # __init__ is exempt: not shared yet
+        self._tenant_bytes = {}
+        self._total_bytes = 0
+
+    def account(self, session, tenant, est):
+        self._by_session[session.session_id] = (session, tenant, est)  # SEED: lock-discipline
+        self._tenant_bytes[tenant] = est  # SEED: lock-discipline
+        self._total_bytes += est  # SEED: lock-discipline
+
+    def account_properly(self, session, tenant, est):
+        with self._budget_lock:
+            self._by_session[session.session_id] = (session, tenant, est)
+            self._tenant_bytes[tenant] = est
+            self._total_bytes += est
+
+    def release_locked(self, sid, tenant, est):
+        # *_locked naming convention: caller holds the budget lock
+        del self._by_session[sid]
+        self._tenant_bytes[tenant] -= est
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._in_use = {}
+        self._granted = {}
+        self._tokens = 4.0
+
+    def grant(self, tenant, n):
+        self._in_use[tenant] = n  # SEED: lock-discipline
+        self._granted[tenant] = n  # SEED: lock-discipline
+
+    def grant_properly(self, tenant, n):
+        with self._lock:
+            self._in_use[tenant] = self._in_use.get(tenant, 0) + n
+            self._granted[tenant] = n
+
+    def take(self):
+        if self._tokens >= 1.0:  # SEED: lock-discipline
+            return True
+        return False
+
+    def take_properly(self):
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+def admit(registry, tenant):
+    entry = registry._tenants.get(tenant)  # SEED: lock-discipline
+    with registry._lock:
+        entry = registry._tenants.get(tenant)
+    return entry
+
+
+def audited(fabric):
+    # audited exemption: single-threaded harness, lock not needed
+    return fabric._total_bytes  # lint: unlocked-ok

@@ -1,0 +1,65 @@
+"""Clean twin of fleet_lock_bad.py: every shard/budget access sits
+under its lock (or a *_locked contract) — the rule must flag nothing."""
+
+import threading
+
+
+class Fabric:
+    def __init__(self):
+        self._budget_lock = threading.Lock()
+        self._by_session = {}
+        self._tenant_bytes = {}
+        self._total_bytes = 0
+
+    def account(self, session, tenant, est):
+        with self._budget_lock:
+            if session.evicted:
+                return
+            self._by_session[session.session_id] = (session, tenant, est)
+            self._tenant_bytes[tenant] = (
+                self._tenant_bytes.get(tenant, 0) + est
+            )
+            self._total_bytes += est
+
+    def on_evict(self, session, tenant, est):
+        with self._budget_lock:
+            self._by_session.pop(session.session_id, None)
+            self._tenant_bytes[tenant] -= est
+            self._total_bytes -= est
+
+    def snapshot(self):
+        with self._budget_lock:
+            return {
+                "total_bytes": self._total_bytes,
+                "tenant_bytes": dict(self._tenant_bytes),
+            }
+
+    def release_locked(self, sid, tenant, est):
+        # caller holds the budget lock by the naming convention
+        del self._by_session[sid]
+        self._tenant_bytes[tenant] -= est
+
+
+class Budget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._in_use = {}
+        self._granted = {}
+        self._tokens = 4.0
+
+    def grant(self, tenant, n):
+        with self._lock:
+            self._in_use[tenant] = self._in_use.get(tenant, 0) + n
+            self._granted[tenant] = self._granted.get(tenant, 0) + n
+
+    def take(self):
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
+def admit(registry, tenant):
+    with registry._lock:
+        return registry._tenants.get(tenant)

@@ -17,15 +17,23 @@ a matching nobody can replay. This rule makes the convention mechanical:
     the locked region's body — the caller holds the lock by the class's
     documented contract, and call sites are what this rule audits.
   * ``_sessions`` (the store registry) and ``_native_arena`` (the unary
-    arena) are guarded on ANY receiver, including ``self``.
+    arena) are guarded on ANY receiver, including ``self``. The fleet
+    layer's shard/budget state joins the same set: ``_by_session`` /
+    ``_tenant_bytes`` / ``_total_bytes`` / ``_pressure_evictions`` /
+    ``_evictions_by_tenant`` (the fabric's arena-budget accounting,
+    leaf ``_budget_lock``), ``_tenants`` (admission registry),
+    ``_tokens`` (token buckets), and ``_in_use`` / ``_granted`` (the
+    fair thread budget's per-tenant books).
 
 Escapes: methods named ``*_locked`` (the repo's called-under-lock naming
 convention), ``__init__``/``__post_init__`` (object not yet shared), and
 ``# lint: unlocked-ok`` on the line for audited exceptions.
 
-Scope: ``protocol_tpu/services/session_store.py`` and
+Scope: ``protocol_tpu/services/session_store.py``,
 ``protocol_tpu/services/scheduler_grpc.py`` (where the sharded-lock
-protocol lives).
+protocol lives), and the fleet layer (``protocol_tpu/fleet/fabric.py``,
+``protocol_tpu/fleet/admission.py``) whose shard and budget state is
+only ever mutated under its shard/fleet locks.
 """
 
 from __future__ import annotations
@@ -39,7 +47,14 @@ GUARDED_SESSION_ATTRS = {
     "delta_rows_total",
 }
 GUARDED_SESSION_CALLS = {"solve", "apply_delta"}
-GUARDED_ANY_RECEIVER = {"_sessions", "_native_arena"}
+GUARDED_ANY_RECEIVER = {
+    "_sessions", "_native_arena",
+    # fleet fabric budget accounting (leaf _budget_lock)
+    "_by_session", "_tenant_bytes", "_total_bytes",
+    "_pressure_evictions", "_evictions_by_tenant",
+    # admission registry + token buckets + fair-budget books
+    "_tenants", "_tokens", "_in_use", "_granted",
+}
 EXEMPT_FUNCS = {"__init__", "__post_init__"}
 
 
@@ -68,7 +83,10 @@ class LockDisciplineRule(Rule):
     suppress_token = "unlocked-ok"
 
     def applies(self, rel: str) -> bool:
-        return rel.endswith(("session_store.py", "scheduler_grpc.py"))
+        return rel.endswith((
+            "session_store.py", "scheduler_grpc.py",
+            "fleet/fabric.py", "fleet/admission.py",
+        ))
 
     def _inside_lock(self, src: Source, node: ast.AST) -> bool:
         for anc in src.ancestors(node):

@@ -50,10 +50,20 @@ must be bit-identical, and the consolidated /metrics scrape endpoint
 must honor the prometheus-optional degradation contract (200 with
 prometheus_client, clean 503 without; /metrics.json always 200).
 
+With ``--fleet`` it runs the multi-tenant fleet gate (ISSUE 7): N
+concurrent 512-scale trace-replayed sessions over a real localhost gRPC
+seam (the fleet loadgen) must hold per-tenant assigned fraction >=
+``fleet_min_assigned_frac``, per-tenant p99 warm-tick latency <=
+``fleet_p99_tick_ms_max``, complete every tick for every tenant (no
+starvation), and keep the per-session Jain fairness index >=
+``fleet_fairness_floor`` — so an admission/fairness/backpressure
+regression (or a sharded-fabric lock bug serializing tenants) cannot
+merge on green unit tests alone.
+
 Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
-[--trace] [--obs] (--update-floor rewrites perf_floor.json to 25% of
-this machine's measured rate — run on the slowest supported host class,
-then commit.)
+[--trace] [--obs] [--fleet] (--update-floor rewrites perf_floor.json to
+25% of this machine's measured rate — run on the slowest supported host
+class, then commit.)
 """
 
 import argparse
@@ -376,6 +386,83 @@ def obs_gate() -> int:
     return 0
 
 
+def fleet_gate() -> int:
+    """Multi-tenant fleet gate (the ISSUE 7 acceptance bar): 8
+    concurrent 512-scale sessions across 2 tenants and 2 shards on CPU
+    must hold per-tenant quality/latency floors with nobody starved."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from protocol_tpu.fleet.loadgen import run_load
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    sessions, tenants, ticks = 8, 2, 6
+    res = run_load(
+        sessions=sessions, tenants=tenants, providers=512, tasks=512,
+        ticks=ticks, churn=0.02, shards=2, kernel="native-mt:1",
+        max_workers=8,
+    )
+    for e in res["errors"]:
+        failures.append(f"session {e['session']} errored: {e['error']}")
+    per_tenant_ticks = (sessions // tenants) * (ticks + 1)
+    per_tenant_warm = (sessions // tenants) * ticks
+    for t, a in res["tenants"].items():
+        p99 = a["warm_tick"].get("p99_ms", 0.0)
+        warm_count = a["warm_tick"].get("count", 0)
+        print(
+            f"fleet gate: {t} sessions={a['sessions']} "
+            f"p50={a['warm_tick'].get('p50_ms')}ms p99={p99}ms "
+            f"min-assigned={a['min_assigned_frac']} "
+            f"ticks={a['ticks_done']}/{per_tenant_ticks} "
+            f"warm={warm_count}/{per_tenant_warm} "
+            f"refused={a['refused']} reopens={a['reopens']}"
+        )
+        if warm_count < per_tenant_warm:
+            # reopen-served ticks are classified COLD, so an
+            # eviction-thrash regression shows up as missing warm
+            # ticks — and a {count: 0} histogram must never slide
+            # past the p99 ceiling on its 0.0 default
+            failures.append(
+                f"tenant {t} recorded only {warm_count}/"
+                f"{per_tenant_warm} warm delta ticks — deltas were "
+                "refused or re-served via snapshot reopens"
+            )
+        if a["min_assigned_frac"] < floors["fleet_min_assigned_frac"]:
+            failures.append(
+                f"tenant {t} assigned fraction {a['min_assigned_frac']} "
+                f"below {floors['fleet_min_assigned_frac']}"
+            )
+        if p99 > floors["fleet_p99_tick_ms_max"]:
+            failures.append(
+                f"tenant {t} p99 warm tick {p99}ms over "
+                f"{floors['fleet_p99_tick_ms_max']}ms"
+            )
+        if a["ticks_done"] < per_tenant_ticks:
+            failures.append(
+                f"tenant {t} completed only {a['ticks_done']}/"
+                f"{per_tenant_ticks} ticks — starved"
+            )
+    fairness = res["fairness_index_sessions"]
+    print(
+        f"fleet gate: session fairness (Jain) {fairness} "
+        f"(floor {floors['fleet_fairness_floor']}), aggregate "
+        f"{res['aggregate_warm_ticks_per_s']} warm ticks/s"
+    )
+    if fairness < floors["fleet_fairness_floor"]:
+        failures.append(
+            f"session fairness index {fairness} below "
+            f"{floors['fleet_fairness_floor']}"
+        )
+    if not res["metrics_endpoint_ok"]:
+        failures.append("/metrics.json endpoint did not answer")
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("fleet perf gate OK")
+    return 0
+
+
 GOLDEN_TRACE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "artifacts", "golden_trace_512x512.trace",
@@ -468,6 +555,7 @@ def main() -> int:
     ap.add_argument("--sinkhorn", action="store_true")
     ap.add_argument("--trace", action="store_true")
     ap.add_argument("--obs", action="store_true")
+    ap.add_argument("--fleet", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
@@ -478,6 +566,8 @@ def main() -> int:
         return trace_gate()
     if args.obs:
         return obs_gate()
+    if args.fleet:
+        return fleet_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

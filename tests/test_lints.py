@@ -41,9 +41,13 @@ class TestRulesFireExactlyOnSeeds:
         [
             (DeterminismRule, "determinism_bad.py", "determinism_ok.py"),
             (LockDisciplineRule, "lock_bad.py", "lock_ok.py"),
+            (LockDisciplineRule, "fleet_lock_bad.py", "fleet_lock_ok.py"),
             (DenseAllocRule, "dense_bad.py", "dense_ok.py"),
         ],
-        ids=["determinism", "lock-discipline", "dense-alloc"],
+        ids=[
+            "determinism", "lock-discipline", "lock-discipline-fleet",
+            "dense-alloc",
+        ],
     )
     def test_seeds_and_clean_twin(self, rule_cls, bad, ok):
         rule = rule_cls()
