@@ -443,6 +443,84 @@ def device_healthy(timeout: float = 120.0) -> bool:
         return False
 
 
+def run_quality_bench(
+    P: int = 4096,
+    T: int = 4096,
+    churn: float = 0.01,
+    ticks: int = 12,
+    warmup: int = 2,
+    threads: int = 0,
+    engine: str = "auction",
+    seed: int = 0,
+) -> dict:
+    """Warm-chain arena bench WITH the decision-quality plane on: one
+    cold solve, ``warmup`` untimed churn ticks, then ``ticks`` timed
+    ticks at ``churn`` provider churn — reporting headline p50/p99 tick
+    walls, assigned fraction, and the quality scalars (certified
+    duality gap, plan churn ratio, starvation, unassigned causes) the
+    r06 bench round joins on."""
+    import dataclasses
+
+    from protocol_tpu.native.arena import NativeSolveArena
+    from protocol_tpu.obs.metrics import percentiles_ms
+
+    rng = np.random.default_rng(seed)
+    ep = synth_providers(rng, P)
+    er = synth_requirements(rng, T)
+    arena = NativeSolveArena(
+        threads=threads, engine="sinkhorn" if engine == "sinkhorn" else
+        "auction",
+    )
+    churn_rng = np.random.default_rng(seed + 1)
+
+    def _tick(e):
+        price = np.array(e.price, copy=True)
+        load = np.array(e.load, copy=True)
+        rows = churn_rng.choice(P, max(1, int(P * churn)), replace=False)
+        price[rows] = np.round(
+            np.clip(price[rows] + churn_rng.uniform(-0.5, 0.5, rows.size),
+                    0.05, None), 4
+        ).astype(price.dtype)
+        load[rows] = np.clip(
+            load[rows] + churn_rng.uniform(-0.2, 0.2, rows.size)
+            .astype(load.dtype), 0.0, 1.0
+        )
+        return dataclasses.replace(e, price=price, load=load)
+
+    t0 = time.perf_counter()
+    p4t = arena.solve(ep, er, CostWeights())
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    for _ in range(warmup):
+        ep = _tick(ep)
+        arena.solve(ep, er, CostWeights())
+    walls, quality_ticks = [], []
+    for _ in range(ticks):
+        ep = _tick(ep)
+        t0 = time.perf_counter()
+        p4t = arena.solve(ep, er, CostWeights())
+        walls.append((time.perf_counter() - t0) * 1e3)
+        quality_ticks.append({
+            k: v for k, v in arena.last_stats.items()
+            if isinstance(v, (int, float, bool))
+        })
+    assigned = int((p4t[:T] >= 0).sum())
+    from protocol_tpu.obs.quality import aggregate_quality
+
+    pct = percentiles_ms(walls)
+    return {
+        "P": P, "T": T, "churn": churn, "ticks": ticks,
+        "engine": engine, "threads": arena.threads,
+        "cold_ms": round(cold_ms, 3),
+        "p50_tick_ms": pct["p50_ms"],
+        "p99_tick_ms": pct["p99_ms"],
+        "mean_tick_ms": round(float(np.mean(walls)), 3),
+        "assigned_frac": round(assigned / T, 6),
+        # the shared canonical roll-up (same vocabulary as replay
+        # reports and obs report — cross-round joins stay schema-stable)
+        "quality": aggregate_quality(quality_ticks) or {},
+    }
+
+
 def parse_kv_args(argv: list[str]) -> dict[str, str]:
     """``engine=native-mt threads=4``-style arguments (ignores flags)."""
     out: dict[str, str] = {}
@@ -456,6 +534,42 @@ def parse_kv_args(argv: list[str]) -> dict[str, str]:
 def main() -> None:
     global P, T, TILE
     args = parse_kv_args(sys.argv[1:])
+    if args.get("quality"):
+        # quality=1 [p= t= churn= ticks= threads= engine= out=]: the
+        # r06 bench round — warm-chain arena ticks with the decision-
+        # quality plane on. Stable metric name, platform field per the
+        # PR 3 convention, quality scalars nested so cross-round joins
+        # (BENCH_r0*.json) survive schema growth.
+        jax.config.update("jax_platforms", "cpu")
+        res = run_quality_bench(
+            P=int(args.get("p", "4096")),
+            T=int(args.get("t", "4096")),
+            churn=float(args.get("churn", "0.01")),
+            ticks=int(args.get("ticks", "12")),
+            threads=int(args.get("threads", "0") or 0),
+            engine=args.get("engine", "auction"),
+        )
+        headline = {
+            "metric": (
+                f"warm_tick_quality_{res['P']}x{res['T']}_"
+                f"churn{res['churn']}"
+            ),
+            "platform": "native_cpu_engine_requested",
+            "value": res["p50_tick_ms"],
+            "unit": "ms_per_warm_tick_p50",
+            "p50_tick_ms": res["p50_tick_ms"],
+            "p99_tick_ms": res["p99_tick_ms"],
+            "assigned_frac": res["assigned_frac"],
+            "quality": res["quality"],
+        }
+        out_path = args.get("out")
+        if out_path:
+            with open(out_path, "w") as fh:
+                json.dump({**headline, "detail": res}, fh, indent=1)
+                fh.write("\n")
+            log(f"wrote {out_path}")
+        print(json.dumps(headline))
+        return
     wire = args.get("wire")
     if wire:
         # wire=v1|v2|both: loopback wire-path bench (the scheduler seam

@@ -20,6 +20,7 @@
 // incompatible pairs. Build: make native  (g++ -O3 -shared -fPIC).
 
 #include <algorithm>
+#include <atomic>
 #include <cfloat>
 #include <chrono>
 #include <cmath>
@@ -27,6 +28,7 @@
 #include <cstdint>
 #include <cstring>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <queue>
 #include <thread>
@@ -1103,7 +1105,30 @@ int32_t auction_sparse(const int32_t* cand_provider, const float* cand_cost,
 //   [3] repair passes that evicted >= 1 seat [4] eps phases
 //   [5] repair ns        [6] bid ns          [7] merge ns
 //   [8] cleanup ns       [9] tasks retired at exit
+//   [10] outcome/margin pass ns (the decision-observability layer)
+//   [11] plan cost over the candidate support, 1e-6 cost units
+//   [12] reachable-idle price mass and [13] eps-CS slack (the two
+//        duality-gap certificate addends, prices capped at the give-up
+//        magnitude), 1e-6 cost units — filled only with margin_out
 // Accumulated on the calling thread only; null skips every clock read.
+//
+// outcome_out: nullable [T] u8 — the per-task DECISION taxonomy (the
+//   quality plane's native layer, same null-means-zero-overhead contract
+//   as stats_out):
+//     0 assigned
+//     1 unassigned: no feasible candidates at all
+//     2 unassigned: outbid / priced past give-up this solve (or left
+//       open when the event budget ran out)
+//     3 unassigned: carried (stale) retirement — the task entered
+//       retired and nothing re-opened it this solve
+//   Causes are recorded in the SEQUENTIAL merge and the exit loop, both
+//   on the calling thread; helper threads never touch the array.
+// margin_out: nullable [T] f32 — for assigned tasks, the winner margin
+//   at FINAL prices: value(seat) - best value over the task's OTHER
+//   candidates (runner-up floored at -1e8, mirroring the bid math's
+//   single-option floor). 0 for unassigned tasks. One O(T*K) post-pass
+//   on the calling thread; prices/matching are bit-identical with or
+//   without it.
 // Returns the number of assigned tasks.
 int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
                           int32_t P, int32_t T, int32_t K, float eps_start,
@@ -1112,10 +1137,17 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
                           const int32_t* p4t_seed, int32_t max_release,
                           const uint8_t* repair_mask,
                           int32_t* out_provider_for_task,
-                          int64_t* stats_out) {
+                          int64_t* stats_out, uint8_t* outcome_out,
+                          float* margin_out) {
   const bool st = stats_out != nullptr;
   if (st) std::memset(stats_out, 0, kEngineStatsSlots * 8);
   int64_t t_phase = 0;
+  const bool oc = outcome_out != nullptr;
+  // per-task retirement cause recorded during THIS solve (0 = none):
+  // only touched by the sequential merge / exit loop on the calling
+  // thread, and only allocated when the caller asked for outcomes
+  std::vector<uint8_t> cause;
+  if (oc) cause.assign(T, 0);
   std::vector<float> price(price_io, price_io + P);
   std::vector<int32_t> owner(P, -1);
   std::vector<int32_t> p4t(T, -1);
@@ -1300,10 +1332,14 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
         if (st && p >= 0) ++stats_out[1];
         if (p == -2) {
           retired[t] = 1;
+          if (oc) cause[t] = 1;  // no feasible candidates at all
           continue;
         }
         if (p == -3) {
-          if (final_phase) retired[t] = 1;
+          if (final_phase) {
+            retired[t] = 1;
+            if (oc) cause[t] = 2;  // priced out past give-up
+          }
           continue;  // parked: re-collected at the next phase
         }
         if (win_task[p] < 0) {
@@ -1342,7 +1378,8 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
     if (eps <= eps_end || events >= max_events) break;
     eps = std::max(eps * scale, eps_end);
   }
-  delete pool;
+  // pool deliberately outlives the bid loop: the margin/certificate
+  // post-pass below reuses it (helpers idle-wait in between)
   if (st) t_phase = now_ns();
 
   // Cleanup pass (same tail semantics as the Gauss-Seidel engine): a
@@ -1373,6 +1410,40 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
   for (int32_t t = 0; t < T; ++t) {
     out_provider_for_task[t] = p4t[t];
     if (p4t[t] >= 0) ++assigned;
+    if (oc) {
+      // carried-vs-fresh retirement is decided BEFORE retired_io is
+      // overwritten below: a task that entered retired and recorded no
+      // fresh cause this solve is the stale-retired class
+      uint8_t code;
+      if (p4t[t] >= 0) {
+        code = 0;  // assigned (bid, seed carry, or cleanup seat)
+      } else {
+        // a row whose every slot is empty OR infeasible-cost has no
+        // feasible candidates, whatever the bid loop called it (an
+        // infeasible-cost edge parks as "priced out" there because the
+        // classification would cost a compare per slot per round; here
+        // it is one scan per UNASSIGNED task at exit)
+        bool any_feas = false;
+        const int64_t row = static_cast<int64_t>(t) * K;
+        for (int32_t j = 0; j < K; ++j) {
+          const int32_t p = cand_provider[row + j];
+          if (p >= 0 && cand_cost[row + j] < kInfeasible * 0.5f) {
+            any_feas = true;
+            break;
+          }
+        }
+        if (!any_feas) {
+          code = 1;  // no feasible candidates at all
+        } else if (cause[t] != 0) {
+          code = cause[t];  // retired THIS solve: no_candidates / give-up
+        } else if (retired_io[t]) {
+          code = 3;  // carried (stale) retirement, untouched this solve
+        } else {
+          code = 2;  // open at exit: outbid / event budget exhausted
+        }
+      }
+      outcome_out[t] = code;
+    }
     // the RAW flag is carried (a cleanup-seated retired task stays
     // retired): masking by seat here would launder the flag away and
     // re-open the task every warm solve — see the seeding note above
@@ -1381,6 +1452,120 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
   }
   std::memcpy(price_io, price.data(), static_cast<size_t>(P) * 4);
   if (st) stats_out[8] = now_ns() - t_phase;
+  if (margin_out != nullptr) {
+    // winner margin vs runner-up at FINAL prices, one O(T*K) post-pass
+    // on the calling thread — reads only converged state, writes only
+    // margin_out, so the matching/prices are untouched by construction.
+    // The same walk accumulates the DUALITY-GAP certificate (stats
+    // slots [11] plan cost, [12] reachable-idle price, [13] eps-CS
+    // slack, all 1e-6 cost units):
+    //   gap = cs_slack + idle_price
+    // bounds the plan's distance from the optimal assignment of the
+    // same task set on the same candidate support. The certificate's
+    // dual point uses prices CAPPED at the give-up magnitude — any
+    // nonnegative dual vector certifies, and the cap strips the
+    // single-option bid floor's ~1e8 price spikes (real competitive
+    // prices never exceed willingness-to-pay, which give_up bounds)
+    // without loosening converged marketplaces, where every price is
+    // already below it. Margins stay RAW: attribution reports the
+    // price the economy actually charged.
+    if (st) t_phase = now_ns();
+    const float cert_cap = 2.0f * max_cost + 10.0f;
+    // capped dual point hoisted to one min per PROVIDER (the per-edge
+    // min was a measurable share of the serial pass at 16k)
+    std::vector<float> capped(P);
+    for (int32_t p = 0; p < P; ++p) capped[p] = std::min(price[p], cert_cap);
+    // reach marks feed only the idle-price addend, so busy providers —
+    // nearly every edge of a converged marketplace — never store;
+    // relaxed atomics make the surviving same-value marks race-free
+    std::unique_ptr<std::atomic<uint8_t>[]> reach;
+    if (st) {
+      reach.reset(new std::atomic<uint8_t>[P]);
+      for (int32_t p = 0; p < P; ++p)
+        reach[p].store(0, std::memory_order_relaxed);
+    }
+    // FIXED-size chunks, each writing its own double partials, summed in
+    // chunk order by the caller: the certificate is bit-identical for
+    // every thread count (which thread computes a chunk never affects
+    // its value), exactly the bid loop's invariance argument
+    constexpr int32_t kCertChunk = 2048;
+    const int32_t n_chunks = (T + kCertChunk - 1) / kCertChunk;
+    std::vector<double> chunk_cost(n_chunks, 0.0);
+    std::vector<double> chunk_slack(n_chunks, 0.0);
+    std::atomic<int32_t> next_chunk{0};
+    const auto cert_body = [&](int) {
+      for (;;) {
+        const int32_t ci =
+            next_chunk.fetch_add(1, std::memory_order_relaxed);
+        if (ci >= n_chunks) break;
+        const int32_t lo = ci * kCertChunk;
+        const int32_t hi = std::min(lo + kCertChunk, T);
+        double pc = 0.0, sl = 0.0;
+        for (int32_t t = lo; t < hi; ++t) {
+          const int32_t seat = p4t[t];
+          if (seat < 0) {
+            margin_out[t] = 0.0f;
+            continue;
+          }
+          float vseat = kNeg, vother = kNeg;
+          float seat_c = kInfeasible;
+          double best_adj = kInfeasible;
+          const int64_t row = static_cast<int64_t>(t) * K;
+          for (int32_t j = 0; j < K; ++j) {
+            const int32_t p = cand_provider[row + j];
+            if (p < 0) continue;
+            const float c = cand_cost[row + j];
+            const float v = -c - price[p];
+            if (p == seat) {
+              if (v > vseat) {
+                vseat = v;
+                seat_c = c;  // cheapest seat slot (same price => min c)
+              }
+            } else if (v > vother) {
+              vother = v;
+            }
+            if (st && c < kInfeasible * 0.5f) {
+              const double adj = c + static_cast<double>(capped[p]);
+              if (adj < best_adj) best_adj = adj;
+              if (owner[p] < 0)
+                reach[p].store(1, std::memory_order_relaxed);
+            }
+          }
+          if (vother < -1e8f) vother = -1e8f;  // single-option floor
+          margin_out[t] = vseat - vother;
+          if (st && seat_c < kInfeasible * 0.5f) {
+            pc += seat_c;
+            const double seat_adj =
+                seat_c + static_cast<double>(capped[seat]);
+            if (seat_adj > best_adj) sl += seat_adj - best_adj;
+          }
+        }
+        chunk_cost[ci] = pc;
+        chunk_slack[ci] = sl;
+      }
+    };
+    if (pool != nullptr)
+      pool->run(cert_body);
+    else
+      cert_body(0);
+    if (st) {
+      double plan_cost = 0.0, cs_slack = 0.0;
+      for (int32_t ci = 0; ci < n_chunks; ++ci) {
+        plan_cost += chunk_cost[ci];
+        cs_slack += chunk_slack[ci];
+      }
+      double idle = 0.0;
+      for (int32_t p = 0; p < P; ++p) {
+        if (reach[p].load(std::memory_order_relaxed) && owner[p] < 0)
+          idle += capped[p];
+      }
+      stats_out[11] = llround(plan_cost * 1e6);
+      stats_out[12] = llround(idle * 1e6);
+      stats_out[13] = llround(cs_slack * 1e6);
+      stats_out[10] = now_ns() - t_phase;
+    }
+  }
+  delete pool;
   return assigned;
 }
 
@@ -1423,12 +1608,25 @@ int32_t auction_sparse_mt(const int32_t* cand_provider, const float* cand_cost,
 // stats_out: nullable, kEngineStatsSlots i64 slots —
 //   [0] iterations   [1] CSR-transpose build ns   [2] f-update ns
 //   [3] g-update ns  [4] marginal-drift check ns  [5] nnz edges
+//   [6] outcome/margin pass ns
 // Accumulated on the calling thread only; null skips every clock read.
+//
+// outcome_out: nullable [T] u8 — per-task support taxonomy at the
+//   ENTROPIC layer (the injective seat taxonomy comes from the auction
+//   referee downstream): 0 = the task has feasible candidate support,
+//   1 = no feasible candidates at all (the transport plan cannot touch
+//   it). margin_out: nullable [T] f32 — the entropic argmax margin in
+//   cost units, best vs runner-up f_p - c over the task's feasible
+//   candidates at the FINAL potentials (runner-up floored at -1e8 like
+//   the auction's single-option floor; 0 for unsupported tasks). One
+//   O(T*K) post-pass on the calling thread; null means zero overhead
+//   and the potentials are bit-identical either way.
 int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
                            const float* cand_cost, int32_t P, int32_t T,
                            int32_t K, float eps, int32_t max_iters, float tol,
                            int32_t threads, float* f_io, float* g_io,
-                           float* out_err, int64_t* stats_out) {
+                           float* out_err, int64_t* stats_out,
+                           uint8_t* outcome_out, float* margin_out) {
   const bool st = stats_out != nullptr;
   if (st) std::memset(stats_out, 0, kEngineStatsSlots * 8);
   int64_t t_phase = st ? now_ns() : 0;
@@ -1467,6 +1665,10 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
   }
   if (np_valid == 0 || nt_valid == 0) {
     if (out_err != nullptr) *out_err = 0.0f;
+    for (int32_t t = 0; t < T; ++t) {
+      if (outcome_out != nullptr) outcome_out[t] = col_any[t] ? 0 : 1;
+      if (margin_out != nullptr) margin_out[t] = 0.0f;
+    }
     return 0;
   }
   const double m = static_cast<double>(std::min(np_valid, nt_valid));
@@ -1605,6 +1807,37 @@ int32_t sinkhorn_sparse_mt(const int32_t* cand_provider,
   }
   delete pool;
   if (out_err != nullptr) *out_err = static_cast<float>(err);
+  if (outcome_out != nullptr || margin_out != nullptr) {
+    // support taxonomy + entropic argmax margin at the final potentials:
+    // one O(T*K) pass on the calling thread, results untouched
+    if (st) t_phase = now_ns();
+    for (int32_t t = 0; t < T; ++t) {
+      const bool has = col_any[t] != 0;
+      if (outcome_out != nullptr) outcome_out[t] = has ? 0 : 1;
+      if (margin_out == nullptr) continue;
+      if (!has) {
+        margin_out[t] = 0.0f;
+        continue;
+      }
+      const int64_t row = static_cast<int64_t>(t) * K;
+      float v1 = kNeg, v2 = kNeg;
+      for (int32_t j = 0; j < K; ++j) {
+        const int32_t p = cand_provider[row + j];
+        if (p < 0 || p >= P ||
+            cand_cost[row + j] >= kInfeasible * 0.5f) continue;
+        const float v = f_io[p] - cand_cost[row + j];
+        if (v > v1) {
+          v2 = v1;
+          v1 = v;
+        } else if (v > v2) {
+          v2 = v;
+        }
+      }
+      if (v2 < -1e8f) v2 = -1e8f;  // single-option floor
+      margin_out[t] = v1 - v2;
+    }
+    if (st) stats_out[6] = now_ns() - t_phase;
+  }
   if (st) stats_out[0] = it;
   return it;
 }

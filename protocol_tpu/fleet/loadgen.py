@@ -375,6 +375,29 @@ def run_load(
             # index down, not silently vanish from it
             session_rates.append(len(st.warm) / st.wall_s)
 
+    obs_tenants = obs_snapshot.get("tenants", {})
+    slo_snap = obs_snapshot.get("slo", {})
+
+    def _tenant_quality(t: str) -> dict:
+        """WHO was unassigned and WHY, not just the assigned fraction:
+        per-tenant max starvation age + the unassigned-cause counters
+        from the server's quality plane (empty dict for traces recorded
+        before the plane existed)."""
+        q = (obs_tenants.get(t) or {}).get("quality")
+        out: dict = {}
+        if q:
+            out["starve_max_age"] = q["starvation"]["max_age"]
+            causes = dict(q.get("outcomes") or {})
+            causes.pop("assigned", None)
+            out["unassigned_causes"] = causes
+            gap = q.get("gap_per_task")
+            if gap:
+                out["gap_per_task_max"] = gap["max"]
+        fired = (slo_snap.get("fired_by_tenant") or {}).get(t)
+        if fired:
+            out["slo_alerts_fired"] = fired
+        return out
+
     tenants_out = {
         t: {
             "sessions": a["sessions"],
@@ -384,6 +407,7 @@ def run_load(
             "ticks_done": a["ticks_done"],
             "refused": a["refused"],
             "reopens": a["reopens"],
+            **_tenant_quality(t),
         }
         for t, a in sorted(by_tenant.items())
     }
@@ -465,11 +489,23 @@ def _print_report(rep: dict) -> None:
     print(hdr)
     for t, a in rep["tenants"].items():
         warm = a["warm_tick"]
+        quality = ""
+        if "starve_max_age" in a:
+            causes = a.get("unassigned_causes") or {}
+            cause_s = " ".join(
+                f"{k}={v}" for k, v in sorted(causes.items()) if v
+            )
+            quality = (
+                f"  starve<={a['starve_max_age']}"
+                + (f" [{cause_s}]" if cause_s else "")
+            )
+        if a.get("slo_alerts_fired"):
+            quality += f"  SLO-fired={a['slo_alerts_fired']}"
         print(
             f"  {t:<8} {a['sessions']:>4} "
             f"{warm.get('p50_ms', 0):>8} {warm.get('p99_ms', 0):>8} "
             f"{a['min_assigned_frac']:>12} {a['refused']:>8} "
-            f"{a['reopens']:>8}"
+            f"{a['reopens']:>8}{quality}"
         )
     fl = rep["server_obs"].get("fleet", {})
     if fl:

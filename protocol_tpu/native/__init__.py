@@ -207,17 +207,21 @@ def load() -> ctypes.CDLL:
     )
     lib.fused_topk_candidates_mt.restype = None
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    # ... plus the trailing nullable per-task outcome + margin buffers
+    # (the decision-observability layer; null = zero overhead)
     lib.auction_sparse_mt.argtypes = [
         i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int64,
         ctypes.c_int32, f32p, u8p, ctypes.c_void_p, ctypes.c_int32,
-        ctypes.c_void_p, i32p, ctypes.c_void_p,
+        ctypes.c_void_p, i32p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_void_p,
     ]
     lib.auction_sparse_mt.restype = ctypes.c_int32
     lib.sinkhorn_sparse_mt.argtypes = [
         i32p, f32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_float, ctypes.c_int32, ctypes.c_float, ctypes.c_int32,
         f32p, f32p, ctypes.POINTER(ctypes.c_float), ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p,
     ]
     lib.sinkhorn_sparse_mt.restype = ctypes.c_int32
     _libs[variant] = lib
@@ -229,6 +233,24 @@ def load() -> ctypes.CDLL:
 # must match kEngineStatsSlots in assign_engine.cpp
 ENGINE_STATS_SLOTS = 16
 
+# --------------- per-task outcome taxonomy (quality plane) ---------------
+#
+# The decision-observability layer: what happened to each task, and by
+# how much the winner won. Codes must match the engine's exit-loop
+# assignment in assign_engine.cpp; the names are the wire/report
+# vocabulary every layer above (arena stats, obs registry, trace
+# outcome frames, the obs report's cause table) shares.
+OUTCOME_ASSIGNED = 0
+OUTCOME_NO_CANDIDATES = 1
+OUTCOME_OUTBID = 2
+OUTCOME_RETIRED = 3
+OUTCOME_NAMES = {
+    OUTCOME_ASSIGNED: "assigned",
+    OUTCOME_NO_CANDIDATES: "unassigned:no_candidates",
+    OUTCOME_OUTBID: "unassigned:outbid",
+    OUTCOME_RETIRED: "unassigned:retired",
+}
+
 # per-kernel slot layouts: name -> slot index; *_ns slots are converted
 # to *_ms float keys by _parse_stats
 _FUSED_STATS = {
@@ -238,12 +260,31 @@ _FUSED_STATS = {
 _AUCTION_STATS = {
     "rounds": 0, "bids": 1, "evicted": 2, "repair_passes": 3,
     "eps_phases": 4, "repair_ns": 5, "bid_ns": 6, "merge_ns": 7,
-    "cleanup_ns": 8, "retired": 9,
+    "cleanup_ns": 8, "retired": 9, "quality_ns": 10,
+    # duality-gap certificate addends, accumulated in the margin pass
+    # (1e-6 cost units on the wire, floats after parsing; certificate
+    # prices capped at the give-up magnitude — see the engine comment)
+    "plan_cost_u6": 11, "idle_price_u6": 12, "cs_slack_u6": 13,
 }
 _SINKHORN_STATS = {
     "sink_iters": 0, "sink_csr_ns": 1, "sink_f_ns": 2, "sink_g_ns": 3,
-    "sink_err_ns": 4, "sink_nnz": 5,
+    "sink_err_ns": 4, "sink_nnz": 5, "sink_quality_ns": 6,
 }
+
+
+def _outcome_bufs(outcomes, n_tasks: int) -> tuple:
+    """(codes u8[T], margin f32[T], code ptr, margin ptr) for an
+    outcomes dict request; all None when the caller passed None (the
+    engine then skips the post-pass entirely)."""
+    if outcomes is None:
+        return None, None, None, None
+    codes = np.zeros(n_tasks, np.uint8)
+    margin = np.zeros(n_tasks, np.float32)
+    return (
+        codes, margin,
+        codes.ctypes.data_as(ctypes.c_void_p),
+        margin.ctypes.data_as(ctypes.c_void_p),
+    )
 
 
 def _stats_buf(stats) -> tuple:
@@ -266,6 +307,10 @@ def _parse_stats(stats: dict, buf, layout: dict) -> None:
         if name.endswith("_ns"):
             key = name[:-3] + "_ms"
             stats[key] = round(stats.get(key, 0.0) + v / 1e6, 3)
+        elif name.endswith("_u6"):
+            # cost-unit scalars shipped as 1e-6 fixed point (i64 slots)
+            key = name[:-3]
+            stats[key] = round(stats.get(key, 0.0) + v / 1e6, 6)
         elif name.endswith("_threads"):
             stats[name] = v  # a setting, not a counter: last write wins
         else:
@@ -438,6 +483,7 @@ def auction_sparse_mt(
     max_release: int = 0,
     repair_mask: Optional[np.ndarray] = None,
     stats: Optional[dict] = None,
+    outcomes: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Deterministic parallel auction (engine=native-mt): synchronous
     Jacobi bidding rounds — per-thread bid buffers against a shared price
@@ -470,6 +516,15 @@ def auction_sparse_mt(
     ``bids``, ``evicted``, ``repair_passes``, ``eps_phases``,
     ``retired``, and ``repair_ms``/``bid_ms``/``merge_ms``/
     ``cleanup_ms`` phase walls). Stats never feed solver state.
+
+    ``outcomes``: optional dict the call fills with the per-task
+    decision taxonomy — ``codes`` (u8 [T]: ``OUTCOME_ASSIGNED`` /
+    ``OUTCOME_NO_CANDIDATES`` / ``OUTCOME_OUTBID`` /
+    ``OUTCOME_RETIRED``, see ``OUTCOME_NAMES``) and ``margin`` (f32 [T]:
+    winner margin vs runner-up at final prices for assigned tasks, 0
+    otherwise). Same contract as ``stats``: None means the engine skips
+    the pass entirely, and the matching/prices/retirement are
+    bit-identical with or without the buffers.
 
     Returns (provider_for_task [T] i32, price [P] f32, retired [T] bool).
     """
@@ -518,14 +573,18 @@ def auction_sparse_mt(
         mask_ptr = mask_arr.ctypes.data_as(ctypes.c_void_p)
     out = np.empty(T, np.int32)
     buf, stats_ptr = _stats_buf(stats)
+    oc_codes, oc_margin, oc_ptr, mg_ptr = _outcome_bufs(outcomes, T)
     lib.auction_sparse_mt(
         cand_p, cand_c, num_providers, T, K,
         eps_start, eps_end, scale, max_events, int(threads),
         price_io, retired_io, seed_ptr, int(max_release), mask_ptr, out,
-        stats_ptr,
+        stats_ptr, oc_ptr, mg_ptr,
     )
     if stats is not None:
         _parse_stats(stats, buf, _AUCTION_STATS)
+    if outcomes is not None:
+        outcomes["codes"] = oc_codes
+        outcomes["margin"] = oc_margin
     return out, price_io, retired_io.astype(bool)
 
 
@@ -540,6 +599,7 @@ def sinkhorn_sparse_mt(
     f: Optional[np.ndarray] = None,
     g: Optional[np.ndarray] = None,
     stats: Optional[dict] = None,
+    outcomes: Optional[dict] = None,
 ) -> tuple[np.ndarray, np.ndarray, int, float]:
     """One eps phase of the sparse multi-threaded Sinkhorn engine
     (engine=sinkhorn-mt): log-domain entropic OT restricted to the top-K
@@ -558,6 +618,15 @@ def sinkhorn_sparse_mt(
 
     Iterates until the provider-marginal drift falls below ``tol`` or
     ``max_iters`` runs out (task marginals are exact after every update).
+
+    ``outcomes``: optional dict filled with the entropic-layer taxonomy
+    — ``codes`` (u8 [T]: 0 = feasible candidate support,
+    ``OUTCOME_NO_CANDIDATES`` = the plan cannot touch the task) and
+    ``margin`` (f32 [T]: argmax margin of ``f_p - c`` over the task's
+    candidates at the final potentials, in cost units). The injective
+    seat taxonomy comes from the auction referee downstream; None means
+    zero overhead and bit-identical potentials.
+
     Returns (f, g, iterations_run, final_marginal_err).
     """
     lib = load()
@@ -584,13 +653,17 @@ def sinkhorn_sparse_mt(
         raise ValueError(f"g has {g_io.shape[0]} rows, want {T}")
     err = ctypes.c_float(0.0)
     buf, stats_ptr = _stats_buf(stats)
+    oc_codes, oc_margin, oc_ptr, mg_ptr = _outcome_bufs(outcomes, T)
     iters = lib.sinkhorn_sparse_mt(
         cand_p, cand_c, num_providers, T, K,
         float(eps), int(max_iters), float(tol), int(threads),
-        f_io, g_io, ctypes.byref(err), stats_ptr,
+        f_io, g_io, ctypes.byref(err), stats_ptr, oc_ptr, mg_ptr,
     )
     if stats is not None:
         _parse_stats(stats, buf, _SINKHORN_STATS)
+    if outcomes is not None:
+        outcomes["codes"] = oc_codes
+        outcomes["margin"] = oc_margin
     return f_io, g_io, int(iters), float(err.value)
 
 

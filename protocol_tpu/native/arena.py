@@ -39,6 +39,7 @@ from typing import Optional
 import numpy as np
 
 from protocol_tpu import native, obs
+from protocol_tpu.obs import quality as _quality
 from protocol_tpu.obs.spans import TRACER as _tracer
 
 # canonical dtypes per encoded field (mirrors native.fused_topk_candidates'
@@ -206,6 +207,12 @@ class NativeSolveArena:
         self._sink_stats: dict = {}
         self._warm_solves = 0
         self._dual_age = 0
+        # quality plane (obs): per-task consecutive-unassigned ages and
+        # the last computed quality scalars (reused verbatim by the
+        # byte-identical short-circuit tick — nothing changed, so the
+        # gap/outcome certificate is still exact)
+        self._starve_age: Optional[np.ndarray] = None
+        self._last_quality: dict = {}
 
     # ---------------- internals ----------------
 
@@ -232,6 +239,7 @@ class NativeSolveArena:
         seed: Optional[np.ndarray] = None,
         max_release: int = 0,
         eng: Optional[dict] = None,
+        outs: Optional[dict] = None,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """The sinkhorn engine's solve stage over the CURRENT cached
         candidate structure: entropic potentials (cold: the full anneal
@@ -298,14 +306,43 @@ class NativeSolveArena:
             threads=self.threads,
             price=price0, retired=retired,
             seed_provider_for_task=seed, max_release=max_release,
-            stats=eng,
+            stats=eng, outcomes=outs,
         )
+
+    def _quality_pass(
+        self,
+        rf: dict,
+        p4t: np.ndarray,
+        price: Optional[np.ndarray],
+        prev_p4t: Optional[np.ndarray],
+        outs: Optional[dict],
+        eng: Optional[dict] = None,
+    ) -> dict:
+        """The decision-quality record for one solve (obs plane on):
+        certified duality gap from the carried duals, plan churn vs the
+        previous tick, starvation ages, and the native outcome taxonomy
+        — flat scalars for ``last_stats`` (wall in ``quality_ms``).
+        Timings and certificates ride NEXT TO the result, never into
+        it."""
+        t0 = time.perf_counter()
+        stats, self._starve_age = _quality.tick_quality(
+            self._cand_p, self._cand_c, p4t, price,
+            valid=rf["valid"].astype(bool),
+            prev_p4t=prev_p4t,
+            starve_age=self._starve_age,
+            outcomes=outs,
+            eng=eng,
+        )
+        stats["quality_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        self._last_quality = stats
+        return stats
 
     def _cold(self, ep, er, weights, pf, rf, P, T) -> np.ndarray:
         # engine phase stats (the obs plane's native layer): one dict
         # accumulates across every kernel call of this solve; timings
         # ride NEXT TO the result, never into it
         eng: Optional[dict] = {} if obs.enabled() else None
+        outs: Optional[dict] = {} if obs.enabled() else None
         t0 = time.perf_counter()
         with _tracer.span("arena.candidates", cold=True, tasks=T):
             cand_p, cand_c = native.fused_topk_candidates(
@@ -318,13 +355,13 @@ class NativeSolveArena:
             if self.engine == "sinkhorn":
                 self._f = self._g = None
                 p4t, price, retired = self._sinkhorn_round(
-                    P, warm=False, eng=eng
+                    P, warm=False, eng=eng, outs=outs
                 )
             else:
                 p4t, price, retired = native.auction_sparse_mt(
                     cand_p, cand_c, num_providers=P,
                     eps_start=self.eps_start, eps_end=self.eps_end,
-                    threads=self.threads, stats=eng,
+                    threads=self.threads, stats=eng, outcomes=outs,
                 )
         t_solve = time.perf_counter()
         self._p_fields, self._r_fields = pf, rf
@@ -332,7 +369,15 @@ class NativeSolveArena:
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves = 0
         self._dual_age = 0
+        # a cold solve starts the starvation clock fresh (everything was
+        # re-seated from scratch); churn vs a pre-cold plan is undefined
+        self._starve_age = None
+        qual = (
+            self._quality_pass(rf, p4t, price, None, outs, eng)
+            if obs.enabled() else {}
+        )
         self.last_stats = {
+            **qual,
             "cold": True,
             "engine": self.engine,
             "rows": T,
@@ -499,7 +544,34 @@ class NativeSolveArena:
             # byte-identical marketplace: the carried matching IS the
             # solve (prices/retirement already consistent with it)
             self._warm_solves += 1
+            qual: dict = {}
+            if obs.enabled():
+                # nothing changed, so the carried gap/outcome
+                # certificate is still exact — reuse it instead of
+                # re-scanning [T x K]; only the tick-indexed signals
+                # (starvation ages, zero churn) advance
+                t_q = time.perf_counter()
+                self._starve_age = _quality.starvation_update(
+                    self._starve_age, self._p4t,
+                    rf["valid"].astype(bool),
+                )
+                qual = dict(self._last_quality)
+                qual["churn_rows"] = 0
+                qual["churn_ratio"] = 0.0
+                qual["starve_max"] = (
+                    int(self._starve_age.max())
+                    if self._starve_age.size else 0
+                )
+                qual["starving"] = int((self._starve_age > 0).sum())
+                qual["starve_hist"] = _quality.starvation_hist(
+                    self._starve_age
+                )
+                qual["quality_ms"] = round(
+                    (time.perf_counter() - t_q) * 1e3, 3
+                )
+                self._last_quality = qual
             self.last_stats = {
+                **qual,
                 "cold": False,
                 "rows": T,
                 "dirty_providers": 0,
@@ -511,6 +583,11 @@ class NativeSolveArena:
             return self._p4t.copy()
 
         eng: Optional[dict] = {} if obs.enabled() else None
+        outs: Optional[dict] = {} if obs.enabled() else None
+        # the previous tick's plan, captured BEFORE the dirty-task
+        # re-seat below mutates it in place — the churn ratio compares
+        # plan-to-plan, not plan-to-scratchpad
+        prev_p4t = self._p4t.copy() if obs.enabled() else None
         t_start = time.perf_counter()
         old_price = self._p_fields["price"]
         old_load = self._p_fields["load"]
@@ -618,7 +695,7 @@ class NativeSolveArena:
             # solve, so they cannot ratchet the way auction prices do
             if dual_refresh:
                 p4t, price, retired = self._sinkhorn_round(
-                    P, warm=True, eng=eng
+                    P, warm=True, eng=eng, outs=outs
                 )
                 self._dual_age = 0
             else:
@@ -627,14 +704,14 @@ class NativeSolveArena:
                     retired=self._retired & ~changed,
                     seed=self._p4t,
                     max_release=self.max_release,
-                    eng=eng,
+                    eng=eng, outs=outs,
                 )
                 self._dual_age += 1
         elif dual_refresh:
             p4t, price, retired = native.auction_sparse_mt(
                 self._cand_p, self._cand_c, num_providers=P,
                 eps_start=self.eps_start, eps_end=self.eps_end,
-                threads=self.threads, stats=eng,
+                threads=self.threads, stats=eng, outcomes=outs,
             )
             self._dual_age = 0
         else:
@@ -648,7 +725,7 @@ class NativeSolveArena:
                 seed_provider_for_task=self._p4t,
                 max_release=self.max_release,
                 repair_mask=repair,
-                stats=eng,
+                stats=eng, outcomes=outs,
             )
             self._dual_age += 1
         t_solve = time.perf_counter()
@@ -658,7 +735,12 @@ class NativeSolveArena:
         )
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves += 1
+        qual = (
+            self._quality_pass(rf, p4t, price, prev_p4t, outs, eng)
+            if obs.enabled() else {}
+        )
         self.last_stats = {
+            **qual,
             "cold": False,
             "engine": self.engine,
             "rows": T,

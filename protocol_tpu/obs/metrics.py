@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import math
 import threading
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Optional
 
 try:
@@ -153,6 +153,10 @@ class _SessionObs:
     __slots__ = (
         "ticks", "cold_ticks", "assigned_frac", "min_assigned_frac",
         "rows_total", "rows_changed", "delta_rows",
+        # quality plane (decision observability): certified duality gap,
+        # plan churn, starvation ages, and the outcome-cause counters
+        "gap_last", "gap_max", "churn_last", "churn_max",
+        "starve_max", "starve_hist", "outcome_counts", "unexplained",
     )
 
     def __init__(self):
@@ -163,12 +167,85 @@ class _SessionObs:
         self.rows_total = 0
         self.rows_changed = 0
         self.delta_rows = 0
+        self.gap_last: Optional[float] = None
+        self.gap_max = 0.0
+        self.churn_last: Optional[float] = None
+        self.churn_max = 0.0
+        self.starve_max = 0
+        self.starve_hist: Optional[list] = None
+        # cause name -> cumulative task-tick count (assigned included,
+        # so fractions are computable from the counters alone)
+        self.outcome_counts: Optional[dict] = None
+        self.unexplained = 0
 
     def reuse_ratio(self) -> float:
         """Fraction of candidate rows the warm path did NOT recompute."""
         if self.rows_total == 0:
             return 0.0
         return min(1.0, max(0.0, 1.0 - self.rows_changed / self.rows_total))
+
+    def observe_quality(self, stats: dict) -> None:
+        """Fold one tick's quality scalars (the arena's last_stats keys
+        from obs.quality.tick_quality) into the roll-up."""
+        gap = stats.get("gap_per_task")
+        if gap is not None:
+            self.gap_last = float(gap)
+            self.gap_max = max(self.gap_max, float(gap))
+        churn = stats.get("churn_ratio")
+        if churn is not None:
+            self.churn_last = float(churn)
+            self.churn_max = max(self.churn_max, float(churn))
+        if stats.get("starve_max") is not None:
+            self.starve_max = max(self.starve_max, int(stats["starve_max"]))
+        hist = stats.get("starve_hist")
+        if hist:
+            if self.starve_hist is None or len(self.starve_hist) != len(hist):
+                self.starve_hist = [0] * len(hist)
+            for i, c in enumerate(hist):
+                self.starve_hist[i] += int(c)
+        # the ONE taxonomy home is quality.OUTCOME_STAT_KEYS — a new
+        # outcome code must not silently miss the per-tenant counters
+        from protocol_tpu.obs.quality import OUTCOME_STAT_KEYS
+
+        cause_keys = tuple(
+            (key, key.removeprefix("outcome_"))
+            for _, key in OUTCOME_STAT_KEYS
+        )
+        if any(stats.get(k) is not None for k, _ in cause_keys):
+            if self.outcome_counts is None:
+                self.outcome_counts = {name: 0 for _, name in cause_keys}
+            for key, name in cause_keys:
+                self.outcome_counts[name] += int(stats.get(key) or 0)
+        self.unexplained += int(stats.get("outcome_unexplained") or 0)
+
+    def quality_snapshot(self) -> Optional[dict]:
+        if (
+            self.gap_last is None
+            and self.churn_last is None
+            and self.outcome_counts is None
+            and self.starve_hist is None
+        ):
+            return None
+        out: dict = {
+            "starvation": {
+                "max_age": self.starve_max,
+                "hist": list(self.starve_hist or []),
+            },
+        }
+        if self.gap_last is not None:
+            out["gap_per_task"] = {
+                "last": round(self.gap_last, 6),
+                "max": round(self.gap_max, 6),
+            }
+        if self.churn_last is not None:
+            out["churn_ratio"] = {
+                "last": round(self.churn_last, 6),
+                "max": round(self.churn_max, 6),
+            }
+        if self.outcome_counts is not None:
+            out["outcomes"] = dict(self.outcome_counts)
+            out["outcomes"]["unexplained"] = self.unexplained
+        return out
 
 
 class ObsRegistry:
@@ -202,9 +279,16 @@ class ObsRegistry:
         self._fleet = None  # fleet.fabric.SessionFabric
         self._admission = None  # fleet.admission.TenantAdmission
         self._registry = None
+        # SLO engine (obs.slo.SLOEngine): evaluated inside observe_tick
+        # under the registry lock; fired/cleared alert events land in a
+        # bounded ring for the snapshot and are returned to the caller
+        # (the servicer appends them to the trace as event frames)
+        self._slo = None
+        self._alerts = deque(maxlen=256)
 
     def attach(
-        self, budget=None, store=None, fleet=None, admission=None
+        self, budget=None, store=None, fleet=None, admission=None,
+        slo=None,
     ) -> None:
         if budget is not None:
             self._budget = budget
@@ -214,6 +298,8 @@ class ObsRegistry:
             self._fleet = fleet
         if admission is not None:
             self._admission = admission
+        if slo is not None:
+            self._slo = slo
 
     # ---------------- recording ----------------
 
@@ -239,9 +325,13 @@ class ObsRegistry:
         arena_stats: Optional[dict] = None,
         delta_rows: int = 0,
         cold: Optional[bool] = None,
-    ) -> None:
+    ) -> list:
         """One solve tick for one session: latency, assigned fraction,
-        and (from the arena's ``last_stats``) the reuse ratio inputs.
+        the reuse ratio inputs, and (when present in ``arena_stats``)
+        the quality-plane scalars — certified gap, churn, starvation,
+        outcome causes. Returns the SLO alert events this tick fired or
+        cleared (empty without an attached SLO engine / breach), so the
+        caller can append them to the trace as event frames.
 
         No ``arena_stats`` means a STATELESS kernel (auction/topk/...):
         every such tick is a full solve — classified cold, and excluded
@@ -250,20 +340,26 @@ class ObsRegistry:
         stats = arena_stats or {}
         if cold is None:
             cold = bool(stats.get("cold", True)) if stats else True
+        frac = min(1.0, num_assigned / n_tasks) if n_tasks > 0 else None
         with self._lock:
+            session_entry = self._entry(self._sessions, session_id)
+            # tick index = ticks this session observed BEFORE this one
+            # (0-based, matching trace/report tick numbering): cold +
+            # warm, deterministic, replay-stable — never wall-clock
+            tick = (
+                session_entry.ticks.count + session_entry.cold_ticks.count
+            )
             for s in (
-                self._entry(self._sessions, session_id),
+                session_entry,
                 self._entry(self._tenants, tenant_of(session_id)),
             ):
                 (s.cold_ticks if cold else s.ticks).observe_ms(wall_ms)
-                if n_tasks > 0:
+                if frac is not None:
                     # clamp: the one-to-many "best" kernel counts
                     # assigned PROVIDERS, which can exceed the task
                     # count — the gauge stays a fraction
-                    s.assigned_frac = min(1.0, num_assigned / n_tasks)
-                    s.min_assigned_frac = min(
-                        s.min_assigned_frac, s.assigned_frac
-                    )
+                    s.assigned_frac = frac
+                    s.min_assigned_frac = min(s.min_assigned_frac, frac)
                 if stats:
                     # the arena reports row counts over its PADDED
                     # (pow2) batch; mixing them with the real n_tasks
@@ -275,7 +371,24 @@ class ObsRegistry:
                         s.rows_changed += int(
                             stats.get("changed_rows", rows if cold else 0)
                         )
+                    s.observe_quality(stats)
                 s.delta_rows += int(delta_rows)
+            alerts: list = []
+            if self._slo is not None:
+                alerts = self._slo.observe(
+                    session_id, tenant_of(session_id), tick,
+                    {
+                        "wall_ms": wall_ms,
+                        "assigned_frac": frac,
+                        "starve_max": stats.get("starve_max"),
+                        "gap_per_task": stats.get("gap_per_task"),
+                        "churn_ratio": stats.get("churn_ratio"),
+                    },
+                    cold=cold,
+                )
+                for a in alerts:
+                    self._alerts.append(a)
+        return alerts
 
     def forget(self, session_id: str) -> None:
         """Drop one session's metrics (optional — the LRU cap already
@@ -289,7 +402,7 @@ class ObsRegistry:
         """Authoritative nested snapshot: per-session histograms +
         fleet-level gauges. Works with or without prometheus."""
         def _one(s: _SessionObs, key: str) -> dict:
-            return {
+            out = {
                 "tenant": tenant_of(key),
                 "tick": s.ticks.snapshot_ms(),
                 "cold_tick": s.cold_ticks.snapshot_ms(),
@@ -298,6 +411,10 @@ class ObsRegistry:
                 "arena_reuse_ratio": round(s.reuse_ratio(), 4),
                 "delta_rows": s.delta_rows,
             }
+            quality = s.quality_snapshot()
+            if quality is not None:
+                out["quality"] = quality
+            return out
 
         with self._lock:
             sessions = {
@@ -306,6 +423,13 @@ class ObsRegistry:
             tenants = {
                 t: _one(s, t) for t, s in self._tenants.items()
             }
+            # SLO engine + alert ring are registry state mutated under
+            # this lock by observe_tick — snapshot them here too, or a
+            # scrape races "OrderedDict mutated during iteration"
+            slo_snap: Optional[dict] = None
+            if self._slo is not None:
+                slo_snap = self._slo.snapshot()
+                slo_snap["recent"] = list(self._alerts)[-32:]
         out: dict = {
             "role": self.role, "sessions": sessions, "tenants": tenants,
         }
@@ -340,6 +464,8 @@ class ObsRegistry:
         admission = self._admission
         if admission is not None:
             out["admission"] = admission.snapshot()
+        if slo_snap is not None:
+            out["slo"] = slo_snap
         return out
 
     def render(self) -> bytes:
@@ -422,6 +548,26 @@ class ObsRegistry:
                 "Per-tenant minimum assigned fraction",
                 ["role", "tenant"], registry=reg,
             )
+            g_gap = Gauge(
+                "scheduler_obs_tenant_duality_gap_per_task",
+                "Certified duality gap per assigned task (quality plane)",
+                ["role", "tenant", "agg"], registry=reg,
+            )
+            g_churn = Gauge(
+                "scheduler_obs_tenant_plan_churn_ratio",
+                "Fraction of tasks whose provider changed tick-over-tick",
+                ["role", "tenant", "agg"], registry=reg,
+            )
+            g_starve = Gauge(
+                "scheduler_obs_tenant_starvation_age_max",
+                "Longest consecutive-ticks-unassigned age observed",
+                ["role", "tenant"], registry=reg,
+            )
+            g_cause = Gauge(
+                "scheduler_obs_tenant_task_outcomes_total",
+                "Cumulative per-task decision outcomes by cause",
+                ["role", "tenant", "cause"], registry=reg,
+            )
             for t, s in snap["tenants"].items():
                 tick = s["tick"]
                 if tick.get("count"):
@@ -432,6 +578,32 @@ class ObsRegistry:
                 g_ten_frac.labels(role=role, tenant=t).set(
                     s["min_assigned_frac"]
                 )
+                quality = s.get("quality")
+                if not quality:
+                    continue
+                gap = quality.get("gap_per_task")
+                if gap:
+                    g_gap.labels(role=role, tenant=t, agg="last").set(
+                        gap["last"]
+                    )
+                    g_gap.labels(role=role, tenant=t, agg="max").set(
+                        gap["max"]
+                    )
+                churn = quality.get("churn_ratio")
+                if churn:
+                    g_churn.labels(role=role, tenant=t, agg="last").set(
+                        churn["last"]
+                    )
+                    g_churn.labels(role=role, tenant=t, agg="max").set(
+                        churn["max"]
+                    )
+                g_starve.labels(role=role, tenant=t).set(
+                    quality["starvation"]["max_age"]
+                )
+                for cause, count in (quality.get("outcomes") or {}).items():
+                    g_cause.labels(role=role, tenant=t, cause=cause).set(
+                        count
+                    )
         if "fleet" in snap:
             fl = snap["fleet"]
             g_shard = Gauge(
@@ -477,4 +649,21 @@ class ObsRegistry:
                 ["role"], registry=reg,
             )
             g_fair.labels(role=role).set(snap["budget"]["fairness_index"])
+        if "slo" in snap:
+            slo = snap["slo"]
+            g_slo = Gauge(
+                "scheduler_obs_slo_alerts_fired_total",
+                "Multi-window burn-rate SLO alerts fired",
+                ["role", "tenant"], registry=reg,
+            )
+            g_slo.labels(role=role, tenant="_total").set(
+                slo["fired_total"]
+            )
+            for t, n in slo["fired_by_tenant"].items():
+                g_slo.labels(role=role, tenant=t).set(n)
+            g_slo_active = Gauge(
+                "scheduler_obs_slo_alerts_active",
+                "Currently-firing SLO alerts", ["role"], registry=reg,
+            )
+            g_slo_active.labels(role=role).set(len(slo["active"]))
         return generate_latest(reg)

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Optional
 
 from protocol_tpu.obs.metrics import LatencyHistogram
+from protocol_tpu.obs.quality import aggregate_quality
 
 # per-tick table columns pulled from OUTCOME metrics when present:
 # (key, header, is_ms)
@@ -136,6 +137,103 @@ def flame(outcomes, width: int = 32) -> list[str]:
     return lines
 
 
+# quality-plane columns pulled from OUTCOME metrics: (key, header, fmt)
+_QUALITY_COLS = (
+    ("gap_per_task", "gap/task", "f6"),
+    ("churn_ratio", "churn", "f4"),
+    ("starve_max", "starve", "i"),
+    ("outcome_no_candidates", "no_cand", "i"),
+    ("outcome_outbid", "outbid", "i"),
+    ("outcome_retired", "retired", "i"),
+    ("outcome_unexplained", "unexpl", "i"),
+)
+
+
+def _fmt_q(v, fmt: str) -> str:
+    if v is None:
+        return "-"
+    if fmt == "f6":
+        return f"{float(v):.6f}"
+    if fmt == "f4":
+        return f"{float(v):.4f}"
+    return str(int(v))
+
+
+def quality_summary(outcomes, events=None) -> Optional[dict]:
+    """Aggregate the quality scalars riding OUTCOME frames via the
+    shared canonical roll-up (None when the trace predates the quality
+    plane), plus the trace's SLO alert-event count."""
+    out = aggregate_quality([o.metrics for o in outcomes])
+    if out is None:
+        return None
+    alerts = [
+        e for frame in (events or []) for e in frame.get("events", [])
+        if e.get("kind") == "slo"
+    ]
+    if alerts:
+        out["slo_alerts"] = len(alerts)
+    return out
+
+
+def quality_table(outcomes, events=None) -> list[str]:
+    """The decision-quality section: per-tick certified gap / churn /
+    starvation / unassigned-cause table plus the roll-up line (and any
+    SLO alert events the trace carries)."""
+    summary = quality_summary(outcomes, events)
+    if summary is None:
+        return ["(no quality scalars in this trace — re-record with the "
+                "obs plane on)"]
+    cols = [
+        c for c in _QUALITY_COLS
+        if any(o.metrics.get(c[0]) is not None for o in outcomes)
+    ]
+    lines = []
+    header = "tick  " + "  ".join(f"{h:>9}" for _, h, _ in cols)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for o in outcomes:
+        m = o.metrics
+        if m.get("gap_per_task") is None:
+            continue
+        lines.append(
+            f"{o.tick:>4}  " + "  ".join(
+                f"{_fmt_q(m.get(k), fmt):>9}" for k, _, fmt in cols
+            )
+        )
+    lines.append("")
+    causes = summary["causes"]
+    lines.append(
+        f"certified gap/task mean {summary['gap_per_task_mean']:.6f} "
+        f"max {summary['gap_per_task_max']:.6f}"
+        + (
+            f" | churn mean {summary['churn_ratio_mean']:.4f} "
+            f"max {summary['churn_ratio_max']:.4f}"
+            if "churn_ratio_mean" in summary else ""
+        )
+        + f" | starvation max {summary['starve_max']} ticks"
+    )
+    lines.append(
+        "unassigned causes: "
+        f"no_candidates={causes['no_candidates']} "
+        f"outbid={causes['outbid']} retired={causes['retired']} "
+        f"unexplained={summary['unexplained_unassigned']}"
+        f" (assigned task-ticks: {causes['assigned']})"
+    )
+    if summary.get("slo_alerts"):
+        lines.append(f"SLO alert events in trace: {summary['slo_alerts']}")
+        for frame in events or []:
+            for e in frame.get("events", []):
+                if e.get("kind") != "slo":
+                    continue
+                lines.append(
+                    f"  tick {e.get('tick'):>4} {e.get('state'):>5} "
+                    f"{e.get('slo')} session={e.get('session')} "
+                    f"value={e.get('value')} threshold={e.get('threshold')} "
+                    f"burn={e.get('burn_short')}/{e.get('burn_long')}"
+                )
+    return lines
+
+
 def percentile_table(outcomes) -> list[str]:
     """Cold vs warm tick-latency distribution (obs histograms)."""
     cold = LatencyHistogram()
@@ -189,6 +287,9 @@ def report_dict(trace_path: str) -> dict:
         "cold": cold.snapshot_ms(),
         "warm": warm.snapshot_ms(),
     }
+    quality = quality_summary(t.outcomes, t.events)
+    if quality is not None:
+        out["quality"] = quality
     if t.snapshot is not None:
         out.update(
             providers=t.snapshot.n_providers, tasks=t.snapshot.n_tasks,
@@ -223,6 +324,9 @@ def render(trace_path: str) -> str:
     lines.append("")
     lines.append("tick latency distribution")
     lines.extend(percentile_table(t.outcomes))
+    lines.append("")
+    lines.append("quality (decision plane)")
+    lines.extend(quality_table(t.outcomes, t.events))
     lines.append("")
     lines.append("flame (span totals across ticks)")
     lines.extend(flame(t.outcomes))

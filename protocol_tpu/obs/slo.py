@@ -1,0 +1,259 @@
+"""Declarative per-tenant SLOs with multi-window burn-rate alerting.
+
+A small, deterministic SLO engine over the seam's per-tick quality and
+latency signals. Each tick the :class:`~protocol_tpu.obs.metrics.ObsRegistry`
+feeds one observation per session into :meth:`SLOEngine.observe`; the
+engine classifies it good/bad per objective, pushes the bit into
+TICK-INDEXED windows, and fires a structured alert event when BOTH a
+short and a long window burn the error budget faster than the window
+pair's threshold (the classic multi-window burn-rate rule: the short
+window gives fast detection, the long window keeps one-tick blips from
+paging).
+
+Objectives (any subset may be set; unset = not evaluated):
+
+  ==================  ===============================================
+  p99_warm_tick_ms    warm tick wall above this is a bad tick
+  min_assigned_frac   assigned fraction below this is a bad tick
+  max_starvation_age  any task starving longer than this: bad tick
+  max_gap_per_task    certified duality gap per task above this: bad
+  max_churn_ratio     plan churn ratio above this: bad tick
+  ==================  ===============================================
+
+Burn rate = (bad fraction over the window) / ``budget_frac``. A pair
+only evaluates once BOTH its windows have filled (a half-filled window
+must not page), so detection latency is floored at the pair's LONG
+window: with the default 5% budget and window pairs, a sustained
+20%-bad signal fires the fast pair the moment its 32-tick long window
+fills; a slow 10% bleed fires the slow pair once 128 ticks are in.
+Outages shorter than the fast pair's long window never page — by
+design, ticks are cheap and sub-window blips are the noise the long
+window exists to absorb.
+
+DETERMINISM: windows are counted in TICKS, never wall-clock — the
+engine reads no clock and holds no timestamps, so replaying a recorded
+workload reproduces the exact same alert sequence (the determinism lint
+enforces the no-wall-clock rule on this module). Alert events carry the
+tick index; wall-clock correlation belongs to the scrape layer.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict, deque
+from itertools import islice
+from dataclasses import dataclass, field
+from typing import Optional
+
+# (short window ticks, long window ticks, burn-rate threshold): both
+# windows must burn >= threshold to fire; the pairs are ordered
+# fast-to-slow and evaluated independently.
+DEFAULT_WINDOWS = ((8, 32, 4.0), (32, 128, 2.0))
+
+# objective catalog: (objective name, config attr, metric key, sense)
+# sense "gt": metric > threshold is bad; "lt": metric < threshold is bad
+_OBJECTIVES = (
+    ("warm_tick_p99_ms", "p99_warm_tick_ms", "wall_ms", "gt"),
+    ("assigned_frac", "min_assigned_frac", "assigned_frac", "lt"),
+    ("starvation_age", "max_starvation_age", "starve_max", "gt"),
+    ("gap_per_task", "max_gap_per_task", "gap_per_task", "gt"),
+    ("churn_ratio", "max_churn_ratio", "churn_ratio", "gt"),
+)
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Declarative objective set. All-None (the default) is inert: the
+    engine records nothing and fires nothing."""
+
+    p99_warm_tick_ms: Optional[float] = None
+    min_assigned_frac: Optional[float] = None
+    max_starvation_age: Optional[float] = None
+    max_gap_per_task: Optional[float] = None
+    max_churn_ratio: Optional[float] = None
+    budget_frac: float = 0.05
+    windows: tuple = DEFAULT_WINDOWS
+
+    def active(self) -> bool:
+        return any(
+            getattr(self, attr) is not None for _, attr, _, _ in _OBJECTIVES
+        )
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "SLOConfig":
+        """PROTOCOL_TPU_SLO_{P99_MS,MIN_ASSIGNED,MAX_STARVE,MAX_GAP,
+        MAX_CHURN,BUDGET} — unset vars leave the objective off."""
+        e = os.environ if env is None else env
+
+        def _f(name: str) -> Optional[float]:
+            v = e.get(f"PROTOCOL_TPU_SLO_{name}", "").strip()
+            return float(v) if v else None
+
+        return cls(
+            p99_warm_tick_ms=_f("P99_MS"),
+            min_assigned_frac=_f("MIN_ASSIGNED"),
+            max_starvation_age=_f("MAX_STARVE"),
+            max_gap_per_task=_f("MAX_GAP"),
+            max_churn_ratio=_f("MAX_CHURN"),
+            budget_frac=_f("BUDGET") or 0.05,
+        )
+
+    def snapshot(self) -> dict:
+        out = {
+            attr: getattr(self, attr)
+            for _, attr, _, _ in _OBJECTIVES
+            if getattr(self, attr) is not None
+        }
+        out["budget_frac"] = self.budget_frac
+        out["windows"] = [list(w) for w in self.windows]
+        return out
+
+
+@dataclass
+class _ObjectiveState:
+    """Per (session, objective) burn-rate state: the tick-indexed bad
+    bits plus which window pairs are currently firing."""
+
+    bits: deque = field(default_factory=deque)
+    active: list = field(default_factory=list)  # bool per window pair
+
+
+class SLOEngine:
+    """Evaluates one :class:`SLOConfig` across sessions. Not
+    thread-safe by itself — the ObsRegistry calls it under its own
+    lock, the same serialization every other per-session stat gets."""
+
+    def __init__(self, config: SLOConfig, max_sessions: int = 512):
+        self.config = config
+        self.max_sessions = int(max_sessions)
+        self._long_max = max(
+            (w[1] for w in config.windows), default=0
+        )
+        # session -> objective name -> _ObjectiveState (LRU-bounded:
+        # session ids are client-minted, same story as the registry)
+        self._state: OrderedDict[str, dict] = OrderedDict()
+        self.fired_total = 0
+        self._fired_by_tenant: dict[str, int] = {}
+
+    # ---------------- internals ----------------
+
+    def _session_state(self, session_id: str) -> dict:
+        s = self._state.get(session_id)
+        if s is None:
+            s = self._state[session_id] = {}
+            while len(self._state) > self.max_sessions:
+                self._state.popitem(last=False)
+        else:
+            self._state.move_to_end(session_id)
+        return s
+
+    @staticmethod
+    def _burn(bits: deque, window: int, budget: float) -> Optional[float]:
+        """Burn rate over the trailing ``window`` bits; None until the
+        window has filled (a half-filled window must not page)."""
+        n = len(bits)
+        if n < window:
+            return None
+        # bits is bounded at the longest window, so the tail walk is a
+        # few hundred ints at most — no ring bookkeeping needed
+        bad = sum(islice(bits, n - window, n))
+        return (bad / window) / max(budget, 1e-9)
+
+    # ---------------- the observe step ----------------
+
+    def observe(
+        self,
+        session_id: str,
+        tenant: str,
+        tick: int,
+        metrics: dict,
+        cold: bool = False,
+    ) -> list[dict]:
+        """Feed one session tick; returns the alert events that FIRED
+        or CLEARED on this tick (usually empty). ``metrics`` keys match
+        the objective catalog (wall_ms, assigned_frac, starve_max,
+        gap_per_task, churn_ratio); absent keys skip their objective
+        for this tick."""
+        cfg = self.config
+        if not cfg.active():
+            return []
+        state = self._session_state(session_id)
+        events: list[dict] = []
+        for name, attr, key, sense in _OBJECTIVES:
+            threshold = getattr(cfg, attr)
+            if threshold is None:
+                continue
+            if name == "warm_tick_p99_ms" and cold:
+                continue  # latency objective is a warm-tick contract
+            value = metrics.get(key)
+            if value is None:
+                continue
+            bad = (
+                value > threshold if sense == "gt" else value < threshold
+            )
+            st = state.get(name)
+            if st is None:
+                st = state[name] = _ObjectiveState(
+                    bits=deque(maxlen=self._long_max),
+                    active=[False] * len(cfg.windows),
+                )
+            st.bits.append(1 if bad else 0)
+            # one burn per DISTINCT window length (the default pairs
+            # share their 32-tick window), computed under the registry
+            # lock the solve path also serializes on — keep it cheap
+            burns = {
+                w: self._burn(st.bits, w, cfg.budget_frac)
+                for w in sorted({
+                    w for pair in cfg.windows for w in pair[:2]
+                })
+            }
+            for i, (short, long_w, burn_thresh) in enumerate(cfg.windows):
+                burn_s = burns[short]
+                burn_l = burns[long_w]
+                if burn_s is None or burn_l is None:
+                    continue
+                firing = burn_s >= burn_thresh and burn_l >= burn_thresh
+                if firing == st.active[i]:
+                    continue
+                st.active[i] = firing
+                event = {
+                    "kind": "slo",
+                    "state": "fire" if firing else "clear",
+                    "slo": name,
+                    "session": session_id,
+                    "tenant": tenant,
+                    "tick": int(tick),
+                    "value": value,
+                    "threshold": threshold,
+                    "burn_short": round(burn_s, 3),
+                    "burn_long": round(burn_l, 3),
+                    "window": [short, long_w],
+                }
+                events.append(event)
+                if firing:
+                    self.fired_total += 1
+                    self._fired_by_tenant[tenant] = (
+                        self._fired_by_tenant.get(tenant, 0) + 1
+                    )
+        return events
+
+    def active_alerts(self) -> list[dict]:
+        """Currently-firing (session, objective, window) triples."""
+        out = []
+        for sid, objectives in self._state.items():
+            for name, st in objectives.items():
+                for i, firing in enumerate(st.active):
+                    if firing:
+                        out.append({
+                            "session": sid, "slo": name,
+                            "window": list(self.config.windows[i][:2]),
+                        })
+        return out
+
+    def snapshot(self) -> dict:
+        return {
+            "config": self.config.snapshot(),
+            "fired_total": self.fired_total,
+            "fired_by_tenant": dict(self._fired_by_tenant),
+            "active": self.active_alerts(),
+        }

@@ -165,6 +165,7 @@ class SchedulerBackendServicer:
         max_sessions: int = 8,
         session_ttl_s: float = 900.0,
         fleet=None,
+        slo=None,
     ):
         from protocol_tpu.sched.cand_cache import CandidateMemo
 
@@ -225,11 +226,21 @@ class SchedulerBackendServicer:
         # budget/store gauges read at scrape time. The dict snapshot is
         # authoritative; /metrics is wired by serve(metrics_port=...).
         self.obs = ObsRegistry(role="server")
+        # SLO engine (obs/slo.py): declarative per-tenant objectives
+        # evaluated with tick-indexed multi-window burn rates inside
+        # observe_tick; ``slo`` is an SLOConfig, None reads the
+        # PROTOCOL_TPU_SLO_* env vars (all-unset = inert)
+        from protocol_tpu.obs.slo import SLOConfig, SLOEngine
+
+        self.slo = SLOEngine(
+            slo if slo is not None else SLOConfig.from_env()
+        )
         self.obs.attach(
             budget=self._engine_budget,
             store=self.sessions,
             fleet=self.sessions,
             admission=self.admission,
+            slo=self.slo,
         )
         # flight recorder (PROTOCOL_TPU_TRACE=<path>): any solve served by
         # this backend records its exact inputs + outcomes — unary calls
@@ -524,17 +535,42 @@ class SchedulerBackendServicer:
         num_assigned: int,
         arena_stats: Optional[dict] = None,
         delta_rows: int = 0,
-    ) -> None:
+        trace_tick: Optional[int] = None,
+    ) -> list:
+        """Returns the SLO alert events this tick fired/cleared (empty
+        without a configured SLO engine or a breach) — the caller lands
+        them in the trace as event frames. ``trace_tick`` anchors the
+        EVENT frame at the caller's wire tick (session paths MUST pass
+        it: this runs after the session lock is released, so a pipelined
+        delta may already have advanced the recorder's stream tick)."""
         from protocol_tpu import obs
 
         if not obs.enabled():
             # PROTOCOL_TPU_OBS=0 turns the WHOLE plane off — per-session
             # registries included, not just spans and engine stats
-            return
-        self.obs.observe_tick(
+            return []
+        alerts = self.obs.observe_tick(
             session_id, (time.perf_counter() - t0) * 1e3, n_tasks,
             num_assigned, arena_stats=arena_stats, delta_rows=delta_rows,
         )
+        if alerts and self.trace is not None:
+            from protocol_tpu.trace.recorder import safe as _trace_safe
+
+            # structured breach events ride the flight recorder too, so
+            # replay/report can show WHEN the quality plane paged. The
+            # unary registry keys ("unary:v1"/"unary:v2") are NOT trace
+            # stream owners — column-mode streams are unowned (None);
+            # the recorder drops events whose owner doesn't match its
+            # stream, so alerts never land in a different workload's
+            # trace
+            _trace_safe(
+                self.trace.record_events, alerts,
+                session_id=(
+                    None if session_id.startswith("unary:") else session_id
+                ),
+                tick=trace_tick,
+            )
+        return alerts
 
     # ---------------- v1 unary (frozen contract) ----------------
 
@@ -789,7 +825,7 @@ class SchedulerBackendServicer:
         self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
         self._observe_tick(
             session.session_id, t0, session.n_tasks,
-            int((p4t >= 0).sum()), arena_stats,
+            int((p4t >= 0).sum()), arena_stats, trace_tick=0,
         )
         if self.trace is not None:
             # flight recorder, session mode: the snapshot frame is the
@@ -951,6 +987,9 @@ class SchedulerBackendServicer:
             p4t_out, t4p, price = session.solve()
             arena_stats = dict(session.arena.last_stats)
             session.tick += 1
+            tick_no = session.tick  # this delta's wire tick, for the
+            # post-lock obs/event hooks (== int(request.tick), checked
+            # above)
             if session.evicted:
                 # eviction landed DURING the solve (the store flags
                 # without taking session.lock — coupling store eviction
@@ -990,6 +1029,7 @@ class SchedulerBackendServicer:
             session.session_id, t0, session.n_tasks,
             int((p4t_out >= 0).sum()), arena_stats,
             delta_rows=int(prow.size + trow.size),
+            trace_tick=tick_no,
         )
         del t4p, price  # session state: stays server-side
         # SLIM response: p4t only. task_for_provider is derivable from it
@@ -1083,6 +1123,7 @@ def serve(
     max_sessions: int = 8,
     session_ttl_s: float = 900.0,
     fleet=None,
+    slo=None,
 ) -> grpc.Server:
     """Start the backend server (non-blocking; call .wait_for_termination()).
     The servicer rides on the returned server as ``.servicer`` (tests and
@@ -1092,6 +1133,11 @@ def serve(
     count, arena byte budgets, admission rate, delta queue depth);
     None reads ``PROTOCOL_TPU_FLEET_*`` from the environment, and the
     defaults are transparent for single-session use.
+
+    ``slo`` is an :class:`~protocol_tpu.obs.slo.SLOConfig` (per-tenant
+    quality/latency objectives with multi-window burn-rate alerting);
+    None reads ``PROTOCOL_TPU_SLO_*`` — all unset leaves the engine
+    inert.
 
     ``metrics_port`` starts the consolidated observability scrape
     endpoint (``/metrics`` prometheus text merging SeamMetrics + the
@@ -1109,6 +1155,7 @@ def serve(
         max_sessions=max_sessions,
         session_ttl_s=session_ttl_s,
         fleet=fleet,
+        slo=slo,
     )
     server.add_generic_rpc_handlers((_handlers(servicer),))
     server.servicer = servicer

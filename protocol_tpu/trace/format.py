@@ -56,6 +56,9 @@ KIND_META = 1      # JSON: trace provenance + generator knobs
 KIND_SNAPSHOT = 2  # pb.SnapshotChunk: epoch header + AssignRequestV2 payload
 KIND_DELTA = 3     # u32 n | pb.AssignDeltaRequest[n] | JSON events
 KIND_OUTCOME = 4   # u32 n | pb.AssignResponseV2[n] | JSON {tick, metrics}
+KIND_EVENT = 5     # JSON {tick, events}: out-of-band structured events
+#                    (SLO burn-rate alerts) — NOT solve inputs, so the
+#                    replayer ignores them; old readers skip the kind
 
 _FLAG_DEFLATE = 1
 _HEADER = struct.Struct("<BBII")
@@ -193,6 +196,9 @@ class Trace:
     outcomes: list  # OutcomeRecord, tick order (tick 0 = snapshot solve)
     truncated: bool
     n_frames: int
+    # EVENT frames ({tick, events}, e.g. SLO alerts) — observational
+    # side channel, never replay input
+    events: list = dataclasses.field(default_factory=list)
 
     @property
     def ticks(self) -> int:
@@ -283,6 +289,17 @@ class TraceWriter:
                 wire.encode_requirements_v2(_as_ns(r_cols))
             )
         self.write_delta(req, events)
+
+    def write_events(self, tick: int, events: list) -> None:
+        """Out-of-band structured events (SLO burn-rate alerts) tied to
+        a tick. Never a solve input: the replayer skips EVENT frames,
+        and pre-EVENT readers skip the unknown kind by contract."""
+        self._frame(
+            KIND_EVENT,
+            json.dumps(
+                {"tick": int(tick), "events": list(events)}, sort_keys=True
+            ).encode(),
+        )
 
     def write_outcome(
         self,
@@ -417,6 +434,7 @@ def read_trace(path: str) -> Trace:
     snapshot: Optional[Snapshot] = None
     deltas: list[DeltaRecord] = []
     outcomes: list[OutcomeRecord] = []
+    events: list = []
     truncated = False
     n_frames = 0
     for kind, payload in read_frames(path):
@@ -432,11 +450,14 @@ def read_trace(path: str) -> Trace:
             deltas.append(_parse_delta(payload))
         elif kind == KIND_OUTCOME:
             outcomes.append(_parse_outcome(payload))
+        elif kind == KIND_EVENT:
+            events.append(json.loads(payload))
         # unknown kinds are skipped: future writers may append new frame
         # kinds without breaking old readers (the version rides in META)
     return Trace(
         path=path, meta=meta, snapshot=snapshot, deltas=deltas,
         outcomes=outcomes, truncated=truncated, n_frames=n_frames,
+        events=events,
     )
 
 
@@ -451,6 +472,7 @@ def info(path: str) -> dict:
         "truncated": t.truncated,
         "ticks": t.ticks,
         "outcomes": len(t.outcomes),
+        "events": len(t.events),
     }
     if t.snapshot is not None:
         s = t.snapshot

@@ -250,6 +250,30 @@ class TraceRecorder:
                 metrics=metrics,
             )
 
+    def record_events(
+        self,
+        events: list,
+        session_id: Optional[str] = None,
+        tick: Optional[int] = None,
+    ) -> None:
+        """Out-of-band structured events (SLO burn-rate alerts).
+        Ownership mirrors the outcome contract exactly: a session-owned
+        stream accepts only its own session's events, a column-mode
+        stream (``session_id=None``, the unary path) accepts only
+        unary events — an event must never land in a stream that is
+        recording a DIFFERENT workload's ticks. ``tick`` anchors the
+        EVENT frame explicitly (the caller's wire tick); None falls
+        back to the stream's current tick, which is only safe when the
+        caller IS the path advancing it (column mode)."""
+        with self._lock:
+            if self._session_id != session_id:
+                return
+            if self._writer is None:
+                return  # nothing recorded yet: no tick to anchor to
+            self._writer.write_events(
+                self._tick if tick is None else int(tick), events
+            )
+
     def close(self) -> None:
         with self._lock:
             if self._writer is not None:

@@ -379,6 +379,7 @@ def replay(
         "assigned": [],
     }
     p4ts: list = []
+    tick_stats: list = []  # scalar per-tick stats (quality plane)
     try:
         for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
             if max_ticks is not None and tick >= max_ticks:
@@ -397,6 +398,10 @@ def replay(
             report["ticks"] += 1
             report["tick_wall_ms"].append(round(wall_ms, 3))
             report["assigned"].append(int((p4t >= 0).sum()))
+            tick_stats.append({
+                k: v for k, v in (stats or {}).items()
+                if isinstance(v, (int, float, bool))
+            })
             if keep_p4t:
                 p4ts.append(p4t)
             if writer is not None:
@@ -450,12 +455,26 @@ def replay(
             from protocol_tpu.obs.metrics import percentiles_ms
 
             report["warm_percentiles"] = percentiles_ms(walls[1:])
+    quality = _aggregate_quality(tick_stats)
+    if quality is not None:
+        report["quality"] = quality
     if isinstance(backend, _WireTransport):
         report["wire_bytes_out"] = backend.bytes_out
         report["wire_bytes_in"] = backend.bytes_in
     if keep_p4t:
         report["p4ts"] = p4ts
     return report
+
+
+def _aggregate_quality(tick_stats: list) -> Optional[dict]:
+    """Roll the per-tick quality scalars (arena last_stats through the
+    inproc backends; wire replays report quality server-side) into the
+    replay report — the shared canonical roll-up (certified gap, plan
+    churn over warm ticks, starvation, outcome-cause totals with the
+    zero-unexplained invariant the CI quality gate holds)."""
+    from protocol_tpu.obs.quality import aggregate_quality
+
+    return aggregate_quality(tick_stats)
 
 
 def compare(
@@ -495,4 +514,29 @@ def compare(
         out["warm_speedup_b_over_a"] = round(
             a["warm_mean_ms"] / b["warm_mean_ms"], 3
         )
+    # quality deltas, not just bit-identity: the A/B answer for "the
+    # plans differ — by how MUCH, and who pays" (the streaming roadmap
+    # item gates its bounded-staleness contract on exactly this)
+    qa, qb = a.get("quality"), b.get("quality")
+    if qa and qb:
+        delta = {
+            "gap_per_task_delta": round(
+                qb["gap_per_task_mean"] - qa["gap_per_task_mean"], 6
+            ),
+            "starve_max_delta": qb["starve_max"] - qa["starve_max"],
+        }
+        if qa.get("plan_cost_mean"):
+            delta["plan_cost_ratio_b_over_a"] = round(
+                qb["plan_cost_mean"] / qa["plan_cost_mean"], 6
+            )
+        if (
+            qa.get("churn_ratio_mean") is not None
+            and qb.get("churn_ratio_mean") is not None
+        ):
+            delta["churn_ratio_delta"] = round(
+                qb["churn_ratio_mean"] - qa["churn_ratio_mean"], 6
+            )
+        out["quality_delta"] = delta
+    if a.get("assigned") and b.get("assigned"):
+        out["assigned_min_delta"] = min(b["assigned"]) - min(a["assigned"])
     return out

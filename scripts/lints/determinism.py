@@ -18,7 +18,19 @@ Three ambient-nondeterminism classes can silently break that promise:
     drift across numpy versions; jitter must come from the hash-based
     tie-breakers the kernels already share.
 
-Scope: ``protocol_tpu/native/`` and ``protocol_tpu/ops/``.
+Scope: ``protocol_tpu/native/`` and ``protocol_tpu/ops/``, plus the
+decision-quality plane (``protocol_tpu/obs/quality.py``,
+``protocol_tpu/obs/slo.py``) whose replay-stability contract is the
+same bit-for-bit promise.
+
+The SLO engine (``obs/slo.py``) additionally runs under the STRICT
+no-clock mode: its burn-rate windows are TICK-indexed by contract (a
+replayed workload must reproduce the exact alert sequence), so ANY
+clock read — ``perf_counter`` and ``monotonic`` included, which the
+base rule allows for stats — and any ``datetime`` import is a finding.
+Wall-clock correlation belongs to the scrape layer, never inside the
+alert engine.
+
 Escape: ``# lint: determinism-ok`` on the offending line.
 """
 
@@ -46,8 +58,24 @@ class DeterminismRule(Rule):
     name = "determinism"
     suppress_token = "determinism-ok"
 
+    # tick-indexed modules: ANY clock read is a finding, not just
+    # wall-clock (the fixture twins carry the "slo_" prefix so the
+    # strict mode is exercised by the seeded tests too)
+    _STRICT_NO_CLOCK = ("protocol_tpu/obs/slo.py",)
+
     def applies(self, rel: str) -> bool:
-        return rel.startswith(("protocol_tpu/native/", "protocol_tpu/ops/"))
+        return rel.startswith(
+            ("protocol_tpu/native/", "protocol_tpu/ops/")
+        ) or rel.endswith(
+            ("protocol_tpu/obs/quality.py", "protocol_tpu/obs/slo.py")
+        )
+
+    @classmethod
+    def _is_strict(cls, rel: str) -> bool:
+        name = rel.replace("\\", "/").rsplit("/", 1)[-1]
+        return rel.endswith(cls._STRICT_NO_CLOCK) or name.startswith(
+            "slo_"
+        )
 
     @staticmethod
     def _time_bindings(tree: ast.AST) -> tuple[set[str], set[str]]:
@@ -69,6 +97,7 @@ class DeterminismRule(Rule):
 
     def check(self, src: Source) -> list[Finding]:
         out: list[Finding] = []
+        self._strict = self._is_strict(src.rel)
         self._time_mods, self._time_fns = self._time_bindings(src.tree)
         for node in ast.walk(src.tree):
             if isinstance(node, (ast.For, ast.AsyncFor)):
@@ -104,13 +133,21 @@ class DeterminismRule(Rule):
             )
         if not isinstance(fn, ast.Attribute):
             return []
-        # <any alias of the time module>.time()/.time_ns()
-        if fn.attr in ("time", "time_ns") and isinstance(fn.value, ast.Name):
-            if fn.value.id in self._time_mods:
+        # <any alias of the time module>.time()/.time_ns() — and in the
+        # STRICT tick-indexed modules, any clock at all
+        if isinstance(fn.value, ast.Name) and fn.value.id in self._time_mods:
+            if fn.attr in ("time", "time_ns"):
                 return self.finding(
                     src, call,
                     "wall-clock read in a solver path — results must not "
                     "depend on when the solve ran",
+                )
+            if self._strict:
+                return self.finding(
+                    src, call,
+                    f"time.{fn.attr} in a tick-indexed module — burn-rate "
+                    "windows count ticks, never clocks (replay must "
+                    "reproduce the exact alert sequence)",
                 )
         # random.X(...) / np.random.X(...)
         root = fn.value
@@ -138,8 +175,34 @@ class DeterminismRule(Rule):
                     return self.finding(
                         src, node, "random import in a solver module"
                     )
-        elif isinstance(node, ast.ImportFrom) and node.module == "random":
-            return self.finding(
-                src, node, "random import in a solver module"
-            )
+                if self._strict and (
+                    a.name == "datetime" or a.name.startswith("datetime.")
+                ):
+                    return self.finding(
+                        src, node,
+                        "datetime import in a tick-indexed module — the "
+                        "alert engine holds no timestamps",
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random":
+                return self.finding(
+                    src, node, "random import in a solver module"
+                )
+            if self._strict and node.module == "time":
+                # a from-import would bind the clock to a bare name the
+                # call-site check can't see — flag it at the source
+                return self.finding(
+                    src, node,
+                    "time import in a tick-indexed module — burn-rate "
+                    "windows count ticks, never clocks",
+                )
+            if self._strict and node.module and (
+                node.module == "datetime"
+                or node.module.startswith("datetime.")
+            ):
+                return self.finding(
+                    src, node,
+                    "datetime import in a tick-indexed module — the "
+                    "alert engine holds no timestamps",
+                )
         return []

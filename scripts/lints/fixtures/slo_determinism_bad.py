@@ -1,0 +1,27 @@
+"""Seeded violations for the determinism rule's STRICT tick-indexed
+mode (the SLO engine contract): any clock read — not just wall-clock —
+and any datetime import is a finding, because burn-rate windows count
+ticks and a replayed workload must reproduce the exact alert sequence.
+The ``slo_`` filename prefix opts this fixture into strict mode."""
+
+import datetime  # SEED: determinism
+import time
+from time import perf_counter  # SEED: determinism
+
+WINDOWS = {8, 32}
+
+
+def observe(tick, bad):
+    # base checks still apply in strict modules
+    for w in {16, 64}:  # SEED: determinism
+        _ = w
+    stamp = time.time()  # SEED: determinism
+    started = time.perf_counter()  # SEED: determinism
+    beat = time.monotonic()  # SEED: determinism
+    return stamp, started, beat
+
+
+def window_edges():
+    # allowed elsewhere for stats, a finding here: the alert engine
+    # holds no timestamps at all
+    return time.perf_counter_ns()  # SEED: determinism

@@ -1,0 +1,28 @@
+"""Clean twin for the strict tick-indexed determinism mode: the SLO
+engine shape done right — tick-counted windows, env-driven config,
+sorted iteration, no clock anywhere."""
+
+import os
+from collections import deque
+
+WINDOWS = ((8, 32, 4.0), (32, 128, 2.0))
+
+
+def from_env():
+    v = os.environ.get("PROTOCOL_TPU_SLO_BUDGET", "").strip()
+    return float(v) if v else 0.05
+
+
+def observe(state, tick, bad):
+    bits = state.setdefault("bits", deque(maxlen=128))
+    bits.append(1 if bad else 0)
+    events = []
+    for short, long_w, thresh in WINDOWS:
+        if len(bits) < long_w:
+            continue
+        burn = sum(list(bits)[-short:]) / short / 0.05
+        if burn >= thresh:
+            events.append({"tick": int(tick), "window": [short, long_w]})
+    for key in sorted({"a", "b"}):
+        _ = key
+    return events

@@ -191,15 +191,18 @@ def _child(args) -> int:
     cand_p, cand_c = fused[threads[0]]
 
     # --- stress 2: warm auction chain (Jacobi bidding rounds, per-thread
-    # bid buffers, eps-CS repair, seat eviction caps) with churned costs
+    # bid buffers, eps-CS repair, seat eviction caps) with churned costs;
+    # the outcome taxonomy + margins ride the same invariance check
     chains = {}
     for t in threads:
         crng = np.random.default_rng(11)
         cc_t = cand_c.copy()
+        outs: dict = {}
         p4t, price, retired = native.auction_sparse_mt(
-            cand_p, cc_t, num_providers=P, threads=t
+            cand_p, cc_t, num_providers=P, threads=t, outcomes=outs
         )
-        trace = [p4t.copy(), price.copy()]
+        trace = [p4t.copy(), price.copy(),
+                 outs["codes"].copy(), outs["margin"].copy()]
         for _ in range(args.ticks):
             rows = crng.choice(T, max(1, T // 50), replace=False)
             cc_t[rows] *= (0.8 + 0.4 * crng.random((rows.size, 1))).astype(np.float32)
@@ -207,16 +210,42 @@ def _child(args) -> int:
             retired[rows] = False
             mask = np.zeros(T, bool)
             mask[rows] = True
+            outs = {}
             p4t, price, retired = native.auction_sparse_mt(
                 cand_p, cc_t, num_providers=P,
                 eps_start=0.32, eps_end=0.02, threads=t,
                 price=price, retired=retired,
                 seed_provider_for_task=p4t,
-                max_release=64, repair_mask=mask,
+                max_release=64, repair_mask=mask, outcomes=outs,
             )
-            trace += [p4t.copy(), price.copy()]
+            trace += [p4t.copy(), price.copy(),
+                      outs["codes"].copy(), outs["margin"].copy()]
         chains[t] = trace
     _assert_identical(chains, "auction_sparse_mt warm chain")
+
+    # --- stress 2b: the PARALLEL margin/certificate post-pass. The
+    # helper pool only exists at T >= 8192 (kParMin), so the chunked
+    # cert reduction + relaxed-atomic reach marks never run above; this
+    # drives them at pool scale with stats on (cert scalars must be
+    # bit-identical: fixed chunks summed in chunk order)
+    Pq = Tq = max(8192, P)
+    epq, erq, wq = _synth_marketplace(np.random.default_rng(23), Pq, Tq)
+    cq_p, cq_c = native.fused_topk_candidates(
+        epq, erq, wq, k=args.top_k, threads=max(threads)
+    )
+    certs = {}
+    for t in threads:
+        outs, stats = {}, {}
+        p4t, price, _ = native.auction_sparse_mt(
+            cq_p, cq_c, num_providers=Pq, threads=t,
+            stats=stats, outcomes=outs,
+        )
+        certs[t] = [
+            p4t.copy(), outs["codes"].copy(), outs["margin"].copy(),
+            np.array([stats["plan_cost"], stats["idle_price"],
+                      stats["cs_slack"]]),
+        ]
+    _assert_identical(certs, "auction_sparse_mt parallel cert pass")
 
     # --- stress 3: sparse Sinkhorn potentials (row updates + CSR-transpose
     # column updates), cold anneal then churned warm single-phase

@@ -40,13 +40,15 @@ class TestRulesFireExactlyOnSeeds:
         "rule_cls,bad,ok",
         [
             (DeterminismRule, "determinism_bad.py", "determinism_ok.py"),
+            (DeterminismRule, "slo_determinism_bad.py",
+             "slo_determinism_ok.py"),
             (LockDisciplineRule, "lock_bad.py", "lock_ok.py"),
             (LockDisciplineRule, "fleet_lock_bad.py", "fleet_lock_ok.py"),
             (DenseAllocRule, "dense_bad.py", "dense_ok.py"),
         ],
         ids=[
-            "determinism", "lock-discipline", "lock-discipline-fleet",
-            "dense-alloc",
+            "determinism", "determinism-slo-strict", "lock-discipline",
+            "lock-discipline-fleet", "dense-alloc",
         ],
     )
     def test_seeds_and_clean_twin(self, rule_cls, bad, ok):
@@ -148,6 +150,48 @@ class TestEngine:
         )
         assert bad.returncode == 1
         assert "dense-alloc" in bad.stdout
+
+
+class TestSLOStrictMode:
+    """The strict tick-indexed mode covers the REAL SLO engine:
+    mutation-verified — injecting a clock read into obs/slo.py must be
+    caught, and the unmutated module must be clean."""
+
+    REAL = REPO / "protocol_tpu" / "obs" / "slo.py"
+
+    def test_real_slo_module_is_strict_and_clean(self):
+        rule = DeterminismRule()
+        assert rule.applies("protocol_tpu/obs/slo.py")
+        assert rule._is_strict("protocol_tpu/obs/slo.py")
+        assert rule.check(Source(self.REAL)) == []
+
+    def test_quality_module_covered_and_clean(self):
+        rule = DeterminismRule()
+        assert rule.applies("protocol_tpu/obs/quality.py")
+        assert not rule._is_strict("protocol_tpu/obs/quality.py")
+        assert rule.check(
+            Source(REPO / "protocol_tpu" / "obs" / "quality.py")
+        ) == []
+
+    @pytest.mark.parametrize(
+        "mutation",
+        [
+            "        import time\n        _t0 = time.monotonic()\n",
+            "        import time\n        _t0 = time.perf_counter()\n",
+            "        from datetime import datetime\n"
+            "        _now = datetime.now()\n",
+        ],
+        ids=["monotonic", "perf_counter", "datetime"],
+    )
+    def test_mutated_slo_engine_is_caught(self, tmp_path, mutation):
+        src = self.REAL.read_text()
+        needle = "        cfg = self.config\n"
+        assert needle in src  # observe() body anchor
+        mutated = tmp_path / "slo_mutated.py"  # slo_ prefix: strict
+        mutated.write_text(src.replace(needle, needle + mutation, 1))
+        findings = DeterminismRule().check(Source(mutated))
+        assert findings, "clock read injected into observe() not caught"
+        assert all(f.rule == "determinism" for f in findings)
 
 
 class TestSuppression:
