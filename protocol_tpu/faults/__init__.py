@@ -1,0 +1,37 @@
+"""Deterministic chaos plane for the scheduler seam.
+
+The reference protocol is built for constant partial failure (heartbeat
+monitors, invite retries, node ejection); this package is the seam's
+equivalent: a SEEDED, byte-replayable fault-injection plane wired into
+the seams the repo already owns, plus the recovery machinery that makes
+those faults survivable.
+
+  * :mod:`protocol_tpu.faults.plan` — the fault schedule: a pure
+    function of ``(seed, site, method, call index)`` deciding drops,
+    delays, corruptions, truncations and duplications, plus scripted
+    one-shot events (servicer kill, shard blackout, forced eviction).
+    No ``random``, no clocks: the same seed replays the same chaos.
+  * :mod:`protocol_tpu.faults.inject` — where faults land: a client-side
+    RPC shim (drop / delay / corrupt TensorBlob bytes / truncate
+    snapshot streams / duplicate deltas) and a server-side gRPC
+    interceptor (drop / delay before the servicer).
+  * :mod:`protocol_tpu.faults.checkpoint` — warm session checkpoints:
+    per-session crash-atomic journals reusing the trace SNAPSHOT /
+    OUTCOME / ARENA codecs, so a restarted servicer rehydrates sessions
+    warm and ``AssignDelta`` resumes at the checkpointed cursor instead
+    of refusing every client into a full-snapshot reopen herd.
+  * :mod:`protocol_tpu.faults.harness` — the seeded chaos drill the CI
+    gate runs: a recorded trace driven through kills, drops, delays and
+    blackouts must reconverge with zero full-snapshot reopens and a
+    final plan bit-identical to the fault-free replay.
+"""
+
+from protocol_tpu.faults.plan import ChaosConfig, FaultAction, FaultSchedule
+from protocol_tpu.faults.checkpoint import SessionCheckpointer
+
+__all__ = [
+    "ChaosConfig",
+    "FaultAction",
+    "FaultSchedule",
+    "SessionCheckpointer",
+]

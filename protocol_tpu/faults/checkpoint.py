@@ -1,0 +1,298 @@
+"""Warm session checkpoints: crash-safe per-session journals.
+
+A servicer crash used to destroy every session arena: H clients would
+stampede into cold full-snapshot reopens (the herd the fallback ladder
+exists to avoid, amplified H-fold at the worst possible moment). The
+checkpointer gives each session a compact on-disk twin, flushed on a
+tick cadence BEFORE the tick's response is acknowledged, so a restarted
+servicer rehydrates every session warm and ``AssignDelta`` resumes at
+the checkpointed cursor.
+
+One file per session, reusing the trace container and codecs verbatim
+(``PTTRACE1`` framing, SNAPSHOT = the session's padded columns as the
+wire's own ``AssignRequestV2``, OUTCOME = the last acknowledged plan,
+ARENA = the carried solver state via ``pack_arrays``):
+
+    META      JSON: session identity + solve params + tick cursor +
+              dedup CRC + arena cadence cursors
+    SNAPSHOT  the session's CURRENT cumulative columns (padded, with
+              the valid mask — bit-exact restore, no re-padding drift)
+    ARENA     candidate structure + duals + previous matching
+              (``NativeSolveArena.export_state``): the candidate lists
+              are PATH-DEPENDENT (incremental merges reorder them), so
+              without this frame a restart could only continue cold —
+              with it, the restored warm chain is bit-identical to the
+              uninterrupted one
+    OUTCOME   tick + the last plan the client was (or was about to be)
+              acknowledged — what idempotent retransmit replays
+
+Writes are crash-atomic (temp file + ``os.replace``) and frames are
+individually CRC'd, so a kill mid-flush leaves either the previous
+intact checkpoint or a torn temp file nobody reads. A checkpoint that
+fails to load (torn, version drift, decode error) is SKIPPED with a
+warning: the session's client falls back down the ladder exactly as it
+would have without checkpoints — recovery is an optimization, never a
+new failure mode.
+
+Cadence: ``every=1`` (the default, and what the chaos gate runs)
+checkpoints every tick — the zero-reopen guarantee. ``every=N`` trades
+durability for throughput: a crash loses up to N-1 ticks and the
+affected clients re-open from their authoritative columns (counted,
+bounded, explicit).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.trace import format as tfmt
+
+log = logging.getLogger(__name__)
+
+_META_KIND = "session-checkpoint"
+_SUFFIX = ".ckpt"
+
+
+def _fname(session_id: str) -> str:
+    # session ids are tenant-chosen strings: hash to a fixed-width safe
+    # filename (the id itself rides in META)
+    return hashlib.sha1(session_id.encode()).hexdigest()[:24] + _SUFFIX
+
+
+class SessionCheckpointer:
+    """Per-session checkpoint writer/loader over a directory."""
+
+    def __init__(self, directory: str, every: int = 1):
+        self.directory = directory
+        self.every = max(1, int(every))
+        os.makedirs(directory, exist_ok=True)
+        # obs counters (scraped via the servicer's seam metrics)
+        self.flushes = 0
+        self.flush_failures = 0
+
+    def path_for(self, session_id: str) -> str:
+        return os.path.join(self.directory, _fname(session_id))
+
+    def due(self, tick: int) -> bool:
+        """Is ``tick`` on the flush cadence? Tick 0 (the snapshot
+        solve) always checkpoints — a crash before the first delta must
+        still restore the session."""
+        return tick == 0 or tick % self.every == 0
+
+    # ---------------- write ----------------
+
+    def flush_locked(self, session) -> bool:
+        """Write the session's checkpoint (caller holds
+        ``session.lock`` — the state must be a consistent tick). Best
+        effort: a failed flush warns and counts, never fails the RPC;
+        the cost is one potential reopen after a crash."""
+        try:
+            self._write_locked(session)
+            self.flushes += 1
+            return True
+        except Exception:
+            self.flush_failures += 1
+            log.warning(
+                "session checkpoint flush failed for %s",
+                session.session_id, exc_info=True,
+            )
+            return False
+
+    def _write_locked(self, session) -> None:
+        from protocol_tpu.proto import scheduler_pb2 as pb
+        from protocol_tpu.proto import wire
+
+        state = session.arena.export_state()
+        meta = {
+            "kind": _META_KIND,
+            "session_id": session.session_id,
+            "fingerprint": session.fingerprint,
+            "kernel": session.kernel,
+            "threads": int(session.threads),
+            "top_k": int(session.top_k),
+            "weights": [
+                float(session.weights.price),
+                float(session.weights.load),
+                float(session.weights.proximity),
+                float(session.weights.priority),
+            ],
+            "n_providers": int(session.n_providers),
+            "n_tasks": int(session.n_tasks),
+            "tick": int(session.tick),
+            "last_delta_crc": int(session.last_delta_crc),
+            "delta_rows_total": int(session.delta_rows_total),
+        }
+        if state is not None:
+            meta["arena"] = {
+                "warm_solves": state.pop("warm_solves"),
+                "dual_age": state.pop("dual_age"),
+                "weights_key": list(state.pop("weights_key")),
+            }
+        req = pb.AssignRequestV2(
+            providers=wire.encode_providers_v2(
+                tfmt._as_ns(session.p_cols)
+            ),
+            requirements=wire.encode_requirements_v2(
+                tfmt._as_ns(session.r_cols)
+            ),
+            kernel=session.kernel,
+            top_k=session.top_k,
+        )
+        final = self.path_for(session.session_id)
+        tmp = final + ".tmp"
+        writer = tfmt.TraceWriter(tmp, meta=meta)
+        try:
+            writer.write_snapshot(
+                session.session_id, session.fingerprint, req
+            )
+            if state is not None:
+                writer.write_arena(state)
+            if session.last_p4t is not None:
+                writer.write_outcome(
+                    int(session.tick),
+                    np.asarray(session.last_p4t, np.int32),
+                )
+        finally:
+            writer.close()
+        os.replace(tmp, final)
+
+    # ---------------- read ----------------
+
+    def load_all(self, budget=None, limit: Optional[int] = None) -> list:
+        """Rehydrate the loadable checkpoints in the directory into
+        fresh :class:`SolveSession` objects (sorted by session id for a
+        deterministic restore order). ``limit`` caps the restore at the
+        N most-recently-flushed files (the caller's session budget —
+        restoring more would make the store's LRU pressure evict the
+        sessions just restored). Unloadable files are skipped with a
+        warning — the affected client re-opens down the ladder."""
+        out = []
+        try:
+            names = sorted(
+                n for n in os.listdir(self.directory)
+                if n.endswith(_SUFFIX)
+            )
+        except OSError:
+            return out
+        if limit is not None and len(names) > limit:
+            def _mtime(name: str) -> float:
+                try:
+                    return os.path.getmtime(
+                        os.path.join(self.directory, name)
+                    )
+                except OSError:
+                    return 0.0
+
+            skipped = len(names) - limit
+            names = sorted(
+                sorted(names, key=_mtime)[-limit:]
+            )
+            log.warning(
+                "checkpoint restore capped at %d sessions "
+                "(%d older files skipped)", limit, skipped,
+            )
+        loaded = []
+        for name in names:
+            path = os.path.join(self.directory, name)
+            try:
+                loaded.append(self._load(path, budget))
+            except Exception:
+                log.warning(
+                    "skipping unloadable session checkpoint %s", path,
+                    exc_info=True,
+                )
+        loaded.sort(key=lambda s: s.session_id)
+        out.extend(loaded)
+        return out
+
+    def _load(self, path: str, budget):
+        from protocol_tpu.fleet import estimate_arena_bytes
+        from protocol_tpu.native.arena import NativeSolveArena
+        from protocol_tpu.ops.cost import CostWeights
+        from protocol_tpu.services.session_store import (
+            SolveSession,
+            parse_session_kernel,
+        )
+
+        meta: Optional[dict] = None
+        snapshot = None
+        arena_state: Optional[dict] = None
+        outcome = None
+        for kind, payload in tfmt.read_frames(path):
+            if kind == -1:
+                raise ValueError(f"{path}: torn checkpoint tail")
+            if kind == tfmt.KIND_META:
+                meta = json.loads(payload)
+            elif kind == tfmt.KIND_SNAPSHOT:
+                snapshot = tfmt._parse_snapshot(payload)
+            elif kind == tfmt.KIND_ARENA:
+                arena_state = tfmt.unpack_arrays(payload)
+            elif kind == tfmt.KIND_OUTCOME:
+                outcome = tfmt._parse_outcome(payload)
+        if meta is None or meta.get("kind") != _META_KIND:
+            raise ValueError(f"{path}: not a session checkpoint")
+        if snapshot is None:
+            raise ValueError(f"{path}: checkpoint has no snapshot frame")
+        parsed = parse_session_kernel(meta["kernel"])
+        if parsed is None:
+            raise ValueError(
+                f"{path}: checkpointed kernel {meta['kernel']!r} is not "
+                "session-servable"
+            )
+        engine, _ = parsed
+        threads = int(meta["threads"])
+        arena = NativeSolveArena(
+            k=int(meta["top_k"]), threads=threads, engine=engine
+        )
+        p_cols, r_cols = snapshot.p_cols, snapshot.r_cols  # lint: unlocked-ok (parsed trace frame, not a live session)
+        if arena_state is not None:
+            am = meta.get("arena") or {}
+            arena_state["warm_solves"] = int(am.get("warm_solves", 0))
+            arena_state["dual_age"] = int(am.get("dual_age", 0))
+            arena_state["weights_key"] = tuple(
+                am.get("weights_key") or meta["weights"]
+            )
+            arena.restore_state(
+                tfmt._as_ns(p_cols), tfmt._as_ns(r_cols), arena_state
+            )
+        session = SolveSession(
+            session_id=meta["session_id"],
+            fingerprint=meta["fingerprint"],
+            weights=CostWeights(*meta["weights"]),
+            kernel=meta["kernel"],
+            threads=threads,
+            top_k=int(meta["top_k"]),
+            p_cols=p_cols,
+            r_cols=r_cols,
+            n_providers=int(meta["n_providers"]),
+            n_tasks=int(meta["n_tasks"]),
+            arena=arena,
+            tick=int(meta["tick"]),
+            budget=budget,
+            arena_bytes=estimate_arena_bytes(
+                p_cols, r_cols, int(meta["top_k"])
+            ),
+        )
+        # fresh object, not yet visible to any store: no lock exists yet
+        session.delta_rows_total = int(meta.get("delta_rows_total", 0))  # lint: unlocked-ok (fresh object)
+        session.last_delta_crc = int(meta.get("last_delta_crc", 0))  # lint: unlocked-ok (fresh object)
+        if outcome is not None:
+            session.last_p4t = np.asarray(  # lint: unlocked-ok (fresh object)
+                outcome.provider_for_task, np.int32
+            )
+        return session
+
+    def drop(self, session_id: str) -> None:
+        """Remove a session's checkpoint (explicit client drop — an
+        evicted-for-pressure session keeps its file: resurrecting it on
+        restart is harmless, a same-id reopen just overwrites)."""
+        try:
+            os.remove(self.path_for(session_id))
+        except OSError:
+            pass

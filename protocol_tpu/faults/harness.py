@@ -1,0 +1,402 @@
+"""The seeded chaos drill: one recorded trace, one session, real faults.
+
+``run_chaos`` drives a recorded (or synthesized) trace through a live
+loopback servicer while the fault plane fires — server-side drops and
+delays (gRPC interceptor), client-side corruption / truncation /
+duplication / lost responses (the :class:`ChaosClient` shim), a
+scripted servicer kill+restart, a shard blackout, a forced eviction,
+and the per-tick solve deadline — and reports what the recovery
+machinery did about it.
+
+The acceptance claim this harness exists to check is the strongest one
+the trace subsystem can express (the VirtualFlow decoupling argument):
+under kills, drops, delays and blackouts, the session must reconverge
+**warm** — zero full-snapshot reopens — and every fresh (non-degraded)
+tick's plan must be **bit-identical to the fault-free replay** of the
+same trace. Degraded (stale) answers must be explicitly flagged and
+bounded; a forced eviction is the one fault whose contract IS the
+reopen (counted, not hidden).
+
+The kill is staged as the worst case the checkpoint protocol must
+survive: the tick is applied and flushed server-side, the RESPONSE is
+discarded (as a crash would), the servicer is torn down and a fresh one
+rehydrates from the checkpoint directory — the client's retransmit must
+then be answered idempotently from the restored cursor, not refused
+into a reopen.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from protocol_tpu.faults.inject import ChaosClient
+from protocol_tpu.faults.plan import ChaosConfig, FaultSchedule
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Driver:
+    """One session's chaos-hardened drive loop (the production ladder:
+    transport retry + reconnect, RESOURCE_EXHAUSTED backoff-retry,
+    INVALID_ARGUMENT resend, reopen only when the session is truly
+    gone)."""
+
+    def __init__(self, address: str, schedule: FaultSchedule,
+                 sid: str, kernel: str, snap, max_retries: int = 60):
+        from protocol_tpu.services.scheduler_grpc import (
+            SchedulerBackendClient,
+        )
+
+        self.address = address
+        self.sid = sid
+        self.kernel = kernel
+        self.snap = snap
+        self.max_retries = max_retries
+        self.client = ChaosClient(
+            SchedulerBackendClient(address), schedule
+        )
+        self.fp: Optional[str] = None
+        self.server_tick = 0
+        self.counters = {
+            "reopens": 0,
+            "transport_retries": 0,
+            "throttle_retries": 0,
+            "corrupt_resends": 0,
+            "stale_served": 0,
+            "replayed_served": 0,
+        }
+
+    def _count(self, name: str) -> None:
+        self.counters[name] += 1
+
+    def reconnect(self) -> None:
+        from protocol_tpu.services.scheduler_grpc import (
+            SchedulerBackendClient,
+        )
+
+        self.client.rebind(SchedulerBackendClient(self.address))
+
+    def open(self, p_cols, r_cols) -> np.ndarray:
+        from protocol_tpu.proto import scheduler_pb2 as pb
+        from protocol_tpu.proto import wire
+        from protocol_tpu.trace import format as tfmt
+
+        snap = self.snap
+        w = tfmt._as_ns(dict(zip(
+            ("price", "load", "proximity", "priority"), snap.weights
+        )))
+        fp = wire.epoch_fingerprint(
+            p_cols, r_cols, w, self.kernel,
+            max(int(snap.top_k) or 64, 1), snap.eps, snap.max_iters,
+        )
+        req = pb.AssignRequestV2(
+            providers=wire.encode_providers_v2(tfmt._as_ns(p_cols)),
+            requirements=wire.encode_requirements_v2(
+                tfmt._as_ns(r_cols)
+            ),
+            weights=pb.CostWeights(
+                price=snap.weights[0], load=snap.weights[1],
+                proximity=snap.weights[2], priority=snap.weights[3],
+            ),
+            kernel=self.kernel, top_k=snap.top_k, eps=snap.eps,
+            max_iters=snap.max_iters,
+        )
+        chunks = list(wire.chunk_snapshot(self.sid, fp, req))
+        for attempt in range(self.max_retries):
+            try:
+                resp = self.client.open_session(
+                    iter(chunks), timeout=300
+                )
+            except grpc.RpcError:
+                self._count("transport_retries")
+                time.sleep(0.01 * min(attempt + 1, 10))
+                self.reconnect()
+                continue
+            if resp.ok:
+                self.fp = fp
+                self.server_tick = 0
+                return wire.unblob(
+                    resp.result.provider_for_task, np.int32
+                )
+            # truncated stream / draining: transient, re-send the
+            # snapshot (the chaos twin of the matcher's unary fallback)
+            self._count("transport_retries")
+            time.sleep(0.01 * min(attempt + 1, 10))
+        raise RuntimeError(
+            f"OpenSession never succeeded after {self.max_retries} "
+            "attempts"
+        )
+
+    def _delta_request(self, tick: int, delta):
+        from protocol_tpu.proto import scheduler_pb2 as pb
+        from protocol_tpu.proto import wire
+        from protocol_tpu.trace import format as tfmt
+
+        req = pb.AssignDeltaRequest(
+            session_id=self.sid, epoch_fingerprint=self.fp, tick=tick
+        )
+        if delta.provider_rows.size:
+            req.provider_rows.CopyFrom(
+                wire.blob(delta.provider_rows, np.int32)
+            )
+            req.providers.CopyFrom(
+                wire.encode_providers_v2(tfmt._as_ns(delta.p_cols))
+            )
+        if delta.task_rows.size:
+            req.task_rows.CopyFrom(wire.blob(delta.task_rows, np.int32))
+            req.requirements.CopyFrom(
+                wire.encode_requirements_v2(tfmt._as_ns(delta.r_cols))
+            )
+        return req
+
+    def tick(self, delta, p_cols, r_cols) -> tuple[np.ndarray, bool]:
+        """One delta tick through the ladder. Returns (p4t, stale)."""
+        from protocol_tpu.proto import wire
+
+        req = self._delta_request(self.server_tick + 1, delta)
+        invalid_resent = False
+        for attempt in range(self.max_retries):
+            try:
+                resp = self.client.assign_delta(req, timeout=300)
+            except grpc.RpcError as e:
+                if (
+                    e.code() == grpc.StatusCode.INVALID_ARGUMENT
+                    and not invalid_resent
+                ):
+                    # corrupted-in-transit frame refused at decode
+                    # before any state moved: resend once
+                    self._count("corrupt_resends")
+                    invalid_resent = True
+                    continue
+                self._count("transport_retries")
+                time.sleep(0.01 * min(attempt + 1, 10))
+                self.reconnect()
+                continue
+            if resp.session_ok:
+                self.server_tick += 1
+                if resp.stale:
+                    self._count("stale_served")
+                if resp.replayed:
+                    self._count("replayed_served")
+                return (
+                    wire.unblob(
+                        resp.result.provider_for_task, np.int32
+                    ),
+                    bool(resp.stale),
+                )
+            if "RESOURCE_EXHAUSTED" in resp.error:
+                # blackout / admission / backpressure: the session is
+                # alive — retry the SAME tick after a short backoff
+                self._count("throttle_retries")
+                time.sleep(0.01 * min(attempt + 1, 10))
+                continue
+            # truly gone (evicted / unknown): reopen from the current
+            # cumulative columns — the counted, last-resort rung
+            self._count("reopens")
+            p4t = self.open(p_cols, r_cols)
+            return p4t, False
+        raise RuntimeError(
+            f"delta tick {self.server_tick + 1} never succeeded after "
+            f"{self.max_retries} attempts"
+        )
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def run_chaos(
+    trace_path: str,
+    kernel: Optional[str] = None,
+    seed: int = 0,
+    drop_rate: float = 0.0,
+    delay_rate: float = 0.0,
+    delay_ms: float = 2.0,
+    corrupt_rate: float = 0.0,
+    truncate_rate: float = 0.0,
+    duplicate_rate: float = 0.0,
+    kill_at_tick: Optional[int] = None,
+    blackout_at_tick: Optional[int] = None,
+    blackout_refusals: int = 2,
+    evict_at_tick: Optional[int] = None,
+    tick_deadline_ms: Optional[float] = None,
+    max_stale_ticks: int = 2,
+    ckpt_every: int = 1,
+    shards: int = 2,
+    ckpt_dir: Optional[str] = None,
+) -> dict:
+    """Run the drill. Returns the report dict; the perf gate asserts on
+    it (this function only measures — policy lives in the gate)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from protocol_tpu.fleet.fabric import FleetConfig
+    from protocol_tpu.services.scheduler_grpc import serve
+    from protocol_tpu.trace import format as tfmt
+    from protocol_tpu.trace.replay import iter_input_ticks, replay
+
+    trace = tfmt.read_trace(trace_path)
+    snap = trace.snapshot
+    if snap is None:
+        raise ValueError(f"{trace_path}: no snapshot frame")
+    kernel = kernel or snap.kernel or "native-mt:1"
+
+    # fault-free ground truth: the same trace through the in-process
+    # arena (bit-identical to the wire path by the replay-identity gate)
+    base = replay(
+        trace_path, engine=kernel, verify=False, keep_p4t=True
+    )
+    baseline = base["p4ts"]
+
+    config = ChaosConfig(
+        seed=seed, drop_rate=drop_rate, delay_rate=delay_rate,
+        delay_ms=delay_ms, corrupt_rate=corrupt_rate,
+        truncate_rate=truncate_rate, duplicate_rate=duplicate_rate,
+        kill_at_tick=kill_at_tick, blackout_shard=0,
+        blackout_refusals=blackout_refusals,
+        evict_at_tick=evict_at_tick,
+    )
+    schedule = FaultSchedule(config)
+
+    tmpdir = None
+    if ckpt_dir is None:
+        tmpdir = tempfile.TemporaryDirectory(prefix="chaos_ckpt_")
+        ckpt_dir = tmpdir.name
+    fleet_cfg = FleetConfig(
+        shards=shards, ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+        tick_deadline_ms=tick_deadline_ms,
+        max_stale_ticks=max_stale_ticks,
+    )
+    port = _free_port()
+    address = f"127.0.0.1:{port}"
+    server = serve(address, fleet=fleet_cfg, chaos=schedule)
+    sid = "t0@chaos"
+    driver = _Driver(address, schedule, sid, kernel, snap)
+
+    per_tick_identical: list[bool] = []
+    stale_ticks: list[int] = []
+    fresh_mismatch_ticks: list[int] = []
+    assigned_frac_min = 1.0
+    restarted = False
+    try:
+        for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
+            if tick == 0:
+                p4t, stale = driver.open(p_cols, r_cols), False
+            else:
+                if kill_at_tick is not None and tick == kill_at_tick:
+                    # the worst-case crash window: the tick is applied
+                    # and checkpointed server-side, the response dies,
+                    # the servicer dies — the retransmit must be
+                    # answered idempotently by the RESTART
+                    req = driver._delta_request(
+                        driver.server_tick + 1, delta
+                    )
+                    try:
+                        driver.client.assign_delta(req, timeout=300)
+                    except grpc.RpcError:
+                        pass  # a chaos drop here is fine either way
+                    server.stop(grace=None)
+                    server = serve(
+                        address, fleet=fleet_cfg, chaos=schedule
+                    )
+                    restarted = True
+                    driver.reconnect()
+                if (
+                    blackout_at_tick is not None
+                    and tick == blackout_at_tick
+                ):
+                    server.servicer.sessions.blackout(
+                        server.servicer.sessions.shard_index(sid),
+                        blackout_refusals,
+                    )
+                if evict_at_tick is not None and tick == evict_at_tick:
+                    server.servicer.sessions.shard_of(sid).evict(
+                        sid, "chaos"
+                    )
+                p4t, stale = driver.tick(delta, p_cols, r_cols)
+            n_live = int(np.asarray(r_cols["valid"], bool).sum())
+            if n_live > 0:
+                assigned_frac_min = min(
+                    assigned_frac_min,
+                    float((p4t >= 0).sum()) / n_live,
+                )
+            if stale:
+                stale_ticks.append(tick)
+                per_tick_identical.append(False)
+            else:
+                same = bool(np.array_equal(p4t, baseline[tick]))
+                per_tick_identical.append(same)
+                if not same:
+                    fresh_mismatch_ticks.append(tick)
+        servicer = server.servicer
+        seam = servicer.seam.snapshot()
+        obs_snap = servicer.obs.snapshot()
+        fleet_snap = servicer.sessions.snapshot()
+    finally:
+        driver.close()
+        server.stop(grace=None)
+        if tmpdir is not None:
+            tmpdir.cleanup()
+
+    ticks = len(per_tick_identical)
+    return {
+        "trace": trace_path,
+        "kernel": kernel,
+        "chaos": config.spec(),
+        "ticks": ticks,
+        "restarted": restarted,
+        "client": dict(driver.counters),
+        "injected": dict(driver.client.counters),
+        "stale_ticks": stale_ticks,
+        "assigned_frac_min": round(assigned_frac_min, 4),
+        "max_stale_streak": _max_streak(stale_ticks),
+        "fresh_ticks_identical": not fresh_mismatch_ticks,
+        "fresh_mismatch_ticks": fresh_mismatch_ticks[:8],
+        "final_tick_identical": (
+            bool(per_tick_identical[-1]) if ticks else False
+        ),
+        "server_seam": {
+            k: v for k, v in sorted(seam.items())
+            if isinstance(v, (int, float)) and (
+                "stale" in k or "replay" in k or "restore" in k
+                or "reopen" in k or "tick_mismatch" in k
+                or "deadline" in k or "drain" in k or "ckpt" in k
+            )
+        },
+        "server_stale_obs": _stale_obs(obs_snap),
+        "blackout_refusals_served": fleet_snap.get(
+            "blackout_refusals_served", 0
+        ),
+    }
+
+
+def _max_streak(stale_ticks: list) -> int:
+    best = run = 0
+    prev = None
+    for t in stale_ticks:
+        run = run + 1 if prev is not None and t == prev + 1 else 1
+        best = max(best, run)
+        prev = t
+    return best
+
+
+def _stale_obs(obs_snap: dict) -> dict:
+    """Per-tenant stale-tick counters from the obs plane (degraded
+    answers must be COUNTED, not just flagged — the acceptance bar)."""
+    out = {}
+    for tenant, entry in (obs_snap.get("tenants") or {}).items():
+        n = entry.get("stale_ticks")
+        if n:
+            out[tenant] = n
+    return out

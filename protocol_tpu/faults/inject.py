@@ -1,0 +1,329 @@
+"""Fault injectors: where the schedule's decisions land on the wire.
+
+Two injection sites, both driven by the same :class:`FaultSchedule` so
+one seed describes the whole run:
+
+  * :class:`ChaosClient` — wraps a ``SchedulerBackendClient`` and
+    applies client-observable transport faults: dropped requests AND
+    dropped responses (the server processed, the answer died — the case
+    that exercises idempotent retransmit), injected delay, corrupted
+    TensorBlob bytes (the input-hardening refusal path), truncated
+    OpenSession chunk streams, and duplicated deltas (the dedup path).
+  * :class:`ChaosServerInterceptor` — a real ``grpc.ServerInterceptor``
+    that drops (UNAVAILABLE before the servicer runs) or delays RPCs
+    server-side, so the client's retry ladder sees genuine mid-stream
+    failures on a live HTTP/2 connection.
+
+Corruption mutates a COPY of the request: the caller's message is never
+damaged, exactly like a wire-level bit flip leaves the sender's buffer
+intact. A corrupted frame must be REJECTED by the server's decode
+hardening (INVALID_ARGUMENT) before it can poison a session arena —
+that refusal is the behavior under test, not an error in the injector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import grpc
+
+from protocol_tpu.faults.plan import FaultAction, FaultSchedule
+
+
+class FaultInjectedError(grpc.RpcError):
+    """The client-side injector's stand-in for a transport failure —
+    quacks like a live RpcError (``code()``/``details()``) so the
+    production retry ladder handles it without knowing chaos exists."""
+
+    def __init__(self, code=grpc.StatusCode.UNAVAILABLE,
+                 details: str = "chaos: injected fault"):
+        super().__init__(details)
+        self._code = code
+        self._details = details
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+_F32_NAN = b"\x00\x00\xc0\x7f"  # little-endian float32 quiet NaN
+
+
+def corrupt_request(request, schedule: FaultSchedule, site: str,
+                    method: str, index: int):
+    """Deterministically poison a COPY of ``request`` such that the
+    server's decode hardening MUST refuse it (the refusal path is what
+    this fault class drills — a flip that decodes to a valid finite
+    value would silently APPLY and poison the arena, the exact outcome
+    the contract forbids): a float column gets one deterministic lane
+    overwritten with NaN bytes; a message carrying only integer blobs
+    gets its first blob sheared by a byte (size mismatch at unblob).
+    Returns the corrupted copy, or None when the message carries no
+    blob bytes at all (an empty delta)."""
+    mutated = type(request)()
+    mutated.CopyFrom(request)
+    float_blobs, int_blobs = [], []
+    for batch_name in ("providers", "requirements"):
+        if mutated.HasField(batch_name):
+            for nt in getattr(mutated, batch_name).columns:
+                if len(nt.tensor.data):
+                    (
+                        float_blobs if nt.tensor.dtype == "float32"
+                        else int_blobs
+                    ).append(nt.tensor)
+    fields = type(mutated).DESCRIPTOR.fields_by_name
+    for blob_name in ("provider_rows", "task_rows"):
+        if blob_name in fields and mutated.HasField(blob_name):
+            b = getattr(mutated, blob_name)
+            if len(b.data):
+                int_blobs.append(b)
+    if float_blobs and len(float_blobs[0].data) >= 4:
+        target = float_blobs[0]
+        off, _ = schedule.corrupt_byte(
+            site, method, index, len(target.data)
+        )
+        lane = (off // 4) % (len(target.data) // 4)
+        raw = bytearray(target.data)
+        raw[lane * 4:lane * 4 + 4] = _F32_NAN
+        target.data = bytes(raw)
+        return mutated
+    if int_blobs:
+        target = int_blobs[0]
+        target.data = target.data[:-1]  # size mismatch at unblob
+        return mutated
+    return None
+
+
+class ChaosClient:
+    """``SchedulerBackendClient`` wrapper applying the schedule's
+    client-side faults per call. Interface-compatible with the subset
+    the session drivers use (``open_session`` / ``assign_delta`` /
+    ``assign_v2`` / ``assign`` / ``health`` / ``close``).
+
+    Fault semantics per call:
+
+      drop       deliver-or-not is decided by one extra schedule bit:
+                 half the drops never reach the server (request lost),
+                 half reach it and lose the RESPONSE — the server
+                 processed the tick, so the retry MUST be answered
+                 idempotently, not re-applied.
+      delay      sleep ``delay_ms`` before sending.
+      corrupt    poison one TensorBlob in a copy (NaN lane / sheared
+                 blob); the server must refuse at decode
+                 (INVALID_ARGUMENT).
+      truncate   OpenSession only: the final chunk is withheld, so the
+                 server sees a short stream and refuses.
+      duplicate  AssignDelta only: the same request is sent twice
+                 back-to-back; the second answer must be the replayed
+                 twin of the first (``counters["dup_mismatch"]`` counts
+                 violations).
+    """
+
+    def __init__(self, client, schedule: FaultSchedule,
+                 site: str = "client"):
+        self._client = client
+        self._schedule = schedule
+        self._site = site
+        self._lock = threading.Lock()
+        self._index: dict[str, int] = {}
+        self.counters: dict[str, int] = {}
+
+    # ---------------- bookkeeping ----------------
+
+    def _next(self, method: str) -> int:
+        with self._lock:
+            i = self._index.get(method, 0)
+            self._index[method] = i + 1
+            return i
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    def _act(self, method: str) -> tuple[FaultAction, int]:
+        i = self._next(method)
+        return self._schedule.decide(self._site, method, i), i
+
+    def _drop_after(self, method: str, index: int) -> bool:
+        # one extra deterministic bit: False = request lost before the
+        # server, True = server processed and the response was lost
+        return FaultSchedule._frac(
+            self._schedule.config.seed, "drop_after", self._site, method,
+            index,
+        ) < 0.5
+
+    # ---------------- faulted calls ----------------
+
+    def _unary(self, method: str, send, request):
+        act, i = self._act(method)
+        if act.delay_ms:
+            self._count("delay")
+            time.sleep(act.delay_ms / 1e3)
+        if act.drop:
+            if self._drop_after(method, i):
+                send(request)  # the server sees it; the answer dies
+                self._count("drop_response")
+            else:
+                self._count("drop_request")
+            raise FaultInjectedError()
+        if act.corrupt:
+            mutated = corrupt_request(
+                request, self._schedule, self._site, method, i
+            )
+            if mutated is not None:
+                self._count("corrupt")
+                return send(mutated)
+        if act.duplicate and method == "AssignDelta":
+            self._count("duplicate")
+            first = send(request)
+            second = send(request)
+            if (
+                first.session_ok and second.session_ok
+                and first.result.provider_for_task.data
+                != second.result.provider_for_task.data
+            ):
+                # a duplicated tick that produced a DIFFERENT plan was
+                # double-applied — the exact bug dedup exists to refuse
+                self._count("dup_mismatch")
+            return first
+        return send(request)
+
+    def assign_delta(self, request, timeout=60.0, metadata=None):
+        return self._unary(
+            "AssignDelta",
+            lambda req: self._client.assign_delta(
+                req, timeout=timeout, metadata=metadata
+            ),
+            request,
+        )
+
+    def assign_v2(self, request, timeout=60.0, metadata=None):
+        return self._unary(
+            "AssignV2",
+            lambda req: self._client.assign_v2(
+                req, timeout=timeout, metadata=metadata
+            ),
+            request,
+        )
+
+    def assign(self, request, timeout=60.0, metadata=None):
+        return self._unary(
+            "Assign",
+            lambda req: self._client.assign(
+                req, timeout=timeout, metadata=metadata
+            ),
+            request,
+        )
+
+    def open_session(self, chunks, timeout=300.0, metadata=None):
+        act, i = self._act("OpenSession")
+        if act.delay_ms:
+            self._count("delay")
+            time.sleep(act.delay_ms / 1e3)
+        if act.drop:
+            # a streamed call's drop is always request-side: losing the
+            # response of a half-open stream presents as UNAVAILABLE
+            # either way
+            self._count("drop_request")
+            raise FaultInjectedError()
+        chunk_list = list(chunks)
+        if act.truncate and len(chunk_list) > 0:
+            self._count("truncate")
+            if len(chunk_list) > 1:
+                chunk_list = chunk_list[:-1]
+            else:
+                # single-chunk snapshot: shear the payload instead
+                short = type(chunk_list[0])()
+                short.CopyFrom(chunk_list[0])
+                short.payload = short.payload[: max(
+                    1, len(short.payload) // 2
+                )]
+                chunk_list = [short]
+        return self._client.open_session(
+            iter(chunk_list), timeout=timeout, metadata=metadata
+        )
+
+    def health(self, timeout=10.0):
+        return self._client.health(timeout=timeout)
+
+    def close(self) -> None:
+        self._client.close()
+
+    # reconnect support: the harness's retry ladder replaces the inner
+    # client on transport failure, keeping the fault counters/cursors
+    @property
+    def address(self) -> str:
+        return self._client.address
+
+    def rebind(self, client) -> None:
+        old, self._client = self._client, client
+        try:
+            old.close()
+        except Exception:
+            pass
+
+
+class ChaosServerInterceptor(grpc.ServerInterceptor):
+    """Server-side drop/delay by method, one decision per RPC. Wraps
+    whichever handler shape the method uses (unary-unary or
+    stream-unary — the seam's two shapes); other shapes pass through."""
+
+    def __init__(self, schedule: FaultSchedule, site: str = "server"):
+        self._schedule = schedule
+        self._site = site
+        self._lock = threading.Lock()
+        self._index: dict[str, int] = {}
+        self.counters: dict[str, int] = {}
+
+    def _next(self, method: str) -> int:
+        with self._lock:
+            i = self._index.get(method, 0)
+            self._index[method] = i + 1
+            return i
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        method = handler_call_details.method.rsplit("/", 1)[-1]
+        act = self._schedule.decide(
+            self._site, method, self._next(method)
+        )
+        if not (act.drop or act.delay_ms):
+            return handler
+
+        def wrap(inner):
+            def faulted(request_or_iterator, context):
+                if act.delay_ms:
+                    self._count("delay")
+                    time.sleep(act.delay_ms / 1e3)
+                if act.drop:
+                    self._count("drop")
+                    context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        "chaos: injected server-side drop",
+                    )
+                return inner(request_or_iterator, context)
+
+            return faulted
+
+        if handler.unary_unary is not None:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        if handler.stream_unary is not None:
+            return grpc.stream_unary_rpc_method_handler(
+                wrap(handler.stream_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler

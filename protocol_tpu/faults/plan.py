@@ -1,0 +1,192 @@
+"""Seeded deterministic fault schedule.
+
+Every fault decision is a pure function of ``(seed, fault class, site,
+method, call index)``: the same config replays the same chaos byte for
+byte, so a chaos run can be re-executed for debugging and its acceptance
+claims (reconvergence, bit-identical final plans) can be gated in CI the
+same way perf claims are. The hash-to-fraction trick is the one the
+client's retry jitter already uses (``RemoteBatchMatcher._backoff_s``):
+sha1 bytes as a uniform draw — no ``random`` (drifts across library
+versions), no clocks.
+
+Rate faults (drop / delay / corrupt / truncate / duplicate) fire
+independently per call with their configured probability. Scripted
+faults (servicer kill, shard blackout, forced eviction, budget
+starvation) are one-shot events keyed on a tick index and are owned by
+the DRIVER (harness / loadgen), not the injectors — a process kill is
+not something an interceptor can do to itself cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import NamedTuple, Optional
+
+ENV_VAR = "PROTOCOL_TPU_CHAOS"
+
+
+class FaultAction(NamedTuple):
+    """What one call suffers. ``delay_ms == 0`` means no delay."""
+
+    drop: bool
+    delay_ms: float
+    corrupt: bool
+    truncate: bool
+    duplicate: bool
+
+    @property
+    def clean(self) -> bool:
+        return not (
+            self.drop or self.delay_ms or self.corrupt
+            or self.truncate or self.duplicate
+        )
+
+
+NO_FAULT = FaultAction(False, 0.0, False, False, False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Declarative chaos knobs. All-zero (the default) is inert.
+
+    ``from_spec`` parses the compact ``key=value,key=value`` form the
+    env var and CLI flags carry, e.g.::
+
+        seed=3,drop=0.05,delay=0.05,delay_ms=5,corrupt=0.01,
+        kill_at_tick=4,blackout_shard=1,blackout_refusals=2
+    """
+
+    seed: int = 0
+    drop_rate: float = 0.0
+    delay_rate: float = 0.0
+    delay_ms: float = 5.0
+    corrupt_rate: float = 0.0
+    truncate_rate: float = 0.0
+    duplicate_rate: float = 0.0
+    # scripted one-shot events (driver-owned; see module docstring)
+    kill_at_tick: Optional[int] = None
+    blackout_shard: Optional[int] = None
+    blackout_refusals: int = 2
+    evict_at_tick: Optional[int] = None
+    starve_budget_ticks: int = 0
+
+    _FLOATS = (
+        "drop_rate", "delay_rate", "delay_ms", "corrupt_rate",
+        "truncate_rate", "duplicate_rate",
+    )
+    _INTS = (
+        "seed", "kill_at_tick", "blackout_shard", "blackout_refusals",
+        "evict_at_tick", "starve_budget_ticks",
+    )
+    # spec aliases: the short names the env/CLI spec uses
+    _ALIASES = {
+        "drop": "drop_rate",
+        "delay": "delay_rate",
+        "corrupt": "corrupt_rate",
+        "truncate": "truncate_rate",
+        "dup": "duplicate_rate",
+    }
+
+    def active(self) -> bool:
+        return bool(
+            self.drop_rate or self.delay_rate or self.corrupt_rate
+            or self.truncate_rate or self.duplicate_rate
+            or self.kill_at_tick is not None
+            or self.blackout_shard is not None
+            or self.evict_at_tick is not None
+            or self.starve_budget_ticks
+        )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosConfig":
+        kv: dict = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"chaos spec item {part!r} is not key=value"
+                )
+            key, _, val = part.partition("=")
+            key = cls._ALIASES.get(key.strip(), key.strip())
+            if key in cls._FLOATS:
+                kv[key] = float(val)
+            elif key in cls._INTS:
+                kv[key] = int(val)
+            else:
+                raise ValueError(f"unknown chaos knob {key!r}")
+        return cls(**kv)
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> Optional["ChaosConfig"]:
+        e = os.environ if env is None else env
+        spec = e.get(ENV_VAR, "").strip()
+        return cls.from_spec(spec) if spec else None
+
+    def spec(self) -> str:
+        """The compact round-trippable form (provenance for reports)."""
+        parts = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v != f.default:
+                parts.append(f"{f.name}={v}")
+        return ",".join(parts)
+
+
+class FaultSchedule:
+    """The deterministic decision engine over a :class:`ChaosConfig`.
+
+    ``decide(site, method, index)`` answers "what does call number
+    ``index`` of ``method`` at ``site`` suffer?" — a pure function, so
+    injectors on both sides of the wire can share one config without
+    sharing state, and a replayed run sees the identical fault train.
+    """
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+
+    @staticmethod
+    def _frac(seed: int, salt: str, site: str, method: str,
+              index: int) -> float:
+        digest = hashlib.sha1(
+            f"{seed}:{salt}:{site}:{method}:{index}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / 2.0 ** 64
+
+    def decide(self, site: str, method: str, index: int) -> FaultAction:
+        c = self.config
+        f = self._frac
+        drop = c.drop_rate > 0 and f(
+            c.seed, "drop", site, method, index
+        ) < c.drop_rate
+        delay = (
+            c.delay_ms
+            if c.delay_rate > 0
+            and f(c.seed, "delay", site, method, index) < c.delay_rate
+            else 0.0
+        )
+        corrupt = c.corrupt_rate > 0 and f(
+            c.seed, "corrupt", site, method, index
+        ) < c.corrupt_rate
+        truncate = c.truncate_rate > 0 and f(
+            c.seed, "truncate", site, method, index
+        ) < c.truncate_rate
+        duplicate = c.duplicate_rate > 0 and f(
+            c.seed, "dup", site, method, index
+        ) < c.duplicate_rate
+        return FaultAction(drop, delay, corrupt, truncate, duplicate)
+
+    def corrupt_byte(self, site: str, method: str, index: int,
+                     n_bytes: int) -> tuple[int, int]:
+        """Deterministic (offset, xor-mask) for a corruption fault —
+        which byte of the payload flips, and how. The mask is never 0
+        (a corruption that changes nothing is not a fault)."""
+        digest = hashlib.sha1(
+            f"{self.config.seed}:cbyte:{site}:{method}:{index}".encode()
+        ).digest()
+        off = int.from_bytes(digest[:8], "big") % max(n_bytes, 1)
+        mask = digest[8] or 0xFF
+        return off, mask

@@ -91,6 +91,17 @@ class FleetConfig:
     admit_burst: float = 16.0
     tenant_weights: Optional[dict] = None
     delta_queue_depth: int = 8  # <= 0 disables backpressure
+    # ---- resilience layer (chaos plane). ``ckpt_dir`` enables warm
+    # session checkpoints (faults/checkpoint.py): flushed every
+    # ``ckpt_every`` ticks BEFORE the tick is acknowledged, rehydrated
+    # at servicer boot. ``tick_deadline_ms`` arms the per-tick solve
+    # watchdog: a tick whose budget is already burned is served the
+    # previous plan with an explicit stale flag, never more than
+    # ``max_stale_ticks`` in a row (the bounded-staleness contract).
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 1
+    tick_deadline_ms: Optional[float] = None
+    max_stale_ticks: int = 2
 
     @classmethod
     def from_env(cls) -> "FleetConfig":
@@ -110,6 +121,14 @@ class FleetConfig:
             admit_burst=float(env("PROTOCOL_TPU_FLEET_ADMIT_BURST", "16")),
             delta_queue_depth=int(
                 env("PROTOCOL_TPU_FLEET_QUEUE_DEPTH", "8")
+            ),
+            ckpt_dir=env("PROTOCOL_TPU_FLEET_CKPT_DIR") or None,
+            ckpt_every=int(env("PROTOCOL_TPU_FLEET_CKPT_EVERY", "1")),
+            tick_deadline_ms=_opt(
+                "PROTOCOL_TPU_FLEET_TICK_DEADLINE_MS", float
+            ),
+            max_stale_ticks=int(
+                env("PROTOCOL_TPU_FLEET_MAX_STALE", "2")
             ),
         )
 
@@ -159,6 +178,19 @@ class SessionFabric:
         self._total_bytes = 0
         self._pressure_evictions = 0
         self._evictions_by_tenant: dict[str, int] = {}
+        # ---- shard blackout (chaos plane: store-level fault). A
+        # blacked-out shard REFUSES the next N lookups with the
+        # RESOURCE_EXHAUSTED retry shape — the session still exists, so
+        # a client that backs off and retries resumes warm with zero
+        # reopens; an eviction-shaped refusal here would amplify a
+        # transient shard outage into a full-snapshot reopen herd.
+        self._blackout_lock = threading.Lock()
+        self._blackout: dict[int, int] = {}  # shard index -> refusals left
+        self.blackout_refusals_served = 0
+        # optional let-go observer (the servicer's checkpoint GC): fires
+        # for EVERY store let-go path with its reason, under the owning
+        # shard's lock — leaf work only, same contract as on_evict
+        self.on_let_go = None
 
     # ---------------- shard map ----------------
 
@@ -177,10 +209,34 @@ class SessionFabric:
         self._apply_pressure(protect=session.session_id)
 
     def get(self, session_id: str, fingerprint: str):
-        return self.shard_of(session_id).get(session_id, fingerprint)
+        idx = self.shard_index(session_id)
+        with self._blackout_lock:
+            left = self._blackout.get(idx, 0)
+            if left > 0:
+                self._blackout[idx] = left - 1
+                self.blackout_refusals_served += 1
+                return None, (
+                    "RESOURCE_EXHAUSTED: shard blackout (retry)"
+                )
+        return self.shards[idx].get(session_id, fingerprint)
+
+    def blackout(self, shard: int, refusals: int) -> None:
+        """Black out one shard for the next ``refusals`` lookups (the
+        chaos plane's store-level fault). Deterministic by construction:
+        counted in lookups, not wall-clock."""
+        with self._blackout_lock:
+            self._blackout[int(shard) % self.n_shards] = int(refusals)
 
     def drop(self, session_id: str) -> None:
         self.shard_of(session_id).drop(session_id)
+
+    def snapshot_sessions(self) -> list:
+        """Point-in-time list of every live session across shards (the
+        drain path's checkpoint-flush walk)."""
+        out = []
+        for shard in self.shards:
+            out.extend(shard.snapshot_sessions())
+        return out
 
     def __len__(self) -> int:
         return sum(len(s) for s in self.shards)
@@ -233,6 +289,7 @@ class SessionFabric:
             "evictions": self.evictions,
             "expirations": self.expirations,
             "evictions_by_tenant": by_tenant,
+            "blackout_refusals_served": self.blackout_refusals_served,
         }
 
     # ---------------- budget accounting ----------------
@@ -256,6 +313,11 @@ class SessionFabric:
     def _on_store_evict(self, session, reason: str) -> None:
         # store callback: runs under the owning shard's lock; only the
         # leaf budget lock may be taken here
+        if self.on_let_go is not None:
+            try:
+                self.on_let_go(session, reason)
+            except Exception:
+                pass  # an observer failure must never fail an eviction
         with self._budget_lock:
             entry = self._by_session.get(session.session_id)
             if entry is None or entry[0] is not session:

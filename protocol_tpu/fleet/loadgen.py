@@ -58,6 +58,7 @@ class _SessionStats:
     __slots__ = (
         "sid", "tenant", "cold_ms", "warm", "assigned_frac_min",
         "ticks_done", "refused", "reopens", "wall_s", "error",
+        "transport_retries", "stale", "replayed",
     )
 
     def __init__(self, sid: str):
@@ -71,6 +72,10 @@ class _SessionStats:
         self.reopens = 0
         self.wall_s = 0.0
         self.error: Optional[str] = None
+        # resilience ladder counters (the restart drill reads these)
+        self.transport_retries = 0
+        self.stale = 0
+        self.replayed = 0
 
 
 def _request_v2(snap, p_cols, r_cols, kernel: str):
@@ -123,7 +128,13 @@ def _drive_session(
     """One session's whole life against the servicer: snapshot open,
     then every recorded delta as a lockstep tick. Refusals follow the
     production ladder: bounded backoff-retry for RESOURCE_EXHAUSTED,
-    re-open from the current cumulative columns for evicted/unknown."""
+    re-open from the current cumulative columns for evicted/unknown,
+    and — the restart drill's rung — transport failures (a servicer
+    dying or draining mid-tick) reconnect and retry the SAME call, so
+    a kill+restart shows up as retries and warm resumes, never as a
+    failed session."""
+    import grpc
+
     from protocol_tpu.proto import scheduler_pb2 as pb
     from protocol_tpu.proto import wire
     from protocol_tpu.services.scheduler_grpc import SchedulerBackendClient
@@ -131,6 +142,25 @@ def _drive_session(
     from protocol_tpu.trace.replay import iter_input_ticks
 
     client = SchedulerBackendClient(address)
+
+    def send(call, transport_attempts: int = 60):
+        """Run ``call(client)`` with reconnect-and-retry on transport
+        failure (the restart window): bounded, deterministic backoff."""
+        nonlocal client
+        for attempt in range(transport_attempts):
+            try:
+                return call(client)
+            except grpc.RpcError:
+                if attempt + 1 >= transport_attempts:
+                    raise
+                stats.transport_retries += 1
+                time.sleep(0.02 * min(attempt + 1, 10))
+                try:
+                    client.close()
+                except Exception:
+                    pass
+                client = SchedulerBackendClient(address)
+
     t_run = time.perf_counter()
     try:
         snap = trace.snapshot
@@ -139,9 +169,9 @@ def _drive_session(
         for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
             t0 = time.perf_counter()
             if tick == 0:
-                fp, err, p4t = _open(
-                    client, snap, p_cols, r_cols, sid, kernel
-                )
+                fp, err, p4t = send(lambda c: _open(
+                    c, snap, p_cols, r_cols, sid, kernel
+                ))
                 if fp is None:
                     stats.error = f"OpenSession refused: {err}"
                     return
@@ -171,28 +201,42 @@ def _drive_session(
                 p4t = None
                 reopened = False
                 for retry in range(max_retries):
-                    resp = client.assign_delta(req, timeout=600)
+                    resp = send(
+                        lambda c: c.assign_delta(req, timeout=600)
+                    )
                     if resp.session_ok:
                         server_tick += 1
+                        if resp.stale:
+                            stats.stale += 1
+                        if resp.replayed:
+                            stats.replayed += 1
                         p4t = wire.unblob(
                             resp.result.provider_for_task, np.int32
                         )
                         break
                     stats.refused += 1
                     if "RESOURCE_EXHAUSTED" in resp.error:
-                        # admission/backpressure: back off and retry the
-                        # SAME tick (deterministic per-retry delay; many
-                        # sessions desync naturally on server service
-                        # order)
+                        # admission/backpressure/blackout: back off and
+                        # retry the SAME tick (deterministic per-retry
+                        # delay; many sessions desync naturally on
+                        # server service order)
                         time.sleep(0.01 * (retry + 1))
                         continue
                     # evicted / unknown / tick mismatch: re-open from
-                    # our authoritative cumulative columns (ladder)
+                    # our authoritative cumulative columns (ladder);
+                    # a "draining" refusal is transient — the
+                    # replacement server admits, so keep trying
                     stats.reopens += 1
                     reopened = True
-                    fp, err, p4t = _open(
-                        client, snap, p_cols, r_cols, sid, kernel
-                    )
+                    for dr in range(max_retries):
+                        fp, err, p4t = send(lambda c: _open(
+                            c, snap, p_cols, r_cols, sid, kernel
+                        ))
+                        if fp is not None or "draining" not in (
+                            err or ""
+                        ):
+                            break
+                        time.sleep(0.05 * (dr + 1))
                     if fp is None:
                         stats.error = f"re-open refused: {err}"
                         return
@@ -242,6 +286,10 @@ def run_load(
     max_sessions: Optional[int] = None,
     seed: int = 0,
     check_endpoint: bool = True,
+    restart_at_tick: Optional[int] = None,
+    restart_mode: str = "crash",
+    ckpt_dir: Optional[str] = None,
+    ckpt_every: int = 1,
 ) -> dict:
     """Run the harness; returns the report dict (see module docstring).
 
@@ -249,12 +297,26 @@ def run_load(
     rest over the remaining tenants — the "a tenant hammering 50
     sessions can't starve a tenant with 1" drill. ``traces`` replays
     recorded trace files (cycled over tenants) instead of synthesizing.
-    """
+
+    ``restart_at_tick`` arms the RESTART DRILL: once every session has
+    completed that many ticks, the servicer is taken down —
+    ``restart_mode="crash"`` hard-stops it (the kill path; recovery
+    rests on the per-tick flush-before-ack checkpoints),
+    ``restart_mode="drain"`` runs the SIGTERM drain (stop admitting,
+    finish in-flight ticks, flush checkpoints + trace tails) — and a
+    fresh servicer on the same port rehydrates from ``ckpt_dir``
+    (a temp dir when None). Sessions ride the production ladder
+    through the outage; with checkpoints on, they resume WARM (zero
+    reopens, counted in the report)."""
     from protocol_tpu.fleet.fabric import FleetConfig
     from protocol_tpu.services.scheduler_grpc import serve
     from protocol_tpu.trace import format as tfmt
     from protocol_tpu.trace.synth import synth_trace
 
+    if restart_mode not in ("crash", "drain"):
+        raise ValueError(
+            f"restart_mode must be crash|drain, got {restart_mode!r}"
+        )
     sessions = int(sessions)
     tenants = max(1, min(int(tenants), sessions))
     tmpdir = None
@@ -280,24 +342,74 @@ def run_load(
         trace = parsed[t % len(parsed)]
         sids.append((f"t{t}@s{i}", trace))
 
+    ckpt_tmp = None
+    if restart_at_tick is not None and ckpt_dir is None:
+        ckpt_tmp = tempfile.TemporaryDirectory(prefix="loadgen_ckpt_")
+        ckpt_dir = ckpt_tmp.name
+    if restart_at_tick is not None:
+        # the first server dies mid-run, taking its metrics endpoint
+        # with it: the scrape check would report a false negative
+        check_endpoint = False
     cfg = FleetConfig(
         shards=shards,
         admit_rate=admit_rate,
         max_bytes=max_bytes,
         delta_queue_depth=queue_depth,
+        ckpt_dir=ckpt_dir,
+        ckpt_every=ckpt_every,
     )
     port = _free_port()
     address = f"127.0.0.1:{port}"
-    server = serve(
-        address,
+    serve_kwargs = dict(
         max_workers=max_workers,
-        metrics_port=0 if check_endpoint else None,
         # every concurrent session must be pinnable: the default
         # max_sessions=8 would LRU-thrash 64 concurrent sessions
         max_sessions=max_sessions or max(sessions, 8),
         fleet=cfg,
     )
+    server_box = [serve(
+        address,
+        metrics_port=0 if check_endpoint else None,
+        **serve_kwargs,
+    )]
     all_stats = [_SessionStats(sid) for sid, _ in sids]
+    restart_report: dict = {}
+
+    def _restart_controller(driver_threads):
+        """Take the servicer down once every session has ticked past
+        ``restart_at_tick``, then bring a fresh one up on the same
+        port (rehydrating from ckpt_dir). Driver threads ride their
+        retry ladders through the outage."""
+        from protocol_tpu.services.scheduler_grpc import drain
+
+        while True:
+            # snapshot the live set once per pass: a driver flipping
+            # its error flag mid-check must not empty the min() below
+            live = [st for st in all_stats if not st.error]
+            if not live:
+                return  # everybody already failed; nothing to drill
+            if min(st.ticks_done for st in live) >= restart_at_tick:
+                break
+            if not any(th.is_alive() for th in driver_threads):
+                # the run finished before any session reached the drill
+                # tick (restart_at_tick beyond the trace): exit instead
+                # of spinning forever — the smoke gate reports the
+                # never-fired drill as the explicit failure it is
+                return
+            time.sleep(0.01)
+        old = server_box[0]
+        if restart_mode == "drain":
+            restart_report["flushed"] = drain(old, grace_s=10.0)
+        else:
+            old.stop(grace=None)  # the kill path: no drain, no flush
+        server_box[0] = serve(address, metrics_port=None, **serve_kwargs)
+        restart_report["restarted"] = True
+        restart_report["sessions_restored"] = int(
+            server_box[0].servicer.seam.snapshot().get(
+                "session_session_restored", 0
+            )
+        )
+
     t_wall = time.perf_counter()
     try:
         threads = [
@@ -308,11 +420,17 @@ def run_load(
             )
             for (_, trace), st in zip(sids, all_stats)
         ]
+        if restart_at_tick is not None:
+            threads.append(threading.Thread(
+                target=_restart_controller, args=(list(threads),),
+                name="loadgen-restart",
+            ))
         for th in threads:
             th.start()
         for th in threads:
             th.join()
         wall_s = time.perf_counter() - t_wall
+        server = server_box[0]
         obs_snapshot = server.servicer.obs.snapshot()
         endpoint_json = None
         if check_endpoint and server.metrics is not None:
@@ -331,11 +449,14 @@ def run_load(
                 # traceback instead of a named gate failure
                 endpoint_json = None
     finally:
+        server = server_box[0]
         if server.metrics is not None:
             server.metrics.stop()
         server.stop(grace=None)
         if tmpdir is not None:
             tmpdir.cleanup()
+        if ckpt_tmp is not None:
+            ckpt_tmp.cleanup()
 
     # ---------------- aggregation ----------------
     by_tenant: dict[str, dict] = {}
@@ -355,6 +476,9 @@ def run_load(
                 "ticks_done": 0,
                 "refused": 0,
                 "reopens": 0,
+                "transport_retries": 0,
+                "stale": 0,
+                "replayed": 0,
             },
         )
         agg["sessions"] += 1
@@ -368,6 +492,9 @@ def run_load(
         agg["ticks_done"] += st.ticks_done
         agg["refused"] += st.refused
         agg["reopens"] += st.reopens
+        agg["transport_retries"] += st.transport_retries
+        agg["stale"] += st.stale
+        agg["replayed"] += st.replayed
         total_warm_ticks += len(st.warm)
         if st.wall_s > 0:
             # zero-warm sessions contribute rate 0: a starved session
@@ -407,6 +534,9 @@ def run_load(
             "ticks_done": a["ticks_done"],
             "refused": a["refused"],
             "reopens": a["reopens"],
+            "transport_retries": a["transport_retries"],
+            "stale": a["stale"],
+            "replayed": a["replayed"],
             **_tenant_quality(t),
         }
         for t, a in sorted(by_tenant.items())
@@ -448,6 +578,13 @@ def run_load(
             "max_bytes": max_bytes,
             "queue_depth": queue_depth,
             "seed": seed,
+            "restart_at_tick": restart_at_tick,
+            "restart_mode": (
+                restart_mode if restart_at_tick is not None else None
+            ),
+            "ckpt_every": (
+                ckpt_every if ckpt_dir is not None else None
+            ),
             "traces": [str(p) for p in traces] if tmpdir is None else
                       "synth (ephemeral)",
         },
@@ -466,6 +603,17 @@ def run_load(
         "metrics_endpoint_ok": endpoint_json is not None,
         "scaling": scaling,
     }
+    if restart_at_tick is not None:
+        report["restart"] = {
+            "mode": restart_mode,
+            "at_tick": restart_at_tick,
+            **restart_report,
+            "reopens_total": sum(st.reopens for st in all_stats),
+            "transport_retries_total": sum(
+                st.transport_retries for st in all_stats
+            ),
+            "replayed_total": sum(st.replayed for st in all_stats),
+        }
     return report
 
 
@@ -521,6 +669,18 @@ def _print_report(rep: dict) -> None:
             f"(degraded {bud.get('degraded_grants')}), fairness gauge "
             f"{bud.get('fairness_index')}"
         )
+    rs = rep.get("restart")
+    if rs:
+        print(
+            f"  restart drill: mode={rs['mode']} at tick "
+            f"{rs['at_tick']} | restored "
+            f"{rs.get('sessions_restored', 0)} session(s) | reopens "
+            f"{rs['reopens_total']} | transport retries "
+            f"{rs['transport_retries_total']} | replayed "
+            f"{rs['replayed_total']}"
+            + (f" | drain-flushed {rs['flushed']}" if "flushed" in rs
+               else "")
+        )
     sc = rep["scaling"]
     print(
         f"  scaling ({sc['model']}): measured "
@@ -560,10 +720,21 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-depth", type=int, default=8)
     ap.add_argument("--max-workers", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--restart-at-tick", type=int, default=None,
+                    help="restart drill: take the servicer down once "
+                         "every session passed this tick, bring a "
+                         "fresh one up on the same port (warm "
+                         "checkpoint rehydration)")
+    ap.add_argument("--restart-mode", choices=("crash", "drain"),
+                    default="crash")
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--ckpt-every", type=int, default=1)
     ap.add_argument("--out", default=None, help="write the JSON report")
     ap.add_argument("--smoke", action="store_true",
                     help="exit non-zero unless every session completed "
-                         "with assigned fraction >= 0.9")
+                         "with assigned fraction >= 0.9 (with a "
+                         "restart drill armed: also zero reopens — "
+                         "recovery must be warm)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -574,6 +745,9 @@ def main(argv=None) -> int:
         skew=args.skew, traces=args.trace, admit_rate=args.admit_rate,
         max_bytes=args.max_bytes, queue_depth=args.queue_depth,
         max_workers=args.max_workers, seed=args.seed,
+        restart_at_tick=args.restart_at_tick,
+        restart_mode=args.restart_mode,
+        ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
     )
     _print_report(rep)
     if args.out:
@@ -587,6 +761,19 @@ def main(argv=None) -> int:
                 bad.append(
                     {"tenant": t, "error": "assigned frac < 0.9"}
                 )
+        rs = rep.get("restart")
+        if rs and rs["reopens_total"] > 0:
+            bad.append({
+                "restart": rs["mode"],
+                "error": f"{rs['reopens_total']} full-snapshot "
+                         "reopens after restart — recovery was not "
+                         "warm",
+            })
+        if rs and not rs.get("restarted"):
+            bad.append({
+                "restart": rs["mode"],
+                "error": "restart controller never fired",
+            })
         if bad:
             print(f"SMOKE FAIL: {bad}")
             return 1

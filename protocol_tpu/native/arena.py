@@ -192,6 +192,85 @@ class NativeSolveArena:
         solve — (None, None) on the auction engine / before any solve."""
         return self._f, self._g
 
+    def export_state(self) -> Optional[dict]:
+        """The carried warm state as a flat dict of scalars and arrays —
+        everything the next solve's trajectory depends on: the candidate
+        structure (path-dependent: incremental merges reorder lists, so
+        regenerating it cold would NOT reproduce the warm chain), the
+        auction/sinkhorn duals, the previous matching, the shadow
+        columns' role is played by the caller (who must restore the same
+        columns), and the cadence cursors (``warm_solves`` drives
+        ``cold_every``, ``dual_age`` drives ``dual_refresh_every`` — a
+        restore that dropped them would re-ground on a different tick).
+
+        Returns None before any solve (nothing carried: a restore would
+        just be a cold arena). Arrays are copies — a checkpoint must not
+        alias live solver state."""
+        if self._cand_p is None:
+            return None
+
+        def _c(a):
+            return None if a is None else np.array(a, copy=True)
+
+        out = {
+            "cand_p": _c(self._cand_p),
+            "cand_c": _c(self._cand_c),
+            "price": _c(self._price),
+            "retired": _c(self._retired),
+            "p4t": _c(self._p4t),
+            "f": _c(self._f),
+            "g": _c(self._g),
+            "starve_age": _c(self._starve_age),
+            "warm_solves": int(self._warm_solves),
+            "dual_age": int(self._dual_age),
+            "weights_key": tuple(self._weights_key),
+        }
+        # the arena's OWN dirty-detection baseline (it can lag the
+        # session's current columns when degraded ticks applied deltas
+        # without solving): restoring the session columns as the
+        # baseline would silently swallow that accumulated churn
+        for name, _ in _P_SPEC:
+            out[f"pf_{name}"] = _c(self._p_fields[name])
+        for name, _ in _R_SPEC:
+            out[f"rf_{name}"] = _c(self._r_fields[name])
+        return out
+
+    def restore_state(self, ep, er, state: dict) -> None:
+        """Rehydrate the warm chain from :meth:`export_state` output plus
+        the exact columns (``ep``/``er``) the exporting arena last
+        solved. The next ``solve`` continues the chain bit-identically:
+        dirty detection diffs against these columns, the candidate
+        structure and duals are the exported ones, and the cadence
+        cursors resume mid-schedule. The arena's construction params
+        (k / eps ladder / engine / refresh cadences) must match the
+        exporter's — the checkpoint layer persists and re-applies them."""
+        self.invalidate()
+        if "pf_gpu_count" in state:
+            # exported baseline columns win (see export_state: they can
+            # lag the caller's current columns after degraded ticks)
+            self._p_fields = {
+                name: np.array(state[f"pf_{name}"], copy=True)
+                for name, _ in _P_SPEC
+            }
+            self._r_fields = {
+                name: np.array(state[f"rf_{name}"], copy=True)
+                for name, _ in _R_SPEC
+            }
+        else:
+            self._p_fields = _canon(ep, _P_SPEC)
+            self._r_fields = _canon(er, _R_SPEC)
+        self._cand_p = np.array(state["cand_p"], copy=True)
+        self._cand_c = np.array(state["cand_c"], copy=True)
+        for name in ("price", "retired", "p4t", "f", "g", "starve_age"):
+            v = state.get(name)
+            setattr(
+                self, f"_{name}",
+                None if v is None else np.array(v, copy=True),
+            )
+        self._warm_solves = int(state["warm_solves"])
+        self._dual_age = int(state["dual_age"])
+        self._weights_key = tuple(state["weights_key"])
+
     def invalidate(self) -> None:
         """Drop all carried state: the next solve is cold."""
         self._p_fields: Optional[dict] = None

@@ -157,6 +157,9 @@ class _SessionObs:
         # plan churn, starvation ages, and the outcome-cause counters
         "gap_last", "gap_max", "churn_last", "churn_max",
         "starve_max", "starve_hist", "outcome_counts", "unexplained",
+        # resilience plane: degraded (stale-plan) answers, flagged by
+        # the servicer's tick-deadline watchdog, and the worst streak
+        "stale_ticks", "stale_streak_max",
     )
 
     def __init__(self):
@@ -167,6 +170,8 @@ class _SessionObs:
         self.rows_total = 0
         self.rows_changed = 0
         self.delta_rows = 0
+        self.stale_ticks = 0
+        self.stale_streak_max = 0
         self.gap_last: Optional[float] = None
         self.gap_max = 0.0
         self.churn_last: Optional[float] = None
@@ -187,6 +192,14 @@ class _SessionObs:
     def observe_quality(self, stats: dict) -> None:
         """Fold one tick's quality scalars (the arena's last_stats keys
         from obs.quality.tick_quality) into the roll-up."""
+        if stats.get("stale"):
+            # degraded answer: the deadline watchdog served the
+            # previous plan — counted per session AND per tenant so the
+            # staleness contract is auditable, not just flagged
+            self.stale_ticks += 1
+            self.stale_streak_max = max(
+                self.stale_streak_max, int(stats.get("stale_streak") or 1)
+            )
         gap = stats.get("gap_per_task")
         if gap is not None:
             self.gap_last = float(gap)
@@ -383,6 +396,15 @@ class ObsRegistry:
                         "starve_max": stats.get("starve_max"),
                         "gap_per_task": stats.get("gap_per_task"),
                         "churn_ratio": stats.get("churn_ratio"),
+                        # stateful (session) ticks always carry a
+                        # streak value — 0 on fresh solves — so the
+                        # stale SLO objective sees every tick, not just
+                        # degraded ones; stateless kernels (no stats)
+                        # pass None = not evaluated
+                        "stale_streak": (
+                            int(stats.get("stale_streak") or 0)
+                            if stats else None
+                        ),
                     },
                     cold=cold,
                 )
@@ -411,6 +433,9 @@ class ObsRegistry:
                 "arena_reuse_ratio": round(s.reuse_ratio(), 4),
                 "delta_rows": s.delta_rows,
             }
+            if s.stale_ticks:
+                out["stale_ticks"] = s.stale_ticks
+                out["stale_streak_max"] = s.stale_streak_max
             quality = s.quality_snapshot()
             if quality is not None:
                 out["quality"] = quality

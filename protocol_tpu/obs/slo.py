@@ -58,6 +58,11 @@ _OBJECTIVES = (
     ("starvation_age", "max_starvation_age", "starve_max", "gt"),
     ("gap_per_task", "max_gap_per_task", "gap_per_task", "gt"),
     ("churn_ratio", "max_churn_ratio", "churn_ratio", "gt"),
+    # bounded-staleness contract (resilience plane): a tick is bad when
+    # the deadline watchdog's consecutive stale-answer streak exceeds
+    # the objective — sustained degradation pages, one absorbed
+    # overrun does not
+    ("stale_streak", "max_stale_streak", "stale_streak", "gt"),
 )
 
 
@@ -71,6 +76,7 @@ class SLOConfig:
     max_starvation_age: Optional[float] = None
     max_gap_per_task: Optional[float] = None
     max_churn_ratio: Optional[float] = None
+    max_stale_streak: Optional[float] = None
     budget_frac: float = 0.05
     windows: tuple = DEFAULT_WINDOWS
 
@@ -82,7 +88,8 @@ class SLOConfig:
     @classmethod
     def from_env(cls, env: Optional[dict] = None) -> "SLOConfig":
         """PROTOCOL_TPU_SLO_{P99_MS,MIN_ASSIGNED,MAX_STARVE,MAX_GAP,
-        MAX_CHURN,BUDGET} — unset vars leave the objective off."""
+        MAX_CHURN,MAX_STALE,BUDGET} — unset vars leave the objective
+        off."""
         e = os.environ if env is None else env
 
         def _f(name: str) -> Optional[float]:
@@ -95,6 +102,7 @@ class SLOConfig:
             max_starvation_age=_f("MAX_STARVE"),
             max_gap_per_task=_f("MAX_GAP"),
             max_churn_ratio=_f("MAX_CHURN"),
+            max_stale_streak=_f("MAX_STALE"),
             budget_frac=_f("BUDGET") or 0.05,
         )
 

@@ -133,7 +133,31 @@ def _decode_columns(msg, spec: dict[str, np.dtype]) -> dict[str, np.ndarray]:
     missing = set(spec) - set(cols)
     if missing:
         raise ValueError(f"tensor batch missing columns: {sorted(missing)}")
-    return {name: unblob(cols[name], dt) for name, dt in spec.items()}
+    out = {name: unblob(cols[name], dt) for name, dt in spec.items()}
+    # ---- input hardening at the wire (chaos-plane satellite): a frame
+    # that decodes at the right dtypes can still be poison — ragged
+    # row counts index out of sibling columns, and a NaN/Inf cost
+    # propagates through the cost tensor into carried session state
+    # where no later tick can flush it. Reject HERE, before anything
+    # lands in an arena; the servicer answers INVALID_ARGUMENT (every
+    # decode call site already wraps ValueError).
+    n_rows = None
+    for name, a in out.items():
+        if a.ndim == 0:
+            raise ValueError(f"column {name!r} is not row-shaped")
+        if n_rows is None:
+            n_rows = a.shape[0]
+        elif a.shape[0] != n_rows:
+            raise ValueError(
+                f"column row-count mismatch: {name!r} has {a.shape[0]} "
+                f"rows, expected {n_rows}"
+            )
+        if a.dtype.kind == "f" and a.size and not np.isfinite(a).all():
+            raise ValueError(
+                f"non-finite values in column {name!r} (NaN/Inf costs "
+                "are refused before they can poison a session arena)"
+            )
+    return out
 
 
 def decode_providers_v2(msg: pb.ProviderBatchV2):

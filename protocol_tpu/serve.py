@@ -562,7 +562,9 @@ async def serve_kv_api(args) -> None:
 
 def serve_scheduler(args) -> None:
     """The gRPC kernel backend — the pod that actually holds the TPU."""
-    from protocol_tpu.services.scheduler_grpc import serve
+    import signal
+
+    from protocol_tpu.services.scheduler_grpc import drain, serve
 
     server = serve(
         address=args.address, max_workers=args.max_workers,
@@ -573,6 +575,20 @@ def serve_scheduler(args) -> None:
         print(
             f"obs /metrics on 127.0.0.1:{server.metrics.port}", flush=True
         )
+
+    def _on_sigterm(signum, frame):
+        # graceful drain: stop admitting OpenSession, finish in-flight
+        # ticks, flush session checkpoints + trace tails, exit 0 — a
+        # rolling restart rehydrates every session warm instead of
+        # stampeding clients into cold snapshot reopens
+        flushed = drain(server)
+        print(
+            f"drained: {flushed} session checkpoint(s) flushed",
+            flush=True,
+        )
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     server.wait_for_termination()
 
 

@@ -147,6 +147,26 @@ def _pad_pow2(enc, n_real: int):
     return dataclasses.replace(enc, **out)
 
 
+def _delta_crc(request: "pb.AssignDeltaRequest") -> int:
+    """Byte-exact identity of one delta tick WITHOUT re-serializing the
+    just-deserialized message (that would add O(delta bytes) of encode
+    work to every tick inside the session lock): CRC over the tick
+    cursor plus every blob's already-materialized raw bytes — the only
+    payload a retransmitted delta can differ in. The idempotent-
+    retransmit dedup (and the checkpointed cursor it survives restarts
+    through) rests on this identity."""
+    import zlib
+
+    crc = zlib.crc32(int(request.tick).to_bytes(8, "little"))  # lint: unlocked-ok (protobuf field, not session state)
+    for b in (request.provider_rows, request.task_rows):
+        crc = zlib.crc32(b.data, crc)
+    for batch in (request.providers, request.requirements):
+        for nt in batch.columns:
+            crc = zlib.crc32(nt.name.encode(), crc)
+            crc = zlib.crc32(nt.tensor.data, crc)
+    return crc
+
+
 class _SolveOut(NamedTuple):
     """Kernel output over the REAL (unpadded) row counts."""
 
@@ -252,6 +272,41 @@ class SchedulerBackendServicer:
             from protocol_tpu.trace.recorder import TraceRecorder
 
             self.trace = TraceRecorder.from_env("server")
+        # ---- resilience layer (chaos plane). With ``ckpt_dir`` set,
+        # every session keeps a crash-atomic on-disk twin (flushed on
+        # the tick cadence BEFORE the tick is acknowledged), and a
+        # fresh servicer REHYDRATES them here: after a crash+restart
+        # the client's next AssignDelta resumes at the checkpointed
+        # cursor instead of being refused into a full-snapshot reopen
+        # herd. ``draining`` is the SIGTERM drain flag: OpenSession
+        # stops admitting, in-flight ticks finish, checkpoints flush.
+        self.draining = False
+        self.ckpt = None
+        if cfg.ckpt_dir:
+            from protocol_tpu.faults.checkpoint import SessionCheckpointer
+
+            self.ckpt = SessionCheckpointer(
+                cfg.ckpt_dir, every=cfg.ckpt_every
+            )
+            # newest-first, capped at the session budget: stale files
+            # must never crowd the restore past max_sessions (the put
+            # pressure below would then LRU-evict restored sessions)
+            for session in self.ckpt.load_all(
+                budget=self._engine_budget, limit=max_sessions
+            ):
+                self.sessions.put(session)
+                self.seam.count("session_restored")
+            # checkpoint GC: a ttl-expired or client-dropped session's
+            # client is GONE — its file would only resurrect a dead
+            # session at every restart, growing ckpt_dir without bound.
+            # lru/pressure/replace keep their files: the session is
+            # alive client-side (or the file already belongs to the
+            # same-id successor, which flushed over it at open).
+            def _ckpt_gc(session, reason: str) -> None:
+                if reason in ("ttl", "drop"):
+                    self.ckpt.drop(session.session_id)
+
+            self.sessions.on_let_go = _ckpt_gc
 
     # ---------------- shared kernel dispatch ----------------
 
@@ -594,6 +649,31 @@ class SchedulerBackendServicer:
                 "unary admission rate exceeded",
             )
 
+    def _check_deadline(self, context, where: str) -> None:
+        """Honor the caller's gRPC deadline/cancellation BEFORE a solve
+        is dispatched: a client that hung up (or whose deadline is
+        already burned) must not keep consuming engine threads — its
+        answer is undeliverable either way. Tolerates bare/fake
+        contexts (tests drive servicer methods directly)."""
+        if context is None:
+            return
+        is_active = getattr(context, "is_active", None)
+        if callable(is_active) and not context.is_active():
+            self.seam.count("deadline_refused")
+            context.abort(
+                grpc.StatusCode.CANCELLED,
+                f"client cancelled before the {where} solve",
+            )
+        time_remaining = getattr(context, "time_remaining", None)
+        if callable(time_remaining):
+            remaining = context.time_remaining()
+            if remaining is not None and remaining <= 0:
+                self.seam.count("deadline_refused")
+                context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"deadline burned before the {where} solve",
+                )
+
     def _assign_v1(
         self, request: pb.AssignRequest, context, mark: int, root
     ) -> pb.AssignResponse:
@@ -608,6 +688,7 @@ class SchedulerBackendServicer:
             warm = _np(request.warm_price, np.float32)
             seeds = _np(request.seed_provider_for_task, np.int32)
         kernel = request.kernel or "auction"
+        self._check_deadline(context, "v1 unary")
         with _tracer.span("engine.solve", kernel=kernel):
             out = self._solve(
                 ep, er, self._weights_of(request), kernel,
@@ -679,6 +760,7 @@ class SchedulerBackendServicer:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         t_dec = time.perf_counter()
         kernel = request.kernel or "auction"
+        self._check_deadline(context, "v2 unary")
         with _tracer.span("engine.solve", kernel=kernel):
             out = self._solve(
                 ep, er, self._weights_of(request), kernel,
@@ -747,6 +829,18 @@ class SchedulerBackendServicer:
         except ValueError as e:
             return pb.OpenSessionResponse(ok=False, error=str(e))
         self.seam.add_bytes("in", wire_bytes)
+        if self.draining:
+            # SIGTERM drain: stop ADMITTING — in-flight sessions keep
+            # ticking until the server stops. A transient refusal on
+            # the protocol surface, not a capability one: the client
+            # ladder degrades this tick to unary and keeps the session
+            # protocol available for the replacement server.
+            self.seam.count("drain_refused")
+            return pb.OpenSessionResponse(
+                ok=False,
+                error="UNAVAILABLE: draining, not admitting new "
+                      "sessions (retry against the replacement)",
+            )
         # tenant admission BEFORE the expensive decode + cold solve: an
         # over-rate tenant costs the server one token-bucket check, not
         # a snapshot decode. The refusal is a protocol answer on the
@@ -814,10 +908,17 @@ class SchedulerBackendServicer:
             arena_bytes=estimate_arena_bytes(padded_p, padded_r, top_k),
         )
         t_dec = time.perf_counter()
+        self._check_deadline(context, "session-open")
         with _tracer.span("engine.solve", kernel=kernel, cold=True):
             with session.lock:
                 p4t, t4p, price = session.solve()
                 arena_stats = dict(session.arena.last_stats)
+                # idempotence cache + warm checkpoint for tick 0: a
+                # crash before the first delta must restore the session
+                # (flush-before-ack, same as every delta tick)
+                session.last_p4t = np.asarray(p4t, np.int32)
+                if self.ckpt is not None:
+                    self.ckpt.flush_locked(session)
         t_solve = time.perf_counter()
         self.sessions.put(session)
         self.seam.count("session_open")
@@ -970,6 +1071,32 @@ class SchedulerBackendServicer:
                 return pb.AssignDeltaResponse(
                     session_ok=False, error="session evicted"
                 )
+            if (
+                int(request.tick) == session.tick
+                and session.tick > 0
+                and session.last_p4t is not None
+            ):
+                # idempotent retransmit: the client re-sent a tick this
+                # session already applied — its response died on the
+                # wire, or the servicer crashed after the
+                # flush-before-ack checkpoint and the client retried
+                # against the restart. The CRC proves it is the SAME
+                # delta (byte-identical retransmit); the cached answer
+                # replays and the tick is applied exactly once. A
+                # same-tick request with DIFFERENT bytes is genuine
+                # divergence and refuses below.
+                if _delta_crc(request) == session.last_delta_crc:
+                    self.seam.count("delta_replayed")
+                    cached = np.asarray(session.last_p4t, np.int32)
+                    return pb.AssignDeltaResponse(
+                        session_ok=True,
+                        replayed=True,
+                        result=pb.AssignResponseV2(
+                            provider_for_task=blob(cached, np.int32),
+                            num_assigned=int((cached >= 0).sum()),
+                            solve_ms=(time.perf_counter() - t0) * 1e3,
+                        ),
+                    )
             if int(request.tick) != session.tick + 1:
                 # replayed or skipped tick: the client's shadow copy and
                 # this session's columns have diverged — refuse, never
@@ -980,16 +1107,71 @@ class SchedulerBackendServicer:
                     error=f"tick cursor mismatch (have {session.tick}, "
                           f"got {int(request.tick)})",
                 )
+            # honor the caller's gRPC deadline/cancellation BEFORE the
+            # delta is applied: an abort after apply_delta (but before
+            # the tick cursor + dedup CRC advance) would let the
+            # client's retry DOUBLE-APPLY this tick — the exact bug the
+            # retransmit protocol exists to refuse
+            self._check_deadline(context, "delta")
             try:
                 session.apply_delta(prow, p_delta, trow, r_delta)
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            p4t_out, t4p, price = session.solve()
-            arena_stats = dict(session.arena.last_stats)
+            # ---- graceful degradation: the per-tick solve watchdog.
+            # When the tick's deadline budget is already burned (lock
+            # wait + decode + the EWMA of recent solve walls would
+            # overrun it), serve the PREVIOUS plan with an explicit
+            # stale flag instead of starting a solve whose answer will
+            # arrive too late to act on. The delta was still APPLIED —
+            # columns stay client-consistent — and the streak is
+            # hard-bounded by ``max_stale_ticks``: past it the solve
+            # runs regardless, so staleness is a contract, never an
+            # escape hatch. (The native solve is uninterruptible C++;
+            # the watchdog is predictive, which is the only honest kind
+            # here.)
+            deadline_ms = self.fleet_config.tick_deadline_ms
+            stale = (
+                deadline_ms is not None
+                and session.last_p4t is not None
+                and session.stale_streak
+                < self.fleet_config.max_stale_ticks
+                and (time.perf_counter() - t0) * 1e3
+                + session.solve_ewma_ms > deadline_ms
+            )
+            if stale:
+                session.stale_streak += 1
+                staleness = session.stale_streak
+                p4t_out = np.array(session.last_p4t, np.int32)
+                price = None
+                arena_stats = {
+                    "cold": False,  # served from carried state: a
+                    # stale tick must not read as a cold solve in obs
+                    "stale": True, "stale_streak": staleness,
+                    "assigned": int((p4t_out >= 0).sum()),
+                }
+                self.seam.count("stale_served")
+            else:
+                # (the deadline was already honored before apply_delta;
+                # re-checking here would abort AFTER state moved)
+                staleness = 0
+                t_s0 = time.perf_counter()
+                p4t_out, t4p, price = session.solve()
+                solve_ms = (time.perf_counter() - t_s0) * 1e3
+                # EWMA of solve walls feeds the watchdog's prediction
+                session.solve_ewma_ms = (
+                    solve_ms if session.solve_ewma_ms == 0.0
+                    else 0.5 * session.solve_ewma_ms + 0.5 * solve_ms
+                )
+                session.stale_streak = 0
+                arena_stats = dict(session.arena.last_stats)
+                del t4p  # derivable client-side; stays server-side
             session.tick += 1
             tick_no = session.tick  # this delta's wire tick, for the
             # post-lock obs/event hooks (== int(request.tick), checked
             # above)
+            # idempotence cache: what a retransmit of THIS tick replays
+            session.last_p4t = p4t_out
+            session.last_delta_crc = _delta_crc(request)
             if session.evicted:
                 # eviction landed DURING the solve (the store flags
                 # without taking session.lock — coupling store eviction
@@ -1018,9 +1200,20 @@ class SchedulerBackendServicer:
                         "bytes_in": request.ByteSize(),
                         "delta_rows": int(prow.size + trow.size),
                         "wire": "v2-session",
+                        **(
+                            {"stale": True, "staleness_ticks": staleness}
+                            if staleness else {}
+                        ),
                     }, arena_stats, mark, root),
                     session_id=session.session_id,
                 )
+            if self.ckpt is not None and self.ckpt.due(session.tick):
+                # flush-before-ack: the checkpoint lands on disk BEFORE
+                # the client sees this tick acknowledged, so a crash at
+                # any instant leaves the cursor at-or-one-behind the
+                # client's — either the restart resumes at the next
+                # tick, or the client's retransmit hits the dedup path.
+                self.ckpt.flush_locked(session)
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms(
             "solve", (time.perf_counter() - t_dec) * 1e3
@@ -1031,13 +1224,15 @@ class SchedulerBackendServicer:
             delta_rows=int(prow.size + trow.size),
             trace_tick=tick_no,
         )
-        del t4p, price  # session state: stays server-side
+        del price  # session state: stays server-side
         # SLIM response: p4t only. task_for_provider is derivable from it
         # (the client scatters), and prices/retirement are session state —
         # shipping them back every tick would spend O(P) wire bytes on
         # data the delta protocol exists to keep off the wire
         resp = pb.AssignDeltaResponse(
             session_ok=True,
+            stale=bool(staleness),
+            staleness_ticks=staleness,
             result=pb.AssignResponseV2(
                 provider_for_task=blob(p4t_out, np.int32),
                 num_assigned=int((p4t_out >= 0).sum()),
@@ -1047,6 +1242,23 @@ class SchedulerBackendServicer:
         )
         self.seam.add_bytes("out", resp.ByteSize())
         return resp
+
+    def finish_drain(self) -> int:
+        """The drain tail: flush every live session's checkpoint and the
+        trace recorder's tail frames. Called AFTER the server stopped
+        accepting RPCs (in-flight ticks have finished), so each session
+        lock is uncontended. Returns the number of sessions flushed."""
+        flushed = 0
+        if self.ckpt is not None:
+            for session in self.sessions.snapshot_sessions():
+                with session.lock:
+                    if not session.evicted and self.ckpt.flush_locked(
+                        session
+                    ):
+                        flushed += 1
+        if self.trace is not None:
+            self.trace.close()
+        return flushed
 
     def Health(self, request: pb.HealthRequest, context) -> pb.HealthResponse:
         import jax
@@ -1066,6 +1278,12 @@ class SchedulerBackendServicer:
         seam["sessions_active"] = float(len(self.sessions))
         seam["session_evictions"] = float(self.sessions.evictions)
         seam["session_expirations"] = float(self.sessions.expirations)
+        seam["draining"] = 1.0 if self.draining else 0.0
+        if self.ckpt is not None:
+            seam["ckpt_flushes"] = float(self.ckpt.flushes)
+            seam["ckpt_flush_failures"] = float(
+                self.ckpt.flush_failures
+            )
         for name in sorted(seam):
             resp.seam_metrics.add(name=name, value=seam[name])
         return resp
@@ -1116,6 +1334,20 @@ _CHANNEL_OPTIONS = [
 ]
 
 
+def drain(server: grpc.Server, grace_s: float = 5.0) -> int:
+    """Graceful drain (the SIGTERM path): stop admitting OpenSession,
+    stop taking new RPCs and let in-flight ticks finish (``grace_s``),
+    then flush every session checkpoint and the trace tail. Returns the
+    number of sessions flushed; after this the process can exit 0 and a
+    restarted servicer rehydrates every session warm."""
+    servicer = server.servicer
+    servicer.draining = True
+    server.stop(grace=grace_s).wait()
+    if server.metrics is not None:
+        server.metrics.stop()
+    return servicer.finish_drain()
+
+
 def serve(
     address: str = "127.0.0.1:50061",
     max_workers: int = 4,
@@ -1124,6 +1356,7 @@ def serve(
     session_ttl_s: float = 900.0,
     fleet=None,
     slo=None,
+    chaos=None,
 ) -> grpc.Server:
     """Start the backend server (non-blocking; call .wait_for_termination()).
     The servicer rides on the returned server as ``.servicer`` (tests and
@@ -1146,10 +1379,31 @@ def serve(
     endpoint rides on the server as ``.metrics`` with its ``.port``).
     ``PROTOCOL_TPU_METRICS_PORT`` enables it from the environment. None
     and no env var: no HTTP listener (the Health RPC still serves the
-    seam snapshot)."""
+    seam snapshot).
+
+    ``chaos`` arms the server-side fault interceptor (drop/delay before
+    the servicer) — a :class:`~protocol_tpu.faults.plan.ChaosConfig` or
+    ``FaultSchedule``; None reads ``PROTOCOL_TPU_CHAOS`` from the
+    environment (unset = no interceptor, zero overhead)."""
+    interceptors: tuple = ()
+    if chaos is None:
+        from protocol_tpu.faults.plan import ChaosConfig
+
+        chaos = ChaosConfig.from_env()
+    if chaos is not None:
+        from protocol_tpu.faults.inject import ChaosServerInterceptor
+        from protocol_tpu.faults.plan import ChaosConfig, FaultSchedule
+
+        schedule = (
+            chaos if isinstance(chaos, FaultSchedule)
+            else FaultSchedule(chaos)
+        )
+        if schedule.config.active():
+            interceptors = (ChaosServerInterceptor(schedule),)
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
+        interceptors=interceptors,
     )
     servicer = SchedulerBackendServicer(
         max_sessions=max_sessions,
@@ -1377,6 +1631,15 @@ _RETRYABLE = (
     grpc.StatusCode.DEADLINE_EXCEEDED,
 )
 
+# OpenSession refusal markers that are CAPABILITY answers (the server
+# will never serve this session protocol for these parameters): only
+# these may demote the client's ladder permanently. Anything else —
+# torn streams, draining servers, corrupted frames — is transient.
+_PERMANENT_REFUSALS = (
+    "not session-servable",
+    "fingerprint mismatch",
+)
+
 
 class RemoteBatchMatcher(TpuBatchMatcher):
     """TpuBatchMatcher whose device solves go through the gRPC scheduler
@@ -1433,12 +1696,20 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         retries: int = 3,
         retry_base_s: float = 0.05,
         retry_max_s: float = 2.0,
+        tick_timeout_s: Optional[float] = None,
         **kwargs,
     ):
         super().__init__(store, **kwargs)
         if wire not in ("v1", "v2"):
             raise ValueError(f"wire must be v1|v2, got {wire!r}")
         self.request_timeout = request_timeout
+        # per-RPC deadline sized to the tick budget: steady-state solve
+        # RPCs (unary + AssignDelta) carry this deadline so a wedged
+        # server fails THIS tick fast instead of parking the scheduler
+        # loop for request_timeout; the cold OpenSession stream keeps
+        # the long timeout (a snapshot solve legitimately takes it).
+        # None = no tick budget (fall back to request_timeout).
+        self.tick_timeout_s = tick_timeout_s
         self.wire = wire
         self.chunk_bytes = chunk_bytes
         self.gzip_snapshots = gzip_snapshots
@@ -1456,10 +1727,15 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         self._session: Optional[dict] = None
         self._session_uid = uuid.uuid4().hex
         self._session_refused = False
+        # resilience counters for the current refresh (degraded answers
+        # are explicit all the way up: the matcher's stats name them)
+        self._stale_ticks = 0
+        self._replayed_ticks = 0
 
     def refresh(self) -> None:
         self._rtt_ms, self._backend_ms = [], []
         self._bytes_out = self._bytes_in = 0
+        self._stale_ticks = self._replayed_ticks = 0
         # one causal trace per scheduler tick: every RPC this refresh
         # issues injects this span's context, and the servicer's spans
         # adopt it — "where did the tick go" is answerable end to end
@@ -1474,6 +1750,12 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             )
             self.last_solve_stats["remote_bytes_out"] = self._bytes_out
             self.last_solve_stats["remote_bytes_in"] = self._bytes_in
+            if self._stale_ticks:
+                self.last_solve_stats["stale_ticks"] = self._stale_ticks
+            if self._replayed_ticks:
+                self.last_solve_stats["replayed_ticks"] = (
+                    self._replayed_ticks
+                )
 
     @staticmethod
     def _strip_padding(enc):
@@ -1483,11 +1765,18 @@ class RemoteBatchMatcher(TpuBatchMatcher):
 
     def _reconnect(self) -> None:
         address = self.client.address
+        fresh = SchedulerBackendClient(address)
+        rebind = getattr(self.client, "rebind", None)
+        if callable(rebind):
+            # chaos shim (faults.inject.ChaosClient): keep the injector
+            # and its fault cursors, swap only the dead channel under it
+            rebind(fresh)
+            return
         try:
             self.client.close()
         except Exception:
             pass
-        self.client = SchedulerBackendClient(address)
+        self.client = fresh
 
     def _backoff_s(self, attempt: int) -> float:
         """Bounded exponential backoff with deterministic jitter for
@@ -1672,12 +1961,30 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             wire=self.wire,
         )
         self.seam.observe_ms("serialize", (_t_ser - t0) * 1e3)
-        resp = self._timed(
-            lambda: self.client.assign_delta(
-                req, timeout=self.request_timeout
-            ),
-            req.ByteSize(),
-        )
+        # delta RPCs carry the TICK deadline (the budget this answer is
+        # useful within), not the long snapshot timeout
+        tick_timeout = self.tick_timeout_s or self.request_timeout
+        try:
+            resp = self._timed(
+                lambda: self.client.assign_delta(
+                    req, timeout=tick_timeout
+                ),
+                req.ByteSize(),
+            )
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.INVALID_ARGUMENT:
+                raise
+            # the frame was mangled in transit (the hardening layer
+            # refused it at decode, BEFORE any session state moved):
+            # resending the same delta is safe — once. A persistent
+            # INVALID_ARGUMENT is a real contract violation and raises.
+            self.seam.count("corrupt_resend")
+            resp = self._timed(
+                lambda: self.client.assign_delta(
+                    req, timeout=tick_timeout
+                ),
+                req.ByteSize(),
+            )
         if not resp.session_ok and "RESOURCE_EXHAUSTED" in resp.error:
             # fleet admission/backpressure throttle: the session is
             # still alive server-side, so retry the SAME delta after a
@@ -1690,7 +1997,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
                 time.sleep(self._backoff_s(attempt))
                 resp = self._timed(
                     lambda: self.client.assign_delta(
-                        req, timeout=self.request_timeout
+                        req, timeout=tick_timeout
                     ),
                     req.ByteSize(),
                 )
@@ -1710,6 +2017,19 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             )
         st["p_cols"], st["r_cols"] = p_cols, r_cols
         st["tick"] += 1
+        if resp.stale:
+            # DEGRADED answer: the server burned its tick deadline and
+            # served the previous plan, explicitly flagged. The delta
+            # was still applied server-side (shadow update above is
+            # correct); the staleness is bounded by the server's
+            # max_stale_ticks contract and surfaced in solve stats.
+            self.seam.count("stale_served")
+            self._stale_ticks += 1
+        if resp.replayed:
+            # idempotent retransmit answer (our original send was
+            # answered but the response died): counted, not an error
+            self.seam.count("delta_replayed")
+            self._replayed_ticks += 1
         self._backend_ms.append(resp.result.solve_ms)
         return _res_v2(resp.result, n_providers=params[-2])
 
@@ -1753,6 +2073,17 @@ class RemoteBatchMatcher(TpuBatchMatcher):
                 # here would demote a briefly-throttled tenant to
                 # unthrottled full-snapshot unary solves FOREVER
                 self.seam.count("session_throttled")
+                self._session = None
+                return None
+            if not any(
+                marker in resp.error for marker in _PERMANENT_REFUSALS
+            ):
+                # transient refusal (torn/truncated snapshot stream, a
+                # draining server, a corrupted frame the hardening
+                # layer bounced): degrade THIS tick to unary and try
+                # the session protocol again next tick — only a
+                # capability answer may demote the ladder permanently
+                self.seam.count("session_transient_refusal")
                 self._session = None
                 return None
             # server-side capability refusal is a protocol answer, not

@@ -197,6 +197,24 @@ class SolveSession:
     # computed once at open from rows x dtype widths
     # (fleet.fabric.estimate_arena_bytes) — never re-measured
     arena_bytes: int = 0
+    # ---- idempotent-retransmit cache (chaos plane). A delta whose
+    # RESPONSE died on the wire (or whose servicer crashed after the
+    # flush-before-ack checkpoint) is retransmitted by the client with
+    # the same tick: instead of refusing it into a full-snapshot reopen,
+    # the servicer matches the retransmit's CRC against the last APPLIED
+    # delta and replays the cached answer — the tick is applied exactly
+    # once, and the "no tick lost or double-applied" gate rests on this.
+    last_delta_crc: int = 0
+    last_p4t: object = None  # np.ndarray [n_tasks] i32 after any solve
+    # ---- graceful degradation (bounded staleness). When a tick's
+    # deadline budget is already burned (lock wait + decode + the EWMA
+    # of recent solve walls would overrun it), the servicer serves the
+    # PREVIOUS plan with an explicit stale flag instead of starting a
+    # solve it cannot finish in time; the streak is hard-bounded by the
+    # fleet config (beyond it the solve runs regardless — staleness is
+    # a contract, not an escape hatch).
+    stale_streak: int = 0
+    solve_ewma_ms: float = 0.0
 
     def enter_tick(self, max_depth: int) -> bool:
         """Claim one queued-tick slot; False = over ``max_depth``
@@ -427,6 +445,13 @@ class SessionStore:
                     continue
                 return sid, s.last_used
         return None
+
+    def snapshot_sessions(self) -> list:
+        """Point-in-time list of the live sessions (drain's checkpoint
+        flush walks it; each session is then locked individually — the
+        store lock is never held across a flush)."""
+        with self._lock:
+            return list(self._sessions.values())
 
     def __len__(self) -> int:
         with self._lock:

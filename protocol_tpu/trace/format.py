@@ -59,6 +59,11 @@ KIND_OUTCOME = 4   # u32 n | pb.AssignResponseV2[n] | JSON {tick, metrics}
 KIND_EVENT = 5     # JSON {tick, events}: out-of-band structured events
 #                    (SLO burn-rate alerts) — NOT solve inputs, so the
 #                    replayer ignores them; old readers skip the kind
+KIND_ARENA = 6     # named-ndarray pack (pack_arrays): carried solver
+#                    state — used by the session CHECKPOINT files
+#                    (faults/checkpoint.py), never by workload traces;
+#                    the replayer skips the kind by the unknown-kind
+#                    contract
 
 _FLAG_DEFLATE = 1
 _HEADER = struct.Struct("<BBII")
@@ -117,6 +122,66 @@ def _check_tables() -> None:
                 f"{name}_TRACE_DTYPES drifted from the wire table — archived "
                 "traces would decode at the wrong widths"
             )
+
+
+# ---------------- named-ndarray pack (ARENA frames) ----------------
+
+
+def pack_arrays(named: dict[str, Optional[np.ndarray]]) -> bytes:
+    """Deterministic bytes for a dict of (optionally None) ndarrays:
+    a sorted JSON manifest (name -> dtype/shape/offset) followed by the
+    C-order little-endian raw buffers. The checkpoint codec — same
+    byte-exactness contract as the TensorBlob columns, without protobuf
+    in the way (carried solver state is not a wire message)."""
+    manifest: dict = {}
+    buffers: list[bytes] = []
+    off = 0
+    for name in sorted(named):
+        a = named[name]
+        if a is None:
+            manifest[name] = None
+            continue
+        a = np.ascontiguousarray(a)
+        raw = a.tobytes()
+        manifest[name] = {
+            "dtype": a.dtype.name,
+            "shape": list(a.shape),
+            "offset": off,
+        }
+        buffers.append(raw)
+        off += len(raw)
+    head = json.dumps(manifest, sort_keys=True).encode()
+    return struct.pack("<I", len(head)) + head + b"".join(buffers)
+
+
+def unpack_arrays(payload: bytes) -> dict[str, Optional[np.ndarray]]:
+    """Inverse of :func:`pack_arrays`. Raises ValueError on a short or
+    inconsistent payload (a torn checkpoint must fail loudly at load,
+    never decode at the wrong widths)."""
+    if len(payload) < 4:
+        raise ValueError("array pack too short for its header")
+    (n,) = struct.unpack_from("<I", payload)
+    head = payload[4:4 + n]
+    if len(head) < n:
+        raise ValueError("array pack manifest truncated")
+    manifest = json.loads(head)
+    base = 4 + n
+    out: dict[str, Optional[np.ndarray]] = {}
+    for name, m in manifest.items():
+        if m is None:
+            out[name] = None
+            continue
+        dt = np.dtype(m["dtype"])
+        shape = tuple(int(s) for s in m["shape"])
+        count = int(np.prod(shape)) if shape else 1
+        start = base + int(m["offset"])
+        end = start + count * dt.itemsize
+        if end > len(payload):
+            raise ValueError(f"array pack buffer {name!r} truncated")
+        out[name] = np.frombuffer(
+            payload[start:end], dtype=dt
+        ).reshape(shape)
+    return out
 
 
 # ---------------- frame records ----------------
@@ -300,6 +365,12 @@ class TraceWriter:
                 {"tick": int(tick), "events": list(events)}, sort_keys=True
             ).encode(),
         )
+
+    def write_arena(self, named: dict[str, Optional[np.ndarray]]) -> None:
+        """Carried solver state as one ARENA frame (checkpoint files;
+        workload traces never carry one — the replayer skips the
+        kind)."""
+        self._frame(KIND_ARENA, pack_arrays(named))
 
     def write_outcome(
         self,

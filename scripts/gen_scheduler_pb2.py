@@ -203,6 +203,15 @@ def build_file() -> dp.FileDescriptorProto:
         ("session_ok", 1, "bool", False),
         ("error", 2, "string", False),
         ("result", 3, "AssignResponseV2", False),
+        # resilience surface (appended fields — old clients skip them):
+        # stale=True marks a DEGRADED answer (the per-tick solve
+        # deadline was burned, so the previous plan was served;
+        # staleness_ticks counts how many ticks old it is), replayed=
+        # True marks an idempotent retransmit answer (the delta was
+        # already applied; this is the cached response, not a re-solve)
+        ("stale", 4, "bool", False),
+        ("staleness_ticks", 5, "uint32", False),
+        ("replayed", 6, "bool", False),
     ])
     _msg(fd, "MetricSample", [
         ("name", 1, "string", False),

@@ -21,7 +21,10 @@ Three ambient-nondeterminism classes can silently break that promise:
 Scope: ``protocol_tpu/native/`` and ``protocol_tpu/ops/``, plus the
 decision-quality plane (``protocol_tpu/obs/quality.py``,
 ``protocol_tpu/obs/slo.py``) whose replay-stability contract is the
-same bit-for-bit promise.
+same bit-for-bit promise, plus the chaos plane
+(``protocol_tpu/faults/``): a fault schedule that consulted ``random``
+or a wall clock would make every chaos run unreplayable — the seeded
+byte-replayability claim is the whole point of the plane.
 
 The SLO engine (``obs/slo.py``) additionally runs under the STRICT
 no-clock mode: its burn-rate windows are TICK-indexed by contract (a
@@ -65,7 +68,10 @@ class DeterminismRule(Rule):
 
     def applies(self, rel: str) -> bool:
         return rel.startswith(
-            ("protocol_tpu/native/", "protocol_tpu/ops/")
+            (
+                "protocol_tpu/native/", "protocol_tpu/ops/",
+                "protocol_tpu/faults/",
+            )
         ) or rel.endswith(
             ("protocol_tpu/obs/quality.py", "protocol_tpu/obs/slo.py")
         )

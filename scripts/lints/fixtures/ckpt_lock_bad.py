@@ -1,0 +1,30 @@
+"""Seeded violations for the lock-discipline rule over the checkpoint
+layer (shapes mirror faults/checkpoint.py). A flush that reads session
+state outside the session lock persists a TORN tick that a restart
+then resurrects — including the resilience-plane cursors the rule
+newly guards (last_p4t / last_delta_crc / stale_streak /
+solve_ewma_ms)."""
+
+
+def flush(ckpt, session):
+    cursor = session.tick  # SEED: lock-discipline
+    plan = session.last_p4t  # SEED: lock-discipline
+    crc = session.last_delta_crc  # SEED: lock-discipline
+    streak = session.stale_streak  # SEED: lock-discipline
+    ewma = session.solve_ewma_ms  # SEED: lock-discipline
+    state = session.arena.export_state()  # SEED: lock-discipline
+    return cursor, plan, crc, streak, ewma, state
+
+
+def flush_properly(ckpt, session):
+    with session.lock:
+        return (
+            session.tick,
+            session.last_p4t,
+            session.arena.export_state(),
+        )
+
+
+def flush_tail_locked(ckpt, session):
+    # *_locked naming convention: the caller holds session.lock
+    return session.tick, session.last_delta_crc

@@ -1,0 +1,25 @@
+"""Clean twin of ckpt_lock_bad.py: every flush holds the session lock
+(or is *_locked by the naming contract) before touching the
+tick-consistent state it serializes."""
+
+
+def flush(ckpt, session):
+    with session.lock:
+        cursor = session.tick
+        plan = session.last_p4t
+        crc = session.last_delta_crc
+        state = session.arena.export_state()
+    return cursor, plan, crc, state
+
+
+def flush_locked(ckpt, session):
+    return (
+        session.tick,
+        session.stale_streak,
+        session.solve_ewma_ms,
+    )
+
+
+def audited_peek(session):
+    # fresh object, not yet visible to any store: no lock exists yet
+    return session.last_p4t  # lint: unlocked-ok (fresh object)

@@ -1,0 +1,20 @@
+"""Seeded violations for the determinism rule over the chaos plane
+(shapes mirror protocol_tpu/faults/plan.py). A fault schedule that
+consults ``random`` or a wall clock is unreplayable — the seeded
+byte-replayability claim is the whole point of the plane."""
+
+import random  # SEED: determinism
+import time
+
+
+class DriftingSchedule:
+    def __init__(self, seed: int):
+        self.seed = seed
+
+    def decide(self, site: str, method: str, index: int):
+        drop = random.random() < 0.05  # SEED: determinism
+        delay = (time.time() % 1.0) < 0.05  # SEED: determinism
+        order = []
+        for m in {"Assign", "AssignDelta"}:  # SEED: determinism
+            order.append(m)
+        return drop, delay, order

@@ -1,0 +1,30 @@
+"""Clean twin of faults_determinism_bad.py: the seeded hash-draw
+spelling the chaos plane actually uses (plan.FaultSchedule) — every
+decision a pure function of (seed, salt, site, method, index)."""
+
+import hashlib
+import time
+
+
+class SeededSchedule:
+    def __init__(self, seed: int):
+        self.seed = seed
+
+    def _frac(self, salt: str, site: str, method: str,
+              index: int) -> float:
+        digest = hashlib.sha1(
+            f"{self.seed}:{salt}:{site}:{method}:{index}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / 2.0 ** 64
+
+    def decide(self, site: str, method: str, index: int):
+        drop = self._frac("drop", site, method, index) < 0.05
+        order = []
+        for m in sorted({"Assign", "AssignDelta"}):
+            order.append(m)
+        return drop, order
+
+    def measure_injection(self):
+        # perf_counter for STATS is allowed in non-strict modules —
+        # stats ride next to fault decisions, never into them
+        return time.perf_counter()

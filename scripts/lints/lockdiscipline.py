@@ -31,9 +31,12 @@ convention), ``__init__``/``__post_init__`` (object not yet shared), and
 
 Scope: ``protocol_tpu/services/session_store.py``,
 ``protocol_tpu/services/scheduler_grpc.py`` (where the sharded-lock
-protocol lives), and the fleet layer (``protocol_tpu/fleet/fabric.py``,
+protocol lives), the fleet layer (``protocol_tpu/fleet/fabric.py``,
 ``protocol_tpu/fleet/admission.py``) whose shard and budget state is
-only ever mutated under its shard/fleet locks.
+only ever mutated under its shard/fleet locks, and the checkpoint
+layer (``protocol_tpu/faults/checkpoint.py``) which serializes a
+session's tick-consistent state — a flush outside the session lock
+would persist a torn tick that a restart then resurrects.
 """
 
 from __future__ import annotations
@@ -45,6 +48,11 @@ from scripts.lints.base import Finding, Rule, Source, register
 GUARDED_SESSION_ATTRS = {
     "tick", "arena", "p_cols", "r_cols", "evicted", "last_used",
     "delta_rows_total",
+    # resilience plane: the idempotent-retransmit cache and the
+    # deadline watchdog's staleness cursors are tick-consistent state —
+    # reading them outside the session lock ships a plan from a torn
+    # tick
+    "last_p4t", "last_delta_crc", "stale_streak", "solve_ewma_ms",
 }
 GUARDED_SESSION_CALLS = {"solve", "apply_delta"}
 GUARDED_ANY_RECEIVER = {
@@ -86,6 +94,7 @@ class LockDisciplineRule(Rule):
         return rel.endswith((
             "session_store.py", "scheduler_grpc.py",
             "fleet/fabric.py", "fleet/admission.py",
+            "faults/checkpoint.py",
         ))
 
     def _inside_lock(self, src: Source, node: ast.AST) -> bool:

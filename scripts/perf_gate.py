@@ -70,10 +70,24 @@ must carry a cause code, plan churn at 1% population churn must stay
 within the obs overhead budget — so a cert/taxonomy/stability
 regression cannot merge on green unit tests alone.
 
+With ``--chaos`` it runs the seeded fault-schedule gate (ISSUE 9): the
+committed golden trace driven through a live loopback servicer under
+the chaos plane — servicer kill + restart mid-run (warm checkpoint
+rehydration), 5% RPC drop + 5% delay, duplicated deltas, one forced
+shard blackout — must RECONVERGE WARM: zero full-snapshot reopens,
+no tick lost or double-applied (the idempotent-retransmit dedup is
+exercised and must fire), and every tick's plan bit-identical to the
+fault-free replay. A second phase forces an eviction and asserts the
+fallback ladder's counted reopen; a third arms the per-tick solve
+deadline and asserts degraded (stale-plan) answers are explicitly
+flagged, counted in obs, and bounded by ``max_stale_ticks``. A
+recovery/degradation regression cannot merge on green unit tests
+alone.
+
 Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
-[--trace] [--obs] [--fleet] [--quality] (--update-floor rewrites perf_floor.json to
-25% of this machine's measured rate — run on the slowest supported host
-class, then commit.)
+[--trace] [--obs] [--fleet] [--quality] [--chaos] (--update-floor
+rewrites perf_floor.json to 25% of this machine's measured rate — run
+on the slowest supported host class, then commit.)
 """
 
 import argparse
@@ -735,6 +749,138 @@ def quality_gate() -> int:
     return 0
 
 
+def chaos_gate() -> int:
+    """Seeded chaos gate (the ISSUE 9 acceptance bar) over the
+    committed golden trace. Three phases, one seed each — every run
+    replays the identical fault train (the schedule is a pure function
+    of the seed, and the acceptance claims are exact, not statistical).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from protocol_tpu.faults.harness import run_chaos
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    frac_floor = floors["chaos_min_assigned_frac"]
+    stale_bound = int(floors["chaos_max_stale_streak"])
+
+    # ---- phase A: kill + drop + delay + dup + blackout -> warm
+    # reconvergence with bit-identical plans
+    rep = run_chaos(
+        GOLDEN_TRACE, seed=3,
+        drop_rate=0.05, delay_rate=0.05, delay_ms=2.0,
+        duplicate_rate=0.1,
+        kill_at_tick=3, blackout_at_tick=5, blackout_refusals=2,
+    )
+    print(
+        f"chaos gate A (kill/drop/delay/dup/blackout): "
+        f"{rep['ticks']} ticks, restarted={rep['restarted']}, "
+        f"reopens={rep['client']['reopens']}, "
+        f"replayed={rep['client']['replayed_served']}, "
+        f"identical={rep['fresh_ticks_identical']}, "
+        f"min-assigned={rep['assigned_frac_min']}"
+    )
+    if not rep["restarted"]:
+        failures.append("phase A never killed/restarted the servicer")
+    if rep["client"]["reopens"] != 0:
+        failures.append(
+            f"phase A: {rep['client']['reopens']} full-snapshot "
+            "reopens after restart — recovery was not warm"
+        )
+    if not rep["fresh_ticks_identical"] or not rep[
+        "final_tick_identical"
+    ]:
+        failures.append(
+            f"phase A: plans diverged from the fault-free replay at "
+            f"ticks {rep['fresh_mismatch_ticks']} — a tick was lost, "
+            "double-applied, or the restored arena continued cold"
+        )
+    if rep["client"]["replayed_served"] < 1:
+        failures.append(
+            "phase A: the idempotent-retransmit dedup never fired — "
+            "the kill window did not exercise the crash protocol"
+        )
+    if rep["blackout_refusals_served"] < 1:
+        failures.append("phase A: the shard blackout never refused")
+    if rep["stale_ticks"]:
+        failures.append(
+            "phase A: stale answers served with no deadline configured"
+        )
+    if rep["assigned_frac_min"] < frac_floor:
+        failures.append(
+            f"phase A: assigned fraction {rep['assigned_frac_min']} "
+            f"below {frac_floor}"
+        )
+
+    # ---- phase B: forced eviction -> the fallback ladder's counted
+    # reopen (the one fault whose CONTRACT is the reopen)
+    rep_b = run_chaos(GOLDEN_TRACE, seed=4, evict_at_tick=4)
+    print(
+        f"chaos gate B (forced eviction): reopens="
+        f"{rep_b['client']['reopens']}, "
+        f"min-assigned={rep_b['assigned_frac_min']}"
+    )
+    if rep_b["client"]["reopens"] != 1:
+        failures.append(
+            f"phase B: expected exactly 1 counted reopen after the "
+            f"forced eviction, got {rep_b['client']['reopens']}"
+        )
+    if rep_b["assigned_frac_min"] < frac_floor:
+        failures.append(
+            f"phase B: assigned fraction {rep_b['assigned_frac_min']} "
+            f"below {frac_floor}"
+        )
+
+    # ---- phase C: per-tick deadline -> bounded, flagged, counted
+    # staleness (the graceful-degradation contract)
+    rep_c = run_chaos(
+        GOLDEN_TRACE, seed=5, tick_deadline_ms=0.01,
+        max_stale_ticks=stale_bound,
+    )
+    n_stale = len(rep_c["stale_ticks"])
+    print(
+        f"chaos gate C (deadline degradation): {n_stale} stale ticks, "
+        f"max streak {rep_c['max_stale_streak']} (bound {stale_bound}), "
+        f"client-counted {rep_c['client']['stale_served']}, "
+        f"obs-counted {rep_c['server_stale_obs']}, "
+        f"min-assigned {rep_c['assigned_frac_min']}"
+    )
+    if n_stale == 0:
+        failures.append(
+            "phase C: the 0.01 ms deadline produced no stale answers — "
+            "the watchdog is dark"
+        )
+    if rep_c["max_stale_streak"] > stale_bound:
+        failures.append(
+            f"phase C: stale streak {rep_c['max_stale_streak']} "
+            f"exceeds the {stale_bound}-tick bound — staleness is not "
+            "bounded"
+        )
+    if rep_c["client"]["stale_served"] != n_stale:
+        failures.append(
+            "phase C: client-side stale count disagrees with the "
+            "flagged responses — degradation is not explicit"
+        )
+    if sum(rep_c["server_stale_obs"].values()) != n_stale:
+        failures.append(
+            f"phase C: obs plane counted "
+            f"{sum(rep_c['server_stale_obs'].values())} stale ticks "
+            f"for {n_stale} served — degraded answers must be counted"
+        )
+    if rep_c["assigned_frac_min"] < frac_floor:
+        failures.append(
+            f"phase C: assigned fraction {rep_c['assigned_frac_min']} "
+            f"below {frac_floor} — staleness bought too much quality"
+        )
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("chaos perf gate OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update-floor", action="store_true")
@@ -744,6 +890,7 @@ def main() -> int:
     ap.add_argument("--obs", action="store_true")
     ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--quality", action="store_true")
+    ap.add_argument("--chaos", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
@@ -758,6 +905,8 @@ def main() -> int:
         return fleet_gate()
     if args.quality:
         return quality_gate()
+    if args.chaos:
+        return chaos_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

@@ -42,13 +42,17 @@ class TestRulesFireExactlyOnSeeds:
             (DeterminismRule, "determinism_bad.py", "determinism_ok.py"),
             (DeterminismRule, "slo_determinism_bad.py",
              "slo_determinism_ok.py"),
+            (DeterminismRule, "faults_determinism_bad.py",
+             "faults_determinism_ok.py"),
             (LockDisciplineRule, "lock_bad.py", "lock_ok.py"),
             (LockDisciplineRule, "fleet_lock_bad.py", "fleet_lock_ok.py"),
+            (LockDisciplineRule, "ckpt_lock_bad.py", "ckpt_lock_ok.py"),
             (DenseAllocRule, "dense_bad.py", "dense_ok.py"),
         ],
         ids=[
-            "determinism", "determinism-slo-strict", "lock-discipline",
-            "lock-discipline-fleet", "dense-alloc",
+            "determinism", "determinism-slo-strict", "determinism-faults",
+            "lock-discipline", "lock-discipline-fleet",
+            "lock-discipline-ckpt", "dense-alloc",
         ],
     )
     def test_seeds_and_clean_twin(self, rule_cls, bad, ok):
@@ -192,6 +196,61 @@ class TestSLOStrictMode:
         findings = DeterminismRule().check(Source(mutated))
         assert findings, "clock read injected into observe() not caught"
         assert all(f.rule == "determinism" for f in findings)
+
+
+class TestChaosPlaneCoverage:
+    """The chaos-plane lint extension (ISSUE 9): the determinism rule
+    covers ``protocol_tpu/faults/`` (a schedule that consulted
+    ``random`` or a wall clock would be unreplayable) and the
+    lock-discipline rule covers the checkpoint layer (a flush outside
+    the session lock persists a torn tick). Mutation-verified both
+    ways: the real modules are clean, and an injected violation is
+    caught."""
+
+    FAULTS = REPO / "protocol_tpu" / "faults"
+
+    def test_determinism_rule_covers_the_fault_plane(self):
+        rule = DeterminismRule()
+        assert rule.applies("protocol_tpu/faults/plan.py")
+        assert rule.applies("protocol_tpu/faults/inject.py")
+        assert rule.applies("protocol_tpu/faults/harness.py")
+        assert not rule._is_strict("protocol_tpu/faults/plan.py")
+        for mod in ("plan.py", "inject.py", "harness.py",
+                    "checkpoint.py"):
+            assert rule.check(Source(self.FAULTS / mod)) == [], mod
+
+    def test_lock_rule_covers_the_checkpoint_layer(self):
+        rule = LockDisciplineRule()
+        assert rule.applies("protocol_tpu/faults/checkpoint.py")
+        assert rule.check(Source(self.FAULTS / "checkpoint.py")) == []
+
+    def test_mutated_fault_schedule_is_caught(self, tmp_path):
+        src = (self.FAULTS / "plan.py").read_text()
+        needle = "        f = self._frac\n"
+        assert needle in src  # decide() body anchor
+        mutated = tmp_path / "plan_mutated.py"
+        mutated.write_text(src.replace(
+            needle,
+            needle + "        import random\n"
+            "        _jitter = random.random()\n",
+            1,
+        ))
+        findings = DeterminismRule().check(Source(mutated))
+        assert findings, "random draw injected into decide() not caught"
+        assert all(f.rule == "determinism" for f in findings)
+
+    def test_mutated_checkpoint_flush_is_caught(self, tmp_path):
+        src = (self.FAULTS / "checkpoint.py").read_text()
+        mutated = tmp_path / "checkpoint_mutated.py"
+        mutated.write_text(
+            src + "\n\ndef torn_peek(session):\n"
+            "    return session.last_p4t, session.tick\n"
+        )
+        findings = LockDisciplineRule().check(Source(mutated))
+        assert len(findings) == 2, (
+            "unlocked resilience-cursor reads not caught"
+        )
+        assert all(f.rule == "lock-discipline" for f in findings)
 
 
 class TestSuppression:

@@ -248,7 +248,12 @@ class TestSessionProtocol:
         assert not r.session_ok
         assert "unknown" in r.error
 
-    def test_tick_replay_refused(self, backend):
+    def test_tick_replay_and_divergence(self, backend):
+        """A byte-identical retransmit of the last applied tick is
+        answered idempotently from the dedup cache (the ISSUE 9 crash
+        protocol: the original response died on the wire); a same-tick
+        request with DIFFERENT bytes is genuine divergence and refuses,
+        as does a skipped tick."""
         _, client = backend
         ep, er = _market(seed=5)
         p_cols = wire.canon_columns(ep, wire.P_WIRE_DTYPES)
@@ -258,12 +263,33 @@ class TestSessionProtocol:
         ok = client.assign_delta(pb.AssignDeltaRequest(
             session_id="s-tick", epoch_fingerprint=fp, tick=1
         ))
-        assert ok.session_ok
+        assert ok.session_ok and not ok.replayed
+        # identical retransmit: replayed twin, applied exactly once
         replay = client.assign_delta(pb.AssignDeltaRequest(
             session_id="s-tick", epoch_fingerprint=fp, tick=1
         ))
-        assert not replay.session_ok
-        assert "tick" in replay.error
+        assert replay.session_ok and replay.replayed
+        np.testing.assert_array_equal(
+            wire.unblob(ok.result.provider_for_task, np.int32),
+            wire.unblob(replay.result.provider_for_task, np.int32),
+        )
+        # same tick, different bytes: diverged shadow state — refused
+        rows = np.array([0], np.int32)
+        diverged = client.assign_delta(pb.AssignDeltaRequest(
+            session_id="s-tick", epoch_fingerprint=fp, tick=1,
+            provider_rows=wire.blob(rows, np.int32),
+            providers=wire.encode_providers_v2(
+                wire.take_rows(p_cols, rows)
+            ),
+        ))
+        assert not diverged.session_ok
+        assert "tick" in diverged.error
+        # skipped tick: refused (the cursor is at 1, not 2)
+        skipped = client.assign_delta(pb.AssignDeltaRequest(
+            session_id="s-tick", epoch_fingerprint=fp, tick=3
+        ))
+        assert not skipped.session_ok
+        assert "tick" in skipped.error
 
     def test_client_claimed_fingerprint_is_verified(self, backend):
         """A client whose codec disagrees with the server must be told at
