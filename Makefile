@@ -65,12 +65,14 @@ bench-scaling:
 scale-tests:
 	PROTOCOL_TPU_SCALE_TESTS=1 $(PY) -m pytest tests/test_scale_matcher.py -v
 
-# fail-the-build lint discipline: the hermetic unused-import gate plus
-# the project rule engine (determinism / lock / dtype / dense-alloc
-# contracts — scripts/lints/)
+# fail-the-build lint discipline: the hermetic unused-import gate, the
+# project rule engine (determinism / lock / dtype / dense-alloc
+# contracts — scripts/lints/), and the whole-program analyzer
+# (lock-order / protocol-sm / jax-purity — scripts/analysis/)
 lint:
 	$(PY) scripts/lint.py
 	$(PY) -m scripts.lints
+	$(PY) -m scripts.analysis
 
 proto:
 	protoc --python_out=. protocol_tpu/proto/scheduler.proto

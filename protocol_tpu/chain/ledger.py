@@ -33,12 +33,12 @@ from __future__ import annotations
 
 import enum
 import hashlib
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from protocol_tpu.security.wallet import verify_signature
+from protocol_tpu.utils.lockwitness import make_rlock
 
 
 class LedgerError(Exception):
@@ -106,7 +106,7 @@ def invite_digest(domain_id: int, pool_id: int, node: str, nonce: str, expiratio
 
 class Ledger:
     def __init__(self, min_stake_per_compute_unit: int = 10):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ledger")
         self.balances: dict[str, int] = {}
         self.allowances: dict[tuple[str, str], int] = {}
         self.providers: dict[str, ProviderInfo] = {}

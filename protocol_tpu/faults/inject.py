@@ -23,12 +23,12 @@ that refusal is the behavior under test, not an error in the injector.
 
 from __future__ import annotations
 
-import threading
 import time
 
 import grpc
 
 from protocol_tpu.faults.plan import FaultAction, FaultSchedule
+from protocol_tpu.utils.lockwitness import make_lock
 
 
 class FaultInjectedError(grpc.RpcError):
@@ -127,7 +127,7 @@ class ChaosClient:
         self._client = client
         self._schedule = schedule
         self._site = site
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos")
         self._index: dict[str, int] = {}
         self.counters: dict[str, int] = {}
 
@@ -274,7 +274,7 @@ class ChaosServerInterceptor(grpc.ServerInterceptor):
     def __init__(self, schedule: FaultSchedule, site: str = "server"):
         self._schedule = schedule
         self._site = site
-        self._lock = threading.Lock()
+        self._lock = make_lock("chaos")
         self._index: dict[str, int] = {}
         self.counters: dict[str, int] = {}
 

@@ -28,12 +28,12 @@ the defaults read ``time.monotonic`` exactly like the session TTLs.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Optional
 
 from protocol_tpu.services.session_store import EngineThreadBudget
+from protocol_tpu.utils.lockwitness import make_lock
 
 
 def jain_index(xs) -> float:
@@ -62,7 +62,7 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = float(burst)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("bucket")
         self._tokens = float(burst)
         self._last = clock()
 
@@ -105,7 +105,7 @@ class TenantAdmission:
         self.burst = float(burst)
         self.per_tenant = dict(per_tenant or {})
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("admission")
         # LRU-bounded: tenant keys are derived from client-minted
         # session ids (a bare uuid's "tenant" is the whole uuid — the
         # production RemoteBatchMatcher mints exactly those), so an

@@ -34,13 +34,13 @@ import bisect
 import dataclasses
 import hashlib
 import os
-import threading
 from typing import Optional
 
 import numpy as np
 
 from protocol_tpu.obs.metrics import tenant_of
 from protocol_tpu.services.session_store import SessionStore
+from protocol_tpu.utils.lockwitness import make_lock
 
 
 def _h(key: str) -> int:
@@ -172,7 +172,7 @@ class SessionFabric:
         self._ring_shards = [s for _, s in ring]
         # ---- arena budget accounting (LEAF lock: callbacks land here
         # from under shard locks; never call a shard while holding it)
-        self._budget_lock = threading.Lock()
+        self._budget_lock = make_lock("budget")
         self._by_session: dict[str, tuple] = {}  # sid -> (session, tenant, bytes)
         self._tenant_bytes: dict[str, int] = {}
         self._total_bytes = 0
@@ -184,7 +184,7 @@ class SessionFabric:
         # a client that backs off and retries resumes warm with zero
         # reopens; an eviction-shaped refusal here would amplify a
         # transient shard outage into a full-snapshot reopen herd.
-        self._blackout_lock = threading.Lock()
+        self._blackout_lock = make_lock("blackout")
         self._blackout: dict[int, int] = {}  # shard index -> refusals left
         self.blackout_refusals_served = 0
         # optional let-go observer (the servicer's checkpoint GC): fires

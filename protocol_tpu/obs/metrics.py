@@ -23,7 +23,6 @@ registry still measures, only the scrape endpoint 503s).
 from __future__ import annotations
 
 import math
-import threading
 from collections import OrderedDict, deque
 from typing import Optional
 
@@ -279,7 +278,9 @@ class ObsRegistry:
         # label cardinality on every scrape. Recency-evicted at
         # ``max_sessions`` instead.
         self.max_sessions = int(max_sessions)
-        self._lock = threading.Lock()
+        from protocol_tpu.utils.lockwitness import make_lock
+
+        self._lock = make_lock("registry")
         self._sessions: OrderedDict[str, _SessionObs] = OrderedDict()
         # per-tenant roll-up, recorded in the SAME observe_tick pass:
         # tenant histograms are true merged distributions (p50/p99 over

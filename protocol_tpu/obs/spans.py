@@ -46,9 +46,11 @@ class SpanTracer:
     (one attribute check, no lock)."""
 
     def __init__(self, capacity: int = 4096, enabled: bool = True):
+        from protocol_tpu.utils.lockwitness import make_lock
+
         self.enabled = enabled
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         self._ring: deque = deque(maxlen=self.capacity)
         self._next_id = 1
         self._seq = 0  # completed spans ever (ring-overflow-proof cursor)

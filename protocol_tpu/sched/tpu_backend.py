@@ -29,7 +29,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-import threading
 import time
 from typing import Optional
 
@@ -64,7 +63,11 @@ from protocol_tpu.store.domains.node_store import NodeStatus, OrchestratorNode
 
 SCHEDULABLE = (NodeStatus.HEALTHY, NodeStatus.WAITING_FOR_HEARTBEAT)
 
-_PROFILE_LOCK = threading.Lock()  # jax.profiler.trace is process-global
+from protocol_tpu.utils.lockwitness import LazyLock, make_lock
+
+# LazyLock: module-global (the witness decision must wait for first use);
+# jax.profiler.trace is process-global
+_PROFILE_LOCK = LazyLock("profile")
 
 
 def _pow2_bucket(n: int, floor: int = 8) -> int:
@@ -308,7 +311,7 @@ class TpuBatchMatcher:
         self._covered: set[str] = set()  # addresses the last solve considered
         # heartbeats arrive from worker threads (asyncio.to_thread): one lock
         # serializes solves and makes (_assignment, _covered) swaps atomic
-        self._solve_lock = threading.Lock()
+        self._solve_lock = make_lock("solve")
         self.encoder = FeatureEncoder()
         self._cache = CandidateCache(self.encoder, self.weights, k=top_k)
         # content-hash memo for the UNCACHED wire path (stateless repeats)

@@ -22,7 +22,6 @@ sync.rs:16,76-87,135-222); location enrichment via a pluggable resolver
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Awaitable, Callable, Optional
 
@@ -41,6 +40,7 @@ from protocol_tpu.security.middleware import (
     validate_signature_middleware,
 )
 from protocol_tpu.store.kv import KVStore
+from protocol_tpu.utils.lockwitness import make_lock
 
 NODE_KEY = "node:{}"
 NODE_IDS = "node:ids"
@@ -112,7 +112,7 @@ class DiscoveryService:
         # _register_node and chain_sync_once run in worker threads (their
         # ledger calls may be remote HTTP): this lock restores the
         # read-modify-write serialization the event loop used to provide
-        self._write_lock = threading.Lock()
+        self._write_lock = make_lock("discovery")
 
     # ---------------- HTTP surface ----------------
 

@@ -208,9 +208,9 @@ class SchedulerBackendServicer:
         # determinism contract, so borrowing fewer threads never changes
         # a matching).
         self._native_arena = None
-        import threading
+        from protocol_tpu.utils.lockwitness import make_lock
 
-        self._unary_arena_lock = threading.Lock()
+        self._unary_arena_lock = make_lock("arena")
         # ---- fleet layer (always on; the defaults are transparent):
         # sessions live in a consistent-hash sharded fabric (each shard
         # its own lock domain, global count/byte budgets enforced by

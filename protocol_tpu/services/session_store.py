@@ -36,9 +36,18 @@ import numpy as np
 
 from protocol_tpu.obs.spans import TRACER as _tracer
 from protocol_tpu.proto.wire import P_WIRE_DTYPES, R_WIRE_DTYPES
+from protocol_tpu.utils.lockwitness import make_lock
 
 # session-servable kernel strings -> the arena engine behind them
 _SESSION_ENGINES = {"native-mt": "auction", "sinkhorn-mt": "sinkhorn"}
+
+
+def _session_lock():
+    return make_lock("session")
+
+
+def _inflight_lock():
+    return make_lock("inflight")
 
 
 def parse_session_kernel(kernel: str) -> Optional[tuple[str, int]]:
@@ -85,7 +94,7 @@ class EngineThreadBudget:
     def __init__(self, total: Optional[int] = None):
         self.total = int(total) if total else (os.cpu_count() or 1)
         self._avail = self.total
-        self._lock = threading.Lock()
+        self._lock = make_lock("threadpool")
         # obs plane counters (read by ObsRegistry's budget gauges):
         # cumulative grants, grants smaller than requested (the
         # saturation signal the fleet roadmap gates on), and the lowest
@@ -167,7 +176,7 @@ class SolveSession:
     arena: object  # NativeSolveArena
     tick: int = 0
     last_used: float = field(default_factory=time.monotonic)
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: threading.Lock = field(default_factory=_session_lock)
     delta_rows_total: int = 0
     # set (under the store lock) when the store lets go of this session —
     # LRU eviction, TTL expiry, drop, or same-id replacement. An in-flight
@@ -191,7 +200,7 @@ class SolveSession:
     # to refuse. Guarded by its own tiny lock so the check never
     # contends with a running solve.
     inflight: int = 0
-    inflight_lock: threading.Lock = field(default_factory=threading.Lock)
+    inflight_lock: threading.Lock = field(default_factory=_inflight_lock)
     # fleet arena-budget accounting: byte estimate of this session's
     # pinned state (padded columns + candidate structure + duals),
     # computed once at open from rows x dtype widths
@@ -343,7 +352,7 @@ class SessionStore:
     ):
         self.max_sessions = max_sessions
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard")
         self._sessions: OrderedDict[str, SolveSession] = OrderedDict()
         self.evictions = 0
         self.expirations = 0

@@ -37,8 +37,9 @@ import fnmatch
 import functools
 import json
 import os
-import threading
 import time
+
+from protocol_tpu.utils.lockwitness import make_rlock
 from typing import Callable, Iterable, Optional
 
 
@@ -77,7 +78,7 @@ class KVStore:
         persist_path: Optional[str] = None,
         compact_threshold: int = 100_000,
     ):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("kv")
         self._data: dict[str, object] = {}
         self._expiry: dict[str, float] = {}
         # persistence needs wall-clock TTLs; in-memory stays monotonic

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 from typing import Optional
 
 import numpy as np
@@ -39,10 +38,12 @@ import numpy as np
 from protocol_tpu.proto import scheduler_pb2 as pb
 from protocol_tpu.proto import wire
 from protocol_tpu.trace import format as tfmt
+from protocol_tpu.utils.lockwitness import LazyLock, make_lock
 
 ENV_VAR = "PROTOCOL_TPU_TRACE"
 
-_claim_lock = threading.Lock()
+# LazyLock: module-global — the witness decision must wait for first use
+_claim_lock = LazyLock("trace-claim")
 _claimed: set[str] = set()
 
 log = logging.getLogger(__name__)
@@ -70,7 +71,7 @@ class TraceRecorder:
         self.path = path
         self.role = role
         self.meta = dict(meta or {})
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace")
         self._writer: Optional[tfmt.TraceWriter] = None
         self._epoch = 0
         self._tick = 0

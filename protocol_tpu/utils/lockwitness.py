@@ -1,0 +1,251 @@
+"""Runtime lock-order witness: the dynamic half of the lock-order
+analyzer (``scripts/analysis/lockorder.py``).
+
+The static analyzer proves the *code* acquires locks in spec order
+(``scripts/analysis/lock_order.toml``); this module asserts the same
+order *live*. Every lock-owning module creates its locks through
+:func:`make_lock` with the lock's spec domain name. With
+``PROTOCOL_TPU_LOCK_WITNESS`` unset (the default) that is a plain
+``threading.Lock`` — zero overhead, nothing changes. With
+``PROTOCOL_TPU_LOCK_WITNESS=1`` each lock is wrapped in a
+:class:`WitnessedLock` that checks, at every acquisition, that the
+acquiring thread holds no lock of equal or higher rank — the same
+strict-ascending-rank rule the static pass enforces, now checked under
+the real interleavings of the fleet race suite and the chaos drills.
+
+Violations are RECORDED, not raised (``violations()`` returns them, the
+race/chaos tests assert the list is empty): raising inside a lock
+acquisition would turn an ordering bug into an unrelated crash halfway
+through a drill, losing the evidence. ``PROTOCOL_TPU_LOCK_WITNESS=strict``
+raises immediately instead — the bisection mode.
+
+Rank rule: a thread may acquire a lock only while every lock it already
+holds has a strictly LOWER rank. Equal rank is a violation too — that is
+what "shard locks never nest" means mechanically. Reentrant domains
+(``reentrant = true`` in the spec) may re-acquire a lock they already
+hold (RLock semantics); acquiring a *different* instance of the same
+domain still violates.
+
+The domain/rank table is loaded from the committed spec so the static
+and dynamic checks can never drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_SPEC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))),
+    "scripts", "analysis", "lock_order.toml",
+)
+
+# loaded lazily on first witnessed-lock creation; None = not yet loaded
+_RANKS: Optional[dict] = None
+_REENTRANT: frozenset = frozenset()
+
+_tls = threading.local()
+_violations: list = []
+_violations_lock = threading.Lock()  # meta-lock, never witnessed
+
+
+class LockOrderViolation(RuntimeError):
+    pass
+
+
+def _load_ranks() -> dict:
+    global _RANKS, _REENTRANT
+    if _RANKS is not None:
+        return _RANKS
+    try:
+        # load the spec module BY PATH: perf_gate/serve processes may
+        # not have the repo root on sys.path, and the witness must not
+        # depend on the ``scripts`` package being importable
+        import importlib.util
+        import sys
+
+        loader_path = os.path.join(
+            os.path.dirname(_SPEC_PATH), "spec.py"
+        )
+        mod_spec = importlib.util.spec_from_file_location(
+            "_pt_lock_spec", loader_path
+        )
+        mod = importlib.util.module_from_spec(mod_spec)
+        # dataclasses resolves string annotations through
+        # sys.modules[cls.__module__]; a path-loaded module must be
+        # registered or @dataclass itself raises on 3.10
+        sys.modules[mod_spec.name] = mod
+        mod_spec.loader.exec_module(mod)
+        spec = mod.load_spec(_SPEC_PATH)
+        _RANKS = dict(spec.ranks)
+        _REENTRANT = frozenset(spec.reentrant)
+    except Exception:
+        # the witness must degrade to INERT, never crash the server: a
+        # missing/unparsable spec means no ordering is asserted (the
+        # static analyzer fails CI on the spec instead). An empty rank
+        # table disables checking entirely — all-zero ranks would
+        # otherwise read every nested acquisition as a violation.
+        _RANKS = {}
+        _REENTRANT = frozenset()
+    return _RANKS
+
+
+def enabled() -> bool:
+    v = os.environ.get("PROTOCOL_TPU_LOCK_WITNESS", "")
+    return v not in ("", "0", "off", "false")
+
+
+def strict() -> bool:
+    return os.environ.get("PROTOCOL_TPU_LOCK_WITNESS", "") == "strict"
+
+
+def _held() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def violations() -> list:
+    with _violations_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    with _violations_lock:
+        _violations.clear()
+
+
+def _record(entry: dict) -> None:
+    with _violations_lock:
+        if len(_violations) < 1024:  # bounded: a hot loop can't OOM us
+            _violations.append(entry)
+    if strict():
+        raise LockOrderViolation(str(entry))
+
+
+class WitnessedLock:
+    """A ``threading.Lock`` twin that checks the rank order on acquire.
+
+    Supports the full surface the codebase uses: ``with``, bare
+    ``acquire()/release()`` (tests hold session locks across calls), and
+    ``locked()``. The held-stack is thread-local; blocking on a
+    contended lock is unchanged — the witness only looks at what THIS
+    thread already holds at the acquisition attempt."""
+
+    __slots__ = ("domain", "rank", "reentrant", "_lock")
+
+    def __init__(self, domain: str, reentrant: Optional[bool] = None):
+        ranks = _load_ranks()
+        self.domain = domain
+        self.rank = int(ranks.get(domain, 0))
+        self.reentrant = (
+            domain in _REENTRANT if reentrant is None else bool(reentrant)
+        )
+        self._lock = (
+            threading.RLock() if self.reentrant else threading.Lock()
+        )
+
+    def _check(self) -> None:
+        if not _RANKS:
+            return  # inert: no spec, no ordering asserted
+        held = _held()
+        if not held:
+            return
+        if self.reentrant and any(e[2] is self for e in held):
+            return  # RLock re-acquisition of the same instance
+        top_rank = max(e[1] for e in held)
+        if self.rank <= top_rank:
+            _record({
+                "acquiring": self.domain,
+                "rank": self.rank,
+                "held": [(e[0], e[1]) for e in held],
+                "thread": threading.current_thread().name,
+            })
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._check()
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _held().append((self.domain, self.rank, self))
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][2] is self:
+                del held[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def make_lock(domain: str):
+    """Create the lock for ``domain`` (a ``[domains]`` key in
+    ``lock_order.toml``). Plain ``threading.Lock`` unless the witness is
+    armed — call sites pay one env read at *creation*, nothing per
+    acquisition."""
+    if enabled():
+        return WitnessedLock(domain)
+    return threading.Lock()
+
+
+def make_rlock(domain: str):
+    """Reentrant variant (``ledger``/``kv`` keep RLock semantics)."""
+    if enabled():
+        return WitnessedLock(domain, reentrant=True)
+    return threading.RLock()
+
+
+class LazyLock:
+    """Module-level lock whose witness decision happens at FIRST USE,
+    not import: module globals (``_claim_lock``, ``_PROFILE_LOCK``) are
+    created when the module first imports — in a test session that is
+    during collection, before any fixture arms the witness, so an
+    import-time ``make_lock`` would silently pin them as plain Locks
+    for the whole process. Costs one attribute check per acquisition on
+    these two low-frequency locks."""
+
+    __slots__ = ("domain", "_lock")
+
+    def __init__(self, domain: str):
+        self.domain = domain
+        self._lock = None
+
+    def _resolve(self):
+        lock = self._lock
+        if lock is None:
+            # double-checked under the meta-lock: two racing creators
+            # handing out DIFFERENT lock objects would break mutual
+            # exclusion, the one property a lock must never lose
+            with _violations_lock:
+                if self._lock is None:
+                    self._lock = make_lock(self.domain)
+                lock = self._lock
+        return lock
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._resolve().acquire(blocking, timeout)
+
+    def release(self) -> None:
+        self._resolve().release()
+
+    def locked(self) -> bool:
+        return self._resolve().locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -239,8 +239,10 @@ class SeamMetrics:
     stay measurable in environments without prometheus_client."""
 
     def __init__(self, role: str = "server"):
+        from protocol_tpu.utils.lockwitness import make_lock
+
         self.role = role
-        self._lock = __import__("threading").Lock()
+        self._lock = make_lock("seam")
         self._ms_sum: dict[str, float] = {}
         self._ms_count: dict[str, int] = {}
         self._bytes: dict[str, int] = {}

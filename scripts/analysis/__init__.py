@@ -1,0 +1,31 @@
+"""Whole-program concurrency & contract analyzer (``python -m
+scripts.analysis``).
+
+Three interprocedural passes that go beyond the per-file lint engine
+(``scripts.lints`` — which stays the home of the single-file AST rules):
+
+  lock-order   call-graph-propagated lock-order graph over all of
+               protocol_tpu/, checked for rank violations and cycles
+               against the committed spec (lock_order.toml), plus the
+               dropped-lock check on ``*_locked`` helpers. The runtime
+               twin is protocol_tpu/utils/lockwitness.py
+               (PROTOCOL_TPU_LOCK_WITNESS=1).
+  protocol-sm  wire-v2 session lifecycle state-machine checker over the
+               servicer handlers: ladder-recognizable refusals, decode
+               hardening before any arena mutation, deadline before
+               mutation, cursor/CRC advance and flush before ack.
+  jax-purity   TPU-readiness pass over the jit closure (ops/, parallel/,
+               the jax engine path): host syncs, ambient clock/RNG,
+               Python control flow on traced values, float64-defaulting
+               numpy constructors.
+
+All passes emit the lint engine's Finding shape and share its SARIF
+emitter; escapes are per-pass (``# lint: lock-order-ok`` /
+``protocol-ok`` / ``purity-ok``) and audited for staleness by this
+package's own runner, exactly like the lint engine audits its tokens.
+"""
+
+from scripts.analysis.spec import Spec, load_spec  # noqa: F401
+from scripts.analysis import lockorder, protocolsm, purity  # noqa: F401
+
+__all__ = ["Spec", "load_spec", "lockorder", "protocolsm", "purity"]

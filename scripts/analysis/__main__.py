@@ -1,0 +1,121 @@
+"""CLI for the whole-program analyzer: ``python -m scripts.analysis``.
+
+Runs all three passes (or a ``--pass`` subset), audits this engine's
+escape tokens for staleness, prints findings in the lint engine's
+``path:line: [rule] message`` shape, and exits 1 on any finding — the
+same fail-the-build discipline as ``python -m scripts.lints``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+from scripts.analysis import lockorder, protocolsm, purity
+from scripts.analysis.spec import load_spec
+from scripts.lints.base import REPO, Finding
+
+_PASSES = ("lock-order", "protocol-sm", "jax-purity")
+
+_TOKEN_RE = re.compile(r"#\s*lint:\s*([A-Za-z0-9_-]+)")
+
+
+def _audit_own_escapes(files, token: str, consumed: set) -> list[Finding]:
+    """Stale-escape audit for one pass: every annotation of this pass's
+    token in its scanned files must have suppressed a finding."""
+    out: list[Finding] = []
+    for rel in sorted(files):
+        try:
+            lines = (REPO / rel).read_text().splitlines()
+        except OSError:
+            continue
+        for lineno, text in enumerate(lines, 1):
+            m = _TOKEN_RE.search(text)
+            if m is None or m.group(1) != token:
+                continue
+            if (rel, lineno) not in consumed:
+                out.append(Finding(
+                    "stale-escape", rel, lineno,
+                    f"escape '# lint: {token}' suppresses no finding "
+                    "— remove it (suppressions must not rot)",
+                ))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.analysis",
+        description="whole-program concurrency & contract analyzer "
+                    "(lock-order / protocol-sm / jax-purity)",
+    )
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=_PASSES, default=None,
+                    help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--graph", action="store_true",
+                    help="print the observed lock-order graph and exit")
+    ap.add_argument("--sarif", default=None, metavar="OUT.json",
+                    help="also write findings as SARIF 2.1.0 (shared "
+                         "emitter with scripts.lints)")
+    args = ap.parse_args(argv)
+    passes = tuple(args.passes) if args.passes else _PASSES
+
+    spec = load_spec()
+    findings: list[Finding] = []
+
+    if "lock-order" in passes or args.graph:
+        an = lockorder.LockOrderAnalyzer(spec=spec)
+        findings.extend(an.run())
+        if args.graph:
+            print("observed lock-order graph (held -> acquired):")
+            for line in an.graph_lines():
+                print("  " + line)
+            return 0
+        files = {
+            info.rel for info in an.index.functions.values()
+        }
+        findings.extend(_audit_own_escapes(
+            files, lockorder.SUPPRESS, an.consumed
+        ))
+
+    if "protocol-sm" in passes:
+        ck = protocolsm.ProtocolChecker(spec=spec)
+        findings.extend(ck.run())
+        findings.extend(_audit_own_escapes(
+            set(protocolsm.DEFAULT_ROOTS), protocolsm.SUPPRESS,
+            ck.consumed,
+        ))
+
+    if "jax-purity" in passes:
+        pc = purity.PurityChecker()
+        findings.extend(pc.run())
+        files = {info.rel for info in pc.index.functions.values()}
+        findings.extend(_audit_own_escapes(
+            files, purity.SUPPRESS, pc.consumed
+        ))
+
+    for f in findings:
+        print(f)
+    if args.sarif:
+        from scripts.lints.sarif import write_sarif
+
+        write_sarif(
+            args.sarif, findings, "scripts.analysis",
+            rule_help={
+                "lock-order": "lock acquisition violates the committed "
+                              "rank order (lock_order.toml)",
+                "protocol-sm": "servicer handler diverges from the "
+                               "wire-v2 session lifecycle model",
+                "jax-purity": "jit-reachable code is not trace-pure "
+                              "(host sync / ambient state / promotion)",
+                "stale-escape": "escape annotation suppresses nothing",
+            },
+        )
+        print(f"sarif written: {args.sarif} ({len(findings)} finding(s))")
+    if not findings:
+        print(f"analysis clean ({', '.join(passes)}) over protocol_tpu")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""Whole-program function index + best-effort call resolution.
+
+The per-file lints (``scripts/lints``) are deliberately local: one AST,
+one rule, no knowledge of who calls whom. The analyzer passes need the
+opposite — "which locks does this call acquire, transitively?" and
+"which functions can a jitted kernel reach?" — so this module builds a
+program-wide index of every function/method under the scanned roots and
+resolves call sites through four tiers:
+
+  1. ``self.m()`` / ``cls.m()``: the enclosing class, its indexed bases
+     and subclasses (an overridden method resolves to every override —
+     the analysis is a MAY analysis, over-approximation is the sound
+     direction).
+  2. Receiver patterns from the committed spec (``[receivers]`` in
+     ``lock_order.toml``): ``self.sessions.get(...)`` resolves through
+     ``self.sessions -> SessionFabric``. Subscripts and call parens are
+     stripped first, so ``self.shards[i].evict`` and
+     ``self.shard_of(sid).put`` both type through their base chain.
+  3. Spec ``[callbacks]``: attributes holding dynamically-bound
+     callables (``self._on_evict``) that no AST walk can see.
+  4. Bare names: same-module functions; method names defined by exactly
+     one indexed class resolve there unless the name is on the
+     common-name blacklist (``.get``/``.append``/... would otherwise
+     glue every dict access into the graph).
+
+Unresolved calls are dropped, counted in ``Index.unresolved`` — a MAY
+analysis loses edges there, which is why the load-bearing dynamic edges
+ride the committed callback table instead of a heuristic.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Optional
+
+from scripts.lints.base import REPO, SKIP_PARTS
+
+# names too generic to resolve by uniqueness: builtin-container verbs and
+# logging/string methods that would wire dict/list/str traffic into the
+# call graph as false method edges
+COMMON_NAMES = frozenset({
+    "get", "put", "pop", "popitem", "items", "keys", "values", "append",
+    "add", "update", "copy", "clear", "extend", "remove", "insert",
+    "sort", "reverse", "count", "index", "join", "split", "strip",
+    "startswith", "endswith", "encode", "decode", "format", "read",
+    "write", "close", "open", "flush", "seek", "send", "recv", "abort",
+    "start", "stop", "run", "join", "result", "done", "submit", "group",
+    "match", "search", "info", "warning", "error", "debug", "exception",
+    "acquire", "release", "wait", "notify", "set", "is_set", "locked",
+})
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str  # "rel/path.py::Class.method" (nested: "outer.<locals>.f")
+    name: str
+    rel: str  # repo-relative file
+    class_name: Optional[str]
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    # filled by analysis passes (lockorder summaries etc.)
+    summary: dict = dataclasses.field(default_factory=dict)
+
+
+class Index:
+    """Program-wide function/method index over a set of source roots."""
+
+    def __init__(self, spec=None):
+        self.spec = spec
+        self.functions: dict[str, FunctionInfo] = {}
+        self.by_name: dict[str, list[str]] = {}
+        self.by_class_method: dict[tuple, list[str]] = {}
+        self.class_bases: dict[str, list[str]] = {}
+        self.subclasses: dict[str, set] = {}
+        self.modules: dict[str, dict] = {}  # rel -> {name: qname} top level
+        self.imports: dict[str, dict] = {}  # rel -> {local name: (mod rel, orig)}
+        self.trees: dict[str, ast.Module] = {}
+        self.unresolved = 0
+
+    # ---------------- construction ----------------
+
+    @classmethod
+    def build(cls, roots, spec=None, skip_files=()) -> "Index":
+        idx = cls(spec=spec)
+        for path in iter_python_files(roots):
+            rel = _rel(path)
+            if rel in skip_files:
+                continue
+            try:
+                tree = ast.parse(path.read_text(), filename=str(path))
+            except SyntaxError:
+                continue  # the lint engine reports syntax errors
+            idx._index_module(rel, tree)
+        # subclass closure (single level is enough for this codebase's
+        # flat hierarchies, but walk transitively anyway)
+        for klass, bases in idx.class_bases.items():
+            for base in bases:
+                idx.subclasses.setdefault(base, set()).add(klass)
+        return idx
+
+    def _index_module(self, rel: str, tree: ast.Module) -> None:
+        self.trees[rel] = tree
+        self.modules.setdefault(rel, {})
+        imports = self.imports.setdefault(rel, {})
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mod_rel = node.module.replace(".", "/") + ".py"
+                for a in node.names:
+                    if a.name != "*":
+                        imports[a.asname or a.name] = (mod_rel, a.name)
+
+        def visit(node, class_name, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = (
+                        f"{prefix}{child.name}" if not class_name
+                        else f"{prefix}{class_name}.{child.name}"
+                    )
+                    qname = f"{rel}::{qual}"
+                    info = FunctionInfo(
+                        qname=qname, name=child.name, rel=rel,
+                        class_name=class_name, node=child,
+                    )
+                    self.functions[qname] = info
+                    self.by_name.setdefault(child.name, []).append(qname)
+                    if class_name:
+                        self.by_class_method.setdefault(
+                            (class_name, child.name), []
+                        ).append(qname)
+                    else:
+                        self.modules[rel].setdefault(child.name, qname)
+                    visit(child, None, f"{qual}.<locals>.")
+                elif isinstance(child, ast.ClassDef):
+                    self.class_bases[child.name] = [
+                        b.id for b in child.bases if isinstance(b, ast.Name)
+                    ] + [
+                        b.attr for b in child.bases
+                        if isinstance(b, ast.Attribute)
+                    ]
+                    visit(child, child.name, prefix)
+                else:
+                    visit(child, class_name, prefix)
+
+        visit(tree, None, "")
+
+    # ---------------- class helpers ----------------
+
+    def class_family(self, class_name: str) -> list[str]:
+        """The class, its indexed ancestors, and its indexed
+        descendants — the sound resolution set for a method call on an
+        instance typed only by class name."""
+        seen: list[str] = []
+        frontier = [class_name]
+        while frontier:
+            k = frontier.pop()
+            if k in seen:
+                continue
+            seen.append(k)
+            frontier.extend(self.class_bases.get(k, []))
+            frontier.extend(self.subclasses.get(k, ()))
+        return seen
+
+    def methods_of(self, class_name: str, method: str) -> list[str]:
+        out = []
+        for k in self.class_family(class_name):
+            out.extend(self.by_class_method.get((k, method), []))
+        return out
+
+    # ---------------- call resolution ----------------
+
+    def resolve_call(
+        self, call: ast.Call, caller: FunctionInfo
+    ) -> list[str]:
+        fn = call.func
+        spec = self.spec
+        if isinstance(fn, ast.Name):
+            # committed callback bindings first (safe(fn, ...) shims)
+            if spec is not None and fn.id in spec.callbacks:
+                out = []
+                for target in spec.callbacks[fn.id]:
+                    if "." in target:
+                        klass, meth = target.rsplit(".", 1)
+                        out.extend(
+                            self.by_class_method.get((klass, meth), [])
+                        )
+                    else:
+                        out.extend(self.by_name.get(target, []))
+                return out
+            qname = self.modules.get(caller.rel, {}).get(fn.id)
+            if qname:
+                return [qname]
+            # nested function in the same enclosing scope
+            local = [
+                q for q in self.by_name.get(fn.id, ())
+                if q.startswith(caller.rel + "::")
+            ]
+            if local:
+                return local
+            # cross-module: a bare name bound by `from X import f`
+            imp = self.imports.get(caller.rel, {}).get(fn.id)
+            if imp is not None:
+                mod_rel, orig = imp
+                qname = self.modules.get(mod_rel, {}).get(orig)
+                if qname:
+                    return [qname]
+            self.unresolved += 1
+            return []
+        if not isinstance(fn, ast.Attribute):
+            self.unresolved += 1
+            return []
+        attr = fn.attr
+        pattern = receiver_pattern(fn.value)
+        full_pattern = f"{pattern}.{attr}" if pattern else attr
+        # tier 3: committed callback bindings
+        if spec is not None and full_pattern in spec.callbacks:
+            out = []
+            for target in spec.callbacks[full_pattern]:
+                if "." in target:
+                    klass, meth = target.rsplit(".", 1)
+                    out.extend(self.by_class_method.get((klass, meth), []))
+                else:
+                    out.extend(self.by_name.get(target, []))
+            return out
+        # tier 1: self/cls
+        if pattern in ("self", "cls") and caller.class_name:
+            hits = self.methods_of(caller.class_name, attr)
+            if hits:
+                return hits
+        # tier 2: spec receiver typing
+        if spec is not None:
+            klass = spec.receivers.get(pattern)
+            if klass is not None:
+                hits = self.methods_of(klass, attr)
+                if hits:
+                    return hits
+        # tier 4: unique method name, blacklist-guarded
+        if attr not in COMMON_NAMES:
+            owners = {
+                k for (k, m) in self.by_class_method if m == attr
+            }
+            if len(owners) == 1:
+                return self.by_class_method[(next(iter(owners)), attr)]
+            mods = [
+                q for mod in self.modules.values()
+                for n, q in mod.items() if n == attr
+            ]
+            if not owners and len(mods) == 1:
+                return mods
+        self.unresolved += 1
+        return []
+
+
+def receiver_pattern(expr: ast.AST) -> str:
+    """Normalize a receiver expression to a dotted pattern: subscripts
+    and call parentheses stripped (``self.shards[i]`` -> "self.shards",
+    ``self.shard_of(sid)`` -> "self.shard_of")."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = receiver_pattern(expr.value)
+        return f"{base}.{expr.attr}" if base else expr.attr
+    if isinstance(expr, ast.Subscript):
+        return receiver_pattern(expr.value)
+    if isinstance(expr, ast.Call):
+        return receiver_pattern(expr.func)
+    return ""
+
+
+def _rel(path: pathlib.Path) -> str:
+    resolved = path.resolve()
+    try:
+        return str(resolved.relative_to(REPO))
+    except ValueError:
+        return str(path)
+
+
+def iter_python_files(roots) -> list[pathlib.Path]:
+    out = []
+    for root in roots:
+        p = (
+            pathlib.Path(root)
+            if pathlib.Path(root).is_absolute() else REPO / root
+        )
+        if p.is_file():
+            out.append(p)
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if not SKIP_PARTS.intersection(f.parts):
+                out.append(f)
+    return out

@@ -1,0 +1,20 @@
+"""Seeded DROPPED-LOCK mutation (the seeded marker line is the oracle): a
+``*_locked`` helper — the repo's called-under-lock naming contract —
+invoked with nothing held."""
+
+import threading
+
+
+class SessionStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def sweep(self):
+        self._expire_locked()  # SEED: lock-order
+
+    def sweep_correct(self):
+        with self._lock:
+            self._expire_locked()
+
+    def _expire_locked(self):
+        pass

@@ -1,0 +1,34 @@
+"""Seeded lock-order violations (the seeded marker lines are the
+oracle): the REORDERED-ACQUISITION mutation class — holding the fabric
+budget leaf while entering a shard, directly and through a call chain
+the per-file lint cannot see."""
+
+import threading
+
+
+class SessionStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def evict(self, sid):
+        with self._lock:
+            self._let_go_locked(sid)
+
+    def _let_go_locked(self, sid):
+        pass
+
+
+class SessionFabric:
+    def __init__(self):
+        self._budget_lock = threading.Lock()
+        self.shards = [SessionStore()]
+
+    def pressure_backwards(self, shard):
+        # interprocedural: evict() takes the shard lock three frames in
+        with self._budget_lock:
+            shard.evict("sid")  # SEED: lock-order
+
+    def nested_backwards(self, shard):
+        with self._budget_lock:
+            with shard._lock:  # SEED: lock-order
+                pass

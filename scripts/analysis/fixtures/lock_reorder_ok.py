@@ -1,0 +1,35 @@
+"""Clean twin of lock_reorder_bad.py: the same shapes in SPEC order
+(shard -> budget leaf), so the analyzer must stay silent."""
+
+import threading
+
+
+class SessionStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._on_evict = None
+
+    def evict(self, sid):
+        with self._lock:
+            self._let_go_locked(sid)
+
+    def _let_go_locked(self, sid):
+        if self._on_evict is not None:
+            self._on_evict(sid, "pressure")
+
+
+class SessionFabric:
+    def __init__(self):
+        self._budget_lock = threading.Lock()
+        self.shards = [SessionStore()]
+
+    def _on_store_evict(self, session, reason):
+        # the real callback shape: shard lock held by the caller, only
+        # the budget LEAF taken here
+        with self._budget_lock:
+            pass
+
+    def pressure_forward(self, shard):
+        shard.evict("sid")
+        with self._budget_lock:
+            pass

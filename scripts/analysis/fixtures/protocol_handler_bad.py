@@ -1,0 +1,68 @@
+"""Seeded wire-v2 protocol violations (the seeded marker lines are
+the oracle). Handler shapes mirror the servicer; ``pb``/``unblob`` are
+AST-level stand-ins — the checker never imports fixtures."""
+
+
+class BadServicer:
+    def deadline_after_apply(self, request, context, session):
+        # the PR 9 review-caught mutation: deadline honored after the
+        # delta applied — an abort here double-applies on retry
+        with session.lock:
+            try:
+                rows = unblob(request.provider_rows, None)
+            except ValueError:
+                context.abort(None, "bad frame")
+            session.apply_delta(rows, {}, rows, {})
+            self._check_deadline(context, "delta")  # SEED: protocol-sm
+            session.tick += 1
+            session.last_delta_crc = 7
+            return pb.AssignDeltaResponse(session_ok=True)
+
+    def unmarked_refusal(self, request, session):
+        if session.evicted:
+            return pb.AssignDeltaResponse(  # SEED: protocol-sm
+                session_ok=False, error="nope, try later",
+            )
+        session.tick += 1
+        session.last_delta_crc = 1
+        return pb.AssignDeltaResponse(session_ok=True)
+
+    def computed_refusal(self, request, session):
+        msg = "over quota"
+        session.tick += 1
+        session.last_delta_crc = 5
+        if session.evicted:
+            return pb.AssignDeltaResponse(session_ok=False, error=msg)  # SEED: protocol-sm
+        return pb.AssignDeltaResponse(session_ok=True)
+
+    def ack_before_crc(self, request, session):
+        if request.tick == 0:
+            return pb.AssignDeltaResponse(session_ok=True)  # SEED: protocol-sm
+        session.last_delta_crc = 9
+        return pb.AssignDeltaResponse(session_ok=True)
+
+    def flush_after_ack(self, request, session):
+        session.tick += 1
+        session.last_delta_crc = 3
+        try:
+            return pb.AssignDeltaResponse(session_ok=True)
+        finally:
+            self.ckpt.flush_locked(session)  # SEED: protocol-sm
+
+    def decode_after_mutation(self, request, session):
+        session.apply_delta(None, {}, None, {})
+        try:
+            rows = unblob(request.provider_rows, None)  # SEED: protocol-sm
+        except ValueError:
+            rows = None
+        del rows
+        session.tick += 1
+        session.last_delta_crc = 2
+        return pb.AssignDeltaResponse(session_ok=True)
+
+    def unhardened_decode(self, request, session):
+        rows = unblob(request.provider_rows, None)  # SEED: protocol-sm
+        session.apply_delta(rows, {}, rows, {})
+        session.tick += 1
+        session.last_delta_crc = 4
+        return pb.AssignDeltaResponse(session_ok=True)

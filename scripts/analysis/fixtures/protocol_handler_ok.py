@@ -1,0 +1,38 @@
+"""Clean twin of protocol_handler_bad.py: the lifecycle implemented to
+spec — hardened decode, deadline before mutation, cursor/CRC advance
+and flush before ack, marker-carrying refusals. The checker must stay
+silent."""
+
+
+class GoodServicer:
+    def assign_delta(self, request, context, session):
+        if not self.admission.admit("t"):
+            return pb.AssignDeltaResponse(
+                session_ok=False,
+                error="RESOURCE_EXHAUSTED: tenant over admission rate",
+            )
+        found, reason = self.sessions.get(request.session_id, request.fp)
+        if found is None:
+            return pb.AssignDeltaResponse(session_ok=False, error=reason)
+        with session.lock:
+            if session.evicted:
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error="session evicted"
+                )
+            try:
+                rows = unblob(request.provider_rows, None)
+            except ValueError as e:
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error=str(e)
+                )
+            if int(request.tick) != session.tick + 1:
+                return pb.AssignDeltaResponse(
+                    session_ok=False,
+                    error=f"tick cursor mismatch (have {session.tick})",
+                )
+            self._check_deadline(context, "delta")
+            session.apply_delta(rows, {}, rows, {})
+            session.tick += 1
+            session.last_delta_crc = 11
+            self.ckpt.flush_locked(session)
+            return pb.AssignDeltaResponse(session_ok=True)

@@ -1,0 +1,70 @@
+"""Seeded JAX purity violations (the seeded marker lines are the
+oracle): the HOST-SYNC-IN-JIT mutation class plus each of the other
+purity rules — ambient clock/RNG, Python branching on traced values,
+float64-defaulting numpy constructors, and an interprocedural host sync
+reached through a helper."""
+
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_item(cost):
+    total = cost.sum()
+    return float(total.item())  # SEED: jax-purity
+
+
+@jax.jit
+def bad_host_asarray(cost):
+    return np.asarray(cost)  # SEED: jax-purity
+
+
+@jax.jit
+def bad_clock(cost):
+    return cost * time.time()  # SEED: jax-purity
+
+
+@jax.jit
+def bad_rng(cost):
+    return cost + np.random.rand(3)  # SEED: jax-purity
+
+
+@jax.jit
+def bad_branch(cost, eps):
+    if eps > 0:  # SEED: jax-purity
+        cost = cost / eps
+    return cost
+
+
+@jax.jit
+def bad_promote(cost):
+    return cost + np.zeros(4)  # SEED: jax-purity
+
+
+def helper_sync(x):
+    return x.tolist()  # SEED: jax-purity
+
+
+@jax.jit
+def bad_via_helper(cost):
+    return helper_sync(cost)
+
+
+@jax.jit
+def bad_sync_in_loop(cost):
+    out = []
+    for _ in range(2):
+        out.append(cost.item())  # SEED: jax-purity
+    return out
+
+
+@jax.jit
+def bad_branch_in_try(cost, eps):
+    try:
+        if eps > 0:  # SEED: jax-purity
+            cost = cost / eps
+    finally:
+        pass
+    return cost

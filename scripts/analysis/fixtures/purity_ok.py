@@ -1,0 +1,44 @@
+"""Clean twin of purity_bad.py: the legal idioms — shape/dtype
+specialization, is-None defaults, static_argnames branching, dtype-
+pinned constructors, lax control flow — must produce zero findings."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def ok_shape_branch(cost, task_order=None):
+    P, T = cost.shape
+    if task_order is None:
+        task_order = jnp.arange(T, dtype=jnp.int32)
+    if cost.ndim != 2:
+        raise ValueError("cost must be [P, T]")
+
+    def step(avail, col):
+        masked = jnp.where(avail, col, 1e9)
+        p = jnp.argmin(masked).astype(jnp.int32)
+        return avail.at[p].set(False), p
+
+    _, picks = lax.scan(step, jnp.ones(P, dtype=bool), cost.T)
+    return picks[task_order]
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def ok_static_branch(cost, tile=128):
+    if tile <= 0:
+        raise ValueError("tile must be positive")
+    pinned = np.zeros(4, np.float32)  # dtype pinned: no promotion
+    return cost + jnp.asarray(pinned)
+
+
+def ok_helper(x):
+    return jnp.maximum(x, 0.0)
+
+
+@jax.jit
+def ok_via_helper(cost):
+    return ok_helper(cost)

@@ -1,0 +1,452 @@
+"""Interprocedural lock-order analysis over the whole package.
+
+The per-file lock-discipline lint answers "is this guarded field read
+under *a* lock?"; it is structurally blind to the bug class PR 8/9 shipped
+— code that holds the RIGHT lock while acquiring another one in the
+WRONG order. This pass builds the whole-program lock-order graph:
+
+  1. Every function body is walked lexically, tracking the multiset of
+     lock DOMAINS held at each point (``with <lock>`` items, in item
+     order; bare ``.acquire()``/``.release()`` pairs tracked linearly
+     through the statement list — the test-harness idiom).
+  2. Lock expressions classify to domains through the committed spec
+     (``lock_order.toml`` ``[classify]``/``[classify_class]``); a
+     lock-shaped expression the spec cannot name is itself a finding —
+     the spec must stay total over the tree.
+  3. Call sites resolve through :mod:`scripts.analysis.callgraph`
+     (including the ``*_locked`` helpers and the spec's callback
+     bindings), and a fixpoint computes each function's transitive
+     acquisition summary — so "holds shard, calls a helper three frames
+     above a budget-lock acquire" produces the shard->budget edge at the
+     *call site*.
+  4. Every edge (held-domain -> acquired-domain) must be strictly
+     rank-ascending per the spec; equal ranks never nest (shard/session
+     self-nesting), reentrant domains may re-enter. The aggregate graph
+     is also cycle-checked — belt and braces over the rank table itself.
+
+Two further rules ride the same walk:
+
+  * ``lock-dropped``: a ``*_locked``-suffixed helper (the repo's
+    called-under-lock naming contract) invoked on a path where the
+    caller provably holds nothing — the "dropped lock" bug class.
+  * ``lock-unclassified``: a with-item/acquire on a lock-shaped
+    expression the spec has no domain for.
+
+Findings use the lint engine's Finding shape; escapes:
+``# lint: lock-order-ok`` on the acquisition/call line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from scripts.analysis.callgraph import (
+    FunctionInfo,
+    Index,
+    receiver_pattern,
+)
+from scripts.analysis.spec import Spec, load_spec
+from scripts.lints.base import Finding
+
+RULE = "lock-order"
+SUPPRESS = "lock-order-ok"
+
+DEFAULT_ROOTS = ("protocol_tpu",)
+
+# functions that run before the object is shared: lock acquisition
+# inside them cannot order against anything
+EXEMPT_FUNCS = {"__init__", "__post_init__"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    held: str
+    acquired: str
+    rel: str
+    line: int
+    via: str  # "acquire" or the callee qname for propagated edges
+
+
+def _is_lock_shaped(expr: ast.AST) -> bool:
+    """with-item / receiver shapes that denote a lock object. Calls are
+    NOT unwrapped: ``threading.Lock()`` is a constructor and
+    ``_tracer.span(...)`` a context manager — acquisition is only ever
+    spelled as a bare name or attribute here."""
+    if isinstance(expr, ast.Attribute):
+        return "lock" in expr.attr.lower()
+    if isinstance(expr, ast.Name):
+        return "lock" in expr.id.lower()
+    return False
+
+
+def _lock_attr_name(expr: ast.AST) -> str:
+    return expr.attr if isinstance(expr, ast.Attribute) else expr.id
+
+
+class _FunctionScan:
+    """One function's lexical walk: acquisition events, call events, and
+    the held-domain stack at each."""
+
+    def __init__(self, info: FunctionInfo, analyzer: "LockOrderAnalyzer"):
+        self.info = info
+        self.an = analyzer
+        self.acquires: list = []  # (held tuple, domain, node)
+        self.calls: list = []  # (held tuple, call node)
+        self.unclassified: list = []  # lock-shaped but spec-less
+
+    def scan(self) -> None:
+        node = self.info.node
+        body = getattr(node, "body", None)
+        if body is None:
+            return
+        self._block(body, [])
+
+    # ---- classification ----
+
+    def _domain_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _lock_attr_name(expr)
+        # module-scoped override first (locks touched from module-level
+        # closures where no class context exists)
+        dom = self.an.spec.classify_class.get(f"{self.info.rel}:{attr}")
+        if dom is not None:
+            return dom
+        class_ctx: Optional[str] = None
+        if isinstance(expr, ast.Attribute):
+            pattern = receiver_pattern(expr.value)
+            if pattern in ("self", "cls"):
+                class_ctx = self.info.class_name
+            else:
+                class_ctx = self.an.spec.receivers.get(pattern)
+        return self.an.spec.domain_of(attr, class_ctx)
+
+    # ---- lexical walk ----
+
+    def _block(self, stmts, held: list) -> None:
+        # a linear pass so bare .acquire()/.release() extend the held
+        # set for the *following* statements of the same block
+        local_held = list(held)
+        for st in stmts:
+            self._stmt(st, local_held)
+
+    def _stmt(self, st: ast.AST, held: list) -> None:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in st.items:
+                ctx = item.context_expr
+                if _is_lock_shaped(ctx):
+                    self._acquire(ctx, inner)
+                else:
+                    self._exprs(ctx, inner)
+            self._block(st.body, inner)
+            return
+        if isinstance(
+            st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested defs run later, with their own entry state
+        if isinstance(st, ast.Try):
+            self._block(st.body, held)
+            for h in st.handlers:
+                self._block(h.body, held)
+            self._block(st.orelse, held)
+            self._block(st.finalbody, held)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._exprs(st.test, held)
+            self._block(st.body, held)
+            self._block(st.orelse, held)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._exprs(st.iter, held)
+            self._block(st.body, held)
+            self._block(st.orelse, held)
+            return
+        # expression statements / assigns / returns: look for bare
+        # acquire/release and ordinary calls
+        self._exprs(st, held, allow_acquire=True)
+
+    def _exprs(self, node: ast.AST, held: list, allow_acquire=False) -> None:
+        # manual traversal so nested defs/lambdas are PRUNED (their
+        # bodies run later, with their own entry state), unlike ast.walk
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue  # deferred execution: separate entry state
+            stack.extend(ast.iter_child_nodes(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("acquire", "release")
+                and _is_lock_shaped(fn.value)
+            ):
+                if fn.attr == "acquire" and allow_acquire:
+                    dom = self._acquire(fn.value, held, push=True)
+                    del dom
+                elif fn.attr == "release":
+                    dom = self._domain_of(fn.value)
+                    if dom is not None and dom in held:
+                        # linear model: release drops the most recent
+                        for i in range(len(held) - 1, -1, -1):
+                            if held[i] == dom:
+                                del held[i]
+                                break
+                continue
+            self.calls.append((tuple(held), sub))
+
+    def _acquire(self, expr, held: list, push: bool = True):
+        dom = self._domain_of(expr)
+        if dom is None:
+            self.unclassified.append(expr)
+            return None
+        self.acquires.append((tuple(held), dom, expr))
+        if push:
+            held.append(dom)
+        return dom
+
+
+class LockOrderAnalyzer:
+    def __init__(
+        self, roots=DEFAULT_ROOTS, spec: Optional[Spec] = None,
+        index: Optional[Index] = None,
+    ):
+        self.spec = spec if spec is not None else load_spec()
+        self.index = (
+            index if index is not None
+            else Index.build(
+                roots, spec=self.spec, skip_files=self.spec.skip_files
+            )
+        )
+        self.scans: dict[str, _FunctionScan] = {}
+        self.edges: list[Edge] = []
+        self.findings: list[Finding] = []
+        self.consumed: set = set()  # (rel, line) escapes that fired
+        self._line_cache: dict[str, list] = {}
+
+    # ---------------- pipeline ----------------
+
+    def run(self) -> list[Finding]:
+        for qname, info in self.index.functions.items():
+            scan = _FunctionScan(info, self)
+            scan.scan()
+            self.scans[qname] = scan
+            for expr in scan.unclassified:
+                self._find(
+                    info, expr, RULE,
+                    f"lock-shaped expression "
+                    f"{ast.unparse(expr)!r} has no domain in "
+                    "lock_order.toml — the spec must stay total",
+                )
+        summaries = self._fixpoint()
+        self._emit_edges(summaries)
+        self._check_edges()
+        self._check_dropped(summaries)
+        self._check_cycles()
+        return self.findings
+
+    # ---------------- summaries ----------------
+
+    def _fixpoint(self) -> dict[str, frozenset]:
+        """qname -> domains the function may acquire, transitively."""
+        summaries = {
+            q: frozenset(d for _, d, _ in s.acquires)
+            for q, s in self.scans.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for qname, scan in self.scans.items():
+                acc = set(summaries[qname])
+                before = len(acc)
+                for _, call in scan.calls:
+                    for callee in self.index.resolve_call(
+                        call, scan.info
+                    ):
+                        acc |= summaries.get(callee, frozenset())
+                if len(acc) != before:
+                    summaries[qname] = frozenset(acc)
+                    changed = True
+        return summaries
+
+    def _emit_edges(self, summaries) -> None:
+        for qname, scan in self.scans.items():
+            info = scan.info
+            if info.name in EXEMPT_FUNCS:
+                continue
+            for held, dom, node in scan.acquires:
+                for h in held:
+                    self.edges.append(Edge(
+                        h, dom, info.rel, node.lineno, "acquire"
+                    ))
+            for held, call in scan.calls:
+                if not held:
+                    continue
+                for callee in self.index.resolve_call(call, info):
+                    for dom in summaries.get(callee, ()):
+                        for h in held:
+                            self.edges.append(Edge(
+                                h, dom, info.rel, call.lineno, callee
+                            ))
+
+    # ---------------- checks ----------------
+
+    def _check_edges(self) -> None:
+        ranks = self.spec.ranks
+        reentrant = set(self.spec.reentrant)
+        seen = set()
+        for e in self.edges:
+            key = (e.held, e.acquired, e.rel, e.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            if e.held == e.acquired:
+                if e.acquired in reentrant:
+                    continue
+                why = (
+                    f"domain {e.acquired!r} nests itself "
+                    f"({'direct' if e.via == 'acquire' else 'via ' + e.via})"
+                    " — these locks never nest"
+                )
+            elif ranks.get(e.acquired, 0) > ranks.get(e.held, 0):
+                continue
+            else:
+                why = (
+                    f"acquires {e.acquired!r} "
+                    f"(rank {ranks.get(e.acquired, 0)}) while holding "
+                    f"{e.held!r} (rank {ranks.get(e.held, 0)})"
+                    + (
+                        "" if e.via == "acquire"
+                        else f" via {e.via}"
+                    )
+                    + " — violates the committed order "
+                    "(scripts/analysis/lock_order.toml)"
+                )
+            self._find_at(e.rel, e.line, RULE, why)
+
+    def _check_dropped(self, summaries) -> None:
+        """A ``*_locked`` helper reached with nothing held: the caller
+        dropped the lock the naming contract promises."""
+        for qname, scan in self.scans.items():
+            info = scan.info
+            if (
+                info.name.endswith("_locked")
+                or info.name in EXEMPT_FUNCS
+            ):
+                continue  # the contract passes through / not yet shared
+            for held, call in scan.calls:
+                if held:
+                    continue
+                fn = call.func
+                callee_name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if not callee_name.endswith("_locked"):
+                    continue
+                self._find_at(
+                    info.rel, call.lineno, RULE,
+                    f"{callee_name}() called with no lock held — the "
+                    "_locked suffix is the called-under-lock contract",
+                )
+
+    def _check_cycles(self) -> None:
+        graph: dict[str, set] = {}
+        site: dict[tuple, Edge] = {}
+        for e in self.edges:
+            if e.held != e.acquired:
+                graph.setdefault(e.held, set()).add(e.acquired)
+                site.setdefault((e.held, e.acquired), e)
+        # iterative DFS cycle detection over the domain graph
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {d: WHITE for d in graph}
+        stack_path: list[str] = []
+
+        def dfs(start: str) -> Optional[list]:
+            stack = [(start, iter(graph.get(start, ())))]
+            color[start] = GRAY
+            stack_path.append(start)
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    c = color.get(nxt, WHITE)
+                    if c == GRAY:
+                        return stack_path[stack_path.index(nxt):] + [nxt]
+                    if c == WHITE:
+                        color[nxt] = GRAY
+                        stack_path.append(nxt)
+                        stack.append((nxt, iter(graph.get(nxt, ()))))
+                        advanced = True
+                        break
+                if not advanced:
+                    stack.pop()
+                    stack_path.pop()
+                    color[node] = BLACK
+            return None
+
+        for d in sorted(graph):
+            if color.get(d, 0) == WHITE:
+                cyc = dfs(d)
+                if cyc:
+                    e = site.get((cyc[0], cyc[1]))
+                    self._find_at(
+                        e.rel if e else "scripts/analysis/lock_order.toml",
+                        e.line if e else 0, RULE,
+                        "lock-order CYCLE (potential deadlock): "
+                        + " -> ".join(cyc),
+                    )
+                    return
+
+    # ---------------- reporting ----------------
+
+    def _find(self, info: FunctionInfo, node, rule, msg) -> None:
+        self._find_at(info.rel, getattr(node, "lineno", 0), rule, msg)
+
+    def _find_at(self, rel: str, line: int, rule: str, msg: str) -> None:
+        if self._suppressed(rel, line):
+            return
+        self.findings.append(Finding(rule, rel, line, msg))
+
+    def _suppressed(self, rel: str, line: int) -> bool:
+        tree_lines = self._lines(rel)
+        if tree_lines and 1 <= line <= len(tree_lines):
+            # own token only: blanket "lint: ok" stays a lint-engine
+            # concept (its audit owns that token's staleness)
+            if f"lint: {SUPPRESS}" in tree_lines[line - 1]:
+                self.consumed.add((rel, line))
+                return True
+        return False
+
+    def _lines(self, rel: str):
+        if rel not in self._line_cache:
+            from scripts.lints.base import REPO
+
+            try:
+                self._line_cache[rel] = (
+                    (REPO / rel).read_text().splitlines()
+                )
+            except OSError:
+                self._line_cache[rel] = []
+        return self._line_cache[rel]
+
+    # ---------------- reporting helpers for the CLI ----------------
+
+    def graph_lines(self) -> list[str]:
+        """Deduplicated ``held -> acquired`` edges with one example
+        site each — the committed graph the docs cite."""
+        best: dict[tuple, Edge] = {}
+        for e in self.edges:
+            best.setdefault((e.held, e.acquired), e)
+        out = []
+        for (h, a), e in sorted(best.items()):
+            out.append(f"{h:12s} -> {a:12s}  ({e.rel}:{e.line})")
+        return out
+
+
+def run(roots=DEFAULT_ROOTS, spec=None, index=None) -> list[Finding]:
+    return LockOrderAnalyzer(roots, spec=spec, index=index).run()

@@ -1,0 +1,393 @@
+"""Session-protocol state-machine checker for the wire-v2 lifecycle.
+
+The wire-v2 session protocol is a state machine the servicer implements
+by hand (scheduler_grpc.py) and the client ladder dispatches on by
+error-string markers. Nothing machine-checks that the handler code still
+implements the model — this pass does, against the committed lifecycle
+below and the ``[protocol]`` marker table in ``lock_order.toml``.
+
+The model (states x transitions; OUTCOME names match the seam counters)::
+
+    CLOSED  --OpenSession ok-->                       WARM  (tick 0 ackd)
+    CLOSED  --OpenSession refused (capability)-->     UNARY (ladder demoted)
+    CLOSED  --OpenSession refused (throttle/drain)--> CLOSED (retry/degrade)
+    WARM    --AssignDelta tick==cursor+1 ok-->        WARM  (cursor+1, ackd)
+    WARM    --AssignDelta tick==cursor, crc match-->  WARM  (replayed ack)
+    WARM    --AssignDelta refused (throttle)-->       WARM  (retry in place)
+    WARM    --AssignDelta refused (mismatch/evict)--> CLOSED (re-open)
+    WARM    --evict/ttl/drop-->                       CLOSED
+    WARM    --crash + checkpoint restore-->           WARM  (cursor kept)
+
+What the checker enforces on every handler function (a function
+returning a ``pb.*Response`` carrying ``ok=``/``session_ok=``):
+
+  R1 ladder-recognizable refusals: every ``ok=False`` return's error
+     text must carry one of the committed ladder markers — the client
+     dispatches on these substrings; an unrecognized refusal is treated
+     as transient forever (the silent-retry-loop bug). Non-literal
+     errors are allowed only in decode-hardening except-blocks (the
+     transient rung by design) or when bound from a store lookup's
+     refusal reason (whose strings the store owns).
+
+  R2 decode-hardening precedes arena mutation: every decode call
+     (``assemble_snapshot``/``decode_*_v2``/``unblob``) must sit inside
+     a try that catches ``ValueError``, and every decode must lexically
+     precede the first session mutation (``apply_delta``/``solve``/
+     ``put``) — a handler that moves state before the frame is proven
+     well-formed can be desynced by one corrupt byte.
+
+  R3 deadline before mutation: a handler that mutates session state and
+     consults the RPC deadline must do so BEFORE the first mutation —
+     aborting after ``apply_delta`` but before the ack lets the client's
+     retry double-apply the tick (the exact PR 9 review catch).
+
+  R4 cursor/CRC advance before ack: on the delta ack path, the tick
+     cursor advance and the retransmit-CRC store must precede the
+     ``session_ok=True`` return (and the checkpoint flush, when
+     configured, sits between them) — an ack before the cursor moved
+     breaks exactly-once delta application across crash/retry. On the
+     open path, the session must be published (``put``) before the ack.
+
+Escape: ``# lint: protocol-ok`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from scripts.analysis.spec import Spec, load_spec
+from scripts.lints.base import Finding, Source, iter_files
+
+RULE = "protocol-sm"
+SUPPRESS = "protocol-ok"
+
+# servicer files the checker scans by default (fixtures are passed
+# explicitly by the tests)
+DEFAULT_ROOTS = ("protocol_tpu/services/scheduler_grpc.py",)
+
+DECODE_FNS = {
+    "assemble_snapshot", "decode_providers_v2", "decode_requirements_v2",
+    "unblob",
+}
+MUTATION_FNS = {"apply_delta", "solve", "put"}
+DEADLINE_FNS = {"_check_deadline"}
+FLUSH_FNS = {"flush_locked"}
+CURSOR_ATTRS = {"tick"}
+CRC_ATTRS = {"last_delta_crc"}
+
+
+@dataclasses.dataclass
+class _Return:
+    node: ast.Return
+    ok: bool
+    replayed: bool
+    error: ast.AST  # the error= keyword value (None if absent)
+
+
+def _literal_text(node: ast.AST) -> Optional[str]:
+    """Best-effort constant text of an error expression: plain strings,
+    f-strings (all constant parts), and +-concatenation."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = [
+            v.value for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ]
+        return "".join(parts) if parts else None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_text(node.left)
+        right = _literal_text(node.right)
+        if left is not None or right is not None:
+            return (left or "") + (right or "")
+    return None
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+class _HandlerScan(ast.NodeVisitor):
+    """Collect the protocol events of one handler function, in lexical
+    (== straight-line execution) order."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.returns: list[_Return] = []
+        self.decodes: list = []  # (node, hardened: bool)
+        self.mutations: list = []
+        self.deadline_checks: list = []
+        self.flushes: list = []
+        self.cursor_advances: list = []
+        self.crc_stores: list = []
+        self.puts: list = []
+        self._try_depth: list = []  # stack of "catches ValueError" flags
+        for st in fn.body:
+            self.visit(st)
+
+    # -- structure --
+
+    def visit_Try(self, node: ast.Try) -> None:
+        catches = any(
+            h.type is None
+            or ("ValueError" in ast.unparse(h.type))
+            or ("Exception" in ast.unparse(h.type))
+            for h in node.handlers
+        )
+        self._try_depth.append(catches)
+        for st in node.body:
+            self.visit(st)
+        self._try_depth.pop()
+        for h in node.handlers:
+            for st in h.body:
+                self.visit(st)
+        for st in node.orelse + node.finalbody:
+            self.visit(st)
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs are their own handlers (or not handlers)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass
+
+    # -- events --
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        if name in DECODE_FNS:
+            self.decodes.append((node, any(self._try_depth)))
+        elif name in MUTATION_FNS:
+            if name == "put":
+                self.puts.append(node)
+            self.mutations.append(node)
+        elif name in DEADLINE_FNS or "deadline" in name:
+            self.deadline_checks.append(node)
+        elif name in FLUSH_FNS:
+            self.flushes.append(node)
+        self.generic_visit(node)
+
+    def _attr_store(self, target: ast.AST, node: ast.AST) -> None:
+        if not isinstance(target, ast.Attribute):
+            return
+        if target.attr in CURSOR_ATTRS:
+            self.cursor_advances.append(node)
+        elif target.attr in CRC_ATTRS:
+            self.crc_stores.append(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._attr_store(t, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._attr_store(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        val = node.value
+        if isinstance(val, ast.Call):
+            kws = {
+                k.arg: k.value for k in val.keywords if k.arg is not None
+            }
+            ok_kw = kws.get("ok", kws.get("session_ok"))
+            if ok_kw is not None and isinstance(ok_kw, ast.Constant):
+                replayed = isinstance(
+                    kws.get("replayed"), ast.Constant
+                ) and bool(kws["replayed"].value)
+                self.returns.append(_Return(
+                    node, bool(ok_kw.value), replayed, kws.get("error")
+                ))
+        self.generic_visit(node)
+
+
+def _reason_names(fn: ast.AST) -> set[str]:
+    """Names tuple-bound from a ``.get(...)`` store lookup — the store
+    owns those refusal strings (R1's third allowed shape)."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        if _call_name(node.value) != "get":
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Tuple):
+                out |= {
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                }
+    return out
+
+
+class ProtocolChecker:
+    def __init__(self, roots=DEFAULT_ROOTS, spec: Optional[Spec] = None):
+        self.roots = roots
+        self.spec = spec if spec is not None else load_spec()
+        self.findings: list[Finding] = []
+        self.consumed: set = set()  # (rel, line) escapes that fired
+
+    def run(self) -> list[Finding]:
+        for path in iter_files(self.roots):
+            try:
+                src = Source(path)
+            except SyntaxError:
+                continue  # the lint engine owns syntax reporting
+            self.check_source(src)
+        return self.findings
+
+    # ---------------- per-file ----------------
+
+    def check_source(self, src: Source) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            scan = _HandlerScan(node)
+            if not scan.returns:
+                continue  # not a protocol handler
+            self._check_handler(src, node, scan)
+
+    def _check_handler(self, src: Source, fn, scan: _HandlerScan) -> None:
+        markers = self.spec.ladder_markers
+        reason_ok = _reason_names(fn)
+
+        # R1: ladder-recognizable refusal text
+        for ret in scan.returns:
+            if ret.ok:
+                continue
+            text = _literal_text(ret.error)
+            if text is not None:
+                if not any(m in text for m in markers):
+                    self._find(
+                        src, ret.node,
+                        f"refusal error {text[:48]!r} carries no "
+                        "ladder marker — the client will treat it as "
+                        "transient forever (markers: "
+                        f"{', '.join(markers[:3])}, ...)",
+                    )
+                continue
+            if ret.error is None:
+                self._find(
+                    src, ret.node,
+                    "refusal with no error text — the ladder cannot "
+                    "classify it",
+                )
+                continue
+            in_handler = self._inside_except(src, ret.node)
+            is_reason = (
+                isinstance(ret.error, ast.Name)
+                and ret.error.id in reason_ok
+            )
+            if not in_handler and not is_reason:
+                self._find(
+                    src, ret.node,
+                    "refusal error is computed "
+                    f"({ast.unparse(ret.error)!r}) outside a decode "
+                    "except-block and not a store-lookup reason — "
+                    "the ladder cannot rely on its markers",
+                )
+
+        # R2: decode hardening + decode-before-mutation
+        first_mut = min(
+            (m.lineno for m in scan.mutations), default=None
+        )
+        for node, hardened in scan.decodes:
+            if not hardened:
+                self._find(
+                    src, node,
+                    f"decode call {_call_name(node)}() outside a "
+                    "ValueError-hardened try — a corrupt frame becomes "
+                    "an unhandled exception mid-handler",
+                )
+            if first_mut is not None and node.lineno > first_mut:
+                self._find(
+                    src, node,
+                    f"decode call {_call_name(node)}() after session "
+                    f"state moved (line {first_mut}) — hardening must "
+                    "precede any mutation",
+                )
+
+        # R3: deadline before mutation
+        if scan.mutations and scan.deadline_checks:
+            for node in scan.deadline_checks:
+                if node.lineno > first_mut:
+                    self._find(
+                        src, node,
+                        "deadline honored AFTER session state moved "
+                        f"(first mutation line {first_mut}) — an abort "
+                        "here lets the client's retry double-apply "
+                        "the tick",
+                    )
+
+        # R4: cursor/CRC advance (and flush/publish) before ack
+        acks = [
+            r for r in scan.returns if r.ok and not r.replayed
+        ]
+        for ret in acks:
+            line = ret.node.lineno
+            if scan.crc_stores and not any(
+                n.lineno < line for n in scan.crc_stores
+            ):
+                self._find(
+                    src, ret.node,
+                    "ack before the retransmit-CRC store — a replayed "
+                    "delta would re-apply instead of deduping",
+                )
+            if scan.cursor_advances and scan.crc_stores and not any(
+                n.lineno < line for n in scan.cursor_advances
+            ):
+                self._find(
+                    src, ret.node,
+                    "ack before the tick-cursor advance — the client "
+                    "and server cursors diverge on the next delta",
+                )
+            for fl in scan.flushes:
+                if fl.lineno > line:
+                    self._find(
+                        src, fl,
+                        "checkpoint flush AFTER the ack return — a "
+                        "crash between them loses an acknowledged tick "
+                        "(flush-before-ack is the recovery contract)",
+                    )
+            if scan.puts and scan.decodes and not any(
+                p.lineno < line for p in scan.puts
+            ):
+                self._find(
+                    src, ret.node,
+                    "ack before the session is published to the store "
+                    "— the first delta would refuse with unknown "
+                    "session",
+                )
+
+    # ---------------- helpers ----------------
+
+    @staticmethod
+    def _inside_except(src: Source, node: ast.AST) -> bool:
+        return any(
+            isinstance(anc, ast.ExceptHandler)
+            for anc in src.ancestors(node)
+        )
+
+    def _find(self, src: Source, node, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(src.lines):
+            if f"lint: {SUPPRESS}" in src.lines[line - 1]:
+                self.consumed.add((src.rel, line))
+                return
+        self.findings.append(Finding(RULE, src.rel, line, msg))
+
+
+def run(roots=DEFAULT_ROOTS, spec=None) -> list[Finding]:
+    return ProtocolChecker(roots, spec=spec).run()

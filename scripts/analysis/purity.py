@@ -1,0 +1,410 @@
+"""JAX/TPU-readiness purity pass over the jit-compiled paths.
+
+ROADMAP #5 makes the jax engine a first-class backend again, and the
+whole premise of "TPU day is a flag flip" is that the jitted code is
+trace-pure TODAY: no host syncs, no wall-clock or RNG inside a traced
+region, no Python control flow on traced values, no silent float64
+promotion sneaking in through numpy defaults. On CPU these bugs cost a
+little; on a real TPU every one is either a compile error or a
+device-to-host round-trip that erases the point of the hardware.
+
+The pass finds every jit entry point (``@jax.jit``, ``@partial(jax.jit,
+static_argnames=...)``) under the jax roots (``ops/``, ``parallel/``,
+and the jax engine path in ``sched/tpu_backend.py``), closes over the
+call graph to every reachable helper, and checks the closure:
+
+  P1 host sync: ``.item()`` / ``.tolist()`` / ``.block_until_ready()``,
+     and ``np.asarray``/``np.array`` applied to a traced value — each
+     forces a device sync inside the traced region (TracerArray
+     conversion error on TPU, silent round-trip under jit-of-CPU).
+
+  P2 ambient impurity: ``time.*`` / ``random.*`` / ``np.random.*``
+     calls inside the jit closure — traced once at compile time, then
+     frozen: the jitted function replays the FIRST call's value forever
+     (the classic "why is my jitter constant" bug).
+
+  P3 Python control flow on traced values: an ``if``/``while`` whose
+     test reads a traced parameter forces a concrete bool mid-trace.
+     Static shape/dtype probing (``.shape``/``.ndim``/``.dtype``/
+     ``.size``, ``is None`` checks, ``isinstance``) is legal and
+     whitelisted — that is how kernels specialize per shape.
+
+  P4 implicit dtype promotion: numpy array constructors without an
+     explicit ``dtype=`` inside the closure (``np.zeros``/``np.ones``/
+     ``np.full``/``np.arange``/``np.empty``/``np.linspace``) default to
+     float64/int64 — mixed into a traced op they either promote the
+     whole expression or silently truncate under x64-off, and the wire
+     dtype contract is f32/i32.
+
+Taint is deliberately coarse: inside a jit entry, every parameter not
+named in ``static_argnames`` is traced; assignments propagate taint
+lexically; helpers reached from jitted code treat ALL their parameters
+as traced (a MAY analysis — the sound direction). Escape:
+``# lint: purity-ok`` on the line, for values that are genuinely static
+at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from scripts.analysis.callgraph import Index, receiver_pattern
+from scripts.lints.base import Finding, REPO
+
+RULE = "jax-purity"
+SUPPRESS = "purity-ok"
+
+DEFAULT_ROOTS = (
+    "protocol_tpu/ops",
+    "protocol_tpu/parallel",
+    "protocol_tpu/sched/tpu_backend.py",
+)
+
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+NP_SYNC_FNS = {"asarray", "array"}
+NP_PROMOTING_FNS = {
+    "zeros", "ones", "full", "arange", "empty", "linspace",
+}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "at"}
+
+
+def _jit_static_argnames(dec: ast.AST) -> Optional[tuple]:
+    """If ``dec`` is a jit decorator, return its static_argnames tuple
+    (possibly empty); else None."""
+    # @jax.jit / @jit
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return ()
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return ()
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        # @partial(jax.jit, static_argnames=(...)) / @jax.jit(...)
+        is_partial = (
+            isinstance(fn, ast.Name) and fn.id == "partial"
+            or isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        )
+        if is_partial:
+            if not dec.args or _jit_static_argnames(dec.args[0]) is None:
+                return None
+        elif _jit_static_argnames(fn) is None:
+            return None
+        names: list = []
+        for kw in dec.keywords:
+            if kw.arg in ("static_argnames", "static_argnums") and (
+                isinstance(kw.value, (ast.Tuple, ast.List))
+            ):
+                names.extend(
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                )
+            elif kw.arg in ("static_argnames",) and isinstance(
+                kw.value, ast.Constant
+            ):
+                names.append(kw.value.value)
+        return tuple(names)
+    return None
+
+
+class _Taint:
+    """Lexical taint set for one function body."""
+
+    def __init__(self, fn: ast.AST, static_names: set):
+        self.tainted: set[str] = set()
+        args = fn.args
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+        ):
+            if a.arg not in static_names and a.arg not in ("self", "cls"):
+                self.tainted.add(a.arg)
+
+    def expr_tainted(self, expr: ast.AST) -> bool:
+        """A tainted Name taints the expression UNLESS every use goes
+        through a static probe: ``x.shape[0]`` / ``x.ndim`` / ``x.dtype``
+        are trace-time constants even when ``x`` is traced — that is the
+        legal shape-specialization idiom, not a host sync."""
+        for sub in ast.walk(expr):
+            if not (
+                isinstance(sub, ast.Name) and sub.id in self.tainted
+            ):
+                continue
+            if not _through_static_attr(sub):
+                return True
+        return False
+
+    def assign(self, node: ast.AST) -> None:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets, value = [node.target], node.value
+        else:
+            return
+        if value is None:
+            return
+        names = [
+            t.id for tgt in targets for t in ast.walk(tgt)
+            if isinstance(t, ast.Name)
+        ]
+        if self.expr_tainted(value):
+            self.tainted.update(names)
+        else:
+            # retaint-kill: a name rebound to a pure value is clean again
+            for n in names:
+                self.tainted.discard(n)
+
+
+def _through_static_attr(name: ast.Name) -> bool:
+    """Does this Name use flow through a ``.shape``/``.ndim``/... probe
+    (anywhere up its attribute chain)?"""
+    node: ast.AST = name
+    parent = getattr(node, "_pp_parent", None)
+    while isinstance(parent, (ast.Attribute, ast.Subscript)):
+        if isinstance(parent, ast.Attribute) and (
+            parent.attr in STATIC_ATTRS
+        ):
+            return True
+        node, parent = parent, getattr(parent, "_pp_parent", None)
+    return False
+
+
+def _static_only_test(test: ast.AST, taint: _Taint) -> bool:
+    """True when every tainted name in the test is reached only through
+    static probes (shape/ndim/dtype/size), ``is [not] None``, or
+    isinstance — the legal specialization idioms."""
+    for sub in ast.walk(test):
+        if not isinstance(sub, ast.Name) or sub.id not in taint.tainted:
+            continue
+        if _through_static_attr(sub):
+            continue
+        parent = getattr(sub, "_pp_parent", None)
+        ok = False
+        if isinstance(parent, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot))
+            for op in parent.ops
+        ):
+            ok = True
+        elif isinstance(parent, ast.Call) and (
+            isinstance(parent.func, ast.Name)
+            and parent.func.id in ("isinstance", "len")
+        ):
+            ok = True
+        if not ok:
+            return False
+    return True
+
+
+def _link_parents(root: ast.AST) -> None:
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            child._pp_parent = node  # type: ignore[attr-defined]
+
+
+class PurityChecker:
+    def __init__(self, roots=DEFAULT_ROOTS, index: Optional[Index] = None):
+        # purity resolves calls structurally; the lock spec's receiver
+        # tables are irrelevant here, so the index may omit the spec
+        self.index = (
+            index if index is not None else Index.build(roots)
+        )
+        self.findings: list[Finding] = []
+        self.consumed: set = set()  # (rel, line) escapes that fired
+        self._lines: dict[str, list] = {}
+
+    # ---------------- jit closure ----------------
+
+    def jit_entries(self) -> dict[str, tuple]:
+        """qname -> static_argnames for every decorated jit entry."""
+        out = {}
+        for qname, info in self.index.functions.items():
+            for dec in getattr(info.node, "decorator_list", ()):
+                names = _jit_static_argnames(dec)
+                if names is not None:
+                    out[qname] = names
+                    break
+        return out
+
+    def closure(self, entries) -> set[str]:
+        seen = set(entries)
+        frontier = list(entries)
+        while frontier:
+            qname = frontier.pop()
+            info = self.index.functions[qname]
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                for callee in self.index.resolve_call(call, info):
+                    if callee not in seen:
+                        seen.add(callee)
+                        frontier.append(callee)
+        return seen
+
+    # ---------------- checks ----------------
+
+    def run(self) -> list[Finding]:
+        entries = self.jit_entries()
+        reach = self.closure(entries)
+        for qname in sorted(reach):
+            info = self.index.functions[qname]
+            static_names = set(entries.get(qname, ()))
+            self._check_function(info, static_names)
+        return self.findings
+
+    def _check_function(self, info, static_names: set) -> None:
+        fn = info.node
+        _link_parents(fn)
+        taint = _Taint(fn, static_names)
+        self._walk_block(info, fn.body, taint)
+
+    def _walk_block(self, info, stmts, taint: _Taint) -> None:
+        for st in stmts:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # nested defs (scan/while bodies) inherit the taint of
+                # their free variables; conservatively, their params are
+                # traced too (they receive carry/batch values)
+                inner = _Taint(st, set())
+                inner.tainted |= taint.tainted
+                self._walk_block(info, st.body, inner)
+                continue
+            taint.assign(st)
+            if isinstance(st, (ast.If, ast.While)):
+                if taint.expr_tainted(st.test) and not _static_only_test(
+                    st.test, taint
+                ):
+                    self._find(
+                        info, st,
+                        "Python control flow on a traced value — "
+                        "forces a concrete bool mid-trace; use "
+                        "lax.cond/select or jnp.where",
+                    )
+                self._check_stmt_calls(info, st.test, taint)
+                self._walk_block(info, st.body, taint)
+                self._walk_block(info, st.orelse, taint)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                # loop variables of a tainted iterable are traced
+                self._check_stmt_calls(info, st.iter, taint)
+                if taint.expr_tainted(st.iter):
+                    taint.tainted.update(
+                        n.id for n in ast.walk(st.target)
+                        if isinstance(n, ast.Name)
+                    )
+                self._walk_block(info, st.body, taint)
+                self._walk_block(info, st.orelse, taint)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._check_stmt_calls(
+                        info, item.context_expr, taint
+                    )
+                self._walk_block(info, st.body, taint)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk_block(info, st.body, taint)
+                for h in st.handlers:
+                    self._walk_block(info, h.body, taint)
+                self._walk_block(info, st.orelse, taint)
+                self._walk_block(info, st.finalbody, taint)
+                continue
+            self._check_stmt_calls(info, st, taint)
+
+    def _check_stmt_calls(self, info, node: ast.AST, taint) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_call(info, sub, taint)
+
+    def _check_call(self, info, call: ast.Call, taint: _Taint) -> None:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            recv = receiver_pattern(fn.value)
+            root = recv.split(".", 1)[0]
+            # P1: device->host syncs
+            if fn.attr in HOST_SYNC_METHODS:
+                self._find(
+                    info, call,
+                    f".{fn.attr}() inside a jit-reachable path — "
+                    "device-to-host sync (TracerArray conversion on "
+                    "TPU)",
+                )
+                return
+            if root in ("np", "numpy"):
+                if fn.attr in NP_SYNC_FNS and any(
+                    taint.expr_tainted(a) for a in call.args
+                ):
+                    self._find(
+                        info, call,
+                        f"np.{fn.attr}() on a traced value inside jit "
+                        "— host materialization of a tracer",
+                    )
+                    return
+                # P2: np.random.*
+                if recv.endswith(".random"):
+                    self._find(
+                        info, call,
+                        "np.random inside a jit-reachable path — "
+                        "traced once, frozen forever; thread "
+                        "jax.random keys instead",
+                    )
+                    return
+                # P4: float64-defaulting constructors
+                if fn.attr in NP_PROMOTING_FNS and not any(
+                    kw.arg == "dtype" for kw in call.keywords
+                ) and len(call.args) < _dtype_positional(fn.attr):
+                    self._find(
+                        info, call,
+                        f"np.{fn.attr}() without dtype= inside a "
+                        "jit-reachable path — float64/int64 default "
+                        "promotes or truncates against the f32/i32 "
+                        "wire contract",
+                    )
+                    return
+            # P2: wall clock / random module
+            if root == "time":
+                self._find(
+                    info, call,
+                    "wall-clock read inside a jit-reachable path — "
+                    "traced once at compile time, frozen thereafter",
+                )
+                return
+            if root == "random":
+                self._find(
+                    info, call,
+                    "random module inside a jit-reachable path — "
+                    "traced once at compile time, frozen thereafter",
+                )
+                return
+
+    # ---------------- reporting ----------------
+
+    def _find(self, info, node, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        lines = self._file_lines(info.rel)
+        if lines and 1 <= line <= len(lines):
+            if f"lint: {SUPPRESS}" in lines[line - 1]:
+                self.consumed.add((info.rel, line))
+                return
+        self.findings.append(Finding(RULE, info.rel, line, msg))
+
+    def _file_lines(self, rel: str):
+        if rel not in self._lines:
+            try:
+                self._lines[rel] = (REPO / rel).read_text().splitlines()
+            except OSError:
+                self._lines[rel] = []
+        return self._lines[rel]
+
+
+def _dtype_positional(ctor: str) -> int:
+    """Positional arity at which dtype would appear for each numpy
+    constructor (np.zeros((n,), np.float32) passes dtype positionally)."""
+    return {
+        "zeros": 2, "ones": 2, "empty": 2, "full": 3,
+        "arange": 4, "linspace": 7,
+    }.get(ctor, 2)
+
+
+def run(roots=DEFAULT_ROOTS, index=None) -> list[Finding]:
+    return PurityChecker(roots, index=index).run()

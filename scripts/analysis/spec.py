@@ -1,0 +1,166 @@
+"""Committed-spec loader for the whole-program analyzer.
+
+``lock_order.toml`` is the single source of truth three consumers share:
+
+  * the static lock-order pass (``scripts/analysis/lockorder.py``) —
+    classifies every lock expression into a domain and checks the
+    call-graph-propagated acquisition edges against the rank order;
+  * the runtime witness (``protocol_tpu/utils/lockwitness.py``) —
+    asserts the same rank order live under the race/chaos suites;
+  * the protocol checker (``scripts/analysis/protocolsm.py``) — reads
+    the ladder-marker table from the ``[protocol]`` section.
+
+This container pins Python 3.10 (no stdlib ``tomllib``), so the loader
+carries a minimal TOML-subset parser: ``[section]`` headers and
+``key = value`` lines where value is an int, a float, a bool, a quoted
+string, or a flat array of quoted strings — exactly the shapes the spec
+uses, nothing more. When the interpreter has ``tomllib`` it is used
+instead, so the subset parser can never drift from real TOML silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+_KEY = r'(?:"(?P<qkey>[^"]+)"|(?P<key>[A-Za-z0-9_.\-]+))'
+_LINE = re.compile(rf"^\s*{_KEY}\s*=\s*(?P<value>.+?)\s*$")
+_SECTION = re.compile(r"^\s*\[(?P<name>[A-Za-z0-9_.\-]+)\]\s*$")
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("["):
+        if not raw.endswith("]"):
+            raise ValueError(f"unterminated array: {raw!r}")
+        body = raw[1:-1].strip()
+        if not body:
+            return []
+        items = []
+        for part in body.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if not (part.startswith('"') and part.endswith('"')):
+                raise ValueError(f"array items must be strings: {part!r}")
+            items.append(part[1:-1])
+        return items
+    if raw.startswith('"'):
+        if not (raw.endswith('"') and len(raw) >= 2):
+            raise ValueError(f"unterminated string: {raw!r}")
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        return float(raw)
+
+
+def parse_toml_subset(text: str) -> dict:
+    out: dict = {}
+    section: dict = out
+    pending: Optional[str] = None  # multi-line array accumulator
+    for lineno, line in enumerate(text.splitlines(), 1):
+        # strip full-line and trailing comments (the spec never puts '#'
+        # inside strings, so a bare split is sound for this subset)
+        stripped = line.split("#", 1)[0].rstrip()
+        if pending is not None:
+            pending += " " + stripped.strip()
+            if stripped.strip().endswith("]"):
+                m = _LINE.match(pending)
+                if m is None:
+                    raise ValueError(
+                        f"line {lineno}: cannot parse array {pending!r}"
+                    )
+                key = m.group("qkey") or m.group("key")
+                section[key] = _parse_value(m.group("value"))
+                pending = None
+            continue
+        if not stripped.strip():
+            continue
+        m = _SECTION.match(stripped)
+        if m:
+            section = out.setdefault(m.group("name"), {})
+            continue
+        if stripped.count("[") > stripped.count("]") and "=" in stripped:
+            pending = stripped.strip()
+            continue
+        m = _LINE.match(stripped)
+        if m is None:
+            raise ValueError(f"line {lineno}: cannot parse {line!r}")
+        key = m.group("qkey") or m.group("key")
+        section[key] = _parse_value(m.group("value"))
+    if pending is not None:
+        raise ValueError(f"unterminated multi-line array: {pending!r}")
+    return out
+
+
+def _load_toml(path: str) -> dict:
+    with open(path, "rb") as fh:
+        data = fh.read()
+    try:
+        import tomllib  # Python >= 3.11
+
+        return tomllib.loads(data.decode())
+    except ImportError:
+        return parse_toml_subset(data.decode())
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """The parsed lock-order spec."""
+
+    ranks: dict  # domain -> int rank (strictly ascending acquisition)
+    reentrant: tuple  # domains with RLock semantics
+    classify_attr: dict  # lock attribute name -> domain
+    classify_class: dict  # "ClassName.attr" -> domain
+    receivers: dict  # receiver expr pattern -> class name
+    callbacks: dict  # "receiver.attr" call -> list of concrete functions
+    ladder_markers: tuple  # substrings the client ladder recognizes
+    skip_files: tuple  # repo-relative files the lock pass never scans
+
+    def domain_of(
+        self, attr: str, class_name: Optional[str] = None
+    ) -> Optional[str]:
+        if class_name is not None:
+            dom = self.classify_class.get(f"{class_name}.{attr}")
+            if dom is not None:
+                return dom
+        return self.classify_attr.get(attr)
+
+
+def load_spec(path: Optional[str] = None) -> Spec:
+    if path is None:
+        path = os.path.join(os.path.dirname(__file__), "lock_order.toml")
+    doc = _load_toml(path)
+    ranks = {k: int(v) for k, v in doc.get("domains", {}).items()}
+    unknown = [
+        d for d in doc.get("reentrant", {}).get("domains", [])
+        if d not in ranks
+    ]
+    if unknown:
+        raise ValueError(f"reentrant domains missing ranks: {unknown}")
+    for table in ("classify", "classify_class"):
+        for key, dom in doc.get(table, {}).items():
+            if dom not in ranks:
+                raise ValueError(
+                    f"[{table}] {key!r} maps to unranked domain {dom!r}"
+                )
+    return Spec(
+        ranks=ranks,
+        reentrant=tuple(doc.get("reentrant", {}).get("domains", [])),
+        classify_attr=dict(doc.get("classify", {})),
+        classify_class=dict(doc.get("classify_class", {})),
+        receivers=dict(doc.get("receivers", {})),
+        callbacks={
+            k: (v if isinstance(v, list) else [v])
+            for k, v in doc.get("callbacks", {}).items()
+        },
+        ladder_markers=tuple(
+            doc.get("protocol", {}).get("ladder_markers", [])
+        ),
+        skip_files=tuple(doc.get("scan", {}).get("skip_files", [])),
+    )

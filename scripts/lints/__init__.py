@@ -9,7 +9,12 @@ modules for the catalog:
 
 Run: ``python -m scripts.lints`` (exit 1 on any finding — the clippy
 ``-D warnings`` discipline of the reference CI, applied to the
-invariants clippy cannot see).
+invariants clippy cannot see). The engine also AUDITS escape
+annotations: a ``# lint: <token>`` that no longer suppresses any
+finding is a ``stale-escape`` finding itself. ``--sarif out.json``
+emits SARIF 2.1.0 through the emitter shared with the whole-program
+analyzer (``python -m scripts.analysis`` — lock-order graph, session-
+protocol state machine, jax purity; see scripts/analysis/).
 """
 
 from scripts.lints import densealloc, determinism, dtype_contract, lockdiscipline  # noqa: F401

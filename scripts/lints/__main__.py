@@ -20,6 +20,9 @@ def main(argv=None) -> int:
     ap.add_argument("--rule", action="append", default=None,
                     help="run only this rule (repeatable)")
     ap.add_argument("--list", action="store_true", help="list rules and exit")
+    ap.add_argument("--sarif", default=None, metavar="OUT.json",
+                    help="also write findings as SARIF 2.1.0 (the shared "
+                         "emitter CI uploads to GitHub code scanning)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -37,6 +40,15 @@ def main(argv=None) -> int:
     findings = run_rules(roots=args.roots, rules=rules)
     for f in findings:
         print(f)
+    if args.sarif:
+        from scripts.lints.sarif import write_sarif
+
+        write_sarif(
+            args.sarif, findings, "scripts.lints",
+            rule_help={r.name: (r.__doc__ or r.name).strip().split("\n")[0]
+                       for r in (rules or RULES)},
+        )
+        print(f"sarif written: {args.sarif} ({len(findings)} finding(s))")
     if not findings:
         names = ", ".join(r.name for r in (rules or RULES))
         print(f"lints clean ({names}) over {', '.join(args.roots)}")

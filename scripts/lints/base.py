@@ -31,6 +31,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import pathlib
+import re
 from typing import Optional
 
 REPO = pathlib.Path(__file__).resolve().parents[2]
@@ -39,6 +40,27 @@ REPO = pathlib.Path(__file__).resolve().parents[2]
 # are only ever linted explicitly, by the tests)
 DEFAULT_ROOTS = ("protocol_tpu",)
 SKIP_PARTS = {"__pycache__", "fixtures"}
+
+# escape tokens owned by the whole-program analyzer
+# (``python -m scripts.analysis``), which audits its own staleness
+# WITHIN its scan scope — the lint-engine audit must neither flag them
+# stale nor call them unknown there. OUTSIDE the owning analyzer's
+# scope nobody would ever audit them, so the lint engine reports those
+# as stale itself (an escape no pass can consume suppresses nothing by
+# construction). Token -> owning pass's path scope ((), meaning "the
+# whole lint walk", for the lock pass which scans all of protocol_tpu).
+# Kept in sync with the analyzers' roots by tests/test_analysis.py.
+EXTERNAL_SUPPRESS_SCOPES = {
+    "lock-order-ok": (),
+    "protocol-ok": ("protocol_tpu/services/scheduler_grpc.py",),
+    "purity-ok": (
+        "protocol_tpu/ops", "protocol_tpu/parallel",
+        "protocol_tpu/sched/tpu_backend.py",
+    ),
+}
+EXTERNAL_SUPPRESS_TOKENS = tuple(EXTERNAL_SUPPRESS_SCOPES)
+
+_ESCAPE_RE = re.compile(r"#\s*lint:\s*([A-Za-z0-9_-]+)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +87,9 @@ class Source:
         self.text = path.read_text()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=str(path))
+        # line numbers where an escape annotation actually suppressed a
+        # finding this run — the stale-escape audit's evidence trail
+        self.consumed_escapes: set[int] = set()
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
                 child._lint_parent = node  # type: ignore[attr-defined]
@@ -84,7 +109,9 @@ class Source:
     def suppressed(self, line: int, token: str) -> bool:
         if 1 <= line <= len(self.lines):
             text = self.lines[line - 1]
-            return f"lint: {token}" in text or "lint: ok" in text
+            if f"lint: {token}" in text or "lint: ok" in text:
+                self.consumed_escapes.add(line)
+                return True
         return False
 
 
@@ -132,13 +159,16 @@ def iter_files(roots=DEFAULT_ROOTS) -> list[pathlib.Path]:
 
 
 def run_rules(
-    roots=DEFAULT_ROOTS, rules: Optional[list[Rule]] = None
+    roots=DEFAULT_ROOTS,
+    rules: Optional[list[Rule]] = None,
+    audit_escapes: bool = True,
 ) -> list[Finding]:
     """The engine: parse each file once, dispatch to every applicable
-    rule, then run the cross-file passes. Returns all findings (empty ==
-    the build may proceed)."""
+    rule, run the cross-file passes, then audit escape annotations.
+    Returns all findings (empty == the build may proceed)."""
     active = RULES if rules is None else rules
     findings: list[Finding] = []
+    audited: list[tuple] = []  # (rel, lines, consumed line set)
     for path in iter_files(roots):
         resolved = path.resolve()
         rel = (
@@ -150,6 +180,14 @@ def run_rules(
         explicit = str(path) in map(str, roots) or rel in roots
         applicable = [r for r in active if explicit or r.applies(rel)]
         if not applicable:
+            # still audited: an escape annotation in a file no rule
+            # even scans suppresses nothing by construction
+            try:
+                audited.append(
+                    (rel, path.read_text().splitlines(), set())
+                )
+            except OSError:
+                pass
             continue
         try:
             src = Source(path)
@@ -160,6 +198,54 @@ def run_rules(
             continue
         for rule in applicable:
             findings.extend(rule.check(src))
+        audited.append((rel, src.lines, src.consumed_escapes))
     for rule in active:
         findings.extend(rule.check_repo())
+    if audit_escapes and rules is None:
+        # only when the FULL catalog ran: a --rule subset run has not
+        # given every escape its chance to suppress
+        for rel, lines, consumed in audited:
+            findings.extend(stale_escapes(rel, lines, consumed))
     return findings
+
+
+def stale_escapes(rel: str, lines, consumed: set) -> list[Finding]:
+    """The anti-rot audit: every ``# lint: <token>`` annotation must
+    have suppressed a finding THIS run. A suppression that no longer
+    suppresses anything is dead weight that silently licenses future
+    violations on its line — reported (and failing the build) so
+    escapes get removed the same push that obsoletes them."""
+    own_tokens = {r.suppress_token for r in RULES if r.suppress_token}
+    out: list[Finding] = []
+    for lineno, text in enumerate(lines, 1):
+        m = _ESCAPE_RE.search(text)
+        if m is None:
+            continue
+        token = m.group(1)
+        if token in EXTERNAL_SUPPRESS_SCOPES:
+            scope = EXTERNAL_SUPPRESS_SCOPES[token]
+            in_scope = not scope or any(
+                rel == s or rel.startswith(s + "/") for s in scope
+            )
+            if in_scope:
+                continue  # the owning analyzer audits it there
+            out.append(Finding(
+                "stale-escape", rel, lineno,
+                f"escape '# lint: {token}' is outside the owning "
+                "analyzer's scan scope — no pass can ever consume it",
+            ))
+            continue
+        if token != "ok" and token not in own_tokens:
+            out.append(Finding(
+                "stale-escape", rel, lineno,
+                f"unknown escape token {token!r} — not a rule escape "
+                "in this engine or the analyzer",
+            ))
+            continue
+        if lineno not in consumed:
+            out.append(Finding(
+                "stale-escape", rel, lineno,
+                f"escape '# lint: {token}' suppresses no finding — "
+                "remove it (suppressions must not rot)",
+            ))
+    return out

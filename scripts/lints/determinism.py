@@ -40,12 +40,50 @@ Escape: ``# lint: determinism-ok`` on the offending line.
 from __future__ import annotations
 
 import ast
+import dataclasses
 
 from scripts.lints.base import Finding, Rule, Source, register
 
 _SET_BUILTINS = {"set", "frozenset"}
 _NONDET_MAPPINGS = {"vars", "globals", "locals"}
 _RANDOM_ROOTS = {"np", "numpy"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """One determinism-covered module family. THE single source of
+    truth for (a) the rule's path filter, (b) strict-mode selection,
+    and (c) the fixture-harness parametrization in tests/test_lints.py
+    — which previously each hardcoded their own directory lists, so a
+    new package could land in one and silently fall out of the other."""
+
+    name: str
+    prefixes: tuple = ()   # repo-relative directory prefixes
+    suffixes: tuple = ()   # exact-module suffixes
+    fixture_prefix: str = ""  # "<prefix>determinism_{bad,ok}.py" twins
+    strict: bool = False   # strict no-clock mode (tick-indexed modules)
+
+
+# add a package here and BOTH the rule scope and the seeded-fixture
+# harness pick it up (the harness asserts the fixture twins exist)
+SCOPES = (
+    Scope(
+        "kernel",
+        prefixes=("protocol_tpu/native/", "protocol_tpu/ops/"),
+    ),
+    Scope(
+        "faults",
+        prefixes=("protocol_tpu/faults/",),
+        fixture_prefix="faults_",
+    ),
+    Scope("quality", suffixes=("protocol_tpu/obs/quality.py",)),
+    Scope(
+        "slo",
+        suffixes=("protocol_tpu/obs/slo.py",),
+        fixture_prefix="slo_",
+        strict=True,
+    ),
+)
 
 
 def _is_set_expr(node: ast.AST) -> bool:
@@ -61,26 +99,23 @@ class DeterminismRule(Rule):
     name = "determinism"
     suppress_token = "determinism-ok"
 
-    # tick-indexed modules: ANY clock read is a finding, not just
-    # wall-clock (the fixture twins carry the "slo_" prefix so the
-    # strict mode is exercised by the seeded tests too)
-    _STRICT_NO_CLOCK = ("protocol_tpu/obs/slo.py",)
-
     def applies(self, rel: str) -> bool:
-        return rel.startswith(
-            (
-                "protocol_tpu/native/", "protocol_tpu/ops/",
-                "protocol_tpu/faults/",
-            )
-        ) or rel.endswith(
-            ("protocol_tpu/obs/quality.py", "protocol_tpu/obs/slo.py")
+        return any(
+            rel.startswith(s.prefixes) or rel.endswith(s.suffixes)
+            for s in SCOPES
+            if s.prefixes or s.suffixes
         )
 
     @classmethod
     def _is_strict(cls, rel: str) -> bool:
+        # strict mode follows the SAME table: the real tick-indexed
+        # modules by suffix, their fixture twins by filename prefix
         name = rel.replace("\\", "/").rsplit("/", 1)[-1]
-        return rel.endswith(cls._STRICT_NO_CLOCK) or name.startswith(
-            "slo_"
+        return any(
+            rel.endswith(s.suffixes)
+            or (s.fixture_prefix and name.startswith(s.fixture_prefix))
+            for s in SCOPES
+            if s.strict
         )
 
     @staticmethod

@@ -1,0 +1,83 @@
+"""Shared SARIF 2.1.0 emitter for the lint engine and the whole-program
+analyzer (``python -m scripts.lints --sarif out.json`` /
+``python -m scripts.analysis --sarif out.json``).
+
+One emitter, two producers: both tools speak the same Finding shape
+(``scripts.lints.base.Finding``), so CI uploads one artifact format and
+GitHub code scanning renders every rule — per-file lint or
+interprocedural analysis — as inline annotations on the PR diff.
+"""
+
+from __future__ import annotations
+
+import json
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(
+    findings,
+    tool_name: str,
+    info_uri: str = "",
+    rule_help: dict | None = None,
+) -> dict:
+    """Findings -> one-run SARIF log. ``rule_help`` maps rule id ->
+    short description (rendered in the code-scanning rule index)."""
+    rule_ids = sorted({f.rule for f in findings})
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {
+                "text": (rule_help or {}).get(rid, rid)
+            },
+        }
+        for rid in rule_ids
+    ]
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace("\\", "/"),
+                            "uriBaseId": "%SRCROOT%",
+                        },
+                        "region": {"startLine": max(int(f.line), 1)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": tool_name,
+                        "informationUri": info_uri,
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+
+
+def write_sarif(
+    path: str, findings, tool_name: str, rule_help: dict | None = None
+) -> None:
+    doc = to_sarif(findings, tool_name, rule_help=rule_help)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")

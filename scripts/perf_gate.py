@@ -756,6 +756,15 @@ def chaos_gate() -> int:
     of the seed, and the acceptance claims are exact, not statistical).
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # arm the runtime lock-order witness (ISSUE 10): every lock the
+    # chaos drill's servers create from here on asserts the committed
+    # acquisition order (scripts/analysis/lock_order.toml) live, under
+    # the adversarial interleavings the fault train produces. Zero
+    # violations is part of this gate's acceptance bar.
+    os.environ.setdefault("PROTOCOL_TPU_LOCK_WITNESS", "1")
+    from protocol_tpu.utils import lockwitness
+
+    lockwitness.reset()
     from protocol_tpu.faults.harness import run_chaos
 
     with open(FLOOR_PATH) as fh:
@@ -871,6 +880,18 @@ def chaos_gate() -> int:
         failures.append(
             f"phase C: assigned fraction {rep_c['assigned_frac_min']} "
             f"below {frac_floor} — staleness bought too much quality"
+        )
+
+    # ---- lock-order witness verdict over all three phases
+    violations = lockwitness.violations()
+    print(
+        f"lock witness: {len(violations)} order violation(s) across "
+        "chaos phases A/B/C"
+    )
+    if violations:
+        failures.append(
+            f"lock-order witness recorded {len(violations)} "
+            f"violation(s) under chaos: {violations[:3]}"
         )
 
     if failures:

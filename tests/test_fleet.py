@@ -374,6 +374,24 @@ class TestFleetOverWire:
     """gRPC-level fleet behavior: admission refusals, delta
     backpressure, and the adversarial multi-tenant race suite."""
 
+    @pytest.fixture(autouse=True)
+    def _lock_witness(self, monkeypatch):
+        """Arm the runtime lock-order witness (ISSUE 10) for every
+        wire-level fleet test: each lock the in-process server creates
+        asserts the committed acquisition order live, and a test that
+        completed its races with ANY recorded violation fails — the
+        dynamic twin of scripts/analysis/lockorder.py, run under the
+        adversarial interleavings this suite exists to produce."""
+        from protocol_tpu.utils import lockwitness
+
+        monkeypatch.setenv("PROTOCOL_TPU_LOCK_WITNESS", "1")
+        lockwitness.reset()
+        yield
+        assert lockwitness.violations() == [], (
+            "lock-order witness violations under the fleet race suite: "
+            f"{lockwitness.violations()[:5]}"
+        )
+
     def _serve(self, **fleet_kw):
         from protocol_tpu.services.scheduler_grpc import (
             SchedulerBackendClient,

@@ -15,12 +15,28 @@ import pytest
 from scripts.lints import RULES, run_rules
 from scripts.lints.base import Source, iter_files
 from scripts.lints.densealloc import DenseAllocRule
-from scripts.lints.determinism import DeterminismRule
+from scripts.lints.determinism import SCOPES, DeterminismRule
 from scripts.lints.dtype_contract import DtypeContractRule
 from scripts.lints.lockdiscipline import LockDisciplineRule
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "scripts" / "lints" / "fixtures"
+
+# determinism fixture harness DERIVED from the rule's own scope table
+# (one source of truth: a new scope added to SCOPES automatically
+# demands its fixture twins here — it cannot silently fall out of
+# coverage)
+_DET_CASES, _seen = [], set()
+for _scope in SCOPES:
+    if _scope.fixture_prefix in _seen:
+        continue
+    _seen.add(_scope.fixture_prefix)
+    _DET_CASES.append((
+        DeterminismRule,
+        f"{_scope.fixture_prefix}determinism_bad.py",
+        f"{_scope.fixture_prefix}determinism_ok.py",
+        f"determinism-{_scope.name}",
+    ))
 
 
 def seeded_lines(path: pathlib.Path, rule_name: str) -> set[int]:
@@ -38,19 +54,13 @@ def run_on(rule, fname: str):
 class TestRulesFireExactlyOnSeeds:
     @pytest.mark.parametrize(
         "rule_cls,bad,ok",
-        [
-            (DeterminismRule, "determinism_bad.py", "determinism_ok.py"),
-            (DeterminismRule, "slo_determinism_bad.py",
-             "slo_determinism_ok.py"),
-            (DeterminismRule, "faults_determinism_bad.py",
-             "faults_determinism_ok.py"),
+        [c[:3] for c in _DET_CASES] + [
             (LockDisciplineRule, "lock_bad.py", "lock_ok.py"),
             (LockDisciplineRule, "fleet_lock_bad.py", "fleet_lock_ok.py"),
             (LockDisciplineRule, "ckpt_lock_bad.py", "ckpt_lock_ok.py"),
             (DenseAllocRule, "dense_bad.py", "dense_ok.py"),
         ],
-        ids=[
-            "determinism", "determinism-slo-strict", "determinism-faults",
+        ids=[c[3] for c in _DET_CASES] + [
             "lock-discipline", "lock-discipline-fleet",
             "lock-discipline-ckpt", "dense-alloc",
         ],
@@ -66,6 +76,22 @@ class TestRulesFireExactlyOnSeeds:
         assert len(findings) == len(expected)
         assert all(f.rule == rule.name for f in findings)
         assert run_on(rule, ok) == []
+
+    def test_every_determinism_scope_has_fixture_twins_and_coverage(self):
+        """The anti-drift guarantee: each SCOPES entry must own fixture
+        twins, and the rule's path filter must cover its declared
+        paths — a new package added to the table cannot silently skip
+        either half."""
+        rule = DeterminismRule()
+        for scope in SCOPES:
+            bad = FIXTURES / f"{scope.fixture_prefix}determinism_bad.py"
+            ok = FIXTURES / f"{scope.fixture_prefix}determinism_ok.py"
+            assert bad.exists() and ok.exists(), scope.name
+            for prefix in scope.prefixes:
+                assert rule.applies(prefix + "x.py"), scope.name
+            for suffix in scope.suffixes:
+                assert rule.applies(suffix), scope.name
+                assert rule._is_strict(suffix) == scope.strict, scope.name
 
     def test_dtype_call_sites(self):
         rule = DtypeContractRule()

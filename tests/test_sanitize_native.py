@@ -8,7 +8,11 @@ import numpy as np
 import pytest
 
 from protocol_tpu import native
-from scripts.sanitize_native import _REPORT_MARKERS, _synth_marketplace
+from scripts.sanitize_native import (
+    _REPORT_MARKERS,
+    _clang_tidy,
+    _synth_marketplace,
+)
 
 
 class TestVariantSelection:
@@ -102,6 +106,31 @@ class TestStressHarnessInputs:
         for fam in ("ThreadSanitizer", "AddressSanitizer", "LeakSanitizer",
                     "runtime error"):
             assert fam in text
+
+
+class TestClangTidyMandatory:
+    """The static pass is pinned and non-optional (ISSUE 10 satellite):
+    a missing clang-tidy binary must FAIL the harness, not skip — the
+    old behavior let the gate silently rot off-CI."""
+
+    def test_missing_clang_tidy_fails(self, monkeypatch):
+        import scripts.sanitize_native as sn
+
+        monkeypatch.setattr(sn.shutil, "which", lambda name: None)
+        lines = []
+        assert _clang_tidy(lines.append) is False
+        assert any("mandatory" in ln for ln in lines)
+
+    def test_ci_installs_and_runs_tidy_as_its_own_step(self):
+        wf = open(os.path.join(
+            os.path.dirname(__file__), "..",
+            ".github", "workflows", "checks.yml",
+        )).read()
+        assert "clang-tidy" in wf
+        # the workflow must INSTALL the toolchain (pinned step), and no
+        # job may pass --skip-clang-tidy
+        assert "apt-get install" in wf and "clang-tidy" in wf
+        assert "--skip-clang-tidy" not in wf
 
 
 class TestMakefileParity:
