@@ -1,0 +1,36 @@
+"""Distributed fleet-of-fleets: N servicer processes, one session space.
+
+The reference protocol is explicitly multi-process — a discovery
+service routes workers to an orchestrator pool coordinator — while the
+reproduction's fleet (PR 7) and chaos/checkpoint plane (PR 9) lived in
+ONE process. This package combines those two halves into a horizontally
+scaled service that can lose any single process and keep serving warm:
+
+  * :class:`FleetTopology` — consistent-hash session->process routing
+    (the same sha1 ring the in-process fabric shards by, lifted to
+    endpoints), with an ordered failover walk per session and a
+    generation counter that bumps on membership change.
+  * :class:`DiscoveryEndpoint` — the thin discovery tier (the
+    reference's discovery/orchestrator split): an HTTP endpoint serving
+    the endpoint map (``/fleet.json``) and per-session routes
+    (``/route?session=...``) so clients bootstrap their failover lists
+    without hardcoding the fleet.
+  * :class:`ProcessFleet` — spawns/kills/drains real servicer
+    processes over a SHARED checkpoint-journal root (each process owns
+    its ``(proc id, session id)`` namespace), re-routes a dead
+    process's orphaned journals along the ring, and drives LIVE
+    migration through the servicer's ``Migrate`` RPC.
+
+Migration protocol (zero client reopens, bounded staleness): the source
+records a ``moved:<endpoint>`` redirect, evicts the session (reason
+``migrate`` — in-flight solves refuse, the journal file survives),
+flushes the journal at its final tick, and atomically renames it into
+the target's namespace. The client follows the redirect and resends the
+SAME delta; the target rehydrates the journal warm on that miss, and
+the tick-cursor/CRC retransmit dedup carries "no tick lost or
+double-applied" across the process boundary.
+"""
+
+from protocol_tpu.dfleet.topology import FleetTopology  # noqa: F401
+
+__all__ = ["FleetTopology"]

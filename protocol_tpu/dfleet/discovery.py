@@ -1,0 +1,115 @@
+"""Discovery tier: the endpoint map, served over HTTP.
+
+Mirrors the reference's discovery-service/orchestrator split: a worker
+asks discovery where its pool lives, then speaks the pool protocol
+there. Here a scheduler client asks ``/route?session=<sid>`` for the
+session's home endpoint plus its ordered failover list (or fetches the
+whole map from ``/fleet.json`` and routes client-side via
+:class:`~protocol_tpu.dfleet.topology.FleetTopology` — same ring, same
+answer). The payload carries the topology ``generation`` so a client
+can tell a stale cached map from a fresh one after a membership change.
+
+Same daemon-threaded ``ThreadingHTTPServer`` idiom as the obs
+``/metrics`` endpoint — no new dependencies, and a scrape/debug surface
+for free. The topology is read through a zero-arg callable so the
+manager can swap in a new (immutable) topology on membership change
+without any locking here.
+
+Routes::
+
+    /fleet.json            the full topology (endpoints, procs, generation)
+    /route?session=<sid>   {"endpoint", "failover", "generation"}
+    /healthz               liveness probe
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from protocol_tpu.dfleet.topology import FleetTopology
+
+
+class DiscoveryEndpoint:
+    """Serve one fleet's topology. ``topology_fn`` returns the CURRENT
+    immutable :class:`FleetTopology` (the manager rebinds it on
+    membership change)."""
+
+    def __init__(
+        self,
+        topology_fn: Callable[[], FleetTopology],
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.topology_fn = topology_fn
+        endpoint = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: routing is periodic
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload, sort_keys=True).encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "application/json; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                topo = endpoint.topology_fn()
+                if parsed.path == "/fleet.json":
+                    self._send(200, topo.to_dict())
+                    return
+                if parsed.path == "/route":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    sid = (q.get("session") or [""])[0]
+                    if not sid:
+                        self._send(
+                            400, {"error": "session query param required"}
+                        )
+                        return
+                    self._send(200, {
+                        "session": sid,
+                        "endpoint": topo.endpoint_for(sid),
+                        "failover": topo.failover_order(sid),
+                        "generation": topo.generation,
+                    })
+                    return
+                if parsed.path == "/healthz":
+                    self._send(200, {"status": "ok"})
+                    return
+                self._send(404, {"error": f"no route {parsed.path!r}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="dfleet-discovery",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def fetch_topology(url: str, timeout: float = 10.0) -> FleetTopology:
+    """Client bootstrap: fetch the fleet map from a discovery endpoint
+    (``url`` is the endpoint base, e.g. ``http://127.0.0.1:8123``)."""
+    with urllib.request.urlopen(
+        f"{url.rstrip('/')}/fleet.json", timeout=timeout
+    ) as r:
+        return FleetTopology.from_dict(json.loads(r.read().decode()))

@@ -1,0 +1,107 @@
+"""One servicer process of the distributed fleet (the spawn target).
+
+``python -m protocol_tpu.dfleet.proc --address H:P --proc-id pK
+--journal-root DIR`` boots ONE scheduler servicer whose checkpoint
+journals live under the SHARED root in this process's own namespace
+(``DIR/pK/``) and whose advertised endpoint rides every
+``moved:<endpoint>`` redirect it ever issues. The manager
+(:class:`~protocol_tpu.dfleet.manager.ProcessFleet`) spawns N of these,
+health-polls them ready, and later kills (drill) or drains (rolling
+upgrade) them.
+
+SIGTERM runs the PR 9 graceful drain (stop admitting, finish in-flight
+ticks, flush every journal) and exits 0 — after which the manager hands
+the journals off along the ring and the survivors rehydrate them warm.
+
+Prints ``DFLEET-READY <address> proc=<id> metrics=<port>`` once
+serving; with the lock witness armed (``PROTOCOL_TPU_LOCK_WITNESS``),
+any recorded violations are written to
+``<journal-root>/witness_<proc-id>.json`` at drain/exit so the dfleet
+perf gate can assert on them from the parent process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def _dump_witness(journal_root: str, proc_id: str) -> None:
+    if not os.environ.get("PROTOCOL_TPU_LOCK_WITNESS"):
+        return
+    from protocol_tpu.utils import lockwitness
+
+    try:
+        path = os.path.join(journal_root, f"witness_{proc_id}.json")
+        with open(path, "w") as fh:
+            json.dump(list(lockwitness.violations()), fh)
+    except Exception:
+        pass  # witness reporting must never block an exit
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m protocol_tpu.dfleet.proc",
+        description="One dfleet servicer process (see module docstring).",
+    )
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--proc-id", required=True)
+    ap.add_argument("--journal-root", required=True)
+    ap.add_argument("--endpoint", default=None,
+                    help="advertised endpoint (default: --address)")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--max-sessions", type=int, default=64)
+    ap.add_argument("--max-workers", type=int, default=8)
+    ap.add_argument("--session-ttl-s", type=float, default=900.0)
+    ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--metrics-port", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from protocol_tpu.fleet.fabric import FleetConfig
+    from protocol_tpu.services.scheduler_grpc import drain, serve
+
+    cfg = FleetConfig.from_env()
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg,
+        shards=args.shards,
+        ckpt_dir=args.journal_root,
+        ckpt_every=args.ckpt_every,
+        proc_id=args.proc_id,
+        endpoint=args.endpoint or args.address,
+    )
+    server = serve(
+        address=args.address,
+        max_workers=args.max_workers,
+        max_sessions=args.max_sessions,
+        session_ttl_s=args.session_ttl_s,
+        metrics_port=args.metrics_port,
+        fleet=cfg,
+    )
+    metrics_port = server.metrics.port if server.metrics else 0
+
+    def _on_sigterm(signum, frame):
+        flushed = drain(server)
+        print(f"dfleet proc {args.proc_id} drained: {flushed} "
+              "journal(s) flushed", flush=True)
+        _dump_witness(args.journal_root, args.proc_id)
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    print(
+        f"DFLEET-READY {args.address} proc={args.proc_id} "
+        f"metrics={metrics_port}",
+        flush=True,
+    )
+    server.wait_for_termination()
+    _dump_witness(args.journal_root, args.proc_id)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

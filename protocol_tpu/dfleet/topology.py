@@ -1,0 +1,138 @@
+"""Consistent-hash session->process routing for the distributed fleet.
+
+The same sha1 ring the in-process :class:`SessionFabric` shards by,
+lifted one level: keys are session ids, values are PROCESS endpoints.
+Decoupling workload placement (which process serves a session) from the
+workload itself (the session's journal, portable by construction) is
+the VirtualFlow argument applied to the scheduler seam — and because
+both layers hash the same way, a session's in-process shard is stable
+regardless of which process it lands on.
+
+The ring is immutable; membership change builds a NEW topology with a
+bumped ``generation`` (:meth:`without` / :meth:`with_endpoint`), so a
+topology object can be shared across threads without locking and a
+stale client can detect it is routing on an old view. ``failover_order``
+is the client ladder's endpoint list: the ring walk from the session's
+position, deduplicated — the first entry is the session's home, the
+rest are where its journal will be re-routed if the home dies (the
+manager's orphan handoff uses the same walk, so client failover and
+journal re-routing agree by construction).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Optional
+
+# THE ring hash — imported from the in-process fabric, not copied: the
+# "both layers hash the same way" shard-stability claim is an import,
+# not a convention a future edit can silently break
+from protocol_tpu.fleet.fabric import _h
+
+
+class FleetTopology:
+    """Immutable endpoint ring. ``procs`` maps endpoint -> proc id (the
+    checkpoint-journal namespace that process owns)."""
+
+    def __init__(
+        self,
+        endpoints: list,
+        procs: Optional[dict] = None,
+        vnodes: int = 64,
+        generation: int = 0,
+    ):
+        self.endpoints = [str(e) for e in endpoints]
+        if not self.endpoints:
+            raise ValueError("topology needs at least one endpoint")
+        if len(set(self.endpoints)) != len(self.endpoints):
+            raise ValueError("duplicate endpoints in topology")
+        self.procs = dict(procs) if procs else {
+            e: f"p{i}" for i, e in enumerate(self.endpoints)
+        }
+        for e in self.endpoints:
+            if e not in self.procs:
+                raise ValueError(f"endpoint {e!r} has no proc id")
+        self.vnodes = max(1, int(vnodes))
+        self.generation = int(generation)
+        ring = sorted(
+            (_h(f"{e}/vnode-{j}"), i)
+            for i, e in enumerate(self.endpoints)
+            for j in range(self.vnodes)
+        )
+        self._ring_keys = [k for k, _ in ring]
+        self._ring_idx = [i for _, i in ring]
+
+    # ---------------- routing ----------------
+
+    def endpoint_for(self, session_id: str) -> str:
+        i = bisect.bisect_right(self._ring_keys, _h(session_id))
+        return self.endpoints[self._ring_idx[i % len(self._ring_idx)]]
+
+    def proc_for(self, session_id: str) -> str:
+        return self.procs[self.endpoint_for(session_id)]
+
+    def failover_order(self, session_id: str) -> list:
+        """Ordered endpoint list for one session: home first, then the
+        ring successors (deduplicated) — the client's failover ladder
+        AND the journal re-route order, one walk for both."""
+        start = bisect.bisect_right(self._ring_keys, _h(session_id))
+        seen: list = []
+        n = len(self._ring_idx)
+        for step in range(n):
+            ep = self.endpoints[self._ring_idx[(start + step) % n]]
+            if ep not in seen:
+                seen.append(ep)
+                if len(seen) == len(self.endpoints):
+                    break
+        return seen
+
+    # ---------------- membership (copy-on-change) ----------------
+
+    def without(self, endpoint: str) -> "FleetTopology":
+        """New topology with ``endpoint`` removed and the generation
+        bumped (a killed/drained process). ~1/N of the sessions re-home
+        to their ring successor; everyone else keeps their placement —
+        the consistent-hash property the journal handoff relies on to
+        move only the dead process's sessions."""
+        remaining = [e for e in self.endpoints if e != endpoint]
+        return FleetTopology(
+            remaining,
+            procs={e: self.procs[e] for e in remaining},
+            vnodes=self.vnodes,
+            generation=self.generation + 1,
+        )
+
+    def with_endpoint(
+        self, endpoint: str, proc_id: str
+    ) -> "FleetTopology":
+        """New topology with ``endpoint`` added (scale-out / a replaced
+        process coming back)."""
+        if endpoint in self.endpoints:
+            raise ValueError(f"endpoint {endpoint!r} already present")
+        procs = dict(self.procs)
+        procs[endpoint] = str(proc_id)
+        return FleetTopology(
+            self.endpoints + [endpoint],
+            procs=procs,
+            vnodes=self.vnodes,
+            generation=self.generation + 1,
+        )
+
+    # ---------------- wire form (the discovery payload) ----------------
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "endpoints": list(self.endpoints),
+            "procs": dict(self.procs),
+            "vnodes": self.vnodes,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetTopology":
+        return cls(
+            d["endpoints"],
+            procs=d.get("procs"),
+            vnodes=d.get("vnodes", 64),
+            generation=d.get("generation", 0),
+        )

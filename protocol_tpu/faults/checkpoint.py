@@ -39,6 +39,18 @@ checkpoints every tick — the zero-reopen guarantee. ``every=N`` trades
 durability for throughput: a crash loses up to N-1 ticks and the
 affected clients re-open from their authoritative columns (counted,
 bounded, explicit).
+
+Namespacing (dfleet): journals are keyed by **(process id, session
+id)** — every checkpointer owns ``<root>/<proc_id>/`` and only ever
+reads its own namespace, so N servicer processes can share one journal
+root (a shared volume) without ever rehydrating each other's live
+sessions. Migration rides on this: :meth:`handoff` atomically renames a
+journal from this process's namespace into the target's (``os.replace``
+— the journal exists in exactly one namespace at every instant), and
+the target rehydrates it warm on its next delta miss
+(:meth:`load_one`). The post-load ownership re-check closes the
+POSIX-fd window where a reader that opened the file just before the
+rename could otherwise rehydrate a journal it no longer owns.
 """
 
 from __future__ import annotations
@@ -65,19 +77,46 @@ def _fname(session_id: str) -> str:
     return hashlib.sha1(session_id.encode()).hexdigest()[:24] + _SUFFIX
 
 
-class SessionCheckpointer:
-    """Per-session checkpoint writer/loader over a directory."""
+def journal_session_id(path: str) -> Optional[str]:
+    """Session id recorded in a journal's META frame (None when the
+    file is torn/foreign) — what a dead process's orphaned journals are
+    re-routed by (the filename is a hash; the id itself rides in META)."""
+    try:
+        for kind, payload in tfmt.read_frames(path):
+            if kind == tfmt.KIND_META:
+                meta = json.loads(payload)
+                if meta.get("kind") == _META_KIND:
+                    return meta.get("session_id")
+                return None
+            break  # META is always the first frame
+    except Exception:
+        return None
+    return None
 
-    def __init__(self, directory: str, every: int = 1):
-        self.directory = directory
+
+class SessionCheckpointer:
+    """Per-session checkpoint writer/loader over ``<root>/<proc_id>/``
+    (one namespace per servicer process; see the module docstring)."""
+
+    def __init__(self, directory: str, every: int = 1,
+                 proc_id: str = "p0"):
+        self.root = directory
+        self.proc_id = str(proc_id)
+        self.directory = os.path.join(directory, self.proc_id)
         self.every = max(1, int(every))
-        os.makedirs(directory, exist_ok=True)
+        os.makedirs(self.directory, exist_ok=True)
         # obs counters (scraped via the servicer's seam metrics)
         self.flushes = 0
         self.flush_failures = 0
+        self.handoffs = 0
 
     def path_for(self, session_id: str) -> str:
         return os.path.join(self.directory, _fname(session_id))
+
+    def peer_path(self, session_id: str, proc_id: str) -> str:
+        """Where ``session_id``'s journal lives in ANOTHER process's
+        namespace under the same root (the handoff target)."""
+        return os.path.join(self.root, str(proc_id), _fname(session_id))
 
     def due(self, tick: int) -> bool:
         """Is ``tick`` on the flush cadence? Tick 0 (the snapshot
@@ -161,6 +200,25 @@ class SessionCheckpointer:
         finally:
             writer.close()
         os.replace(tmp, final)
+
+    # ---------------- migration handoff ----------------
+
+    def handoff(self, session_id: str, dst_proc_id: str) -> bool:
+        """Atomically move ``session_id``'s journal from this process's
+        namespace into ``dst_proc_id``'s (``os.replace`` — same
+        filesystem, so the journal exists in exactly one namespace at
+        every instant: two processes can never BOTH rehydrate it).
+        False = no journal to move (never flushed, or already handed
+        off)."""
+        src = self.path_for(session_id)
+        dst = self.peer_path(session_id, dst_proc_id)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.replace(src, dst)
+        except OSError:
+            return False
+        self.handoffs += 1
+        return True
 
     # ---------------- read ----------------
 
@@ -288,6 +346,34 @@ class SessionCheckpointer:
             )
         return session
 
+    def load_one(self, session_id: str, budget=None):
+        """Rehydrate ONE session from this process's namespace (the
+        lazy-restore path behind a delta miss after a migration
+        handoff). None = no journal here, or unloadable (warned — the
+        client falls down the ladder). The ownership re-check after the
+        read closes the rename race: a journal handed off mid-read is
+        discarded, never served."""
+        path = self.path_for(session_id)
+        if not os.path.exists(path):
+            return None
+        try:
+            session = self._load(path, budget)
+        except Exception:
+            log.warning(
+                "skipping unloadable session checkpoint %s", path,
+                exc_info=True,
+            )
+            return None
+        if session.session_id != session_id:
+            # hash-prefix collision between two session ids: refuse
+            # rather than serve someone else's state
+            return None
+        if not os.path.exists(path):
+            # handed off to another namespace while we were reading:
+            # the target owns it now
+            return None
+        return session
+
     def drop(self, session_id: str) -> None:
         """Remove a session's checkpoint (explicit client drop — an
         evicted-for-pressure session keeps its file: resurrecting it on
@@ -296,3 +382,42 @@ class SessionCheckpointer:
             os.remove(self.path_for(session_id))
         except OSError:
             pass
+
+
+def handoff_orphans(root: str, src_proc_id: str, route) -> list:
+    """Re-route a DEAD process's journal namespace: every loadable
+    journal under ``<root>/<src_proc_id>/`` is renamed into the
+    namespace ``route(session_id)`` picks (None = leave in place).
+    Returns ``[(session_id, dst_proc_id), ...]`` for the journals
+    moved. Only safe once the source process is actually gone (kill -9
+    / confirmed exit) — a live source would flush right back into its
+    namespace. Unreadable journals are skipped with a warning: the
+    affected client re-opens down the ladder, same contract as a torn
+    restart."""
+    src_dir = os.path.join(root, str(src_proc_id))
+    moved = []
+    try:
+        names = sorted(
+            n for n in os.listdir(src_dir) if n.endswith(_SUFFIX)
+        )
+    except OSError:
+        return moved
+    for name in names:
+        path = os.path.join(src_dir, name)
+        sid = journal_session_id(path)
+        if sid is None:
+            log.warning("orphan journal %s has no readable META", path)
+            continue
+        dst_proc = route(sid)
+        if dst_proc is None or str(dst_proc) == str(src_proc_id):
+            continue
+        dst_dir = os.path.join(root, str(dst_proc))
+        os.makedirs(dst_dir, exist_ok=True)
+        try:
+            os.replace(path, os.path.join(dst_dir, name))
+        except OSError:
+            log.warning("orphan handoff failed for %s", path,
+                        exc_info=True)
+            continue
+        moved.append((sid, str(dst_proc)))
+    return moved

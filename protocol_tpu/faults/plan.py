@@ -71,6 +71,16 @@ class ChaosConfig:
     blackout_refusals: int = 2
     evict_at_tick: Optional[int] = None
     starve_budget_ticks: int = 0
+    # process-level scripted targets (dfleet): which fleet PROCESS dies
+    # (SIGKILL — the crash drill) or live-migrates (Migrate RPC — the
+    # rolling-upgrade drill) once every session has passed the tick.
+    # Owned by the multi-process driver (fleet/loadgen --processes /
+    # dfleet.manager), exactly like kill_at_tick is owned by the
+    # single-process harness — a process cannot kill -9 itself cleanly.
+    kill_proc_at_tick: Optional[int] = None
+    kill_proc: int = 1
+    migrate_at_tick: Optional[int] = None
+    migrate_proc: int = 1
 
     _FLOATS = (
         "drop_rate", "delay_rate", "delay_ms", "corrupt_rate",
@@ -79,6 +89,8 @@ class ChaosConfig:
     _INTS = (
         "seed", "kill_at_tick", "blackout_shard", "blackout_refusals",
         "evict_at_tick", "starve_budget_ticks",
+        "kill_proc_at_tick", "kill_proc",
+        "migrate_at_tick", "migrate_proc",
     )
     # spec aliases: the short names the env/CLI spec uses
     _ALIASES = {
@@ -97,6 +109,8 @@ class ChaosConfig:
             or self.blackout_shard is not None
             or self.evict_at_tick is not None
             or self.starve_budget_ticks
+            or self.kill_proc_at_tick is not None
+            or self.migrate_at_tick is not None
         )
 
     @classmethod

@@ -102,6 +102,14 @@ class FleetConfig:
     ckpt_every: int = 1
     tick_deadline_ms: Optional[float] = None
     max_stale_ticks: int = 2
+    # ---- distributed fleet (dfleet). ``proc_id`` namespaces this
+    # process's checkpoint journals under the shared ``ckpt_dir`` root
+    # (journals are keyed by (proc id, session id) so N processes can
+    # share one journal volume); ``endpoint`` is the address this
+    # process advertises in "moved:<endpoint>" migration redirects and
+    # the discovery map.
+    proc_id: str = "p0"
+    endpoint: Optional[str] = None
 
     @classmethod
     def from_env(cls) -> "FleetConfig":
@@ -130,6 +138,8 @@ class FleetConfig:
             max_stale_ticks=int(
                 env("PROTOCOL_TPU_FLEET_MAX_STALE", "2")
             ),
+            proc_id=env("PROTOCOL_TPU_FLEET_PROC_ID", "p0"),
+            endpoint=env("PROTOCOL_TPU_FLEET_ENDPOINT") or None,
         )
 
 

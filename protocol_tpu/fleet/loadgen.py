@@ -59,6 +59,7 @@ class _SessionStats:
         "sid", "tenant", "cold_ms", "warm", "assigned_frac_min",
         "ticks_done", "refused", "reopens", "wall_s", "error",
         "transport_retries", "stale", "replayed",
+        "moved_redirects", "failovers", "handoff_waits",
     )
 
     def __init__(self, sid: str):
@@ -76,6 +77,10 @@ class _SessionStats:
         self.transport_retries = 0
         self.stale = 0
         self.replayed = 0
+        # dfleet ladder counters (the multi-process drills read these)
+        self.moved_redirects = 0
+        self.failovers = 0
+        self.handoff_waits = 0
 
 
 def _request_v2(snap, p_cols, r_cols, kernel: str):
@@ -118,7 +123,7 @@ def _open(client, snap, p_cols, r_cols, sid: str, kernel: str):
 
 
 def _drive_session(
-    address: str,
+    address,
     trace,
     sid: str,
     kernel: str,
@@ -132,7 +137,14 @@ def _drive_session(
     and — the restart drill's rung — transport failures (a servicer
     dying or draining mid-tick) reconnect and retry the SAME call, so
     a kill+restart shows up as retries and warm resumes, never as a
-    failed session."""
+    failed session.
+
+    ``address`` may be an ORDERED endpoint list (the dfleet failover
+    ladder): transport failures past the first reconnect rotate to the
+    next endpoint, a ``moved:<endpoint>`` refusal rebinds straight to
+    the session's new home, and an "unknown session" right after a
+    failover rides a bounded handoff-wait (the journal rename may still
+    be in flight) before conceding to a reopen."""
     import grpc
 
     from protocol_tpu.proto import scheduler_pb2 as pb
@@ -141,12 +153,31 @@ def _drive_session(
     from protocol_tpu.trace import format as tfmt
     from protocol_tpu.trace.replay import iter_input_ticks
 
-    client = SchedulerBackendClient(address)
+    endpoints = (
+        [str(a) for a in address]
+        if isinstance(address, (list, tuple)) else [str(address)]
+    )
+    ep_i = 0
+    client = SchedulerBackendClient(endpoints[ep_i])
+
+    def rebind(endpoint: Optional[str] = None):
+        nonlocal client, ep_i
+        if endpoint:
+            if endpoint not in endpoints:
+                endpoints.append(endpoint)
+            ep_i = endpoints.index(endpoint)
+        try:
+            client.close()
+        except Exception:
+            pass
+        client = SchedulerBackendClient(endpoints[ep_i])
 
     def send(call, transport_attempts: int = 60):
         """Run ``call(client)`` with reconnect-and-retry on transport
-        failure (the restart window): bounded, deterministic backoff."""
-        nonlocal client
+        failure (the restart window): bounded, deterministic backoff.
+        The first retry reconnects the SAME endpoint (transient blip);
+        later retries fail over down the endpoint list."""
+        nonlocal ep_i
         for attempt in range(transport_attempts):
             try:
                 return call(client)
@@ -155,11 +186,10 @@ def _drive_session(
                     raise
                 stats.transport_retries += 1
                 time.sleep(0.02 * min(attempt + 1, 10))
-                try:
-                    client.close()
-                except Exception:
-                    pass
-                client = SchedulerBackendClient(address)
+                if attempt >= 1 and len(endpoints) > 1:
+                    ep_i = (ep_i + 1) % len(endpoints)
+                    stats.failovers += 1
+                rebind()
 
     t_run = time.perf_counter()
     try:
@@ -200,6 +230,7 @@ def _drive_session(
                     )
                 p4t = None
                 reopened = False
+                evict_retried = False
                 for retry in range(max_retries):
                     resp = send(
                         lambda c: c.assign_delta(req, timeout=600)
@@ -222,7 +253,45 @@ def _drive_session(
                         # server service order)
                         time.sleep(0.01 * (retry + 1))
                         continue
-                    # evicted / unknown / tick mismatch: re-open from
+                    if resp.error.startswith("moved:"):
+                        # live migration redirect: the session is WARM
+                        # at its new home — rebind and resend the SAME
+                        # tick (a reopen here would throw the warm
+                        # arena away, the opposite of the migration's
+                        # point)
+                        stats.moved_redirects += 1
+                        rebind(resp.error[len("moved:"):].strip())
+                        continue
+                    if (
+                        "session evicted" in resp.error
+                        and not evict_retried
+                    ):
+                        # a migration racing this in-flight tick lands
+                        # as "session evicted"; ONE resend turns it
+                        # into the moved redirect (a genuine eviction
+                        # answers "unknown session" and re-opens)
+                        evict_retried = True
+                        continue
+                    if (
+                        "unknown session" in resp.error
+                        and len(endpoints) > 1
+                        and retry + 1 < max_retries
+                    ):
+                        # failover handoff window: the dead process's
+                        # journal rename may still be in flight — OR a
+                        # double transport blip rotated us away from
+                        # the session's LIVE home. Rotate while
+                        # waiting: the owner (live session or
+                        # re-routed journal) is always somewhere in
+                        # the endpoint list, so the walk converges
+                        # warm instead of parking on a non-owner until
+                        # the budget forces a reopen
+                        stats.handoff_waits += 1
+                        time.sleep(0.02 * (retry + 1))
+                        ep_i = (ep_i + 1) % len(endpoints)
+                        rebind()
+                        continue
+                    # tick mismatch / exhausted rungs: re-open from
                     # our authoritative cumulative columns (ladder);
                     # a "draining" refusal is transient — the
                     # replacement server admits, so keep trying
@@ -290,6 +359,8 @@ def run_load(
     restart_mode: str = "crash",
     ckpt_dir: Optional[str] = None,
     ckpt_every: int = 1,
+    processes: int = 1,
+    chaos: Optional[str] = None,
 ) -> dict:
     """Run the harness; returns the report dict (see module docstring).
 
@@ -307,7 +378,18 @@ def run_load(
     fresh servicer on the same port rehydrates from ``ckpt_dir``
     (a temp dir when None). Sessions ride the production ladder
     through the outage; with checkpoints on, they resume WARM (zero
-    reopens, counted in the report)."""
+    reopens, counted in the report).
+
+    ``processes > 1`` runs the DISTRIBUTED fleet instead: N real
+    servicer subprocesses behind the consistent-hash endpoint ring,
+    sessions routed (with ordered failover lists) by
+    :class:`~protocol_tpu.dfleet.topology.FleetTopology` over a shared
+    journal root. ``restart_at_tick`` then arms the PROCESS drill —
+    ``crash`` SIGKILLs one process (``ChaosConfig.kill_proc`` via the
+    ``chaos`` spec; default process 1) and re-routes its orphaned
+    journals along the ring; ``drain`` live-migrates its sessions off
+    first (Migrate RPC + "moved:" redirects), then SIGTERMs it. The
+    report adds per-process scrape summaries and migration counters."""
     from protocol_tpu.fleet.fabric import FleetConfig
     from protocol_tpu.services.scheduler_grpc import serve
     from protocol_tpu.trace import format as tfmt
@@ -316,6 +398,18 @@ def run_load(
     if restart_mode not in ("crash", "drain"):
         raise ValueError(
             f"restart_mode must be crash|drain, got {restart_mode!r}"
+        )
+    if int(processes) > 1:
+        return _run_load_processes(
+            sessions=sessions, tenants=tenants, providers=providers,
+            tasks=tasks, ticks=ticks, churn=churn, kernel=kernel,
+            shards=shards, skew=skew, traces=traces,
+            max_workers=max_workers, max_sessions=max_sessions,
+            seed=seed, restart_at_tick=restart_at_tick,
+            restart_mode=restart_mode, ckpt_dir=ckpt_dir,
+            ckpt_every=ckpt_every, processes=int(processes),
+            chaos=chaos, admit_rate=admit_rate, max_bytes=max_bytes,
+            queue_depth=queue_depth,
         )
     sessions = int(sessions)
     tenants = max(1, min(int(tenants), sessions))
@@ -617,6 +711,339 @@ def run_load(
     return report
 
 
+def _run_load_processes(
+    sessions: int,
+    tenants: int,
+    providers: int,
+    tasks: int,
+    ticks: int,
+    churn: float,
+    kernel: str,
+    shards: int,
+    skew: bool,
+    traces,
+    max_workers: int,
+    max_sessions,
+    seed: int,
+    restart_at_tick,
+    restart_mode: str,
+    ckpt_dir,
+    ckpt_every: int,
+    processes: int,
+    chaos,
+    admit_rate=None,
+    max_bytes=None,
+    queue_depth: int = 8,
+) -> dict:
+    """The distributed-fleet harness behind ``run_load(processes=N)``:
+    real subprocesses, ring routing, the process-level kill/migrate
+    drills, per-process scrape in the report. Client-side driving is
+    the SAME ``_drive_session`` as the single-process harness — the
+    failover/moved/handoff rungs are the only additions, and they are
+    inert at one endpoint."""
+    from protocol_tpu.dfleet.manager import ProcessFleet
+    from protocol_tpu.faults.plan import ChaosConfig
+    from protocol_tpu.trace import format as tfmt
+    from protocol_tpu.trace.synth import synth_trace
+
+    chaos_cfg = (
+        ChaosConfig.from_spec(chaos) if isinstance(chaos, str)
+        else (chaos or ChaosConfig())
+    )
+    # drill selection: an explicit --restart-at-tick uses restart_mode;
+    # otherwise the CHAOS KNOB that armed the tick picks the action —
+    # kill_proc_at_tick is always the crash drill and migrate_at_tick
+    # always the live-migrate+drain drill, regardless of the
+    # restart_mode default
+    if restart_at_tick is not None:
+        drill_tick = restart_at_tick
+        drill_mode = restart_mode
+        drill_proc = (
+            chaos_cfg.migrate_proc if drill_mode == "drain"
+            else chaos_cfg.kill_proc
+        )
+    elif chaos_cfg.kill_proc_at_tick is not None:
+        drill_tick = chaos_cfg.kill_proc_at_tick
+        drill_mode = "crash"
+        drill_proc = chaos_cfg.kill_proc
+    elif chaos_cfg.migrate_at_tick is not None:
+        drill_tick = chaos_cfg.migrate_at_tick
+        drill_mode = "drain"
+        drill_proc = chaos_cfg.migrate_proc
+    else:
+        drill_tick = None
+        drill_mode = restart_mode
+        drill_proc = chaos_cfg.kill_proc
+    sessions = int(sessions)
+    tenants = max(1, min(int(tenants), sessions))
+    tmpdir = None
+    if traces is None:
+        tmpdir = tempfile.TemporaryDirectory(prefix="dfleet_loadgen_")
+        traces = [
+            synth_trace(
+                os.path.join(tmpdir.name, f"tenant{t}.trace"),
+                n_providers=providers, n_tasks=tasks, ticks=ticks,
+                churn=churn, seed=seed + t, kernel=kernel,
+            )
+            for t in range(tenants)
+        ]
+    parsed = [tfmt.read_trace(p) for p in traces]
+
+    sids: list[tuple[str, object]] = []
+    for i in range(sessions):
+        if skew and tenants > 1:
+            t = 0 if i == 0 else 1 + (i - 1) % (tenants - 1)
+        else:
+            t = i % tenants
+        sids.append((f"t{t}@s{i}", parsed[t % len(parsed)]))
+
+    env_extra = {}
+    if isinstance(chaos, str) and chaos:
+        # rate faults (drop/delay/...) fire inside every process's own
+        # seeded interceptor; the scripted process events stay DRIVER-
+        # owned here (a process cannot kill -9 itself cleanly)
+        env_extra["PROTOCOL_TPU_CHAOS"] = chaos
+    # admission/budget knobs ride the FleetConfig env surface into each
+    # process (proc.py builds from_env then overrides only identity
+    # fields) — a CLI knob accepted next to --processes must configure
+    # the fleet, not silently measure against defaults
+    if admit_rate is not None:
+        env_extra["PROTOCOL_TPU_FLEET_ADMIT_RATE"] = str(admit_rate)
+    if max_bytes is not None:
+        env_extra["PROTOCOL_TPU_FLEET_MAX_BYTES"] = str(int(max_bytes))
+    if queue_depth != 8:
+        env_extra["PROTOCOL_TPU_FLEET_QUEUE_DEPTH"] = str(
+            int(queue_depth)
+        )
+    fleet = ProcessFleet(
+        processes=processes,
+        journal_root=ckpt_dir,
+        shards=shards,
+        max_sessions=max_sessions or max(sessions, 8),
+        max_workers=max_workers,
+        ckpt_every=ckpt_every,
+        env_extra=env_extra,
+        discovery=True,
+    )
+    all_stats = [_SessionStats(sid) for sid, _ in sids]
+    drill_report: dict = {}
+
+    def _drill_controller(driver_threads):
+        while True:
+            live = [st for st in all_stats if not st.error]
+            if not live:
+                return
+            if min(st.ticks_done for st in live) >= drill_tick:
+                break
+            if not any(th.is_alive() for th in driver_threads):
+                return  # drill tick unreachable: reported, not spun on
+            time.sleep(0.01)
+        # if the configured target serves ZERO sessions (ring luck with
+        # few sessions and ephemeral ports), retarget to the busiest
+        # process — a drill that kills/migrates an idle process proves
+        # nothing about recovery
+        target = drill_proc
+        topo = fleet.topology
+        by_ep: dict = {}
+        for st in all_stats:
+            ep = topo.endpoint_for(st.sid)
+            by_ep[ep] = by_ep.get(ep, 0) + 1
+        if by_ep and not by_ep.get(fleet.proc_at(target).address):
+            busiest = max(by_ep, key=lambda e: by_ep[e])
+            target = next(
+                p.index for p in fleet.procs if p.address == busiest
+            )
+            drill_report["retargeted"] = True
+        if drill_mode == "drain":
+            # LIVE migration first (the source keeps answering with
+            # "moved:" redirects while sessions rehydrate at the
+            # target), then the graceful SIGTERM
+            drill_report["migrated"] = fleet.migrate_all(target)
+            fleet.drain(target)
+            drill_report["drained"] = True
+        else:
+            fleet.kill(target)
+            drill_report["killed"] = True
+            moved = fleet.handoff_dead(target)
+            drill_report["journals_rerouted"] = len(moved)
+        drill_report["proc"] = fleet.proc_at(target).proc_id
+        drill_report["generation"] = fleet.topology.generation
+
+    t_wall = time.perf_counter()
+    try:
+        fleet.start()
+        topo = fleet.topology
+        threads = [
+            threading.Thread(
+                target=_drive_session,
+                args=(
+                    topo.failover_order(st.sid), trace, st.sid, kernel,
+                    st,
+                ),
+                name=f"dfleet-loadgen-{st.sid}",
+            )
+            for (_, trace), st in zip(sids, all_stats)
+        ]
+        if drill_tick is not None:
+            threads.append(threading.Thread(
+                target=_drill_controller, args=(list(threads),),
+                name="dfleet-loadgen-drill",
+            ))
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall_s = time.perf_counter() - t_wall
+        scrapes = fleet.scrape()
+        topology_out = fleet.topology.to_dict()
+        # drain (don't kill) the survivors: each dumps its lock-witness
+        # verdict at SIGTERM — reading witness files before this would
+        # make the "zero violations in surviving processes" bar vacuous
+        # (a SIGKILLed process writes nothing)
+        for p in list(fleet.live()):
+            try:
+                fleet.drain(p.index)
+            except Exception:
+                pass
+        witness = fleet.witness_violations()
+    finally:
+        fleet.stop()
+        if tmpdir is not None:
+            tmpdir.cleanup()
+
+    # ---------------- aggregation (client-side) ----------------
+    by_tenant: dict[str, dict] = {}
+    session_rates = []
+    errors = []
+    total_warm_ticks = 0
+    fleet_warm = LatencyHistogram()
+    for st in all_stats:
+        if st.error:
+            errors.append({"session": st.sid, "error": st.error})
+        agg = by_tenant.setdefault(
+            st.tenant,
+            {
+                "sessions": 0,
+                "warm_hist": LatencyHistogram(),
+                "cold_hist": LatencyHistogram(),
+                "min_assigned_frac": 1.0,
+                "ticks_done": 0, "refused": 0, "reopens": 0,
+                "transport_retries": 0, "stale": 0, "replayed": 0,
+                "moved_redirects": 0, "failovers": 0,
+                "handoff_waits": 0,
+            },
+        )
+        agg["sessions"] += 1
+        for w in st.warm:
+            agg["warm_hist"].observe_ms(w)
+            fleet_warm.observe_ms(w)
+        for c in st.cold_ms:
+            agg["cold_hist"].observe_ms(c)
+        agg["min_assigned_frac"] = min(
+            agg["min_assigned_frac"], st.assigned_frac_min
+        )
+        for key in (
+            "ticks_done", "refused", "reopens", "transport_retries",
+            "stale", "replayed", "moved_redirects", "failovers",
+            "handoff_waits",
+        ):
+            agg[key] += getattr(st, key)
+        total_warm_ticks += len(st.warm)
+        if st.wall_s > 0:
+            session_rates.append(len(st.warm) / st.wall_s)
+
+    def _proc_summary(snap) -> Optional[dict]:
+        """The per-process slice the fleet report needs: migration/
+        restore counters plus that process's own warm-tick view."""
+        if snap is None:
+            return None
+        seam = snap.get("seam") or {}
+        obs = snap.get("obs") or {}
+        out = {
+            k.replace("session_", "", 1): int(v)
+            for k, v in seam.items()
+            if k.startswith("session_") and any(
+                m in k for m in (
+                    "open", "restored", "rehydrated", "migrated",
+                    "moved", "reopen", "hit", "replayed", "stale",
+                )
+            )
+        }
+        for entry in (obs.get("sessions") or {}).values():
+            tick = entry.get("tick") or {}
+            if tick.get("count"):
+                # scrape gives quantiles, not raw observations: carry
+                # p50/p99 per session and report the worst-case p99
+                out.setdefault("session_p99s_ms", []).append(
+                    tick.get("p99_ms", 0.0)
+                )
+        if "session_p99s_ms" in out:
+            p99s = out.pop("session_p99s_ms")
+            out["warm_tick_p99_ms_max"] = max(p99s)
+            out["sessions_observed"] = len(p99s)
+        return out
+
+    tenants_out = {
+        t: {
+            "sessions": a["sessions"],
+            "warm_tick": a["warm_hist"].snapshot_ms(),
+            "cold_tick": a["cold_hist"].snapshot_ms(),
+            "min_assigned_frac": round(a["min_assigned_frac"], 4),
+            **{k: a[k] for k in (
+                "ticks_done", "refused", "reopens",
+                "transport_retries", "stale", "replayed",
+                "moved_redirects", "failovers", "handoff_waits",
+            )},
+        }
+        for t, a in sorted(by_tenant.items())
+    }
+
+    report = {
+        "config": {
+            "sessions": sessions, "tenants": tenants,
+            "providers": providers, "tasks": tasks, "ticks": ticks,
+            "churn": churn, "kernel": kernel, "shards": shards,
+            "skew": skew, "seed": seed, "processes": processes,
+            "chaos": chaos if isinstance(chaos, str) else None,
+            "restart_at_tick": restart_at_tick,
+            "restart_mode": (
+                drill_mode if drill_tick is not None else None
+            ),
+            "ckpt_every": ckpt_every,
+        },
+        "wall_s": round(wall_s, 3),
+        "total_warm_ticks": total_warm_ticks,
+        "aggregate_warm_ticks_per_s": round(
+            total_warm_ticks / wall_s if wall_s > 0 else 0.0, 2
+        ),
+        "fleet_warm_tick": fleet_warm.snapshot_ms(),
+        "fairness_index_sessions": jain_index(session_rates),
+        "tenants": tenants_out,
+        "errors": errors,
+        "topology": topology_out,
+        "processes": {
+            pid: _proc_summary(snap) for pid, snap in scrapes.items()
+        },
+        "witness_violations": witness,
+        "migration": {
+            "moved_redirects": sum(
+                st.moved_redirects for st in all_stats
+            ),
+            "failovers": sum(st.failovers for st in all_stats),
+            "handoff_waits": sum(st.handoff_waits for st in all_stats),
+            "reopens_total": sum(st.reopens for st in all_stats),
+            "replayed_total": sum(st.replayed for st in all_stats),
+            "stale_total": sum(st.stale for st in all_stats),
+        },
+    }
+    if drill_tick is not None:
+        report["drill"] = {
+            "mode": drill_mode, "at_tick": drill_tick,
+            **drill_report,
+        }
+    return report
+
+
 def _print_report(rep: dict) -> None:
     cfg = rep["config"]
     print(
@@ -655,20 +1082,44 @@ def _print_report(rep: dict) -> None:
             f"{a['min_assigned_frac']:>12} {a['refused']:>8} "
             f"{a['reopens']:>8}{quality}"
         )
-    fl = rep["server_obs"].get("fleet", {})
+    fl = rep.get("server_obs", {}).get("fleet", {})
     if fl:
         print(
             f"  shards {fl.get('shards')} | arena "
             f"{fl.get('total_bytes', 0) / 1e6:.1f} MB | pressure "
             f"evictions {fl.get('pressure_evictions', 0)}"
         )
-    bud = rep["server_obs"].get("budget", {})
+    bud = rep.get("server_obs", {}).get("budget", {})
     if bud:
         print(
             f"  thread budget: grants {bud.get('grants')} "
             f"(degraded {bud.get('degraded_grants')}), fairness gauge "
             f"{bud.get('fairness_index')}"
         )
+    mig = rep.get("migration")
+    if mig:
+        print(
+            f"  dfleet: failovers {mig['failovers']} | moved redirects "
+            f"{mig['moved_redirects']} | handoff waits "
+            f"{mig['handoff_waits']} | replayed {mig['replayed_total']}"
+            f" | stale {mig['stale_total']} | reopens "
+            f"{mig['reopens_total']}"
+        )
+        for pid, p in sorted((rep.get("processes") or {}).items()):
+            if p is None:
+                print(f"  {pid}: (down)")
+                continue
+            line = " ".join(
+                f"{k}={v}" for k, v in sorted(p.items())
+                if not isinstance(v, float)
+            )
+            p99 = p.get("warm_tick_p99_ms_max")
+            if p99 is not None:
+                line += f" warm_p99_max={p99}ms"
+            print(f"  {pid}: {line}")
+        drill = rep.get("drill")
+        if drill:
+            print(f"  drill: {drill}")
     rs = rep.get("restart")
     if rs:
         print(
@@ -681,16 +1132,17 @@ def _print_report(rep: dict) -> None:
             + (f" | drain-flushed {rs['flushed']}" if "flushed" in rs
                else "")
         )
-    sc = rep["scaling"]
-    print(
-        f"  scaling ({sc['model']}): measured "
-        f"{sc['measured_warm_ticks_per_s']}/s on "
-        f"{sc['measured_cores']} cores -> "
-        + ", ".join(
-            f"{c}c: {v}/s"
-            for c, v in sc["projected_warm_ticks_per_s"].items()
+    sc = rep.get("scaling")
+    if sc:
+        print(
+            f"  scaling ({sc['model']}): measured "
+            f"{sc['measured_warm_ticks_per_s']}/s on "
+            f"{sc['measured_cores']} cores -> "
+            + ", ".join(
+                f"{c}c: {v}/s"
+                for c, v in sc["projected_warm_ticks_per_s"].items()
+            )
         )
-    )
     if rep["errors"]:
         print(f"  ERRORS ({len(rep['errors'])}):")
         for e in rep["errors"][:8]:
@@ -729,6 +1181,16 @@ def main(argv=None) -> int:
                     default="crash")
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=1)
+    ap.add_argument("--processes", type=int, default=1,
+                    help="N > 1 runs the DISTRIBUTED fleet: N real "
+                         "servicer subprocesses behind the endpoint "
+                         "ring over a shared journal root; the restart "
+                         "drill becomes the process kill/migrate drill")
+    ap.add_argument("--chaos", default=None,
+                    help="seeded chaos spec (faults.plan.ChaosConfig): "
+                         "rate faults arm every process's interceptor; "
+                         "kill_proc_at_tick/migrate_at_tick script the "
+                         "driver-owned process drills")
     ap.add_argument("--out", default=None, help="write the JSON report")
     ap.add_argument("--smoke", action="store_true",
                     help="exit non-zero unless every session completed "
@@ -748,6 +1210,7 @@ def main(argv=None) -> int:
         restart_at_tick=args.restart_at_tick,
         restart_mode=args.restart_mode,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+        processes=args.processes, chaos=args.chaos,
     )
     _print_report(rep)
     if args.out:
@@ -774,6 +1237,30 @@ def main(argv=None) -> int:
                 "restart": rs["mode"],
                 "error": "restart controller never fired",
             })
+        drill = rep.get("drill")
+        if drill:
+            mig = rep["migration"]
+            if mig["reopens_total"] > 0:
+                bad.append({
+                    "drill": drill["mode"],
+                    "error": f"{mig['reopens_total']} full-snapshot "
+                             "reopens after the process drill — "
+                             "recovery was not warm",
+                })
+            if not (drill.get("killed") or drill.get("drained")):
+                bad.append({
+                    "drill": drill["mode"],
+                    "error": "process drill never fired",
+                })
+            for pid, viols in (
+                rep.get("witness_violations") or {}
+            ).items():
+                if viols:
+                    bad.append({
+                        "proc": pid,
+                        "error": f"{len(viols)} lock-order witness "
+                                 "violation(s)",
+                    })
         if bad:
             print(f"SMOKE FAIL: {bad}")
             return 1

@@ -299,10 +299,15 @@ class ObsRegistry:
         # (the servicer appends them to the trace as event frames)
         self._slo = None
         self._alerts = deque(maxlen=256)
+        # dfleet process identity: stamps every snapshot/scrape so a
+        # multi-process join (loadgen --processes, the fleet manager's
+        # scrape) can tell which process it is reading without relying
+        # on port bookkeeping
+        self._proc_id = None
 
     def attach(
         self, budget=None, store=None, fleet=None, admission=None,
-        slo=None,
+        slo=None, proc_id=None,
     ) -> None:
         if budget is not None:
             self._budget = budget
@@ -314,6 +319,8 @@ class ObsRegistry:
             self._admission = admission
         if slo is not None:
             self._slo = slo
+        if proc_id is not None:
+            self._proc_id = str(proc_id)
 
     # ---------------- recording ----------------
 
@@ -459,6 +466,8 @@ class ObsRegistry:
         out: dict = {
             "role": self.role, "sessions": sessions, "tenants": tenants,
         }
+        if self._proc_id is not None:
+            out["proc_id"] = self._proc_id
         budget = self._budget
         if budget is not None:
             avail = budget.available

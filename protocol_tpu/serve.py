@@ -566,9 +566,30 @@ def serve_scheduler(args) -> None:
 
     from protocol_tpu.services.scheduler_grpc import drain, serve
 
+    fleet = None
+    if args.proc_id or args.ckpt_dir or args.endpoint:
+        # dfleet pod identity: flags override the PROTOCOL_TPU_FLEET_*
+        # env (the charts' surface), same precedence as everywhere else
+        import dataclasses
+
+        from protocol_tpu.fleet.fabric import FleetConfig
+
+        fleet = FleetConfig.from_env()
+        overrides = {}
+        if args.proc_id:
+            overrides["proc_id"] = args.proc_id
+        if args.ckpt_dir:
+            overrides["ckpt_dir"] = args.ckpt_dir
+        # precedence: flag > PROTOCOL_TPU_FLEET_ENDPOINT env > bind
+        # address (the env value must survive an unrelated flag — a
+        # moved:<bind-address> redirect would hand clients 0.0.0.0)
+        overrides["endpoint"] = (
+            args.endpoint or fleet.endpoint or args.address
+        )
+        fleet = dataclasses.replace(fleet, **overrides)
     server = serve(
         address=args.address, max_workers=args.max_workers,
-        metrics_port=args.metrics_port,
+        metrics_port=args.metrics_port, fleet=fleet,
     )
     print(f"scheduler backend on {args.address} (version {VERSION})", flush=True)
     if server.metrics is not None:
@@ -590,6 +611,76 @@ def serve_scheduler(args) -> None:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     server.wait_for_termination()
+
+
+def serve_dfleet(args) -> int:
+    """N scheduler servicer processes + the discovery endpoint — the
+    whole distributed fleet from one command (the compose/Helm
+    equivalent execs one ``scheduler`` pod per process and a discovery
+    pod instead; this is the single-host shape and the local drill)."""
+    import signal
+
+    from protocol_tpu.dfleet.discovery import DiscoveryEndpoint
+    from protocol_tpu.dfleet.manager import ProcessFleet
+
+    fleet = ProcessFleet(
+        processes=args.processes,
+        journal_root=args.journal_root,
+        shards=args.shards,
+        max_sessions=args.max_sessions,
+        max_workers=args.max_workers,
+    )
+    fleet.start()
+    disco = DiscoveryEndpoint(
+        lambda: fleet.topology, port=args.discovery_port
+    )
+    print(
+        f"dfleet: {args.processes} servicer process(es) "
+        f"{[p.address for p in fleet.procs]} (version {VERSION})",
+        flush=True,
+    )
+    print(f"discovery on {disco.url}/fleet.json", flush=True)
+
+    stop = []
+
+    def _on_signal(signum, frame):
+        stop.append(signum)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        import time as _time
+
+        while not stop:
+            _time.sleep(0.5)
+            for p in fleet.live():
+                if p.popen is not None and p.popen.poll() is not None:
+                    # a process died underneath us: re-route its
+                    # journals so the survivors serve its sessions warm
+                    print(
+                        f"dfleet: {p.proc_id} exited "
+                        f"(rc={p.popen.returncode}); re-routing "
+                        "journals", flush=True,
+                    )
+                    p.alive = False
+                    fleet.drop_endpoint(p.address)
+                    moved = fleet.handoff_dead(p.index)
+                    print(
+                        f"dfleet: {len(moved)} journal(s) re-routed",
+                        flush=True,
+                    )
+    finally:
+        # graceful fleet drain: SIGTERM every live process (each
+        # flushes its journals and exits 0), then stop discovery
+        for p in fleet.live():
+            try:
+                fleet.drain(p.index)
+            except Exception:
+                pass
+        disco.stop()
+        fleet.stop()
+    print("dfleet: drained and stopped", flush=True)
+    return 0
 
 
 async def serve_worker(args) -> None:
@@ -870,6 +961,37 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="consolidated /metrics scrape endpoint (obs plane); also "
              "via PROTOCOL_TPU_METRICS_PORT",
     )
+    p.add_argument(
+        "--proc-id", default=None,
+        help="dfleet process id: namespaces this pod's checkpoint "
+             "journals under the shared --ckpt-dir root (also "
+             "PROTOCOL_TPU_FLEET_PROC_ID)",
+    )
+    p.add_argument(
+        "--ckpt-dir", default=None,
+        help="shared checkpoint-journal root (warm restart + live "
+             "migration handoff; also PROTOCOL_TPU_FLEET_CKPT_DIR)",
+    )
+    p.add_argument(
+        "--endpoint", default=None,
+        help="advertised endpoint for moved:<endpoint> migration "
+             "redirects (default: --address; also "
+             "PROTOCOL_TPU_FLEET_ENDPOINT)",
+    )
+
+    p = sub.add_parser(
+        "dfleet",
+        help="N scheduler servicer processes behind the consistent-"
+        "hash endpoint ring with a discovery endpoint, over one shared "
+        "journal root (the multi-process deployment shape)",
+    )
+    p.add_argument("--processes", type=int, default=3)
+    p.add_argument("--journal-root", required=True)
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--max-sessions", type=int, default=64)
+    p.add_argument("--max-workers", type=int, default=8)
+    p.add_argument("--discovery-port", type=int, default=0,
+                   help="discovery endpoint port (0 = ephemeral)")
 
     p = sub.add_parser("ledger-api")
     p.add_argument("--port", type=int, default=8095)
@@ -923,7 +1045,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", forced)
-    if args.service not in ("scheduler", "ledger-api", "kv-api"):
+    if args.service not in ("scheduler", "dfleet", "ledger-api", "kv-api"):
         if not args.ledger_url:
             parser.error("--ledger-url (or LEDGER_URL env) required")
         if args.pool_id < 0:
@@ -931,6 +1053,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.service == "scheduler":
         serve_scheduler(args)
         return 0
+    if args.service == "dfleet":
+        return serve_dfleet(args)
     if args.service == "bootstrap":
         return run_bootstrap(args)
     coro = {

@@ -261,6 +261,7 @@ class SchedulerBackendServicer:
             fleet=self.sessions,
             admission=self.admission,
             slo=self.slo,
+            proc_id=cfg.proc_id,
         )
         # flight recorder (PROTOCOL_TPU_TRACE=<path>): any solve served by
         # this backend records its exact inputs + outcomes — unary calls
@@ -282,11 +283,30 @@ class SchedulerBackendServicer:
         # stops admitting, in-flight ticks finish, checkpoints flush.
         self.draining = False
         self.ckpt = None
+        # ---- distributed fleet (dfleet) router state. ``_moved`` maps
+        # a migrated-away session to the endpoint now serving it — the
+        # "moved:<endpoint>" redirect answer the client ladder follows
+        # warm. ``_no_rehydrate`` tombstones sessions this process
+        # itself evicted (lru/pressure/chaos): eviction exists to
+        # RELEASE memory, so the lazy journal rehydrate below must not
+        # resurrect the victim on its next delta — the PR 9 contract
+        # (eviction = one counted reopen) stands. Both are bounded and
+        # guarded by the leaf ``router`` lock (dict ops only, safely
+        # acquirable from under a shard lock in the eviction callback).
+        from collections import OrderedDict as _ODict
+
+        self._router_lock = make_lock("router")
+        self._moved: "_ODict[str, str]" = _ODict()
+        self._no_rehydrate: "_ODict[str, bool]" = _ODict()
+        self._rehydrating: set = set()
+        self._migrating: set = set()
+        self.proc_id = cfg.proc_id
+        self.endpoint = cfg.endpoint
         if cfg.ckpt_dir:
             from protocol_tpu.faults.checkpoint import SessionCheckpointer
 
             self.ckpt = SessionCheckpointer(
-                cfg.ckpt_dir, every=cfg.ckpt_every
+                cfg.ckpt_dir, every=cfg.ckpt_every, proc_id=cfg.proc_id
             )
             # newest-first, capped at the session budget: stale files
             # must never crowd the restore past max_sessions (the put
@@ -301,12 +321,163 @@ class SchedulerBackendServicer:
             # session at every restart, growing ckpt_dir without bound.
             # lru/pressure/replace keep their files: the session is
             # alive client-side (or the file already belongs to the
-            # same-id successor, which flushed over it at open).
+            # same-id successor, which flushed over it at open). Every
+            # OTHER involuntary let-go additionally tombstones the
+            # session against LAZY rehydration (see _router_lock note).
             def _ckpt_gc(session, reason: str) -> None:
                 if reason in ("ttl", "drop"):
                     self.ckpt.drop(session.session_id)
+                elif reason not in ("migrate", "replace"):
+                    self._router_tombstone(session.session_id)
 
             self.sessions.on_let_go = _ckpt_gc
+
+    # ---------------- dfleet router surface ----------------
+
+    _ROUTER_CAP = 4096  # bound for the moved/tombstone maps (client-
+    # minted session ids; same rationale as fabric._MAX_TENANT_KEYS)
+
+    def _router_tombstone(self, session_id: str) -> None:
+        with self._router_lock:
+            self._no_rehydrate[session_id] = True
+            while len(self._no_rehydrate) > self._ROUTER_CAP:
+                self._no_rehydrate.popitem(last=False)
+
+    def _router_adopt(self, session_id: str) -> None:
+        """A session was (re)opened or rehydrated HERE: this process
+        owns it now — clear any stale redirect/tombstone so its deltas
+        are served, not bounced."""
+        with self._router_lock:
+            self._moved.pop(session_id, None)
+            self._no_rehydrate.pop(session_id, None)
+
+    def _moved_to(self, session_id: str) -> Optional[str]:
+        """Where this session was migrated to, or None. The JOURNAL'S
+        LOCATION is the authority and the redirect map only a cache: if
+        the journal is back in OUR namespace (the target died and the
+        ring re-routed it here), the stale redirect would bounce
+        clients at a corpse forever — adopt the session back instead."""
+        with self._router_lock:
+            moved = self._moved.get(session_id)
+            in_flight = session_id in self._migrating
+        if moved is None:
+            return None
+        # in-flight migration: the journal is legitimately still here
+        # (flush happens after the redirect is recorded) — the redirect
+        # stands, and the client's handoff-wait rung covers the rename
+        if not in_flight and self.ckpt is not None and os.path.exists(
+            self.ckpt.path_for(session_id)
+        ):
+            self._router_adopt(session_id)
+            return None
+        return moved
+
+    def _rehydrate(self, session_id: str, fingerprint: str):
+        """Lazy warm restore behind a delta miss: if this process's
+        journal namespace holds the session (a migration handoff landed
+        it here, or a crash-restart's boot cap skipped it), load and
+        adopt it. None = nothing to restore (the caller answers the
+        miss normally). Single-flight per session id: a concurrent miss
+        returns None and rides the client's bounded handoff-wait rung."""
+        if self.ckpt is None:
+            return None
+        with self._router_lock:
+            if (
+                session_id in self._no_rehydrate
+                or session_id in self._moved
+                or session_id in self._rehydrating
+            ):
+                return None
+            self._rehydrating.add(session_id)
+        try:
+            loaded = self.ckpt.load_one(
+                session_id, budget=self._engine_budget
+            )
+            if loaded is None:
+                return None
+            self.sessions.put(loaded)
+            self.seam.count("session_rehydrated")
+        finally:
+            with self._router_lock:
+                self._rehydrating.discard(session_id)
+        session, _ = self.sessions.get(session_id, fingerprint)
+        return session
+
+    def migrate_out(
+        self,
+        target_endpoint: str,
+        target_proc_id: str,
+        session_ids=None,
+    ) -> int:
+        """Live-drain sessions onto another process: record the
+        redirect FIRST (a delta racing the eviction is answered
+        "moved:", never "unknown"), evict (in-flight solves refuse via
+        the evicted flag), flush the journal at its final tick, and
+        hand it off atomically into the target's namespace. The target
+        rehydrates each session warm on its first redirected delta —
+        zero client reopens, and the tick-cursor/CRC dedup carries the
+        retransmit guarantee across the boundary."""
+        if self.ckpt is None:
+            return 0
+        wanted = set(session_ids) if session_ids else None
+        moved = 0
+        for session in self.sessions.snapshot_sessions():
+            sid = session.session_id
+            if wanted is not None and sid not in wanted:
+                continue
+            with self._router_lock:
+                self._moved[sid] = target_endpoint
+                self._migrating.add(sid)
+                while len(self._moved) > self._ROUTER_CAP:
+                    self._moved.popitem(last=False)
+            try:
+                self.sessions.shard_of(sid).evict(sid, reason="migrate")
+                with session.lock:
+                    flushed = self.ckpt.flush_locked(session)
+                if not flushed or not self.ckpt.handoff(
+                    sid, target_proc_id
+                ):
+                    # no journal to move (flush failed / never
+                    # flushed): drop the redirect — the client's ladder
+                    # re-opens at the target instead of chasing a
+                    # journal that is not there (counted, explicit, the
+                    # pre-dfleet contract)
+                    with self._router_lock:
+                        self._moved.pop(sid, None)
+                    continue
+            finally:
+                with self._router_lock:
+                    self._migrating.discard(sid)
+            moved += 1
+            self.seam.count("session_migrated_out")
+        return moved
+
+    def Migrate(
+        self, request: pb.MigrateRequest, context
+    ) -> pb.MigrateResponse:
+        """Admin surface for live migration (the dfleet manager and
+        rolling-upgrade drills call this; it is not on any client hot
+        path)."""
+        with self._rpc_span("rpc.Migrate", context):
+            if not request.target_endpoint or not request.target_proc_id:
+                return pb.MigrateResponse(
+                    ok=False,
+                    error="UNAVAILABLE: migrate needs target_endpoint "
+                          "and target_proc_id",
+                )
+            if self.ckpt is None:
+                return pb.MigrateResponse(
+                    ok=False,
+                    error="UNAVAILABLE: no checkpoint journal "
+                          "configured (ckpt_dir unset) — nothing to "
+                          "hand off",
+                )
+            moved = self.migrate_out(
+                request.target_endpoint,
+                request.target_proc_id,
+                list(request.session_ids) or None,
+            )
+            return pb.MigrateResponse(ok=True, moved=moved)
 
     # ---------------- shared kernel dispatch ----------------
 
@@ -841,6 +1012,17 @@ class SchedulerBackendServicer:
                 error="UNAVAILABLE: draining, not admitting new "
                       "sessions (retry against the replacement)",
             )
+        if session_id:
+            moved = self._moved_to(session_id)
+            if moved is not None:
+                # dfleet: this session was live-migrated away — even a
+                # re-open belongs at its new home (opening it HERE would
+                # fork ownership: two processes each believing they hold
+                # the authoritative arena)
+                self.seam.count("moved_refused")
+                return pb.OpenSessionResponse(
+                    ok=False, error=f"moved:{moved}"
+                )
         # tenant admission BEFORE the expensive decode + cold solve: an
         # over-rate tenant costs the server one token-bucket check, not
         # a snapshot decode. The refusal is a protocol answer on the
@@ -921,6 +1103,7 @@ class SchedulerBackendServicer:
                     self.ckpt.flush_locked(session)
         t_solve = time.perf_counter()
         self.sessions.put(session)
+        self._router_adopt(session.session_id)
         self.seam.count("session_open")
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms("solve", (t_solve - t_dec) * 1e3)
@@ -991,6 +1174,20 @@ class SchedulerBackendServicer:
         session, reason = self.sessions.get(
             request.session_id, request.epoch_fingerprint
         )
+        if session is None and reason == "unknown session":
+            # dfleet: a migrated-away session answers with its new home
+            # (the client rebinds and resends the SAME delta — warm);
+            # a session whose journal was handed TO us rehydrates here
+            # lazily and the delta proceeds as if it never moved
+            moved = self._moved_to(request.session_id)
+            if moved is not None:
+                self.seam.count("moved_refused")
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error=f"moved:{moved}"
+                )
+            session = self._rehydrate(
+                request.session_id, request.epoch_fingerprint
+            )
         if session is None:
             self.seam.count("session_miss")
             return pb.AssignDeltaResponse(session_ok=False, error=reason)
@@ -1284,6 +1481,9 @@ class SchedulerBackendServicer:
             seam["ckpt_flush_failures"] = float(
                 self.ckpt.flush_failures
             )
+            seam["ckpt_handoffs"] = float(self.ckpt.handoffs)
+        with self._router_lock:
+            seam["sessions_moved_out"] = float(len(self._moved))
         for name in sorted(seam):
             resp.seam_metrics.add(name=name, value=seam[name])
         return resp
@@ -1317,6 +1517,11 @@ def _handlers(servicer: SchedulerBackendServicer) -> grpc.GenericRpcHandler:
                 servicer.Health,
                 request_deserializer=pb.HealthRequest.FromString,
                 response_serializer=pb.HealthResponse.SerializeToString,
+            ),
+            "Migrate": grpc.unary_unary_rpc_method_handler(
+                servicer.Migrate,
+                request_deserializer=pb.MigrateRequest.FromString,
+                response_serializer=pb.MigrateResponse.SerializeToString,
             ),
         },
     )
@@ -1456,6 +1661,11 @@ class SchedulerBackendClient:
             request_serializer=pb.HealthRequest.SerializeToString,
             response_deserializer=pb.HealthResponse.FromString,
         )
+        self._migrate = self.channel.unary_unary(
+            f"/{SERVICE_NAME}/Migrate",
+            request_serializer=pb.MigrateRequest.SerializeToString,
+            response_deserializer=pb.MigrateResponse.FromString,
+        )
 
     @staticmethod
     def _md(metadata):
@@ -1497,6 +1707,11 @@ class SchedulerBackendClient:
 
     def health(self, timeout: float = 10.0) -> pb.HealthResponse:
         return self._health(pb.HealthRequest(), timeout=timeout)
+
+    def migrate(
+        self, request: pb.MigrateRequest, timeout: float = 120.0,
+    ) -> pb.MigrateResponse:
+        return self._migrate(request, timeout=timeout)
 
     def close(self) -> None:
         self.channel.close()
@@ -1688,7 +1903,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
     def __init__(
         self,
         store,
-        address: str = "127.0.0.1:50061",
+        address="127.0.0.1:50061",
         request_timeout: float = 300.0,
         wire: str = "v1",
         chunk_bytes: int = 1 << 20,
@@ -1702,6 +1917,19 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         super().__init__(store, **kwargs)
         if wire not in ("v1", "v2"):
             raise ValueError(f"wire must be v1|v2, got {wire!r}")
+        # ``address`` accepts one endpoint, a comma-separated list, or a
+        # sequence: an ORDERED endpoint list is the dfleet failover
+        # ladder — transport failures past the first reconnect rotate
+        # to the next endpoint, and a "moved:<endpoint>" refusal
+        # rebinds directly (see rebind()).
+        if isinstance(address, (list, tuple)):
+            endpoints = [str(a) for a in address]
+        else:
+            endpoints = [a.strip() for a in str(address).split(",")]
+        self.endpoints = [e for e in endpoints if e] or [
+            "127.0.0.1:50061"
+        ]
+        self._endpoint_i = 0
         self.request_timeout = request_timeout
         # per-RPC deadline sized to the tick budget: steady-state solve
         # RPCs (unary + AssignDelta) carry this deadline so a wedged
@@ -1716,7 +1944,7 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         self.retries = retries
         self.retry_base_s = retry_base_s
         self.retry_max_s = retry_max_s
-        self.client = SchedulerBackendClient(address)
+        self.client = SchedulerBackendClient(self.endpoints[0])
         self.seam = SeamMetrics(role="client")
         self._rtt_ms: list[float] = []
         self._backend_ms: list[float] = []
@@ -1763,20 +1991,38 @@ class RemoteBatchMatcher(TpuBatchMatcher):
 
     # ---------------- transport: retry + reconnect ----------------
 
-    def _reconnect(self) -> None:
-        address = self.client.address
-        fresh = SchedulerBackendClient(address)
-        rebind = getattr(self.client, "rebind", None)
-        if callable(rebind):
-            # chaos shim (faults.inject.ChaosClient): keep the injector
-            # and its fault cursors, swap only the dead channel under it
-            rebind(fresh)
+    def rebind(self, endpoint: Optional[str] = None) -> None:
+        """Reconnect the channel — to ``endpoint`` when given (a
+        "moved:<endpoint>" migration redirect, inserted into the
+        failover list if new), else to the current endpoint. A chaos
+        shim (faults.inject.ChaosClient) keeps its injector and fault
+        cursors: only the dead channel under it is swapped."""
+        if endpoint:
+            if endpoint not in self.endpoints:
+                self.endpoints.append(endpoint)
+            self._endpoint_i = self.endpoints.index(endpoint)
+        fresh = SchedulerBackendClient(self.endpoints[self._endpoint_i])
+        shim_rebind = getattr(self.client, "rebind", None)
+        if callable(shim_rebind):
+            shim_rebind(fresh)
             return
         try:
             self.client.close()
         except Exception:
             pass
         self.client = fresh
+
+    def _reconnect(self, failover: bool = False) -> None:
+        """Fresh channel; with ``failover`` (a retry that already
+        reconnected once and failed again) rotate to the next endpoint
+        in the ordered list — a dead process's clients spread over the
+        survivors instead of hammering the corpse."""
+        if failover and len(self.endpoints) > 1:
+            self._endpoint_i = (
+                self._endpoint_i + 1
+            ) % len(self.endpoints)
+            self.seam.count("endpoint_failover")
+        self.rebind()
 
     def _backoff_s(self, attempt: int) -> float:
         """Bounded exponential backoff with deterministic jitter for
@@ -1823,7 +2069,9 @@ class RemoteBatchMatcher(TpuBatchMatcher):
                     raise
                 self.seam.count("retry")
                 time.sleep(self._backoff_s(attempt))
-                self._reconnect()
+                # first retry reconnects the SAME endpoint (transient
+                # blip); later retries fail over down the endpoint list
+                self._reconnect(failover=attempt >= 1)
 
     # ---------------- v1/v2 unary ----------------
 
@@ -1985,26 +2233,8 @@ class RemoteBatchMatcher(TpuBatchMatcher):
                 ),
                 req.ByteSize(),
             )
-        if not resp.session_ok and "RESOURCE_EXHAUSTED" in resp.error:
-            # fleet admission/backpressure throttle: the session is
-            # still alive server-side, so retry the SAME delta after a
-            # bounded jittered backoff (the token bucket refills at
-            # admit_rate) — re-opening here would AMPLIFY an over-rate
-            # tenant's load into full snapshot solves, the opposite of
-            # what the refusal asked for
-            for attempt in range(self.retries):
-                self.seam.count("throttled_retry")
-                time.sleep(self._backoff_s(attempt))
-                resp = self._timed(
-                    lambda: self.client.assign_delta(
-                        req, timeout=tick_timeout
-                    ),
-                    req.ByteSize(),
-                )
-                if resp.session_ok or (
-                    "RESOURCE_EXHAUSTED" not in resp.error
-                ):
-                    break
+        if not resp.session_ok:
+            resp = self._delta_refusal_ladder(resp, req, tick_timeout)
         if not resp.session_ok:
             # evicted / expired / served by a replica that never saw the
             # snapshot (or still throttled after the bounded retries):
@@ -2032,6 +2262,71 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             self._replayed_ticks += 1
         self._backend_ms.append(resp.result.solve_ms)
         return _res_v2(resp.result, n_providers=params[-2])
+
+    def _delta_refusal_ladder(self, resp, req, tick_timeout):
+        """Refusal handling for one delta, each rung bounded; returns
+        the final response (still not session_ok => the caller
+        re-opens, the pre-dfleet last resort).
+
+        throttle   RESOURCE_EXHAUSTED: admission/backpressure — retry
+                   the SAME delta after jittered backoff (re-opening
+                   would AMPLIFY an over-rate tenant's load into full
+                   snapshot solves, the opposite of what the refusal
+                   asked for).
+        moved      "moved:<endpoint>": live migration redirect — rebind
+                   to the new home and resend the SAME delta; the
+                   session rehydrates warm there from its handed-off
+                   journal (zero reopens is the whole point).
+        evicted    one same-delta resend: a migration races an
+                   in-flight delta as "session evicted"; the resend is
+                   answered "moved:" (follow it warm) — a GENUINE
+                   eviction answers "unknown session" and re-opens.
+        handoff    "unknown session" with >1 endpoint: the journal
+                   rename may still be in flight after a failover —
+                   or a double transport blip failed us over AWAY from
+                   the session's live home. Bounded backoff, rotating
+                   an endpoint per wait (the owner — live session or
+                   re-routed journal — is somewhere in the list), then
+                   concede to a reopen.
+        """
+        throttles = redirects = waits = 0
+        evict_retried = False
+        # snapshot the redirect budget BEFORE the loop: rebind() grows
+        # self.endpoints with each fresh redirect target, so a bound
+        # read inside the loop would chase a split-brain map forever
+        redirect_limit = len(self.endpoints) + 1
+        while not resp.session_ok:
+            err = resp.error
+            if "RESOURCE_EXHAUSTED" in err:
+                if throttles >= self.retries:
+                    break
+                self.seam.count("throttled_retry")
+                time.sleep(self._backoff_s(throttles))
+                throttles += 1
+            elif err.startswith("moved:"):
+                if redirects >= redirect_limit:
+                    break  # redirect loop (split-brain maps): re-open
+                self.seam.count("moved_redirect")
+                self.rebind(err[len("moved:"):].strip())
+                redirects += 1
+            elif "session evicted" in err and not evict_retried:
+                evict_retried = True
+            elif "unknown session" in err and len(self.endpoints) > 1:
+                if waits >= max(self.retries, len(self.endpoints)):
+                    break
+                self.seam.count("handoff_wait")
+                time.sleep(self._backoff_s(waits))
+                waits += 1
+                self._reconnect(failover=True)
+            else:
+                break
+            resp = self._timed(
+                lambda: self.client.assign_delta(
+                    req, timeout=tick_timeout
+                ),
+                req.ByteSize(),
+            )
+        return resp
 
     def _open_session(
         self, p_cols, r_cols, kernel, eps, max_iters, top_k, params, t0,
@@ -2065,6 +2360,26 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             ),
             n_bytes,
         )
+        redirects = 0
+        redirect_limit = len(self.endpoints) + 1  # pre-loop snapshot:
+        # rebind() appends fresh targets, a live bound never trips
+        while (
+            not resp.ok
+            and resp.error.startswith("moved:")
+            and redirects < redirect_limit
+        ):
+            # live-migration redirect on the OPEN itself: the session's
+            # journal lives at the new home — opening here would fork
+            # ownership, so the server bounced us there instead
+            self.seam.count("moved_redirect")
+            self.rebind(resp.error[len("moved:"):].strip())
+            redirects += 1
+            resp = self._timed(
+                lambda: self.client.open_session(
+                    iter(chunks), timeout=self.request_timeout
+                ),
+                n_bytes,
+            )
         if not resp.ok:
             if "RESOURCE_EXHAUSTED" in resp.error:
                 # admission throttle, NOT a capability refusal: this

@@ -218,6 +218,23 @@ def build_file() -> dp.FileDescriptorProto:
         ("value", 2, "double", False),
     ])
 
+    # ---------------- dfleet: live session migration (admin) ----------
+    # Drain this process's sessions onto another process: flush each
+    # session's checkpoint journal, hand the journal off atomically to
+    # the target's namespace, and answer subsequent deltas for the
+    # moved sessions with a "moved:<endpoint>" redirect. Empty
+    # session_ids = every live session (whole-process drain).
+    _msg(fd, "MigrateRequest", [
+        ("target_endpoint", 1, "string", False),
+        ("target_proc_id", 2, "string", False),
+        ("session_ids", 3, "string", True),
+    ])
+    _msg(fd, "MigrateResponse", [
+        ("ok", 1, "bool", False),
+        ("error", 2, "string", False),
+        ("moved", 3, "uint32", False),
+    ])
+
     svc = fd.service.add()
     svc.name = "SchedulerBackend"
     for name, inp, out, cstream in [
@@ -226,6 +243,7 @@ def build_file() -> dp.FileDescriptorProto:
         ("AssignV2", "AssignRequestV2", "AssignResponseV2", False),
         ("OpenSession", "SnapshotChunk", "OpenSessionResponse", True),
         ("AssignDelta", "AssignDeltaRequest", "AssignDeltaResponse", False),
+        ("Migrate", "MigrateRequest", "MigrateResponse", False),
     ]:
         m = svc.method.add()
         m.name = name

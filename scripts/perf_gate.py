@@ -84,10 +84,24 @@ flagged, counted in obs, and bounded by ``max_stale_ticks``. A
 recovery/degradation regression cannot merge on green unit tests
 alone.
 
+With ``--dfleet`` it runs the distributed-fleet gate (ISSUE 12): the
+loadgen drives sessions across THREE real servicer processes behind
+the consistent-hash endpoint ring over a shared journal root, under
+seeded drop/delay faults, and one process is SIGKILLed mid-run (its
+orphaned journals re-routed along the ring). Every session must resume
+WARM on a surviving process — zero full-snapshot reopens — with
+per-tenant assigned fraction >= ``dfleet_min_assigned_frac``, session
+fairness >= ``dfleet_fairness_floor``, staleness counted and <=
+``dfleet_max_stale_total``, and zero lock-witness violations in the
+surviving processes. A second phase live-migrates a process's sessions
+(Migrate RPC + "moved:" redirects) before a graceful drain and holds
+the same bars — so a routing/migration/handoff regression cannot merge
+on green unit tests alone.
+
 Usage: python scripts/perf_gate.py [--update-floor] [--wire] [--sinkhorn]
-[--trace] [--obs] [--fleet] [--quality] [--chaos] (--update-floor
-rewrites perf_floor.json to 25% of this machine's measured rate — run
-on the slowest supported host class, then commit.)
+[--trace] [--obs] [--fleet] [--quality] [--chaos] [--dfleet]
+(--update-floor rewrites perf_floor.json to 25% of this machine's
+measured rate — run on the slowest supported host class, then commit.)
 """
 
 import argparse
@@ -902,6 +916,117 @@ def chaos_gate() -> int:
     return 0
 
 
+def dfleet_gate() -> int:
+    """Distributed-fleet gate (the ISSUE 12 acceptance bar): kill one
+    of 3 REAL servicer processes mid-run under seeded drop/delay
+    faults; every session must resume warm on a survivor with zero
+    client reopens and bounded counted staleness. Phase B drains a
+    process by LIVE migration and holds the same bars."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the runtime lock-order witness runs INSIDE every spawned process
+    # (env is inherited); each dumps its verdict at drain/exit and the
+    # report joins them — zero violations is part of the bar
+    os.environ.setdefault("PROTOCOL_TPU_LOCK_WITNESS", "1")
+    from protocol_tpu.fleet.loadgen import run_load
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    frac_floor = floors["dfleet_min_assigned_frac"]
+    fairness_floor = floors["dfleet_fairness_floor"]
+    stale_max = int(floors["dfleet_max_stale_total"])
+    failures = []
+
+    def _check(phase: str, rep: dict, want_key: str) -> None:
+        drill = rep.get("drill") or {}
+        mig = rep["migration"]
+        # the drill retargets to a busy process, so it must have moved
+        # REAL state: a kill re-routes journals, a drain live-migrates
+        moved_state = (
+            drill.get("journals_rerouted", 0)
+            if want_key == "killed" else drill.get("migrated", 0)
+        )
+        if drill.get(want_key) and moved_state < 1:
+            failures.append(
+                f"phase {phase}: drill fired but moved no session "
+                "state — the recovery path was never exercised"
+            )
+        print(
+            f"dfleet gate {phase}: drill={drill} | failovers="
+            f"{mig['failovers']} moved={mig['moved_redirects']} "
+            f"handoff_waits={mig['handoff_waits']} replayed="
+            f"{mig['replayed_total']} stale={mig['stale_total']} "
+            f"reopens={mig['reopens_total']} | fairness="
+            f"{rep['fairness_index_sessions']} | fleet p99="
+            f"{rep['fleet_warm_tick'].get('p99_ms')}ms"
+        )
+        for err in rep["errors"]:
+            failures.append(f"phase {phase}: session error: {err}")
+        if not drill.get(want_key):
+            failures.append(
+                f"phase {phase}: the process drill never fired "
+                f"({want_key})"
+            )
+        if mig["reopens_total"] != 0:
+            failures.append(
+                f"phase {phase}: {mig['reopens_total']} full-snapshot "
+                "reopens — recovery was not warm"
+            )
+        if mig["stale_total"] > stale_max:
+            failures.append(
+                f"phase {phase}: {mig['stale_total']} stale ticks "
+                f"exceed the {stale_max} bound"
+            )
+        for t, agg in rep["tenants"].items():
+            if agg["min_assigned_frac"] < frac_floor:
+                failures.append(
+                    f"phase {phase}: tenant {t} assigned "
+                    f"{agg['min_assigned_frac']} below {frac_floor}"
+                )
+            if agg["ticks_done"] == 0:
+                failures.append(
+                    f"phase {phase}: tenant {t} completed zero ticks"
+                )
+        if rep["fairness_index_sessions"] < fairness_floor:
+            failures.append(
+                f"phase {phase}: session fairness "
+                f"{rep['fairness_index_sessions']} below "
+                f"{fairness_floor}"
+            )
+        for pid, viols in (rep.get("witness_violations") or {}).items():
+            if viols:
+                failures.append(
+                    f"phase {phase}: {len(viols)} lock-witness "
+                    f"violation(s) in {pid}: {viols[:2]}"
+                )
+
+    # ---- phase A: kill -9 one of 3 processes mid-run under seeded
+    # drop/delay faults -> warm failover along the ring
+    rep = run_load(
+        sessions=9, tenants=3, providers=256, tasks=256, ticks=8,
+        churn=0.02, kernel="native-mt:1", shards=2, seed=1,
+        processes=3, restart_at_tick=3, restart_mode="crash",
+        chaos="seed=5,drop=0.03,delay=0.05,delay_ms=2,"
+              "kill_proc_at_tick=3,kill_proc=1",
+    )
+    _check("A (kill -9 + faults)", rep, "killed")
+
+    # ---- phase B: live migration (Migrate RPC, moved: redirects),
+    # then graceful drain of the emptied process
+    rep_b = run_load(
+        sessions=6, tenants=2, providers=256, tasks=256, ticks=8,
+        churn=0.02, kernel="native-mt:1", shards=2, seed=2,
+        processes=3, restart_at_tick=3, restart_mode="drain",
+    )
+    _check("B (live migrate + drain)", rep_b, "drained")
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("dfleet perf gate OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update-floor", action="store_true")
@@ -912,6 +1037,7 @@ def main() -> int:
     ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--quality", action="store_true")
     ap.add_argument("--chaos", action="store_true")
+    ap.add_argument("--dfleet", action="store_true")
     args = ap.parse_args()
 
     if args.wire:
@@ -928,6 +1054,8 @@ def main() -> int:
         return quality_gate()
     if args.chaos:
         return chaos_gate()
+    if args.dfleet:
+        return dfleet_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

@@ -1,0 +1,642 @@
+"""Distributed fleet-of-fleets (ISSUE 12).
+
+Covers the multi-process contracts the ``--dfleet`` CI gate rests on,
+at unit/in-process grain: consistent-hash endpoint routing (failover
+order agrees with post-kill re-homing by construction), the
+(proc id, session id) journal namespace with atomic rename handoff
+(exclusive ownership asserted under concurrent loads), LIVE migration
+over a real wire — a session mid-delta-stream is moved between two
+servicers with plans bit-identical to fault-free single-process replay
+and the retransmit dedup asserted ACROSS the process boundary — the
+client ladder's moved-redirect / endpoint-failover / handoff-wait
+rungs, and the eviction tombstone that keeps the PR 9 "eviction = one
+counted reopen" contract intact next to lazy rehydration. The real
+3-subprocess kill -9 drill lives in ``perf_gate.py --dfleet``; a
+2-subprocess smoke is here but slow-marked.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.dfleet.discovery import DiscoveryEndpoint, fetch_topology
+from protocol_tpu.dfleet.topology import FleetTopology
+from protocol_tpu.faults.checkpoint import (
+    SessionCheckpointer,
+    handoff_orphans,
+    journal_session_id,
+)
+from protocol_tpu.faults.plan import ChaosConfig
+from protocol_tpu.fleet.fabric import FleetConfig
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto import wire
+from protocol_tpu.services.scheduler_grpc import (
+    RemoteBatchMatcher,
+    SchedulerBackendClient,
+    serve,
+)
+from protocol_tpu.trace import format as tfmt
+
+from tests.test_scheduler_grpc import _pool_world
+
+NATIVE = native.available()
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------- topology: the endpoint ring ----------------
+
+
+class TestTopology:
+    def test_routing_is_deterministic_and_total(self):
+        topo = FleetTopology(["a:1", "b:2", "c:3"], vnodes=32)
+        homes = {f"t{i}@s{i}": topo.endpoint_for(f"t{i}@s{i}")
+                 for i in range(64)}
+        again = FleetTopology(["a:1", "b:2", "c:3"], vnodes=32)
+        assert homes == {
+            sid: again.endpoint_for(sid) for sid in homes
+        }
+        # all three endpoints get work at this scale
+        assert set(homes.values()) == {"a:1", "b:2", "c:3"}
+
+    def test_failover_order_matches_post_kill_rehoming(self):
+        """The client's failover list and the ring's re-homing after a
+        kill must agree: the session's new home IS the next entry in
+        its failover order — journal re-routes and client failover can
+        never disagree about where a session lands."""
+        topo = FleetTopology(["a:1", "b:2", "c:3"])
+        for i in range(48):
+            sid = f"t{i % 3}@sess-{i}"
+            order = topo.failover_order(sid)
+            assert order[0] == topo.endpoint_for(sid)
+            assert sorted(order) == sorted(topo.endpoints)
+            survived = topo.without(order[0])
+            assert survived.endpoint_for(sid) == order[1]
+
+    def test_without_moves_only_the_dead_endpoints_sessions(self):
+        topo = FleetTopology(["a:1", "b:2", "c:3"])
+        gone = "b:2"
+        survived = topo.without(gone)
+        assert survived.generation == topo.generation + 1
+        for i in range(64):
+            sid = f"x@{i}"
+            if topo.endpoint_for(sid) != gone:
+                assert survived.endpoint_for(sid) == topo.endpoint_for(
+                    sid
+                )
+
+    def test_duplicate_and_unknown_refused(self):
+        with pytest.raises(ValueError):
+            FleetTopology(["a:1", "a:1"])
+        with pytest.raises(ValueError):
+            FleetTopology([])
+        with pytest.raises(ValueError):
+            FleetTopology(["a:1"], procs={"b:2": "p0"})
+
+    def test_dict_roundtrip(self):
+        topo = FleetTopology(
+            ["a:1", "b:2"], procs={"a:1": "p7", "b:2": "p9"},
+            vnodes=16, generation=3,
+        )
+        rt = FleetTopology.from_dict(
+            json.loads(json.dumps(topo.to_dict()))
+        )
+        assert rt.generation == 3 and rt.procs == topo.procs
+        for i in range(32):
+            assert rt.endpoint_for(f"s{i}") == topo.endpoint_for(f"s{i}")
+
+
+class TestDiscovery:
+    def test_fleet_json_and_route(self):
+        topo_box = [FleetTopology(["a:1", "b:2", "c:3"])]
+        disco = DiscoveryEndpoint(lambda: topo_box[0])
+        try:
+            fetched = fetch_topology(disco.url)
+            assert fetched.endpoints == topo_box[0].endpoints
+            sid = "t0@route-me"
+            with urllib.request.urlopen(
+                f"{disco.url}/route?session={sid}", timeout=10
+            ) as r:
+                route = json.loads(r.read().decode())
+            assert route["endpoint"] == topo_box[0].endpoint_for(sid)
+            assert route["failover"] == topo_box[0].failover_order(sid)
+            # membership change is visible through the same endpoint
+            topo_box[0] = topo_box[0].without("b:2")
+            assert fetch_topology(disco.url).generation == 1
+        finally:
+            disco.stop()
+
+    def test_bad_requests_are_answered_not_crashed(self):
+        disco = DiscoveryEndpoint(lambda: FleetTopology(["a:1"]))
+        try:
+            for path, code in (("/route", 400), ("/nope", 404)):
+                try:
+                    urllib.request.urlopen(
+                        f"{disco.url}{path}", timeout=10
+                    )
+                    assert False, "expected HTTPError"
+                except urllib.error.HTTPError as e:
+                    assert e.code == code
+        finally:
+            disco.stop()
+
+
+class TestChaosProcessKnobs:
+    def test_process_targets_parse_and_roundtrip(self):
+        cfg = ChaosConfig.from_spec(
+            "seed=5,drop=0.03,kill_proc_at_tick=3,kill_proc=2,"
+            "migrate_at_tick=4,migrate_proc=0"
+        )
+        assert cfg.kill_proc_at_tick == 3 and cfg.kill_proc == 2
+        assert cfg.migrate_at_tick == 4 and cfg.migrate_proc == 0
+        assert cfg.active()
+        assert ChaosConfig.from_spec(cfg.spec()) == cfg
+
+    def test_proc_id_and_endpoint_ride_the_env(self, monkeypatch):
+        monkeypatch.setenv("PROTOCOL_TPU_FLEET_PROC_ID", "p7")
+        monkeypatch.setenv(
+            "PROTOCOL_TPU_FLEET_ENDPOINT", "10.0.0.7:50061"
+        )
+        cfg = FleetConfig.from_env()
+        assert cfg.proc_id == "p7"
+        assert cfg.endpoint == "10.0.0.7:50061"
+
+
+# ---------------- wire helpers (session driving) ----------------
+
+
+def _synth(tmp_path, ticks=6, seed=3, n=64):
+    from protocol_tpu.trace.synth import synth_trace
+
+    path = str(tmp_path / "dfleet.trace")
+    synth_trace(
+        path, n_providers=n, n_tasks=n, ticks=ticks, churn=0.05,
+        seed=seed, kernel="native-mt:1",
+    )
+    return path
+
+
+def _open_session(client, snap, sid, p_cols, r_cols):
+    w = tfmt._as_ns(dict(zip(
+        ("price", "load", "proximity", "priority"), snap.weights
+    )))
+    fp = wire.epoch_fingerprint(
+        p_cols, r_cols, w, "native-mt:1",
+        max(int(snap.top_k) or 64, 1), snap.eps, snap.max_iters,
+    )
+    req = pb.AssignRequestV2(
+        providers=wire.encode_providers_v2(tfmt._as_ns(p_cols)),
+        requirements=wire.encode_requirements_v2(tfmt._as_ns(r_cols)),
+        weights=pb.CostWeights(
+            price=snap.weights[0], load=snap.weights[1],
+            proximity=snap.weights[2], priority=snap.weights[3],
+        ),
+        kernel="native-mt:1", top_k=snap.top_k, eps=snap.eps,
+        max_iters=snap.max_iters,
+    )
+    chunks = list(wire.chunk_snapshot(sid, fp, req))
+    return fp, client.open_session(iter(chunks), timeout=120)
+
+
+def _delta_request(sid, fp, tick, delta):
+    req = pb.AssignDeltaRequest(
+        session_id=sid, epoch_fingerprint=fp, tick=tick
+    )
+    if delta.provider_rows.size:
+        req.provider_rows.CopyFrom(wire.blob(delta.provider_rows, np.int32))
+        req.providers.CopyFrom(
+            wire.encode_providers_v2(tfmt._as_ns(delta.p_cols))
+        )
+    if delta.task_rows.size:
+        req.task_rows.CopyFrom(wire.blob(delta.task_rows, np.int32))
+        req.requirements.CopyFrom(
+            wire.encode_requirements_v2(tfmt._as_ns(delta.r_cols))
+        )
+    return req
+
+
+def _serve_pair(root):
+    """Two servicers sharing one journal root, distinct namespaces —
+    the in-test stand-in for two fleet processes (same wire protocol,
+    same checkpointers, one GIL)."""
+    addr_a = f"127.0.0.1:{_free_port()}"
+    addr_b = f"127.0.0.1:{_free_port()}"
+    a = serve(addr_a, fleet=FleetConfig(
+        shards=2, ckpt_dir=root, proc_id="p0", endpoint=addr_a))
+    b = serve(addr_b, fleet=FleetConfig(
+        shards=2, ckpt_dir=root, proc_id="p1", endpoint=addr_b))
+    return (addr_a, a), (addr_b, b)
+
+
+# ---------------- journal namespace + atomic handoff ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestJournalNamespace:
+    @pytest.fixture()
+    def flushed(self, tmp_path):
+        """A real flushed journal in p0's namespace (driven over the
+        wire so the journal is exactly what production writes)."""
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=2))
+        sid = "t0@ns-test"
+        client = SchedulerBackendClient(addr_a)
+        fp = None
+        server_tick = 0
+        try:
+            for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
+                if tick == 0:
+                    fp, resp = _open_session(
+                        client, trace.snapshot, sid, p_cols, r_cols
+                    )
+                    assert resp.ok, resp.error
+                else:
+                    resp = client.assign_delta(_delta_request(
+                        sid, fp, server_tick + 1, delta
+                    ), timeout=120)
+                    assert resp.session_ok, resp.error
+                    server_tick += 1
+            yield root, sid, server_tick
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_namespace_is_exclusive(self, flushed):
+        root, sid, tick = flushed
+        p0 = SessionCheckpointer(root, proc_id="p0")
+        p1 = SessionCheckpointer(root, proc_id="p1")
+        assert journal_session_id(p0.path_for(sid)) == sid
+        assert p1.load_one(sid) is None  # not p1's journal
+        restored = p0.load_one(sid)
+        assert restored is not None and restored.tick == tick
+
+    def test_handoff_moves_ownership_atomically(self, flushed):
+        root, sid, tick = flushed
+        p0 = SessionCheckpointer(root, proc_id="p0")
+        p1 = SessionCheckpointer(root, proc_id="p1")
+        assert p0.handoff(sid, "p1") is True
+        assert p0.handoff(sid, "p1") is False  # already gone
+        assert p0.load_one(sid) is None
+        restored = p1.load_one(sid)
+        assert restored is not None
+        assert restored.tick == tick
+        assert restored.last_p4t is not None
+
+    def test_concurrent_loads_never_break_exclusivity(self, flushed):
+        """The satellite race test: ownership flips while the OTHER
+        side is loading; after every handoff completes the source can
+        never load the journal, and the target always can — a journal
+        is rehydratable from exactly one namespace."""
+        root, sid, _ = flushed
+        p0 = SessionCheckpointer(root, proc_id="p0")
+        p1 = SessionCheckpointer(root, proc_id="p1")
+        for i in range(12):
+            owner, other = (p0, p1) if i % 2 == 0 else (p1, p0)
+            racer_result = []
+
+            def _racer():
+                # races the rename from the TARGET side: legal answers
+                # are None (pre-rename) or the session (post-rename)
+                racer_result.append(other.load_one(sid))
+
+            th = threading.Thread(target=_racer)
+            th.start()
+            assert owner.handoff(sid, other.proc_id) is True
+            th.join()
+            assert owner.load_one(sid) is None
+            got = other.load_one(sid)
+            assert got is not None and got.session_id == sid
+            for r in racer_result:
+                assert r is None or r.session_id == sid
+
+    def test_orphan_reroute_by_meta_session_id(self, flushed):
+        root, sid, tick = flushed
+        moved = handoff_orphans(root, "p0", lambda s: "p2")
+        assert moved == [(sid, "p2")]
+        p2 = SessionCheckpointer(root, proc_id="p2")
+        restored = p2.load_one(sid)
+        assert restored is not None and restored.tick == tick
+        # route=None leaves journals in place
+        assert handoff_orphans(root, "p2", lambda s: None) == []
+        assert p2.load_one(sid) is not None
+
+
+# ---------------- live migration over a real wire ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestLiveMigration:
+    def test_mid_stream_migration_is_warm_and_bit_identical(
+        self, tmp_path
+    ):
+        """The tentpole drill at unit grain: a session mid-delta-stream
+        is checkpointed, migrated, and resumed on a second servicer;
+        every plan must be bit-identical to fault-free single-process
+        replay, and a retransmit of the last tick must be answered as
+        the replayed twin ACROSS the process boundary."""
+        from protocol_tpu.trace.replay import iter_input_ticks, replay
+
+        trace_path = _synth(tmp_path, ticks=6)
+        trace = tfmt.read_trace(trace_path)
+        baseline = replay(
+            trace_path, engine="native-mt:1", verify=False,
+            keep_p4t=True,
+        )["p4ts"]
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        sid = "t0@mig"
+        client = SchedulerBackendClient(addr_a)
+        moved_redirects = 0
+        server_tick = 0
+        last_req = last_p4t = fp = None
+        try:
+            for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
+                if tick == 0:
+                    fp, resp = _open_session(
+                        client, trace.snapshot, sid, p_cols, r_cols
+                    )
+                    assert resp.ok, resp.error
+                    p4t = wire.unblob(
+                        resp.result.provider_for_task, np.int32
+                    )
+                else:
+                    if tick == 3:
+                        assert a.servicer.migrate_out(addr_b, "p1") == 1
+                    req = _delta_request(
+                        sid, fp, server_tick + 1, delta
+                    )
+                    resp = client.assign_delta(req, timeout=120)
+                    if not resp.session_ok and resp.error.startswith(
+                        "moved:"
+                    ):
+                        target = resp.error[len("moved:"):].strip()
+                        assert target == addr_b
+                        moved_redirects += 1
+                        client.close()
+                        client = SchedulerBackendClient(target)
+                        resp = client.assign_delta(req, timeout=120)
+                    assert resp.session_ok, f"tick {tick}: {resp.error}"
+                    assert not resp.replayed
+                    server_tick += 1
+                    p4t = wire.unblob(
+                        resp.result.provider_for_task, np.int32
+                    )
+                    last_req, last_p4t = req, p4t
+                assert np.array_equal(p4t, baseline[tick]), (
+                    f"tick {tick} diverged from fault-free replay"
+                )
+            assert moved_redirects == 1
+
+            # retransmit dedup across the boundary: the SAME final tick
+            # resent to the NEW home replays the cached twin
+            resp = client.assign_delta(last_req, timeout=120)
+            assert resp.session_ok and resp.replayed
+            assert np.array_equal(
+                wire.unblob(resp.result.provider_for_task, np.int32),
+                last_p4t,
+            )
+
+            seam_a = a.servicer.seam.snapshot()
+            seam_b = b.servicer.seam.snapshot()
+            assert seam_a.get("session_session_migrated_out") == 1
+            assert seam_a.get("session_moved_refused") == 1
+            assert seam_b.get("session_session_rehydrated") == 1
+            # zero reopens anywhere: exactly one session_open total
+            assert seam_a.get("session_session_open") == 1
+            assert "session_session_open" not in seam_b
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_rerouted_journal_clears_stale_redirect(self, tmp_path):
+        """Migration target dies and the ring re-routes the journal
+        BACK to the original home: the stale moved:<dead endpoint>
+        entry must not blackhole the session — the journal's location
+        is the authority, and the old home adopts the session back
+        and serves it warm."""
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=4))
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        sid = "t0@boomerang"
+        client = SchedulerBackendClient(addr_a)
+        try:
+            ticks = list(iter_input_ticks(trace))
+            _t, p_cols, r_cols, _d = ticks[0]
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok
+            assert a.servicer.migrate_out(addr_b, "p1") == 1
+            # tick 1 lands at B (rehydrates there, flushes to p1)
+            client_b = SchedulerBackendClient(addr_b)
+            resp = client_b.assign_delta(
+                _delta_request(sid, fp, 1, ticks[1][3]), timeout=120
+            )
+            assert resp.session_ok, resp.error
+            client_b.close()
+            # B dies; the ring re-routes its orphaned journal back to A
+            b.stop(grace=None)
+            assert handoff_orphans(root, "p1", lambda s: "p0") == [
+                (sid, "p0")
+            ]
+            # the delta at A must ADOPT (journal is here), not bounce
+            # at the corpse via the stale moved:addr_b entry
+            resp = client.assign_delta(
+                _delta_request(sid, fp, 2, ticks[2][3]), timeout=120
+            )
+            assert resp.session_ok, resp.error
+            seam_a = a.servicer.seam.snapshot()
+            assert seam_a.get("session_session_rehydrated") == 1
+            assert "session_moved_refused" not in seam_a
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_reopen_at_old_home_is_redirected(self, tmp_path):
+        """A client that tries to RE-OPEN at the old home after a
+        migration is bounced to the new one — opening there would fork
+        ownership of the session's state."""
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=1))
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        sid = "t0@reopen"
+        client = SchedulerBackendClient(addr_a)
+        try:
+            ticks = list(iter_input_ticks(trace))
+            _tick, p_cols, r_cols, _d = ticks[0]
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok
+            assert a.servicer.migrate_out(addr_b, "p1") == 1
+            _fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert not resp.ok
+            assert resp.error == f"moved:{addr_b}"
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_eviction_tombstone_preserves_reopen_contract(
+        self, tmp_path
+    ):
+        """Lazy rehydration must NOT resurrect a session this process
+        itself evicted for capacity — eviction releases memory, and the
+        PR 9 contract (forced eviction = the ladder's counted reopen)
+        still holds with journals on disk."""
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=2))
+        root = str(tmp_path / "journals")
+        (addr_a, a), (_addr_b, b) = _serve_pair(root)
+        sid = "t0@evict"
+        client = SchedulerBackendClient(addr_a)
+        try:
+            ticks = list(iter_input_ticks(trace))
+            _t, p_cols, r_cols, _d = ticks[0]
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok
+            # forced eviction (chaos/pressure shape) — journal remains
+            # on disk, but the tombstone forbids lazy resurrection
+            assert a.servicer.sessions.shard_of(sid).evict(
+                sid, "chaos"
+            )
+            _t1, _p, _r, delta = ticks[1]
+            resp = client.assign_delta(
+                _delta_request(sid, fp, 1, delta), timeout=120
+            )
+            assert not resp.session_ok
+            assert "unknown session" in resp.error
+            assert "session_session_rehydrated" not in (
+                a.servicer.seam.snapshot()
+            )
+            # a fresh OPEN clears the tombstone (new incarnation)
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+
+# ---------------- the production client's dfleet rungs ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestRemoteMatcherFailover:
+    def test_moved_redirect_resumes_warm_without_reopen(self, tmp_path):
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        store = _pool_world()
+        m = RemoteBatchMatcher(
+            store, [addr_a, addr_b], min_solve_interval=0.0, wire="v2",
+            native_fallback=True, native_engine="native-mt",
+            native_threads=2, retry_base_s=0.01,
+        )
+        try:
+            m.refresh()
+            m.refresh()
+            assert m._session["tick"] == 1
+            moved = a.servicer.migrate_out(addr_b, "p1")
+            assert moved == 1
+            m.refresh()  # delta -> moved: -> rebind -> SAME delta warm
+            snap = m.seam.snapshot()
+            assert snap.get("session_moved_redirect") == 1
+            assert "session_session_reopen" not in snap
+            assert m._session["tick"] == 2
+            assert m._assignment
+            seam_b = b.servicer.seam.snapshot()
+            assert seam_b.get("session_session_rehydrated") == 1
+        finally:
+            m.client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_kill_plus_handoff_fails_over_warm(self, tmp_path):
+        """The crash drill at matcher grain: the session's home dies
+        (hard stop), its orphaned journal is re-routed, and the next
+        refresh fails over down the endpoint list and resumes WARM —
+        zero reopens, the delta stream uninterrupted."""
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        store = _pool_world()
+        m = RemoteBatchMatcher(
+            store, [addr_a, addr_b], min_solve_interval=0.0, wire="v2",
+            native_fallback=True, native_engine="native-mt",
+            native_threads=2, retry_base_s=0.01, retries=4,
+        )
+        try:
+            m.refresh()
+            m.refresh()
+            assert m._session["tick"] == 1
+            a.stop(grace=None)  # kill -9 stand-in
+            moved = handoff_orphans(root, "p0", lambda s: "p1")
+            assert [s for s, _ in moved] == [m._session["id"]]
+            m.refresh()
+            snap = m.seam.snapshot()
+            assert snap.get("session_endpoint_failover", 0) >= 1
+            assert "session_session_reopen" not in snap
+            assert m._session["tick"] == 2
+            assert m._assignment
+            seam_b = b.servicer.seam.snapshot()
+            assert seam_b.get("session_session_rehydrated") == 1
+        finally:
+            m.client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+
+# ---------------- real subprocesses (slow: spawn cost) ----------------
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestProcessFleetSubprocess:
+    def test_kill_one_of_two_processes_resumes_warm(self, tmp_path):
+        from protocol_tpu.dfleet.manager import ProcessFleet
+        from protocol_tpu.fleet.loadgen import run_load
+
+        rep = run_load(
+            sessions=2, tenants=2, providers=64, tasks=64, ticks=6,
+            churn=0.05, kernel="native-mt:1", shards=2,
+            seed=1, processes=2, restart_at_tick=2,
+            restart_mode="crash",
+            ckpt_dir=str(tmp_path / "journals"),
+        )
+        assert rep["errors"] == []
+        assert rep["drill"].get("killed")
+        mig = rep["migration"]
+        assert mig["reopens_total"] == 0
+        for t, agg in rep["tenants"].items():
+            assert agg["min_assigned_frac"] >= 0.9
+        # ProcessFleet API surface smoke (scrape/witness join shapes)
+        assert set(rep["processes"].keys()) == {"p0", "p1"}
+        del ProcessFleet  # imported to assert availability

@@ -663,7 +663,7 @@ class TestDrainAndWarmRestart:
 
             flushed = drain(server)  # the SIGTERM path minus the signal
             assert flushed == 1
-            assert list(tmp_path.glob("*.ckpt"))
+            assert list(tmp_path.glob("**/*.ckpt"))
 
             # rolling restart: a fresh servicer on the same port
             # rehydrates from the checkpoint directory
@@ -684,7 +684,7 @@ class TestDrainAndWarmRestart:
             # it (its client is gone — the file would only resurrect a
             # dead session at every restart); ckpt_dir stays bounded
             server.servicer.sessions.drop(m._session["id"])
-            assert not list(tmp_path.glob("*.ckpt"))
+            assert not list(tmp_path.glob("**/*.ckpt"))
         finally:
             m.client.close()
             server.stop(grace=None)
@@ -784,8 +784,12 @@ def test_unloadable_checkpoints_are_skipped_not_fatal(tmp_path):
     from protocol_tpu.faults.checkpoint import SessionCheckpointer
 
     ckpt = SessionCheckpointer(str(tmp_path))
-    (tmp_path / "torn.ckpt").write_bytes(b"PTTRACE1garbage")
-    (tmp_path / "empty.ckpt").write_bytes(b"")
+    # journals live in the checkpointer's own (proc id) namespace
+    import pathlib
+
+    ns = pathlib.Path(ckpt.directory)
+    (ns / "torn.ckpt").write_bytes(b"PTTRACE1garbage")
+    (ns / "empty.ckpt").write_bytes(b"")
     # recovery is an optimization, never a new failure mode
     assert ckpt.load_all() == []
     assert ckpt.due(0) and ckpt.due(1)

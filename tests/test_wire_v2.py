@@ -479,7 +479,7 @@ def test_transient_unavailable_is_retried(backend):
     )
     real = m.client
     m.client = _FlakyClient(real, fail_n=1)
-    m._reconnect = lambda: None  # keep the flaky wrapper through retries
+    m._reconnect = lambda **kw: None  # keep the flaky wrapper through retries
     m.refresh()
     assert m._assignment
     assert m.seam.snapshot().get("session_retry", 0) >= 1
@@ -494,7 +494,7 @@ def test_retry_budget_exhausted_raises(backend):
     )
     real = m.client
     m.client = _FlakyClient(real, fail_n=5)
-    m._reconnect = lambda: None
+    m._reconnect = lambda **kw: None
     with pytest.raises(grpc.RpcError):
         m.refresh()
     real.close()
@@ -510,7 +510,7 @@ def test_unimplemented_v2_falls_back_to_v1(backend):
         real, fail_n=99, code=grpc.StatusCode.UNIMPLEMENTED,
         only={"assign_v2", "assign_delta", "open_session"},
     )
-    m._reconnect = lambda: None
+    m._reconnect = lambda **kw: None
     m.refresh()
     assert m.wire == "v1"
     assert m._assignment
