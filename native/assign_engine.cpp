@@ -419,6 +419,243 @@ struct ProviderPrecomp {
   }
 };
 
+// Per-task scalars hoisted once per row pass (and, for the repair
+// kernel, once per SOLVE — every phase shares the same table).
+struct TaskScore {
+  uint8_t valid, cpu_req, has_loc;
+  int32_t cores, ram, storage;
+  float slat, clat, slon, clon, prio;
+  bool any_opt;
+};
+
+inline TaskScore make_task_score(const RequirementFeatures* rf, int32_t t,
+                                 int32_t K, float w_priority) {
+  TaskScore ts;
+  ts.valid = rf->valid[t];
+  ts.cpu_req = rf->cpu_required[t];
+  ts.cores = rf->cpu_cores[t];
+  ts.ram = rf->ram_mb[t];
+  ts.storage = rf->storage_gb[t];
+  ts.slat = std::sin(rf->lat[t]);
+  ts.clat = std::cos(rf->lat[t]);
+  ts.slon = std::sin(rf->lon[t]);
+  ts.clon = std::cos(rf->lon[t]);
+  ts.has_loc = rf->has_location[t];
+  ts.prio = w_priority * rf->priority[t];
+  ts.any_opt = false;
+  for (int32_t o = 0; o < K; ++o) {
+    ts.any_opt =
+        ts.any_opt || rf->gpu_opt_valid[static_cast<int64_t>(t) * K + o];
+  }
+  return ts;
+}
+
+// One GPU OR-alternative check — the exact expressions of the historical
+// scalar pass, factored so the full-scan, bucket-pruned, and repair
+// paths share ONE implementation (bit-identity across paths holds by
+// construction, not by parallel maintenance of three copies).
+inline bool gpu_option_ok(const ProviderFeatures* pf,
+                          const RequirementFeatures* rf, int64_t tk,
+                          int32_t W, int32_t p) {
+  const int32_t pc = pf->gpu_count[p];
+  const int32_t pm = pf->gpu_mem_mb[p];
+  const int32_t rc = rf->gpu_count[tk];
+  const bool count_ok = rc < 0 || (pc < 0 ? rc == 0 : pc == rc);
+  const bool mem_ok =
+      ge_min(pm, rf->gpu_mem_min[tk]) && le_max(pm, rf->gpu_mem_max[tk]);
+  const int32_t rtot_min = rf->gpu_total_mem_min[tk];
+  const int32_t rtot_max = rf->gpu_total_mem_max[tk];
+  const int32_t total = pc * pm;
+  const bool have_total = pc >= 0 && pm >= 0;
+  const bool tot_ok = (rtot_min < 0 || !have_total || total >= rtot_min) &&
+                      (rtot_max < 0 || !have_total || total <= rtot_max);
+  const int32_t mid = pf->gpu_model_id[p];
+  const int32_t mid0 = mid > 0 ? mid : 0;
+  const uint32_t* mask = rf->gpu_model_mask + tk * W;
+  const bool model_hit = (mask[mid0 >> 5] >> (mid0 & 31)) & 1u;
+  const bool model_ok =
+      !rf->gpu_model_constrained[tk] || (mid >= 0 && model_hit);
+  return count_ok && mem_ok && tot_ok && model_ok;
+}
+
+// The per-(provider, task) cost cell: feasibility gates + cost terms,
+// kInfeasible when any gate fails. Each cell is a pure function of its
+// own features — identical expressions in every caller means identical
+// float bits in every caller.
+inline float score_cell(const ProviderFeatures* pf,
+                        const RequirementFeatures* rf,
+                        const ProviderPrecomp& pre, const TaskScore& ts,
+                        int32_t t, int32_t K, int32_t W, int32_t p,
+                        float w_proximity) {
+  bool ok =
+      !ts.cpu_req || (pf->has_cpu[p] && ge_min(pf->cpu_cores[p], ts.cores));
+  ok = ok && ge_min(pf->ram_mb[p], ts.ram);
+  ok = ok && ge_min(pf->storage_gb[p], ts.storage);
+  ok = ok && pf->valid[p] && ts.valid;
+  if (ok && ts.any_opt) {
+    bool gany = false;
+    for (int32_t o = 0; o < K && !gany; ++o) {
+      const int64_t tk = static_cast<int64_t>(t) * K + o;
+      if (!rf->gpu_opt_valid[tk]) continue;
+      gany = gpu_option_ok(pf, rf, tk, W, p);
+    }
+    ok = pf->has_gpu[p] && gany;
+  }
+  if (!ok) return kInfeasible;
+  float c = pre.base[p] - ts.prio;
+  if (ts.has_loc && pf->has_location[p]) {
+    const float cos_dlat = pre.clat[p] * ts.clat + pre.slat[p] * ts.slat;
+    const float cos_dlon = pre.clon[p] * ts.clon + pre.slon[p] * ts.slon;
+    float a = 0.5f * (1.0f - cos_dlat) +
+              pre.clat[p] * ts.clat * 0.5f * (1.0f - cos_dlon);
+    a = a < 0.0f ? 0.0f : (a > 1.0f ? 1.0f : a);
+    const float dist = 2.0f * 6371.0f * asin_poly(std::sqrt(a));
+    c += w_proximity * dist;
+  }
+  return c;
+}
+
+// ---- capability-signature buckets (the sub-quadratic cold pruner) ----
+//
+// Providers are grouped by the two EXACT-SEMANTICS discrete axes of the
+// compat mask — GPU model id and GPU count (pc == rc, not >=) — plus
+// validity and has_gpu. A task derives, per GPU OR-alternative, the set
+// of (model, count) buckets that could possibly satisfy it; providers
+// outside the union are PROVABLY infeasible (their model is accepted by
+// no option, or their count matches none), so exact-scoring only the
+// admissible buckets reproduces the full row scan bit-for-bit. The
+// threshold gates (cpu/ram/storage/mem) are left to the exact-scoring
+// verification pass — they prune cells, never correctness. Coverage
+// fallback: when the admissible union is most of the fleet (no GPU
+// options, or permissive ones), the row runs the historical full scan —
+// also exact, so candidate sets are ALWAYS equal to the unpruned pass,
+// never merely similar.
+constexpr int32_t kModelBuckets = 64;   // mid<0 | 0..61 | >=62 pooled
+constexpr int32_t kCountBuckets = 11;   // pc<0 | 0..8 | >8 pooled
+constexpr int32_t kNumBuckets = 2 + kModelBuckets * kCountBuckets;
+
+inline int32_t provider_bucket(const ProviderFeatures* pf, int32_t p) {
+  if (!pf->valid[p]) return 0;   // infeasible for every task
+  if (!pf->has_gpu[p]) return 1; // infeasible for any GPU-requiring task
+  const int32_t mid = pf->gpu_model_id[p];
+  const int32_t mb = mid < 0 ? 0 : 1 + (mid < kModelBuckets - 2
+                                            ? mid : kModelBuckets - 2);
+  const int32_t pc = pf->gpu_count[p];
+  const int32_t cb = pc < 0 ? 0 : (pc <= 8 ? 1 + pc : kCountBuckets - 1);
+  return 2 + mb * kCountBuckets + cb;
+}
+
+struct BucketIndex {
+  std::vector<int32_t> start;  // [kNumBuckets + 1] prefix offsets
+  std::vector<int32_t> ids;    // provider ids grouped by bucket,
+                               // ascending within each bucket
+  BucketIndex(const ProviderFeatures* pf, int32_t P)
+      : start(kNumBuckets + 1, 0), ids(P) {
+    for (int32_t p = 0; p < P; ++p) ++start[provider_bucket(pf, p) + 1];
+    for (int32_t b = 0; b < kNumBuckets; ++b) start[b + 1] += start[b];
+    std::vector<int32_t> fill(start.begin(), start.end() - 1);
+    for (int32_t p = 0; p < P; ++p) ids[fill[provider_bucket(pf, p)]++] = p;
+  }
+};
+
+// Fill adm[kNumBuckets] for one task; returns the admissible provider
+// count. Clear bits are PROVABLY infeasible buckets; set bits are merely
+// possible (the exact-scoring pass decides). Deterministic: a pure
+// function of the requirement row.
+inline int64_t task_admissible(const RequirementFeatures* rf, int32_t t,
+                               int32_t K, int32_t W, const TaskScore& ts,
+                               const BucketIndex& bx, uint8_t* adm) {
+  std::memset(adm, 0, kNumBuckets);
+  if (!ts.valid) return 0;
+  if (!ts.any_opt) {
+    // GPU-irrelevant task: every live bucket admissible (bucket 0 =
+    // invalid providers stays pruned — valid=0 fails the scalar gate
+    // for every task)
+    std::memset(adm + 1, 1, kNumBuckets - 1);
+  } else {
+    for (int32_t o = 0; o < K; ++o) {
+      const int64_t tk = static_cast<int64_t>(t) * K + o;
+      if (!rf->gpu_opt_valid[tk]) continue;
+      uint8_t cadm[kCountBuckets];
+      const int32_t rc = rf->gpu_count[tk];
+      for (int32_t cb = 0; cb < kCountBuckets; ++cb) {
+        bool ok;
+        if (rc < 0) ok = true;                       // any count
+        else if (rc == 0) ok = cb <= 1;              // pc absent or 0
+        else if (rc <= 8) ok = cb == 1 + rc;         // exact match
+        else ok = cb == kCountBuckets - 1;           // pooled >8 bucket
+        cadm[cb] = ok;
+      }
+      bool madm[kModelBuckets];
+      if (!rf->gpu_model_constrained[tk]) {
+        for (int32_t mb = 0; mb < kModelBuckets; ++mb) madm[mb] = true;
+      } else {
+        const uint32_t* mask = rf->gpu_model_mask + tk * W;
+        madm[0] = false;  // mid < 0 fails a constrained option
+        for (int32_t mb = 1; mb < kModelBuckets - 1; ++mb) {
+          const int32_t mid = mb - 1;
+          madm[mb] =
+              mid < W * 32 && ((mask[mid >> 5] >> (mid & 31)) & 1u);
+        }
+        bool any_hi = false;  // pooled high bucket: any bit >= 62 set
+        for (int32_t bit = kModelBuckets - 2; bit < W * 32 && !any_hi;
+             ++bit) {
+          any_hi = (mask[bit >> 5] >> (bit & 31)) & 1u;
+        }
+        madm[kModelBuckets - 1] = any_hi;
+      }
+      for (int32_t mb = 0; mb < kModelBuckets; ++mb) {
+        if (!madm[mb]) continue;
+        uint8_t* row = adm + 2 + mb * kCountBuckets;
+        for (int32_t cb = 0; cb < kCountBuckets; ++cb) row[cb] |= cadm[cb];
+      }
+    }
+  }
+  int64_t n = 0;
+  for (int32_t b = 0; b < kNumBuckets; ++b) {
+    if (adm[b]) n += bx.start[b + 1] - bx.start[b];
+  }
+  return n;
+}
+
+// The transposed admissibility question — can a provider in model/count
+// bucket (mb, cb) possibly satisfy task t? — for provider-major column
+// sweeps (the repair kernel's dirty columns). Must answer true whenever
+// task_admissible would set the bucket's bit: a false negative here
+// would silently skip a feasible cell.
+inline bool bucket_admits_task(const RequirementFeatures* rf, int32_t t,
+                               int32_t K, int32_t W, const TaskScore& ts,
+                               bool has_gpu, int32_t mb, int32_t cb) {
+  if (!ts.valid) return false;
+  if (!ts.any_opt) return true;
+  if (!has_gpu) return false;
+  for (int32_t o = 0; o < K; ++o) {
+    const int64_t tk = static_cast<int64_t>(t) * K + o;
+    if (!rf->gpu_opt_valid[tk]) continue;
+    const int32_t rc = rf->gpu_count[tk];
+    bool cok;
+    if (rc < 0) cok = true;
+    else if (rc == 0) cok = cb <= 1;
+    else if (rc <= 8) cok = cb == 1 + rc;
+    else cok = cb == kCountBuckets - 1;
+    if (!cok) continue;
+    if (!rf->gpu_model_constrained[tk]) return true;
+    if (mb == 0) continue;
+    const uint32_t* mask = rf->gpu_model_mask + tk * W;
+    if (mb < kModelBuckets - 1) {
+      const int32_t mid = mb - 1;
+      if (mid < W * 32 && ((mask[mid >> 5] >> (mid & 31)) & 1u)) {
+        return true;
+      }
+    } else {
+      for (int32_t bit = kModelBuckets - 2; bit < W * 32; ++bit) {
+        if ((mask[bit >> 5] >> (bit & 31)) & 1u) return true;
+      }
+    }
+  }
+  return false;
+}
+
 // The fused per-task pass over [t_begin, t_end): feature->cost into an
 // L2-resident scratch row, vectorized top-k select, optional reverse
 // (provider->task) tracking into caller-provided buffers. Tasks are
@@ -426,43 +663,141 @@ struct ProviderPrecomp {
 // single-range outputs bit-for-bit; the reverse buffers hold each
 // provider's best-r keys over the CHUNK — a set selection that a later
 // merge combines into the global best-r (also order-independent).
+// With ``bx`` non-null, rows whose admissible-bucket union is below
+// ``coverage_frac`` of the fleet score only that union (bit-identical
+// by the pruner's provable-infeasibility contract); other rows fall
+// back to the full scan. ``probes`` (nullable, 3 slots per thread):
+// [0] admissible providers visited, [1] full-scan fallback rows,
+// [2] bucket-pruned rows.
 void fused_process_tasks(const ProviderFeatures* pf,
                          const RequirementFeatures* rf, int32_t P,
                          int32_t t_begin, int32_t t_end, int32_t K, int32_t W,
                          int32_t k, int32_t k_out, float w_proximity,
                          float w_priority, const ProviderPrecomp& pre,
                          int32_t reverse_r, uint64_t* rev, float* rev_worst,
-                         int32_t* out_cand_provider, float* out_cand_cost) {
+                         int32_t* out_cand_provider, float* out_cand_cost,
+                         const BucketIndex* bx = nullptr,
+                         float coverage_frac = 0.6f,
+                         int64_t* probes = nullptr, int32_t slack_cap = 0,
+                         int32_t* slack_p = nullptr,
+                         float* slack_c = nullptr,
+                         bool force_scalar = false) {
   const bool do_rev = rev != nullptr && reverse_r > 0;
   const float* base = pre.base.data();
   const float* slat = pre.slat.data();
   const float* clat = pre.clat.data();
   const float* slon = pre.slon.data();
   const float* clon = pre.clon.data();
-  std::vector<uint8_t> ok0(P);   // scalar (cpu/ram/storage/valid) gates
-  std::vector<uint8_t> gany(P);  // any GPU option satisfied
+  // selection width: top-(k + slack) keys are tracked so the emitted
+  // slack tail (the repair kernel's deletion absorber) rides the same
+  // pass; the first k of a top-(k+s) selection IS the top-k, so the
+  // emitted candidate rows are bit-identical at every slack setting
+  const int32_t k_sel =
+      slack_p != nullptr ? std::min(k + slack_cap, P) : k;
   std::vector<float> scratch(P);
-  std::vector<uint64_t> topbuf(k);  // sorted packed (cost, provider) keys
+  std::vector<uint64_t> topbuf(k_sel);  // sorted packed (cost, provider)
+  std::vector<uint8_t> adm(bx != nullptr ? kNumBuckets : 0);
+  const uint64_t pad_key = pack_key(kInfeasible, 0xffffffffu);
+  const auto emit_slack = [&](int32_t t, const uint64_t* buf) {
+    if (slack_p == nullptr) return;
+    const int64_t sbase = static_cast<int64_t>(t) * slack_cap;
+    for (int32_t j = 0; j < slack_cap; ++j) {
+      if (k + j < k_sel) {
+        const float c = unpack_key_cost(buf[k + j]);
+        const bool feas = c < kInfeasible * 0.5f;
+        slack_p[sbase + j] =
+            feas ? static_cast<int32_t>(buf[k + j] & 0xffffffffu) : -1;
+        slack_c[sbase + j] = feas ? c : kInfeasible;
+      } else {
+        slack_p[sbase + j] = -1;
+        slack_c[sbase + j] = kInfeasible;
+      }
+    }
+  };
 
   for (int32_t t = t_begin; t < t_end; ++t) {
-    const uint8_t t_valid = rf->valid[t];
-    const uint8_t t_cpu_req = rf->cpu_required[t];
-    const int32_t t_cores = rf->cpu_cores[t];
-    const int32_t t_ram = rf->ram_mb[t];
-    const int32_t t_storage = rf->storage_gb[t];
-    const float t_slat = std::sin(rf->lat[t]);
-    const float t_clat = std::cos(rf->lat[t]);
-    const float t_slon = std::sin(rf->lon[t]);
-    const float t_clon = std::cos(rf->lon[t]);
-    const uint8_t t_has_loc = rf->has_location[t];
-    const float prio = w_priority * rf->priority[t];
-    bool any_opt = false;
-    for (int32_t o = 0; o < K; ++o) {
-      any_opt = any_opt || rf->gpu_opt_valid[static_cast<int64_t>(t) * K + o];
+    // ONE construction of the per-task scalars (shared with the repair
+    // kernel): the locals below exist only so the AVX/scalar blocks
+    // keep their historical names — deriving them from ts means a
+    // future edit to the hoists cannot silently split the fused pass
+    // from the repair kernel's bit-identity contract
+    const TaskScore ts = make_task_score(rf, t, K, w_priority);
+    const uint8_t t_valid = ts.valid;
+    const uint8_t t_cpu_req = ts.cpu_req;
+    const int32_t t_cores = ts.cores;
+    const int32_t t_ram = ts.ram;
+    const int32_t t_storage = ts.storage;
+    const float t_slat = ts.slat;
+    const float t_clat = ts.clat;
+    const float t_slon = ts.slon;
+    const float t_clon = ts.clon;
+    const uint8_t t_has_loc = ts.has_loc;
+    const float prio = ts.prio;
+    const bool any_opt = ts.any_opt;
+    if (bx != nullptr) {
+      const int64_t n_adm =
+          task_admissible(rf, t, K, W, ts, *bx, adm.data());
+      if (n_adm < static_cast<int64_t>(coverage_frac * P)) {
+        // bucket-pruned row: exact-score only the admissible union.
+        // Same keys, same jitter, same insert rule as the full scan —
+        // pruned-out providers are provably infeasible, so the top-k
+        // SET (and every reverse fold) is bit-identical.
+        if (probes != nullptr) {
+          probes[0] += n_adm;
+          ++probes[2];
+        }
+        uint64_t* buf = topbuf.data();
+        for (int32_t j = 0; j < k_sel; ++j) buf[j] = pad_key;
+        for (int32_t b = 1; b < kNumBuckets; ++b) {
+          if (!adm[b]) continue;
+          for (int32_t i = bx->start[b]; i < bx->start[b + 1]; ++i) {
+            const int32_t p = bx->ids[i];
+            const float c =
+                score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity);
+            if (c >= kInfeasible * 0.5f) continue;
+            const float cj = c + jitter(p, t);
+            if (do_rev && c < rev_worst[p]) {
+              uint64_t* rb = rev + static_cast<size_t>(p) * reverse_r;
+              const uint64_t rkey =
+                  pack_key(cj, static_cast<uint32_t>(t));
+              if (rkey < rb[reverse_r - 1]) {
+                sorted_insert(rb, reverse_r, rkey);
+                rev_worst[p] = unpack_key_cost(rb[reverse_r - 1]);
+              }
+            }
+            const uint64_t key = pack_key(cj, p);
+            if (key < buf[k_sel - 1]) sorted_insert(buf, k_sel, key);
+          }
+        }
+        const int64_t out_base = static_cast<int64_t>(t) * k_out;
+        for (int32_t j = 0; j < k; ++j) {
+          const float c = unpack_key_cost(buf[j]);
+          const bool feas = c < kInfeasible * 0.5f;
+          out_cand_provider[out_base + j] =
+              feas ? static_cast<int32_t>(buf[j] & 0xffffffffu) : -1;
+          out_cand_cost[out_base + j] = c;
+        }
+        for (int32_t j = k; j < k_out; ++j) {
+          out_cand_provider[out_base + j] = -1;
+          out_cand_cost[out_base + j] = kInfeasible;
+        }
+        emit_slack(t, buf);
+        continue;
+      }
+      if (probes != nullptr) {
+        probes[0] += P;
+        ++probes[1];
+      }
     }
     int32_t p0 = 0;
 #if defined(__AVX512F__)
-    {
+    // the persistent-structure family (bucketed / rev_out / slack — the
+    // v2 entry) pins the SCALAR cost pipeline even on AVX-512 builds:
+    // the vector path's FMA contraction differs from score_cell in
+    // ULPs, and two float pipelines cannot coexist behind the repair
+    // kernel's bit-identical-to-rebuild promise. Legacy fused entries
+    // (no persistent outputs) keep the vector path.
+    if (!force_scalar) {
       const __m512i neg1 = _mm512_set1_epi32(-1);
       const __m512i zero = _mm512_setzero_si512();
       const __m512 vinf = _mm512_set1_ps(kInfeasible);
@@ -611,59 +946,12 @@ void fused_process_tasks(const ProviderFeatures* pf,
       }
     }
 #endif
-    // scalar tail (and full path on non-AVX-512 builds)
+    // scalar tail (and full path on non-AVX-512 builds): the shared
+    // per-cell scorer — the same expressions the historical inline loops
+    // computed, now the ONE implementation every path calls
     if (p0 < P) {
       for (int32_t p = p0; p < P; ++p) {
-        bool ok = !t_cpu_req ||
-                  (pf->has_cpu[p] && ge_min(pf->cpu_cores[p], t_cores));
-        ok = ok && ge_min(pf->ram_mb[p], t_ram);
-        ok = ok && ge_min(pf->storage_gb[p], t_storage);
-        ok = ok && pf->valid[p] && t_valid;
-        ok0[p] = ok;
-      }
-      std::memset(gany.data() + p0, 0, P - p0);
-      for (int32_t o = 0; o < K; ++o) {
-        const int64_t tk = static_cast<int64_t>(t) * K + o;
-        if (!rf->gpu_opt_valid[tk]) continue;
-        const int32_t rc = rf->gpu_count[tk];
-        const int32_t rmem_min = rf->gpu_mem_min[tk];
-        const int32_t rmem_max = rf->gpu_mem_max[tk];
-        const int32_t rtot_min = rf->gpu_total_mem_min[tk];
-        const int32_t rtot_max = rf->gpu_total_mem_max[tk];
-        const bool constrained = rf->gpu_model_constrained[tk];
-        const uint32_t* mask = rf->gpu_model_mask + tk * W;
-        for (int32_t p = p0; p < P; ++p) {
-          const int32_t pc = pf->gpu_count[p];
-          const int32_t pm = pf->gpu_mem_mb[p];
-          const bool count_ok = rc < 0 || (pc < 0 ? rc == 0 : pc == rc);
-          const bool mem_ok = ge_min(pm, rmem_min) && le_max(pm, rmem_max);
-          const int32_t total = pc * pm;
-          const bool have_total = pc >= 0 && pm >= 0;
-          const bool tot_ok =
-              (rtot_min < 0 || !have_total || total >= rtot_min) &&
-              (rtot_max < 0 || !have_total || total <= rtot_max);
-          const int32_t mid = pf->gpu_model_id[p];
-          const int32_t mid0 = mid > 0 ? mid : 0;
-          const bool model_hit = (mask[mid0 >> 5] >> (mid0 & 31)) & 1u;
-          const bool model_ok = !constrained || (mid >= 0 && model_hit);
-          gany[p] |=
-              static_cast<uint8_t>(count_ok && mem_ok && tot_ok && model_ok);
-        }
-      }
-      for (int32_t p = p0; p < P; ++p) {
-        const bool feas =
-            ok0[p] && (!any_opt || (pf->has_gpu[p] && gany[p]));
-        float c = base[p] - prio;
-        if (t_has_loc && pf->has_location[p]) {
-          const float cos_dlat = clat[p] * t_clat + slat[p] * t_slat;
-          const float cos_dlon = clon[p] * t_clon + slon[p] * t_slon;
-          float a = 0.5f * (1.0f - cos_dlat) +
-                    clat[p] * t_clat * 0.5f * (1.0f - cos_dlon);
-          a = a < 0.0f ? 0.0f : (a > 1.0f ? 1.0f : a);
-          const float dist = 2.0f * 6371.0f * asin_poly(std::sqrt(a));
-          c += w_proximity * dist;
-        }
-        scratch[p] = feas ? c : kInfeasible;
+        scratch[p] = score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity);
       }
     }
     if (do_rev) {
@@ -682,17 +970,18 @@ void fused_process_tasks(const ProviderFeatures* pf,
         }
       }
     }
-    // top-k select: vectorized reject + sorted insertion (same output
-    // contract as topk_candidates on a dense row)
+    // top-k_sel select: vectorized reject + sorted insertion (same
+    // output contract as topk_candidates on a dense row; the emitted
+    // first k is the top-k whatever the slack width)
     uint64_t* buf = topbuf.data();
-    for (int32_t p = 0; p < k; ++p) {
+    for (int32_t p = 0; p < k_sel; ++p) {
       const float c = scratch[p];
       const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t) : c;
       buf[p] = pack_key(cj, p);
     }
-    std::sort(buf, buf + k);
-    float root = unpack_key_cost(buf[k - 1]);
-    int32_t p = k;
+    std::sort(buf, buf + k_sel);
+    float root = unpack_key_cost(buf[k_sel - 1]);
+    int32_t p = k_sel;
 #if defined(__AVX512F__)
     __m512 vr = _mm512_set1_ps(root);
     for (; p + 16 <= P; p += 16) {
@@ -704,9 +993,9 @@ void fused_process_tasks(const ProviderFeatures* pf,
         const float c = scratch[pp];
         const float cj = (c < kInfeasible * 0.5f) ? c + jitter(pp, t) : c;
         const uint64_t key = pack_key(cj, pp);
-        if (key >= buf[k - 1]) continue;
-        sorted_insert(buf, k, key);
-        root = unpack_key_cost(buf[k - 1]);
+        if (key >= buf[k_sel - 1]) continue;
+        sorted_insert(buf, k_sel, key);
+        root = unpack_key_cost(buf[k_sel - 1]);
         vr = _mm512_set1_ps(root);
       }
     }
@@ -716,9 +1005,9 @@ void fused_process_tasks(const ProviderFeatures* pf,
       if (c > root) continue;
       const float cj = (c < kInfeasible * 0.5f) ? c + jitter(p, t) : c;
       const uint64_t key = pack_key(cj, p);
-      if (key >= buf[k - 1]) continue;
-      sorted_insert(buf, k, key);
-      root = unpack_key_cost(buf[k - 1]);
+      if (key >= buf[k_sel - 1]) continue;
+      sorted_insert(buf, k_sel, key);
+      root = unpack_key_cost(buf[k_sel - 1]);
     }
     const int64_t out_base = static_cast<int64_t>(t) * k_out;
     for (int32_t j = 0; j < k; ++j) {
@@ -732,6 +1021,7 @@ void fused_process_tasks(const ProviderFeatures* pf,
       out_cand_provider[out_base + j] = -1;
       out_cand_cost[out_base + j] = kInfeasible;
     }
+    emit_slack(t, buf);
   }
 }
 
@@ -761,8 +1051,14 @@ void scatter_reverse_edges(int32_t P, int32_t T, int32_t k, int32_t k_out,
       edges.push_back({static_cast<int32_t>(rb[j] & 0xffffffffu), c, p});
     }
   }
+  // fully-ordered comparator (provider id breaks exact-cost ties): the
+  // warm repair rebuilds SUBSETS of rows from the same edge universe, so
+  // the fill order must be a pure function of edge VALUES, never of
+  // std::sort's unstable tie handling
   std::sort(edges.begin(), edges.end(), [](const Edge& a, const Edge& b) {
-    return a.t != b.t ? a.t < b.t : a.c < b.c;
+    if (a.t != b.t) return a.t < b.t;
+    if (a.c != b.c) return a.c < b.c;
+    return a.p < b.p;
   });
   std::vector<int32_t> fill(T, 0);
   for (const Edge& e : edges) {
@@ -790,7 +1086,11 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                      float w_price, float w_load, float w_proximity,
                      float w_priority, int32_t* out_cand_provider,
                      float* out_cand_cost, int32_t reverse_r, int32_t extra,
-                     int32_t threads, int64_t* stats_out = nullptr) {
+                     int32_t threads, int64_t* stats_out = nullptr,
+                     int32_t use_buckets = 0, float coverage_frac = 0.6f,
+                     uint64_t* rev_out = nullptr, int32_t slack_cap = 0,
+                     int32_t* slack_p_out = nullptr,
+                     float* slack_c_out = nullptr) {
   // Bidirectional candidates (the degraded-mode twin of the JAX path's
   // ops/sparse.candidates_topk_bidir): on price-dominated fleets every
   // task's forward top-k holds the same cheap providers, capping the
@@ -809,11 +1109,35 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
   const ProviderPrecomp pre(pf, P, w_price, w_load);
   const uint64_t pad_key = pack_key(kInfeasible, 0xffffffffu);
   const bool st = stats_out != nullptr;
-  int64_t t0 = st ? now_ns() : 0;
   if (st) {
     std::memset(stats_out, 0, kEngineStatsSlots * 8);
     stats_out[3] = nt;
   }
+  // the persistent-structure (v2) family forces one float pipeline —
+  // see the AVX-512 note in fused_process_tasks
+  const bool force_scalar =
+      use_buckets != 0 || rev_out != nullptr || slack_p_out != nullptr;
+  int64_t t0 = st ? now_ns() : 0;
+  std::unique_ptr<BucketIndex> bx;
+  if (use_buckets) {
+    bx.reset(new BucketIndex(pf, P));
+    if (st) {
+      stats_out[7] = now_ns() - t0;
+      t0 = now_ns();
+    }
+  }
+  // per-thread pruner counters ([0] providers visited, [1] fallback
+  // rows, [2] pruned rows), summed by the caller after the join — the
+  // stats array itself stays calling-thread-only
+  std::vector<int64_t> probes_all(st && use_buckets ? nt * 3 : 0, 0);
+  const auto fold_probes = [&]() {
+    if (probes_all.empty()) return;
+    for (int i = 0; i < nt; ++i) {
+      stats_out[4] += probes_all[static_cast<size_t>(i) * 3];
+      stats_out[6] += probes_all[static_cast<size_t>(i) * 3 + 1];
+      stats_out[5] += probes_all[static_cast<size_t>(i) * 3 + 2];
+    }
+  };
 
   if (nt <= 1) {
     std::vector<uint64_t> rev;
@@ -826,15 +1150,28 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                         w_priority, pre, do_rev ? reverse_r : 0,
                         do_rev ? rev.data() : nullptr,
                         do_rev ? rev_worst.data() : nullptr,
-                        out_cand_provider, out_cand_cost);
+                        out_cand_provider, out_cand_cost, bx.get(),
+                        coverage_frac,
+                        probes_all.empty() ? nullptr : probes_all.data(),
+                        slack_cap, slack_p_out, slack_c_out,
+                        force_scalar);
     if (st) {
       stats_out[0] = now_ns() - t0;
       t0 = now_ns();
+      fold_probes();
     }
     if (do_rev) {
+      if (rev_out != nullptr) {
+        std::memcpy(rev_out, rev.data(),
+                    static_cast<size_t>(P) * reverse_r * 8);
+      }
       scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, rev.data(),
                             out_cand_provider, out_cand_cost);
       if (st) stats_out[2] = now_ns() - t0;
+    } else if (rev_out != nullptr) {
+      for (size_t i = 0; i < static_cast<size_t>(P) * reverse_r; ++i) {
+        rev_out[i] = pad_key;
+      }
     }
     return;
   }
@@ -859,11 +1196,24 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
         : nullptr;
     fused_process_tasks(pf, rf, P, t0, t1, K, W, k, k_out, w_proximity,
                         w_priority, pre, do_rev ? reverse_r : 0, rev, worst,
-                        out_cand_provider, out_cand_cost);
+                        out_cand_provider, out_cand_cost, bx.get(),
+                        coverage_frac,
+                        probes_all.empty()
+                            ? nullptr
+                            : probes_all.data() +
+                                  static_cast<size_t>(tid) * 3,
+                        slack_cap, slack_p_out, slack_c_out,
+                        force_scalar);
   });
   if (st) {
     stats_out[0] = now_ns() - t0;
     t0 = now_ns();
+    fold_probes();
+  }
+  if (!do_rev && rev_out != nullptr) {
+    for (size_t i = 0; i < static_cast<size_t>(P) * reverse_r; ++i) {
+      rev_out[i] = pad_key;
+    }
   }
   if (do_rev) {
     // deterministic reduction: per provider, the r smallest keys of the
@@ -885,6 +1235,10 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
     if (st) {
       stats_out[1] = now_ns() - t0;
       t0 = now_ns();
+    }
+    if (rev_out != nullptr) {
+      std::memcpy(rev_out, merged.data(),
+                  static_cast<size_t>(P) * reverse_r * 8);
     }
     scatter_reverse_edges(P, T, k, k_out, reverse_r, extra, merged.data(),
                           out_cand_provider, out_cand_cost);
@@ -909,7 +1263,9 @@ void fused_topk_candidates(const ProviderFeatures* pf,
 // parallel + a deterministic reverse-edge merge. threads <= 0 means "all
 // hardware threads". Output is bit-identical for every thread count.
 // stats_out (nullable, kEngineStatsSlots i64): [0] fused-pass ns,
-// [1] reverse-merge ns, [2] scatter ns, [3] threads used.
+// [1] reverse-merge ns, [2] scatter ns, [3] threads used,
+// [4] providers visited (pruner on), [5] bucket-pruned rows,
+// [6] coverage-fallback rows, [7] bucket-index build ns.
 void fused_topk_candidates_mt(const ProviderFeatures* pf,
                               const RequirementFeatures* rf, int32_t P,
                               int32_t T, int32_t K, int32_t W, int32_t k,
@@ -921,6 +1277,731 @@ void fused_topk_candidates_mt(const ProviderFeatures* pf,
   fused_topk_impl(pf, rf, P, T, K, W, k, w_price, w_load, w_proximity,
                   w_priority, out_cand_provider, out_cand_cost, reverse_r,
                   extra, threads, stats_out);
+}
+
+// The v2 fused entry (the persistent-candidate seam): adds the
+// capability-bucket pruner (``use_buckets`` — sub-quadratic cold
+// generation whose output is bit-identical to the full scan, coverage
+// fallback per row) and ``rev_out`` (nullable [P * reverse_r] u64) —
+// the per-provider reverse-edge keys the pass computed, exported so the
+// warm arena can persist them and repair incrementally instead of
+// regenerating cold. Same determinism contract as _mt.
+void fused_topk_candidates_v2(const ProviderFeatures* pf,
+                              const RequirementFeatures* rf, int32_t P,
+                              int32_t T, int32_t K, int32_t W, int32_t k,
+                              float w_price, float w_load, float w_proximity,
+                              float w_priority, int32_t* out_cand_provider,
+                              float* out_cand_cost, int32_t reverse_r,
+                              int32_t extra, int32_t threads,
+                              int32_t use_buckets, float coverage_frac,
+                              uint64_t* rev_out, int32_t slack_cap,
+                              int32_t* slack_p_out, float* slack_c_out,
+                              int64_t* stats_out) {
+  fused_topk_impl(pf, rf, P, T, K, W, k, w_price, w_load, w_proximity,
+                  w_priority, out_cand_provider, out_cand_cost, reverse_r,
+                  extra, threads, stats_out, use_buckets, coverage_frac,
+                  rev_out, slack_cap, slack_p_out, slack_c_out);
+}
+
+namespace {
+
+struct Ent {  // forward entrant: dirty provider key into a clean row
+  int32_t t;
+  uint64_t key;  // pack_key(jittered cost, provider)
+};
+
+struct RevEdge {  // candidate reverse edge from a dirty-task row scan
+  int32_t q;     // clean provider whose reverse list it may enter
+  uint64_t key;  // pack_key(jittered cost, task)
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Incremental candidate repair (the persistent-structure warm path).
+//
+// Given the CURRENT feature columns plus the candidate structure built on
+// the previous tick's columns — which differ ONLY at the listed dirty
+// provider/task rows — rewrite cand/rev IN PLACE to be bit-identical to a
+// from-scratch fused_topk_candidates_v2 build on the current columns,
+// touching O(dirty_P * T + dirty_T * admissible + touched_rows * K) cells
+// instead of the full O(P * T) matrix. The exactness argument, per row:
+//
+//   * forward top-k: stripping the dirty entries from a row and folding
+//     in every dirty provider whose NEW key is <= the row's old k-th key
+//     (theta) yields a pool whose first k IS the exact top-k whenever the
+//     pool still holds >= k keys (every excluded clean provider's key
+//     exceeds theta, hence the pool's k-th). A row whose pool shrinks
+//     below k (a top-k member churned out without replacement) is
+//     re-scored through the bucket pruner — counted, never guessed.
+//   * reverse lists: a dirty provider's list is rebuilt from its fresh
+//     column (computed anyway); a clean provider's list only changes via
+//     dirty-TASK edges — stripped then re-folded from the dirty rows'
+//     scans, with the same pool argument per list (was-full lists that
+//     lose more entries than re-enter below their old worst key are
+//     rebuilt from one O(T) column scan).
+//   * extras: re-scattered only for rows whose incoming reverse edges or
+//     forward list changed — per-task fill is a pure function of exactly
+//     those inputs, so untouched rows are bit-identical by construction.
+//
+// Every phase is either row/provider-parallel over disjoint outputs or a
+// collect-then-sort reduction over value-ordered keys, so the result is
+// bit-identical for every thread count — the fused pass's contract.
+//
+// touched_out [T] u8: rows whose candidate CONTENT moved (either
+//   direction) — the warm auction's repair_mask / seat-guard input.
+// changed_out [T] u8: rows whose membership changed (in EITHER
+//   direction — the historical _merge_delta's dirty-membership compare
+//   also fired on departures) or where a kept candidate got cheaper by
+//   > cheaper_tol — the retirement-clearing contract. Pure cost
+//   increases with unchanged membership cannot un-retire; a membership
+//   loss clears the flag and the re-bid simply re-retires (harmless,
+//   and plan-compatible with the pre-repair behavior the golden traces
+//   were recorded under).
+// stats_out (nullable, kEngineStatsSlots i64):
+//   [0] merged rows   [1] rescanned rows   [2] dirty provider columns
+//   [3] reverse-list column rescans        [4] providers visited
+//   [5] exact-scored cells                 [6] coverage-fallback rows
+//   [7] column-pass ns [8] merge ns [9] reverse ns [10] scatter ns
+//   [11] compare ns    [12] threads used   [13] forward entrants
+//   [14] changed rows  [15] touched rows
+// Returns 0, or -1 on malformed shape arguments.
+int32_t repair_topk_candidates_mt(
+    const ProviderFeatures* pf, const RequirementFeatures* rf, int32_t P,
+    int32_t T, int32_t K, int32_t W, int32_t k, float w_price, float w_load,
+    float w_proximity, float w_priority, int32_t* cand_p_io, float* cand_c_io,
+    uint64_t* rev_io, int32_t* slack_p_io, float* slack_c_io,
+    int32_t slack_cap, const int32_t* dirty_p, int32_t n_dp,
+    const int32_t* dirty_t, int32_t n_dt, int32_t reverse_r, int32_t extra,
+    int32_t threads, float cheaper_tol, float coverage_frac,
+    uint8_t* touched_out, uint8_t* changed_out, int64_t* stats_out) {
+  if (P <= 0 || T <= 0 || k <= 0 || k > P || reverse_r <= 0 || extra < 0) {
+    return -1;
+  }
+  if (slack_p_io == nullptr || slack_c_io == nullptr) slack_cap = 0;
+  const int32_t k_out = k + extra;
+  // repair-time selection width for rescans: the rebuilt row refills its
+  // slack tail so the deletion absorber re-arms
+  const int32_t k_sel = std::min(k + slack_cap, P);
+  const bool st = stats_out != nullptr;
+  if (st) std::memset(stats_out, 0, kEngineStatsSlots * 8);
+  int64_t t_phase = st ? now_ns() : 0;
+  const uint64_t pad_key = pack_key(kInfeasible, 0xffffffffu);
+  // small instances run every phase inline: a repair at 512 rows is
+  // microseconds of work, and spawning a helper pool would cost more
+  // than the whole job (the engine's usual wakeup-amortization rule);
+  // the result is identical either way — every phase is thread-count
+  // invariant by construction
+  constexpr int32_t kRepairParMin = 4096;
+  const int nt = std::max(P, T) >= kRepairParMin
+                     ? resolve_threads(threads, T)
+                     : 1;
+  if (st) stats_out[12] = nt;
+  // ONE helper pool for every phase: the kernel is a pipeline of seven
+  // short parallel regions, and per-region thread spawns (~100 us x
+  // nt-1) would dominate the repair wall at high thread counts — the
+  // exact wakeup-amortization argument of the -mt auction's pool
+  std::unique_ptr<HelperPool> pool(
+      nt > 1 ? new HelperPool(nt - 1) : nullptr);
+  const auto par = [&](const std::function<void(int)>& fn) {
+    if (pool != nullptr) {
+      pool->run(fn);
+    } else {
+      fn(0);
+    }
+  };
+  const ProviderPrecomp pre(pf, P, w_price, w_load);
+  const BucketIndex bx(pf, P);
+
+  std::vector<uint8_t> in_dp(P, 0), in_dt(T, 0);
+  for (int32_t i = 0; i < n_dp; ++i) {
+    if (dirty_p[i] >= 0 && dirty_p[i] < P) in_dp[dirty_p[i]] = 1;
+  }
+  for (int32_t i = 0; i < n_dt; ++i) {
+    if (dirty_t[i] >= 0 && dirty_t[i] < T) in_dt[dirty_t[i]] = 1;
+  }
+  std::memset(touched_out, 0, T);
+  std::memset(changed_out, 0, T);
+
+  // hoisted per-task scalars + each row's entrant bound tau (the key of
+  // the LAST entry of forward+slack — every provider outside the
+  // maintained list is provably beyond it, the pool argument's anchor;
+  // an empty list means every clean provider is infeasible, so tau
+  // opens to the pad key and every feasible dirty key enters) + each
+  // reverse list's pre-repair worst key — every later phase reads
+  // these as an immutable snapshot
+  std::vector<TaskScore> ts_all(T);
+  std::vector<uint64_t> theta(T);
+  // forward-not-full rows carry a PROOF, not just a bound: a top-k with
+  // an empty tail means fewer than k providers were feasible at the
+  // last rebuild, so every clean provider outside the list is
+  // infeasible — ANY newly-feasible dirty key must enter (the tau
+  // filter only orders known-feasible competition)
+  std::vector<uint8_t> not_full(T);
+  const int32_t tchunk = (T + nt - 1) / nt;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * tchunk, T);
+    const int32_t hi = std::min<int32_t>(lo + tchunk, T);
+    for (int32_t t = lo; t < hi; ++t) {
+      ts_all[t] = make_task_score(rf, t, K, w_priority);
+      not_full[t] =
+          cand_p_io[static_cast<int64_t>(t) * k_out + k - 1] < 0;
+      uint64_t tau = pad_key;
+      bool found = false;
+      for (int32_t j = slack_cap - 1; j >= 0 && !found; --j) {
+        const int64_t s = static_cast<int64_t>(t) * slack_cap + j;
+        if (slack_p_io[s] >= 0) {
+          tau = pack_key(slack_c_io[s], slack_p_io[s]);
+          found = true;
+        }
+      }
+      for (int32_t j = k - 1; j >= 0 && !found; --j) {
+        const int64_t s = static_cast<int64_t>(t) * k_out + j;
+        if (cand_p_io[s] >= 0) {
+          tau = pack_key(cand_c_io[s], cand_p_io[s]);
+          found = true;
+        }
+      }
+      theta[t] = tau;
+    }
+  });
+  std::vector<uint64_t> wkey(P);  // reverse worst-key snapshot
+  for (int32_t p = 0; p < P; ++p) {
+    wkey[p] = rev_io[static_cast<size_t>(p) * reverse_r + reverse_r - 1];
+  }
+
+  // ---- phase 1: dirty-provider columns. One gated sweep per dirty
+  // provider: rebuilds its reverse list exactly (the column IS its edge
+  // universe) and emits forward entrants (new key <= theta) per row.
+  std::vector<std::vector<Ent>> ents(nt);
+  std::vector<std::vector<int32_t>> aff(nt);  // affected task ids
+  std::vector<int64_t> cells(nt, 0);
+
+  // ONE gated column sweep shared by this phase and the phase-3
+  // reverse-list rebuild: bucket-pruned exact scoring of provider p's
+  // column into a reverse_r key buffer (cells the transposed bucket
+  // predicate proves infeasible are skipped, never scored — the same
+  // exactness contract as the task-side pruning), optionally
+  // collecting forward entrants. One implementation, so the two
+  // column-shaped passes cannot drift apart.
+  const auto sweep_column = [&](int32_t p, uint64_t* rb,
+                                std::vector<Ent>* ent_out, int tid) {
+    for (int32_t j = 0; j < reverse_r; ++j) rb[j] = pad_key;
+    if (!pf->valid[p]) return;
+    const bool p_gpu = pf->has_gpu[p] != 0;
+    const int32_t b = provider_bucket(pf, p);
+    const int32_t mb = b >= 2 ? (b - 2) / kCountBuckets : 0;
+    const int32_t cb = b >= 2 ? (b - 2) % kCountBuckets : 0;
+    for (int32_t t = 0; t < T; ++t) {
+      if (b >= 2 &&
+          !bucket_admits_task(rf, t, K, W, ts_all[t], p_gpu, mb, cb)) {
+        continue;
+      }
+      if (b == 1 && ts_all[t].any_opt) continue;  // no GPU
+      const float c =
+          score_cell(pf, rf, pre, ts_all[t], t, K, W, p, w_proximity);
+      ++cells[tid];
+      if (c >= kInfeasible * 0.5f) continue;
+      const float cj = c + jitter(p, t);
+      const uint64_t rkey = pack_key(cj, static_cast<uint32_t>(t));
+      if (rkey < rb[reverse_r - 1]) {
+        sorted_insert(rb, reverse_r, rkey);
+      }
+      if (ent_out != nullptr && !in_dt[t]) {
+        const uint64_t fkey = pack_key(cj, p);
+        if (fkey <= theta[t] || not_full[t]) {
+          ent_out->push_back({t, fkey});
+        }
+      }
+    }
+  };
+
+  const int32_t pchunk = (n_dp + nt - 1) / nt;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * pchunk, n_dp);
+    const int32_t hi = std::min<int32_t>(lo + pchunk, n_dp);
+    std::vector<uint64_t> rb(reverse_r);
+    for (int32_t i = lo; i < hi; ++i) {
+      const int32_t p = dirty_p[i];
+      if (p < 0 || p >= P) continue;
+      uint64_t* dst = rev_io + static_cast<size_t>(p) * reverse_r;
+      for (int32_t j = 0; j < reverse_r; ++j) {  // old edges -> affected
+        if (unpack_key_cost(dst[j]) >= kInfeasible * 0.5f) break;
+        aff[tid].push_back(static_cast<int32_t>(dst[j] & 0xffffffffu));
+      }
+      sweep_column(p, rb.data(), &ents[tid], tid);
+      std::memcpy(dst, rb.data(), static_cast<size_t>(reverse_r) * 8);
+      for (int32_t j = 0; j < reverse_r; ++j) {  // new edges -> affected
+        if (unpack_key_cost(rb[j]) >= kInfeasible * 0.5f) break;
+        aff[tid].push_back(static_cast<int32_t>(rb[j] & 0xffffffffu));
+      }
+    }
+  });
+  if (st) {
+    stats_out[2] = n_dp;
+    stats_out[7] = now_ns() - t_phase;
+    t_phase = now_ns();
+  }
+
+  // deterministic entrant order: sorted by (row, key) regardless of
+  // which thread computed which dirty column
+  std::vector<Ent> entrants;
+  for (int i = 0; i < nt; ++i) {
+    entrants.insert(entrants.end(), ents[i].begin(), ents[i].end());
+    ents[i].clear();
+  }
+  std::sort(entrants.begin(), entrants.end(), [](const Ent& a, const Ent& b) {
+    return a.t != b.t ? a.t < b.t : a.key < b.key;
+  });
+  if (st) stats_out[13] = static_cast<int64_t>(entrants.size());
+
+  // forward work set: rows holding a dirty provider, or receiving an
+  // entrant (dirty-task rows are rebuilt whole, below)
+  std::vector<uint8_t> proc(T, 0);
+  for (const Ent& e : entrants) proc[e.t] = 1;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * tchunk, T);
+    const int32_t hi = std::min<int32_t>(lo + tchunk, T);
+    for (int32_t t = lo; t < hi; ++t) {
+      if (in_dt[t] || proc[t]) continue;
+      const int64_t row = static_cast<int64_t>(t) * k_out;
+      for (int32_t j = 0; j < k; ++j) {
+        const int32_t p = cand_p_io[row + j];
+        if (p >= 0 && in_dp[p]) {
+          proc[t] = 1;
+          break;
+        }
+      }
+      if (proc[t]) continue;
+      // a dirty provider parked in the SLACK tail must be stripped too
+      // (its cached key is stale even though the auction never sees it)
+      const int64_t srow = static_cast<int64_t>(t) * slack_cap;
+      for (int32_t j = 0; j < slack_cap; ++j) {
+        const int32_t p = slack_p_io[srow + j];
+        if (p >= 0 && in_dp[p]) {
+          proc[t] = 1;
+          break;
+        }
+      }
+    }
+  });
+
+  // old-row copies (pre-modification) for the touched/changed compare;
+  // rows are appended once, in ascending task order per copy pass
+  std::vector<int32_t> old_idx(T, -1);
+  std::vector<int32_t> old_rows;
+  std::vector<int32_t> old_p;
+  std::vector<float> old_c;
+  // row registration is a cheap sequential prefix; the bulk memcpy of
+  // the registered rows runs on the pool
+  const auto copy_rows = [&](const std::function<bool(int32_t)>& want) {
+    const size_t first = old_rows.size();
+    for (int32_t t = 0; t < T; ++t) {
+      if (old_idx[t] < 0 && want(t)) {
+        old_idx[t] = static_cast<int32_t>(old_rows.size());
+        old_rows.push_back(t);
+      }
+    }
+    const size_t n_new = old_rows.size() - first;
+    if (n_new == 0) return;
+    old_p.resize(old_rows.size() * static_cast<size_t>(k_out));
+    old_c.resize(old_rows.size() * static_cast<size_t>(k_out));
+    const int32_t cchunk =
+        static_cast<int32_t>((n_new + nt - 1) / nt);
+    par([&](int tid) {
+      const size_t lo = first + std::min<size_t>(
+          static_cast<size_t>(tid) * cchunk, n_new);
+      const size_t hi = first + std::min<size_t>(
+          static_cast<size_t>(tid) * cchunk + cchunk, n_new);
+      for (size_t i = lo; i < hi; ++i) {
+        const int64_t row = static_cast<int64_t>(old_rows[i]) * k_out;
+        std::memcpy(old_p.data() + i * k_out, cand_p_io + row,
+                    static_cast<size_t>(k_out) * 4);
+        std::memcpy(old_c.data() + i * k_out, cand_c_io + row,
+                    static_cast<size_t>(k_out) * 4);
+      }
+    });
+  };
+  copy_rows([&](int32_t t) { return proc[t] || in_dt[t]; });
+
+  // a bucket-exact row scan shared by dirty-task rebuilds and merge
+  // rescans: fills the row's k forward slots; optionally collects
+  // reverse-edge candidates for clean providers (dirty-task rows only —
+  // a rescan's cells did not change value, so its edges are already in
+  // exactly the right reverse lists)
+  std::vector<int64_t> fb_rows(nt, 0), scanned(nt, 0);
+  const auto emit_row = [&](int32_t t, const uint64_t* keys, int32_t n) {
+    // write a row's forward slots + slack tail from n ascending keys
+    const int64_t row = static_cast<int64_t>(t) * k_out;
+    for (int32_t j = 0; j < k; ++j) {
+      const bool feas = j < n && unpack_key_cost(keys[j]) < kInfeasible * 0.5f;
+      cand_p_io[row + j] =
+          feas ? static_cast<int32_t>(keys[j] & 0xffffffffu) : -1;
+      cand_c_io[row + j] = feas ? unpack_key_cost(keys[j]) : kInfeasible;
+    }
+    const int64_t srow = static_cast<int64_t>(t) * slack_cap;
+    for (int32_t j = 0; j < slack_cap; ++j) {
+      const int32_t at = k + j;
+      const bool feas =
+          at < n && unpack_key_cost(keys[at]) < kInfeasible * 0.5f;
+      slack_p_io[srow + j] =
+          feas ? static_cast<int32_t>(keys[at] & 0xffffffffu) : -1;
+      slack_c_io[srow + j] = feas ? unpack_key_cost(keys[at]) : kInfeasible;
+    }
+  };
+  const auto scan_row = [&](int32_t t, std::vector<uint64_t>& buf,
+                            std::vector<uint8_t>& adm, int tid,
+                            std::vector<RevEdge>* collect) {
+    for (int32_t j = 0; j < k_sel; ++j) buf[j] = pad_key;
+    const TaskScore& ts = ts_all[t];
+    const int64_t n_adm = task_admissible(rf, t, K, W, ts, bx, adm.data());
+    const bool full = n_adm >= static_cast<int64_t>(coverage_frac * P);
+    if (full) ++fb_rows[tid];
+    const auto visit = [&](int32_t p) {
+      const float c =
+          score_cell(pf, rf, pre, ts_all[t], t, K, W, p, w_proximity);
+      ++cells[tid];
+      if (c >= kInfeasible * 0.5f) return;
+      const float cj = c + jitter(p, t);
+      if (collect != nullptr && !in_dp[p]) {
+        const uint64_t rkey = pack_key(cj, static_cast<uint32_t>(t));
+        if (rkey <= wkey[p]) collect->push_back({p, rkey});
+      }
+      const uint64_t key = pack_key(cj, p);
+      if (key < buf[k_sel - 1]) sorted_insert(buf.data(), k_sel, key);
+    };
+    if (full) {
+      scanned[tid] += P;
+      for (int32_t p = 0; p < P; ++p) visit(p);
+    } else {
+      scanned[tid] += n_adm;
+      for (int32_t b = 1; b < kNumBuckets; ++b) {
+        if (!adm[b]) continue;
+        for (int32_t i = bx.start[b]; i < bx.start[b + 1]; ++i) {
+          visit(bx.ids[i]);
+        }
+      }
+    }
+    emit_row(t, buf.data(), k_sel);
+  };
+
+  // ---- phase 2a: dirty-task rows — full exact rebuild via the pruner,
+  // collecting their reverse-edge candidates for phase 3
+  std::vector<std::vector<RevEdge>> redges(nt);
+  const int32_t dtchunk = (n_dt + nt - 1) / nt;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * dtchunk, n_dt);
+    const int32_t hi = std::min<int32_t>(lo + dtchunk, n_dt);
+    std::vector<uint64_t> buf(k_sel);
+    std::vector<uint8_t> adm(kNumBuckets);
+    for (int32_t i = lo; i < hi; ++i) {
+      const int32_t t = dirty_t[i];
+      if (t < 0 || t >= T) continue;
+      scan_row(t, buf, adm, tid, &redges[tid]);
+      touched_out[t] = 1;
+      changed_out[t] = 1;
+    }
+  });
+
+  // ---- phase 2b: merges for rows the provider churn touched, over the
+  // maintained list L = forward + slack (strip dirty entries, fold the
+  // entrants admitted below tau, keep the best k+slack)
+  std::vector<int64_t> merged_n(nt, 0), rescan_n(nt, 0);
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * tchunk, T);
+    const int32_t hi = std::min<int32_t>(lo + tchunk, T);
+    std::vector<uint64_t> buf(k_sel);
+    std::vector<uint64_t> pool(static_cast<size_t>(k_sel));
+    std::vector<uint8_t> adm(kNumBuckets);
+    for (int32_t t = lo; t < hi; ++t) {
+      if (!proc[t] || in_dt[t]) continue;
+      const int64_t row = static_cast<int64_t>(t) * k_out;
+      const int64_t srow = static_cast<int64_t>(t) * slack_cap;
+      // retained: the row's non-dirty keys, ascending (forward then
+      // slack — both stored ascending, slack keys beyond forward's)
+      pool.clear();
+      for (int32_t j = 0; j < k; ++j) {
+        const int32_t p = cand_p_io[row + j];
+        if (p < 0) break;
+        if (!in_dp[p]) pool.push_back(pack_key(cand_c_io[row + j], p));
+      }
+      for (int32_t j = 0; j < slack_cap; ++j) {
+        const int32_t p = slack_p_io[srow + j];
+        if (p < 0) break;
+        if (!in_dp[p]) pool.push_back(pack_key(slack_c_io[srow + j], p));
+      }
+      const size_t n_ret = pool.size();
+      const Ent probe{t, 0};
+      auto e_lo = std::lower_bound(
+          entrants.begin(), entrants.end(), probe,
+          [](const Ent& a, const Ent& b) { return a.t < b.t; });
+      for (auto it = e_lo; it != entrants.end() && it->t == t; ++it) {
+        pool.push_back(it->key);
+      }
+      // merge the two ascending runs (retained, entrants)
+      std::inplace_merge(pool.begin(), pool.begin() + n_ret, pool.end());
+      // a forward-not-full row's pool is ALL feasible providers (clean
+      // absentees are provably infeasible, every feasible dirty key was
+      // admitted) — exact at any size, no rescan
+      if (static_cast<int32_t>(pool.size()) >= k || not_full[t]) {
+        // the pool covers the top-k exactly (every provider outside it
+        // is beyond tau, hence beyond the pool's k-th key); the tail
+        // re-arms the slack, trimmed at capacity (tau ratchets down)
+        ++merged_n[tid];
+        emit_row(t, pool.data(),
+                 std::min<int32_t>(pool.size(), k_sel));
+      } else {
+        // the list lost more members than re-entered: the true
+        // successor is outside the maintained structure — re-score the
+        // row exactly (bucket-pruned, never the full matrix)
+        ++rescan_n[tid];
+        scan_row(t, buf, adm, tid, nullptr);
+      }
+    }
+  });
+  if (st) {
+    for (int i = 0; i < nt; ++i) {
+      stats_out[0] += merged_n[i];
+      stats_out[1] += rescan_n[i];
+      stats_out[6] += fb_rows[i];
+      stats_out[4] += scanned[i];
+    }
+    stats_out[8] = now_ns() - t_phase;
+    t_phase = now_ns();
+  }
+
+  // ---- phase 3: clean providers' reverse lists — strip dirty-task
+  // entries, fold the dirty rows' fresh edges back in, rebuild from one
+  // column scan when the pool argument no longer covers the list
+  std::vector<RevEdge> edges;
+  for (int i = 0; i < nt; ++i) {
+    edges.insert(edges.end(), redges[i].begin(), redges[i].end());
+    redges[i].clear();
+  }
+  std::sort(edges.begin(), edges.end(),
+            [](const RevEdge& a, const RevEdge& b) {
+              return a.q != b.q ? a.q < b.q : a.key < b.key;
+            });
+  std::vector<int64_t> rev_rescans(nt, 0);
+  const int32_t qchunk = (P + nt - 1) / nt;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * qchunk, P);
+    const int32_t hi = std::min<int32_t>(lo + qchunk, P);
+    std::vector<uint64_t> keep(reverse_r);
+    const RevEdge probe_lo{lo, 0};
+    auto it = std::lower_bound(
+        edges.begin(), edges.end(), probe_lo,
+        [](const RevEdge& a, const RevEdge& b) { return a.q < b.q; });
+    for (int32_t q = lo; q < hi; ++q) {
+      if (in_dp[q]) {
+        while (it != edges.end() && it->q == q) ++it;  // rebuilt in phase 1
+        continue;
+      }
+      uint64_t* rb = rev_io + static_cast<size_t>(q) * reverse_r;
+      int32_t d = 0, m = 0;
+      for (int32_t j = 0; j < reverse_r; ++j) {
+        const uint64_t key = rb[j];
+        if (unpack_key_cost(key) >= kInfeasible * 0.5f) break;
+        const int32_t task = static_cast<int32_t>(key & 0xffffffffu);
+        if (in_dt[task]) {
+          ++d;
+        } else {
+          keep[m++] = key;
+        }
+      }
+      const auto e_begin = it;
+      while (it != edges.end() && it->q == q) ++it;
+      const auto e_end = it;
+      if (d == 0 && e_begin == e_end) continue;  // untouched list
+      // affected: the full old membership and (later) the new one
+      for (int32_t j = 0; j < m + d; ++j) {
+        if (unpack_key_cost(rb[j]) >= kInfeasible * 0.5f) break;
+        aff[tid].push_back(static_cast<int32_t>(rb[j] & 0xffffffffu));
+      }
+      const bool was_full = unpack_key_cost(wkey[q]) < kInfeasible * 0.5f;
+      const int64_t iprime = e_end - e_begin;  // all collected <= wkey
+      if (was_full && iprime < d) {
+        // the list lost more entries than re-entered below its old
+        // worst: the true best-r includes unknown clean edges — one
+        // exact gated column sweep (the phase-1 implementation,
+        // entrants off) rebuilds it
+        ++rev_rescans[tid];
+        sweep_column(q, keep.data(), nullptr, tid);
+        std::memcpy(rb, keep.data(), static_cast<size_t>(reverse_r) * 8);
+      } else {
+        // best-r of (kept ascending) U (edges ascending): two-pointer
+        // merge into the list, pad the tail
+        int32_t a = 0;
+        auto b = e_begin;
+        std::vector<uint64_t> out(reverse_r, pad_key);
+        int32_t n = 0;
+        while (n < reverse_r && (a < m || b != e_end)) {
+          if (a < m && (b == e_end || keep[a] <= b->key)) {
+            out[n++] = keep[a++];
+          } else {
+            out[n++] = (b++)->key;
+          }
+        }
+        std::memcpy(rb, out.data(), static_cast<size_t>(reverse_r) * 8);
+      }
+      for (int32_t j = 0; j < reverse_r; ++j) {  // new membership
+        if (unpack_key_cost(rb[j]) >= kInfeasible * 0.5f) break;
+        aff[tid].push_back(static_cast<int32_t>(rb[j] & 0xffffffffu));
+      }
+    }
+  });
+  if (st) {
+    for (int i = 0; i < nt; ++i) stats_out[3] += rev_rescans[i];
+    stats_out[9] = now_ns() - t_phase;
+    t_phase = now_ns();
+  }
+
+  // ---- phase 4: re-scatter extras for every affected row
+  if (extra > 0) {
+    std::vector<uint8_t> affected(T, 0);
+    for (int32_t t = 0; t < T; ++t) {
+      if (proc[t] || in_dt[t]) affected[t] = 1;
+    }
+    for (int i = 0; i < nt; ++i) {
+      for (const int32_t t : aff[i]) {
+        if (t >= 0 && t < T) affected[t] = 1;
+      }
+      aff[i].clear();
+    }
+    copy_rows([&](int32_t t) { return affected[t] != 0; });
+    struct SEdge {
+      int32_t t;
+      float c;
+      int32_t p;
+    };
+    std::vector<std::vector<SEdge>> sed(nt);
+    par([&](int tid) {
+      const int32_t lo = std::min<int32_t>(tid * qchunk, P);
+      const int32_t hi = std::min<int32_t>(lo + qchunk, P);
+      for (int32_t p = lo; p < hi; ++p) {
+        const uint64_t* rb = rev_io + static_cast<size_t>(p) * reverse_r;
+        for (int32_t j = 0; j < reverse_r; ++j) {
+          const float c = unpack_key_cost(rb[j]);
+          if (c >= kInfeasible * 0.5f) break;
+          const int32_t t = static_cast<int32_t>(rb[j] & 0xffffffffu);
+          if (affected[t]) sed[tid].push_back({t, c, p});
+        }
+      }
+    });
+    std::vector<SEdge> sedges;
+    for (int i = 0; i < nt; ++i) {
+      sedges.insert(sedges.end(), sed[i].begin(), sed[i].end());
+      sed[i].clear();
+    }
+    // the cold scatter's exact (t, c, p) fill order, restricted to the
+    // affected subset — per-task fill only ever reads a task's own edges
+    std::sort(sedges.begin(), sedges.end(),
+              [](const SEdge& a, const SEdge& b) {
+                if (a.t != b.t) return a.t < b.t;
+                if (a.c != b.c) return a.c < b.c;
+                return a.p < b.p;
+              });
+    // reset + fill, task-parallel: each thread owns a contiguous span
+    // of task ids and the matching (sorted) edge span — per-task fill
+    // is a pure function of that task's own edges and forward list
+    const int64_t n_se = static_cast<int64_t>(sedges.size());
+    par([&](int tid) {
+      const int32_t lo = std::min<int32_t>(tid * tchunk, T);
+      const int32_t hi = std::min<int32_t>(lo + tchunk, T);
+      for (int32_t t = lo; t < hi; ++t) {
+        if (!affected[t]) continue;
+        const int64_t row = static_cast<int64_t>(t) * k_out;
+        for (int32_t j = k; j < k_out; ++j) {
+          cand_p_io[row + j] = -1;
+          cand_c_io[row + j] = kInfeasible;
+        }
+      }
+      const SEdge probe{lo, 0.0f, 0};
+      auto it = std::lower_bound(
+          sedges.begin(), sedges.end(), probe,
+          [](const SEdge& a, const SEdge& b) { return a.t < b.t; });
+      for (int64_t i = it - sedges.begin(); i < n_se; ++i) {
+        const SEdge& e = sedges[i];
+        if (e.t >= hi) break;
+        const int64_t row = static_cast<int64_t>(e.t) * k_out;
+        int32_t fill = 0;
+        while (fill < extra && cand_p_io[row + k + fill] >= 0) ++fill;
+        if (fill >= extra) continue;
+        bool dup = false;
+        for (int32_t j = 0; j < k && !dup; ++j) {
+          dup = cand_p_io[row + j] == e.p;
+        }
+        if (dup) continue;
+        cand_p_io[row + k + fill] = e.p;
+        cand_c_io[row + k + fill] = e.c;
+      }
+    });
+  }
+  if (st) {
+    stats_out[10] = now_ns() - t_phase;
+    t_phase = now_ns();
+  }
+
+  // ---- phase 5: touched/changed against the saved old rows
+  const int32_t n_old = static_cast<int32_t>(old_rows.size());
+  const int32_t ochunk = (n_old + nt - 1) / nt;
+  par([&](int tid) {
+    const int32_t lo = std::min<int32_t>(tid * ochunk, n_old);
+    const int32_t hi = std::min<int32_t>(lo + ochunk, n_old);
+    // epoch-tagged per-provider scratch: membership + aligned-cost
+    // analysis in one O(k_out) pass per row, no per-row sorts (rows
+    // hold each provider at most once — the extras dup-check invariant)
+    std::vector<int32_t> seen(P, -1);
+    std::vector<float> ocost(P, 0.0f);
+    for (int32_t i = lo; i < hi; ++i) {
+      const int32_t t = old_rows[i];
+      if (in_dt[t]) continue;  // forced touched+changed above
+      const int64_t row = static_cast<int64_t>(t) * k_out;
+      const int32_t* op = old_p.data() + static_cast<int64_t>(i) * k_out;
+      const float* oc = old_c.data() + static_cast<int64_t>(i) * k_out;
+      if (std::memcmp(op, cand_p_io + row,
+                      static_cast<size_t>(k_out) * 4) == 0 &&
+          std::memcmp(oc, cand_c_io + row,
+                      static_cast<size_t>(k_out) * 4) == 0) {
+        continue;  // bit-identical row: untouched
+      }
+      touched_out[t] = 1;
+      int32_t n_old_m = 0, n_new_m = 0;
+      for (int32_t j = 0; j < k_out; ++j) {
+        const int32_t p = op[j];
+        if (p < 0) continue;
+        seen[p] = i;
+        ocost[p] = oc[j];
+        ++n_old_m;
+      }
+      bool member_changed = false;
+      bool cheaper = false;
+      for (int32_t j = 0; j < k_out; ++j) {
+        const int32_t p = cand_p_io[row + j];
+        if (p < 0) continue;
+        ++n_new_m;
+        if (seen[p] != i) {
+          member_changed = true;
+          break;
+        }
+        if (ocost[p] - cand_c_io[row + j] > cheaper_tol) cheaper = true;
+      }
+      if (member_changed || cheaper || n_old_m != n_new_m) {
+        changed_out[t] = 1;
+      }
+    }
+  });
+  if (st) {
+    stats_out[11] = now_ns() - t_phase;
+    int64_t total_cells = 0;
+    for (int i = 0; i < nt; ++i) total_cells += cells[i];
+    stats_out[5] = total_cells;
+    for (int32_t t = 0; t < T; ++t) {
+      stats_out[14] += changed_out[t];
+      stats_out[15] += touched_out[t];
+    }
+  }
+  return 0;
 }
 
 // Gauss-Seidel auction on candidate lists with eps-scaling.

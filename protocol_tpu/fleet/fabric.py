@@ -53,7 +53,8 @@ _MAX_TENANT_KEYS = 512
 
 
 def estimate_arena_bytes(
-    p_cols: dict, r_cols: dict, top_k: int
+    p_cols: dict, r_cols: dict, top_k: int,
+    reverse_r: int = 8, slack: int = 16,
 ) -> int:
     """Byte estimate of one session's pinned server-side state, from
     rows x dtype widths: the padded columns (held twice — the session's
@@ -69,6 +70,11 @@ def estimate_arena_bytes(
     t_pad = int(np.asarray(r_cols["cpu_cores"]).shape[0])
     k = min(max(int(top_k), 1), max(p_pad, 1))
     cand = t_pad * k * 8  # cand_p i32 + cand_c f32
+    # the persistent repair state: reverse-edge keys u64 over
+    # [P, reverse_r] and the slack shadow i32+f32 over [T, slack] —
+    # defaults mirror NativeSolveArena's; callers running bigger knobs
+    # must pass theirs or the admission budget undercounts
+    cand += p_pad * reverse_r * 8 + t_pad * slack * 8
     duals = p_pad * (4 + 1 + 4) + t_pad * 4
     return 2 * (pb + rb) + cand + duals
 

@@ -206,7 +206,34 @@ def load() -> ctypes.CDLL:
         + [ctypes.c_int32, ctypes.c_void_p]
     )
     lib.fused_topk_candidates_mt.restype = None
+    # v2: + use_buckets flag, coverage_frac, nullable rev_out (the
+    # persistent [P, reverse_r] u64 reverse-edge keys the warm arena
+    # carries), nullable slack tail ([T, slack] next-cheapest shadow —
+    # the repair kernel's deletion absorber), nullable stats
+    lib.fused_topk_candidates_v2.argtypes = (
+        lib.fused_topk_candidates.argtypes
+        + [ctypes.c_int32, ctypes.c_int32, ctypes.c_float,
+           ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+           ctypes.c_void_p, ctypes.c_void_p]
+    )
+    lib.fused_topk_candidates_v2.restype = None
     u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    # incremental candidate repair: features + cand/rev/slack io + dirty
+    # index sets + knobs + touched/changed masks + nullable stats
+    lib.repair_topk_candidates_mt.argtypes = [
+        ctypes.POINTER(_ProviderFeatures),
+        ctypes.POINTER(_RequirementFeatures),
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        i32p, f32p, u64p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int32, i32p, ctypes.c_int32, i32p, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float,
+        u8p, u8p, ctypes.c_void_p,
+    ]
+    lib.repair_topk_candidates_mt.restype = ctypes.c_int32
     # ... plus the trailing nullable per-task outcome + margin buffers
     # (the decision-observability layer; null = zero overhead)
     lib.auction_sparse_mt.argtypes = [
@@ -256,6 +283,22 @@ OUTCOME_NAMES = {
 _FUSED_STATS = {
     "gen_fused_ns": 0, "gen_rev_merge_ns": 1, "gen_scatter_ns": 2,
     "gen_threads": 3,
+    # capability-bucket pruner counters (0 when the pruner is off)
+    "gen_visited": 4, "gen_pruned_rows": 5, "gen_fallback_rows": 6,
+    "gen_bucket_ns": 7,
+}
+# incremental candidate repair (repair_topk_candidates_mt) — surfaced by
+# the arena as eng_cand_repair_* / eng_cand_* scalars
+_REPAIR_STATS = {
+    "cand_repair_rows": 0, "cand_repair_rescans": 1,
+    "cand_repair_cols": 2, "cand_repair_rev_rescans": 3,
+    "cand_repair_visited": 4, "cand_repair_exact_scores": 5,
+    "cand_repair_fallback_rows": 6,
+    "cand_repair_col_ns": 7, "cand_repair_merge_ns": 8,
+    "cand_repair_rev_ns": 9, "cand_repair_scatter_ns": 10,
+    "cand_repair_compare_ns": 11, "cand_repair_threads": 12,
+    "cand_repair_entrants": 13, "cand_repair_changed": 14,
+    "cand_repair_touched": 15,
 }
 _AUCTION_STATS = {
     "rounds": 0, "bids": 1, "evicted": 2, "repair_passes": 3,
@@ -349,10 +392,52 @@ def topk_candidates(cost: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
     return cand_p, cand_c
 
 
+def _marshal_features(p, r) -> tuple:
+    """(pa, ra, pf, rf, P, T, K, W) for EncodedProviders /
+    EncodedRequirements — the keep-alive lists MUST outlive the native
+    call (the structs hold raw pointers into them)."""
+
+    def i32(a):
+        return np.ascontiguousarray(np.asarray(a), np.int32)
+
+    def f32(a):
+        return np.ascontiguousarray(np.asarray(a), np.float32)
+
+    def u8(a):
+        return np.ascontiguousarray(np.asarray(a), np.uint8)
+
+    def u32(a):
+        return np.ascontiguousarray(np.asarray(a), np.uint32)
+
+    pa = [
+        i32(p.gpu_count), i32(p.gpu_mem_mb), i32(p.gpu_model_id),
+        u8(p.has_gpu), u8(p.has_cpu), i32(p.cpu_cores), i32(p.ram_mb),
+        i32(p.storage_gb), f32(p.lat), f32(p.lon), u8(p.has_location),
+        f32(p.price), f32(p.load), u8(p.valid),
+    ]
+    ra = [
+        u8(r.cpu_required), i32(r.cpu_cores), i32(r.ram_mb),
+        i32(r.storage_gb), u8(r.gpu_opt_valid), i32(r.gpu_count),
+        i32(r.gpu_mem_min), i32(r.gpu_mem_max), i32(r.gpu_total_mem_min),
+        i32(r.gpu_total_mem_max), u32(r.gpu_model_mask),
+        u8(r.gpu_model_constrained), f32(r.lat), f32(r.lon),
+        u8(r.has_location), f32(r.priority), u8(r.valid),
+    ]
+    pf = _ProviderFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in pa])
+    rf = _RequirementFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in ra])
+    return (
+        pa, ra, pf, rf,
+        pa[0].shape[0], ra[1].shape[0], ra[4].shape[1], ra[10].shape[2],
+    )
+
+
 def fused_topk_candidates(
     providers, requirements, weights=None, k: int = 64,
     reverse_r: int = 8, extra: int = 16, threads: Optional[int] = None,
     stats: Optional[dict] = None,
+    bucketed: bool = False, coverage_frac: float = 0.6,
+    rev_out: Optional[np.ndarray] = None,
+    slack_out: Optional[tuple] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Fused cost + per-task top-k straight from encoded features — the
     degraded-mode twin of ops.sparse.candidates_topk_bidir (same jitter)
@@ -379,6 +464,25 @@ def fused_topk_candidates(
     the -mt engine (at ``threads=1`` when none was asked for, which is
     bit-compatible with the single-threaded pass by the determinism
     contract).
+
+    ``bucketed``: route each row through the capability-signature
+    pruner — only the buckets whose (model, count) signature could
+    satisfy one of the task's GPU alternatives are exact-scored, with a
+    per-row full-scan fallback above ``coverage_frac``. Output is
+    BIT-IDENTICAL to the unbucketed pass (pruned providers are provably
+    infeasible); only the work shrinks.
+
+    ``rev_out``: optional [P, reverse_r] u64 array the call fills with
+    the per-provider reverse-edge keys — the persistent half of the
+    warm arena's incrementally-repaired candidate structure.
+
+    ``slack_out``: optional ``(slack_p [T, S] i32, slack_c [T, S] f32)``
+    pair the call fills with each row's next-S-cheapest providers
+    beyond the top-k — the repair kernel's deletion absorber (a
+    departing top-k member is replaced from the slack instead of
+    forcing a row re-score). Tracking the wider selection never
+    changes the emitted top-k (the first k of a top-(k+S) selection IS
+    the top-k).
     """
     lib = load()
     if weights is None:
@@ -386,47 +490,50 @@ def fused_topk_candidates(
 
         weights = CostWeights()
 
-    def i32(a):
-        return np.ascontiguousarray(np.asarray(a), np.int32)
-
-    def f32(a):
-        return np.ascontiguousarray(np.asarray(a), np.float32)
-
-    def u8(a):
-        return np.ascontiguousarray(np.asarray(a), np.uint8)
-
-    def u32(a):
-        return np.ascontiguousarray(np.asarray(a), np.uint32)
-
-    p = providers
-    r = requirements
     # keep references alive for the duration of the call
-    pa = [
-        i32(p.gpu_count), i32(p.gpu_mem_mb), i32(p.gpu_model_id),
-        u8(p.has_gpu), u8(p.has_cpu), i32(p.cpu_cores), i32(p.ram_mb),
-        i32(p.storage_gb), f32(p.lat), f32(p.lon), u8(p.has_location),
-        f32(p.price), f32(p.load), u8(p.valid),
-    ]
-    ra = [
-        u8(r.cpu_required), i32(r.cpu_cores), i32(r.ram_mb),
-        i32(r.storage_gb), u8(r.gpu_opt_valid), i32(r.gpu_count),
-        i32(r.gpu_mem_min), i32(r.gpu_mem_max), i32(r.gpu_total_mem_min),
-        i32(r.gpu_total_mem_max), u32(r.gpu_model_mask),
-        u8(r.gpu_model_constrained), f32(r.lat), f32(r.lon),
-        u8(r.has_location), f32(r.priority), u8(r.valid),
-    ]
-    P = pa[0].shape[0]
-    T = ra[1].shape[0]
-    K = ra[4].shape[1]
-    W = ra[10].shape[2]
+    pa, ra, pf, rf, P, T, K, W = _marshal_features(providers, requirements)
     k = min(k, P)
+    # persistent-output validation runs against the CALLER's declared
+    # shapes, BEFORE the degenerate reset below zeroes reverse_r — an
+    # empty batch must stay the documented quiet no-op, not a shape error
+    if rev_out is not None:
+        if rev_out.dtype != np.uint64 or rev_out.shape != (P, reverse_r):
+            raise ValueError(
+                f"rev_out must be uint64 [P={P}, reverse_r={reverse_r}], "
+                f"got {rev_out.dtype} {rev_out.shape}"
+            )
+        if not rev_out.flags["C_CONTIGUOUS"]:
+            raise ValueError("rev_out must be C-contiguous")
+    slack_cap = 0
+    if slack_out is not None:
+        sp, sc = slack_out
+        slack_cap = int(sp.shape[1])
+        if (
+            sp.dtype != np.int32 or sc.dtype != np.float32
+            or sp.shape != (T, slack_cap) or sc.shape != sp.shape
+            or not sp.flags["C_CONTIGUOUS"] or not sc.flags["C_CONTIGUOUS"]
+        ):
+            raise ValueError(
+                "slack_out must be C-contiguous (i32 [T, S], f32 [T, S])"
+            )
     if reverse_r <= 0 or extra <= 0 or k <= 0 or T <= 0:
         # degenerate shapes: the C++ pass early-returns without writing,
         # so extras must not allocate (np.empty garbage would flow into
-        # the auction as out-of-range provider ids)
+        # the auction as out-of-range provider ids) and the persistent
+        # outputs are padded HERE — empty lists, infeasible keys
+        if rev_out is not None:
+            # pack_key(kInfeasible, 0xffffffff): the engine's pad key
+            b = np.uint64(
+                np.float32(1e9).view(np.uint32) | np.uint32(0x80000000)
+            )
+            rev_out[...] = (b << np.uint64(32)) | np.uint64(0xFFFFFFFF)
+            rev_out = None
+        if slack_out is not None:
+            slack_out[0][...] = -1
+            slack_out[1][...] = np.float32(1e9)
+            slack_out = None
+            slack_cap = 0
         reverse_r = extra = 0
-    pf = _ProviderFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in pa])
-    rf = _RequirementFeatures(*[a.ctypes.data_as(ctypes.c_void_p) for a in ra])
     cand_p = np.empty((T, k + extra), np.int32)
     cand_c = np.empty((T, k + extra), np.float32)
     args = (
@@ -435,8 +542,30 @@ def fused_topk_candidates(
         float(weights.proximity), float(weights.priority),
         cand_p, cand_c, reverse_r, extra,
     )
-    if threads is None and stats is None:
+    if (
+        threads is None and stats is None and not bucketed
+        and rev_out is None and slack_out is None
+    ):
         lib.fused_topk_candidates(*args)
+    elif bucketed or rev_out is not None or slack_out is not None:
+        buf, ptr = _stats_buf(stats)
+        lib.fused_topk_candidates_v2(
+            *args, int(1 if threads is None else threads),
+            int(bool(bucketed)), float(coverage_frac),
+            None if rev_out is None else rev_out.ctypes.data_as(
+                ctypes.c_void_p
+            ),
+            slack_cap,
+            None if slack_out is None else slack_out[0].ctypes.data_as(
+                ctypes.c_void_p
+            ),
+            None if slack_out is None else slack_out[1].ctypes.data_as(
+                ctypes.c_void_p
+            ),
+            ptr,
+        )
+        if stats is not None:
+            _parse_stats(stats, buf, _FUSED_STATS)
     else:
         buf, ptr = _stats_buf(stats)
         lib.fused_topk_candidates_mt(
@@ -445,6 +574,94 @@ def fused_topk_candidates(
         if stats is not None:
             _parse_stats(stats, buf, _FUSED_STATS)
     return cand_p, cand_c
+
+
+def repair_topk_candidates(
+    providers, requirements, weights,
+    cand_p: np.ndarray, cand_c: np.ndarray, rev: np.ndarray,
+    dirty_p: np.ndarray, dirty_t: np.ndarray,
+    k: int, reverse_r: int = 8, extra: int = 16, threads: int = 0,
+    cheaper_tol: float = 0.05, coverage_frac: float = 0.6,
+    slack: Optional[tuple] = None,
+    stats: Optional[dict] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Incrementally repair a persistent candidate structure IN PLACE so
+    it is bit-identical to a from-scratch
+    ``fused_topk_candidates(..., rev_out=...)`` build on the CURRENT
+    features — touching only the rows/columns the dirty provider/task
+    index sets reach, never the full [P, T] matrix.
+
+    ``cand_p`` [T, k+extra] i32 / ``cand_c`` [T, k+extra] f32 /
+    ``rev`` [P, reverse_r] u64 are the structure built on the PREVIOUS
+    features (which must differ from the current ones only at the dirty
+    rows) and are rewritten in place. Returns ``(touched, changed)``
+    bool [T] masks: rows whose content moved (the warm auction's
+    repair_mask / seat-guard input) and rows whose membership changed or
+    got materially cheaper (the retirement-clearing contract).
+
+    ``slack``: optional persistent ``(slack_p [T, S] i32,
+    slack_c [T, S] f32)`` pair from ``fused_topk_candidates``'s
+    ``slack_out`` — the next-cheapest shadow that absorbs top-k
+    deletions (a row only re-scores when it loses more members than the
+    slack + entrants replace). Rewritten in place; lazily degraded
+    (never part of the bit-identity contract, which covers cand + rev).
+
+    Deterministic for every thread count; ``stats`` fills the
+    ``cand_repair_*`` counters/walls (see ``_REPAIR_STATS``)."""
+    lib = load()
+    pa, ra, pf, rf, P, T, K, W = _marshal_features(providers, requirements)
+    if cand_p.shape != (T, k + extra) or cand_c.shape != cand_p.shape:
+        raise ValueError(
+            f"cand arrays must be [T={T}, k+extra={k + extra}], got "
+            f"{cand_p.shape} / {cand_c.shape}"
+        )
+    if rev.dtype != np.uint64 or rev.shape != (P, reverse_r):
+        raise ValueError(
+            f"rev must be uint64 [P={P}, reverse_r={reverse_r}], got "
+            f"{rev.dtype} {rev.shape}"
+        )
+    for name, a in (("cand_p", cand_p), ("cand_c", cand_c), ("rev", rev)):
+        if not a.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"{name} must be C-contiguous")
+    slack_cap = 0
+    if slack is not None:
+        sp, sc = slack
+        slack_cap = int(sp.shape[1])
+        if (
+            sp.dtype != np.int32 or sc.dtype != np.float32
+            or sp.shape != (T, slack_cap) or sc.shape != sp.shape
+            or not sp.flags["C_CONTIGUOUS"] or not sc.flags["C_CONTIGUOUS"]
+        ):
+            raise ValueError(
+                "slack must be C-contiguous (i32 [T, S], f32 [T, S])"
+            )
+    # unique + sorted: a duplicated dirty id would sweep one column from
+    # two threads (torn reverse list) and double-insert its forward
+    # entrants (a dup inside one candidate row makes v1 == v2 in the
+    # auction bid math) — dedup at the seam, not by caller convention
+    dp = np.unique(np.asarray(dirty_p)).astype(np.int32)
+    dt = np.unique(np.asarray(dirty_t)).astype(np.int32)
+    touched = np.zeros(T, np.uint8)
+    changed = np.zeros(T, np.uint8)
+    buf, stats_ptr = _stats_buf(stats)
+    rc = lib.repair_topk_candidates_mt(
+        ctypes.byref(pf), ctypes.byref(rf), P, T, K, W, int(k),
+        float(weights.price), float(weights.load),
+        float(weights.proximity), float(weights.priority),
+        cand_p, cand_c, rev,
+        None if slack is None else slack[0].ctypes.data_as(ctypes.c_void_p),
+        None if slack is None else slack[1].ctypes.data_as(ctypes.c_void_p),
+        slack_cap,
+        dp, int(dp.size), dt, int(dt.size),
+        int(reverse_r), int(extra), int(threads),
+        float(cheaper_tol), float(coverage_frac),
+        touched, changed, stats_ptr,
+    )
+    if rc != 0:
+        raise ValueError(f"repair_topk_candidates_mt rejected shapes (rc={rc})")
+    if stats is not None:
+        _parse_stats(stats, buf, _REPAIR_STATS)
+    return touched.astype(bool), changed.astype(bool)
 
 
 def auction_sparse(

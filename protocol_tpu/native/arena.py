@@ -7,28 +7,35 @@ survives between ticks instead of rebuilding it —
 
   - **Candidate structure.** The fused cost+top-k pass is the dominant
     stage (~90% of a cold native solve). The arena keeps the assembled
-    [T, k+extra] bidirectional candidate lists and, on churn, recomputes
-    only the rows that can have changed: dirty TASKS get a fresh fused
-    pass against the full fleet; dirty PROVIDERS are dropped from every
-    cached list and re-merged from one [dirty-P x T] delta pass (their
-    forward candidates AND their reverse edges) — never the full pass.
+    [T, k+extra] bidirectional candidate lists PLUS the per-provider
+    reverse-edge keys as one persistent, incrementally-REPAIRED object:
+    on churn, ``native.repair_topk_candidates`` rewrites only the
+    rows/columns the dirty provider/task sets reach and the result is
+    BIT-IDENTICAL to a from-scratch rebuild on the current features —
+    the structure is exact at every tick, never a drifting cache. A
+    1%-churn tick issues zero full-matrix candidate passes
+    (``last_stats["cand_cold_passes"] == 0``); cold builds route through
+    the capability-bucket pruner (sub-quadratic when GPU constraints are
+    selective, per-row full-scan fallback otherwise — also exact).
   - **Auction dual state.** Prices per provider, the retirement mask per
     task, and the previous matching are carried into a single-phase warm
     auction (native.auction_sparse_mt), whose eps-CS repair evicts stale
-    seeds. Retirement flags are cleared for exactly the rows whose
-    candidates changed — the same caller contract the JAX warm kernel
+    seeds. Retirement flags are cleared for exactly the rows the repair
+    reports ``changed`` (membership moved, or a kept candidate got
+    materially cheaper) — the same caller contract the JAX warm kernel
     documents ("rows whose costs or candidates changed must be cleared").
 
 Dirty detection is value-based: each provider/requirement feature column
 is compared row-wise against the previous solve's columns, so any change
 that can affect feasibility or cost (specs, price, load, validity, the
-requirement DSL fields) marks its row dirty and ONLY that row is
-recomputed. Two staleness backstops mirror the TPU path: a dirty fraction
-above ``max_dirty_frac`` triggers a full rebuild (the delta pass would
-cost more than it saves), and ``cold_every`` bounds tie-jitter drift from
-delta passes (delta candidates are jittered by their local indices, like
-the CandidateCache's merge batches) plus the warm chain's monotone price
-ratchet.
+requirement DSL fields) marks its row dirty and ONLY that row's reach is
+repaired. Price/load drift is churn like any other (the exactness
+contract re-scores the drifted columns; the historical in-place cost
+shift kept membership stale between cold re-grounds). Backstops: a
+dirty fraction above ``max_dirty_frac`` triggers a full rebuild (the
+repair would cost more than it saves), and ``cold_every`` re-grounds the
+auction duals (the structure itself no longer drifts — repair is exact —
+so the cadence only bounds the warm chain's monotone price ratchet).
 """
 
 from __future__ import annotations
@@ -64,6 +71,21 @@ _R_SPEC = (
 )
 
 
+# persisted candidate-structure dtypes: these arrays ride checkpoint
+# journal frames (faults/checkpoint.py) and migration handoffs, so their
+# widths are a durable on-disk contract — the dtype-contract lint
+# cross-checks this table against export_state's cand_* keys, and
+# restore_state coerces through it, so a drifted width can neither land
+# silently nor reinterpret an archived checkpoint's raw bytes
+_CAND_STATE_DTYPES = {
+    "cand_p": np.int32,
+    "cand_c": np.float32,
+    "cand_rev": np.uint64,
+    "cand_slack_p": np.int32,
+    "cand_slack_c": np.float32,
+}
+
+
 def _canon(enc, spec) -> dict[str, np.ndarray]:
     return {
         name: np.ascontiguousarray(np.asarray(getattr(enc, name)), dtype)
@@ -79,15 +101,6 @@ def _dirty_rows(new: dict, old: dict, spec) -> np.ndarray:
         diff = new[name] != old[name]
         dirty |= diff.reshape(n, -1).any(axis=1)
     return dirty
-
-
-def _subset(fields: dict, idx: np.ndarray, spec) -> object:
-    """A namespace with the gathered rows of each field (duck-types the
-    Encoded* dataclasses for native.fused_topk_candidates)."""
-    ns = type("_Sub", (), {})()
-    for name, _ in spec:
-        setattr(ns, name, fields[name][idx])
-    return ns
 
 
 def _as_ns(fields: dict, spec) -> object:
@@ -121,6 +134,9 @@ class NativeSolveArena:
         # before the marginals polish — 1e-2 halves the iteration bill
         # with no measured effect on the rounded matching
         sink_tol: float = 1e-2,
+        bucketed: bool = True,
+        coverage_frac: float = 0.6,
+        slack: int = 16,
     ):
         if engine not in ("auction", "sinkhorn"):
             raise ValueError(
@@ -130,6 +146,17 @@ class NativeSolveArena:
         self.reverse_r = reverse_r
         self.extra = extra
         self.threads = threads
+        # capability-bucket pruner for cold builds + repair rescans:
+        # bit-identical output (provably-infeasible pruning + coverage
+        # fallback), so the knob is purely a work/latency trade
+        self.bucketed = bucketed
+        self.coverage_frac = coverage_frac
+        # per-row next-cheapest shadow beyond the top-k: the repair
+        # kernel's deletion absorber (a churned-out top-k member is
+        # replaced from the slack instead of forcing a row re-score);
+        # lazily degraded, re-armed by rescans/cold builds, never part
+        # of the auction-visible structure
+        self.slack = slack
         self.cold_every = cold_every
         self.max_dirty_frac = max_dirty_frac
         self.eps_start = eps_start
@@ -215,6 +242,9 @@ class NativeSolveArena:
         out = {
             "cand_p": _c(self._cand_p),
             "cand_c": _c(self._cand_c),
+            "cand_rev": _c(self._rev),
+            "cand_slack_p": _c(self._slack_p),
+            "cand_slack_c": _c(self._slack_c),
             "price": _c(self._price),
             "retired": _c(self._retired),
             "p4t": _c(self._p4t),
@@ -259,8 +289,53 @@ class NativeSolveArena:
         else:
             self._p_fields = _canon(ep, _P_SPEC)
             self._r_fields = _canon(er, _R_SPEC)
-        self._cand_p = np.array(state["cand_p"], copy=True)
-        self._cand_c = np.array(state["cand_c"], copy=True)
+        self._cand_p = np.array(
+            state["cand_p"], _CAND_STATE_DTYPES["cand_p"], copy=True
+        )
+        self._cand_c = np.array(
+            state["cand_c"], _CAND_STATE_DTYPES["cand_c"], copy=True
+        )
+        rev = state.get("cand_rev")
+        # pre-repair checkpoints carry no reverse-edge keys, and a
+        # config-skewed carry (exporter built the structure at a
+        # different reverse_r / candidate width than this arena runs)
+        # cannot be repaired against this arena's knobs: both degrade to
+        # an honest cold re-ground on the first solve instead of a hard
+        # shape error mid-tick (warm duals would be unsound against a
+        # regenerated structure anyway)
+        n_p = self._p_fields["gpu_count"].shape[0]
+        n_t = self._r_fields["cpu_cores"].shape[0]
+        if (
+            rev is None
+            or np.asarray(rev).shape != (n_p, self.reverse_r)
+            or self._cand_p.ndim != 2
+            or self._cand_p.shape
+            != (n_t, min(self.k, n_p) + self.extra)
+        ):
+            self.invalidate()
+            return
+        self._rev = np.array(
+            rev, _CAND_STATE_DTYPES["cand_rev"], copy=True
+        )
+        sp, sc = state.get("cand_slack_p"), state.get("cand_slack_c")
+        # slack is an optimization, not a correctness input: a carry
+        # without it repairs correctly, just with more row re-scores —
+        # but a HALF-present or shape-skewed pair is dropped whole (the
+        # repair wrapper would otherwise raise mid-tick on the first
+        # warm solve instead of just re-scoring more rows)
+        if (
+            sp is None or sc is None
+            or np.asarray(sp).ndim != 2
+            or np.asarray(sp).shape[0] != n_t
+            or np.asarray(sc).shape != np.asarray(sp).shape
+        ):
+            sp = sc = None
+        self._slack_p = None if sp is None else np.array(
+            sp, _CAND_STATE_DTYPES["cand_slack_p"], copy=True
+        )
+        self._slack_c = None if sc is None else np.array(
+            sc, _CAND_STATE_DTYPES["cand_slack_c"], copy=True
+        )
         for name in ("price", "retired", "p4t", "f", "g", "starve_age"):
             v = state.get(name)
             setattr(
@@ -278,6 +353,9 @@ class NativeSolveArena:
         self._weights_key: Optional[tuple] = None
         self._cand_p: Optional[np.ndarray] = None
         self._cand_c: Optional[np.ndarray] = None
+        self._rev: Optional[np.ndarray] = None  # [P, reverse_r] u64 keys
+        self._slack_p: Optional[np.ndarray] = None  # [T, slack] shadow
+        self._slack_c: Optional[np.ndarray] = None
         self._price: Optional[np.ndarray] = None
         self._retired: Optional[np.ndarray] = None
         self._p4t: Optional[np.ndarray] = None
@@ -424,12 +502,30 @@ class NativeSolveArena:
         outs: Optional[dict] = {} if obs.enabled() else None
         t0 = time.perf_counter()
         with _tracer.span("arena.candidates", cold=True, tasks=T):
+            # the persistent reverse-edge keys ride along so the next
+            # churn tick can REPAIR this structure instead of paying
+            # another full-matrix pass
+            persist = (
+                self.reverse_r > 0 and self.extra > 0
+                and min(self.k, P) > 0
+            )
+            rev = np.zeros((P, self.reverse_r), np.uint64) if persist else None
+            slack = (
+                (np.zeros((T, self.slack), np.int32),
+                 np.zeros((T, self.slack), np.float32))
+                if persist and self.slack > 0 else None
+            )
             cand_p, cand_c = native.fused_topk_candidates(
                 ep, er, weights, k=self.k, reverse_r=self.reverse_r,
                 extra=self.extra, threads=self.threads, stats=eng,
+                bucketed=self.bucketed, coverage_frac=self.coverage_frac,
+                rev_out=rev, slack_out=slack,
             )
         t_gen = time.perf_counter()
         self._cand_p, self._cand_c = cand_p, cand_c
+        self._rev = rev
+        self._slack_p = slack[0] if slack is not None else None
+        self._slack_c = slack[1] if slack is not None else None
         with _tracer.span("arena.engine", engine=self.engine, cold=True):
             if self.engine == "sinkhorn":
                 self._f = self._g = None
@@ -460,6 +556,7 @@ class NativeSolveArena:
             "cold": True,
             "engine": self.engine,
             "rows": T,
+            "cand_cold_passes": 1,
             "dirty_providers": P,
             "dirty_tasks": T,
             "changed_rows": T,
@@ -471,93 +568,6 @@ class NativeSolveArena:
             **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
         }
         return p4t
-
-    def _merge_delta(
-        self,
-        rows: np.ndarray,
-        dirty_p_idx: np.ndarray,
-        delta_p: np.ndarray,
-        delta_c: np.ndarray,
-    ) -> np.ndarray:
-        """For the task rows in ``rows``: drop dirty providers from the
-        cached row, fold the delta pass's candidates (forward + reverse,
-        global ids) back in by current cost, and return
-        ``(changed, touched)`` masks aligned with ``rows`` (``touched``
-        feeds the auction's repair_mask; ``changed`` clears retirement). Rows recomputed this solve are excluded
-        by the caller — re-merging them would duplicate dirty providers
-        inside one candidate list (a dup makes v1 == v2 in the bid math)."""
-        cand_p = self._cand_p[rows]
-        cand_c = self._cand_c[rows]
-        in_dirty = np.zeros(self._price.shape[0], bool)
-        in_dirty[dirty_p_idx] = True
-        stale = (cand_p >= 0) & in_dirty[np.maximum(cand_p, 0)]
-        dp = delta_p[rows]
-        dc = delta_c[rows]
-        # only rows that TOUCH a dirty provider (hold one in the cached
-        # list, or receive one from the delta pass) can change: merge and
-        # compare just those — at 1% churn that is a few percent of T,
-        # not all of it
-        touch = stale.any(axis=1) | (dp >= 0).any(axis=1)
-        changed = np.zeros(rows.size, bool)
-        t_idx = np.flatnonzero(touch)
-        if t_idx.size == 0:
-            return changed, touch
-        cand_p_t = cand_p[t_idx]
-        cand_c_t = cand_c[t_idx]
-        stale_t = stale[t_idx]
-        masked_p = np.where(stale_t, -1, cand_p_t)
-
-        allp = np.concatenate([masked_p, dp[t_idx]], axis=1)
-        allc = np.concatenate([cand_c_t, dc[t_idx]], axis=1)
-        key = np.where(allp >= 0, allc, np.inf)
-        k_eff = cand_p.shape[1]
-        idx = np.argsort(key, axis=1, kind="stable")[:, :k_eff]
-        new_p = np.take_along_axis(allp, idx, axis=1).astype(np.int32)
-        new_c = np.take_along_axis(allc, idx, axis=1).astype(np.float32)
-        new_c[new_p < 0] = 0.0
-
-        # Change detection is ORDER-INSENSITIVE. The merge's argsort
-        # reshuffles positions even when a row's candidate content is
-        # untouched (reverse-edge extras are appended unsorted, so the
-        # first merge re-sorts every row); a position-wise compare
-        # cleared ~100% of the retirement carry at 16k under 1% price
-        # churn and the warm auction degenerated to cold-solve work.
-        # What can make a retired task viable again is exactly: (a) a
-        # dirty provider ENTERING or moving within its candidate set
-        # (dirty membership differs), or (b) a kept candidate getting
-        # materially CHEAPER (aligned compare after sorting both lists by
-        # provider id). Pure cost increases and pure losses cannot
-        # un-retire; the 0.05 floor matches the CandidateCache's
-        # stale_abs_tol ("drift big enough to matter").
-        big = np.int32(np.iinfo(np.int32).max)
-        old_dirty = np.where(stale_t, cand_p_t, big)
-        new_dirty = np.where(
-            (new_p >= 0) & in_dirty[np.maximum(new_p, 0)], new_p, big
-        )
-        old_dirty.sort(axis=1)
-        new_dirty.sort(axis=1)
-        member_changed = (old_dirty != new_dirty).any(axis=1)
-        # when dirty membership is unchanged the full membership is too
-        # (non-dirty entries only ever leave by being displaced by an
-        # entering dirty one), so the id-sorted aligned compare is exact
-        o_ord = np.lexsort((cand_c_t, cand_p_t), axis=1)
-        n_ord = np.lexsort((new_c, new_p), axis=1)
-        op = np.take_along_axis(cand_p_t, o_ord, axis=1)
-        oc = np.take_along_axis(cand_c_t, o_ord, axis=1)
-        npp = np.take_along_axis(new_p, n_ord, axis=1)
-        ncc = np.take_along_axis(new_c, n_ord, axis=1)
-        # op >= 0: empty slots carry sentinel costs (kInfeasible on fresh
-        # rows, 0.0 after a merge rewrite) — without the guard a -1==-1
-        # alignment reads as a 1e9 price drop and spuriously un-retires
-        # every touched row on its first merge
-        cheaper = (
-            (op == npp) & (op >= 0) & ((oc - ncc) > 0.05)
-        ).any(axis=1)
-
-        self._cand_p[rows[t_idx]] = new_p
-        self._cand_c[rows[t_idx]] = new_c
-        changed[t_idx] = member_changed | cheaper
-        return changed, touch
 
     # ---------------- the solve ----------------
 
@@ -600,26 +610,30 @@ class NativeSolveArena:
 
         dirty_p = _dirty_rows(pf, self._p_fields, _P_SPEC)
         dirty_t = _dirty_rows(rf, self._r_fields, _R_SPEC)
-        # split provider churn by WHAT changed: price/load-only drift
-        # ("base churn" — the per-heartbeat common case) shifts a
-        # provider's whole cost column uniformly (cost = base + static,
-        # ops/cost.py invariant), so every cached candidate entry can be
-        # updated IN PLACE with one gather-add — no delta pass, no merge,
-        # no membership change. Only structural churn (specs, location,
-        # validity) needs the [dirty-P x T] regeneration. Base drift does
-        # leave candidate SELECTION stale (a repriced provider keeps its
-        # old edges); cold_every bounds that, same as the CandidateCache's
-        # periodic re-ground.
+        # struct/base split is OBSERVABILITY only now: the repair kernel
+        # treats price/load drift as churn like any other (its exactness
+        # contract re-scores the drifted columns — the historical
+        # in-place cost shift kept candidate membership stale between
+        # cold re-grounds, which the persistent structure no longer
+        # tolerates). The cost: a fleet-wide reprice is a full dirty set
+        # and honestly falls back to one cold-equivalent rebuild via
+        # max_dirty_frac instead of pretending to stay warm on stale
+        # selections.
         struct_dirty_p = _dirty_rows(
             pf, self._p_fields,
             [s for s in _P_SPEC if s[0] not in ("price", "load")],
         )
         base_only = dirty_p & ~struct_dirty_p
-        n_dp, n_dt = int(struct_dirty_p.sum()), int(dirty_t.sum())
+        n_dp_all, n_dt = int(dirty_p.sum()), int(dirty_t.sum())
+        n_dp = int(struct_dirty_p.sum())
         n_base = int(base_only.sum())
-        if (n_dp + n_dt) / (P + T) > self.max_dirty_frac:
+        if (n_dp_all + n_dt) / (P + T) > self.max_dirty_frac or (
+            # the incremental repair needs the bidirectional structure
+            # (reverse keys) to exist; without it every churn re-grounds
+            (n_dp_all or n_dt) and self._rev is None
+        ):
             return self._cold(ep, er, weights, pf, rf, P, T)
-        if n_dp == 0 and n_dt == 0 and n_base == 0:
+        if n_dp_all == 0 and n_dt == 0:
             # byte-identical marketplace: the carried matching IS the
             # solve (prices/retirement already consistent with it)
             self._warm_solves += 1
@@ -653,6 +667,7 @@ class NativeSolveArena:
                 **qual,
                 "cold": False,
                 "rows": T,
+                "cand_cold_passes": 0,
                 "dirty_providers": 0,
                 "dirty_tasks": 0,
                 "changed_rows": 0,
@@ -668,81 +683,45 @@ class NativeSolveArena:
         # plan-to-plan, not plan-to-scratchpad
         prev_p4t = self._p4t.copy() if obs.enabled() else None
         t_start = time.perf_counter()
-        old_price = self._p_fields["price"]
-        old_load = self._p_fields["load"]
         self._p_fields, self._r_fields = pf, rf
-        changed = dirty_t.copy()
-        # rows whose candidate COSTS move this solve, in either direction:
-        # the only rows whose eps-CS happiness can degrade (prices are
-        # monotone), so the only rows the warm repair needs to scan
-        repair = dirty_t.copy()
 
-        # ---- base-only drift: shift cached costs in place (one gather)
-        if n_base:
-            db = np.zeros(P, np.float32)
-            b_idx = np.flatnonzero(base_only)
-            db[b_idx] = (
-                np.float32(weights.price) * (pf["price"][b_idx] - old_price[b_idx])
-                + np.float32(weights.load) * (pf["load"][b_idx] - old_load[b_idx])
-            )
-            cp_safe = np.maximum(self._cand_p, 0)
-            entry_db = np.where(self._cand_p >= 0, db[cp_safe], 0.0)
-            self._cand_c += entry_db
-            repair |= (entry_db != 0.0).any(axis=1)
-            # a provider that got materially CHEAPER can un-retire every
-            # task holding it as a candidate; pricier/flat drift cannot
-            cheap = db < -0.05
-            changed |= (
-                (self._cand_p >= 0) & cheap[cp_safe]
-            ).any(axis=1)
-
-        # ---- dirty tasks: fresh fused pass against the full fleet
+        # ---- incremental repair: one native pass rewrites the persistent
+        # structure (forward lists + reverse keys + extras) in place,
+        # bit-identical to a from-scratch rebuild on the current columns,
+        # touching only what the dirty sets reach. ``repair`` (touched
+        # rows — costs moved in either direction) is the only set whose
+        # eps-CS happiness can degrade (prices are monotone), so it is
+        # the only set the warm auction re-scans; ``changed`` is the
+        # retirement-clearing set (membership moved or materially
+        # cheaper — pure cost increases cannot un-retire).
+        repair, changed = native.repair_topk_candidates(
+            _as_ns(pf, _P_SPEC), _as_ns(rf, _R_SPEC), weights,
+            self._cand_p, self._cand_c, self._rev,
+            np.flatnonzero(dirty_p).astype(np.int32),
+            np.flatnonzero(dirty_t).astype(np.int32),
+            k=self._cand_p.shape[1] - self.extra,
+            reverse_r=self.reverse_r, extra=self.extra,
+            threads=self.threads, coverage_frac=self.coverage_frac,
+            slack=(
+                (self._slack_p, self._slack_c)
+                if self._slack_p is not None else None
+            ),
+            stats=eng,
+        )
         if n_dt:
-            t_idx = np.flatnonzero(dirty_t)
-            sub_er = _subset(rf, t_idx, _R_SPEC)
-            tp, tc = native.fused_topk_candidates(
-                _as_ns(pf, _P_SPEC), sub_er, weights, k=self.k,
-                reverse_r=self.reverse_r, extra=self.extra,
-                threads=self.threads, stats=eng,
-            )
-            self._cand_p[t_idx] = tp
-            self._cand_c[t_idx] = tc
             # a dirty task's seat predates its new requirement: re-seat
             # from scratch (the warm repair would keep a stale-but-eps-OK
             # seat on candidates the task no longer declares)
-            self._p4t[t_idx] = -1
-
-        # ---- dirty providers: one [dirty-P x T] delta pass, merged into
-        # every row NOT already recomputed above
-        if n_dp:
-            p_idx = np.flatnonzero(struct_dirty_p)
-            sub_ep = _subset(pf, p_idx, _P_SPEC)
-            kd = min(self.k, n_dp)
-            dp_local, dc = native.fused_topk_candidates(
-                sub_ep, _as_ns(rf, _R_SPEC), weights, k=kd,
-                reverse_r=self.reverse_r, extra=self.extra,
-                threads=self.threads, stats=eng,
-            )
-            # local -> global provider ids
-            dp = np.where(
-                dp_local >= 0, p_idx[np.maximum(dp_local, 0)], -1
-            ).astype(np.int32)
-            keep_rows = np.flatnonzero(~dirty_t)
-            if keep_rows.size:
-                merge_changed, merge_touched = self._merge_delta(
-                    keep_rows, p_idx, dp, dc
-                )
-                changed[keep_rows] |= merge_changed
-                repair[keep_rows] |= merge_touched
+            self._p4t[np.flatnonzero(dirty_t)] = -1
 
         # ---- feasibility guard: a seat whose provider left the row's
-        # candidate list (struct churn dropped it, or an entering cheaper
-        # provider displaced it in the merge) must be unseated HERE, not
+        # candidate list (churn dropped it, or an entering cheaper
+        # provider displaced it in the repair) must be unseated HERE, not
         # left to the auction's eps-CS repair — with max_release capping
         # the repair, an over-cap infeasible seat would persist and then
         # be skipped by later repair masks (its row no longer churns).
         # Only rows whose lists moved this solve (repair mask) can have
-        # lost their seat; base-only drift never changes membership.
+        # lost their seat.
         seat_check = np.flatnonzero(repair & (self._p4t >= 0))
         if seat_check.size:
             in_list = (
@@ -823,6 +802,7 @@ class NativeSolveArena:
             "cold": False,
             "engine": self.engine,
             "rows": T,
+            "cand_cold_passes": 0,
             "dual_refresh": dual_refresh,
             "dirty_providers": n_dp,
             "base_only_providers": n_base,

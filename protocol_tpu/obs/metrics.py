@@ -159,6 +159,11 @@ class _SessionObs:
         # resilience plane: degraded (stale-plan) answers, flagged by
         # the servicer's tick-deadline watchdog, and the worst streak
         "stale_ticks", "stale_streak_max",
+        # incremental candidate maintenance: full-matrix passes vs
+        # repaired/rescanned rows (the candidate-generation wall's
+        # headline counters — a warm fleet should hold cold_passes at
+        # its cold-solve count and grow repairs, never the reverse)
+        "cand_cold_passes", "cand_repaired_rows", "cand_rescan_rows",
     )
 
     def __init__(self):
@@ -181,6 +186,9 @@ class _SessionObs:
         # so fractions are computable from the counters alone)
         self.outcome_counts: Optional[dict] = None
         self.unexplained = 0
+        self.cand_cold_passes = 0
+        self.cand_repaired_rows = 0
+        self.cand_rescan_rows = 0
 
     def reuse_ratio(self) -> float:
         """Fraction of candidate rows the warm path did NOT recompute."""
@@ -392,6 +400,15 @@ class ObsRegistry:
                         s.rows_changed += int(
                             stats.get("changed_rows", rows if cold else 0)
                         )
+                    s.cand_cold_passes += int(
+                        stats.get("cand_cold_passes", 1 if cold else 0)
+                    )
+                    s.cand_repaired_rows += int(
+                        stats.get("eng_cand_repair_rows", 0)
+                    )
+                    s.cand_rescan_rows += int(
+                        stats.get("eng_cand_repair_rescans", 0)
+                    )
                     s.observe_quality(stats)
                 s.delta_rows += int(delta_rows)
             alerts: list = []
@@ -444,6 +461,12 @@ class ObsRegistry:
             if s.stale_ticks:
                 out["stale_ticks"] = s.stale_ticks
                 out["stale_streak_max"] = s.stale_streak_max
+            if s.cand_cold_passes or s.cand_repaired_rows:
+                out["candidates"] = {
+                    "cold_passes": s.cand_cold_passes,
+                    "repaired_rows": s.cand_repaired_rows,
+                    "rescan_rows": s.cand_rescan_rows,
+                }
             quality = s.quality_snapshot()
             if quality is not None:
                 out["quality"] = quality

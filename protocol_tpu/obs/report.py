@@ -39,6 +39,12 @@ _PHASE_COLS = (
     ("eng_bids", "bids", False),
     ("eng_evicted", "evict", False),
     ("eng_sink_iters", "sweeps", False),
+    # incremental candidate maintenance (the repair kernel's phase wall
+    # and row accounting; cold ticks report the cold-pass counter)
+    ("eng_cand_repair_merge_ms", "cand_rep", True),
+    ("eng_cand_repair_rows", "rep_rows", False),
+    ("eng_cand_repair_rescans", "rescans", False),
+    ("cand_cold_passes", "cold_gen", False),
     ("changed_rows", "dirty", False),
     ("delta_rows", "delta", False),
 )

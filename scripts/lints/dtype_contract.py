@@ -228,6 +228,51 @@ class DtypeContractRule(Rule):
                         "columns vanish at the seam",
                     ))
         out += self._check_trace(wire_tree)
+        out += self._check_cand_state(arena_tree)
+        return out
+
+    def _check_cand_state(self, arena_tree: ast.AST) -> list[Finding]:
+        """Fourth dtype site: the arena's persistent candidate structure
+        (forward lists + reverse keys + slack shadow). These arrays ride
+        checkpoint journal frames and live-migration handoffs, so their
+        widths are as durable as the trace tables: _CAND_STATE_DTYPES
+        must exist and cover exactly the cand_* keys export_state emits
+        (restore_state coerces through the same table)."""
+        export_fn = None
+        for node in ast.walk(arena_tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "export_state":
+                export_fn = node
+                break
+        if export_fn is None:
+            return []  # fixture subsets without the arena class: no seam
+        spec = _dict_spec(arena_tree, "_CAND_STATE_DTYPES")
+        if spec is None:
+            return [Finding(
+                self.name, self.arena, export_fn.lineno,
+                "missing dtype table _CAND_STATE_DTYPES — the persisted "
+                "candidate structure's widths are an on-disk contract",
+            )]
+        declared = {n for n, _, _ in spec}
+        emitted = set()
+        for node in ast.walk(export_fn):
+            if isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if (
+                        isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                        and key.value.startswith("cand")
+                    ):
+                        emitted.add(key.value)
+        out: list[Finding] = []
+        if declared != emitted:
+            missing = sorted(emitted - declared)
+            stray = sorted(declared - emitted)
+            out.append(Finding(
+                self.name, self.arena, spec[0][2] if spec else 0,
+                f"_CAND_STATE_DTYPES does not cover export_state's cand_* "
+                f"keys exactly (missing={missing} stray={stray}) — an "
+                "undeclared persisted array restores at a guessed width",
+            ))
         return out
 
     def _check_trace(self, wire_tree: ast.AST) -> list[Finding]:

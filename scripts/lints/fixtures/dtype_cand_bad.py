@@ -1,0 +1,31 @@
+"""Mini arena module for the persisted-candidate dtype seed: the spec
+tables are consistent, but export_state emits a cand_* array
+(cand_rev) that _CAND_STATE_DTYPES never declares — an undeclared
+persisted width (the checkpoint would restore it at a guess)."""
+
+import numpy as np
+
+_P_SPEC = (
+    ("gpu_count", np.int32),
+    ("price", np.float32),
+    ("valid", np.uint8),
+)
+_R_SPEC = (
+    ("cpu_cores", np.int32),
+    ("ram_mb", np.int32),
+    ("valid", np.uint8),
+)
+
+_CAND_STATE_DTYPES = {
+    "cand_p": np.int32,
+    "cand_c": np.float32,
+}
+
+
+class MiniArena:
+    def export_state(self):
+        return {
+            "cand_p": None,
+            "cand_c": None,
+            "cand_rev": None,  # persisted but undeclared: the seed
+        }

@@ -294,6 +294,161 @@ def sinkhorn_gate() -> int:
     return 0
 
 
+def cand_gate() -> int:
+    """Incremental-candidate-maintenance gate (ISSUE 13): a 16k x 16k
+    1%-churn warm tick must repair the persistent structure with ZERO
+    full-matrix candidate passes, beat the arena's own cold generation
+    by >= ``gen_warm_speedup_floor`` (measured at threads=2 — the ratio
+    is Amdahl-sensitive at high core counts, where the cold pass keeps
+    scaling while the repair wall is already tens of ms), touch at most
+    ``cand_repair_cells_frac_max`` of the P*T cell plane (the
+    machine-independent work bound), and leave the structure
+    BIT-IDENTICAL to a from-scratch rebuild. The bucketed cold pruner is
+    held to its own exactness bar on the same population."""
+    import dataclasses
+    import time as _time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu import native
+    from protocol_tpu.native.arena import NativeSolveArena
+    from protocol_tpu.ops.cost import CostWeights
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    w = CostWeights()
+    n = 16384
+    ep = bench.synth_providers(np.random.default_rng(2), n)
+    er = bench.synth_requirements(np.random.default_rng(3), n)
+
+    # ---- bucketed cold pruner: bit-identical to the full scan, and it
+    # genuinely prunes on this (GPU-selective) population. The
+    # reference is the v2 full scan (rev_out requested) — the
+    # persistent-structure family pins one float pipeline on every
+    # build, while the legacy entries keep the vector cost path on
+    # tuned (-march=native AVX-512) local builds
+    st: dict = {}
+    cand_b = native.fused_topk_candidates(
+        ep, er, w, k=64, threads=2, bucketed=True, stats=st
+    )
+    cand_f = native.fused_topk_candidates(
+        ep, er, w, k=64, threads=2,
+        rev_out=np.zeros((n, 8), np.uint64),
+    )
+    if not (
+        np.array_equal(cand_b[0], cand_f[0])
+        and np.array_equal(cand_b[1], cand_f[1])
+    ):
+        failures.append("bucketed cold generation is not bit-identical")
+    visit_frac = st["gen_visited"] / float(n * n)
+    print(
+        f"cand gate: bucketed cold visited {visit_frac:.2%} of P*T "
+        f"({st['gen_fallback_rows']} fallback rows), bit-identical "
+        f"{not failures}"
+    )
+
+    # ---- warm repair floor: cold arena solve, 1% price churn, one warm
+    # tick — zero full-matrix passes, >= floor gen speedup, structure
+    # bit-identical to a from-scratch rebuild
+    arena = NativeSolveArena(threads=2)
+    arena.solve(ep, er, w)
+    if arena.last_stats["cand_cold_passes"] != 1:
+        failures.append(
+            f"cold solve reported cand_cold_passes="
+            f"{arena.last_stats['cand_cold_passes']}, want 1"
+        )
+    gen_cold = float(arena.last_stats["gen_ms"])
+    churn_rng = np.random.default_rng(4)
+    rows = churn_rng.choice(n, n // 100, replace=False)
+    price = np.array(ep.price, copy=True)
+    price[rows] = churn_rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+    ep2 = dataclasses.replace(ep, price=price)
+    t0 = _time.perf_counter()
+    p4t = arena.solve(ep2, er, w)
+    t_warm = _time.perf_counter() - t0
+    stats = arena.last_stats
+    gen_warm = float(stats["gen_ms"])
+    speedup = gen_cold / max(gen_warm, 1e-9)
+    frac = int((p4t >= 0).sum()) / n
+    print(
+        f"cand gate: 16k 1%-churn warm gen {gen_warm:.1f}ms vs cold "
+        f"{gen_cold:.1f}ms ({speedup:.1f}x, floor "
+        f"{floors['gen_warm_speedup_floor']}x); tick {t_warm:.2f}s, "
+        f"assigned frac {frac:.3f}, cand_cold_passes "
+        f"{stats['cand_cold_passes']}"
+    )
+    if stats["cand_cold_passes"] != 0:
+        failures.append(
+            f"warm 1%-churn tick ran {stats['cand_cold_passes']} "
+            "full-matrix candidate passes (want 0)"
+        )
+    if speedup < floors["gen_warm_speedup_floor"]:
+        failures.append(
+            f"warm candidate repair only {speedup:.1f}x faster than cold "
+            f"generation (floor {floors['gen_warm_speedup_floor']}x)"
+        )
+    if frac < floors["cand_min_assigned_frac"]:
+        failures.append(
+            f"warm assigned fraction {frac:.3f} below "
+            f"{floors['cand_min_assigned_frac']}"
+        )
+
+    # ---- machine-independent work bound: cells the repair scored
+    # (requires the obs plane for eng_ stats; re-run the repair kernel
+    # directly so the gate never depends on the obs toggle)
+    rev = np.zeros((n, 8), np.uint64)
+    slack = (np.zeros((n, 16), np.int32), np.zeros((n, 16), np.float32))
+    cp, cc = native.fused_topk_candidates(
+        ep, er, w, k=64, threads=2, bucketed=True, rev_out=rev,
+        slack_out=slack,
+    )
+    rst: dict = {}
+    native.repair_topk_candidates(
+        ep2, er, w, cp, cc, rev, rows.astype(np.int32),
+        np.zeros(0, np.int32), k=64, threads=2, slack=slack, stats=rst,
+    )
+    cells_frac = rst["cand_repair_exact_scores"] / float(n * n)
+    print(
+        f"cand gate: repair scored {cells_frac:.2%} of P*T "
+        f"(ceiling {floors['cand_repair_cells_frac_max']:.0%}), "
+        f"{rst['cand_repair_rescans']} row rescans, "
+        f"{rst['cand_repair_rows']} merges"
+    )
+    if cells_frac > floors["cand_repair_cells_frac_max"]:
+        failures.append(
+            f"repair scored {cells_frac:.2%} of the cell plane "
+            f"(ceiling {floors['cand_repair_cells_frac_max']:.0%})"
+        )
+
+    # ---- repaired-structure exactness on the gate population
+    rev_ref = np.zeros((n, 8), np.uint64)
+    ref_p, ref_c = native.fused_topk_candidates(
+        ep2, er, w, k=64, threads=2, rev_out=rev_ref
+    )
+    if not (
+        np.array_equal(cp, ref_p) and np.array_equal(cc, ref_c)
+        and np.array_equal(rev, rev_ref)
+        and np.array_equal(arena._cand_p, ref_p)
+        and np.array_equal(arena._cand_c, ref_c)
+    ):
+        failures.append(
+            "repaired candidate structure is not bit-identical to a "
+            "from-scratch rebuild"
+        )
+    else:
+        print("cand gate: repaired structure bit-identical to rebuild")
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("cand perf gate OK")
+    return 0
+
+
 def paired_overhead(run, pairs: int = 9):
     """Robust A/B overhead estimate for a noisy wall: ``run(flag)``
     returns the chain wall with instrumentation on (True) / off
@@ -355,7 +510,15 @@ def arena_chain_overhead(label: str, max_frac: float):
     spans + native EngineStats + outcome/margin buffers + the
     certificate pass + tick_quality in one go. Returns ``(within,
     results)`` — ``results[flag]`` holds the chain's three matchings
-    for the bit-identity check."""
+    for the bit-identity check.
+
+    Budget note (ISSUE 13): ``obs_overhead_max_frac`` was recalibrated
+    0.03 -> 0.05 when incremental candidate maintenance shrank the
+    chain's DENOMINATOR ~30% (bucketed cold gen + warm repair). The
+    plane's absolute cost per solve is unchanged (~1.3 ms: the
+    margin/certificate pass + tick_quality + buffers); 5% of the faster
+    chain is the same milliseconds the original 3% bar licensed — a
+    real instrumentation regression still fails every attempt."""
     import dataclasses
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -623,6 +786,12 @@ def trace_gate() -> int:
     # warm-solve floor on the inproc replay's own tick walls. A replay
     # that diverged at the cold tick has no warm walls — skip the floor
     # math so the DIVERGENCE failures above surface, not a KeyError.
+    # NOTE the floor is 2.0x since ISSUE 13: the exact-repair warm path
+    # does MORE work at this toy (512) scale than the historical
+    # stale-merge (it maintains bit-identity with a from-scratch
+    # rebuild), so the 512 ratio is overhead-dominated — the strong
+    # warm-generation floor (>= 10x at 16k, 1% churn) lives in
+    # ``perf_gate.py --cand``.
     if "warm_median_ms" in warm_rep:
         speedup = warm_rep["cold_ms"] / max(
             warm_rep["warm_median_ms"], 1e-9
@@ -1038,8 +1207,11 @@ def main() -> int:
     ap.add_argument("--quality", action="store_true")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--dfleet", action="store_true")
+    ap.add_argument("--cand", action="store_true")
     args = ap.parse_args()
 
+    if args.cand:
+        return cand_gate()
     if args.wire:
         return wire_gate()
     if args.sinkhorn:

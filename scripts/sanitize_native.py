@@ -192,6 +192,57 @@ def _child(args) -> int:
     _assert_identical(fused, "fused_topk_candidates_mt")
     cand_p, cand_c = fused[threads[0]]
 
+    # --- stress 1b: capability-bucket pruner + incremental repair chain
+    # (the persistent-candidate warm path): bucketed cold must equal the
+    # full scan bit-for-bit, and a churned repair chain must stay
+    # thread-invariant AND bit-identical to from-scratch rebuilds — the
+    # repair kernel's parallel phases (pooled column sweeps, merges,
+    # reverse strip/fold, subset scatter) all run under the sanitizer
+    for t in threads:
+        got = native.fused_topk_candidates(
+            ep, er, w, k=K, threads=t, bucketed=True
+        )
+        if not (np.array_equal(got[0], cand_p)
+                and np.array_equal(got[1], cand_c)):
+            raise SystemExit(
+                "BUCKETED PRUNER NOT EXACT: bucketed cold generation "
+                f"differs from the full scan at threads={t}"
+            )
+    repair_runs = {}
+    for t in threads:
+        crng = np.random.default_rng(29)
+        ep_t, er_t, w_t = _synth_marketplace(np.random.default_rng(7), P, T)
+        rev = np.zeros((P, 8), np.uint64)
+        slack = (np.zeros((T, 8), np.int32), np.zeros((T, 8), np.float32))
+        cp, cc = native.fused_topk_candidates(
+            ep_t, er_t, w_t, k=K, threads=t, bucketed=True,
+            rev_out=rev, slack_out=slack,
+        )
+        trace = [cp.copy(), cc.copy(), rev.copy()]
+        for _ in range(max(2, args.ticks // 2)):
+            drift, struct, tasks = _churn(crng, ep_t, er_t, frac=0.02)
+            dirty_p = np.union1d(drift, struct).astype(np.int32)
+            touched, changed = native.repair_topk_candidates(
+                ep_t, er_t, w_t, cp, cc, rev,
+                dirty_p, tasks.astype(np.int32),
+                k=K, threads=t, slack=slack,
+            )
+            trace += [cp.copy(), cc.copy(), rev.copy(),
+                      touched.copy(), changed.copy()]
+        repair_runs[t] = trace
+        if t == threads[0]:
+            rev_ref = np.zeros((P, 8), np.uint64)
+            rp, rc = native.fused_topk_candidates(
+                ep_t, er_t, w_t, k=K, threads=t, rev_out=rev_ref
+            )
+            if not (np.array_equal(cp, rp) and np.array_equal(cc, rc)
+                    and np.array_equal(rev, rev_ref)):
+                raise SystemExit(
+                    "REPAIR NOT EXACT: repaired candidate structure "
+                    "differs from a from-scratch rebuild"
+                )
+    _assert_identical(repair_runs, "repair_topk_candidates_mt chain")
+
     # --- stress 2: warm auction chain (Jacobi bidding rounds, per-thread
     # bid buffers, eps-CS repair, seat eviction caps) with churned costs;
     # the outcome taxonomy + margins ride the same invariance check

@@ -420,6 +420,15 @@ class TestLiveMigration:
             # zero reopens anywhere: exactly one session_open total
             assert seam_a.get("session_session_open") == 1
             assert "session_session_open" not in seam_b
+
+            # the persistent candidate structure rode the journal: the
+            # rehydrated session's post-handoff delta ticks REPAIRED the
+            # carried structure warm — zero full-matrix candidate
+            # passes — instead of regenerating cold on the new process
+            session, _ = b.servicer.sessions.get(sid, fp)
+            assert session is not None
+            assert session.arena.last_stats["cold"] is False
+            assert session.arena.last_stats["cand_cold_passes"] == 0
         finally:
             client.close()
             a.stop(grace=None)

@@ -128,6 +128,29 @@ class TestDtypeCrossCheck:
         )
         assert rule.check_repo() == []
 
+    def test_persisted_candidate_table_mismatch_is_a_finding(self):
+        """Fourth dtype site: export_state's cand_* keys must be covered
+        by _CAND_STATE_DTYPES exactly (the persisted candidate
+        structure's widths are an on-disk journal contract)."""
+        rule = DtypeContractRule(
+            wire=str(FIXTURES / "dtype_wire_ok.py"),
+            arena=str(FIXTURES / "dtype_cand_bad.py"),
+            encoding=str(FIXTURES / "dtype_encoding_ok.py"),
+            trace=str(FIXTURES / "dtype_trace_ok.py"),
+        )
+        findings = rule.check_repo()
+        assert len(findings) == 1
+        assert "cand_rev" in findings[0].message
+
+    def test_real_arena_candidate_table_is_consistent(self):
+        """The shipped arena's declared table covers its export exactly
+        (mutation coverage rides the seeded fixture above)."""
+        findings = [
+            f for f in DtypeContractRule().check_repo()
+            if "_CAND_STATE_DTYPES" in f.message or "cand_" in f.message
+        ]
+        assert findings == []
+
     def test_missing_table_is_a_finding_not_a_crash(self):
         rule = DtypeContractRule(
             wire=str(FIXTURES / "dtype_encoding_ok.py"),  # no dtype dicts

@@ -154,17 +154,20 @@ class TestWarmArena:
         assert arena.last_stats["changed_rows"] == 0
         assert arena.last_stats["cold"] is False
 
-    def test_churn_recomputes_only_dirty_rows(self, monkeypatch):
+    def test_churn_repairs_in_place_without_any_fused_pass(
+        self, monkeypatch
+    ):
+        """Mixed churn (specs + price + task priorities) must flow
+        entirely through the native repair kernel — zero calls to the
+        fused generator, zero full-matrix passes — and leave the
+        persistent structure bit-identical to a from-scratch rebuild on
+        the churned features."""
         from protocol_tpu.native.arena import NativeSolveArena
 
         ep, er = self._marketplace()
-        n = np.asarray(ep.price).shape[0]
         arena = NativeSolveArena(threads=2)
         arena.solve(ep, er, CostWeights())
 
-        # churn 5 providers' SPECS (structural: candidate regeneration),
-        # 2 more providers' price (base-only: in-place cost shift), and
-        # 3 tasks' priority
         mem = np.array(ep.gpu_mem_mb, copy=True)
         mem[[3, 50, 99, 120, 200]] += 8000
         price = np.array(ep.price, copy=True)
@@ -174,55 +177,62 @@ class TestWarmArena:
         prio[[7, 8, 9]] += 0.25
         er2 = dataclasses.replace(er, priority=prio)
 
-        shapes = []
-        real = native.fused_topk_candidates
         monkeypatch.setattr(
             native, "fused_topk_candidates",
-            lambda p, r, *a, **kw: shapes.append(
-                (np.asarray(p.price).shape[0], np.asarray(r.priority).shape[0])
-            )
-            or real(p, r, *a, **kw),
+            lambda *a, **kw: pytest.fail(
+                "warm churn ran a fused candidate pass"
+            ),
         )
         p4t = arena.solve(ep2, er2, CostWeights())
         stats = arena.last_stats
         assert stats["cold"] is False
+        assert stats["cand_cold_passes"] == 0
         assert stats["dirty_providers"] == 5
         assert stats["base_only_providers"] == 2
         assert stats["dirty_tasks"] == 3
-        # exactly two delta passes: [full-P x 3 dirty tasks] and
-        # [5 struct-dirty providers x full-T] — never the full [P x T]
-        # pass, and NO pass for the price-only providers (their cached
-        # costs shift in place)
-        assert sorted(shapes) == sorted([(n, 3), (5, n)])
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
+        # the repaired structure IS the cold structure, bit for bit
+        monkeypatch.undo()
+        rev_ref = np.zeros_like(arena._rev)
+        ref_p, ref_c = native.fused_topk_candidates(
+            ep2, er2, CostWeights(), k=arena.k,
+            reverse_r=arena.reverse_r, extra=arena.extra,
+            threads=2, rev_out=rev_ref,
+        )
+        np.testing.assert_array_equal(arena._cand_p, ref_p)
+        np.testing.assert_array_equal(arena._cand_c, ref_c)
+        np.testing.assert_array_equal(arena._rev, rev_ref)
 
-    def test_base_only_churn_shifts_costs_in_place(self, monkeypatch):
-        """Price/load drift must NOT regenerate candidates: cached costs
-        shift by exactly the base delta (cost = base + static)."""
+    def test_base_only_churn_repairs_membership_exactly(self, monkeypatch):
+        """Price drift is churn like any other under the exactness
+        contract: no fused pass, but a repriced provider's candidate
+        entries (and any membership it gained or lost) match a cold
+        rebuild exactly — not the historical stale in-place shift."""
         from protocol_tpu.native.arena import NativeSolveArena
 
         ep, er = self._marketplace()
         arena = NativeSolveArena(threads=2)
         arena.solve(ep, er, CostWeights())
-        before_p = arena._cand_p.copy()
-        before_c = arena._cand_c.copy()
 
         price = np.array(ep.price, copy=True)
         price[7] += 0.25
+        ep2 = dataclasses.replace(ep, price=price)
         monkeypatch.setattr(
             native, "fused_topk_candidates",
-            lambda *a, **kw: pytest.fail("base-only churn ran a delta pass"),
+            lambda *a, **kw: pytest.fail("base-only churn ran a fused pass"),
         )
-        arena.solve(dataclasses.replace(ep, price=price), er, CostWeights())
-        np.testing.assert_array_equal(arena._cand_p, before_p)
-        mask = before_p == 7
-        np.testing.assert_allclose(
-            arena._cand_c[mask], before_c[mask] + 0.25, rtol=1e-6
-        )
-        np.testing.assert_array_equal(arena._cand_c[~mask], before_c[~mask])
+        arena.solve(ep2, er, CostWeights())
         assert arena.last_stats["base_only_providers"] == 1
         assert arena.last_stats["dirty_providers"] == 0
+        assert arena.last_stats["cand_cold_passes"] == 0
+        monkeypatch.undo()
+        ref_p, ref_c = native.fused_topk_candidates(
+            ep2, er, CostWeights(), k=arena.k,
+            reverse_r=arena.reverse_r, extra=arena.extra, threads=2,
+        )
+        np.testing.assert_array_equal(arena._cand_p, ref_p)
+        np.testing.assert_array_equal(arena._cand_c, ref_c)
 
     def test_heavy_churn_falls_back_to_cold(self):
         from protocol_tpu.native.arena import NativeSolveArena
@@ -239,9 +249,12 @@ class TestWarmArena:
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
 
-    def test_fleetwide_price_drift_stays_warm(self):
-        """A fleet-wide reprice is base-only churn: handled in place, no
-        cold rebuild even above max_dirty_frac."""
+    def test_fleetwide_price_drift_regrounds_cold(self):
+        """A fleet-wide reprice dirties every provider: under the
+        exactness contract the repair would cost a cold pass anyway, so
+        max_dirty_frac routes it to an HONEST cold rebuild instead of
+        the historical stay-warm-on-stale-selections shift (whose
+        membership drifted until the next cold_every beat)."""
         from protocol_tpu.native.arena import NativeSolveArena
 
         ep, er = self._marketplace()
@@ -252,8 +265,8 @@ class TestWarmArena:
         p4t = arena.solve(
             dataclasses.replace(ep, price=price), er, CostWeights()
         )
-        assert arena.last_stats["cold"] is False
-        assert arena.last_stats["base_only_providers"] == len(price)
+        assert arena.last_stats["cold"] is True
+        assert arena.last_stats["cand_cold_passes"] == 1
         pos = p4t[p4t >= 0]
         assert np.unique(pos).size == pos.size
 
