@@ -29,8 +29,25 @@ the target's namespace. The client follows the redirect and resends the
 SAME delta; the target rehydrates the journal warm on that miss, and
 the tick-cursor/CRC retransmit dedup carries "no tick lost or
 double-applied" across the process boundary.
+
+Autonomous resilience tier (ISSUE 14): :class:`FailureDetector` (in
+``detector.py``) watches per-process Health heartbeats through a
+deterministic alive→suspect→dead state machine (EWMA inter-arrival
+thresholds, flap suppression so a slow-but-alive node degrades instead
+of being ejected); on DEAD the manager runs the ejection autonomously
+— generation bump, journal re-route, and FENCE supersession
+(``faults/checkpoint.py``): a monotonic epoch stamped into each
+process's journal namespace at spawn and superseded at ejection, so a
+SIGSTOPped zombie that resumes finds itself out-fenced and refuses
+(``moved:``) instead of double-applying ticks. Split-brain impossible
+by construction: the journal's location is the authority — at the
+highest fence.
 """
 
+from protocol_tpu.dfleet.detector import (  # noqa: F401
+    DetectorConfig,
+    FailureDetector,
+)
 from protocol_tpu.dfleet.topology import FleetTopology  # noqa: F401
 
-__all__ = ["FleetTopology"]
+__all__ = ["FleetTopology", "FailureDetector", "DetectorConfig"]

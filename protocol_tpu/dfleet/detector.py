@@ -1,0 +1,253 @@
+"""Deterministic heartbeat failure detector for the process fleet.
+
+PR 12 gave the fleet journal re-routing, live migration, and a client
+failover ladder — but every recovery was DRIVER-scripted: nothing
+noticed a dead or wedged process on its own. This module is the
+autonomous half: a per-process health tracker with an explicit
+
+    alive --(heartbeats stop)--> suspect --(sustained)--> dead
+
+state machine, EWMA inter-arrival tracking (the detection threshold
+adapts to the sampler's real cadence instead of hardcoding a period),
+and flap suppression (a slow-but-alive process that oscillates
+alive<->suspect inflates its own thresholds instead of being ejected —
+the gray-failure degradation ladder: while merely SUSPECT, a process
+keeps its sessions and serves them under the bounded-staleness
+watchdog contract; only DEAD triggers ejection).
+
+Determinism contract (the lint enforces it): this module never reads a
+clock. Every method takes ``now`` explicitly — the caller owns time
+(:meth:`ProcessFleet.start_detector` feeds ``time.perf_counter``; the
+tests feed a virtual clock), so a recorded sample sequence replays to
+the identical transition sequence, byte for byte. ``dead`` is terminal
+by design: a zombie's late heartbeat is COUNTED, never believed —
+resurrection is a membership change (a fresh spawn with a fresh fence
+epoch), not a state transition.
+
+The detector itself is pure bookkeeping; POLICY lives in the caller.
+On ``dead`` the fleet manager runs the existing recovery machinery:
+``handoff_dead`` re-routes the namespace's journals along the ring,
+the ``FleetTopology`` generation bumps (the discovery tier serves the
+new ring on its next poll), and the namespace's fencing epoch is
+superseded so the ejected process — paused, partitioned, or merely
+slow — can never ack or flush against journals it no longer owns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from protocol_tpu.utils.lockwitness import make_lock
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    """Detection thresholds. All elapsed-time comparisons are against
+    ``factor * max(ewma, min_interval_s) * flap penalty`` — the EWMA
+    tracks the sampler's real heartbeat cadence, ``min_interval_s``
+    floors it (a fast sampler must not hair-trigger), and the penalty
+    implements flap suppression (see :meth:`FailureDetector.evaluate`).
+    """
+
+    alpha: float = 0.3            # EWMA smoothing of inter-arrivals
+    suspect_factor: float = 3.0   # alive -> suspect past this many ewmas
+    dead_factor: float = 6.0      # suspect -> dead past this many ewmas
+    min_interval_s: float = 0.1   # EWMA floor
+    dead_misses: int = 3          # consecutive failed probes ALSO required
+    flap_penalty: float = 1.0     # threshold inflation per recent flap
+    flap_memory: int = 4          # recent-flap count cap
+    flap_decay_beats: int = 8     # clean beats that forgive one flap
+    max_penalty: float = 4.0      # penalty ceiling
+
+
+class _ProcHealth:
+    __slots__ = (
+        "state", "last_seen", "ewma_s", "misses", "first_miss_at",
+        "flaps", "recent_flaps", "clean_streak", "suspect_since",
+        "dead_at", "zombie_beats",
+    )
+
+    def __init__(self) -> None:
+        self.state = ALIVE
+        self.last_seen: Optional[float] = None
+        self.ewma_s: Optional[float] = None
+        self.misses = 0
+        self.first_miss_at: Optional[float] = None
+        self.flaps = 0          # lifetime suspect->alive recoveries
+        self.recent_flaps = 0   # the suppression window (decays)
+        self.clean_streak = 0
+        self.suspect_since: Optional[float] = None
+        self.dead_at: Optional[float] = None
+        self.zombie_beats = 0   # heartbeats AFTER dead (counted, ignored)
+
+
+class FailureDetector:
+    """Track N processes' heartbeat health (see module docstring).
+
+    Thread contract: all methods are safe to call concurrently (one
+    leaf lock); :meth:`evaluate` returns the NEWLY dead proc ids and the
+    caller reacts outside the lock — the detector never calls back into
+    fleet machinery, so its lock nests under nothing.
+    """
+
+    def __init__(self, proc_ids, config: Optional[DetectorConfig] = None):
+        self.config = config or DetectorConfig()
+        self._lock = make_lock("detector")
+        self._procs: dict[str, _ProcHealth] = {
+            str(pid): _ProcHealth() for pid in proc_ids
+        }
+        # bounded transition log: (proc, from, to, at) — what the fleet
+        # report and the gate read to prove "suspect before dead"
+        self.transitions: list[tuple] = []
+        self.suspects_entered = 0
+        self.ejections = 0
+
+    # ---------------- membership ----------------
+
+    def add(self, proc_id: str) -> None:
+        with self._lock:
+            self._procs.setdefault(str(proc_id), _ProcHealth())
+
+    def remove(self, proc_id: str) -> None:
+        """Forget a process the DRIVER took down itself (kill/drain):
+        a scripted death must never count as a detector ejection."""
+        with self._lock:
+            self._procs.pop(str(proc_id), None)
+
+    # ---------------- samples ----------------
+
+    def heartbeat(self, proc_id: str, now: float) -> None:
+        c = self.config
+        with self._lock:
+            p = self._procs.get(str(proc_id))
+            if p is None:
+                return
+            if p.state == DEAD:
+                # terminal: a zombie's late beat is evidence FOR the
+                # fence drill, not a resurrection
+                p.zombie_beats += 1
+                return
+            if p.state == SUSPECT:
+                p.state = ALIVE
+                p.flaps += 1
+                p.recent_flaps = min(p.recent_flaps + 1, c.flap_memory)
+                p.clean_streak = 0
+                p.suspect_since = None
+                self._log(proc_id, SUSPECT, ALIVE, now)
+            else:
+                p.clean_streak += 1
+                if (
+                    p.recent_flaps > 0
+                    and p.clean_streak >= c.flap_decay_beats
+                ):
+                    p.recent_flaps -= 1
+                    p.clean_streak = 0
+            if p.last_seen is not None:
+                interval = max(now - p.last_seen, 0.0)
+                p.ewma_s = (
+                    interval if p.ewma_s is None
+                    else c.alpha * interval + (1.0 - c.alpha) * p.ewma_s
+                )
+            p.last_seen = now
+            p.misses = 0
+            p.first_miss_at = None
+
+    def probe_failed(self, proc_id: str, now: float) -> None:
+        with self._lock:
+            p = self._procs.get(str(proc_id))
+            if p is None or p.state == DEAD:
+                return
+            p.misses += 1
+            if p.first_miss_at is None:
+                p.first_miss_at = now
+
+    # ---------------- evaluation ----------------
+
+    def _threshold_s(self, p: _ProcHealth, factor: float) -> float:
+        c = self.config
+        ewma = max(p.ewma_s or c.min_interval_s, c.min_interval_s)
+        penalty = min(
+            1.0 + c.flap_penalty * p.recent_flaps, c.max_penalty
+        )
+        return factor * ewma * penalty
+
+    def evaluate(self, now: float) -> list:
+        """Advance every process's state machine to ``now``; returns
+        the proc ids that JUST transitioned to dead (each id is
+        returned exactly once, ever). Iteration order is sorted — two
+        detectors fed the same samples eject in the same order."""
+        c = self.config
+        newly_dead: list = []
+        with self._lock:
+            for pid in sorted(self._procs):
+                p = self._procs[pid]
+                if p.state == DEAD:
+                    continue
+                anchor = (
+                    p.last_seen if p.last_seen is not None
+                    else p.first_miss_at
+                )
+                if anchor is None:
+                    continue  # no sample yet: nothing to judge
+                elapsed = now - anchor
+                if p.state == ALIVE and elapsed > self._threshold_s(
+                    p, c.suspect_factor
+                ):
+                    p.state = SUSPECT
+                    p.suspect_since = now
+                    p.clean_streak = 0
+                    self.suspects_entered += 1
+                    self._log(pid, ALIVE, SUSPECT, now)
+                if (
+                    p.state == SUSPECT
+                    and p.misses >= c.dead_misses
+                    and elapsed > self._threshold_s(p, c.dead_factor)
+                ):
+                    p.state = DEAD
+                    p.dead_at = now
+                    self.ejections += 1
+                    self._log(pid, SUSPECT, DEAD, now)
+                    newly_dead.append(pid)
+        return newly_dead
+
+    def _log(self, pid, frm, to, now) -> None:
+        # caller holds the lock
+        self.transitions.append((str(pid), frm, to, float(now)))
+        del self.transitions[:-256]
+
+    # ---------------- introspection ----------------
+
+    def state_of(self, proc_id: str) -> Optional[str]:
+        with self._lock:
+            p = self._procs.get(str(proc_id))
+            return p.state if p is not None else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            procs = {
+                pid: {
+                    "state": p.state,
+                    "ewma_s": round(p.ewma_s, 6) if p.ewma_s else None,
+                    "misses": p.misses,
+                    "flaps": p.flaps,
+                    "recent_flaps": p.recent_flaps,
+                    "zombie_beats": p.zombie_beats,
+                }
+                for pid, p in sorted(self._procs.items())
+            }
+            return {
+                "procs": procs,
+                "totals": {
+                    "suspects_entered": self.suspects_entered,
+                    "ejections": self.ejections,
+                    "flaps": sum(
+                        p.flaps for p in self._procs.values()
+                    ),
+                },
+                "transitions": list(self.transitions),
+            }

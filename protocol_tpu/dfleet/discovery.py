@@ -106,10 +106,23 @@ class DiscoveryEndpoint:
         self._httpd.server_close()
 
 
-def fetch_topology(url: str, timeout: float = 10.0) -> FleetTopology:
-    """Client bootstrap: fetch the fleet map from a discovery endpoint
-    (``url`` is the endpoint base, e.g. ``http://127.0.0.1:8123``)."""
+def fetch_topology(
+    url: str,
+    timeout: float = 10.0,
+    current: "FleetTopology | None" = None,
+) -> FleetTopology:
+    """Client bootstrap/poll: fetch the fleet map from a discovery
+    endpoint (``url`` is the endpoint base, e.g.
+    ``http://127.0.0.1:8123``). GENERATION-MONOTONIC when ``current``
+    is given: a fetched map whose generation is not strictly newer
+    than the one already held is DISCARDED and ``current`` returned
+    unchanged — a stale poll (a lagging discovery replica, a response
+    that raced a detector ejection) must lose to the membership change
+    it is stale against."""
     with urllib.request.urlopen(
         f"{url.rstrip('/')}/fleet.json", timeout=timeout
     ) as r:
-        return FleetTopology.from_dict(json.loads(r.read().decode()))
+        fetched = FleetTopology.from_dict(json.loads(r.read().decode()))
+    if current is not None and fetched.generation <= current.generation:
+        return current
+    return fetched

@@ -32,8 +32,10 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 from typing import Optional
@@ -114,6 +116,16 @@ class ProcessFleet:
             procs={p.address: p.proc_id for p in self.procs},
             vnodes=vnodes,
         )
+        # autonomous failure detection (start_detector): the detector
+        # and its monitor thread, plus the ejection event log the
+        # loadgen report and the zombie-resume gate read (time-to-
+        # detect, false-positive accounting)
+        self.detector = None
+        self._detector_stop: Optional[threading.Event] = None
+        self._detector_thread: Optional[threading.Thread] = None
+        self.ejections: list = []
+        self._detector_ejected: set = set()
+        self.last_handoff_stats: dict = {}
         self.discovery = None
         if discovery:
             from protocol_tpu.dfleet.discovery import DiscoveryEndpoint
@@ -142,6 +154,16 @@ class ProcessFleet:
         return self
 
     def _spawn(self, p: ManagedProc) -> None:
+        # fence stamp at spawn: the child's SessionCheckpointer adopts
+        # this epoch at boot; ejection stamps a HIGHER one into the same
+        # namespace, so a paused-then-resumed incarnation can prove to
+        # itself that it was superseded (faults/checkpoint.py fencing)
+        from protocol_tpu.faults.checkpoint import stamp_fence
+
+        stamp_fence(
+            self.journal_root, p.proc_id,
+            topology=self.topology.to_dict(),
+        )
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env.update(self.env_extra)
@@ -253,34 +275,84 @@ class ProcessFleet:
             ):
                 self.topology = self.topology.without(address)
 
+    def adopt_topology(self, topology: FleetTopology) -> bool:
+        """Adopt an externally-built topology — GENERATION-MONOTONIC:
+        a candidate no newer than the current one is refused (False).
+        The discovery tier serves whatever this manager holds, so this
+        guard is what makes a stale map racing a detector ejection
+        lose fleet-wide, not just per-client."""
+        with self._lock:
+            if topology.generation <= self.topology.generation:
+                return False
+            self.topology = topology
+            return True
+
+    def _driver_takedown(self, p: ManagedProc) -> None:
+        """A DRIVER-owned death begins: claim the process (alive flip
+        under the lock — :meth:`_eject` checks the same flag under the
+        same lock, so the detector can never race a scripted kill into
+        a false ejection) and remove it from an armed detector BEFORE
+        the signal lands — the unresponsive window of a deliberate
+        kill/drain must not read as a failure, and a drain's final
+        flushes must never be fence-refused by a racing ejection."""
+        with self._lock:
+            p.alive = False
+        if self.detector is not None:
+            self.detector.remove(p.proc_id)
+
     def kill(self, index: int) -> ManagedProc:
         """SIGKILL — the crash drill. Call :meth:`handoff_dead` next to
         re-route the orphaned journals; until then failed-over deltas
         ride the client's bounded handoff-wait rung."""
         p = self.procs[index]
+        self._driver_takedown(p)
         if p.popen is not None:
             p.popen.kill()
             p.popen.wait(timeout=30)
-        p.alive = False
         self.drop_endpoint(p.address)
         return p
 
     def drain(self, index: int, timeout_s: float = 60.0) -> ManagedProc:
         """SIGTERM — graceful drain (flush journals, exit 0)."""
         p = self.procs[index]
+        self._driver_takedown(p)
         if p.popen is not None:
             p.popen.terminate()
             p.popen.wait(timeout=timeout_s)
-        p.alive = False
         self.drop_endpoint(p.address)
         return p
 
+    def pause(self, index: int) -> ManagedProc:
+        """SIGSTOP — the zombie drill's gray failure: every thread in
+        the target freezes mid-instruction (locks held, deltas parked),
+        the TCP sockets stay open, and nothing exits. The detector must
+        classify this DEAD and eject; :meth:`resume` later releases the
+        zombie, whose fence is by then superseded."""
+        p = self.procs[index]
+        if p.popen is not None:
+            p.popen.send_signal(signal.SIGSTOP)
+        return p
+
+    def resume(self, index: int) -> ManagedProc:
+        """SIGCONT — release a paused process. An ejected zombie that
+        resumes finds its journal-namespace fence superseded: parked
+        deltas are answered ``moved:``, flushes refuse, no tick it acks
+        can double-apply."""
+        p = self.procs[index]
+        if p.popen is not None:
+            p.popen.send_signal(signal.SIGCONT)
+        return p
+
     def handoff_dead(self, index: int) -> list:
-        """Re-route a dead process's orphaned journals along the
-        CURRENT ring (call after :meth:`kill`/:meth:`drain`). Atomic
+        """Re-route a dead (or ejected-while-paused) process's orphaned
+        journals along the CURRENT ring (call after :meth:`kill`/
+        :meth:`drain`; :meth:`_eject` calls it autonomously). Atomic
         renames: each journal lands in exactly one survivor's
         namespace, chosen by the same hash walk the clients fail over
-        by."""
+        by. The source namespace's fence is superseded FIRST (stamped
+        with the post-ejection ring), so even a source that was merely
+        WEDGED — not dead — can never flush or ack again; torn journals
+        are skipped with a counted warning (``last_handoff_stats``)."""
         from protocol_tpu.faults.checkpoint import handoff_orphans
 
         p = self.procs[index]
@@ -290,10 +362,161 @@ class ProcessFleet:
                 f"{p.proc_id} — it would flush right back"
             )
         topo = self.topology
-        return handoff_orphans(
+        stats: dict = {}
+        moved = handoff_orphans(
             self.journal_root, p.proc_id,
             lambda sid: topo.procs[topo.endpoint_for(sid)],
+            topology=topo.to_dict(),
+            stats=stats,
         )
+        self.last_handoff_stats = stats
+        return moved
+
+    # ---------------- autonomous failure detection ----------------
+
+    def start_detector(
+        self,
+        period_s: float = 0.25,
+        probe_timeout_s: float = 1.0,
+        config=None,
+    ) -> None:
+        """Arm the heartbeat failure detector: a daemon thread samples
+        every live process's Health RPC each ``period_s``, feeds the
+        deterministic :class:`~protocol_tpu.dfleet.detector.
+        FailureDetector` (which owns no clock — this thread is the
+        clock), and on DEAD runs the full autonomous ejection:
+        :meth:`_eject` → topology generation bump (discovery serves the
+        new ring), fence supersession, journal re-route. Driver-killed
+        processes (``alive=False``) are REMOVED from the detector, so a
+        scripted kill never counts as a detector ejection (the
+        false-positive ledger stays honest)."""
+        from protocol_tpu.dfleet.detector import FailureDetector
+        from protocol_tpu.services.scheduler_grpc import (
+            SchedulerBackendClient,
+        )
+
+        if self._detector_thread is not None:
+            return
+        self.detector = FailureDetector(
+            [p.proc_id for p in self.procs if p.alive], config=config
+        )
+        stop = threading.Event()
+        self._detector_stop = stop
+
+        def _monitor():
+            clients: dict = {}
+            try:
+                while not stop.is_set():
+                    for p in list(self.procs):
+                        if stop.is_set():
+                            break
+                        if not p.alive:
+                            # driver-owned deaths were already
+                            # detector.remove()d in _driver_takedown;
+                            # a DETECTOR-ejected proc keeps its DEAD
+                            # record (its flaps stay in the totals and
+                            # a resumed zombie's late beats land as
+                            # zombie_beats — counted, never believed)
+                            if p.proc_id in self._detector_ejected:
+                                c = clients.get(p.proc_id)
+                                if c is None:
+                                    c = SchedulerBackendClient(
+                                        p.address
+                                    )
+                                    clients[p.proc_id] = c
+                                try:
+                                    c.health(timeout=probe_timeout_s)
+                                    self.detector.heartbeat(
+                                        p.proc_id, time.perf_counter()
+                                    )
+                                except Exception:
+                                    pass
+                                continue
+                            self.detector.remove(p.proc_id)
+                            stale = clients.pop(p.proc_id, None)
+                            if stale is not None:
+                                try:
+                                    stale.close()
+                                except Exception:
+                                    pass
+                            continue
+                        c = clients.get(p.proc_id)
+                        if c is None:
+                            c = SchedulerBackendClient(p.address)
+                            clients[p.proc_id] = c
+                        try:
+                            c.health(timeout=probe_timeout_s)
+                            self.detector.heartbeat(
+                                p.proc_id, time.perf_counter()
+                            )
+                        except Exception:
+                            self.detector.probe_failed(
+                                p.proc_id, time.perf_counter()
+                            )
+                            # fresh channel next round: a wedged HTTP/2
+                            # connection must not mask a recovered proc
+                            clients.pop(p.proc_id, None)
+                            try:
+                                c.close()
+                            except Exception:
+                                pass
+                    for dead_pid in self.detector.evaluate(
+                        time.perf_counter()
+                    ):
+                        self._eject(dead_pid)
+                    stop.wait(period_s)
+            finally:
+                for c in clients.values():
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
+
+        self._detector_thread = threading.Thread(
+            target=_monitor, name="dfleet-detector", daemon=True
+        )
+        self._detector_thread.start()
+
+    def stop_detector(self) -> None:
+        if self._detector_stop is not None:
+            self._detector_stop.set()
+        if self._detector_thread is not None:
+            self._detector_thread.join(timeout=10)
+            self._detector_thread = None
+            self._detector_stop = None
+
+    def _eject(self, proc_id: str) -> Optional[dict]:
+        """The autonomous ejection path (detector-owned; a scripted
+        kill/drain never lands here): mark the process dead to the
+        fleet, bump the topology generation (the discovery tier serves
+        the new ring on its next poll), supersede its journal fence,
+        and re-route its journals along the surviving ring — the exact
+        machinery the driver used to invoke by hand, now invoked by
+        evidence."""
+        p = next(
+            (q for q in self.procs if q.proc_id == str(proc_id)), None
+        )
+        if p is None:
+            return None
+        with self._lock:
+            if not p.alive:
+                return None  # driver got there first (kill/drain race)
+            p.alive = False
+        self.drop_endpoint(p.address)
+        moved = self.handoff_dead(p.index)
+        event = {
+            "proc": p.proc_id,
+            "at": time.perf_counter(),
+            "journals_rerouted": len(moved),
+            "journals_skipped": self.last_handoff_stats.get(
+                "journals_skipped", 0
+            ),
+            "generation": self.topology.generation,
+        }
+        with self._lock:
+            self.ejections.append(event)
+            self._detector_ejected.add(p.proc_id)
+        return event
 
     def migrate_all(
         self, src_index: int, dst_index: Optional[int] = None
@@ -369,14 +592,19 @@ class ProcessFleet:
         return out
 
     def stop(self) -> None:
+        self.stop_detector()
         for p in self.procs:
-            if p.popen is not None and p.alive:
+            # kill by PROCESS liveness, not the alive flag: an ejected
+            # zombie (alive=False, still running — possibly still
+            # SIGSTOPped) must not outlive the fleet. SIGKILL
+            # terminates stopped processes too.
+            if p.popen is not None and p.popen.poll() is None:
                 p.popen.kill()
                 try:
                     p.popen.wait(timeout=30)
                 except Exception:
                     pass
-                p.alive = False
+            p.alive = False
         if self.discovery is not None:
             self.discovery.stop()
         if self._tmp is not None:

@@ -51,6 +51,20 @@ the target rehydrates it warm on its next delta miss
 (:meth:`load_one`). The post-load ownership re-check closes the
 POSIX-fd window where a reader that opened the file just before the
 rename could otherwise rehydrate a journal it no longer owns.
+
+Fencing (ISSUE 14): each namespace carries a monotonic **fencing
+epoch** (``FENCE.json``, stamped by the fleet manager at spawn and
+SUPERSEDED at ejection/orphan-handoff). The checkpointer adopts the
+stamp it finds at boot and re-reads the file (stat-cached — one
+``os.stat`` per check) on every flush: a higher epoch on disk means
+this process was EJECTED while it wasn't looking (SIGSTOP zombie,
+partitioned node) and its journals re-routed — the flush REFUSES
+(counted), and the servicer answers ``moved:`` instead of acking, so a
+resumed zombie can never double-apply a tick or resurrect a journal it
+no longer owns. Split-brain is impossible by construction: the PR 12
+rule "the journal's location is the authority" becomes "…at the
+highest fence". The stamp carries the post-ejection topology so the
+zombie's redirects point at each session's REAL new home.
 """
 
 from __future__ import annotations
@@ -69,6 +83,47 @@ log = logging.getLogger(__name__)
 
 _META_KIND = "session-checkpoint"
 _SUFFIX = ".ckpt"
+FENCE_NAME = "FENCE.json"
+
+
+def fence_path(root: str, proc_id: str) -> str:
+    return os.path.join(root, str(proc_id), FENCE_NAME)
+
+
+def read_fence(root: str, proc_id: str) -> dict:
+    """The namespace's current fence stamp: ``{"epoch": int,
+    "topology": dict | None}``. Epoch 0 when no stamp exists (the
+    pre-dfleet single-process layout) — fencing is inert there."""
+    try:
+        with open(fence_path(root, proc_id)) as fh:
+            d = json.load(fh)
+        return {
+            "epoch": int(d.get("epoch", 0)),
+            "topology": d.get("topology"),
+        }
+    except (OSError, ValueError):
+        return {"epoch": 0, "topology": None}
+
+
+def stamp_fence(
+    root: str,
+    proc_id: str,
+    epoch: Optional[int] = None,
+    topology: Optional[dict] = None,
+) -> int:
+    """Write the namespace's fence stamp (crash-atomic: temp +
+    ``os.replace``). ``epoch=None`` bumps monotonically from whatever
+    is on disk — the spawn/ejection callers never need to coordinate a
+    counter, the file IS the counter. Returns the stamped epoch."""
+    if epoch is None:
+        epoch = read_fence(root, proc_id)["epoch"] + 1
+    path = fence_path(root, proc_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump({"epoch": int(epoch), "topology": topology}, fh)
+    os.replace(tmp, path)
+    return int(epoch)
 
 
 def _fname(session_id: str) -> str:
@@ -105,10 +160,20 @@ class SessionCheckpointer:
         self.directory = os.path.join(directory, self.proc_id)
         self.every = max(1, int(every))
         os.makedirs(self.directory, exist_ok=True)
+        # fence adoption: cache the epoch the manager stamped before
+        # spawning us (0 when unstamped — standalone layouts are inert).
+        # A HIGHER epoch appearing on disk later means we were ejected.
+        self.fence_epoch = read_fence(self.root, self.proc_id)["epoch"]
+        self._fence_file = fence_path(self.root, self.proc_id)
+        self._fence_cache: tuple = (None, {
+            "epoch": self.fence_epoch, "topology": None,
+        })
         # obs counters (scraped via the servicer's seam metrics)
         self.flushes = 0
         self.flush_failures = 0
         self.handoffs = 0
+        self.fence_refusals = 0
+        self.journals_skipped = 0
 
     def path_for(self, session_id: str) -> str:
         return os.path.join(self.directory, _fname(session_id))
@@ -117,6 +182,31 @@ class SessionCheckpointer:
         """Where ``session_id``'s journal lives in ANOTHER process's
         namespace under the same root (the handoff target)."""
         return os.path.join(self.root, str(proc_id), _fname(session_id))
+
+    # ---------------- fencing ----------------
+
+    def fence_state(self) -> dict:
+        """The namespace's CURRENT on-disk fence stamp, stat-cached (a
+        check costs one ``os.stat`` unless the file changed). Benign
+        under concurrency: the cache tuple swaps atomically and the
+        worst case is one redundant re-read."""
+        try:
+            st = os.stat(self._fence_file)
+            sig: Optional[tuple] = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            sig = None
+        cached_sig, cached = self._fence_cache
+        if sig != cached_sig:
+            cached = read_fence(self.root, self.proc_id)
+            self._fence_cache = (sig, cached)
+        return cached
+
+    def fence_superseded(self) -> bool:
+        """True when a HIGHER fence epoch was stamped into this
+        namespace than the one this process adopted at boot: we were
+        ejected (detector, orphan handoff) and must neither flush nor
+        ack — the journals belong to the ring's survivors now."""
+        return self.fence_state()["epoch"] > self.fence_epoch
 
     def due(self, tick: int) -> bool:
         """Is ``tick`` on the flush cadence? Tick 0 (the snapshot
@@ -130,7 +220,15 @@ class SessionCheckpointer:
         """Write the session's checkpoint (caller holds
         ``session.lock`` — the state must be a consistent tick). Best
         effort: a failed flush warns and counts, never fails the RPC;
-        the cost is one potential reopen after a crash."""
+        the cost is one potential reopen after a crash.
+
+        A SUPERSEDED FENCE refuses outright (counted separately): an
+        ejected process writing into a namespace whose journals were
+        re-routed would resurrect state a survivor already owns — the
+        exact split-brain the fence exists to make impossible."""
+        if self.fence_superseded():
+            self.fence_refusals += 1
+            return False
         try:
             self._write_locked(session)
             self.flushes += 1
@@ -261,6 +359,10 @@ class SessionCheckpointer:
             try:
                 loaded.append(self._load(path, budget))
             except Exception:
+                # torn META/frames (killed mid-flush), version drift,
+                # decode error: COUNTED skip, never a failed restore —
+                # the affected client re-opens down the ladder
+                self.journals_skipped += 1
                 log.warning(
                     "skipping unloadable session checkpoint %s", path,
                     exc_info=True,
@@ -359,6 +461,7 @@ class SessionCheckpointer:
         try:
             session = self._load(path, budget)
         except Exception:
+            self.journals_skipped += 1
             log.warning(
                 "skipping unloadable session checkpoint %s", path,
                 exc_info=True,
@@ -384,18 +487,41 @@ class SessionCheckpointer:
             pass
 
 
-def handoff_orphans(root: str, src_proc_id: str, route) -> list:
-    """Re-route a DEAD process's journal namespace: every loadable
-    journal under ``<root>/<src_proc_id>/`` is renamed into the
-    namespace ``route(session_id)`` picks (None = leave in place).
+def handoff_orphans(
+    root: str,
+    src_proc_id: str,
+    route,
+    topology: Optional[dict] = None,
+    stats: Optional[dict] = None,
+) -> list:
+    """Re-route a DEAD (or ejected) process's journal namespace: every
+    loadable journal under ``<root>/<src_proc_id>/`` is renamed into
+    the namespace ``route(session_id)`` picks (None = leave in place).
     Returns ``[(session_id, dst_proc_id), ...]`` for the journals
-    moved. Only safe once the source process is actually gone (kill -9
-    / confirmed exit) — a live source would flush right back into its
-    namespace. Unreadable journals are skipped with a warning: the
-    affected client re-opens down the ladder, same contract as a torn
-    restart."""
+    moved. The source namespace's FENCE is superseded FIRST (stamped
+    with ``topology``, the post-ejection ring): a paused-not-dead
+    source that resumes mid- or post-handoff finds its fence
+    superseded and refuses to flush or ack — re-routing is safe even
+    when "dead" was really "wedged". A journal whose META frame is
+    torn (process killed mid-flush) is SKIPPED with a counted
+    ``journals_skipped`` warning instead of raising out of the
+    re-route loop — the affected client re-opens down the ladder, the
+    remaining journals still move. ``stats`` (optional dict) receives
+    ``journals_moved`` / ``journals_skipped`` / ``fence_epoch``."""
     src_dir = os.path.join(root, str(src_proc_id))
     moved = []
+    if stats is None:
+        stats = {}
+    stats.setdefault("journals_moved", 0)
+    stats.setdefault("journals_skipped", 0)
+    # fence FIRST, then enumerate: a wedged-but-running source that
+    # flushes between the listing and the stamp would land a journal
+    # that is neither moved nor fence-refused — stamping before the
+    # listdir means any flush that beats the stamp is IN the listing,
+    # and any flush after it is refused by the fence
+    stats["fence_epoch"] = stamp_fence(
+        root, src_proc_id, topology=topology
+    )
     try:
         names = sorted(
             n for n in os.listdir(src_dir) if n.endswith(_SUFFIX)
@@ -406,7 +532,11 @@ def handoff_orphans(root: str, src_proc_id: str, route) -> list:
         path = os.path.join(src_dir, name)
         sid = journal_session_id(path)
         if sid is None:
-            log.warning("orphan journal %s has no readable META", path)
+            stats["journals_skipped"] += 1
+            log.warning(
+                "orphan journal %s has no readable META "
+                "(torn mid-flush?) — skipped, not fatal", path,
+            )
             continue
         dst_proc = route(sid)
         if dst_proc is None or str(dst_proc) == str(src_proc_id):
@@ -416,8 +546,10 @@ def handoff_orphans(root: str, src_proc_id: str, route) -> list:
         try:
             os.replace(path, os.path.join(dst_dir, name))
         except OSError:
+            stats["journals_skipped"] += 1
             log.warning("orphan handoff failed for %s", path,
                         exc_info=True)
             continue
         moved.append((sid, str(dst_proc)))
+    stats["journals_moved"] = len(moved)
     return moved

@@ -169,6 +169,19 @@ class ChaosClient:
             else:
                 self._count("drop_request")
             raise FaultInjectedError()
+        # directional partition faults: unlike the symmetric drop's
+        # coin flip, these sever exactly ONE direction — drop_request
+        # loses the call before the server (A→B cut), drop_response
+        # lets the server PROCESS it and kills only the answer (B→A
+        # cut: the retry must be served the replayed twin, never
+        # re-applied)
+        if act.drop_request:
+            self._count("drop_request")
+            raise FaultInjectedError()
+        if act.drop_response:
+            send(request)
+            self._count("drop_response")
+            raise FaultInjectedError()
         if act.corrupt:
             mutated = corrupt_request(
                 request, self._schedule, self._site, method, i
@@ -223,11 +236,17 @@ class ChaosClient:
         if act.delay_ms:
             self._count("delay")
             time.sleep(act.delay_ms / 1e3)
-        if act.drop:
+        if act.drop or act.drop_request:
             # a streamed call's drop is always request-side: losing the
             # response of a half-open stream presents as UNAVAILABLE
             # either way
             self._count("drop_request")
+            raise FaultInjectedError()
+        if act.drop_response:
+            self._client.open_session(
+                iter(list(chunks)), timeout=timeout, metadata=metadata
+            )
+            self._count("drop_response")
             raise FaultInjectedError()
         chunk_list = list(chunks)
         if act.truncate and len(chunk_list) > 0:
@@ -269,11 +288,21 @@ class ChaosClient:
 class ChaosServerInterceptor(grpc.ServerInterceptor):
     """Server-side drop/delay by method, one decision per RPC. Wraps
     whichever handler shape the method uses (unary-unary or
-    stream-unary — the seam's two shapes); other shapes pass through."""
+    stream-unary — the seam's two shapes); other shapes pass through.
 
-    def __init__(self, schedule: FaultSchedule, site: str = "server"):
+    ``proc_id`` arms the SLOW-NODE gray failure: when this process is
+    the config's ``slow_proc`` target (proc id ``p<K>``), every RPC's
+    response is inflated by ``slow_ms`` at ``slow_rate`` — the node
+    stays alive and correct, just too slow. The failure detector must
+    classify it SUSPECT (its sessions degrade under the
+    bounded-staleness watchdog), never DEAD — flap suppression is what
+    keeps a merely-slow node in the fleet."""
+
+    def __init__(self, schedule: FaultSchedule, site: str = "server",
+                 proc_id: str = "p0"):
         self._schedule = schedule
         self._site = site
+        self._proc_id = str(proc_id)
         self._lock = make_lock("chaos")
         self._index: dict[str, int] = {}
         self.counters: dict[str, int] = {}
@@ -293,10 +322,19 @@ class ChaosServerInterceptor(grpc.ServerInterceptor):
         if handler is None:
             return None
         method = handler_call_details.method.rsplit("/", 1)[-1]
-        act = self._schedule.decide(
-            self._site, method, self._next(method)
-        )
-        if not (act.drop or act.delay_ms):
+        i = self._next(method)
+        act = self._schedule.decide(self._site, method, i)
+        cfg = self._schedule.config
+        slow_ms = 0.0
+        if (
+            cfg.slow_proc is not None
+            and self._proc_id == f"p{int(cfg.slow_proc)}"
+            and FaultSchedule._frac(
+                cfg.seed, "slow", self._site, method, i
+            ) < cfg.slow_rate
+        ):
+            slow_ms = cfg.slow_ms
+        if not (act.drop or act.delay_ms or slow_ms):
             return handler
 
         def wrap(inner):
@@ -304,6 +342,9 @@ class ChaosServerInterceptor(grpc.ServerInterceptor):
                 if act.delay_ms:
                     self._count("delay")
                     time.sleep(act.delay_ms / 1e3)
+                if slow_ms:
+                    self._count("slow")
+                    time.sleep(slow_ms / 1e3)
                 if act.drop:
                     self._count("drop")
                     context.abort(

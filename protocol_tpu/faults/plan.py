@@ -28,19 +28,30 @@ ENV_VAR = "PROTOCOL_TPU_CHAOS"
 
 
 class FaultAction(NamedTuple):
-    """What one call suffers. ``delay_ms == 0`` means no delay."""
+    """What one call suffers. ``delay_ms == 0`` means no delay.
+
+    ``drop`` is the symmetric transport loss (an extra schedule bit
+    splits it request/response-side at the injector). ``drop_request``
+    and ``drop_response`` are the DIRECTIONAL knobs behind the
+    asymmetric-partition site: with only ``drop_response_rate`` set,
+    requests flow and answers die (A→B flows while B→A drops) — the
+    half-open failure that drills the idempotent-retransmit dedup,
+    never the reopen rung."""
 
     drop: bool
     delay_ms: float
     corrupt: bool
     truncate: bool
     duplicate: bool
+    drop_request: bool = False
+    drop_response: bool = False
 
     @property
     def clean(self) -> bool:
         return not (
             self.drop or self.delay_ms or self.corrupt
             or self.truncate or self.duplicate
+            or self.drop_request or self.drop_response
         )
 
 
@@ -65,6 +76,20 @@ class ChaosConfig:
     corrupt_rate: float = 0.0
     truncate_rate: float = 0.0
     duplicate_rate: float = 0.0
+    # directional (gray) partition faults: request-side loss severs
+    # A→B while answers still flow; response-side loss is the
+    # asymmetric partition the retransmit-dedup ladder exists for —
+    # the server APPLIES the tick, the answer dies, and the resend
+    # must be served the replayed twin, never re-applied
+    drop_request_rate: float = 0.0
+    drop_response_rate: float = 0.0
+    # slow-node gray failure: ONE fleet process (``slow_proc``, by
+    # index — proc id "p<K>") inflates every response by ``slow_ms``
+    # at ``slow_rate`` — alive, answering, and too slow, the failure
+    # mode the detector must classify SUSPECT (degrade, don't eject)
+    slow_proc: Optional[int] = None
+    slow_rate: float = 1.0
+    slow_ms: float = 25.0
     # scripted one-shot events (driver-owned; see module docstring)
     kill_at_tick: Optional[int] = None
     blackout_shard: Optional[int] = None
@@ -81,16 +106,27 @@ class ChaosConfig:
     kill_proc: int = 1
     migrate_at_tick: Optional[int] = None
     migrate_proc: int = 1
+    # SIGSTOP/SIGCONT pause (the zombie-resume drill): the target
+    # process is frozen — not dead — once every session passed the
+    # tick; the detector must eject it autonomously, and the resumed
+    # zombie must find its journal fence superseded. Driver-owned like
+    # every process-level event (a process cannot pause itself and
+    # still be the thing under test).
+    pause_proc_at_tick: Optional[int] = None
+    pause_proc: int = 1
 
     _FLOATS = (
         "drop_rate", "delay_rate", "delay_ms", "corrupt_rate",
         "truncate_rate", "duplicate_rate",
+        "drop_request_rate", "drop_response_rate",
+        "slow_rate", "slow_ms",
     )
     _INTS = (
         "seed", "kill_at_tick", "blackout_shard", "blackout_refusals",
         "evict_at_tick", "starve_budget_ticks",
         "kill_proc_at_tick", "kill_proc",
         "migrate_at_tick", "migrate_proc",
+        "slow_proc", "pause_proc_at_tick", "pause_proc",
     )
     # spec aliases: the short names the env/CLI spec uses
     _ALIASES = {
@@ -99,18 +135,23 @@ class ChaosConfig:
         "corrupt": "corrupt_rate",
         "truncate": "truncate_rate",
         "dup": "duplicate_rate",
+        "dropreq": "drop_request_rate",
+        "dropresp": "drop_response_rate",
     }
 
     def active(self) -> bool:
         return bool(
             self.drop_rate or self.delay_rate or self.corrupt_rate
             or self.truncate_rate or self.duplicate_rate
+            or self.drop_request_rate or self.drop_response_rate
+            or self.slow_proc is not None
             or self.kill_at_tick is not None
             or self.blackout_shard is not None
             or self.evict_at_tick is not None
             or self.starve_budget_ticks
             or self.kill_proc_at_tick is not None
             or self.migrate_at_tick is not None
+            or self.pause_proc_at_tick is not None
         )
 
     @classmethod
@@ -191,7 +232,16 @@ class FaultSchedule:
         duplicate = c.duplicate_rate > 0 and f(
             c.seed, "dup", site, method, index
         ) < c.duplicate_rate
-        return FaultAction(drop, delay, corrupt, truncate, duplicate)
+        drop_request = c.drop_request_rate > 0 and f(
+            c.seed, "dropreq", site, method, index
+        ) < c.drop_request_rate
+        drop_response = c.drop_response_rate > 0 and f(
+            c.seed, "dropresp", site, method, index
+        ) < c.drop_response_rate
+        return FaultAction(
+            drop, delay, corrupt, truncate, duplicate,
+            drop_request, drop_response,
+        )
 
     def corrupt_byte(self, site: str, method: str, index: int,
                      n_bytes: int) -> tuple[int, int]:

@@ -60,6 +60,7 @@ class _SessionStats:
         "ticks_done", "refused", "reopens", "wall_s", "error",
         "transport_retries", "stale", "replayed",
         "moved_redirects", "failovers", "handoff_waits",
+        "plan_mismatches", "verify_stopped",
     )
 
     def __init__(self, sid: str):
@@ -81,6 +82,11 @@ class _SessionStats:
         self.moved_redirects = 0
         self.failovers = 0
         self.handoff_waits = 0
+        # plan verification against the fault-free in-process replay
+        # (the zombie-resume gate's "zero double-applied ticks" proof:
+        # a double-apply diverges the plan stream)
+        self.plan_mismatches = 0
+        self.verify_stopped = False
 
 
 def _request_v2(snap, p_cols, r_cols, kernel: str):
@@ -129,6 +135,8 @@ def _drive_session(
     kernel: str,
     stats: _SessionStats,
     max_retries: int = 20,
+    rpc_timeout_s: float = 600.0,
+    baseline=None,
 ) -> None:
     """One session's whole life against the servicer: snapshot open,
     then every recorded delta as a lockstep tick. Refusals follow the
@@ -144,7 +152,15 @@ def _drive_session(
     next endpoint, a ``moved:<endpoint>`` refusal rebinds straight to
     the session's new home, and an "unknown session" right after a
     failover rides a bounded handoff-wait (the journal rename may still
-    be in flight) before conceding to a reopen."""
+    be in flight) before conceding to a reopen.
+
+    ``rpc_timeout_s`` sizes the per-delta deadline: the pause (zombie)
+    drill needs a SHORT one so a delta parked inside a SIGSTOPped
+    process trips the transport ladder instead of hanging the session
+    on a frozen socket. ``baseline`` (the fault-free replay's per-tick
+    plans) arms bit-identity verification: every fresh warm tick's
+    plan is compared; verification stops at the first reopen (a cold
+    re-ground legitimately re-derives duals)."""
     import grpc
 
     from protocol_tpu.proto import scheduler_pb2 as pb
@@ -231,14 +247,18 @@ def _drive_session(
                 p4t = None
                 reopened = False
                 evict_retried = False
+                served_stale = False
                 for retry in range(max_retries):
                     resp = send(
-                        lambda c: c.assign_delta(req, timeout=600)
+                        lambda c: c.assign_delta(
+                            req, timeout=rpc_timeout_s
+                        )
                     )
                     if resp.session_ok:
                         server_tick += 1
                         if resp.stale:
                             stats.stale += 1
+                            served_stale = True
                         if resp.replayed:
                             stats.replayed += 1
                         p4t = wire.unblob(
@@ -323,6 +343,16 @@ def _drive_session(
                 (stats.cold_ms if reopened else stats.warm).append(
                     (time.perf_counter() - t0) * 1e3
                 )
+                if reopened:
+                    stats.verify_stopped = True
+                if (
+                    baseline is not None
+                    and not stats.verify_stopped
+                    and not served_stale
+                    and tick < len(baseline)
+                    and not np.array_equal(p4t, baseline[tick])
+                ):
+                    stats.plan_mismatches += 1
             stats.ticks_done += 1
             n_live = int(np.asarray(r_cols["valid"], bool).sum())
             if n_live > 0:
@@ -361,6 +391,11 @@ def run_load(
     ckpt_every: int = 1,
     processes: int = 1,
     chaos: Optional[str] = None,
+    detect: bool = False,
+    detector_period_s: float = 0.25,
+    rpc_timeout_s: float = 600.0,
+    max_retries: int = 20,
+    verify_plans: bool = False,
 ) -> dict:
     """Run the harness; returns the report dict (see module docstring).
 
@@ -389,7 +424,20 @@ def run_load(
     ``chaos`` spec; default process 1) and re-routes its orphaned
     journals along the ring; ``drain`` live-migrates its sessions off
     first (Migrate RPC + "moved:" redirects), then SIGTERMs it. The
-    report adds per-process scrape summaries and migration counters."""
+    report adds per-process scrape summaries and migration counters.
+
+    ``chaos`` with ``pause_proc_at_tick`` arms the ZOMBIE drill
+    (processes > 1 only): the target is SIGSTOPped — frozen, not dead
+    — and recovery is AUTONOMOUS: the armed failure detector
+    (``detect=True``, forced on for this drill) must promote it
+    suspect→dead, re-route its journals, and bump the ring with ZERO
+    driver-owned kill events; the zombie is then resumed and must be
+    fence-refused. ``verify_plans`` compares every fresh warm tick's
+    plan against the fault-free in-process replay (the zero-double-
+    applied-ticks proof); ``rpc_timeout_s``/``max_retries`` size the
+    client ladder for the freeze window. The report grows a
+    ``detector`` section: time-to-detect, suspect flaps, fence
+    refusals, false-positive ejections."""
     from protocol_tpu.fleet.fabric import FleetConfig
     from protocol_tpu.services.scheduler_grpc import serve
     from protocol_tpu.trace import format as tfmt
@@ -398,6 +446,14 @@ def run_load(
     if restart_mode not in ("crash", "drain"):
         raise ValueError(
             f"restart_mode must be crash|drain, got {restart_mode!r}"
+        )
+    if int(processes) <= 1 and (detect or verify_plans):
+        # refusing beats a vacuous pass: the single-process path arms
+        # no detector and builds no baseline, so accepting these flags
+        # would report "verified" work that never ran
+        raise ValueError(
+            "detect/verify_plans require the distributed fleet "
+            "(processes > 1)"
         )
     if int(processes) > 1:
         return _run_load_processes(
@@ -409,7 +465,10 @@ def run_load(
             restart_mode=restart_mode, ckpt_dir=ckpt_dir,
             ckpt_every=ckpt_every, processes=int(processes),
             chaos=chaos, admit_rate=admit_rate, max_bytes=max_bytes,
-            queue_depth=queue_depth,
+            queue_depth=queue_depth, detect=detect,
+            detector_period_s=detector_period_s,
+            rpc_timeout_s=rpc_timeout_s, max_retries=max_retries,
+            verify_plans=verify_plans,
         )
     sessions = int(sessions)
     tenants = max(1, min(int(tenants), sessions))
@@ -510,6 +569,10 @@ def run_load(
             threading.Thread(
                 target=_drive_session,
                 args=(address, trace, st.sid, kernel, st),
+                kwargs=dict(
+                    max_retries=max_retries,
+                    rpc_timeout_s=rpc_timeout_s,
+                ),
                 name=f"loadgen-{st.sid}",
             )
             for (_, trace), st in zip(sids, all_stats)
@@ -711,6 +774,60 @@ def run_load(
     return report
 
 
+def _probe_zombie(proc, sid: str) -> dict:
+    """Deterministic fence proof against a RESUMED zombie: any delta it
+    answers must be a ``moved:`` redirect (the fence check precedes the
+    session lookup), and its seam must count the refusal. Returns the
+    drill-report fragment; a zombie that cannot be reached within the
+    budget reports ``zombie_fence_refused=False`` and the gate fails —
+    an unreachable zombie proves nothing."""
+    import grpc
+
+    from protocol_tpu.proto import scheduler_pb2 as pb
+    from protocol_tpu.services.scheduler_grpc import (
+        SchedulerBackendClient,
+    )
+
+    out = {"zombie_fence_refused": False}
+    client = SchedulerBackendClient(proc.address)
+    try:
+        for attempt in range(40):
+            try:
+                resp = client.assign_delta(
+                    pb.AssignDeltaRequest(
+                        session_id=sid, epoch_fingerprint="probe",
+                        tick=1,
+                    ),
+                    timeout=5.0,
+                )
+            except grpc.RpcError:
+                time.sleep(0.25)
+                continue
+            out["zombie_fence_refused"] = (
+                not resp.session_ok
+                and (
+                    resp.error.startswith("moved:")
+                    or "fence superseded" in resp.error
+                )
+            )
+            out["zombie_answer"] = resp.error
+            break
+        try:
+            health = client.health(timeout=5.0)
+            seam = {m.name: m.value for m in health.seam_metrics}
+            out["zombie_fence_refusals"] = int(
+                seam.get("session_fence_refused", 0)
+            )
+            out["zombie_fence_epoch"] = int(
+                seam.get("ckpt_fence_epoch", 0)
+            )
+        except Exception:
+            pass
+    finally:
+        client.close()
+    return out
+
+
 def _run_load_processes(
     sessions: int,
     tenants: int,
@@ -734,6 +851,11 @@ def _run_load_processes(
     admit_rate=None,
     max_bytes=None,
     queue_depth: int = 8,
+    detect: bool = False,
+    detector_period_s: float = 0.25,
+    rpc_timeout_s: float = 600.0,
+    max_retries: int = 20,
+    verify_plans: bool = False,
 ) -> dict:
     """The distributed-fleet harness behind ``run_load(processes=N)``:
     real subprocesses, ring routing, the process-level kill/migrate
@@ -770,10 +892,17 @@ def _run_load_processes(
         drill_tick = chaos_cfg.migrate_at_tick
         drill_mode = "drain"
         drill_proc = chaos_cfg.migrate_proc
+    elif chaos_cfg.pause_proc_at_tick is not None:
+        # the zombie drill: SIGSTOP the target and let the DETECTOR do
+        # the rest (zero driver-owned kill events is part of the bar)
+        drill_tick = chaos_cfg.pause_proc_at_tick
+        drill_mode = "pause"
+        drill_proc = chaos_cfg.pause_proc
     else:
         drill_tick = None
         drill_mode = restart_mode
         drill_proc = chaos_cfg.kill_proc
+    detect = detect or drill_mode == "pause"
     sessions = int(sessions)
     tenants = max(1, min(int(tenants), sessions))
     tmpdir = None
@@ -790,12 +919,14 @@ def _run_load_processes(
     parsed = [tfmt.read_trace(p) for p in traces]
 
     sids: list[tuple[str, object]] = []
+    trace_idx: list[int] = []
     for i in range(sessions):
         if skew and tenants > 1:
             t = 0 if i == 0 else 1 + (i - 1) % (tenants - 1)
         else:
             t = i % tenants
         sids.append((f"t{t}@s{i}", parsed[t % len(parsed)]))
+        trace_idx.append(t % len(parsed))
 
     env_extra = {}
     if isinstance(chaos, str) and chaos:
@@ -854,6 +985,44 @@ def _run_load_processes(
                 p.index for p in fleet.procs if p.address == busiest
             )
             drill_report["retargeted"] = True
+        drill_report["proc"] = fleet.proc_at(target).proc_id
+        if drill_mode == "pause":
+            # SIGSTOP, then HANDS OFF: the detector must promote
+            # suspect->dead and run the ejection (topology bump, fence
+            # supersession, journal re-route) with zero driver-owned
+            # kill events — that autonomy is the thing under test
+            pid = fleet.proc_at(target).proc_id
+            t_pause = time.perf_counter()
+            fleet.pause(target)
+            drill_report["paused"] = True
+            eject = None
+            deadline = t_pause + 120.0
+            while time.perf_counter() < deadline:
+                eject = next(
+                    (e for e in list(fleet.ejections)
+                     if e["proc"] == pid), None,
+                )
+                if eject is not None:
+                    break
+                time.sleep(0.02)
+            if eject is not None:
+                drill_report["ejected_by_detector"] = True
+                drill_report["time_to_detect_s"] = round(
+                    eject["at"] - t_pause, 3
+                )
+                drill_report["journals_rerouted"] = eject[
+                    "journals_rerouted"
+                ]
+                drill_report["generation"] = eject["generation"]
+            # resume the zombie AFTER the ejection: its parked deltas
+            # and anything clients still send it must be fence-refused
+            fleet.resume(target)
+            drill_report["resumed"] = True
+            if eject is not None:
+                drill_report.update(_probe_zombie(
+                    fleet.proc_at(target), sids[0][0]
+                ))
+            return
         if drill_mode == "drain":
             # LIVE migration first (the source keeps answering with
             # "moved:" redirects while sessions rehydrate at the
@@ -869,9 +1038,26 @@ def _run_load_processes(
         drill_report["proc"] = fleet.proc_at(target).proc_id
         drill_report["generation"] = fleet.topology.generation
 
+    baselines = None
+    if verify_plans:
+        # fault-free ground truth per trace: the in-process replay's
+        # per-tick plans (bit-identical to the wire path by the
+        # replay-identity gate) — what "zero double-applied ticks"
+        # is asserted against
+        from protocol_tpu.trace.replay import replay
+
+        baselines = [
+            replay(str(p), engine=kernel, verify=False, keep_p4t=True)[
+                "p4ts"
+            ]
+            for p in traces
+        ]
+
     t_wall = time.perf_counter()
     try:
         fleet.start()
+        if detect:
+            fleet.start_detector(period_s=detector_period_s)
         topo = fleet.topology
         threads = [
             threading.Thread(
@@ -880,9 +1066,18 @@ def _run_load_processes(
                     topo.failover_order(st.sid), trace, st.sid, kernel,
                     st,
                 ),
+                kwargs=dict(
+                    max_retries=max_retries,
+                    rpc_timeout_s=rpc_timeout_s,
+                    baseline=(
+                        baselines[trace_idx[i]] if baselines else None
+                    ),
+                ),
                 name=f"dfleet-loadgen-{st.sid}",
             )
-            for (_, trace), st in zip(sids, all_stats)
+            for i, ((_, trace), st) in enumerate(
+                zip(sids, all_stats)
+            )
         ]
         if drill_tick is not None:
             threads.append(threading.Thread(
@@ -894,6 +1089,15 @@ def _run_load_processes(
         for th in threads:
             th.join()
         wall_s = time.perf_counter() - t_wall
+        # stop the detector BEFORE draining survivors: a drain's
+        # SIGTERM window reads exactly like a dying process, and an
+        # ejection fired at a DELIBERATELY drained proc would pollute
+        # the false-positive ledger
+        fleet.stop_detector()
+        detector_snap = (
+            fleet.detector.snapshot() if fleet.detector else None
+        )
+        ejection_events = list(fleet.ejections)
         scrapes = fleet.scrape()
         topology_out = fleet.topology.to_dict()
         # drain (don't kill) the survivors: each dumps its lock-witness
@@ -930,7 +1134,7 @@ def _run_load_processes(
                 "ticks_done": 0, "refused": 0, "reopens": 0,
                 "transport_retries": 0, "stale": 0, "replayed": 0,
                 "moved_redirects": 0, "failovers": 0,
-                "handoff_waits": 0,
+                "handoff_waits": 0, "plan_mismatches": 0,
             },
         )
         agg["sessions"] += 1
@@ -945,7 +1149,7 @@ def _run_load_processes(
         for key in (
             "ticks_done", "refused", "reopens", "transport_retries",
             "stale", "replayed", "moved_redirects", "failovers",
-            "handoff_waits",
+            "handoff_waits", "plan_mismatches",
         ):
             agg[key] += getattr(st, key)
         total_warm_ticks += len(st.warm)
@@ -966,6 +1170,7 @@ def _run_load_processes(
                 m in k for m in (
                     "open", "restored", "rehydrated", "migrated",
                     "moved", "reopen", "hit", "replayed", "stale",
+                    "fence",
                 )
             )
         }
@@ -993,6 +1198,7 @@ def _run_load_processes(
                 "ticks_done", "refused", "reopens",
                 "transport_retries", "stale", "replayed",
                 "moved_redirects", "failovers", "handoff_waits",
+                "plan_mismatches",
             )},
         }
         for t, a in sorted(by_tenant.items())
@@ -1034,8 +1240,44 @@ def _run_load_processes(
             "reopens_total": sum(st.reopens for st in all_stats),
             "replayed_total": sum(st.replayed for st in all_stats),
             "stale_total": sum(st.stale for st in all_stats),
+            "plan_mismatches_total": sum(
+                st.plan_mismatches for st in all_stats
+            ),
         },
     }
+    if verify_plans:
+        report["verify_plans"] = True
+    if detector_snap is not None:
+        # detector observability (ISSUE 14 satellite): time-to-detect
+        # (fault injection -> ejection), suspect flaps, fence refusals
+        # (zombie probe + survivor scrapes), and the false-positive
+        # ledger — an ejection of a process that was never faulted is
+        # a drill failure, not noise
+        expected = (
+            {drill_report.get("proc")} if drill_mode == "pause"
+            else set()
+        )
+        fence_refusals = drill_report.get("zombie_fence_refusals", 0)
+        for snap in scrapes.values():
+            if snap:
+                fence_refusals += int(
+                    (snap.get("seam") or {}).get(
+                        "session_fence_refused", 0
+                    )
+                )
+        report["detector"] = {
+            "snapshot": detector_snap,
+            "ejections": ejection_events,
+            "suspect_flaps": detector_snap["totals"]["flaps"],
+            "suspects_entered": detector_snap["totals"][
+                "suspects_entered"
+            ],
+            "time_to_detect_s": drill_report.get("time_to_detect_s"),
+            "fence_refusals": fence_refusals,
+            "false_positive_ejections": [
+                e for e in ejection_events if e["proc"] not in expected
+            ],
+        }
     if drill_tick is not None:
         report["drill"] = {
             "mode": drill_mode, "at_tick": drill_tick,
@@ -1104,7 +1346,23 @@ def _print_report(rep: dict) -> None:
             f"{mig['handoff_waits']} | replayed {mig['replayed_total']}"
             f" | stale {mig['stale_total']} | reopens "
             f"{mig['reopens_total']}"
+            + (
+                f" | plan mismatches {mig['plan_mismatches_total']}"
+                if rep.get("verify_plans") else ""
+            )
         )
+        det = rep.get("detector")
+        if det:
+            ttd = det.get("time_to_detect_s")
+            print(
+                "  detector: "
+                + (f"time-to-detect {ttd}s | " if ttd is not None
+                   else "")
+                + f"suspects {det['suspects_entered']} | flaps "
+                f"{det['suspect_flaps']} | fence refusals "
+                f"{det['fence_refusals']} | false-positive ejections "
+                f"{len(det['false_positive_ejections'])}"
+            )
         for pid, p in sorted((rep.get("processes") or {}).items()):
             if p is None:
                 print(f"  {pid}: (down)")
@@ -1189,8 +1447,22 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos", default=None,
                     help="seeded chaos spec (faults.plan.ChaosConfig): "
                          "rate faults arm every process's interceptor; "
-                         "kill_proc_at_tick/migrate_at_tick script the "
-                         "driver-owned process drills")
+                         "kill_proc_at_tick/migrate_at_tick/"
+                         "pause_proc_at_tick script the driver-owned "
+                         "process drills (pause = the zombie drill: "
+                         "detector ejection + fence refusal)")
+    ap.add_argument("--detect", action="store_true",
+                    help="arm the autonomous failure detector "
+                         "(forced on by the pause drill)")
+    ap.add_argument("--rpc-timeout", type=float, default=600.0,
+                    help="per-delta RPC deadline seconds (size small "
+                         "for the pause drill so frozen sockets fail "
+                         "over instead of hanging)")
+    ap.add_argument("--max-retries", type=int, default=20)
+    ap.add_argument("--verify-plans", action="store_true",
+                    help="compare every fresh warm tick's plan against "
+                         "the fault-free in-process replay "
+                         "(bit-identity = zero double-applied ticks)")
     ap.add_argument("--out", default=None, help="write the JSON report")
     ap.add_argument("--smoke", action="store_true",
                     help="exit non-zero unless every session completed "
@@ -1211,6 +1483,8 @@ def main(argv=None) -> int:
         restart_mode=args.restart_mode,
         ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
         processes=args.processes, chaos=args.chaos,
+        detect=args.detect, rpc_timeout_s=args.rpc_timeout,
+        max_retries=args.max_retries, verify_plans=args.verify_plans,
     )
     _print_report(rep)
     if args.out:
@@ -1247,10 +1521,35 @@ def main(argv=None) -> int:
                              "reopens after the process drill — "
                              "recovery was not warm",
                 })
-            if not (drill.get("killed") or drill.get("drained")):
+            if not (
+                drill.get("killed") or drill.get("drained")
+                or drill.get("paused")
+            ):
                 bad.append({
                     "drill": drill["mode"],
                     "error": "process drill never fired",
+                })
+            if drill.get("paused") and not drill.get(
+                "ejected_by_detector"
+            ):
+                bad.append({
+                    "drill": drill["mode"],
+                    "error": "paused process was never ejected by the "
+                             "detector",
+                })
+            if mig.get("plan_mismatches_total"):
+                bad.append({
+                    "drill": drill["mode"],
+                    "error": f"{mig['plan_mismatches_total']} plans "
+                             "diverged from the fault-free replay",
+                })
+            det = rep.get("detector") or {}
+            if det.get("false_positive_ejections"):
+                bad.append({
+                    "drill": drill["mode"],
+                    "error": "detector ejected never-faulted "
+                             f"process(es): "
+                             f"{det['false_positive_ejections']}",
                 })
             for pid, viols in (
                 rep.get("witness_violations") or {}

@@ -372,6 +372,33 @@ class SchedulerBackendServicer:
             return None
         return moved
 
+    def _fence_route(self, session_id: str) -> Optional[str]:
+        """None = this process's journal fence is intact (the normal
+        case — one stat call). Otherwise the namespace's fencing epoch
+        was SUPERSEDED while this process wasn't looking (SIGSTOP
+        zombie resuming after a detector ejection, partitioned node):
+        its journals were re-routed along the ring, so it must neither
+        ack nor admit — split-brain is refused by construction. Returns
+        the session's new home endpoint per the fence-stamped topology,
+        or "" when the stamp carries no usable route (the client
+        re-opens down the ladder, counted)."""
+        if self.ckpt is None or not self.ckpt.fence_superseded():
+            return None
+        self.seam.count("fence_refused")
+        topo = self.ckpt.fence_state().get("topology")
+        if topo:
+            try:
+                from protocol_tpu.dfleet.topology import FleetTopology
+
+                ep = FleetTopology.from_dict(topo).endpoint_for(
+                    session_id
+                )
+                if ep and ep != self.endpoint:
+                    return ep
+            except Exception:  # torn/foreign stamp: fall through
+                pass
+        return ""
+
     def _rehydrate(self, session_id: str, fingerprint: str):
         """Lazy warm restore behind a delta miss: if this process's
         journal namespace holds the session (a migration handoff landed
@@ -1013,6 +1040,19 @@ class SchedulerBackendServicer:
                       "sessions (retry against the replacement)",
             )
         if session_id:
+            fenced = self._fence_route(session_id)
+            if fenced is not None:
+                # this process was EJECTED (fence superseded): it must
+                # not admit sessions against a namespace it no longer
+                # owns — even a zombie that resumed serving
+                if fenced:
+                    return pb.OpenSessionResponse(
+                        ok=False, error=f"moved:{fenced}"
+                    )
+                return pb.OpenSessionResponse(
+                    ok=False,
+                    error="unknown session (journal fence superseded)",
+                )
             moved = self._moved_to(session_id)
             if moved is not None:
                 # dfleet: this session was live-migrated away — even a
@@ -1101,6 +1141,20 @@ class SchedulerBackendServicer:
                 session.last_p4t = np.asarray(p4t, np.int32)
                 if self.ckpt is not None:
                     self.ckpt.flush_locked(session)
+        # post-flush fence re-check (same freeze-window argument as the
+        # delta path): an open that raced an ejection must not be acked
+        # — the client re-opens at the new home instead of holding a
+        # session whose journal can never exist here
+        fenced = self._fence_route(session_id) if session_id else None
+        if fenced is not None:
+            if fenced:
+                return pb.OpenSessionResponse(
+                    ok=False, error=f"moved:{fenced}"
+                )
+            return pb.OpenSessionResponse(
+                ok=False,
+                error="unknown session (journal fence superseded)",
+            )
         t_solve = time.perf_counter()
         self.sessions.put(session)
         self._router_adopt(session.session_id)
@@ -1162,8 +1216,22 @@ class SchedulerBackendServicer:
         self, request: pb.AssignDeltaRequest, context, mark: int, root
     ) -> pb.AssignDeltaResponse:
         t0 = time.perf_counter()
-        # tenant admission first (cheapest check): an over-rate tenant
-        # is refused before it costs a store lookup or a decode
+        # fence first (one stat call): an EJECTED process must refuse
+        # every delta outright — before it consumes a tenant's
+        # admission tokens or a store lookup — because its journal
+        # namespace (and therefore the authority to ack) moved on
+        fenced = self._fence_route(request.session_id)
+        if fenced is not None:
+            if fenced:
+                return pb.AssignDeltaResponse(
+                    session_ok=False, error=f"moved:{fenced}"
+                )
+            return pb.AssignDeltaResponse(
+                session_ok=False,
+                error="unknown session (journal fence superseded)",
+            )
+        # tenant admission next (cheapest stateful check): an over-rate
+        # tenant is refused before it costs a store lookup or a decode
         if not self.admission.admit(tenant_of(request.session_id)):
             self.seam.count("admission_refused")
             return pb.AssignDeltaResponse(
@@ -1411,6 +1479,27 @@ class SchedulerBackendServicer:
                 # client's — either the restart resumes at the next
                 # tick, or the client's retransmit hits the dedup path.
                 self.ckpt.flush_locked(session)
+            # fence re-check AFTER the flush attempt, immediately
+            # before the ack: a SIGSTOP can freeze this thread at ANY
+            # instruction and the ejection (fence bump + journal
+            # re-route) happen while it was frozen. Checking here —
+            # after the flush, which itself refuses on a superseded
+            # fence — closes every freeze window: whatever instant the
+            # freeze hit, either the flushed journal traveled with the
+            # re-route (the resend dedups as the replayed twin) or the
+            # flush was fence-refused and this ack is WITHHELD (the
+            # client resends at the new home, which holds the pre-tick
+            # journal — applied exactly once, split-brain refused).
+            fenced = self._fence_route(request.session_id)
+            if fenced is not None:
+                if fenced:
+                    return pb.AssignDeltaResponse(
+                        session_ok=False, error=f"moved:{fenced}"
+                    )
+                return pb.AssignDeltaResponse(
+                    session_ok=False,
+                    error="unknown session (journal fence superseded)",
+                )
         self.seam.observe_ms("decode", (t_dec - t0) * 1e3)
         self.seam.observe_ms(
             "solve", (time.perf_counter() - t_dec) * 1e3
@@ -1482,6 +1571,13 @@ class SchedulerBackendServicer:
                 self.ckpt.flush_failures
             )
             seam["ckpt_handoffs"] = float(self.ckpt.handoffs)
+            seam["ckpt_fence_epoch"] = float(self.ckpt.fence_epoch)
+            seam["ckpt_fence_refusals"] = float(
+                self.ckpt.fence_refusals
+            )
+            seam["ckpt_journals_skipped"] = float(
+                self.ckpt.journals_skipped
+            )
         with self._router_lock:
             seam["sessions_moved_out"] = float(len(self._moved))
         for name in sorted(seam):
@@ -1604,7 +1700,16 @@ def serve(
             else FaultSchedule(chaos)
         )
         if schedule.config.active():
-            interceptors = (ChaosServerInterceptor(schedule),)
+            # the interceptor needs this process's identity so the
+            # slow-node gray failure (slow_proc=K) can target ONE fleet
+            # process while the rest stay fast
+            proc_id = (
+                fleet.proc_id if fleet is not None
+                else os.environ.get("PROTOCOL_TPU_FLEET_PROC_ID", "p0")
+            )
+            interceptors = (
+                ChaosServerInterceptor(schedule, proc_id=proc_id),
+            )
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=_CHANNEL_OPTIONS,
@@ -1945,6 +2050,10 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         self.retry_base_s = retry_base_s
         self.retry_max_s = retry_max_s
         self.client = SchedulerBackendClient(self.endpoints[0])
+        # generation-monotonic topology adoption (dfleet): the highest
+        # FleetTopology generation this client ever adopted — a stale
+        # /fleet.json poll racing a detector ejection must LOSE
+        self._topology_generation: Optional[int] = None
         self.seam = SeamMetrics(role="client")
         self._rtt_ms: list[float] = []
         self._backend_ms: list[float] = []
@@ -2011,6 +2120,38 @@ class RemoteBatchMatcher(TpuBatchMatcher):
         except Exception:
             pass
         self.client = fresh
+
+    def adopt_topology(self, topology, session_id=None) -> bool:
+        """Adopt a fleet topology (a discovery poll / manager push):
+        the failover endpoint list becomes the ring's ordered walk for
+        this client's session. GENERATION-MONOTONIC: a topology no
+        newer than the one already adopted is refused (returns False,
+        counted) — a stale ``/fleet.json`` poll racing a detector
+        ejection must never resurrect an ejected endpoint into the
+        ladder. If the currently-bound endpoint was ejected, the
+        channel rebinds to the new home immediately."""
+        gen = int(getattr(topology, "generation", 0))
+        if (
+            self._topology_generation is not None
+            and gen <= self._topology_generation
+        ):
+            self.seam.count("stale_topology_refused")
+            return False
+        self._topology_generation = gen
+        sid = session_id or (
+            (self._session or {}).get("id") or self._session_uid
+        )
+        current = self.endpoints[self._endpoint_i]
+        self.endpoints = list(topology.failover_order(sid))
+        if current in self.endpoints:
+            self._endpoint_i = self.endpoints.index(current)
+        else:
+            # our endpoint was ejected from the ring: fail over now
+            self._endpoint_i = 0
+            self.seam.count("endpoint_failover")
+            self.rebind()
+        self.seam.count("topology_adopted")
+        return True
 
     def _reconnect(self, failover: bool = False) -> None:
         """Fresh channel; with ``failover`` (a retry that already

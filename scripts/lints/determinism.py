@@ -24,7 +24,12 @@ decision-quality plane (``protocol_tpu/obs/quality.py``,
 same bit-for-bit promise, plus the chaos plane
 (``protocol_tpu/faults/``): a fault schedule that consulted ``random``
 or a wall clock would make every chaos run unreplayable — the seeded
-byte-replayability claim is the whole point of the plane.
+byte-replayability claim is the whole point of the plane. The dfleet
+failure detector (``protocol_tpu/dfleet/detector.py``) joins under
+the STRICT no-clock mode: it reads time ONLY through the injectable
+``now`` its caller supplies, so a recorded heartbeat/miss sequence
+replays to the identical transition sequence — any in-module clock
+read (``monotonic``/``perf_counter`` included) breaks that.
 
 The SLO engine (``obs/slo.py``) additionally runs under the STRICT
 no-clock mode: its burn-rate windows are TICK-indexed by contract (a
@@ -81,6 +86,12 @@ SCOPES = (
         "slo",
         suffixes=("protocol_tpu/obs/slo.py",),
         fixture_prefix="slo_",
+        strict=True,
+    ),
+    Scope(
+        "detector",
+        suffixes=("protocol_tpu/dfleet/detector.py",),
+        fixture_prefix="detector_",
         strict=True,
     ),
 )

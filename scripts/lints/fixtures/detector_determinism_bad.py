@@ -1,0 +1,26 @@
+"""Seeded violations for the determinism rule over the dfleet failure
+detector (shapes mirror protocol_tpu/dfleet/detector.py, which runs
+under the STRICT no-clock mode). A detector that reads its own clock
+makes time-to-detect unreplayable — the injectable ``now`` its caller
+supplies is the ONLY time source, so a recorded heartbeat/miss
+sequence replays to the identical transition sequence."""
+
+import time
+
+
+class DriftingDetector:
+    def __init__(self):
+        self.last_seen = {}
+
+    def heartbeat(self, proc_id):
+        self.last_seen[proc_id] = time.monotonic()  # SEED: determinism
+
+    def probe_failed(self, proc_id):
+        self.last_seen.setdefault(proc_id, time.time())  # SEED: determinism
+
+    def evaluate(self):
+        dead = []
+        for pid in {p for p in self.last_seen}:  # SEED: determinism
+            if self.last_seen[pid] < 0:
+                dead.append(pid)
+        return dead

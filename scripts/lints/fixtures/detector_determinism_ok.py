@@ -1,0 +1,23 @@
+"""Clean twin of detector_determinism_bad.py: the clock is INJECTED —
+every method takes ``now`` from the caller (the monitor thread owns
+real time; tests own a virtual clock), and iteration over the process
+map is sorted, so two detectors fed the same sample sequence transition
+identically."""
+
+
+class InjectedClockDetector:
+    def __init__(self):
+        self.last_seen = {}
+
+    def heartbeat(self, proc_id, now):
+        self.last_seen[proc_id] = now
+
+    def probe_failed(self, proc_id, now):
+        self.last_seen.setdefault(proc_id, now)
+
+    def evaluate(self, now):
+        dead = []
+        for pid in sorted(self.last_seen):
+            if now - self.last_seen[pid] > 3.0:
+                dead.append(pid)
+        return dead

@@ -78,11 +78,17 @@ shard blackout — must RECONVERGE WARM: zero full-snapshot reopens,
 no tick lost or double-applied (the idempotent-retransmit dedup is
 exercised and must fire), and every tick's plan bit-identical to the
 fault-free replay. A second phase forces an eviction and asserts the
-fallback ladder's counted reopen; a third arms the per-tick solve
-deadline and asserts degraded (stale-plan) answers are explicitly
-flagged, counted in obs, and bounded by ``max_stale_ticks``. A
-recovery/degradation regression cannot merge on green unit tests
-alone.
+fallback ladder's counted reopen; phase C is the ZOMBIE-RESUME drill
+(ISSUE 14): one of 3 real servicer processes is SIGSTOPped mid-run,
+the failure detector must eject it autonomously (suspect->dead, zero
+driver-owned kill events), its journals re-route along the ring, and
+the resumed zombie must be fence-refused — zero double-applied ticks
+(plans bit-identical to the fault-free replay), zero reopens, zero
+false-positive ejections, time-to-detect under the committed floor;
+phase D arms the per-tick solve deadline and asserts degraded
+(stale-plan) answers are explicitly flagged, counted in obs, and
+bounded by ``max_stale_ticks``. A recovery/degradation/autonomy
+regression cannot merge on green unit tests alone.
 
 With ``--dfleet`` it runs the distributed-fleet gate (ISSUE 12): the
 loadgen drives sessions across THREE real servicer processes behind
@@ -933,11 +939,11 @@ def quality_gate() -> int:
 
 
 def chaos_gate() -> int:
-    """Seeded chaos gate (the ISSUE 9 acceptance bar) over the
-    committed golden trace. Three phases, one seed each — every run
-    replays the identical fault train (the schedule is a pure function
-    of the seed, and the acceptance claims are exact, not statistical).
-    """
+    """Seeded chaos gate (the ISSUE 9 acceptance bar, grown the
+    ISSUE 14 zombie-resume phase) over the committed golden trace.
+    Four phases, one seed each — every run replays the identical fault
+    train (the schedule is a pure function of the seed, and the
+    acceptance claims are exact, not statistical)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # arm the runtime lock-order witness (ISSUE 10): every lock the
     # chaos drill's servers create from here on asserts the committed
@@ -1023,7 +1029,93 @@ def chaos_gate() -> int:
             f"below {frac_floor}"
         )
 
-    # ---- phase C: per-tick deadline -> bounded, flagged, counted
+    # ---- phase C: zombie-resume (the ISSUE 14 autonomous-detector
+    # bar): SIGSTOP one of 3 REAL servicer processes mid-run — the
+    # failure detector must promote it suspect->dead with ZERO
+    # driver-owned kill events, re-route its journals along the ring
+    # (topology generation bump), and the resumed zombie must find its
+    # fencing epoch superseded and be moved:-refused. Zero
+    # double-applied ticks (plans bit-identical to the fault-free
+    # replay), zero reopens, time-to-detect under the committed floor.
+    from protocol_tpu.fleet.loadgen import run_load
+
+    ttd_max = float(floors["chaos_time_to_detect_s_max"])
+    zombie_frac_floor = floors["chaos_zombie_min_assigned_frac"]
+    rep_z = run_load(
+        sessions=6, tenants=3, providers=128, tasks=128, ticks=8,
+        churn=0.02, kernel="native-mt:1", shards=2, seed=7,
+        processes=3, chaos="seed=7,pause_proc_at_tick=2,pause_proc=1",
+        rpc_timeout_s=10.0, max_retries=60, verify_plans=True,
+    )
+    drill = rep_z.get("drill") or {}
+    det = rep_z.get("detector") or {}
+    mig_z = rep_z["migration"]
+    print(
+        f"chaos gate C (zombie-resume): ejected_by_detector="
+        f"{drill.get('ejected_by_detector')} ttd="
+        f"{det.get('time_to_detect_s')}s journals_rerouted="
+        f"{drill.get('journals_rerouted')} zombie_refused="
+        f"{drill.get('zombie_fence_refused')} fence_refusals="
+        f"{det.get('fence_refusals')} reopens={mig_z['reopens_total']} "
+        f"plan_mismatches={mig_z['plan_mismatches_total']} "
+        f"false_positives={len(det.get('false_positive_ejections', []))}"
+    )
+    for err in rep_z["errors"]:
+        failures.append(f"phase C: session error: {err}")
+    if not drill.get("ejected_by_detector"):
+        failures.append(
+            "phase C: the paused process was never ejected by the "
+            "detector — autonomy is dark (every prior drill was "
+            "driver-scripted)"
+        )
+    if drill.get("journals_rerouted", 0) < 1:
+        failures.append(
+            "phase C: ejection re-routed no journals — the recovery "
+            "path was never exercised"
+        )
+    if not drill.get("zombie_fence_refused"):
+        failures.append(
+            "phase C: the resumed zombie was NOT fence-refused — a "
+            "paused process could double-serve its old sessions "
+            f"(answer: {drill.get('zombie_answer')!r})"
+        )
+    ttd = det.get("time_to_detect_s")
+    if ttd is None or ttd > ttd_max:
+        failures.append(
+            f"phase C: time-to-detect {ttd}s exceeds the committed "
+            f"{ttd_max}s floor"
+        )
+    if det.get("false_positive_ejections"):
+        failures.append(
+            f"phase C: detector ejected never-faulted process(es): "
+            f"{det['false_positive_ejections']} — flap suppression "
+            "failed"
+        )
+    if mig_z["reopens_total"] != 0:
+        failures.append(
+            f"phase C: {mig_z['reopens_total']} full-snapshot reopens "
+            "— zombie recovery was not warm"
+        )
+    if mig_z["plan_mismatches_total"] != 0:
+        failures.append(
+            f"phase C: {mig_z['plan_mismatches_total']} plans diverged "
+            "from the fault-free replay — a tick was double-applied "
+            "or lost"
+        )
+    for t, agg in rep_z["tenants"].items():
+        if agg["min_assigned_frac"] < zombie_frac_floor:
+            failures.append(
+                f"phase C: tenant {t} assigned "
+                f"{agg['min_assigned_frac']} below {zombie_frac_floor}"
+            )
+    for pid, viols in (rep_z.get("witness_violations") or {}).items():
+        if viols:
+            failures.append(
+                f"phase C: {len(viols)} lock-witness violation(s) in "
+                f"{pid}: {viols[:2]}"
+            )
+
+    # ---- phase D: per-tick deadline -> bounded, flagged, counted
     # staleness (the graceful-degradation contract)
     rep_c = run_chaos(
         GOLDEN_TRACE, seed=5, tick_deadline_ms=0.01,
@@ -1031,7 +1123,7 @@ def chaos_gate() -> int:
     )
     n_stale = len(rep_c["stale_ticks"])
     print(
-        f"chaos gate C (deadline degradation): {n_stale} stale ticks, "
+        f"chaos gate D (deadline degradation): {n_stale} stale ticks, "
         f"max streak {rep_c['max_stale_streak']} (bound {stale_bound}), "
         f"client-counted {rep_c['client']['stale_served']}, "
         f"obs-counted {rep_c['server_stale_obs']}, "
@@ -1039,37 +1131,38 @@ def chaos_gate() -> int:
     )
     if n_stale == 0:
         failures.append(
-            "phase C: the 0.01 ms deadline produced no stale answers — "
+            "phase D: the 0.01 ms deadline produced no stale answers — "
             "the watchdog is dark"
         )
     if rep_c["max_stale_streak"] > stale_bound:
         failures.append(
-            f"phase C: stale streak {rep_c['max_stale_streak']} "
+            f"phase D: stale streak {rep_c['max_stale_streak']} "
             f"exceeds the {stale_bound}-tick bound — staleness is not "
             "bounded"
         )
     if rep_c["client"]["stale_served"] != n_stale:
         failures.append(
-            "phase C: client-side stale count disagrees with the "
+            "phase D: client-side stale count disagrees with the "
             "flagged responses — degradation is not explicit"
         )
     if sum(rep_c["server_stale_obs"].values()) != n_stale:
         failures.append(
-            f"phase C: obs plane counted "
+            f"phase D: obs plane counted "
             f"{sum(rep_c['server_stale_obs'].values())} stale ticks "
             f"for {n_stale} served — degraded answers must be counted"
         )
     if rep_c["assigned_frac_min"] < frac_floor:
         failures.append(
-            f"phase C: assigned fraction {rep_c['assigned_frac_min']} "
+            f"phase D: assigned fraction {rep_c['assigned_frac_min']} "
             f"below {frac_floor} — staleness bought too much quality"
         )
 
-    # ---- lock-order witness verdict over all three phases
+    # ---- lock-order witness verdict over the in-process phases
     violations = lockwitness.violations()
     print(
         f"lock witness: {len(violations)} order violation(s) across "
-        "chaos phases A/B/C"
+        "chaos phases A/B/D (phase C's verdicts ride the per-process "
+        "witness dumps above)"
     )
     if violations:
         failures.append(

@@ -13,6 +13,16 @@ rungs, and the eviction tombstone that keeps the PR 9 "eviction = one
 counted reopen" contract intact next to lazy rehydration. The real
 3-subprocess kill -9 drill lives in ``perf_gate.py --dfleet``; a
 2-subprocess smoke is here but slow-marked.
+
+ISSUE 14 adds the autonomous resilience tier: the deterministic
+heartbeat failure detector (virtual-clock state-machine tests —
+alive→suspect→dead, flap suppression, driver-kill exclusion), fenced
+journal ownership (monotonic namespace epochs; a superseded process is
+``moved:``-refused on delta/open and cannot flush), torn-journal
+hardening (counted skip, never a failed re-route), generation-
+monotonic topology adoption (manager, discovery poll, client ladder),
+and the slow-marked 2-subprocess SIGSTOP zombie drill; the 3-process
+CI bar lives in ``perf_gate.py --chaos`` phase C.
 """
 
 import json
@@ -649,3 +659,443 @@ class TestProcessFleetSubprocess:
         # ProcessFleet API surface smoke (scrape/witness join shapes)
         assert set(rep["processes"].keys()) == {"p0", "p1"}
         del ProcessFleet  # imported to assert availability
+
+
+# ---------------- autonomous failure detection (ISSUE 14) ----------------
+
+
+class TestFailureDetector:
+    """Pure state-machine tests on a VIRTUAL clock — the detector never
+    reads time itself (the determinism lint enforces it), so these
+    drive the exact transition sequence the module promises."""
+
+    CFG = None  # built per test; class attr keeps flake8 quiet
+
+    @staticmethod
+    def _cfg(**kw):
+        from protocol_tpu.dfleet.detector import DetectorConfig
+
+        base = dict(
+            alpha=0.5, suspect_factor=3.0, dead_factor=6.0,
+            min_interval_s=1.0, dead_misses=3, flap_penalty=1.0,
+            flap_memory=4, flap_decay_beats=8, max_penalty=4.0,
+        )
+        base.update(kw)
+        return DetectorConfig(**base)
+
+    def test_alive_suspect_dead_progression(self):
+        from protocol_tpu.dfleet.detector import (
+            ALIVE, DEAD, SUSPECT, FailureDetector,
+        )
+
+        det = FailureDetector(["p0", "p1"], self._cfg())
+        t = 0.0
+        for _ in range(5):
+            t += 1.0
+            det.heartbeat("p0", t)
+            det.heartbeat("p1", t)
+        assert det.state_of("p1") == ALIVE
+        # p1 goes dark; p0 keeps beating
+        dark_from = t
+        for _ in range(3):
+            t += 1.0
+            det.heartbeat("p0", t)
+            det.probe_failed("p1", t)
+        # elapsed == 3.0 is not > 3 x ewma(1.0): still alive
+        assert det.evaluate(dark_from + 3.0) == []
+        det.heartbeat("p0", dark_from + 3.5)
+        assert det.evaluate(dark_from + 3.5) == []
+        assert det.state_of("p1") == SUSPECT  # suspect != ejected
+        # past the dead factor AND >= dead_misses consecutive misses
+        det.heartbeat("p0", dark_from + 6.5)
+        assert det.evaluate(dark_from + 6.5) == ["p1"]
+        assert det.state_of("p1") == DEAD
+        assert det.state_of("p0") == ALIVE
+        # dead is terminal and reported exactly once
+        assert det.evaluate(dark_from + 100.0) == []
+        det.heartbeat("p1", dark_from + 7.0)  # the zombie's late beat
+        assert det.state_of("p1") == DEAD
+        assert det.snapshot()["procs"]["p1"]["zombie_beats"] == 1
+
+    def test_dead_requires_sustained_misses_not_just_elapsed(self):
+        from protocol_tpu.dfleet.detector import SUSPECT, FailureDetector
+
+        det = FailureDetector(["p0"], self._cfg())
+        det.heartbeat("p0", 1.0)
+        det.heartbeat("p0", 2.0)
+        # long silence but ZERO failed probes (e.g. the sampler itself
+        # stalled): suspect, never dead — ejection needs evidence of
+        # refusal, not just a gap
+        assert det.evaluate(30.0) == []
+        assert det.state_of("p0") == SUSPECT
+
+    def test_flap_suppression_inflates_thresholds(self):
+        from protocol_tpu.dfleet.detector import (
+            ALIVE, SUSPECT, FailureDetector,
+        )
+
+        det = FailureDetector(["p0"], self._cfg())
+        t = 0.0
+        for _ in range(4):
+            t += 1.0
+            det.heartbeat("p0", t)
+        # one flap: silence past the suspect threshold, then recover
+        t += 3.5
+        assert det.evaluate(t) == []
+        assert det.state_of("p0") == SUSPECT
+        det.heartbeat("p0", t)
+        assert det.state_of("p0") == ALIVE
+        snap = det.snapshot()
+        assert snap["totals"]["flaps"] == 1
+        assert snap["procs"]["p0"]["recent_flaps"] == 1
+        # suppression: the SAME silence that suspected a clean process
+        # no longer suspects the flapper — the flap penalty (1 +
+        # flap_penalty * recent_flaps) AND the gap-adapted EWMA both
+        # inflated its threshold, which is exactly how a slow-but-alive
+        # node stays in the fleet instead of flap-cycling to ejection
+        det.evaluate(t + 3.5)
+        assert det.state_of("p0") == ALIVE  # the flapper does NOT
+        # ...while the clean twin at the same cadence DOES suspect
+        det2 = FailureDetector(["fresh"], self._cfg())
+        u = 0.0
+        for _ in range(4):
+            u += 1.0
+            det2.heartbeat("fresh", u)
+        det2.evaluate(u + 3.5)
+        assert det2.state_of("fresh") == SUSPECT
+
+    def test_same_samples_replay_identical_transitions(self):
+        from protocol_tpu.dfleet.detector import FailureDetector
+
+        def run():
+            det = FailureDetector(["a", "b"], self._cfg())
+            t = 0.0
+            for i in range(20):
+                t += 1.0
+                det.heartbeat("a", t)
+                if i < 10:
+                    det.heartbeat("b", t)
+                else:
+                    det.probe_failed("b", t)
+                det.evaluate(t)
+            return det.snapshot()
+
+        one, two = run(), run()
+        assert one["transitions"] == two["transitions"]
+        assert one["procs"] == two["procs"]
+
+    def test_driver_kill_is_removed_never_ejected(self):
+        from protocol_tpu.dfleet.detector import FailureDetector
+
+        det = FailureDetector(["p0", "p1"], self._cfg())
+        det.heartbeat("p0", 1.0)
+        det.heartbeat("p1", 1.0)
+        det.remove("p1")  # the driver SIGKILLed it itself
+        for t in (5.0, 9.0, 14.0):
+            det.probe_failed("p1", t)
+        assert det.evaluate(20.0) == []
+        assert det.snapshot()["totals"]["ejections"] == 0
+
+
+# ---------------- fenced journal ownership (ISSUE 14) ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestFencing:
+    def test_fence_stamp_is_monotonic_and_adopted(self, tmp_path):
+        from protocol_tpu.faults.checkpoint import (
+            read_fence,
+            stamp_fence,
+        )
+
+        root = str(tmp_path)
+        assert read_fence(root, "p0")["epoch"] == 0  # unstamped: inert
+        assert stamp_fence(root, "p0") == 1
+        assert stamp_fence(root, "p0", topology={"g": 1}) == 2
+        ck = SessionCheckpointer(root, proc_id="p0")
+        assert ck.fence_epoch == 2
+        assert not ck.fence_superseded()
+        assert stamp_fence(root, "p0") == 3
+        assert ck.fence_superseded()
+        assert ck.fence_state()["epoch"] == 3
+
+    def test_superseded_fence_refuses_flush(self, tmp_path):
+        """flush_locked must refuse (counted) once the namespace fence
+        moved past the adopted epoch — an ejected process can never
+        resurrect a journal a survivor now owns."""
+        from protocol_tpu.faults.checkpoint import stamp_fence
+
+        root = str(tmp_path / "journals")
+        (addr_a, a), (_addr_b, b) = _serve_pair(root)
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=1))
+        sid = "t0@flushfence"
+        client = SchedulerBackendClient(addr_a)
+        try:
+            from protocol_tpu.trace.replay import iter_input_ticks
+
+            _t, p_cols, r_cols, _d = next(iter(iter_input_ticks(trace)))
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok, resp.error
+            stamp_fence(root, "p0")
+            assert a.servicer.finish_drain() == 0  # refused, not flushed
+            assert a.servicer.ckpt.fence_refusals >= 1
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+    def test_zombie_is_fence_refused_and_survivor_serves_warm(
+        self, tmp_path
+    ):
+        """The zombie-resume contract at unit grain, over a real wire:
+        A's namespace is superseded + its journal re-routed (what the
+        detector's ejection does while a SIGSTOPped A is frozen); A —
+        which never observed any of it, exactly like a resumed zombie —
+        must answer ``moved:`` on delta AND re-open, ack nothing, and B
+        must serve the SAME tick warm from the re-routed journal."""
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=4))
+        root = str(tmp_path / "journals")
+        (addr_a, a), (addr_b, b) = _serve_pair(root)
+        sid = "t0@zombie"
+        client = SchedulerBackendClient(addr_a)
+        try:
+            ticks = list(iter_input_ticks(trace))
+            _t, p_cols, r_cols, _d = ticks[0]
+            fp, resp = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert resp.ok, resp.error
+            resp = client.assign_delta(
+                _delta_request(sid, fp, 1, ticks[1][3]), timeout=120
+            )
+            assert resp.session_ok, resp.error
+
+            # the ejection, as the manager runs it against a frozen A:
+            # fence superseded + journal re-routed in one call
+            topo = FleetTopology(
+                [addr_b], procs={addr_b: "p1"}, generation=1
+            )
+            stats: dict = {}
+            moved = handoff_orphans(
+                root, "p0", lambda s: "p1",
+                topology=topo.to_dict(), stats=stats,
+            )
+            assert moved == [(sid, "p1")]
+            assert stats["fence_epoch"] == 1
+
+            # the zombie: delta moved:-refused, re-open moved:-refused
+            resp = client.assign_delta(
+                _delta_request(sid, fp, 2, ticks[2][3]), timeout=120
+            )
+            assert not resp.session_ok
+            assert resp.error == f"moved:{addr_b}"
+            _fp2, resp2 = _open_session(
+                client, trace.snapshot, sid, p_cols, r_cols
+            )
+            assert not resp2.ok and resp2.error == f"moved:{addr_b}"
+            assert a.servicer.seam.snapshot().get(
+                "session_fence_refused"
+            ) == 2
+            # and it can never flush into the superseded namespace
+            assert a.servicer.finish_drain() == 0
+
+            # the survivor serves the SAME tick warm — zero reopens
+            cb = SchedulerBackendClient(addr_b)
+            try:
+                resp = cb.assign_delta(
+                    _delta_request(sid, fp, 2, ticks[2][3]), timeout=120
+                )
+                assert resp.session_ok, resp.error
+                seam_b = b.servicer.seam.snapshot()
+                assert seam_b.get("session_session_rehydrated") == 1
+                assert "session_session_open" not in seam_b
+            finally:
+                cb.close()
+        finally:
+            client.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+
+# ---------------- torn-journal hardening (ISSUE 14 satellite) ----------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestTornJournalHardening:
+    def test_torn_journal_skipped_counted_rest_rerouted(self, tmp_path):
+        """A journal whose META frame is truncated (process killed
+        mid-flush) must be SKIPPED with a counted warning — never raise
+        out of the re-route loop — and the remaining journals must
+        still move. load_all applies the same contract on restore."""
+        import os
+        import shutil
+
+        from protocol_tpu.trace.replay import iter_input_ticks
+
+        root = str(tmp_path / "journals")
+        (addr_a, a), (_addr_b, b) = _serve_pair(root)
+        trace = tfmt.read_trace(_synth(tmp_path, ticks=2))
+        sids = ["t0@torn-x", "t0@torn-y"]
+        clients = []
+        try:
+            for sid in sids:
+                client = SchedulerBackendClient(addr_a)
+                clients.append(client)
+                server_tick = 0
+                for tick, p_cols, r_cols, delta in iter_input_ticks(
+                    trace
+                ):
+                    if tick == 0:
+                        fp, resp = _open_session(
+                            client, trace.snapshot, sid, p_cols, r_cols
+                        )
+                        assert resp.ok, resp.error
+                    else:
+                        resp = client.assign_delta(_delta_request(
+                            sid, fp, server_tick + 1, delta
+                        ), timeout=120)
+                        assert resp.session_ok, resp.error
+                        server_tick += 1
+        finally:
+            for c in clients:
+                c.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
+
+        p0 = SessionCheckpointer(root, proc_id="p0")
+        good = p0.path_for(sids[0])
+        torn = os.path.join(p0.directory, "torn0000deadbeef.ckpt")
+        with open(good, "rb") as fh:
+            blob = fh.read()
+        with open(torn, "wb") as fh:
+            fh.write(blob[:16])  # magic + sheared META header
+
+        stats: dict = {}
+        moved = handoff_orphans(
+            root, "p0", lambda s: "p9", stats=stats
+        )
+        assert sorted(s for s, _ in moved) == sorted(sids)
+        assert stats["journals_moved"] == 2
+        assert stats["journals_skipped"] == 1
+        # the torn file stays behind; the good ones landed in p9
+        assert os.path.exists(torn)
+
+        # restore-side twin: load_all skips the torn file, counted
+        p9 = SessionCheckpointer(root, proc_id="p9")
+        shutil.copyfile(
+            torn, os.path.join(p9.directory, "torn0000deadbeef.ckpt")
+        )
+        restored = p9.load_all()
+        assert sorted(s.session_id for s in restored) == sorted(sids)
+        assert p9.journals_skipped == 1
+
+
+# ---------------- generation-monotonic adoption (ISSUE 14 satellite) ---
+
+
+class TestGenerationMonotonicAdoption:
+    def test_fetch_topology_refuses_stale_generation(self):
+        """A stale /fleet.json poll racing a detector ejection must
+        LOSE: fetch_topology keeps the newer held topology when the
+        served one is not strictly newer."""
+        served = [FleetTopology(["a:1", "b:2", "c:3"])]  # generation 0
+        disco = DiscoveryEndpoint(lambda: served[0])
+        try:
+            held = FleetTopology(
+                ["a:1", "c:3"], procs={"a:1": "p0", "c:3": "p2"},
+                generation=1,
+            )  # what the ejection already produced
+            got = fetch_topology(disco.url, current=held)
+            assert got is held  # the stale poll lost
+            served[0] = served[0].without("b:2").without("a:1")  # gen 2
+            got = fetch_topology(disco.url, current=held)
+            assert got.generation == 2
+            assert got is not held
+        finally:
+            disco.stop()
+
+    def test_manager_adopt_guard_is_generation_monotonic(self):
+        from protocol_tpu.dfleet.manager import ProcessFleet
+
+        fleet = ProcessFleet(processes=2)  # built, never started
+        try:
+            current = fleet.topology
+            stale = FleetTopology(
+                current.endpoints, procs=current.procs,
+                generation=current.generation,
+            )
+            assert fleet.adopt_topology(stale) is False
+            newer = current.without(current.endpoints[0])
+            assert fleet.adopt_topology(newer) is True
+            assert fleet.topology.generation == newer.generation
+            assert fleet.adopt_topology(current) is False  # now stale
+        finally:
+            fleet.stop()
+
+    def test_matcher_adopt_guard_and_reladdering(self):
+        store = _pool_world()
+        m = RemoteBatchMatcher(
+            store, ["a:1", "b:2"], min_solve_interval=0.0
+        )
+        try:
+            topo1 = FleetTopology(
+                ["a:1", "b:2", "c:3"],
+                procs={"a:1": "p0", "b:2": "p1", "c:3": "p2"},
+                generation=1,
+            )
+            assert m.adopt_topology(topo1, session_id="t0@adopt")
+            assert sorted(m.endpoints) == ["a:1", "b:2", "c:3"]
+            assert m.endpoints == topo1.failover_order("t0@adopt")
+            # stale (same and lower generation) must be refused even
+            # if it carries a different membership
+            stale = FleetTopology(["z:9"], generation=1)
+            assert m.adopt_topology(stale) is False
+            assert "z:9" not in m.endpoints
+            assert m.seam.snapshot().get(
+                "session_stale_topology_refused"
+            ) == 1
+            # newer generation that ejected our bound endpoint: adopt
+            # AND fail over off the corpse
+            bound = m.endpoints[m._endpoint_i]
+            topo2 = topo1.without(bound)
+            assert m.adopt_topology(topo2, session_id="t0@adopt")
+            assert bound not in m.endpoints
+            assert m.client.address == m.endpoints[0]
+        finally:
+            m.client.close()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestZombieResumeSubprocess:
+    def test_pause_zombie_is_ejected_fenced_and_warm(self, tmp_path):
+        """The zombie-resume drill end to end on real subprocesses:
+        SIGSTOP one of two processes mid-run — the detector must eject
+        it with zero driver-owned kills, journals re-route, the resumed
+        zombie is fence-refused, and every session resumes warm with
+        plans bit-identical to the fault-free replay."""
+        from protocol_tpu.fleet.loadgen import run_load
+
+        rep = run_load(
+            sessions=2, tenants=2, providers=64, tasks=64, ticks=8,
+            churn=0.05, kernel="native-mt:1", shards=2, seed=1,
+            processes=2,
+            chaos="seed=7,pause_proc_at_tick=2,pause_proc=1",
+            rpc_timeout_s=10.0, max_retries=60, verify_plans=True,
+            ckpt_dir=str(tmp_path / "journals"),
+        )
+        assert rep["errors"] == []
+        drill = rep["drill"]
+        assert drill.get("paused") and drill.get("resumed")
+        assert drill.get("ejected_by_detector")
+        assert drill.get("zombie_fence_refused"), drill
+        det = rep["detector"]
+        assert det["time_to_detect_s"] is not None
+        assert det["false_positive_ejections"] == []
+        mig = rep["migration"]
+        assert mig["reopens_total"] == 0
+        assert mig["plan_mismatches_total"] == 0

@@ -795,3 +795,120 @@ def test_unloadable_checkpoints_are_skipped_not_fatal(tmp_path):
     assert ckpt.due(0) and ckpt.due(1)
     every3 = SessionCheckpointer(str(tmp_path), every=3)
     assert [t for t in range(7) if every3.due(t)] == [0, 3, 6]
+
+
+# ---------------- asymmetric partition (ISSUE 14) ----------------
+
+
+class TestDirectionalDrops:
+    def test_decisions_are_one_directional(self):
+        """With only drop_response_rate set, the schedule must never
+        lose a request (and vice versa): the partition is ASYMMETRIC
+        by construction — A→B flows while B→A drops."""
+        sched = FaultSchedule(ChaosConfig(seed=5, drop_response_rate=0.3))
+        acts = [sched.decide("client", "AssignDelta", i) for i in range(64)]
+        assert any(a.drop_response for a in acts)
+        assert not any(a.drop_request or a.drop for a in acts)
+        rev = FaultSchedule(ChaosConfig(seed=5, drop_request_rate=0.3))
+        acts = [rev.decide("client", "AssignDelta", i) for i in range(64)]
+        assert any(a.drop_request for a in acts)
+        assert not any(a.drop_response or a.drop for a in acts)
+
+    def test_new_knobs_parse_and_roundtrip(self):
+        cfg = ChaosConfig.from_spec(
+            "seed=9,dropreq=0.1,dropresp=0.2,slow_proc=1,slow_ms=40,"
+            "slow_rate=0.5,pause_proc_at_tick=3,pause_proc=2"
+        )
+        assert cfg.drop_request_rate == 0.1
+        assert cfg.drop_response_rate == 0.2
+        assert cfg.slow_proc == 1 and cfg.slow_ms == 40.0
+        assert cfg.pause_proc_at_tick == 3 and cfg.pause_proc == 2
+        assert cfg.active()
+        assert ChaosConfig.from_spec(cfg.spec()) == cfg
+        # every new knob alone arms the plane
+        assert ChaosConfig(drop_response_rate=0.1).active()
+        assert ChaosConfig(slow_proc=0).active()
+        assert ChaosConfig(pause_proc_at_tick=1).active()
+
+    @pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+    @pytest.mark.parametrize("kernel", ["native-mt:1", "sinkhorn-mt:1"])
+    def test_response_drop_rides_retransmit_dedup_bit_identical(
+        self, tmp_path, kernel
+    ):
+        """The asymmetric-partition site end to end, on BOTH engines:
+        requests flow, responses drop (seed 5 kills delta answers at
+        call indices 1 and 5). The server APPLIES each dropped tick;
+        the client's resend must be served the replayed twin — zero
+        reopens, every plan bit-identical to the fault-free replay."""
+        from protocol_tpu.faults.harness import _Driver
+        from protocol_tpu.trace.replay import iter_input_ticks, replay
+        from protocol_tpu.trace.synth import synth_trace
+
+        trace_path = str(tmp_path / f"part_{kernel.split(':')[0]}.trace")
+        synth_trace(
+            trace_path, n_providers=64, n_tasks=64, ticks=6,
+            churn=0.05, seed=3, kernel=kernel,
+        )
+        trace = tfmt.read_trace(trace_path)
+        baseline = replay(
+            trace_path, engine=kernel, verify=False, keep_p4t=True
+        )["p4ts"]
+        schedule = FaultSchedule(
+            ChaosConfig(seed=5, drop_response_rate=0.3)
+        )
+        address = f"127.0.0.1:{_free_port()}"
+        server = serve(address, fleet=FleetConfig(shards=2))
+        driver = _Driver(
+            address, schedule, "t0@partition", kernel, trace.snapshot
+        )
+        try:
+            for tick, p_cols, r_cols, delta in iter_input_ticks(trace):
+                if tick == 0:
+                    p4t = driver.open(p_cols, r_cols)
+                else:
+                    p4t, stale = driver.tick(delta, p_cols, r_cols)
+                    assert not stale
+                assert np.array_equal(p4t, baseline[tick]), (
+                    f"tick {tick} diverged under response drops"
+                )
+            assert driver.client.counters.get("drop_response", 0) >= 1
+            assert "drop_request" not in driver.client.counters
+            assert driver.counters["replayed_served"] >= 1
+            assert driver.counters["reopens"] == 0
+            seam = server.servicer.seam.snapshot()
+            assert seam.get("session_delta_replayed", 0) >= 1
+        finally:
+            driver.close()
+            server.stop(grace=None)
+
+
+class TestSlowNodeInterceptor:
+    def test_slow_proc_targets_one_process(self):
+        """The gray slow-node site: the interceptor inflates responses
+        ONLY in the targeted process — the same schedule in any other
+        proc_id leaves the handler untouched."""
+        cfg = ChaosConfig(seed=1, slow_proc=1, slow_ms=1.0)
+        sched = FaultSchedule(cfg)
+        from protocol_tpu.faults.inject import ChaosServerInterceptor
+
+        calls = []
+
+        class _Details:
+            method = "/pkg.Svc/AssignDelta"
+
+        def handler_fn(request, context):
+            calls.append(request)
+            return "ok"
+
+        def continuation(details):
+            return grpc.unary_unary_rpc_method_handler(handler_fn)
+
+        slow = ChaosServerInterceptor(sched, proc_id="p1")
+        fast = ChaosServerInterceptor(sched, proc_id="p0")
+        wrapped = slow.intercept_service(continuation, _Details())
+        assert wrapped.unary_unary is not handler_fn  # wrapped: delays
+        assert wrapped.unary_unary("req", None) == "ok"
+        assert slow.counters.get("slow") == 1
+        untouched = fast.intercept_service(continuation, _Details())
+        assert untouched.unary_unary is handler_fn  # pass-through
+        assert "slow" not in fast.counters
