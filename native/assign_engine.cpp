@@ -1484,6 +1484,17 @@ int32_t repair_topk_candidates_mt(
   // exactness contract as the task-side pruning), optionally
   // collecting forward entrants. One implementation, so the two
   // column-shaped passes cannot drift apart.
+  // Cost lower-bound precheck for the column sweeps: for an admissible
+  // cell, score_cell = base[p] - prio[t] + proximity with proximity and
+  // jitter both >= 0 (when w_proximity >= 0, the production regime), so
+  // lb = base[p] - prio[t] bounds every achievable key from below
+  // (pack_key is monotone in cost with id 0 minimal). A row whose lb
+  // can neither enter the reverse buffer (current worst only shrinks —
+  // the standard streaming-top-k skip, exact) nor pass the entrant
+  // theta/not-full test is SKIPPED without the proximity math — a
+  // prune-only fast path, never a float change, so bit-identity with
+  // the full sweep holds by construction.
+  const bool lb_ok = w_proximity >= 0.0f;
   const auto sweep_column = [&](int32_t p, uint64_t* rb,
                                 std::vector<Ent>* ent_out, int tid) {
     for (int32_t j = 0; j < reverse_r; ++j) rb[j] = pad_key;
@@ -1498,6 +1509,15 @@ int32_t repair_topk_candidates_mt(
         continue;
       }
       if (b == 1 && ts_all[t].any_opt) continue;  // no GPU
+      if (lb_ok) {
+        const uint64_t lbkey =
+            pack_key(pre.base[p] - ts_all[t].prio, 0);
+        const bool rev_possible = lbkey < rb[reverse_r - 1];
+        const bool fwd_possible =
+            ent_out != nullptr && !in_dt[t] &&
+            (not_full[t] || lbkey <= theta[t]);
+        if (!rev_possible && !fwd_possible) continue;
+      }
       const float c =
           score_cell(pf, rf, pre, ts_all[t], t, K, W, p, w_proximity);
       ++cells[tid];

@@ -76,6 +76,14 @@ class ChaosConfig:
     corrupt_rate: float = 0.0
     truncate_rate: float = 0.0
     duplicate_rate: float = 0.0
+    # event-stream chaos (the "events" site): reorder_rate displaces an
+    # event a few delivery slots later — combined with drop (delivered
+    # late = the retransmit ladder) and duplicate at the same site, it
+    # drills the stream engine's per-source seq dedup: a chaos'd event
+    # stream must CONVERGE (final reconcile bit-identical to fault-free
+    # delivery), with double-applies impossible by construction
+    reorder_rate: float = 0.0
+    reorder_span: int = 4
     # directional (gray) partition faults: request-side loss severs
     # A→B while answers still flow; response-side loss is the
     # asymmetric partition the retransmit-dedup ladder exists for —
@@ -117,7 +125,7 @@ class ChaosConfig:
 
     _FLOATS = (
         "drop_rate", "delay_rate", "delay_ms", "corrupt_rate",
-        "truncate_rate", "duplicate_rate",
+        "truncate_rate", "duplicate_rate", "reorder_rate",
         "drop_request_rate", "drop_response_rate",
         "slow_rate", "slow_ms",
     )
@@ -127,6 +135,7 @@ class ChaosConfig:
         "kill_proc_at_tick", "kill_proc",
         "migrate_at_tick", "migrate_proc",
         "slow_proc", "pause_proc_at_tick", "pause_proc",
+        "reorder_span",
     )
     # spec aliases: the short names the env/CLI spec uses
     _ALIASES = {
@@ -137,12 +146,14 @@ class ChaosConfig:
         "dup": "duplicate_rate",
         "dropreq": "drop_request_rate",
         "dropresp": "drop_response_rate",
+        "reorder": "reorder_rate",
     }
 
     def active(self) -> bool:
         return bool(
             self.drop_rate or self.delay_rate or self.corrupt_rate
             or self.truncate_rate or self.duplicate_rate
+            or self.reorder_rate
             or self.drop_request_rate or self.drop_response_rate
             or self.slow_proc is not None
             or self.kill_at_tick is not None
@@ -243,6 +254,22 @@ class FaultSchedule:
             drop_request, drop_response,
         )
 
+    def reorder_slots(self, site: str, method: str, index: int) -> int:
+        """Deterministic delivery displacement for a reorder fault: 0 =
+        in order, else 1..reorder_span slots late. Same pure-function
+        contract as :meth:`decide`."""
+        c = self.config
+        if c.reorder_rate <= 0:
+            return 0
+        if self._frac(
+            c.seed, "reorder", site, method, index
+        ) >= c.reorder_rate:
+            return 0
+        span = max(int(c.reorder_span), 1)
+        return 1 + int(
+            self._frac(c.seed, "reorder-span", site, method, index) * span
+        )
+
     def corrupt_byte(self, site: str, method: str, index: int,
                      n_bytes: int) -> tuple[int, int]:
         """Deterministic (offset, xor-mask) for a corruption fault —
@@ -254,3 +281,44 @@ class FaultSchedule:
         off = int.from_bytes(digest[:8], "big") % max(n_bytes, 1)
         mask = digest[8] or 0xFF
         return off, mask
+
+
+def event_delivery_order(
+    schedule: FaultSchedule, n_events: int, site: str = "events"
+) -> list:
+    """Chaos'd-but-CONVERGENT delivery order for an event stream: the
+    deterministic composition of the transport faults at the ``events``
+    site with the retransmit ladder the sources already run.
+
+    Per original event index ``i`` (emission order):
+
+      * drop      -> the first delivery dies; the source retransmits,
+                     landing ``reorder_span + 1`` slots later (the ack
+                     timeout's worth of stream progress)
+      * reorder   -> delivered 1..reorder_span slots late (overtaken by
+                     newer events — the dedup ladder supersedes it)
+      * duplicate -> a second copy lands ``reorder_span`` slots after
+                     the first (a retransmit whose original survived)
+
+    Every event index appears at least once (nothing is lost forever —
+    convergence is by construction, exactly what the retransmit ladder
+    guarantees), and the whole order is a pure function of the seeded
+    schedule: a chaos replay sees the identical delivery train.
+    Returns the list of event indices in delivery order (duplicates
+    appear twice)."""
+    span = max(int(schedule.config.reorder_span), 1)
+    entries: list = []
+    for i in range(n_events):
+        action = schedule.decide(site, "event", i)
+        pos = float(i)
+        if action.drop:
+            pos = i + span + 1 + 0.5
+        else:
+            late = schedule.reorder_slots(site, "event", i)
+            if late:
+                pos = i + late + 0.25
+        entries.append((pos, i))
+        if action.duplicate:
+            entries.append((pos + span + 0.75, i))
+    entries.sort()
+    return [i for _, i in entries]

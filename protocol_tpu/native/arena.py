@@ -137,6 +137,7 @@ class NativeSolveArena:
         bucketed: bool = True,
         coverage_frac: float = 0.6,
         slack: int = 16,
+        event_max_bids: int = 16384,
     ):
         if engine not in ("auction", "sinkhorn"):
             raise ValueError(
@@ -192,6 +193,18 @@ class NativeSolveArena:
         # amortized cost is a few tens of ms per tick. cold_every still
         # re-grounds the structure itself.
         self.dual_refresh_every = dual_refresh_every
+        # Per-event auction WORK BUDGET (apply_rows only): a single
+        # event in a saturated pocket can trigger a give-up war —
+        # displaced tasks ratcheting prices to the give-up floor over
+        # hundreds of thousands of fine-eps bids whose outcome
+        # (retirement) is already decided. The budget bounds one
+        # event's bid loop; the unconverged tasks stay unassigned (not
+        # retired) and the NEXT event's call resumes the war from the
+        # carried prices — per-event latency is bounded and the war
+        # amortizes, while reconciliation periodically re-grounds with
+        # an unbudgeted full solve. 0 = unbounded (the historical
+        # behavior).
+        self.event_max_bids = int(event_max_bids)
         # Warm solves open at a COARSE eps and scale down (0.32 -> 0.08 ->
         # eps_end by the engine's 0.25 scale): evicted seats separate from
         # rivals in a handful of coarse rounds instead of thousands of
@@ -370,6 +383,14 @@ class NativeSolveArena:
         # gap/outcome certificate is still exact)
         self._starve_age: Optional[np.ndarray] = None
         self._last_quality: dict = {}
+        # stream plane: the last apply_rows call's touched-row mask
+        self.last_repair_mask: Optional[np.ndarray] = None
+        # columns apply_rows has privatized since the baseline was last
+        # (re)assigned: solve() holds caller arrays by REFERENCE (and
+        # trace-decoded columns are read-only frombuffer views), so the
+        # first in-place event write to a column must copy it — after
+        # that the arena owns it and writes are O(rows)
+        self._owned_cols: set = set()
 
     # ---------------- internals ----------------
 
@@ -540,6 +561,7 @@ class NativeSolveArena:
                 )
         t_solve = time.perf_counter()
         self._p_fields, self._r_fields = pf, rf
+        self._owned_cols = set()
         self._weights_key = self._wkey(weights)
         self._price, self._retired, self._p4t = price, retired, p4t
         self._warm_solves = 0
@@ -564,6 +586,311 @@ class NativeSolveArena:
             "assigned": int((p4t >= 0).sum()),
             "gen_ms": round((t_gen - t0) * 1e3, 3),
             "solve_ms": round((t_solve - t_gen) * 1e3, 3),
+            **(self._sink_stats if self.engine == "sinkhorn" else {}),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t
+
+    # ---------------- streaming entry points ----------------
+
+    def apply_rows(
+        self,
+        provider_rows: Optional[np.ndarray],
+        p_rows: Optional[dict],
+        task_rows: Optional[np.ndarray],
+        r_rows: Optional[dict],
+        weights,
+        event_eps_start: Optional[float] = None,
+    ) -> np.ndarray:
+        """Single-event repair entry (the stream engine's hot path): the
+        caller names the churned rows EXPLICITLY, so there is no O(P+T)
+        value-diff pass — the cost per call is O(churned rows) repair +
+        one masked warm engine pass.
+
+        ``provider_rows``/``task_rows`` are row indices into the arena's
+        current columns; ``p_rows``/``r_rows`` are full-spec column
+        dicts with one value per index (the wire delta shape). Rows
+        whose values equal the current columns are dropped (an event
+        replay is a no-op by construction). The arena's own field
+        baseline is updated IN PLACE for the truly-dirty rows, so a
+        later batch ``solve`` against the same columns sees zero dirty
+        rows — stream and batch entries stay one consistent state.
+
+        Requires a primed arena (``solve`` ran at least once and the
+        persistent candidate structure exists) under the SAME weights;
+        raises RuntimeError/ValueError otherwise — the stream engine
+        treats that as "re-prime with a batch solve", never silently
+        degrades. Never issues a full-matrix candidate pass
+        (``last_stats["cand_cold_passes"] == 0`` always).
+
+        The warm engine runs a SINGLE fine-eps phase by default
+        (``event_eps_start`` = ``eps_end``): a one-event perturbation
+        re-seats in a handful of bids, and the coarse warm ladder's
+        multi-phase overhead would dominate sub-tick latency. Returns
+        provider_for_task [T] (the arena's live padded row space)."""
+        if self._cand_p is None or self._rev is None:
+            raise RuntimeError(
+                "arena not primed for apply_rows: run solve() first "
+                "(the persistent candidate structure must exist)"
+            )
+        if self._weights_key != self._wkey(weights):
+            raise ValueError(
+                "apply_rows under different weights: the carried "
+                "structure was scored under the old weights (re-prime "
+                "with a batch solve)"
+            )
+        t_start = time.perf_counter()
+        P = self._p_fields["gpu_count"].shape[0]
+        T = self._r_fields["cpu_cores"].shape[0]
+
+        def _narrow(rows, vals, fields, spec, n, side):
+            """Coerce event values to spec dtypes, keep only rows that
+            actually change a field, and write them into the arena's
+            baseline in place (privatizing a column on its first write —
+            the baseline may be a caller-shared or read-only buffer).
+            Returns the truly-dirty index array."""
+            if rows is None or vals is None:
+                return np.zeros(0, np.int32)
+            rows = np.asarray(rows, np.int64).ravel()
+            if rows.size == 0:
+                return np.zeros(0, np.int32)
+            if rows.min() < 0 or rows.max() >= n:
+                raise ValueError(f"event row index out of range [0, {n})")
+            dirty = np.zeros(rows.size, bool)
+            canon = {}
+            for name, dtype in spec:
+                v = np.ascontiguousarray(np.asarray(vals[name]), dtype)
+                if v.shape[0] != rows.size:
+                    raise ValueError(
+                        f"event column {name!r} has {v.shape[0]} rows "
+                        f"for {rows.size} row indices"
+                    )
+                canon[name] = v
+                diff = fields[name][rows] != v
+                dirty |= diff.reshape(rows.size, -1).any(axis=1)
+            keep = np.flatnonzero(dirty)
+            if keep.size:
+                idx = rows[keep]
+                for name, _ in spec:
+                    key = (side, name)
+                    if key not in self._owned_cols:
+                        fields[name] = fields[name].copy()
+                        self._owned_cols.add(key)
+                    fields[name][idx] = canon[name][keep]
+            return rows[keep].astype(np.int32)
+
+        # ---- dual pre-conditioning for separable (price/load) drift.
+        # The cost model's provider term is separable: score(t, p) =
+        # base(p) + task/cross terms, base = w_price*price + w_load*
+        # load. A heartbeat that drops base(p) by d makes p a magnet:
+        # every nearby task re-bids it up by fine-eps increments until
+        # its dual price has risen ~d — a bidding war of d/eps rounds
+        # for an outcome KNOWN in closed form. Pre-bumping price[p] by
+        # d keeps c+price invariant for every row (the current plan
+        # stays eps-CS instantly; the seat holder still pockets the
+        # cheaper rate), prices stay monotone (the gap tracker's
+        # soundness argument), and any nonnegative dual certifies — the
+        # war is skipped, not hidden. Applied only to auction duals on
+        # non-structural (price/load-only) churn; cost INCREASES never
+        # pre-drop (monotonicity), they release via the eps-CS repair.
+        bump_rows = bump_vals = None
+        if (
+            self.engine == "auction"
+            and self._price is not None
+            and provider_rows is not None and p_rows is not None
+        ):
+            pr = np.asarray(provider_rows, np.int64).ravel()
+            if pr.size and pr.min() >= 0 and pr.max() < P:
+                old_base = (
+                    float(weights.price)
+                    * self._p_fields["price"][pr].astype(np.float64)
+                    + float(weights.load)
+                    * self._p_fields["load"][pr].astype(np.float64)
+                )
+                structural = np.zeros(pr.size, bool)
+                for name, dtype in _P_SPEC:
+                    if name in ("price", "load"):
+                        continue
+                    v = np.ascontiguousarray(
+                        np.asarray(p_rows[name]), dtype
+                    )
+                    if v.shape[0] != pr.size:
+                        break  # shape error: _narrow raises below
+                    diff = self._p_fields[name][pr] != v
+                    structural |= diff.reshape(pr.size, -1).any(axis=1)
+                else:
+                    new_base = (
+                        float(weights.price) * np.asarray(
+                            p_rows["price"], np.float64
+                        )
+                        + float(weights.load) * np.asarray(
+                            p_rows["load"], np.float64
+                        )
+                    )
+                    dbase = new_base - old_base
+                    sel = ~structural & (dbase < 0)
+                    if sel.any():
+                        bump_rows = pr[sel]
+                        bump_vals = (-dbase[sel]).astype(np.float32)
+        dirty_p = _narrow(
+            provider_rows, p_rows, self._p_fields, _P_SPEC, P, "p"
+        )
+        dirty_t = _narrow(
+            task_rows, r_rows, self._r_fields, _R_SPEC, T, "r"
+        )
+        if bump_rows is not None and (dirty_p.size or dirty_t.size):
+            self._price[bump_rows] += bump_vals
+        n_dp, n_dt = int(dirty_p.size), int(dirty_t.size)
+        if n_dp == 0 and n_dt == 0:
+            self.last_repair_mask = None
+            self.last_stats = {
+                "cold": False, "event": True, "rows": T,
+                "cand_cold_passes": 0, "dirty_providers": 0,
+                "dirty_tasks": 0, "changed_rows": 0,
+                "assigned": int((self._p4t >= 0).sum()),
+            }
+            return self._p4t.copy()
+
+        eng: Optional[dict] = {} if obs.enabled() else None
+        repair, changed = native.repair_topk_candidates(
+            _as_ns(self._p_fields, _P_SPEC),
+            _as_ns(self._r_fields, _R_SPEC), weights,
+            self._cand_p, self._cand_c, self._rev,
+            dirty_p, dirty_t,
+            k=self._cand_p.shape[1] - self.extra,
+            reverse_r=self.reverse_r, extra=self.extra,
+            threads=self.threads, coverage_frac=self.coverage_frac,
+            slack=(
+                (self._slack_p, self._slack_c)
+                if self._slack_p is not None else None
+            ),
+            stats=eng,
+        )
+        if n_dt:
+            # same contract as the batch warm path: a dirty task's seat
+            # predates its new requirement — re-seat from scratch
+            self._p4t[dirty_t] = -1
+        seat_check = np.flatnonzero(repair & (self._p4t >= 0))
+        if seat_check.size:
+            in_list = (
+                self._cand_p[seat_check] == self._p4t[seat_check, None]
+            ).any(axis=1)
+            lost = seat_check[~in_list]
+            if lost.size:
+                self._p4t[lost] = -1
+                changed[lost] = True
+        t_gen = time.perf_counter()
+
+        eps0 = (
+            max(float(event_eps_start), self.eps_end)
+            if event_eps_start is not None else self.eps_end
+        )
+        if self.engine == "sinkhorn":
+            p4t, price, retired = self._sinkhorn_round(
+                P, warm=True,
+                retired=self._retired & ~changed,
+                seed=self._p4t,
+                max_release=self.max_release,
+                eng=eng,
+            )
+        else:
+            p4t, price, retired = native.auction_sparse_mt(
+                self._cand_p, self._cand_c, num_providers=P,
+                eps_start=eps0, eps_end=self.eps_end,
+                threads=self.threads,
+                price=self._price,
+                retired=self._retired & ~changed,
+                seed_provider_for_task=self._p4t,
+                max_release=self.max_release,
+                repair_mask=repair,
+                max_events=(
+                    self.event_max_bids or 50_000_000
+                ),
+                stats=eng,
+            )
+        t_solve = time.perf_counter()
+        self._price, self._retired, self._p4t = price, retired, p4t
+        # the stream engine's gap tracker needs the touched-row mask
+        # (rows whose candidate content moved this event) — exposed as
+        # an attribute, never through last_stats (stats flow into JSON
+        # trace metrics; arrays do not)
+        self.last_repair_mask = repair
+        self.last_stats = {
+            "cold": False,
+            "event": True,
+            "engine": self.engine,
+            "rows": T,
+            "cand_cold_passes": 0,
+            "dirty_providers": n_dp,
+            "dirty_tasks": n_dt,
+            "changed_rows": int(changed.sum()),
+            "repair_rows": int(repair.sum()),
+            "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t_start) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
+            **(self._sink_stats if self.engine == "sinkhorn" else {}),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t
+
+    def reconcile(self) -> np.ndarray:
+        """Full batch re-solve over the CURRENT candidate structure from
+        scratch duals — the stream engine's periodic reconciliation.
+
+        Bit-identical to a cold ``solve`` on the current columns WITHOUT
+        re-paying the full-matrix candidate pass: the repair exactness
+        contract keeps the persistent structure equal to a from-scratch
+        rebuild at every event, so "rebuild + cold engine" and "repaired
+        structure + cold engine" are the same computation. Re-grounds
+        the duals (the per-event warm chain's monotone price ratchet
+        resets here, exactly like ``cold_every`` does for batch chains)
+        and restarts the starvation clock, mirroring ``_cold``."""
+        if self._cand_p is None:
+            raise RuntimeError(
+                "arena not primed for reconcile: run solve() first"
+            )
+        t0 = time.perf_counter()
+        P = self._p_fields["gpu_count"].shape[0]
+        T = self._r_fields["cpu_cores"].shape[0]
+        eng: Optional[dict] = {} if obs.enabled() else None
+        outs: Optional[dict] = {} if obs.enabled() else None
+        prev_p4t = self._p4t.copy() if obs.enabled() else None
+        with _tracer.span("arena.engine", engine=self.engine,
+                          reconcile=True):
+            if self.engine == "sinkhorn":
+                self._f = self._g = None
+                p4t, price, retired = self._sinkhorn_round(
+                    P, warm=False, eng=eng, outs=outs
+                )
+            else:
+                p4t, price, retired = native.auction_sparse_mt(
+                    self._cand_p, self._cand_c, num_providers=P,
+                    eps_start=self.eps_start, eps_end=self.eps_end,
+                    threads=self.threads, stats=eng, outcomes=outs,
+                )
+        t_solve = time.perf_counter()
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves = 0
+        self._dual_age = 0
+        self._starve_age = None
+        qual = (
+            self._quality_pass(
+                self._r_fields, p4t, price, prev_p4t, outs, eng
+            )
+            if obs.enabled() else {}
+        )
+        self.last_stats = {
+            **qual,
+            "cold": False,
+            "reconcile": True,
+            "engine": self.engine,
+            "rows": T,
+            "cand_cold_passes": 0,
+            "dirty_providers": 0,
+            "dirty_tasks": 0,
+            "changed_rows": 0,
+            "assigned": int((p4t >= 0).sum()),
+            "solve_ms": round((t_solve - t0) * 1e3, 3),
             **(self._sink_stats if self.engine == "sinkhorn" else {}),
             **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
         }
@@ -684,6 +1011,7 @@ class NativeSolveArena:
         prev_p4t = self._p4t.copy() if obs.enabled() else None
         t_start = time.perf_counter()
         self._p_fields, self._r_fields = pf, rf
+        self._owned_cols = set()
 
         # ---- incremental repair: one native pass rewrites the persistent
         # structure (forward lists + reverse keys + extras) in place,

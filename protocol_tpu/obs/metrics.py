@@ -130,6 +130,22 @@ class LatencyHistogram:
             "max_ms": round(self.max_ns / 1e6, 3),
         }
 
+    def snapshot_us(self) -> dict:
+        """Microsecond-keyed snapshot — the stream plane's per-event
+        scale, where ms rounding would flatten the whole distribution
+        into its bottom bucket."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean_us": round(self.sum_ns / self.count / 1e3, 1),
+            "p50_us": round(self.quantile_ns(0.50) / 1e3, 1),
+            "p90_us": round(self.quantile_ns(0.90) / 1e3, 1),
+            "p99_us": round(self.quantile_ns(0.99) / 1e3, 1),
+            "p999_us": round(self.quantile_ns(0.999) / 1e3, 1),
+            "max_us": round(self.max_ns / 1e3, 1),
+        }
+
 
 def percentiles_ms(walls_ms) -> dict:
     """One-shot helper for bench emitters: feed a list of wall-clock ms
@@ -164,6 +180,10 @@ class _SessionObs:
         # headline counters — a warm fleet should hold cold_passes at
         # its cold-solve count and grow repairs, never the reverse)
         "cand_cold_passes", "cand_repaired_rows", "cand_rescan_rows",
+        # stream plane: per-event apply latency (µs-scale HDR) and the
+        # dedup / reconcile / divergence / repair-scope counters
+        "events", "events_deduped", "events_reconciled",
+        "event_divergence_max", "event_repair_rows",
     )
 
     def __init__(self):
@@ -189,6 +209,11 @@ class _SessionObs:
         self.cand_cold_passes = 0
         self.cand_repaired_rows = 0
         self.cand_rescan_rows = 0
+        self.events = LatencyHistogram(lowest_ns=100.0)
+        self.events_deduped = 0
+        self.events_reconciled = 0
+        self.event_divergence_max = 0
+        self.event_repair_rows = 0
 
     def reuse_ratio(self) -> float:
         """Fraction of candidate rows the warm path did NOT recompute."""
@@ -437,6 +462,34 @@ class ObsRegistry:
                     self._alerts.append(a)
         return alerts
 
+    def observe_event(
+        self,
+        session_id: str,
+        wall_ms: float,
+        deduped: bool = False,
+        reconciled: bool = False,
+        divergence_rows: int = 0,
+        repair_rows: int = 0,
+    ) -> None:
+        """One STREAM event for one session: per-event apply latency
+        (µs-scale histogram), dedup/reconcile counters, divergence vs
+        the last reconciled plan, and the repair scope. Recorded per
+        session AND per tenant, like observe_tick."""
+        with self._lock:
+            for s in (
+                self._entry(self._sessions, session_id),
+                self._entry(self._tenants, tenant_of(session_id)),
+            ):
+                s.events.observe_ms(wall_ms)
+                if deduped:
+                    s.events_deduped += 1
+                if reconciled:
+                    s.events_reconciled += 1
+                s.event_divergence_max = max(
+                    s.event_divergence_max, int(divergence_rows)
+                )
+                s.event_repair_rows += int(repair_rows)
+
     def forget(self, session_id: str) -> None:
         """Drop one session's metrics (optional — the LRU cap already
         bounds the registry; use when a tenant's history must go now)."""
@@ -466,6 +519,14 @@ class ObsRegistry:
                     "cold_passes": s.cand_cold_passes,
                     "repaired_rows": s.cand_repaired_rows,
                     "rescan_rows": s.cand_rescan_rows,
+                }
+            if s.events.count:
+                out["stream"] = {
+                    "event": s.events.snapshot_us(),
+                    "deduped": s.events_deduped,
+                    "reconciled": s.events_reconciled,
+                    "divergence_rows_max": s.event_divergence_max,
+                    "repair_rows": s.event_repair_rows,
                 }
             quality = s.quality_snapshot()
             if quality is not None:

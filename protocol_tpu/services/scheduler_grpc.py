@@ -40,6 +40,7 @@ from typing import NamedTuple, Optional
 import grpc
 import numpy as np
 
+from protocol_tpu import obs as obs_pkg
 from protocol_tpu.obs.metrics import ObsRegistry, tenant_of
 from protocol_tpu.obs.spans import TRACER as _tracer, span_dicts_compact
 from protocol_tpu.ops.cost import CostWeights, cost_matrix
@@ -165,6 +166,16 @@ def _delta_crc(request: "pb.AssignDeltaRequest") -> int:
             crc = zlib.crc32(nt.name.encode(), crc)
             crc = zlib.crc32(nt.tensor.data, crc)
     return crc
+
+
+def _stream_gap_ceiling() -> Optional[float]:
+    """Server-side certified-gap ceiling for streaming sessions
+    (PROTOCOL_TPU_STREAM_GAP_CEILING): when the streamed plan's
+    certified optimality gap crosses it, the engine reconciles inline
+    instead of serving the drifted plan. Unset = cadence-only
+    reconciliation."""
+    raw = os.environ.get("PROTOCOL_TPU_STREAM_GAP_CEILING", "").strip()
+    return float(raw) if raw else None
 
 
 class _SolveOut(NamedTuple):
@@ -1139,6 +1150,19 @@ class SchedulerBackendServicer:
                 # crash before the first delta must restore the session
                 # (flush-before-ack, same as every delta tick)
                 session.last_p4t = np.asarray(p4t, np.int32)
+                if req.stream_mode:
+                    # streaming session: bind the online engine to the
+                    # just-primed arena — event-typed deltas route
+                    # through per-event localized repair from here on
+                    from protocol_tpu.stream.engine import StreamEngine
+
+                    session.stream = StreamEngine(
+                        session.arena, weights,
+                        reconcile_every=(
+                            int(req.reconcile_every) or 256
+                        ),
+                        gap_ceiling=_stream_gap_ceiling(),
+                    )
                 if self.ckpt is not None:
                     self.ckpt.flush_locked(session)
         # post-flush fence re-check (same freeze-window argument as the
@@ -1378,58 +1402,144 @@ class SchedulerBackendServicer:
             # client's retry DOUBLE-APPLY this tick — the exact bug the
             # retransmit protocol exists to refuse
             self._check_deadline(context, "delta")
-            try:
-                session.apply_delta(prow, p_delta, trow, r_delta)
-            except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            # ---- graceful degradation: the per-tick solve watchdog.
-            # When the tick's deadline budget is already burned (lock
-            # wait + decode + the EWMA of recent solve walls would
-            # overrun it), serve the PREVIOUS plan with an explicit
-            # stale flag instead of starting a solve whose answer will
-            # arrive too late to act on. The delta was still APPLIED —
-            # columns stay client-consistent — and the streak is
-            # hard-bounded by ``max_stale_ticks``: past it the solve
-            # runs regardless, so staleness is a contract, never an
-            # escape hatch. (The native solve is uninterruptible C++;
-            # the watchdog is predictive, which is the only honest kind
-            # here.)
-            deadline_ms = self.fleet_config.tick_deadline_ms
-            stale = (
-                deadline_ms is not None
-                and session.last_p4t is not None
-                and session.stale_streak
-                < self.fleet_config.max_stale_ticks
-                and (time.perf_counter() - t0) * 1e3
-                + session.solve_ewma_ms > deadline_ms
-            )
-            if stale:
-                session.stale_streak += 1
-                staleness = session.stale_streak
+            is_event = bool(request.event_source)
+            ev_deduped = ev_reconciled = False
+            ev_gap = 0.0
+            ev_window = 0
+            if is_event and session.stream is None:
+                # event-typed deltas need the stream engine the session
+                # opted into at open; a batch session refuses with a
+                # ladder-recognizable capability marker (the client
+                # re-opens with stream_mode or stays on batch ticks)
+                self.seam.count("stream_refused")
+                return pb.AssignDeltaResponse(
+                    session_ok=False,
+                    error="not stream-servable (session opened without "
+                          "stream_mode)",
+                )
+            if is_event and session.stream.stale_event(
+                request.event_source, int(request.event_seq)
+            ):
+                # idempotence under chaos: a duplicated or reordered
+                # (superseded) event is ACKED without applying — the
+                # columns, arena, and plan are exactly as if it never
+                # arrived, and the per-source high-water mark makes a
+                # double-apply impossible by construction. The tick
+                # cursor still advances (the wire stream consumed a
+                # tick), which is what keeps the client's lockstep
+                # cursor and the dedup CRC consistent.
+                ev_deduped = True
+                staleness = 0
                 p4t_out = np.array(session.last_p4t, np.int32)
+                ev_window = session.stream.events_since_reconcile
+                # the served plan's HONEST certificate: the engine's
+                # last computed bound, never a false 0.0 "optimal"
+                ev_gap = float(session.stream.gap_last)
                 price = None
                 arena_stats = {
-                    "cold": False,  # served from carried state: a
-                    # stale tick must not read as a cold solve in obs
-                    "stale": True, "stale_streak": staleness,
+                    "cold": False, "event": True, "deduped": True,
                     "assigned": int((p4t_out >= 0).sum()),
                 }
-                self.seam.count("stale_served")
             else:
-                # (the deadline was already honored before apply_delta;
-                # re-checking here would abort AFTER state moved)
+                try:
+                    session.apply_delta(
+                        prow, p_delta, trow, r_delta,
+                        events=(
+                            [{
+                                "kind": request.event_kind or "event",
+                                "source": request.event_source,
+                                "seq": int(request.event_seq),
+                            }]
+                            if is_event else None
+                        ),
+                    )
+                except ValueError as e:
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT, str(e)
+                    )
+            if is_event and not ev_deduped:
+                from protocol_tpu.stream.events import StreamEvent
+
+                res = session.stream.apply(StreamEvent(
+                    kind=request.event_kind or "event",
+                    source=request.event_source,
+                    seq=int(request.event_seq),
+                    provider_rows=prow,
+                    p_cols=p_delta,
+                    task_rows=trow,
+                    r_cols=r_delta,
+                ))
                 staleness = 0
-                t_s0 = time.perf_counter()
-                p4t_out, t4p, price = session.solve()
-                solve_ms = (time.perf_counter() - t_s0) * 1e3
-                # EWMA of solve walls feeds the watchdog's prediction
-                session.solve_ewma_ms = (
-                    solve_ms if session.solve_ewma_ms == 0.0
-                    else 0.5 * session.solve_ewma_ms + 0.5 * solve_ms
-                )
-                session.stale_streak = 0
+                p4t_out = np.asarray(res.plan, np.int32)[
+                    : session.n_tasks
+                ]
+                price = None
+                ev_reconciled = res.reconciled
+                ev_gap = float(res.gap_per_task)
+                ev_window = res.events_since_reconcile
                 arena_stats = dict(session.arena.last_stats)
-                del t4p  # derivable client-side; stays server-side
+                arena_stats["stream_divergence_rows"] = (
+                    res.divergence_rows
+                )
+                arena_stats["stream_repair_rows"] = res.repair_rows
+                arena_stats["gap_per_task"] = ev_gap
+                if res.stale:
+                    # starved reconcile past the bound: flagged +
+                    # counted, same contract as the tick watchdog
+                    arena_stats["stale"] = True
+                    arena_stats["stale_streak"] = (
+                        res.events_since_reconcile
+                        - session.stream.max_stale_events
+                    )
+            if not is_event:
+                # ---- graceful degradation: the per-tick solve watchdog.
+                # When the tick's deadline budget is already burned (lock
+                # wait + decode + the EWMA of recent solve walls would
+                # overrun it), serve the PREVIOUS plan with an explicit
+                # stale flag instead of starting a solve whose answer will
+                # arrive too late to act on. The delta was still APPLIED —
+                # columns stay client-consistent — and the streak is
+                # hard-bounded by ``max_stale_ticks``: past it the solve
+                # runs regardless, so staleness is a contract, never an
+                # escape hatch. (The native solve is uninterruptible C++;
+                # the watchdog is predictive, which is the only honest kind
+                # here.)
+                deadline_ms = self.fleet_config.tick_deadline_ms
+                stale = (
+                    deadline_ms is not None
+                    and session.last_p4t is not None
+                    and session.stale_streak
+                    < self.fleet_config.max_stale_ticks
+                    and (time.perf_counter() - t0) * 1e3
+                    + session.solve_ewma_ms > deadline_ms
+                )
+                if stale:
+                    session.stale_streak += 1
+                    staleness = session.stale_streak
+                    p4t_out = np.array(session.last_p4t, np.int32)
+                    price = None
+                    arena_stats = {
+                        "cold": False,  # served from carried state: a
+                        # stale tick must not read as a cold solve in obs
+                        "stale": True, "stale_streak": staleness,
+                        "assigned": int((p4t_out >= 0).sum()),
+                    }
+                    self.seam.count("stale_served")
+                else:
+                    # (the deadline was already honored before apply_delta;
+                    # re-checking here would abort AFTER state moved)
+                    staleness = 0
+                    t_s0 = time.perf_counter()
+                    p4t_out, t4p, price = session.solve()
+                    solve_ms = (time.perf_counter() - t_s0) * 1e3
+                    # EWMA of solve walls feeds the watchdog's prediction
+                    session.solve_ewma_ms = (
+                        solve_ms if session.solve_ewma_ms == 0.0
+                        else 0.5 * session.solve_ewma_ms + 0.5 * solve_ms
+                    )
+                    session.stale_streak = 0
+                    arena_stats = dict(session.arena.last_stats)
+                    del t4p  # derivable client-side; stays server-side
             session.tick += 1
             tick_no = session.tick  # this delta's wire tick, for the
             # post-lock obs/event hooks (== int(request.tick), checked
@@ -1510,6 +1620,22 @@ class SchedulerBackendServicer:
             delta_rows=int(prow.size + trow.size),
             trace_tick=tick_no,
         )
+        if is_event and obs_pkg.enabled():
+            # per-event stream metrics ride NEXT TO the tick roll-up:
+            # event latency (µs-scale HDR), dedup/reconcile counters,
+            # divergence + repair scope
+            self.obs.observe_event(
+                session.session_id,
+                (time.perf_counter() - t0) * 1e3,
+                deduped=ev_deduped,
+                reconciled=ev_reconciled,
+                divergence_rows=int(
+                    arena_stats.get("stream_divergence_rows", 0)
+                ),
+                repair_rows=int(
+                    arena_stats.get("stream_repair_rows", 0)
+                ),
+            )
         del price  # session state: stays server-side
         # SLIM response: p4t only. task_for_provider is derivable from it
         # (the client scatters), and prices/retirement are session state —
@@ -1526,6 +1652,11 @@ class SchedulerBackendServicer:
                 decode_ms=(t_dec - t0) * 1e3,
             ),
         )
+        if is_event:
+            resp.event_deduped = ev_deduped
+            resp.reconciled = ev_reconciled
+            resp.gap_per_task = ev_gap
+            resp.events_since_reconcile = ev_window
         self.seam.add_bytes("out", resp.ByteSize())
         return resp
 

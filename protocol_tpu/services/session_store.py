@@ -215,6 +215,13 @@ class SolveSession:
     # once, and the "no tick lost or double-applied" gate rests on this.
     last_delta_crc: int = 0
     last_p4t: object = None  # np.ndarray [n_tasks] i32 after any solve
+    # streaming surface (protocol_tpu/stream/): a session opened with
+    # stream_mode binds a StreamEngine to its arena — event-typed
+    # deltas route through per-event localized repair instead of a full
+    # warm solve, with periodic full-solve reconciliation. None = batch
+    # session (event-typed deltas are refused "not stream-servable").
+    # Mutated only under ``lock``.
+    stream: object = None
     # ---- graceful degradation (bounded staleness). When a tick's
     # deadline budget is already burned (lock wait + decode + the EWMA
     # of recent solve walls would overrun it), the servicer serves the
@@ -276,11 +283,17 @@ class SolveSession:
         p_delta: dict[str, np.ndarray],
         task_rows: np.ndarray,
         r_delta: dict[str, np.ndarray],
+        events: Optional[list] = None,
     ) -> int:
         """Write churned rows into the session columns, copy-on-write per
         column. Returns the number of rows actually applied. Row indices
         are validated against the REAL row space — padding rows are the
-        server's own invention and never addressable from the wire."""
+        server's own invention and never addressable from the wire.
+        ``events`` is the stream meta ([{kind, source, seq}]) an
+        event-typed delta carries — recorded into the flight-recorder
+        DELTA frame so a captured stream session replays as a stream
+        trace (event_from_delta finds its meta), never as a plain
+        batch trace."""
         groups = (
             (provider_rows, p_delta, self.p_cols, self.n_providers,
              P_WIRE_DTYPES),
@@ -331,6 +344,7 @@ class SolveSession:
             _trace_safe(
                 self.trace.record_session_delta, self.session_id,
                 self.tick + 1, provider_rows, p_delta, task_rows, r_delta,
+                events,
             )
         return applied
 

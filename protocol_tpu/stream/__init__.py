@@ -1,0 +1,35 @@
+"""Event-driven streaming assignment: sub-tick online repair with
+certified bounded divergence.
+
+The batch seam answers a churned marketplace once per tick; this
+package answers each churn EVENT the moment it arrives — heartbeat,
+join/leave, requirement churn — by localized repair over the warm
+arena (O(churned rows) per event, never a full-matrix candidate pass),
+with an incrementally-maintained certified optimality gap bounding how
+far the streamed plan can drift from the batch plan, and a periodic
+full-solve reconciliation that is bit-identical to a batch replay of
+the same event trace. See ARCHITECTURE.md "Streaming assignment".
+"""
+
+from protocol_tpu.stream.engine import StreamEngine, StreamResult
+from protocol_tpu.stream.events import (
+    EVENT_KINDS,
+    SourceDedup,
+    StreamEvent,
+    coalesce,
+    event_from_delta,
+)
+from protocol_tpu.stream.quality import GapTracker
+from protocol_tpu.stream.replay import batch_shadow_replay, stream_replay
+
+__all__ = [
+    "EVENT_KINDS",
+    "GapTracker",
+    "SourceDedup",
+    "StreamEngine",
+    "StreamEvent",
+    "batch_shadow_replay",
+    "coalesce",
+    "event_from_delta",
+    "stream_replay",
+]

@@ -1,0 +1,289 @@
+"""The online assignment engine: per-event localized repair over a warm
+arena, with certified bounded divergence and periodic reconciliation.
+
+One :class:`StreamEngine` binds to a PRIMED :class:`NativeSolveArena`
+(a batch ``solve`` ran at least once, so the persistent candidate
+structure and duals exist) and turns churn events into sub-tick plan
+updates:
+
+  apply(event)   dedup by (source, seq) -> arena.apply_rows (dirty-row
+                 candidate repair + one masked fine-eps warm engine
+                 pass, O(churned rows)) -> incremental certified-gap
+                 refresh -> divergence count vs the last reconciled
+                 plan. Zero full-matrix candidate passes, ever.
+  reconcile()    arena.reconcile(): a full batch solve over the
+                 (repaired-exact) structure from scratch duals —
+                 bit-identical to a cold batch solve on the current
+                 columns — then an exact gap rebase and a divergence/
+                 staleness counter reset.
+
+Reconciliation runs automatically every ``reconcile_every`` events or
+when the certified gap breaches ``gap_ceiling`` (the quality trigger).
+The bounded-staleness watchdog mirrors the PR 9 contract: if reconcile
+is starved past ``max_stale_events`` (auto-reconcile off, or the due
+flag ignored by the driver), every further streamed answer is flagged
+AND counted stale — staleness is a contract, never silent drift.
+
+Concurrency: ``apply``/``reconcile`` serialize on one "stream"-domain
+lock (rank between the session lock and every leaf it uses), so the
+wire servicer (already under the session lock) and a standalone
+multi-threaded driver both get the same linearized event order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.obs.quality import duality_gap
+from protocol_tpu.obs.spans import TRACER as _tracer
+from protocol_tpu.stream.events import SourceDedup, StreamEvent, coalesce
+from protocol_tpu.stream.quality import GapTracker
+from protocol_tpu.utils.lockwitness import make_lock
+
+
+@dataclasses.dataclass
+class StreamResult:
+    """One apply's answer: the live streamed plan + its certificates."""
+
+    plan: np.ndarray  # provider_for_task [T] i32 (arena row space)
+    deduped: bool = False
+    reconciled: bool = False
+    stale: bool = False
+    events_since_reconcile: int = 0
+    divergence_rows: int = 0
+    gap_per_task: float = 0.0
+    apply_ms: float = 0.0
+    repair_rows: int = 0
+    stats: dict = dataclasses.field(default_factory=dict)
+
+
+class StreamEngine:
+    def __init__(
+        self,
+        arena,
+        weights,
+        reconcile_every: int = 256,
+        gap_ceiling: Optional[float] = None,
+        max_stale_events: int = 4096,
+        auto_reconcile: bool = True,
+        event_eps_start: Optional[float] = None,
+    ):
+        if arena._p4t is None:
+            raise RuntimeError(
+                "StreamEngine needs a primed arena (run a batch solve "
+                "first — the cold solve IS event tick 0)"
+            )
+        self.arena = arena
+        self.weights = weights
+        self.reconcile_every = int(reconcile_every)
+        self.gap_ceiling = gap_ceiling
+        self.max_stale_events = int(max_stale_events)
+        self.auto_reconcile = auto_reconcile
+        self.event_eps_start = event_eps_start
+        self._lock = make_lock("stream")
+        self.dedup = SourceDedup()
+        self._gap = GapTracker()
+        # divergence is measured against the last reconciled plan: the
+        # streamed path's drift since the last full solve
+        self._recon_p4t = np.asarray(arena._p4t, np.int32).copy()
+        self.events_since_reconcile = 0
+        self.reconcile_due = False
+        self.due_reason = ""
+        # counters (obs plane reads these; never fed back into solves)
+        self.events_applied = 0
+        self.events_stale = 0
+        self.reconciles = 0
+        self.divergence_max = 0
+        # observed peak (pre-reconcile breaches included) vs the peak
+        # the engine actually ANSWERED with — a ceiling breach reconciles
+        # inline and serves the fresh plan, so the served-gap contract
+        # is the gate's floor while the observed peak is the alert
+        self.gap_max = 0.0
+        self.gap_served_max = 0.0
+        self._last_recon_gap = self._rebase_gap()
+        # the live plan's most recent certificate — what a deduped ack
+        # honestly reports for the plan it serves
+        self.gap_last = float(
+            self._last_recon_gap.get("gap_per_task", 0.0)
+        )
+
+    # ---------------- internals ----------------
+
+    def _rebase_gap(self) -> dict:
+        a = self.arena
+        return self._gap.rebase(a._cand_p, a._cand_c, a._p4t, a._price)
+
+    def _gap_after_event(self, repair_mask) -> dict:
+        a = self.arena
+        if a.engine == "sinkhorn":
+            # referee prices are re-derived per solve (not monotone), so
+            # the incremental upper-bound argument does not hold: run
+            # the exact O(T*K) scan — proportionate next to the per-
+            # event O(nnz) potential iterations this engine already pays
+            return duality_gap(a._cand_p, a._cand_c, a._p4t, a._price)
+        return self._gap.update(
+            a._cand_p, a._cand_c, a._p4t, a._price, repair_mask
+        )
+
+    def stale_event(self, source: str, seq: int) -> bool:
+        """Peek-only dedup check (the wire path decides whether to apply
+        the session columns BEFORE committing anything)."""
+        with self._lock:
+            return self.dedup.stale(source, seq)
+
+    # ---------------- the hot path ----------------
+
+    def apply(self, event: StreamEvent) -> StreamResult:
+        """Apply one event to the live plan. O(churned rows); never a
+        full-matrix candidate pass. A duplicate/superseded (source, seq)
+        is dropped — counted, current plan answered, state untouched."""
+        with self._lock:
+            return self._apply_locked(event)
+
+    def apply_burst(self, events: list) -> StreamResult:
+        """The coalescing window's flush: dedup-filter the burst, merge
+        survivors into ONE synthetic event (latest-wins per row — exact
+        for full-state events), and apply it as a single repair pass.
+        Arrival order inside the burst is preserved by the merge."""
+        with self._lock:
+            fresh = [
+                ev for ev in events
+                if self.dedup.admit(ev.source, ev.seq)
+            ]
+            merged = coalesce(fresh)
+            if merged is None:
+                return self._result(
+                    self.arena._p4t.copy(), deduped=True, apply_ms=0.0
+                )
+            return self._apply_locked(merged, deduped_checked=True)
+
+    def _apply_locked(
+        self, event: StreamEvent, deduped_checked: bool = False
+    ) -> StreamResult:
+        t0 = time.perf_counter()
+        if not deduped_checked and not self.dedup.admit(
+            event.source, event.seq
+        ):
+            return self._result(
+                self.arena._p4t.copy(),
+                deduped=True,
+                apply_ms=(time.perf_counter() - t0) * 1e3,
+            )
+        plan = self.arena.apply_rows(
+            event.provider_rows, event.p_cols or None,
+            event.task_rows, event.r_cols or None,
+            self.weights,
+            event_eps_start=self.event_eps_start,
+        )
+        stats = self.arena.last_stats
+        # the repair mask (rows whose candidate content moved) is a gap
+        # soundness input: a repaired-cheaper candidate lowers a row's
+        # `best`, which RAISES its slack — those rows must recompute
+        gap = self._gap_after_event(self.arena.last_repair_mask)
+        self.events_applied += 1
+        self.events_since_reconcile += 1
+        gpt = float(gap.get("gap_per_task", 0.0))
+        self.gap_max = max(self.gap_max, gpt)
+        divergence = int((plan != self._recon_p4t).sum())
+        self.divergence_max = max(self.divergence_max, divergence)
+        if self.events_since_reconcile >= self.reconcile_every:
+            self.reconcile_due, self.due_reason = True, "cadence"
+        if self.gap_ceiling is not None and gpt > self.gap_ceiling:
+            self.reconcile_due, self.due_reason = True, "gap"
+        stale = False
+        reconciled = False
+        if self.reconcile_due and self.auto_reconcile:
+            plan = self._reconcile_locked()
+            reconciled = True
+            divergence = 0
+            gpt = float(self._last_recon_gap.get("gap_per_task", 0.0))
+        if not reconciled and (
+            self.events_since_reconcile > self.max_stale_events
+        ):
+            # the watchdog: reconcile starved past the bound — the
+            # answer is still served (the delta was applied; columns
+            # stay consistent) but flagged and counted, the PR 9
+            # bounded-staleness shape
+            stale = True
+            self.events_stale += 1
+        self.gap_served_max = max(self.gap_served_max, gpt)
+        self.gap_last = gpt
+        apply_ms = (time.perf_counter() - t0) * 1e3
+        _tracer.point(
+            "stream.event", kind=event.kind, rows=event.n_rows,
+            reconciled=reconciled,
+        )
+        # COPY at the boundary: apply_rows/reconcile return the live
+        # arena array, which the NEXT event mutates in place (dirty
+        # re-seats write -1 rows) — a caller retaining the plan (the
+        # servicer's retransmit cache above all) must never see it
+        # change under them
+        return self._result(
+            plan.copy(), reconciled=reconciled, stale=stale,
+            divergence_rows=divergence, gap_per_task=gpt,
+            apply_ms=apply_ms,
+            repair_rows=int(stats.get("repair_rows", 0)),
+            stats=stats,
+        )
+
+    def _result(self, plan, **kw) -> StreamResult:
+        return StreamResult(
+            plan=plan,
+            events_since_reconcile=self.events_since_reconcile,
+            **kw,
+        )
+
+    # ---------------- reconciliation ----------------
+
+    def reconcile(self) -> StreamResult:
+        """Run the full batch solve now (drivers with auto_reconcile off
+        call this on their own cadence)."""
+        with self._lock:
+            t0 = time.perf_counter()
+            plan = self._reconcile_locked()
+            return self._result(
+                plan.copy(), reconciled=True,
+                gap_per_task=float(
+                    self._last_recon_gap.get("gap_per_task", 0.0)
+                ),
+                apply_ms=(time.perf_counter() - t0) * 1e3,
+                stats=self.arena.last_stats,
+            )
+
+    def _reconcile_locked(self) -> np.ndarray:
+        plan = self.arena.reconcile()
+        self._recon_p4t = np.asarray(plan, np.int32).copy()
+        self._last_recon_gap = self._rebase_gap()
+        self.gap_max = max(
+            self.gap_max,
+            float(self._last_recon_gap.get("gap_per_task", 0.0)),
+        )
+        self.reconciles += 1
+        self.events_since_reconcile = 0
+        self.reconcile_due = False
+        self.due_reason = ""
+        self.gap_last = float(
+            self._last_recon_gap.get("gap_per_task", 0.0)
+        )
+        return plan
+
+    # ---------------- observability ----------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "events_applied": self.events_applied,
+                "events_deduped": self.dedup.deduped,
+                "events_stale": self.events_stale,
+                "events_since_reconcile": self.events_since_reconcile,
+                "reconciles": self.reconciles,
+                "reconcile_due": self.reconcile_due,
+                "due_reason": self.due_reason,
+                "divergence_max": self.divergence_max,
+                "gap_max": round(self.gap_max, 6),
+                "gap_served_max": round(self.gap_served_max, 6),
+            }

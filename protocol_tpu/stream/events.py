@@ -1,0 +1,182 @@
+"""Event taxonomy + idempotence machinery for the streaming engine.
+
+The reference orchestrator mutates pool allocation the moment a
+heartbeat, invite, or ejection arrives; our event vocabulary mirrors
+that control plane:
+
+  ``heartbeat``   price/load drift on a live provider row (the
+                  per-heartbeat common case)
+  ``join``        a provider row flips valid=True (fresh features)
+  ``leave``       a provider row flips valid=False (disconnect/ejection)
+  ``task``        a task row's requirement churns (submit/update)
+  ``mass``        a multi-row burst (regional outage / reconnect wave) —
+                  outside the per-source supersession contract, see below
+
+An event names its churned rows EXPLICITLY and carries the FULL current
+row state for them (the wire-delta shape, never an increment). That
+full-state contract is what makes chaos cheap to survive:
+
+  * every event carries a ``(source, seq)`` pair with ``seq`` strictly
+    monotonic per source (one source = one provider node or one task
+    submitter, always churning the same row set);
+  * a DUPLICATED event re-arrives with a seq the engine already
+    committed -> dropped (counted, never double-applied);
+  * a REORDERED event arrives with a seq below the source's high-water
+    mark -> it was superseded by the newer full-state event that
+    overtook it -> dropped, and the columns still converge to exactly
+    the in-order outcome ("latest-wins" is exact for full-state rows).
+
+``mass`` events may overlap other sources' rows, so supersession does
+not hold across sources for them — the synth factory only emits them
+into latency workloads, never chaos'd idempotence drills.
+
+Determinism contract: no clocks, no randomness (the determinism lint
+covers this package); arrival timestamps are workload DATA (``at_us``
+from the seeded synth factory), never read from a wall clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+EVENT_KINDS = ("heartbeat", "join", "leave", "task", "mass")
+
+
+@dataclasses.dataclass
+class StreamEvent:
+    """One churn event: explicit rows + full-state values for them.
+
+    ``p_cols``/``r_cols`` are column dicts with one value per row index
+    (trace/wire dtypes); either side may be empty. ``at_us`` is the
+    scheduled arrival offset of the open-loop workload (data, not a
+    clock read)."""
+
+    kind: str
+    source: str
+    seq: int
+    provider_rows: np.ndarray
+    p_cols: dict
+    task_rows: np.ndarray
+    r_cols: dict
+    at_us: int = 0
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.provider_rows.size + self.task_rows.size)
+
+    def meta(self) -> dict:
+        """The JSON side-channel a trace DELTA frame carries."""
+        return {
+            "kind": self.kind,
+            "source": self.source,
+            "seq": int(self.seq),
+            "at_us": int(self.at_us),
+            "rows": self.n_rows,
+        }
+
+
+def event_from_delta(delta) -> Optional[StreamEvent]:
+    """Rebuild a :class:`StreamEvent` from a trace ``DeltaRecord`` whose
+    events list carries a stream-event meta dict (the synth factory's
+    one-event-per-frame layout). None when the frame carries no stream
+    meta (a plain batch-trace delta)."""
+    meta = next(
+        (e for e in (delta.events or []) if "source" in e and "seq" in e),
+        None,
+    )
+    if meta is None:
+        return None
+    return StreamEvent(
+        kind=str(meta.get("kind", "heartbeat")),
+        source=str(meta["source"]),
+        seq=int(meta["seq"]),
+        provider_rows=delta.provider_rows,
+        p_cols=delta.p_cols,
+        task_rows=delta.task_rows,
+        r_cols=delta.r_cols,
+        at_us=int(meta.get("at_us", 0)),
+    )
+
+
+class SourceDedup:
+    """Per-source monotonic high-water marks: the never-double-apply
+    half of the idempotence contract. ``admit`` commits; ``stale`` only
+    peeks (the wire path decides before touching any state).
+
+    The map is LRU-bounded: sources are churn-emitter ids (one per
+    provider/task row at worst), and an unbounded dict would grow one
+    entry per id ever seen — the same client-minted-key argument as
+    ObsRegistry's session cap."""
+
+    def __init__(self, max_sources: int = 1 << 20):
+        from collections import OrderedDict
+
+        self.max_sources = int(max_sources)
+        self._seq: "OrderedDict[str, int]" = OrderedDict()
+        self.deduped = 0
+
+    def stale(self, source: str, seq: int) -> bool:
+        last = self._seq.get(source)
+        return last is not None and int(seq) <= last
+
+    def admit(self, source: str, seq: int) -> bool:
+        """True = fresh (committed as the new high-water mark); False =
+        duplicate/superseded (counted, caller must not apply)."""
+        if self.stale(source, seq):
+            self.deduped += 1
+            return False
+        self._seq[source] = int(seq)
+        self._seq.move_to_end(source)
+        while len(self._seq) > self.max_sources:
+            self._seq.popitem(last=False)
+        return True
+
+
+def coalesce(events: list) -> Optional[StreamEvent]:
+    """Merge a burst of pending events into ONE synthetic event — the
+    coalescing window's flush. Later events override earlier ones on
+    overlapping rows (list order IS arrival order; the caller already
+    dedup-filtered, so arrival order respects per-source seq order and
+    latest-wins is exact). Returns None for an empty burst; a single
+    event passes through untouched."""
+    if not events:
+        return None
+    if len(events) == 1:
+        return events[0]
+
+    def _merge(rows_name, cols_name):
+        # last-writer-wins per row: walk in arrival order, keep the
+        # final value each row saw
+        vals: dict[int, dict] = {}
+        for ev in events:
+            rows = getattr(ev, rows_name)
+            cols = getattr(ev, cols_name)
+            for i, r in enumerate(np.asarray(rows).tolist()):
+                vals[int(r)] = {n: a[i] for n, a in cols.items()}
+        if not vals:
+            return np.zeros(0, np.int32), {}
+        idx = sorted(vals)
+        names = list(vals[idx[0]])
+        out_rows = np.asarray(idx, np.int32)
+        out_cols = {
+            n: np.stack([np.asarray(vals[r][n]) for r in idx])
+            for n in names
+        }
+        return out_rows, out_cols
+
+    prow, p_cols = _merge("provider_rows", "p_cols")
+    trow, r_cols = _merge("task_rows", "r_cols")
+    last = events[-1]
+    return StreamEvent(
+        kind="coalesced",
+        source=last.source,
+        seq=last.seq,
+        provider_rows=prow,
+        p_cols=p_cols,
+        task_rows=trow,
+        r_cols=r_cols,
+        at_us=last.at_us,
+    )

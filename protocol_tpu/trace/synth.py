@@ -320,3 +320,189 @@ def synth_trace(
                 events=events,
             )
     return path
+
+
+# ---------------- event-trace factory (streaming workloads) ----------
+
+
+def synth_event_trace(
+    path: str,
+    n_providers: int = 1024,
+    n_tasks: int = 1024,
+    events: int = 256,
+    seed: int = 0,
+    kernel: str = "native-mt",
+    top_k: int = 64,
+    eps: float = 0.02,
+    max_iters: int = 0,
+    weights: tuple = DEFAULT_WEIGHTS,
+    rate_hz: float = 1000.0,
+    heartbeat_w: float = 0.7,
+    join_w: float = 0.1,
+    leave_w: float = 0.1,
+    task_w: float = 0.1,
+    headroom: float = 0.1,
+    mass_every: int = 0,
+    mass_frac: float = 0.1,
+    reconcile_every: int = 64,
+    compresslevel: int = 6,
+) -> str:
+    """Write a STREAM trace: one DELTA frame per churn event, each
+    carrying the full current row state for its rows plus the stream
+    meta ``{kind, source, seq, at_us}`` (protocol_tpu/stream/events.py
+    documents the taxonomy and the full-state supersession contract).
+
+    Event sources are the churn emitters themselves — provider node
+    ``p<row>`` or task submitter ``t<row>`` — with a strictly monotonic
+    per-source seq, so a chaos'd delivery (drop/dup/reorder) of this
+    trace converges through the dedup ladder. The arrival schedule is
+    OPEN-LOOP and deterministic: ``at_us`` offsets accumulate seeded
+    inter-arrival draws around ``1/rate_hz`` (no Poisson process, no
+    clock — the same (seed, knobs) always writes byte-identical files).
+
+    ``mass_every`` > 0 additionally injects a multi-row disconnect
+    burst every N events (source ``m<k>``) — a latency/pressure drill
+    that sits OUTSIDE the per-source supersession contract, so chaos'd
+    idempotence workloads keep it at 0 (the default).
+    """
+    from protocol_tpu.proto import scheduler_pb2 as pb
+    from protocol_tpu.proto import wire
+    from protocol_tpu.trace import format as tfmt
+
+    rng = np.random.default_rng(seed)
+    ep = synth_providers(rng, n_providers)
+    er = synth_requirements(rng, n_tasks)
+    p_cols = wire.canon_columns(ep, tfmt.P_TRACE_DTYPES)
+    r_cols = wire.canon_columns(er, tfmt.R_TRACE_DTYPES)
+    n_off = int(n_providers * headroom)
+    if n_off:
+        valid = p_cols["valid"].copy()
+        valid[rng.choice(n_providers, n_off, replace=False)] = False
+        p_cols["valid"] = valid
+
+    wns = _W(weights)
+    fp = wire.epoch_fingerprint(
+        p_cols, r_cols, wns, kernel, top_k, eps, max_iters
+    )
+    req = pb.AssignRequestV2(
+        providers=wire.encode_providers_v2(tfmt._as_ns(p_cols)),
+        requirements=wire.encode_requirements_v2(tfmt._as_ns(r_cols)),
+        weights=pb.CostWeights(
+            price=wns.price, load=wns.load,
+            proximity=wns.proximity, priority=wns.priority,
+        ),
+        kernel=kernel, top_k=top_k, eps=eps, max_iters=max_iters,
+    )
+    meta = {
+        "generator": "synth_event_trace",
+        "stream": True,
+        "seed": seed,
+        "n_providers": n_providers,
+        "n_tasks": n_tasks,
+        "events": events,
+        "rate_hz": rate_hz,
+        "headroom": headroom,
+        "mass_every": mass_every,
+        "reconcile_every": reconcile_every,
+    }
+    kinds = ("heartbeat", "join", "leave", "task")
+    mix = np.asarray(
+        [heartbeat_w, join_w, leave_w, task_w], np.float64
+    )
+    mix = mix / mix.sum()
+    seqs: dict = {}
+
+    def _seq(source: str) -> int:
+        seqs[source] = seqs.get(source, -1) + 1
+        return seqs[source]
+
+    def _p_state(rows: np.ndarray) -> dict:
+        return {n: a[rows] for n, a in p_cols.items()}
+
+    def _r_state(rows: np.ndarray) -> dict:
+        return {n: a[rows] for n, a in r_cols.items()}
+
+    at_us = 0
+    empty = np.zeros(0, np.int32)
+    with tfmt.TraceWriter(path, meta=meta,
+                          compresslevel=compresslevel) as w:
+        w.write_snapshot(f"synth-ev-{seed}", fp, req)
+        for i in range(1, events + 1):
+            at_us += int(1e6 / rate_hz * (0.5 + rng.random()))
+            if mass_every and i % mass_every == 0:
+                live = np.flatnonzero(p_cols["valid"])
+                n_down = max(int(live.size * mass_frac), 1)
+                rows = np.sort(
+                    rng.choice(live, min(n_down, live.size), replace=False)
+                ).astype(np.int32)
+                valid = p_cols["valid"].copy()
+                valid[rows] = False
+                p_cols["valid"] = valid
+                src = f"m{i}"
+                ev_meta = {
+                    "kind": "mass", "source": src, "seq": _seq(src),
+                    "at_us": at_us, "rows": int(rows.size),
+                }
+                w.write_delta_cols(
+                    i, rows, _p_state(rows), empty, None,
+                    events=[ev_meta],
+                )
+                continue
+            kind = kinds[int(rng.choice(4, p=mix))]
+            live = np.flatnonzero(p_cols["valid"])
+            dark = np.flatnonzero(~p_cols["valid"])
+            # degrade gracefully when a kind has no eligible rows
+            if kind == "join" and dark.size == 0:
+                kind = "heartbeat"
+            if kind in ("heartbeat", "leave") and live.size == 0:
+                kind = "join" if dark.size else "task"
+            if kind == "task":
+                row = int(rng.integers(0, n_tasks))
+                fresh = wire.canon_columns(
+                    synth_requirements(rng, 1), tfmt.R_TRACE_DTYPES
+                )
+                for name in r_cols:
+                    col = r_cols[name].copy()
+                    col[row] = fresh[name][0]
+                    r_cols[name] = col
+                rows = np.asarray([row], np.int32)
+                src = f"t{row}"
+                w.write_delta_cols(
+                    i, empty, None, rows, _r_state(rows),
+                    events=[{
+                        "kind": kind, "source": src, "seq": _seq(src),
+                        "at_us": at_us, "rows": 1,
+                    }],
+                )
+                continue
+            if kind == "heartbeat":
+                row = int(rng.choice(live))
+                price = p_cols["price"].copy()
+                load = p_cols["load"].copy()
+                price[row] = np.float32(rng.uniform(0.5, 4.0))
+                load[row] = np.float32(rng.uniform(0, 1))
+                p_cols["price"], p_cols["load"] = price, load
+            elif kind == "join":
+                row = int(rng.choice(dark))
+                fresh = wire.canon_columns(
+                    synth_providers(rng, 1), tfmt.P_TRACE_DTYPES
+                )
+                for name in p_cols:
+                    col = p_cols[name].copy()
+                    col[row] = fresh[name][0]
+                    p_cols[name] = col
+            else:  # leave
+                row = int(rng.choice(live))
+                valid = p_cols["valid"].copy()
+                valid[row] = False
+                p_cols["valid"] = valid
+            rows = np.asarray([row], np.int32)
+            src = f"p{row}"
+            w.write_delta_cols(
+                i, rows, _p_state(rows), empty, None,
+                events=[{
+                    "kind": kind, "source": src, "seq": _seq(src),
+                    "at_us": at_us, "rows": 1,
+                }],
+            )
+    return path

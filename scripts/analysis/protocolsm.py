@@ -71,7 +71,11 @@ DECODE_FNS = {
     "assemble_snapshot", "decode_providers_v2", "decode_requirements_v2",
     "unblob",
 }
-MUTATION_FNS = {"apply_delta", "solve", "put"}
+MUTATION_FNS = {"apply_delta", "solve", "put", "apply", "apply_burst"}
+# "apply"/"apply_burst" are the STREAM engine's event mutations
+# (session.stream.apply routes an event-typed delta into the arena):
+# the deadline/decode-before-mutation rules cover the event surface
+# with the same teeth as the batch path
 DEADLINE_FNS = {"_check_deadline"}
 FLUSH_FNS = {"flush_locked"}
 CURSOR_ATTRS = {"tick"}

@@ -164,6 +164,13 @@ def build_file() -> dp.FileDescriptorProto:
         ("max_iters", 7, "uint32", False),
         ("warm_price", 8, "TensorBlob", False),
         ("seed_provider_for_task", 9, "TensorBlob", False),
+        # streaming sessions (appended — old servers skip them): a
+        # session opened with stream_mode accepts event-typed
+        # AssignDelta ticks (per-event localized repair instead of a
+        # full warm solve) and reconciles with a full batch solve every
+        # reconcile_every events (0 = server default)
+        ("stream_mode", 10, "bool", False),
+        ("reconcile_every", 11, "uint32", False),
     ])
     _msg(fd, "AssignResponseV2", [
         ("provider_for_task", 1, "TensorBlob", False),
@@ -198,6 +205,14 @@ def build_file() -> dp.FileDescriptorProto:
         ("providers", 5, "ProviderBatchV2", False),  # churned rows only
         ("task_rows", 6, "TensorBlob", False),
         ("requirements", 7, "RequirementBatchV2", False),
+        # event-typed delta rows (appended): a non-empty event_source
+        # marks this delta as ONE churn event — full current row state
+        # for its rows, with a per-source monotonic seq the server
+        # dedups on (duplicate/superseded events ack without applying).
+        # Only stream-mode sessions serve them.
+        ("event_source", 8, "string", False),
+        ("event_seq", 9, "uint64", False),
+        ("event_kind", 10, "string", False),
     ])
     _msg(fd, "AssignDeltaResponse", [
         ("session_ok", 1, "bool", False),
@@ -212,6 +227,16 @@ def build_file() -> dp.FileDescriptorProto:
         ("stale", 4, "bool", False),
         ("staleness_ticks", 5, "uint32", False),
         ("replayed", 6, "bool", False),
+        # streaming surface (appended): event_deduped=True acks a
+        # duplicate/superseded event WITHOUT applying it (idempotence);
+        # reconciled=True marks this answer as a fresh full-solve
+        # reconciliation; gap_per_task is the certified optimality-gap
+        # bound of the served plan; events_since_reconcile counts the
+        # streamed divergence window
+        ("event_deduped", 7, "bool", False),
+        ("reconciled", 8, "bool", False),
+        ("gap_per_task", 9, "float", False),
+        ("events_since_reconcile", 10, "uint32", False),
     ])
     _msg(fd, "MetricSample", [
         ("name", 1, "string", False),

@@ -83,6 +83,11 @@ SCOPES = (
     ),
     Scope("quality", suffixes=("protocol_tpu/obs/quality.py",)),
     Scope(
+        "stream",
+        prefixes=("protocol_tpu/stream/",),
+        fixture_prefix="stream_",
+    ),
+    Scope(
         "slo",
         suffixes=("protocol_tpu/obs/slo.py",),
         fixture_prefix="slo_",

@@ -1,0 +1,27 @@
+"""Seeded violations for the determinism rule over the streaming
+engine (shapes mirror protocol_tpu/stream/). An event engine that
+consults ``random`` or a wall clock for DECISIONS is unreplayable — a
+recorded event trace could not reproduce its plans bit-for-bit."""
+
+import random  # SEED: determinism
+import time
+
+
+class DriftingStream:
+    def __init__(self):
+        self.events = 0
+
+    def should_reconcile(self) -> bool:
+        # cadence from a wall clock: two replays of the same trace
+        # reconcile at different events
+        return (time.time() % 10.0) < 1.0  # SEED: determinism
+
+    def pick_coalesce_victim(self, pending: dict):
+        # randomized coalescing changes which event's values win
+        return random.choice(list(pending))  # SEED: determinism
+
+    def dirty_sources(self, sources):
+        order = []
+        for s in {x for x in sources}:  # SEED: determinism
+            order.append(s)
+        return order

@@ -1,0 +1,29 @@
+"""Clean twin of stream_determinism_bad.py: the cadence-counted,
+arrival-ordered spelling the stream engine actually uses — reconcile
+decisions from event COUNTS and certified gaps, coalescing by arrival
+order (latest-wins), timing only as stats next to results."""
+
+import time
+
+
+class CountedStream:
+    def __init__(self, reconcile_every: int):
+        self.reconcile_every = reconcile_every
+        self.events = 0
+
+    def should_reconcile(self) -> bool:
+        return self.events >= self.reconcile_every
+
+    def pick_coalesce_victim(self, pending: dict):
+        # dict order IS arrival order: the last writer per row wins
+        for key in pending:
+            last = key
+        return last
+
+    def dirty_sources(self, sources):
+        return sorted(set(sources))
+
+    def measure_apply(self):
+        # perf_counter for STATS is allowed in non-strict modules —
+        # walls ride next to plans, never into them
+        return time.perf_counter()

@@ -455,6 +455,223 @@ def cand_gate() -> int:
     return 0
 
 
+def stream_gate() -> int:
+    """Event-driven streaming gate (ISSUE 15). Three phases:
+
+    A — golden stream trace (artifacts/golden_stream_512x512.trace)
+        replayed event-by-event at threads {1, 2, 4}: every event's
+        plan bit-identical to the recording, ZERO full-matrix candidate
+        passes, and every reconciliation plan bit-identical to the
+        batch-shadow oracle (a fresh always-cold arena solving the
+        accumulated columns at the same boundaries). A ceiling-armed
+        replay asserts the certified-gap contract: every SERVED answer
+        within ``stream_gap_ceiling`` or a fresh inline reconcile.
+    B — the same trace under seeded drop/dup/reorder event chaos: the
+        dedup ladder must fire (duplicates/overtaken events acked, not
+        applied) and the FINAL reconciled plan must be bit-identical to
+        the fault-free replay's (convergence by construction).
+    C — 16k x 16k with 1% churn delivered as SINGLE heartbeat events:
+        p99 per-event apply+repair latency must beat the full warm
+        batch tick on the same host by ``stream_event_speedup_floor``
+        (floor committed conservatively below measured, per this file's
+        convention) and stay under ``stream_event_p99_ms_max``; zero
+        full-matrix passes between reconciles; the closing
+        reconciliation must restore >= ``stream_min_assigned_frac``."""
+    import dataclasses
+    import time as _time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu.faults.plan import ChaosConfig
+    from protocol_tpu.native.arena import NativeSolveArena
+    from protocol_tpu.ops.cost import CostWeights
+    from protocol_tpu.proto import wire
+    from protocol_tpu.stream.engine import StreamEngine
+    from protocol_tpu.stream.events import StreamEvent
+    from protocol_tpu.stream.replay import (
+        batch_shadow_replay,
+        stream_replay,
+    )
+    from protocol_tpu.trace import format as tfmt
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures = []
+    golden = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "golden_stream_512x512.trace",
+    )
+
+    # ---- phase A: replay identity + reconcile bit-identity + ceiling
+    base = None
+    for th in (1, 2, 4):
+        rep = stream_replay(golden, threads=th, keep_recon_p4ts=True)
+        if rep["divergence"] is not None:
+            failures.append(
+                f"stream replay diverged at threads={th}: "
+                f"{rep['divergence']}"
+            )
+            continue
+        if rep["cand_cold_passes"] != 0:
+            failures.append(
+                f"stream replay ran {rep['cand_cold_passes']} "
+                f"full-matrix candidate passes at threads={th} (want 0)"
+            )
+        shadow = batch_shadow_replay(
+            golden, rep["recon_ticks"], threads=th
+        )
+        pairs = list(zip(rep["recon_p4ts"], shadow["p4ts"]))
+        bad = [
+            i for i, (a, b) in enumerate(pairs)
+            if not np.array_equal(a, b)
+        ]
+        if bad or len(pairs) != len(rep["recon_ticks"]):
+            failures.append(
+                f"reconciliation not bit-identical to the batch shadow "
+                f"at threads={th} (windows {bad})"
+            )
+        if th == 1:
+            base = rep
+        print(
+            f"stream gate A: threads={th} events={rep['events']} "
+            f"reconciles={rep['reconciles']} bit-identical, shadow OK"
+        )
+    ceiling = floors["stream_gap_ceiling"]
+    ceil_rep = stream_replay(golden, gap_ceiling=ceiling, verify=False)
+    if ceil_rep["gap_served_max"] > ceiling + 1e-9:
+        failures.append(
+            f"ceiling-armed replay served gap "
+            f"{ceil_rep['gap_served_max']:.4f} above the "
+            f"{ceiling} ceiling (breach must reconcile inline)"
+        )
+    print(
+        f"stream gate A: ceiling {ceiling} armed -> "
+        f"{ceil_rep['reconciles']} reconciles, served gap max "
+        f"{ceil_rep['gap_served_max']:.4f}"
+    )
+
+    # ---- phase B: chaos'd event stream converges via the dedup ladder
+    chaos = ChaosConfig.from_spec("seed=5,drop=0.08,dup=0.08,reorder=0.08")
+    ch = stream_replay(
+        golden, chaos=chaos, verify=False, keep_recon_p4ts=True
+    )
+    if ch["deduped"] <= 0:
+        failures.append(
+            "chaos'd stream never hit the dedup ladder (dup/reorder "
+            "events must be acked without applying)"
+        )
+    if base is not None and not np.array_equal(
+        base["recon_p4ts"][-1], ch["recon_p4ts"][-1]
+    ):
+        failures.append(
+            "chaos'd event stream did NOT converge: final reconciled "
+            "plan differs from the fault-free replay"
+        )
+    print(
+        f"stream gate B: chaos drop/dup/reorder -> "
+        f"{ch['deduped']} deduped of {ch['events']} deliveries, final "
+        f"reconcile bit-identical {base is not None}"
+    )
+
+    # ---- phase C: 16k, 1% churn as single events vs the batch tick
+    w = CostWeights()
+    n = 16384
+    ep = bench.synth_providers(np.random.default_rng(2), n)
+    er = bench.synth_requirements(np.random.default_rng(3), n)
+
+    batch = NativeSolveArena(threads=1)
+    batch.solve(ep, er, w)
+    rng = np.random.default_rng(4)
+    cur = ep
+    batch_walls = []
+    for _ in range(3):
+        rows = rng.choice(n, n // 100, replace=False)
+        price = np.array(cur.price, copy=True)
+        load = np.array(cur.load, copy=True)
+        price[rows] = rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+        load[rows] = rng.uniform(0, 1, rows.size).astype(np.float32)
+        cur = dataclasses.replace(cur, price=price, load=load)
+        t0 = _time.perf_counter()
+        batch.solve(cur, er, w)
+        batch_walls.append((_time.perf_counter() - t0) * 1e3)
+    batch_ms = float(np.median(batch_walls))
+
+    # tight per-event bid budget: a saturated-pocket give-up war
+    # amortizes across events instead of landing on one event's p99
+    # (the unbudgeted war is exactly what the batch tick pays)
+    arena = NativeSolveArena(threads=1, event_max_bids=4096)
+    arena.solve(ep, er, w)
+    se = StreamEngine(arena, w, reconcile_every=10 ** 9)
+    p_cols = wire.canon_columns(ep, tfmt.P_TRACE_DTYPES)
+    rng = np.random.default_rng(4)
+    walls = []
+    cold_passes = 0
+    seqs: dict = {}
+    for _ in range(3):
+        rows = rng.choice(n, n // 100, replace=False)
+        newp = rng.uniform(0.5, 4.0, rows.size).astype(np.float32)
+        newl = rng.uniform(0, 1, rows.size).astype(np.float32)
+        p_cols["price"] = p_cols["price"].copy()
+        p_cols["load"] = p_cols["load"].copy()
+        p_cols["price"][rows] = newp
+        p_cols["load"][rows] = newl
+        for r in rows.tolist():
+            rr = np.asarray([r], np.int32)
+            seqs[r] = seqs.get(r, -1) + 1
+            ev = StreamEvent(
+                kind="heartbeat", source=f"p{r}", seq=seqs[r],
+                provider_rows=rr,
+                p_cols={nm: a[rr] for nm, a in p_cols.items()},
+                task_rows=np.zeros(0, np.int32), r_cols={},
+            )
+            t0 = _time.perf_counter()
+            res = se.apply(ev)
+            walls.append((_time.perf_counter() - t0) * 1e3)
+            cold_passes += int(res.stats.get("cand_cold_passes", 0))
+    walls_a = np.asarray(walls)
+    p50 = float(np.percentile(walls_a, 50))
+    p99 = float(np.percentile(walls_a, 99))
+    recon = se.reconcile()
+    frac = int((recon.plan >= 0).sum()) / n
+    ratio = batch_ms / max(p99, 1e-9)
+    print(
+        f"stream gate C: {walls_a.size} single events at 16k — p50 "
+        f"{p50:.2f}ms p99 {p99:.2f}ms vs warm batch tick "
+        f"{batch_ms:.0f}ms ({ratio:.1f}x, floor "
+        f"{floors['stream_event_speedup_floor']}x); cold passes "
+        f"{cold_passes}, post-reconcile assigned {frac:.4f}"
+    )
+    if cold_passes != 0:
+        failures.append(
+            f"{cold_passes} full-matrix candidate passes between "
+            "reconciles (want 0)"
+        )
+    if ratio < floors["stream_event_speedup_floor"]:
+        failures.append(
+            f"per-event p99 only {ratio:.1f}x below the warm batch "
+            f"tick (floor {floors['stream_event_speedup_floor']}x)"
+        )
+    if p99 > floors["stream_event_p99_ms_max"]:
+        failures.append(
+            f"per-event p99 {p99:.2f}ms above the "
+            f"{floors['stream_event_p99_ms_max']}ms ceiling"
+        )
+    if frac < floors["stream_min_assigned_frac"]:
+        failures.append(
+            f"post-reconcile assigned fraction {frac:.4f} below "
+            f"{floors['stream_min_assigned_frac']}"
+        )
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("stream perf gate OK")
+    return 0
+
+
 def paired_overhead(run, pairs: int = 9):
     """Robust A/B overhead estimate for a noisy wall: ``run(flag)``
     returns the chain wall with instrumentation on (True) / off
@@ -1301,8 +1518,11 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--dfleet", action="store_true")
     ap.add_argument("--cand", action="store_true")
+    ap.add_argument("--stream", action="store_true")
     args = ap.parse_args()
 
+    if args.stream:
+        return stream_gate()
     if args.cand:
         return cand_gate()
     if args.wire:

@@ -242,17 +242,37 @@ class TestRealModuleMutations:
         ).read_text()
         deadline = '            self._check_deadline(context, "delta")\n'
         apply_block = (
-            "            try:\n"
-            "                session.apply_delta(prow, p_delta, trow, "
-            "r_delta)\n"
-            "            except ValueError as e:\n"
-            "                context.abort(grpc.StatusCode."
-            "INVALID_ARGUMENT, str(e))\n"
+            "                try:\n"
+            "                    session.apply_delta(\n"
+            "                        prow, p_delta, trow, r_delta,\n"
+            "                        events=(\n"
+            "                            [{\n"
+            '                                "kind": '
+            'request.event_kind or "event",\n'
+            '                                "source": '
+            "request.event_source,\n"
+            '                                "seq": '
+            "int(request.event_seq),\n"
+            "                            }]\n"
+            "                            if is_event else None\n"
+            "                        ),\n"
+            "                    )\n"
+            "                except ValueError as e:\n"
+            "                    context.abort(\n"
+            "                        grpc.StatusCode.INVALID_ARGUMENT, "
+            "str(e)\n"
+            "                    )\n"
         )
         assert deadline in src and apply_block in src
         # the PR 9 mutation: deadline honored after the delta applied
-        mutated_src = src.replace(deadline + apply_block,
-                                  apply_block + deadline)
+        # (the stream-era handler routes events between the check and
+        # the apply, so the mutation MOVES the check past the apply
+        # rather than swapping adjacent lines)
+        mutated_src = src.replace(deadline, "").replace(
+            apply_block,
+            apply_block
+            + '                self._check_deadline(context, "delta")\n',
+        )
         assert mutated_src != src
         mutated = tmp_path / "scheduler_grpc_mutated.py"
         mutated.write_text(mutated_src)
