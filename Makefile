@@ -6,15 +6,19 @@ PY ?= python
 
 # Native engine codegen flags. -march=x86-64-v2 (not -march=native): the
 # .so must load on any CI/prod host, and sanitizer stacks want a stable
-# ISA. Override for tuned local builds: make native NATIVE_CFLAGS="-O3 -march=native"
+# ISA — the AVX2/AVX-512 kernels are compiled in via per-function target
+# attributes and selected at RUNTIME, so one baseline .so carries every
+# ISA. -ffp-contract=off: no silent a*b+c fusion — every fma is explicit,
+# one float pipeline per ISA (the determinism contract). Override for
+# tuned local builds: make native NATIVE_CFLAGS="-O3 -march=native -ffp-contract=off"
 # (protocol_tpu/native/__init__.py honors the same env var).
-NATIVE_CFLAGS ?= -O3 -march=x86-64-v2
+NATIVE_CFLAGS ?= -O3 -march=x86-64-v2 -ffp-contract=off
 NATIVE_BASE = -std=gnu++17 -pthread -shared -fPIC
 # sanitizer builds: -O1 -g keeps symbols/line numbers in reports and the
 # slowdown usable; separate .so names so they never clobber the prod build
-NATIVE_SAN_CFLAGS ?= -O1 -g -march=x86-64-v2
+NATIVE_SAN_CFLAGS ?= -O1 -g -march=x86-64-v2 -ffp-contract=off
 
-.PHONY: test test-fast native native-tsan native-asan sanitize devnet devnet-persistent bench bench-scaling clean lint
+.PHONY: test test-fast native native-tsan native-asan native-avx2 native-avx512 sanitize devnet devnet-persistent bench bench-scaling clean lint
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -35,6 +39,18 @@ native-tsan:
 
 native-asan:
 	g++ $(NATIVE_SAN_CFLAGS) -fsanitize=address,undefined -fno-sanitize-recover=all $(NATIVE_BASE) -o native/libassign_engine.asan.so native/assign_engine.cpp
+
+# ISA-default variants (selected at runtime via
+# PROTOCOL_TPU_NATIVE_ISA_VARIANT=avx2|avx512): identical codegen — every
+# .so carries all per-ISA kernels — but the baked DEFAULT dispatch differs,
+# for hosts where no env plumbing reaches the process. The runtime clamp
+# still falls back to what the CPU supports. PROTOCOL_TPU_NATIVE_ISA
+# overrides the baked default in any variant.
+native-avx2:
+	g++ $(NATIVE_CFLAGS) -DENGINE_DEFAULT_ISA=1 $(NATIVE_BASE) -o native/libassign_engine.avx2.so native/assign_engine.cpp
+
+native-avx512:
+	g++ $(NATIVE_CFLAGS) -DENGINE_DEFAULT_ISA=2 $(NATIVE_BASE) -o native/libassign_engine.avx512.so native/assign_engine.cpp
 
 # TSan stress gate over all three -mt kernels (threads 1/2/4/8, churned
 # warm-arena re-solves); add --sanitizer asan for the memory/UB pass
@@ -79,4 +95,5 @@ proto:
 
 clean:
 	rm -rf native/libassign_engine.so native/libassign_engine.tsan.so \
-	  native/libassign_engine.asan.so **/__pycache__ .pytest_cache
+	  native/libassign_engine.asan.so native/libassign_engine.avx2.so \
+	  native/libassign_engine.avx512.so **/__pycache__ .pytest_cache

@@ -34,14 +34,66 @@
 #include <thread>
 #include <vector>
 
-#if defined(__AVX512F__)
-#include <immintrin.h>
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>  // lint: isa-dispatch-include
+#define ENGINE_HAVE_X86 1
 #endif
 
 namespace {
 
 constexpr float kInfeasible = 1e9f;
 constexpr float kNeg = -1e18f;
+
+// ---- ISA runtime dispatch (the per-ISA determinism seam) -------------------
+//
+// One baseline .so (built -march=x86-64-v2, no AVX anywhere in common
+// code) carries scalar + AVX2 + AVX-512 kernels via GCC target
+// attributes; which pipeline runs is a RUNTIME choice, never a build
+// fact. The contract: results are bit-identical *within* an ISA across
+// thread counts and builds (the scalar pipeline is additionally
+// bit-identical across ISAs of the same request — it IS the referee).
+// scalar == the historical score_cell pipeline, so every committed
+// golden is the scalar-ISA golden. avx2/avx512 share ONE fmaf-matched
+// float pipeline (score_cell_fma below is provably lane-equal to both
+// vector kernels), so the two vector ISAs also agree bit-for-bit with
+// each other — only scalar-vs-vector differs, in ULPs of the proximity
+// term.
+constexpr int32_t kIsaScalar = 0;
+constexpr int32_t kIsaAvx2 = 1;
+constexpr int32_t kIsaAvx512 = 2;
+
+#ifndef ENGINE_DEFAULT_ISA
+#define ENGINE_DEFAULT_ISA 0
+#endif
+
+// best supported ISA <= want: the graceful-fallback primitive (a host
+// without AVX2 serves any request with scalar; "auto" is a request for
+// avx512 that clamps to whatever the host has)
+inline int32_t clamp_isa(int32_t want) {
+#if defined(ENGINE_HAVE_X86)
+  __builtin_cpu_init();
+  int32_t best = kIsaScalar;
+  if (want >= kIsaAvx2 && __builtin_cpu_supports("avx2") &&
+      __builtin_cpu_supports("fma")) {
+    best = kIsaAvx2;
+  }
+  if (want >= kIsaAvx512 && best == kIsaAvx2 &&
+      __builtin_cpu_supports("avx512f") &&
+      __builtin_cpu_supports("avx512dq") &&
+      __builtin_cpu_supports("avx512bw") &&
+      __builtin_cpu_supports("avx512vl")) {
+    best = kIsaAvx512;
+  }
+  return best;
+#else
+  (void)want;
+  return kIsaScalar;
+#endif
+}
+
+// relaxed atomic: solves snapshot the value once at entry; setting the
+// ISA concurrently with a running solve changes the NEXT solve only
+std::atomic<int32_t> g_isa{clamp_isa(ENGINE_DEFAULT_ISA)};
 
 inline float jitter(uint32_t p, uint32_t t) {
   // must match protocol_tpu/ops/sparse.py candidates_topk
@@ -197,6 +249,26 @@ void greedy_assign(const float* cost, int32_t P, int32_t T,
   }
 }
 
+// ---- ISA provenance ABI ----------------------------------------------------
+// isa codes: 0 = scalar, 1 = avx2, 2 = avx512 (the ctypes wrapper maps
+// names). engine_set_isa clamps to the best SUPPORTED isa <= the request
+// and returns the effective value — dispatch can never crash on a host
+// that lacks the ISA, it degrades (the graceful-fallback contract).
+int32_t engine_isa_supported(int32_t isa) {
+  if (isa < kIsaScalar || isa > kIsaAvx512) return 0;
+  return clamp_isa(isa) == isa ? 1 : 0;
+}
+
+int32_t engine_set_isa(int32_t isa) {
+  if (isa < kIsaScalar) isa = kIsaScalar;
+  if (isa > kIsaAvx512) isa = kIsaAvx512;
+  const int32_t eff = clamp_isa(isa);
+  g_isa.store(eff, std::memory_order_relaxed);
+  return eff;
+}
+
+int32_t engine_get_isa() { return g_isa.load(std::memory_order_relaxed); }
+
 namespace {
 
 // (cost, provider) lexicographic order packed into one u64: the f32 cost
@@ -221,22 +293,34 @@ inline float unpack_key_cost(uint64_t key) {
 // Insert key into the sorted length-k array buf, dropping the current max
 // (caller guarantees key < buf[k-1]). Position found branchlessly.
 inline void sorted_insert(uint64_t* buf, int32_t k, uint64_t key) {
-  int32_t pos = 0;
-#if defined(__AVX512F__)
-  const __m512i vk = _mm512_set1_epi64(static_cast<long long>(key));
-  int32_t g = 0;
-  for (; g + 8 <= k; g += 8) {
-    pos += __builtin_popcount(static_cast<uint32_t>(
-        _mm512_cmplt_epu64_mask(_mm512_loadu_si512(buf + g), vk)));
-  }
-  for (; g < k; ++g) pos += buf[g] < key;
-#else
-  pos = static_cast<int32_t>(std::lower_bound(buf, buf + k, key) - buf);
-#endif
+  const int32_t pos =
+      static_cast<int32_t>(std::lower_bound(buf, buf + k, key) - buf);
   std::memmove(buf + pos + 1, buf + pos,
                static_cast<size_t>(k - 1 - pos) * 8);
   buf[pos] = key;
 }
+
+// Forward declarations for the runtime-dispatched lane helpers; the
+// definitions (the only code in this file allowed to touch intrinsics)
+// live in the PER-ISA KERNELS section below. The comparison helpers are
+// value-only (no float arithmetic), so using them at any ISA can never
+// change result bits — they gate which cells take the slow path, and
+// the slow path re-checks exactly.
+#if defined(ENGINE_HAVE_X86)
+__attribute__((target("avx2"))) uint32_t lanes_le_arr_avx2(
+    const float* v, const float* bound);
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl"))) uint32_t
+lanes_le_arr_avx512(const float* v, const float* bound);
+__attribute__((target("avx2"))) uint32_t lanes_le_bcast_avx2(const float* v,
+                                                             float bound);
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl"))) uint32_t
+lanes_le_bcast_avx512(const float* v, float bound);
+#else
+uint32_t lanes_le_arr_avx2(const float* v, const float* bound);
+uint32_t lanes_le_arr_avx512(const float* v, const float* bound);
+uint32_t lanes_le_bcast_avx2(const float* v, float bound);
+uint32_t lanes_le_bcast_avx512(const float* v, float bound);
+#endif
 
 }  // namespace
 
@@ -253,6 +337,7 @@ void topk_candidates(const float* cost, int32_t P, int32_t T, int32_t k,
                      int32_t* out_cand_provider, float* out_cand_cost) {
   if (k > P) k = P;
   if (k <= 0 || T <= 0) return;  // empty marketplace: nothing to emit
+  const int32_t isa = g_isa.load(std::memory_order_relaxed);
   const int32_t B = 2048;  // tile buffers: 2048*k*8 B = 1 MB (L2) at k=64
   std::vector<uint64_t> bufs(static_cast<size_t>(B) * k);  // sorted keys
   std::vector<float> root_c(B);  // worst kept cost per task (fast reject)
@@ -285,20 +370,29 @@ void topk_candidates(const float* cost, int32_t P, int32_t T, int32_t k,
         root_c[i] = unpack_key_cost(buf[k - 1]);
       };
       int32_t i = 0;
-#if defined(__AVX512F__)
-      // 16-lane reject: jitter >= 0, so unjittered c > root can never
-      // enter the buffer; survivors (rare after warm-up) take the slow path.
-      for (; i + 16 <= nb; i += 16) {
-        const __m512 vc = _mm512_loadu_ps(row + i);
-        const __m512 vr = _mm512_loadu_ps(root_c.data() + i);
-        uint32_t m = _mm512_cmp_ps_mask(vc, vr, _CMP_LE_OQ);
-        while (m) {
-          const int32_t j = __builtin_ctz(m);
-          m &= m - 1;
-          consider(i + j);
+      // wide-lane reject (runtime dispatch): jitter >= 0, so an
+      // unjittered c > root can never enter the buffer; survivors (rare
+      // after warm-up) take the slow path. Comparison-only, so result
+      // bits match the scalar loop at every ISA.
+      if (isa == kIsaAvx512) {
+        for (; i + 16 <= nb; i += 16) {
+          uint32_t m = lanes_le_arr_avx512(row + i, root_c.data() + i);
+          while (m) {
+            const int32_t j = __builtin_ctz(m);
+            m &= m - 1;
+            consider(i + j);
+          }
+        }
+      } else if (isa == kIsaAvx2) {
+        for (; i + 8 <= nb; i += 8) {
+          uint32_t m = lanes_le_arr_avx2(row + i, root_c.data() + i);
+          while (m) {
+            const int32_t j = __builtin_ctz(m);
+            m &= m - 1;
+            consider(i + j);
+          }
         }
       }
-#endif
       for (; i < nb; ++i) {
         if (row[i] <= root_c[i]) consider(i);
       }
@@ -515,6 +609,552 @@ inline float score_cell(const ProviderFeatures* pf,
   return c;
 }
 
+// A lane block of provider features: the SAME pointers serve the full
+// scan (the pf arrays + ProviderPrecomp columns ARE provider-ordered
+// SoA) and the bucket-ordered BucketSoA copies — one vector kernel,
+// two layouts. Index i is a position INTO these arrays; mapping back
+// to the original provider id is the caller's job.
+struct ProviderBlockView {
+  const uint8_t *valid, *has_cpu, *has_gpu, *has_location;
+  const int32_t *cpu_cores, *ram_mb, *storage_gb;
+  const int32_t *gpu_count, *gpu_mem_mb, *gpu_model_id;
+  const float *base, *slat, *clat, *slon, *clon;
+};
+
+inline ProviderBlockView full_view(const ProviderFeatures* pf,
+                                   const ProviderPrecomp& pre) {
+  return {pf->valid,     pf->has_cpu,    pf->has_gpu,
+          pf->has_location, pf->cpu_cores, pf->ram_mb,
+          pf->storage_gb, pf->gpu_count,  pf->gpu_mem_mb,
+          pf->gpu_model_id, pre.base.data(), pre.slat.data(),
+          pre.clat.data(), pre.slon.data(), pre.clon.data()};
+}
+
+// ==== BEGIN PER-ISA KERNELS (isa-dispatch) =================================
+// The ONLY code in this file allowed to touch intrinsics or per-ISA
+// target attributes (enforced by the isa-dispatch lint rule). Every
+// entry point routes through the kIsaOps dispatch table below; common
+// code never branches on compile-time ISA macros.
+//
+// Determinism contract: score_cell_fma is the per-cell twin of BOTH
+// vector kernels — every operation maps 1:1 onto a lane op with the
+// same rounding (fmaf == vfmaddps lane, sqrtf == vsqrtps lane, the
+// clamp mirrors maxps/minps operand order), so any mix of block and
+// single-cell scoring at the same vector ISA produces identical bits.
+// AVX2 and AVX-512 use the same op sequence at different widths, hence
+// agree with each other too. The file is compiled -ffp-contract=off so
+// no surrounding mul+add ever fuses behind the contract's back.
+#if defined(ENGINE_HAVE_X86)
+
+// fmaf-matched scalar scorer for the vector pipeline (isa != scalar):
+// gates are the exact integer logic of score_cell; the cost path swaps
+// each a*b+c for the single-rounded fmaf the vector lanes execute.
+__attribute__((target("avx2,fma"))) float score_cell_fma(
+    const ProviderFeatures* pf, const RequirementFeatures* rf,
+    const ProviderPrecomp& pre, const TaskScore& ts, int32_t t, int32_t K,
+    int32_t W, int32_t p, float w_proximity) {
+  bool ok =
+      !ts.cpu_req || (pf->has_cpu[p] && ge_min(pf->cpu_cores[p], ts.cores));
+  ok = ok && ge_min(pf->ram_mb[p], ts.ram);
+  ok = ok && ge_min(pf->storage_gb[p], ts.storage);
+  ok = ok && pf->valid[p] && ts.valid;
+  if (ok && ts.any_opt) {
+    bool gany = false;
+    for (int32_t o = 0; o < K && !gany; ++o) {
+      const int64_t tk = static_cast<int64_t>(t) * K + o;
+      if (!rf->gpu_opt_valid[tk]) continue;
+      gany = gpu_option_ok(pf, rf, tk, W, p);
+    }
+    ok = pf->has_gpu[p] && gany;
+  }
+  if (!ok) return kInfeasible;
+  float c = pre.base[p] - ts.prio;
+  if (ts.has_loc && pf->has_location[p]) {
+    const float cos_dlat =
+        __builtin_fmaf(pre.clat[p], ts.clat, pre.slat[p] * ts.slat);
+    const float cos_dlon =
+        __builtin_fmaf(pre.clon[p], ts.clon, pre.slon[p] * ts.slon);
+    float a = __builtin_fmaf(pre.clat[p] * ts.clat * 0.5f, 1.0f - cos_dlon,
+                             0.5f * (1.0f - cos_dlat));
+    a = a > 0.0f ? a : 0.0f;  // maxps operand order (second wins ties)
+    a = a < 1.0f ? a : 1.0f;  // minps
+    const float x = std::sqrt(a);
+    const bool big = x > 0.5f;
+    const float xx = big ? std::sqrt((1.0f - x) * 0.5f) : x;
+    const float z = xx * xx;
+    float poly = 4.2163199048e-2f;
+    poly = __builtin_fmaf(poly, z, 2.4181311049e-2f);
+    poly = __builtin_fmaf(poly, z, 4.5470025998e-2f);
+    poly = __builtin_fmaf(poly, z, 7.4953002686e-2f);
+    poly = __builtin_fmaf(poly, z, 1.6666752422e-1f);
+    const float asin_small = __builtin_fmaf(poly * z, xx, xx);
+    const float asin_x =
+        big ? __builtin_fmaf(-2.0f, asin_small, 1.5707963267948966f)
+            : asin_small;
+    const float dist = (2.0f * 6371.0f) * asin_x;
+    c += w_proximity * dist;  // separate mul + add, as the lanes do
+  }
+  return c;
+}
+
+// ---- comparison-only lane helpers (bit-safe at any ISA) ----
+
+__attribute__((target("avx2"))) uint32_t lanes_le_arr_avx2(
+    const float* v, const float* bound) {
+  return static_cast<uint32_t>(_mm256_movemask_ps(_mm256_cmp_ps(
+      _mm256_loadu_ps(v), _mm256_loadu_ps(bound), _CMP_LE_OQ)));
+}
+
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl"))) uint32_t
+lanes_le_arr_avx512(const float* v, const float* bound) {
+  return _mm512_cmp_ps_mask(_mm512_loadu_ps(v), _mm512_loadu_ps(bound),
+                            _CMP_LE_OQ);
+}
+
+__attribute__((target("avx2"))) uint32_t lanes_le_bcast_avx2(const float* v,
+                                                             float bound) {
+  return static_cast<uint32_t>(_mm256_movemask_ps(_mm256_cmp_ps(
+      _mm256_loadu_ps(v), _mm256_set1_ps(bound), _CMP_LE_OQ)));
+}
+
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl"))) uint32_t
+lanes_le_bcast_avx512(const float* v, float bound) {
+  return _mm512_cmp_ps_mask(_mm512_loadu_ps(v), _mm512_set1_ps(bound),
+                            _CMP_LE_OQ);
+}
+
+// Block-skip survivors for the repair column sweeps, lanes over tasks:
+// survive = (lb <= rev_worst_cost) | use_fwd & (not_full | lb <=
+// theta_cost) with lb = base_p - prio[t] (the exact float the per-cell
+// precheck packs). Conservative in the float domain — pack_key is
+// monotone in cost with id 0 minimal, so key(lb,0) <= key(c,p) implies
+// lb <= c; a lane this test retires could never pass the per-cell
+// check, and every survivor re-runs that exact check. Prune-only: no
+// float result ever changes.
+__attribute__((target("avx2"))) uint32_t lb_survivors_avx2(
+    float base_p, const float* prio, const float* theta_cost,
+    const uint8_t* not_full, float rev_worst_cost, int use_fwd) {
+  const __m256 lb =
+      _mm256_sub_ps(_mm256_set1_ps(base_p), _mm256_loadu_ps(prio));
+  __m256 surv =
+      _mm256_cmp_ps(lb, _mm256_set1_ps(rev_worst_cost), _CMP_LE_OQ);
+  if (use_fwd) {
+    const __m256i nf = _mm256_cmpgt_epi32(
+        _mm256_cvtepu8_epi32(
+            _mm_loadl_epi64(reinterpret_cast<const __m128i*>(not_full))),
+        _mm256_setzero_si256());
+    surv = _mm256_or_ps(
+        surv, _mm256_or_ps(_mm256_castsi256_ps(nf),
+                           _mm256_cmp_ps(lb, _mm256_loadu_ps(theta_cost),
+                                         _CMP_LE_OQ)));
+  }
+  return static_cast<uint32_t>(_mm256_movemask_ps(surv));
+}
+
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl"))) uint32_t
+lb_survivors_avx512(float base_p, const float* prio, const float* theta_cost,
+                    const uint8_t* not_full, float rev_worst_cost,
+                    int use_fwd) {
+  const __m512 lb =
+      _mm512_sub_ps(_mm512_set1_ps(base_p), _mm512_loadu_ps(prio));
+  __mmask16 surv =
+      _mm512_cmp_ps_mask(lb, _mm512_set1_ps(rev_worst_cost), _CMP_LE_OQ);
+  if (use_fwd) {
+    surv |= _mm512_cmpgt_epi32_mask(
+                _mm512_cvtepu8_epi32(_mm_loadu_si128(
+                    reinterpret_cast<const __m128i*>(not_full))),
+                _mm512_setzero_si512()) |
+            _mm512_cmp_ps_mask(lb, _mm512_loadu_ps(theta_cost), _CMP_LE_OQ);
+  }
+  return surv;
+}
+
+// ---- the vector scoring kernels ----
+//
+// Lane-for-lane ports of score_cell_fma over one block of the view
+// (8 lanes AVX2, 16 lanes AVX-512): integer/byte gates fold into a
+// lane mask, the cost pipeline is the fixed op sequence documented on
+// score_cell_fma, and failed lanes blend to kInfeasible. Reduction
+// over a row is NOT done here — callers fold the scored block through
+// the same scalar insert sequence as the scalar path, in ascending
+// lane order, so selection order is a pure function of the scores.
+
+__attribute__((target("avx2"))) inline __m256i avx2_u8x8(const uint8_t* p) {
+  return _mm256_cvtepu8_epi32(
+      _mm_loadl_epi64(reinterpret_cast<const __m128i*>(p)));
+}
+
+__attribute__((target("avx2"))) inline __m256i avx2_ge(__m256i a, __m256i b) {
+  return _mm256_or_si256(_mm256_cmpgt_epi32(a, b), _mm256_cmpeq_epi32(a, b));
+}
+
+__attribute__((target("avx2,fma"))) void score_block_avx2(
+    const ProviderBlockView& pv, const RequirementFeatures* rf,
+    const TaskScore& ts, int32_t t, int32_t K, int32_t W, int32_t i0,
+    float w_proximity, float* out) {
+  const __m256i zero = _mm256_setzero_si256();
+  __m256i ok = ts.valid ? _mm256_set1_epi32(-1) : zero;
+  ok = _mm256_and_si256(
+      ok, _mm256_cmpgt_epi32(avx2_u8x8(pv.valid + i0), zero));
+  if (ts.cpu_req) {
+    __m256i cpu_ok = _mm256_cmpgt_epi32(avx2_u8x8(pv.has_cpu + i0), zero);
+    if (ts.cores >= 0) {
+      const __m256i cores = _mm256_loadu_si256(
+          reinterpret_cast<const __m256i*>(pv.cpu_cores + i0));
+      cpu_ok = _mm256_and_si256(
+          cpu_ok,
+          _mm256_and_si256(avx2_ge(cores, _mm256_set1_epi32(ts.cores)),
+                           avx2_ge(cores, zero)));
+    }
+    ok = _mm256_and_si256(ok, cpu_ok);
+  }
+  if (ts.ram >= 0) {
+    const __m256i ram = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(pv.ram_mb + i0));
+    ok = _mm256_and_si256(
+        ok, _mm256_and_si256(avx2_ge(ram, _mm256_set1_epi32(ts.ram)),
+                             avx2_ge(ram, zero)));
+  }
+  if (ts.storage >= 0) {
+    const __m256i st = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(pv.storage_gb + i0));
+    ok = _mm256_and_si256(
+        ok, _mm256_and_si256(avx2_ge(st, _mm256_set1_epi32(ts.storage)),
+                             avx2_ge(st, zero)));
+  }
+  if (ts.any_opt && !_mm256_testz_si256(ok, ok)) {
+    const __m256i pc = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(pv.gpu_count + i0));
+    const __m256i pm = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(pv.gpu_mem_mb + i0));
+    const __m256i mid = _mm256_loadu_si256(
+        reinterpret_cast<const __m256i*>(pv.gpu_model_id + i0));
+    const __m256i pc_abs = _mm256_cmpgt_epi32(zero, pc);
+    const __m256i pm_abs = _mm256_cmpgt_epi32(zero, pm);
+    __m256i gany = zero;
+    for (int32_t o = 0; o < K; ++o) {
+      const int64_t tk = static_cast<int64_t>(t) * K + o;
+      if (!rf->gpu_opt_valid[tk]) continue;
+      __m256i om = _mm256_set1_epi32(-1);
+      const int32_t rc = rf->gpu_count[tk];
+      if (rc == 0) {
+        om = _mm256_and_si256(
+            om, _mm256_or_si256(pc_abs, _mm256_cmpeq_epi32(pc, zero)));
+      } else if (rc > 0) {
+        om = _mm256_and_si256(om,
+                              _mm256_cmpeq_epi32(pc, _mm256_set1_epi32(rc)));
+      }
+      const int32_t rmem_min = rf->gpu_mem_min[tk];
+      if (rmem_min >= 0) {
+        om = _mm256_and_si256(
+            om, _mm256_andnot_si256(
+                    pm_abs, avx2_ge(pm, _mm256_set1_epi32(rmem_min))));
+      }
+      const int32_t rmem_max = rf->gpu_mem_max[tk];
+      if (rmem_max >= 0) {
+        om = _mm256_and_si256(
+            om, _mm256_andnot_si256(
+                    pm_abs, avx2_ge(_mm256_set1_epi32(rmem_max), pm)));
+      }
+      const int32_t rtot_min = rf->gpu_total_mem_min[tk];
+      const int32_t rtot_max = rf->gpu_total_mem_max[tk];
+      if (rtot_min >= 0 || rtot_max >= 0) {
+        const __m256i total = _mm256_mullo_epi32(pc, pm);
+        const __m256i no_total = _mm256_or_si256(pc_abs, pm_abs);
+        if (rtot_min >= 0) {
+          om = _mm256_and_si256(
+              om, _mm256_or_si256(
+                      no_total,
+                      avx2_ge(total, _mm256_set1_epi32(rtot_min))));
+        }
+        if (rtot_max >= 0) {
+          om = _mm256_and_si256(
+              om, _mm256_or_si256(
+                      no_total,
+                      avx2_ge(_mm256_set1_epi32(rtot_max), total)));
+        }
+      }
+      if (rf->gpu_model_constrained[tk]) {
+        const __m256i mid0 = _mm256_max_epi32(mid, zero);
+        const __m256i word = _mm256_min_epi32(_mm256_srli_epi32(mid0, 5),
+                                              _mm256_set1_epi32(W - 1));
+        const __m256i bit = _mm256_and_si256(mid0, _mm256_set1_epi32(31));
+        const __m256i words = _mm256_i32gather_epi32(
+            reinterpret_cast<const int*>(rf->gpu_model_mask + tk * W), word,
+            4);
+        const __m256i hit = _mm256_and_si256(_mm256_srlv_epi32(words, bit),
+                                             _mm256_set1_epi32(1));
+        om = _mm256_and_si256(
+            om, _mm256_and_si256(_mm256_cmpgt_epi32(hit, zero),
+                                 avx2_ge(mid, zero)));
+      }
+      gany = _mm256_or_si256(gany, om);
+    }
+    const __m256i has_gpu =
+        _mm256_cmpgt_epi32(avx2_u8x8(pv.has_gpu + i0), zero);
+    ok = _mm256_and_si256(ok, _mm256_and_si256(has_gpu, gany));
+  }
+  __m256 c = _mm256_sub_ps(_mm256_loadu_ps(pv.base + i0),
+                           _mm256_set1_ps(ts.prio));
+  if (ts.has_loc) {
+    const __m256 pclat = _mm256_loadu_ps(pv.clat + i0);
+    const __m256 cos_dlat = _mm256_fmadd_ps(
+        pclat, _mm256_set1_ps(ts.clat),
+        _mm256_mul_ps(_mm256_loadu_ps(pv.slat + i0),
+                      _mm256_set1_ps(ts.slat)));
+    const __m256 cos_dlon = _mm256_fmadd_ps(
+        _mm256_loadu_ps(pv.clon + i0), _mm256_set1_ps(ts.clon),
+        _mm256_mul_ps(_mm256_loadu_ps(pv.slon + i0),
+                      _mm256_set1_ps(ts.slon)));
+    const __m256 one = _mm256_set1_ps(1.0f);
+    const __m256 half = _mm256_set1_ps(0.5f);
+    __m256 a = _mm256_fmadd_ps(
+        _mm256_mul_ps(_mm256_mul_ps(pclat, _mm256_set1_ps(ts.clat)), half),
+        _mm256_sub_ps(one, cos_dlon),
+        _mm256_mul_ps(half, _mm256_sub_ps(one, cos_dlat)));
+    a = _mm256_min_ps(_mm256_max_ps(a, _mm256_setzero_ps()), one);
+    const __m256 x = _mm256_sqrt_ps(a);
+    const __m256 big = _mm256_cmp_ps(x, half, _CMP_GT_OQ);
+    const __m256 xx = _mm256_blendv_ps(
+        x, _mm256_sqrt_ps(_mm256_mul_ps(_mm256_sub_ps(one, x), half)), big);
+    const __m256 z = _mm256_mul_ps(xx, xx);
+    __m256 poly = _mm256_set1_ps(4.2163199048e-2f);
+    poly = _mm256_fmadd_ps(poly, z, _mm256_set1_ps(2.4181311049e-2f));
+    poly = _mm256_fmadd_ps(poly, z, _mm256_set1_ps(4.5470025998e-2f));
+    poly = _mm256_fmadd_ps(poly, z, _mm256_set1_ps(7.4953002686e-2f));
+    poly = _mm256_fmadd_ps(poly, z, _mm256_set1_ps(1.6666752422e-1f));
+    const __m256 asin_small =
+        _mm256_fmadd_ps(_mm256_mul_ps(poly, z), xx, xx);
+    const __m256 asin_x = _mm256_blendv_ps(
+        asin_small,
+        _mm256_fnmadd_ps(_mm256_set1_ps(2.0f), asin_small,
+                         _mm256_set1_ps(1.5707963267948966f)),
+        big);
+    const __m256 dist =
+        _mm256_mul_ps(_mm256_set1_ps(2.0f * 6371.0f), asin_x);
+    const __m256i ploc =
+        _mm256_cmpgt_epi32(avx2_u8x8(pv.has_location + i0), zero);
+    c = _mm256_blendv_ps(
+        c, _mm256_add_ps(c, _mm256_mul_ps(_mm256_set1_ps(w_proximity), dist)),
+        _mm256_castsi256_ps(ploc));
+  }
+  _mm256_storeu_ps(
+      out, _mm256_blendv_ps(_mm256_set1_ps(kInfeasible), c,
+                            _mm256_castsi256_ps(ok)));
+}
+
+__attribute__((target("avx512f,avx512dq,avx512bw,avx512vl,fma"))) void
+score_block_avx512(const ProviderBlockView& pv, const RequirementFeatures* rf,
+                   const TaskScore& ts, int32_t t, int32_t K, int32_t W,
+                   int32_t i0, float w_proximity, float* out) {
+  const __m512i zero = _mm512_setzero_si512();
+  const __m512 vinf = _mm512_set1_ps(kInfeasible);
+  __mmask16 ok = ts.valid ? static_cast<__mmask16>(0xffff) : 0;
+  ok &= _mm512_cmpgt_epi32_mask(
+      _mm512_cvtepu8_epi32(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(pv.valid + i0))),
+      zero);
+  if (ts.cpu_req) {
+    __mmask16 cpu_ok = _mm512_cmpgt_epi32_mask(
+        _mm512_cvtepu8_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(pv.has_cpu + i0))),
+        zero);
+    if (ts.cores >= 0) {
+      const __m512i cores = _mm512_loadu_si512(pv.cpu_cores + i0);
+      cpu_ok &= _mm512_cmpge_epi32_mask(cores,
+                                        _mm512_set1_epi32(ts.cores)) &
+                _mm512_cmpge_epi32_mask(cores, zero);
+    }
+    ok &= cpu_ok;
+  }
+  if (ts.ram >= 0) {
+    const __m512i ram = _mm512_loadu_si512(pv.ram_mb + i0);
+    ok &= _mm512_cmpge_epi32_mask(ram, _mm512_set1_epi32(ts.ram)) &
+          _mm512_cmpge_epi32_mask(ram, zero);
+  }
+  if (ts.storage >= 0) {
+    const __m512i st = _mm512_loadu_si512(pv.storage_gb + i0);
+    ok &= _mm512_cmpge_epi32_mask(st, _mm512_set1_epi32(ts.storage)) &
+          _mm512_cmpge_epi32_mask(st, zero);
+  }
+  if (ts.any_opt && ok) {
+    const __m512i pc = _mm512_loadu_si512(pv.gpu_count + i0);
+    const __m512i pm = _mm512_loadu_si512(pv.gpu_mem_mb + i0);
+    const __m512i mid = _mm512_loadu_si512(pv.gpu_model_id + i0);
+    const __mmask16 pc_abs = _mm512_cmplt_epi32_mask(pc, zero);
+    const __mmask16 pm_abs = _mm512_cmplt_epi32_mask(pm, zero);
+    __mmask16 gany_m = 0;
+    for (int32_t o = 0; o < K; ++o) {
+      const int64_t tk = static_cast<int64_t>(t) * K + o;
+      if (!rf->gpu_opt_valid[tk]) continue;
+      __mmask16 om = 0xffff;
+      const int32_t rc = rf->gpu_count[tk];
+      if (rc == 0) {
+        om &= pc_abs | _mm512_cmpeq_epi32_mask(pc, zero);
+      } else if (rc > 0) {
+        om &= _mm512_cmpeq_epi32_mask(pc, _mm512_set1_epi32(rc));
+      }
+      const int32_t rmem_min = rf->gpu_mem_min[tk];
+      if (rmem_min >= 0) {
+        om &= _mm512_cmpge_epi32_mask(pm, _mm512_set1_epi32(rmem_min)) &
+              ~pm_abs;
+      }
+      const int32_t rmem_max = rf->gpu_mem_max[tk];
+      if (rmem_max >= 0) {
+        om &= _mm512_cmple_epi32_mask(pm, _mm512_set1_epi32(rmem_max)) &
+              ~pm_abs;
+      }
+      const int32_t rtot_min = rf->gpu_total_mem_min[tk];
+      const int32_t rtot_max = rf->gpu_total_mem_max[tk];
+      if (rtot_min >= 0 || rtot_max >= 0) {
+        const __m512i total = _mm512_mullo_epi32(pc, pm);
+        const __mmask16 no_total = pc_abs | pm_abs;
+        if (rtot_min >= 0) {
+          om &= no_total | _mm512_cmpge_epi32_mask(
+                               total, _mm512_set1_epi32(rtot_min));
+        }
+        if (rtot_max >= 0) {
+          om &= no_total | _mm512_cmple_epi32_mask(
+                               total, _mm512_set1_epi32(rtot_max));
+        }
+      }
+      if (rf->gpu_model_constrained[tk]) {
+        const __m512i mid0 = _mm512_max_epi32(mid, zero);
+        const __m512i word = _mm512_min_epi32(_mm512_srli_epi32(mid0, 5),
+                                              _mm512_set1_epi32(W - 1));
+        const __m512i bit = _mm512_and_si512(mid0, _mm512_set1_epi32(31));
+        const __m512i words = _mm512_i32gather_epi32(
+            word, rf->gpu_model_mask + tk * W, 4);
+        const __m512i hit = _mm512_and_si512(
+            _mm512_srlv_epi32(words, bit), _mm512_set1_epi32(1));
+        om &= _mm512_cmpgt_epi32_mask(hit, zero) &
+              _mm512_cmpge_epi32_mask(mid, zero);
+      }
+      gany_m |= om;
+    }
+    const __mmask16 has_gpu = _mm512_cmpgt_epi32_mask(
+        _mm512_cvtepu8_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(pv.has_gpu + i0))),
+        zero);
+    ok &= has_gpu & gany_m;
+  }
+  __m512 c = _mm512_sub_ps(_mm512_loadu_ps(pv.base + i0),
+                           _mm512_set1_ps(ts.prio));
+  if (ts.has_loc) {
+    const __m512 pclat = _mm512_loadu_ps(pv.clat + i0);
+    const __m512 cos_dlat = _mm512_fmadd_ps(
+        pclat, _mm512_set1_ps(ts.clat),
+        _mm512_mul_ps(_mm512_loadu_ps(pv.slat + i0),
+                      _mm512_set1_ps(ts.slat)));
+    const __m512 cos_dlon = _mm512_fmadd_ps(
+        _mm512_loadu_ps(pv.clon + i0), _mm512_set1_ps(ts.clon),
+        _mm512_mul_ps(_mm512_loadu_ps(pv.slon + i0),
+                      _mm512_set1_ps(ts.slon)));
+    const __m512 one = _mm512_set1_ps(1.0f);
+    const __m512 half = _mm512_set1_ps(0.5f);
+    __m512 a = _mm512_fmadd_ps(
+        _mm512_mul_ps(_mm512_mul_ps(pclat, _mm512_set1_ps(ts.clat)), half),
+        _mm512_sub_ps(one, cos_dlon),
+        _mm512_mul_ps(half, _mm512_sub_ps(one, cos_dlat)));
+    a = _mm512_min_ps(_mm512_max_ps(a, _mm512_setzero_ps()), one);
+    // asin(sqrt(a)), cephes split at 0.5
+    const __m512 x = _mm512_sqrt_ps(a);
+    const __mmask16 big = _mm512_cmp_ps_mask(x, half, _CMP_GT_OQ);
+    const __m512 xx = _mm512_mask_blend_ps(
+        big, x,
+        _mm512_sqrt_ps(_mm512_mul_ps(_mm512_sub_ps(one, x), half)));
+    const __m512 z = _mm512_mul_ps(xx, xx);
+    __m512 poly = _mm512_set1_ps(4.2163199048e-2f);
+    poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(2.4181311049e-2f));
+    poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(4.5470025998e-2f));
+    poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(7.4953002686e-2f));
+    poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(1.6666752422e-1f));
+    const __m512 asin_small =
+        _mm512_fmadd_ps(_mm512_mul_ps(poly, z), xx, xx);
+    const __m512 asin_x = _mm512_mask_blend_ps(
+        big, asin_small,
+        _mm512_fnmadd_ps(_mm512_set1_ps(2.0f), asin_small,
+                         _mm512_set1_ps(1.5707963267948966f)));
+    const __m512 dist =
+        _mm512_mul_ps(_mm512_set1_ps(2.0f * 6371.0f), asin_x);
+    const __mmask16 ploc = _mm512_cmpgt_epi32_mask(
+        _mm512_cvtepu8_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(pv.has_location + i0))),
+        zero);
+    c = _mm512_mask_add_ps(
+        c, ploc, c, _mm512_mul_ps(_mm512_set1_ps(w_proximity), dist));
+  }
+  _mm512_storeu_ps(out, _mm512_mask_blend_ps(ok, vinf, c));
+}
+
+#endif  // ENGINE_HAVE_X86
+// ==== END PER-ISA KERNELS (isa-dispatch) ===================================
+
+#if !defined(ENGINE_HAVE_X86)
+// non-x86 hosts: clamp_isa already pins scalar, so none of these can be
+// reached — stubs keep the dispatch table well-formed.
+inline float score_cell_fma(const ProviderFeatures* pf,
+                            const RequirementFeatures* rf,
+                            const ProviderPrecomp& pre, const TaskScore& ts,
+                            int32_t t, int32_t K, int32_t W, int32_t p,
+                            float w_proximity) {
+  return score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity);
+}
+inline uint32_t lanes_le_arr_avx2(const float*, const float*) { return 0; }
+inline uint32_t lanes_le_arr_avx512(const float*, const float*) { return 0; }
+inline uint32_t lanes_le_bcast_avx2(const float*, float) { return 0; }
+inline uint32_t lanes_le_bcast_avx512(const float*, float) { return 0; }
+inline uint32_t lb_survivors_avx2(float, const float*, const float*,
+                                  const uint8_t*, float, int) {
+  return 0;
+}
+inline uint32_t lb_survivors_avx512(float, const float*, const float*,
+                                    const uint8_t*, float, int) {
+  return 0;
+}
+inline void score_block_avx2(const ProviderBlockView&,
+                             const RequirementFeatures*, const TaskScore&,
+                             int32_t, int32_t, int32_t, int32_t, float,
+                             float*) {}
+inline void score_block_avx512(const ProviderBlockView&,
+                               const RequirementFeatures*, const TaskScore&,
+                               int32_t, int32_t, int32_t, int32_t, float,
+                               float*) {}
+#endif
+
+// The dispatch table: every ISA-dependent operation routes through one
+// of these rows (indexed by the engine isa code). New native entry
+// points must use the table, never intrinsics directly — the
+// isa-dispatch lint enforces the boundary textually.
+struct IsaOps {
+  int32_t width;  // scoring lanes per block
+  void (*score_block)(const ProviderBlockView&, const RequirementFeatures*,
+                      const TaskScore&, int32_t, int32_t, int32_t, int32_t,
+                      float, float*);
+  uint32_t (*le_bcast)(const float*, float);
+  uint32_t (*lb_survivors)(float, const float*, const float*, const uint8_t*,
+                           float, int);
+};
+
+const IsaOps kIsaOps[3] = {
+    {1, nullptr, nullptr, nullptr},
+    {8, score_block_avx2, lanes_le_bcast_avx2, lb_survivors_avx2},
+    {16, score_block_avx512, lanes_le_bcast_avx512, lb_survivors_avx512},
+};
+
+// per-cell scorer behind the ISA seam: scalar keeps the historical
+// pipeline (and its inlining); the vector ISAs score through the
+// fmaf twin so single-cell and block scoring agree bit-for-bit
+inline float score_cell_isa(int32_t isa, const ProviderFeatures* pf,
+                            const RequirementFeatures* rf,
+                            const ProviderPrecomp& pre, const TaskScore& ts,
+                            int32_t t, int32_t K, int32_t W, int32_t p,
+                            float w_proximity) {
+  return isa == kIsaScalar
+             ? score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity)
+             : score_cell_fma(pf, rf, pre, ts, t, K, W, p, w_proximity);
+}
+
 // ---- capability-signature buckets (the sub-quadratic cold pruner) ----
 //
 // Providers are grouped by the two EXACT-SEMANTICS discrete axes of the
@@ -555,6 +1195,54 @@ struct BucketIndex {
     for (int32_t b = 0; b < kNumBuckets; ++b) start[b + 1] += start[b];
     std::vector<int32_t> fill(start.begin(), start.end() - 1);
     for (int32_t p = 0; p < P; ++p) ids[fill[provider_bucket(pf, p)]++] = p;
+  }
+};
+
+// Bucket-ordered SoA feature copies for the vector pruner path: each
+// bucket's providers become one CONTIGUOUS run of every feature column
+// (the per-bucket id indirection in the scalar path costs a gather per
+// feature per cell — the measured difference between vector parity and
+// vector speedup at 16k). Built once per solve when the engine is on a
+// vector ISA and the pruner is enabled; the copies hold the exact
+// values the pf/pre arrays hold, so scoring through either layout is
+// bit-identical. ids aliases bx.ids (position -> original provider).
+struct BucketSoA {
+  std::vector<uint8_t> valid, has_cpu, has_gpu, has_location;
+  std::vector<int32_t> cpu_cores, ram_mb, storage_gb;
+  std::vector<int32_t> gpu_count, gpu_mem_mb, gpu_model_id;
+  std::vector<float> base, slat, clat, slon, clon;
+  const int32_t* ids;
+  BucketSoA(const ProviderFeatures* pf, const ProviderPrecomp& pre,
+            const BucketIndex& bx, int32_t P)
+      : valid(P), has_cpu(P), has_gpu(P), has_location(P), cpu_cores(P),
+        ram_mb(P), storage_gb(P), gpu_count(P), gpu_mem_mb(P),
+        gpu_model_id(P), base(P), slat(P), clat(P), slon(P), clon(P),
+        ids(bx.ids.data()) {
+    for (int32_t i = 0; i < P; ++i) {
+      const int32_t p = bx.ids[i];
+      valid[i] = pf->valid[p];
+      has_cpu[i] = pf->has_cpu[p];
+      has_gpu[i] = pf->has_gpu[p];
+      has_location[i] = pf->has_location[p];
+      cpu_cores[i] = pf->cpu_cores[p];
+      ram_mb[i] = pf->ram_mb[p];
+      storage_gb[i] = pf->storage_gb[p];
+      gpu_count[i] = pf->gpu_count[p];
+      gpu_mem_mb[i] = pf->gpu_mem_mb[p];
+      gpu_model_id[i] = pf->gpu_model_id[p];
+      base[i] = pre.base[p];
+      slat[i] = pre.slat[p];
+      clat[i] = pre.clat[p];
+      slon[i] = pre.slon[p];
+      clon[i] = pre.clon[p];
+    }
+  }
+  ProviderBlockView view() const {
+    return {valid.data(),      has_cpu.data(),   has_gpu.data(),
+            has_location.data(), cpu_cores.data(), ram_mb.data(),
+            storage_gb.data(), gpu_count.data(), gpu_mem_mb.data(),
+            gpu_model_id.data(), base.data(),    slat.data(),
+            clat.data(),       slon.data(),      clon.data()};
   }
 };
 
@@ -681,13 +1369,14 @@ void fused_process_tasks(const ProviderFeatures* pf,
                          int64_t* probes = nullptr, int32_t slack_cap = 0,
                          int32_t* slack_p = nullptr,
                          float* slack_c = nullptr,
-                         bool force_scalar = false) {
+                         int32_t isa = kIsaScalar,
+                         const BucketSoA* soa = nullptr) {
   const bool do_rev = rev != nullptr && reverse_r > 0;
-  const float* base = pre.base.data();
-  const float* slat = pre.slat.data();
-  const float* clat = pre.clat.data();
-  const float* slon = pre.slon.data();
-  const float* clon = pre.clon.data();
+  const IsaOps& ops = kIsaOps[isa];
+  const ProviderBlockView fv = full_view(pf, pre);
+  const ProviderBlockView sv =
+      soa != nullptr ? soa->view() : ProviderBlockView{};
+  float segbuf[16];  // one vector block of bucket-segment scores
   // selection width: top-(k + slack) keys are tracked so the emitted
   // slack tail (the repair kernel's deletion absorber) rides the same
   // pass; the first k of a top-(k+s) selection IS the top-k, so the
@@ -717,23 +1406,9 @@ void fused_process_tasks(const ProviderFeatures* pf,
 
   for (int32_t t = t_begin; t < t_end; ++t) {
     // ONE construction of the per-task scalars (shared with the repair
-    // kernel): the locals below exist only so the AVX/scalar blocks
-    // keep their historical names — deriving them from ts means a
-    // future edit to the hoists cannot silently split the fused pass
-    // from the repair kernel's bit-identity contract
+    // kernel and the per-ISA kernels — every scoring path reads the
+    // same hoists, so an edit here cannot split their bit-identity)
     const TaskScore ts = make_task_score(rf, t, K, w_priority);
-    const uint8_t t_valid = ts.valid;
-    const uint8_t t_cpu_req = ts.cpu_req;
-    const int32_t t_cores = ts.cores;
-    const int32_t t_ram = ts.ram;
-    const int32_t t_storage = ts.storage;
-    const float t_slat = ts.slat;
-    const float t_clat = ts.clat;
-    const float t_slon = ts.slon;
-    const float t_clon = ts.clon;
-    const uint8_t t_has_loc = ts.has_loc;
-    const float prio = ts.prio;
-    const bool any_opt = ts.any_opt;
     if (bx != nullptr) {
       const int64_t n_adm =
           task_admissible(rf, t, K, W, ts, *bx, adm.data());
@@ -748,25 +1423,50 @@ void fused_process_tasks(const ProviderFeatures* pf,
         }
         uint64_t* buf = topbuf.data();
         for (int32_t j = 0; j < k_sel; ++j) buf[j] = pad_key;
-        for (int32_t b = 1; b < kNumBuckets; ++b) {
-          if (!adm[b]) continue;
-          for (int32_t i = bx->start[b]; i < bx->start[b + 1]; ++i) {
-            const int32_t p = bx->ids[i];
-            const float c =
-                score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity);
-            if (c >= kInfeasible * 0.5f) continue;
-            const float cj = c + jitter(p, t);
-            if (do_rev && c < rev_worst[p]) {
-              uint64_t* rb = rev + static_cast<size_t>(p) * reverse_r;
-              const uint64_t rkey =
-                  pack_key(cj, static_cast<uint32_t>(t));
-              if (rkey < rb[reverse_r - 1]) {
-                sorted_insert(rb, reverse_r, rkey);
-                rev_worst[p] = unpack_key_cost(rb[reverse_r - 1]);
+        // fold one scored cell, in the segment's ascending-id order —
+        // the SAME insert sequence whichever layout scored it
+        const auto fold = [&](int32_t p, float c) {
+          if (c >= kInfeasible * 0.5f) return;
+          const float cj = c + jitter(p, t);
+          if (do_rev && c < rev_worst[p]) {
+            uint64_t* rb = rev + static_cast<size_t>(p) * reverse_r;
+            const uint64_t rkey = pack_key(cj, static_cast<uint32_t>(t));
+            if (rkey < rb[reverse_r - 1]) {
+              sorted_insert(rb, reverse_r, rkey);
+              rev_worst[p] = unpack_key_cost(rb[reverse_r - 1]);
+            }
+          }
+          const uint64_t key = pack_key(cj, p);
+          if (key < buf[k_sel - 1]) sorted_insert(buf, k_sel, key);
+        };
+        if (isa != kIsaScalar && soa != nullptr) {
+          // vector segments over the bucket-ordered SoA; sub-block
+          // tails score the same cells through the fmaf twin (equal
+          // bits by the per-ISA contract)
+          for (int32_t b = 1; b < kNumBuckets; ++b) {
+            if (!adm[b]) continue;
+            const int32_t s1 = bx->start[b + 1];
+            int32_t i = bx->start[b];
+            for (; i + ops.width <= s1; i += ops.width) {
+              ops.score_block(sv, rf, ts, t, K, W, i, w_proximity, segbuf);
+              for (int32_t j = 0; j < ops.width; ++j) {
+                fold(soa->ids[i + j], segbuf[j]);
               }
             }
-            const uint64_t key = pack_key(cj, p);
-            if (key < buf[k_sel - 1]) sorted_insert(buf, k_sel, key);
+            for (; i < s1; ++i) {
+              const int32_t p = bx->ids[i];
+              fold(p, score_cell_fma(pf, rf, pre, ts, t, K, W, p,
+                                     w_proximity));
+            }
+          }
+        } else {
+          for (int32_t b = 1; b < kNumBuckets; ++b) {
+            if (!adm[b]) continue;
+            for (int32_t i = bx->start[b]; i < bx->start[b + 1]; ++i) {
+              const int32_t p = bx->ids[i];
+              fold(p, score_cell_isa(isa, pf, rf, pre, ts, t, K, W, p,
+                                     w_proximity));
+            }
           }
         }
         const int64_t out_base = static_cast<int64_t>(t) * k_out;
@@ -790,165 +1490,23 @@ void fused_process_tasks(const ProviderFeatures* pf,
       }
     }
     int32_t p0 = 0;
-#if defined(__AVX512F__)
-    // the persistent-structure family (bucketed / rev_out / slack — the
-    // v2 entry) pins the SCALAR cost pipeline even on AVX-512 builds:
-    // the vector path's FMA contraction differs from score_cell in
-    // ULPs, and two float pipelines cannot coexist behind the repair
-    // kernel's bit-identical-to-rebuild promise. Legacy fused entries
-    // (no persistent outputs) keep the vector path.
-    if (!force_scalar) {
-      const __m512i neg1 = _mm512_set1_epi32(-1);
-      const __m512i zero = _mm512_setzero_si512();
-      const __m512 vinf = _mm512_set1_ps(kInfeasible);
-      for (; p0 + 16 <= P; p0 += 16) {
-        // ---- scalar AND gates (compat_mask "scalar" block)
-        __mmask16 ok = t_valid ? static_cast<__mmask16>(0xffff) : 0;
-        ok &= _mm512_cmpgt_epi32_mask(
-            _mm512_cvtepu8_epi32(_mm_loadu_si128(
-                reinterpret_cast<const __m128i*>(pf->valid + p0))),
-            zero);
-        if (t_cpu_req) {
-          __mmask16 cpu_ok = _mm512_cmpgt_epi32_mask(
-              _mm512_cvtepu8_epi32(_mm_loadu_si128(
-                  reinterpret_cast<const __m128i*>(pf->has_cpu + p0))),
-              zero);
-          if (t_cores >= 0) {
-            const __m512i cores = _mm512_loadu_si512(pf->cpu_cores + p0);
-            cpu_ok &= _mm512_cmpge_epi32_mask(cores,
-                                              _mm512_set1_epi32(t_cores)) &
-                      _mm512_cmpge_epi32_mask(cores, zero);
-          }
-          ok &= cpu_ok;
-        }
-        if (t_ram >= 0) {
-          const __m512i ram = _mm512_loadu_si512(pf->ram_mb + p0);
-          ok &= _mm512_cmpge_epi32_mask(ram, _mm512_set1_epi32(t_ram)) &
-                _mm512_cmpge_epi32_mask(ram, zero);
-        }
-        if (t_storage >= 0) {
-          const __m512i st = _mm512_loadu_si512(pf->storage_gb + p0);
-          ok &= _mm512_cmpge_epi32_mask(st, _mm512_set1_epi32(t_storage)) &
-                _mm512_cmpge_epi32_mask(st, zero);
-        }
-        // ---- GPU OR alternatives
-        if (any_opt && ok) {
-          const __m512i pc = _mm512_loadu_si512(pf->gpu_count + p0);
-          const __m512i pm = _mm512_loadu_si512(pf->gpu_mem_mb + p0);
-          const __m512i mid = _mm512_loadu_si512(pf->gpu_model_id + p0);
-          const __mmask16 pc_abs = _mm512_cmplt_epi32_mask(pc, zero);
-          const __mmask16 pm_abs = _mm512_cmplt_epi32_mask(pm, zero);
-          __mmask16 gany_m = 0;
-          for (int32_t o = 0; o < K; ++o) {
-            const int64_t tk = static_cast<int64_t>(t) * K + o;
-            if (!rf->gpu_opt_valid[tk]) continue;
-            __mmask16 om = 0xffff;
-            const int32_t rc = rf->gpu_count[tk];
-            if (rc == 0) {
-              om &= pc_abs | _mm512_cmpeq_epi32_mask(pc, zero);
-            } else if (rc > 0) {
-              om &= _mm512_cmpeq_epi32_mask(pc, _mm512_set1_epi32(rc));
-            }
-            const int32_t rmem_min = rf->gpu_mem_min[tk];
-            if (rmem_min >= 0) {
-              om &= _mm512_cmpge_epi32_mask(pm, _mm512_set1_epi32(rmem_min)) &
-                    ~pm_abs;
-            }
-            const int32_t rmem_max = rf->gpu_mem_max[tk];
-            if (rmem_max >= 0) {
-              om &= _mm512_cmple_epi32_mask(pm, _mm512_set1_epi32(rmem_max)) &
-                    ~pm_abs;
-            }
-            const int32_t rtot_min = rf->gpu_total_mem_min[tk];
-            const int32_t rtot_max = rf->gpu_total_mem_max[tk];
-            if (rtot_min >= 0 || rtot_max >= 0) {
-              const __m512i total = _mm512_mullo_epi32(pc, pm);
-              const __mmask16 no_total = pc_abs | pm_abs;
-              if (rtot_min >= 0) {
-                om &= no_total | _mm512_cmpge_epi32_mask(
-                                     total, _mm512_set1_epi32(rtot_min));
-              }
-              if (rtot_max >= 0) {
-                om &= no_total | _mm512_cmple_epi32_mask(
-                                     total, _mm512_set1_epi32(rtot_max));
-              }
-            }
-            if (rf->gpu_model_constrained[tk]) {
-              const __m512i mid0 = _mm512_max_epi32(mid, zero);
-              const __m512i word = _mm512_min_epi32(
-                  _mm512_srli_epi32(mid0, 5), _mm512_set1_epi32(W - 1));
-              const __m512i bit = _mm512_and_si512(mid0, _mm512_set1_epi32(31));
-              const __m512i words = _mm512_i32gather_epi32(
-                  word, rf->gpu_model_mask + tk * W, 4);
-              const __m512i hit = _mm512_and_si512(
-                  _mm512_srlv_epi32(words, bit), _mm512_set1_epi32(1));
-              om &= _mm512_cmpgt_epi32_mask(hit, zero) &
-                    _mm512_cmpge_epi32_mask(mid, zero);
-            }
-            gany_m |= om;
-          }
-          const __mmask16 has_gpu = _mm512_cmpgt_epi32_mask(
-              _mm512_cvtepu8_epi32(_mm_loadu_si128(
-                  reinterpret_cast<const __m128i*>(pf->has_gpu + p0))),
-              zero);
-          ok &= has_gpu & gany_m;
-        }
-        // ---- cost terms
-        __m512 c = _mm512_sub_ps(_mm512_loadu_ps(base + p0),
-                                 _mm512_set1_ps(prio));
-        if (t_has_loc) {
-          const __m512 pclat = _mm512_loadu_ps(clat + p0);
-          const __m512 cos_dlat = _mm512_fmadd_ps(
-              pclat, _mm512_set1_ps(t_clat),
-              _mm512_mul_ps(_mm512_loadu_ps(slat + p0),
-                            _mm512_set1_ps(t_slat)));
-          const __m512 cos_dlon = _mm512_fmadd_ps(
-              _mm512_loadu_ps(clon + p0), _mm512_set1_ps(t_clon),
-              _mm512_mul_ps(_mm512_loadu_ps(slon + p0),
-                            _mm512_set1_ps(t_slon)));
-          const __m512 one = _mm512_set1_ps(1.0f);
-          const __m512 half = _mm512_set1_ps(0.5f);
-          __m512 a = _mm512_fmadd_ps(
-              _mm512_mul_ps(_mm512_mul_ps(pclat, _mm512_set1_ps(t_clat)),
-                            half),
-              _mm512_sub_ps(one, cos_dlon),
-              _mm512_mul_ps(half, _mm512_sub_ps(one, cos_dlat)));
-          a = _mm512_min_ps(_mm512_max_ps(a, _mm512_setzero_ps()), one);
-          // asin(sqrt(a)), cephes split at 0.5
-          const __m512 x = _mm512_sqrt_ps(a);
-          const __mmask16 big = _mm512_cmp_ps_mask(x, half, _CMP_GT_OQ);
-          const __m512 xx = _mm512_mask_blend_ps(
-              big, x,
-              _mm512_sqrt_ps(_mm512_mul_ps(_mm512_sub_ps(one, x), half)));
-          const __m512 z = _mm512_mul_ps(xx, xx);
-          __m512 poly = _mm512_set1_ps(4.2163199048e-2f);
-          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(2.4181311049e-2f));
-          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(4.5470025998e-2f));
-          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(7.4953002686e-2f));
-          poly = _mm512_fmadd_ps(poly, z, _mm512_set1_ps(1.6666752422e-1f));
-          const __m512 asin_small =
-              _mm512_fmadd_ps(_mm512_mul_ps(poly, z), xx, xx);
-          const __m512 asin_x = _mm512_mask_blend_ps(
-              big, asin_small,
-              _mm512_fnmadd_ps(_mm512_set1_ps(2.0f), asin_small,
-                               _mm512_set1_ps(1.5707963267948966f)));
-          const __m512 dist =
-              _mm512_mul_ps(_mm512_set1_ps(2.0f * 6371.0f), asin_x);
-          const __mmask16 ploc = _mm512_cmpgt_epi32_mask(
-              _mm512_cvtepu8_epi32(_mm_loadu_si128(
-                  reinterpret_cast<const __m128i*>(pf->has_location + p0))),
-              zero);
-          c = _mm512_mask_add_ps(
-              c, ploc, c, _mm512_mul_ps(_mm512_set1_ps(w_proximity), dist));
-        }
-        _mm512_storeu_ps(scratch.data() + p0,
-                         _mm512_mask_blend_ps(ok, vinf, c));
+    // Full scan through the dispatch table: one vector block kernel per
+    // lane-width stride, fmaf-twin tail. At isa == scalar the loop
+    // below runs score_cell over every cell — the historical pipeline,
+    // bit-for-bit. The persistent-structure family no longer forces
+    // scalar: within an ISA there is exactly ONE float pipeline, so the
+    // repair kernel's bit-identical-to-rebuild promise holds at every
+    // ISA (the tag is the provenance).
+    if (isa != kIsaScalar) {
+      for (; p0 + ops.width <= P; p0 += ops.width) {
+        ops.score_block(fv, rf, ts, t, K, W, p0, w_proximity,
+                        scratch.data() + p0);
+      }
+      for (; p0 < P; ++p0) {
+        scratch[p0] =
+            score_cell_fma(pf, rf, pre, ts, t, K, W, p0, w_proximity);
       }
     }
-#endif
-    // scalar tail (and full path on non-AVX-512 builds): the shared
-    // per-cell scorer — the same expressions the historical inline loops
-    // computed, now the ONE implementation every path calls
     if (p0 < P) {
       for (int32_t p = p0; p < P; ++p) {
         scratch[p] = score_cell(pf, rf, pre, ts, t, K, W, p, w_proximity);
@@ -982,24 +1540,23 @@ void fused_process_tasks(const ProviderFeatures* pf,
     std::sort(buf, buf + k_sel);
     float root = unpack_key_cost(buf[k_sel - 1]);
     int32_t p = k_sel;
-#if defined(__AVX512F__)
-    __m512 vr = _mm512_set1_ps(root);
-    for (; p + 16 <= P; p += 16) {
-      const __m512 vc = _mm512_loadu_ps(scratch.data() + p);
-      uint32_t m = _mm512_cmp_ps_mask(vc, vr, _CMP_LE_OQ);
-      while (m) {
-        const int32_t pp = p + __builtin_ctz(m);
-        m &= m - 1;
-        const float c = scratch[pp];
-        const float cj = (c < kInfeasible * 0.5f) ? c + jitter(pp, t) : c;
-        const uint64_t key = pack_key(cj, pp);
-        if (key >= buf[k_sel - 1]) continue;
-        sorted_insert(buf, k_sel, key);
-        root = unpack_key_cost(buf[k_sel - 1]);
-        vr = _mm512_set1_ps(root);
+    if (isa != kIsaScalar) {
+      // wide-lane reject via the dispatch table (comparison-only, so
+      // this changes which cells take the slow path, never their bits)
+      for (; p + ops.width <= P; p += ops.width) {
+        uint32_t m = ops.le_bcast(scratch.data() + p, root);
+        while (m) {
+          const int32_t pp = p + __builtin_ctz(m);
+          m &= m - 1;
+          const float c = scratch[pp];
+          const float cj = (c < kInfeasible * 0.5f) ? c + jitter(pp, t) : c;
+          const uint64_t key = pack_key(cj, pp);
+          if (key >= buf[k_sel - 1]) continue;
+          sorted_insert(buf, k_sel, key);
+          root = unpack_key_cost(buf[k_sel - 1]);
+        }
       }
     }
-#endif
     for (; p < P; ++p) {
       const float c = scratch[p];
       if (c > root) continue;
@@ -1113,14 +1670,16 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
     std::memset(stats_out, 0, kEngineStatsSlots * 8);
     stats_out[3] = nt;
   }
-  // the persistent-structure (v2) family forces one float pipeline —
-  // see the AVX-512 note in fused_process_tasks
-  const bool force_scalar =
-      use_buckets != 0 || rev_out != nullptr || slack_p_out != nullptr;
+  // one float pipeline per ISA (snapshotted once per solve): scalar is
+  // the historical score_cell pipeline, the vector ISAs the fmaf one —
+  // see the per-ISA contract in fused_process_tasks
+  const int32_t isa = g_isa.load(std::memory_order_relaxed);
   int64_t t0 = st ? now_ns() : 0;
   std::unique_ptr<BucketIndex> bx;
+  std::unique_ptr<BucketSoA> soa;
   if (use_buckets) {
     bx.reset(new BucketIndex(pf, P));
+    if (isa != kIsaScalar) soa.reset(new BucketSoA(pf, pre, *bx, P));
     if (st) {
       stats_out[7] = now_ns() - t0;
       t0 = now_ns();
@@ -1153,8 +1712,8 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                         out_cand_provider, out_cand_cost, bx.get(),
                         coverage_frac,
                         probes_all.empty() ? nullptr : probes_all.data(),
-                        slack_cap, slack_p_out, slack_c_out,
-                        force_scalar);
+                        slack_cap, slack_p_out, slack_c_out, isa,
+                        soa.get());
     if (st) {
       stats_out[0] = now_ns() - t0;
       t0 = now_ns();
@@ -1202,8 +1761,8 @@ void fused_topk_impl(const ProviderFeatures* pf, const RequirementFeatures* rf,
                             ? nullptr
                             : probes_all.data() +
                                   static_cast<size_t>(tid) * 3,
-                        slack_cap, slack_p_out, slack_c_out,
-                        force_scalar);
+                        slack_cap, slack_p_out, slack_c_out, isa,
+                        soa.get());
   });
   if (st) {
     stats_out[0] = now_ns() - t0;
@@ -1412,6 +1971,11 @@ int32_t repair_topk_candidates_mt(
   };
   const ProviderPrecomp pre(pf, P, w_price, w_load);
   const BucketIndex bx(pf, P);
+  // one float pipeline per ISA, snapshotted once — every phase of this
+  // repair and the from-scratch rebuild it must match score through the
+  // same per-cell function (the per-ISA determinism contract)
+  const int32_t isa = g_isa.load(std::memory_order_relaxed);
+  const IsaOps& ops = kIsaOps[isa];
 
   std::vector<uint8_t> in_dp(P, 0), in_dt(T, 0);
   for (int32_t i = 0; i < n_dp; ++i) {
@@ -1438,6 +2002,15 @@ int32_t repair_topk_candidates_mt(
   // infeasible — ANY newly-feasible dirty key must enter (the tau
   // filter only orders known-feasible competition)
   std::vector<uint8_t> not_full(T);
+  // float-domain SoA shadows of the per-task bounds, for the vectorized
+  // block-skip: prio feeds the lower bound lb = base[p] - prio[t], and
+  // theta_cost is the cost component of theta (key-domain comparison is
+  // relaxed to cost-domain — a conservative superset, see lb_survivors)
+  std::vector<float> prio_all, theta_cost;
+  if (isa != kIsaScalar) {
+    prio_all.resize(T);
+    theta_cost.resize(T);
+  }
   const int32_t tchunk = (T + nt - 1) / nt;
   par([&](int tid) {
     const int32_t lo = std::min<int32_t>(tid * tchunk, T);
@@ -1463,6 +2036,10 @@ int32_t repair_topk_candidates_mt(
         }
       }
       theta[t] = tau;
+      if (isa != kIsaScalar) {
+        prio_all[t] = ts_all[t].prio;
+        theta_cost[t] = unpack_key_cost(tau);
+      }
     }
   });
   std::vector<uint64_t> wkey(P);  // reverse worst-key snapshot
@@ -1495,20 +2072,32 @@ int32_t repair_topk_candidates_mt(
   // prune-only fast path, never a float change, so bit-identity with
   // the full sweep holds by construction.
   const bool lb_ok = w_proximity >= 0.0f;
-  const auto sweep_column = [&](int32_t p, uint64_t* rb,
-                                std::vector<Ent>* ent_out, int tid) {
-    for (int32_t j = 0; j < reverse_r; ++j) rb[j] = pad_key;
+  // Block-skip (the vectorized widening of the precheck above): one
+  // lane-block lower-bound test in the FLOAT domain retires a whole
+  // block of rows before any admissibility or scoring work. Soundness:
+  // pack_key is monotone in cost with id 0 minimal, so the key-domain
+  // tests above are implied by the cost-domain tests lb <= worst_cost /
+  // lb <= theta_cost — the float test admits a conservative SUPERSET of
+  // lanes. Survivor lanes fall through to the EXACT per-cell sequence
+  // (bucket gate, key-domain precheck, score), so the set of scored
+  // cells — and therefore every float, every key, and the cells[] stat
+  // — is identical to the scalar sweep. The block test reads the
+  // reverse worst from the block's entry; it only shrinks, so staleness
+  // again only widens the survivor set.
+  const auto sweep_column_range = [&](int32_t p, uint64_t* rb,
+                                      std::vector<Ent>* ent_out, int tid,
+                                      int32_t t0, int32_t t1) {
     if (!pf->valid[p]) return;
     const bool p_gpu = pf->has_gpu[p] != 0;
     const int32_t b = provider_bucket(pf, p);
     const int32_t mb = b >= 2 ? (b - 2) / kCountBuckets : 0;
     const int32_t cb = b >= 2 ? (b - 2) % kCountBuckets : 0;
-    for (int32_t t = 0; t < T; ++t) {
+    const auto cell = [&](int32_t t) {
       if (b >= 2 &&
           !bucket_admits_task(rf, t, K, W, ts_all[t], p_gpu, mb, cb)) {
-        continue;
+        return;
       }
-      if (b == 1 && ts_all[t].any_opt) continue;  // no GPU
+      if (b == 1 && ts_all[t].any_opt) return;  // no GPU
       if (lb_ok) {
         const uint64_t lbkey =
             pack_key(pre.base[p] - ts_all[t].prio, 0);
@@ -1516,12 +2105,13 @@ int32_t repair_topk_candidates_mt(
         const bool fwd_possible =
             ent_out != nullptr && !in_dt[t] &&
             (not_full[t] || lbkey <= theta[t]);
-        if (!rev_possible && !fwd_possible) continue;
+        if (!rev_possible && !fwd_possible) return;
       }
       const float c =
-          score_cell(pf, rf, pre, ts_all[t], t, K, W, p, w_proximity);
+          score_cell_isa(isa, pf, rf, pre, ts_all[t], t, K, W, p,
+                         w_proximity);
       ++cells[tid];
-      if (c >= kInfeasible * 0.5f) continue;
+      if (c >= kInfeasible * 0.5f) return;
       const float cj = c + jitter(p, t);
       const uint64_t rkey = pack_key(cj, static_cast<uint32_t>(t));
       if (rkey < rb[reverse_r - 1]) {
@@ -1533,14 +2123,47 @@ int32_t repair_topk_candidates_mt(
           ent_out->push_back({t, fkey});
         }
       }
+    };
+    int32_t t = t0;
+    if (isa != kIsaScalar && lb_ok) {
+      const int use_fwd = ent_out != nullptr ? 1 : 0;
+      const float base_p = pre.base[p];
+      for (; t + ops.width <= t1; t += ops.width) {
+        const float rw = unpack_key_cost(rb[reverse_r - 1]);
+        uint32_t m = ops.lb_survivors(base_p, prio_all.data() + t,
+                                      theta_cost.data() + t,
+                                      not_full.data() + t, rw, use_fwd);
+        while (m != 0) {
+          const int32_t j = __builtin_ctz(m);
+          m &= m - 1;
+          cell(t + j);  // ctz walks lanes in ascending t: scalar order
+        }
+      }
     }
+    for (; t < t1; ++t) cell(t);
+  };
+  const auto sweep_column = [&](int32_t p, uint64_t* rb,
+                                std::vector<Ent>* ent_out, int tid) {
+    for (int32_t j = 0; j < reverse_r; ++j) rb[j] = pad_key;
+    sweep_column_range(p, rb, ent_out, tid, 0, T);
   };
 
+  // Cache-blocked transposed pass: the naive loop sweeps each dirty
+  // column over ALL T rows before moving on, streaming the full
+  // per-task side arrays (ts_all/theta/not_full, ~50 B/row) through
+  // cache once PER COLUMN. Tiling swaps the loops — a t-tile of side
+  // arrays stays resident while every dirty column visits it. Each
+  // column's reverse state lives in its own rev_io row (thread-owned:
+  // providers are partitioned by chunk) and persists across tiles;
+  // within a column t still ascends monotonically, so inserts happen in
+  // the exact order of the untiled sweep — bit-identical lists, keys,
+  // and cell counts. Entrant push order changes across tiles, which is
+  // invisible: entrants are globally sorted before use.
+  constexpr int32_t kSweepTile = 4096;
   const int32_t pchunk = (n_dp + nt - 1) / nt;
   par([&](int tid) {
     const int32_t lo = std::min<int32_t>(tid * pchunk, n_dp);
     const int32_t hi = std::min<int32_t>(lo + pchunk, n_dp);
-    std::vector<uint64_t> rb(reverse_r);
     for (int32_t i = lo; i < hi; ++i) {
       const int32_t p = dirty_p[i];
       if (p < 0 || p >= P) continue;
@@ -1549,11 +2172,24 @@ int32_t repair_topk_candidates_mt(
         if (unpack_key_cost(dst[j]) >= kInfeasible * 0.5f) break;
         aff[tid].push_back(static_cast<int32_t>(dst[j] & 0xffffffffu));
       }
-      sweep_column(p, rb.data(), &ents[tid], tid);
-      std::memcpy(dst, rb.data(), static_cast<size_t>(reverse_r) * 8);
+      for (int32_t j = 0; j < reverse_r; ++j) dst[j] = pad_key;
+    }
+    for (int32_t tt = 0; tt < T; tt += kSweepTile) {
+      const int32_t te = std::min<int32_t>(tt + kSweepTile, T);
+      for (int32_t i = lo; i < hi; ++i) {
+        const int32_t p = dirty_p[i];
+        if (p < 0 || p >= P) continue;
+        sweep_column_range(p, rev_io + static_cast<size_t>(p) * reverse_r,
+                           &ents[tid], tid, tt, te);
+      }
+    }
+    for (int32_t i = lo; i < hi; ++i) {
+      const int32_t p = dirty_p[i];
+      if (p < 0 || p >= P) continue;
+      const uint64_t* dst = rev_io + static_cast<size_t>(p) * reverse_r;
       for (int32_t j = 0; j < reverse_r; ++j) {  // new edges -> affected
-        if (unpack_key_cost(rb[j]) >= kInfeasible * 0.5f) break;
-        aff[tid].push_back(static_cast<int32_t>(rb[j] & 0xffffffffu));
+        if (unpack_key_cost(dst[j]) >= kInfeasible * 0.5f) break;
+        aff[tid].push_back(static_cast<int32_t>(dst[j] & 0xffffffffu));
       }
     }
   });
@@ -1678,8 +2314,8 @@ int32_t repair_topk_candidates_mt(
     const bool full = n_adm >= static_cast<int64_t>(coverage_frac * P);
     if (full) ++fb_rows[tid];
     const auto visit = [&](int32_t p) {
-      const float c =
-          score_cell(pf, rf, pre, ts_all[t], t, K, W, p, w_proximity);
+      const float c = score_cell_isa(isa, pf, rf, pre, ts_all[t], t, K, W,
+                                     p, w_proximity);
       ++cells[tid];
       if (c >= kInfeasible * 0.5f) return;
       const float cj = c + jitter(p, t);

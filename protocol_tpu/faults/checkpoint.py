@@ -282,6 +282,10 @@ class SessionCheckpointer:
                 "warm_solves": state.pop("warm_solves"),
                 "dual_age": state.pop("dual_age"),
                 "weights_key": list(state.pop("weights_key")),
+                # float-pipeline provenance (string scalar — rides the
+                # JSON meta, not the array pack); restore_state cold
+                # re-grounds on a mismatched-ISA load
+                "native_isa": state.pop("native_isa", "scalar"),
             }
         req = pb.AssignRequestV2(
             providers=wire.encode_providers_v2(
@@ -430,6 +434,7 @@ class SessionCheckpointer:
             arena_state["weights_key"] = tuple(
                 am.get("weights_key") or meta["weights"]
             )
+            arena_state["native_isa"] = str(am.get("native_isa", "scalar"))
             arena.restore_state(
                 tfmt._as_ns(p_cols), tfmt._as_ns(r_cols), arena_state
             )

@@ -29,18 +29,36 @@ _SO_VARIANTS = {
     "": "libassign_engine.so",
     "tsan": "libassign_engine.tsan.so",
     "asan": "libassign_engine.asan.so",
+    # ISA variants: identical codegen (all per-ISA kernels are compiled
+    # into every .so via target attributes), different BAKED default for
+    # hosts with no env plumbing — the runtime dispatch still clamps to
+    # what the CPU actually supports
+    "avx2": "libassign_engine.avx2.so",
+    "avx512": "libassign_engine.avx512.so",
 }
 _SANITIZE_FLAGS = {
     "tsan": ["-fsanitize=thread"],
     "asan": ["-fsanitize=address,undefined", "-fno-sanitize-recover=all"],
 }
+_ISA_VARIANT_FLAGS = {
+    "avx2": ["-DENGINE_DEFAULT_ISA=1"],
+    "avx512": ["-DENGINE_DEFAULT_ISA=2"],
+}
 # -march=x86-64-v2 (SSE4.2/POPCNT baseline, 2009+ hardware) instead of
 # -march=native: a .so built on a dev box must load on any CI/prod host,
 # and sanitizer builds want a stable ISA so reports reproduce across
 # machines. Override via NATIVE_CFLAGS for tuned local builds.
-_DEFAULT_CFLAGS = "-O3 -march=x86-64-v2"
+# -ffp-contract=off: the per-ISA determinism contract demands that plain
+# a*b+c NEVER silently fuses — every fma in the engine is an explicit
+# fmaf/vfmadd, so each ISA has exactly one float pipeline regardless of
+# compiler version or opt level.
+_DEFAULT_CFLAGS = "-O3 -march=x86-64-v2 -ffp-contract=off"
 
 _libs: dict[str, ctypes.CDLL] = {}
+
+# runtime ISA codes — must match the kIsa* constants in assign_engine.cpp
+ISA_NAMES = {0: "scalar", 1: "avx2", 2: "avx512"}
+_ISA_CODES = {"scalar": 0, "avx2": 1, "avx512": 2}
 
 
 class NativeBuildError(RuntimeError):
@@ -61,13 +79,47 @@ def sanitize_variant() -> str:
     return v
 
 
+def isa_request() -> Optional[str]:
+    """Requested runtime ISA from PROTOCOL_TPU_NATIVE_ISA
+    (scalar|avx2|avx512|auto), or None when unset — the loaded .so then
+    keeps its baked default (scalar for the production build, so every
+    committed golden stays valid without any env). ``auto`` requests the
+    widest ISA and lets the engine clamp to host support. Read per
+    load() call, like sanitize_variant()."""
+    v = os.environ.get("PROTOCOL_TPU_NATIVE_ISA", "").strip().lower()
+    if v == "":
+        return None
+    if v not in ("scalar", "avx2", "avx512", "auto"):
+        raise NativeBuildError(
+            "PROTOCOL_TPU_NATIVE_ISA must be scalar|avx2|avx512|auto, "
+            f"got {v!r}"
+        )
+    return v
+
+
+def isa_build_variant() -> str:
+    """Baked-default build variant from PROTOCOL_TPU_NATIVE_ISA_VARIANT
+    ("" | "avx2" | "avx512") — selects which .so load() uses when no
+    sanitizer variant is active (sanitize wins: its .so carries all ISA
+    kernels too, and the runtime env forces dispatch paths under the
+    instrumented build)."""
+    v = os.environ.get("PROTOCOL_TPU_NATIVE_ISA_VARIANT", "").strip().lower()
+    if v in ("", "0", "off", "none"):
+        return ""
+    if v not in _ISA_VARIANT_FLAGS:
+        raise NativeBuildError(
+            f"PROTOCOL_TPU_NATIVE_ISA_VARIANT must be avx2|avx512, got {v!r}"
+        )
+    return v
+
+
 def so_path(variant: str = "") -> str:
     return os.path.join(_REPO_ROOT, "native", _SO_VARIANTS[variant])
 
 
 def _cflags(variant: str) -> list[str]:
     flags = os.environ.get("NATIVE_CFLAGS", _DEFAULT_CFLAGS).split()
-    if variant:
+    if variant in _SANITIZE_FLAGS:
         # sanitizer builds: drop the opt level (and any -march=native a
         # local override smuggled in) for -O1 -g + the sanitizer flags
         flags = [
@@ -75,6 +127,8 @@ def _cflags(variant: str) -> list[str]:
             if not f.startswith("-O") and f != "-march=native"
         ]
         flags = ["-O1", "-g", *_SANITIZE_FLAGS[variant], *flags]
+    elif variant in _ISA_VARIANT_FLAGS:
+        flags = [*flags, *_ISA_VARIANT_FLAGS[variant]]
     return flags
 
 
@@ -165,14 +219,24 @@ def load() -> ctypes.CDLL:
     PROTOCOL_TPU_NATIVE_SANITIZE=tsan|asan selects the instrumented
     variant (run under the matching LD_PRELOADed runtime — see
     scripts/sanitize_native.py, which drives exactly that)."""
-    variant = sanitize_variant()
+    variant = sanitize_variant() or isa_build_variant()
+    isa = isa_request()  # parse (and reject bad values) before any work
     cached = _libs.get(variant)
     if cached is not None:
+        if isa is not None:
+            _apply_isa(cached, isa)
         return cached
     so = so_path(variant)
     if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(_SRC):
         _build(variant)
     lib = ctypes.CDLL(so)
+
+    lib.engine_isa_supported.argtypes = [ctypes.c_int32]
+    lib.engine_isa_supported.restype = ctypes.c_int32
+    lib.engine_set_isa.argtypes = [ctypes.c_int32]
+    lib.engine_set_isa.restype = ctypes.c_int32
+    lib.engine_get_isa.argtypes = []
+    lib.engine_get_isa.restype = ctypes.c_int32
 
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -252,7 +316,44 @@ def load() -> ctypes.CDLL:
     ]
     lib.sinkhorn_sparse_mt.restype = ctypes.c_int32
     _libs[variant] = lib
+    if isa is not None:
+        _apply_isa(lib, isa)
     return lib
+
+
+def _apply_isa(lib: ctypes.CDLL, isa: str) -> None:
+    """Force the engine's runtime ISA. ``auto`` requests the widest; the
+    engine clamps every request to host support (graceful fallback: the
+    call never fails, engine_get_isa reports what actually runs)."""
+    lib.engine_set_isa(_ISA_CODES.get(isa, max(_ISA_CODES.values())))
+
+
+def current_isa() -> str:
+    """The ISA tag the engine is actually scoring with right now — the
+    provenance value threaded through stats, obs, and trace frames."""
+    return ISA_NAMES[int(load().engine_get_isa())]
+
+
+def set_isa(isa: str) -> str:
+    """Force the runtime ISA for this process (persisted via the env var
+    so later load() calls — any variant — agree). Returns the EFFECTIVE
+    ISA name after host-support clamping."""
+    if isa not in ("scalar", "avx2", "avx512", "auto"):
+        raise NativeBuildError(
+            f"isa must be scalar|avx2|avx512|auto, got {isa!r}"
+        )
+    os.environ["PROTOCOL_TPU_NATIVE_ISA"] = isa
+    load()
+    return current_isa()
+
+
+def isa_supported(isa: str) -> bool:
+    """True when the host CPU (and build) can run ``isa`` exactly."""
+    if isa == "auto":
+        return True
+    if isa not in _ISA_CODES:
+        return False
+    return bool(load().engine_isa_supported(_ISA_CODES[isa]))
 
 
 # --------------- engine phase stats (observability plane) ---------------
@@ -345,6 +446,10 @@ def _parse_stats(stats: dict, buf, layout: dict) -> None:
     the fused kernel more than once per solve)."""
     if buf is None:
         return
+    # provenance tag: which float pipeline produced these numbers (and
+    # the plan they describe) — threaded verbatim into arena last_stats,
+    # obs /metrics.json, and trace OUTCOME frames
+    stats["native_isa"] = current_isa()
     for name, slot in layout.items():
         v = int(buf[slot])
         if name.endswith("_ns"):

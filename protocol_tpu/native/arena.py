@@ -267,6 +267,10 @@ class NativeSolveArena:
             "warm_solves": int(self._warm_solves),
             "dual_age": int(self._dual_age),
             "weights_key": tuple(self._weights_key),
+            # float-pipeline provenance: the candidate structure's costs
+            # were scored under this ISA — a restore under a different
+            # one cannot be repaired bit-exactly (see restore_state)
+            "native_isa": native.current_isa(),
         }
         # the arena's OWN dirty-detection baseline (it can lag the
         # session's current columns when degraded ticks applied deltas
@@ -318,8 +322,15 @@ class NativeSolveArena:
         # regenerated structure anyway)
         n_p = self._p_fields["gpu_count"].shape[0]
         n_t = self._r_fields["cpu_cores"].shape[0]
+        # ISA-skewed carry: the exported costs came from a different
+        # float pipeline than this process runs, so repairing against
+        # them would break the bit-identical-to-rebuild promise — same
+        # honest cold re-ground as a config skew. Pre-ISA checkpoints
+        # (no tag) were scored by the historical scalar pipeline.
+        exported_isa = state.get("native_isa", "scalar")
         if (
             rev is None
+            or exported_isa != native.current_isa()
             or np.asarray(rev).shape != (n_p, self.reverse_r)
             or self._cand_p.ndim != 2
             or self._cand_p.shape
@@ -574,6 +585,7 @@ class NativeSolveArena:
             if obs.enabled() else {}
         )
         self.last_stats = {
+            "native_isa": native.current_isa(),
             **qual,
             "cold": True,
             "engine": self.engine,
@@ -744,6 +756,7 @@ class NativeSolveArena:
         if n_dp == 0 and n_dt == 0:
             self.last_repair_mask = None
             self.last_stats = {
+                "native_isa": native.current_isa(),
                 "cold": False, "event": True, "rows": T,
                 "cand_cold_passes": 0, "dirty_providers": 0,
                 "dirty_tasks": 0, "changed_rows": 0,
@@ -816,6 +829,7 @@ class NativeSolveArena:
         # trace metrics; arrays do not)
         self.last_repair_mask = repair
         self.last_stats = {
+            "native_isa": native.current_isa(),
             "cold": False,
             "event": True,
             "engine": self.engine,
@@ -880,6 +894,7 @@ class NativeSolveArena:
             if obs.enabled() else {}
         )
         self.last_stats = {
+            "native_isa": native.current_isa(),
             **qual,
             "cold": False,
             "reconcile": True,
@@ -923,7 +938,10 @@ class NativeSolveArena:
         P = pf["gpu_count"].shape[0]
         T = rf["cpu_cores"].shape[0]
         if P == 0 or T == 0:
-            self.last_stats = {"cold": True, "assigned": 0}
+            self.last_stats = {
+                "native_isa": native.current_isa(),
+                "cold": True, "assigned": 0,
+            }
             return np.full(T, -1, np.int32)
 
         if (
@@ -991,6 +1009,7 @@ class NativeSolveArena:
                 )
                 self._last_quality = qual
             self.last_stats = {
+                "native_isa": native.current_isa(),
                 **qual,
                 "cold": False,
                 "rows": T,
@@ -1126,6 +1145,7 @@ class NativeSolveArena:
             if obs.enabled() else {}
         )
         self.last_stats = {
+            "native_isa": native.current_isa(),
             **qual,
             "cold": False,
             "engine": self.engine,

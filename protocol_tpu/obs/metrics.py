@@ -184,6 +184,9 @@ class _SessionObs:
         # dedup / reconcile / divergence / repair-scope counters
         "events", "events_deduped", "events_reconciled",
         "event_divergence_max", "event_repair_rows",
+        # float-pipeline provenance: which native ISA scored this
+        # session's plans (last write wins — the tag is a setting)
+        "native_isa",
     )
 
     def __init__(self):
@@ -214,6 +217,7 @@ class _SessionObs:
         self.events_reconciled = 0
         self.event_divergence_max = 0
         self.event_repair_rows = 0
+        self.native_isa: Optional[str] = None
 
     def reuse_ratio(self) -> float:
         """Fraction of candidate rows the warm path did NOT recompute."""
@@ -435,6 +439,9 @@ class ObsRegistry:
                         stats.get("eng_cand_repair_rescans", 0)
                     )
                     s.observe_quality(stats)
+                    isa = stats.get("native_isa")
+                    if isa is not None:
+                        s.native_isa = str(isa)
                 s.delta_rows += int(delta_rows)
             alerts: list = []
             if self._slo is not None:
@@ -511,6 +518,8 @@ class ObsRegistry:
                 "arena_reuse_ratio": round(s.reuse_ratio(), 4),
                 "delta_rows": s.delta_rows,
             }
+            if s.native_isa is not None:
+                out["native_isa"] = s.native_isa
             if s.stale_ticks:
                 out["stale_ticks"] = s.stale_ticks
                 out["stale_streak_max"] = s.stale_streak_max

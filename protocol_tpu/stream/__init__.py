@@ -28,6 +28,7 @@ __all__ = [
     "SourceDedup",
     "StreamEngine",
     "StreamEvent",
+    "StreamResult",
     "batch_shadow_replay",
     "coalesce",
     "event_from_delta",

@@ -23,6 +23,7 @@ event:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Optional
 
@@ -30,10 +31,48 @@ import numpy as np
 
 from protocol_tpu.obs.metrics import percentiles_ms
 from protocol_tpu.stream.engine import StreamEngine
-from protocol_tpu.stream.events import StreamEvent, event_from_delta
+from protocol_tpu.stream.events import event_from_delta
 from protocol_tpu.trace import format as tfmt
 
 _ARENA_ENGINE = {"native-mt": "auction", "sinkhorn-mt": "sinkhorn"}
+
+
+@contextlib.contextmanager
+def _pin_recorded_isa(meta: dict):
+    """Pin the native float pipeline to the one that PRODUCED the
+    trace for the duration of a replay — the same contract as the batch
+    replay (trace/replay.py): bit-for-bit outcome verification is only
+    meaningful under the same per-ISA pipeline, and pre-ISA traces were
+    recorded by the historical scalar pipeline. A host that cannot run
+    the recorded ISA clamps down and verification reports honest
+    divergence. Yields the EFFECTIVE isa (None when no native
+    toolchain) and restores the caller's env var + effective ISA on
+    exit — the pin is scoped to the replay, not the process."""
+    import os as _os
+
+    from protocol_tpu import native as _native
+
+    pinned = str(meta.get("recorded_isa", "scalar"))
+    prev_env = _os.environ.get("PROTOCOL_TPU_NATIVE_ISA")
+    prev_eff: Optional[str] = None
+    effective: Optional[str] = None
+    try:
+        prev_eff = _native.current_isa()
+        effective = _native.set_isa(pinned)
+    except _native.NativeBuildError:
+        pass  # no toolchain: arena construction will fail honestly
+    try:
+        yield effective
+    finally:
+        if prev_env is None:
+            _os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+        else:
+            _os.environ["PROTOCOL_TPU_NATIVE_ISA"] = prev_env
+        try:
+            if prev_eff is not None:
+                _native._apply_isa(_native.load(), prev_eff)
+        except _native.NativeBuildError:
+            pass
 
 
 def _open_arena(snap: tfmt.Snapshot, engine: str, threads: int):
@@ -95,9 +134,31 @@ def stream_replay(
     delivered in the chaos'd order with duplicates injected; recorded-
     outcome verification is skipped (intermediate plans legitimately
     differ) and the caller compares final reconciled plans instead."""
+    trace = tfmt.read_trace(trace_path)
+    with _pin_recorded_isa(trace.meta) as effective_isa:
+        return _stream_replay(
+            trace, trace_path, engine, threads, reconcile_every,
+            gap_ceiling, verify, record_path, chaos, final_reconcile,
+            keep_recon_p4ts, effective_isa,
+        )
+
+
+def _stream_replay(
+    trace: tfmt.Trace,
+    trace_path: str,
+    engine: Optional[str],
+    threads: Optional[int],
+    reconcile_every: Optional[int],
+    gap_ceiling: Optional[float],
+    verify: bool,
+    record_path: Optional[str],
+    chaos,
+    final_reconcile: bool,
+    keep_recon_p4ts: bool,
+    effective_isa: Optional[str],
+) -> dict:
     from protocol_tpu.trace.replay import parse_engine
 
-    trace = tfmt.read_trace(trace_path)
     snap = trace.snapshot
     if snap is None:
         raise ValueError(f"{trace_path}: no snapshot frame")
@@ -141,6 +202,10 @@ def stream_replay(
             recorded_threads=n_threads,
             source_trace=trace_path,
         )
+        if effective_isa is not None:
+            # provenance for the NEXT replay's pin (and the CI
+            # replay-identity job's audit of committed goldens)
+            meta["recorded_isa"] = effective_isa
         writer = tfmt.TraceWriter(record_path, meta=meta)
         writer.write_snapshot(
             snap.trace_id, snap.fingerprint, snap.request_v2()
@@ -269,9 +334,24 @@ def batch_shadow_replay(
     boundary (event counts, 1-based) with a fresh always-cold arena —
     "the equivalent batch replay" the stream engine's reconcile must be
     bit-identical to. Returns {"p4ts": [plan per boundary], ...}."""
+    trace = tfmt.read_trace(trace_path)
+    # the oracle must solve under the SAME recorded pipeline as the
+    # stream replay it is compared against, or the bit-identity gate
+    # would report cross-ISA float noise as a reconcile bug
+    with _pin_recorded_isa(trace.meta):
+        return _batch_shadow_replay(trace, trace_path, boundaries,
+                                    engine, threads)
+
+
+def _batch_shadow_replay(
+    trace: tfmt.Trace,
+    trace_path: str,
+    boundaries: list,
+    engine: Optional[str],
+    threads: Optional[int],
+) -> dict:
     from protocol_tpu.trace.replay import parse_engine
 
-    trace = tfmt.read_trace(trace_path)
     snap = trace.snapshot
     if snap is None:
         raise ValueError(f"{trace_path}: no snapshot frame")

@@ -328,6 +328,30 @@ def replay(
             )
     n_threads = eng_threads if threads is None else int(threads)
 
+    # Pin the float pipeline to the one that PRODUCED the trace:
+    # bit-for-bit outcome verification is only meaningful under the same
+    # per-ISA pipeline (the determinism contract is within-ISA). Pre-ISA
+    # traces carry no tag and were recorded by the historical scalar
+    # pipeline. A host that cannot run the recorded ISA clamps down and
+    # verification reports honest divergence (never a crash). The jax
+    # engine never touches the native pipeline — no pin.
+    pinned_isa: Optional[str] = None
+    prev_isa_env: Optional[str] = None
+    prev_isa_eff: Optional[str] = None
+    effective_isa: Optional[str] = None
+    if eng != "jax":
+        import os as _os
+
+        from protocol_tpu import native as _native
+
+        pinned_isa = str(trace.meta.get("recorded_isa", "scalar"))
+        prev_isa_env = _os.environ.get("PROTOCOL_TPU_NATIVE_ISA")
+        try:
+            prev_isa_eff = _native.current_isa()
+            effective_isa = _native.set_isa(pinned_isa)
+        except _native.NativeBuildError:
+            pinned_isa = None  # no toolchain: backends will fail honestly
+
     if transport == "inproc":
         if eng == "jax":
             backend = _InprocJax(snap, n_threads)
@@ -346,6 +370,10 @@ def replay(
             recorded_engine=eng, recorded_threads=n_threads,
             recorded_transport=transport, source_trace=trace_path,
         )
+        if effective_isa is not None:
+            # provenance for the NEXT replay's pin (and the CI
+            # replay-identity job's audit of committed goldens)
+            meta["recorded_isa"] = effective_isa
         writer = tfmt.TraceWriter(record_path, meta=meta)
         # the recorded epoch carries the kernel that actually solved it
         rsnap = tfmt.Snapshot(
@@ -441,6 +469,25 @@ def replay(
         backend.close()
         if writer is not None:
             writer.close()
+        if pinned_isa is not None:
+            # restore the caller's ISA selection (the pin is scoped to
+            # this replay, not the process): the env var goes back to
+            # its prior state and the engine back to its prior
+            # EFFECTIVE isa (which may be a baked variant default, not
+            # scalar)
+            import os as _os
+
+            from protocol_tpu import native as _native
+
+            if prev_isa_env is None:
+                _os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+            else:
+                _os.environ["PROTOCOL_TPU_NATIVE_ISA"] = prev_isa_env
+            try:
+                if prev_isa_eff is not None:
+                    _native._apply_isa(_native.load(), prev_isa_eff)
+            except _native.NativeBuildError:
+                pass
 
     walls = report["tick_wall_ms"]
     if walls:

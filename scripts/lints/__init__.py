@@ -6,6 +6,8 @@ modules for the catalog:
   lock-discipline session/arena state only under its lock (services)
   dtype-contract  one canonical dtype table across wire/arena/encoding
   dense-alloc     no O(P*T) numpy allocations outside ops/blocked.py
+  isa-dispatch    intrinsics confined to the engine's PER-ISA section
+                  (every vector path routes through the kIsaOps table)
 
 Run: ``python -m scripts.lints`` (exit 1 on any finding — the clippy
 ``-D warnings`` discipline of the reference CI, applied to the
@@ -17,10 +19,13 @@ analyzer (``python -m scripts.analysis`` — lock-order graph, session-
 protocol state machine, jax purity; see scripts/analysis/).
 """
 
-from scripts.lints import densealloc, determinism, dtype_contract, lockdiscipline  # noqa: F401
+from scripts.lints import (  # noqa: F401
+    densealloc, determinism, dtype_contract, isa_dispatch, lockdiscipline,
+)
 from scripts.lints.base import RULES, Finding, Rule, Source, register, run_rules
 
 __all__ = [
     "RULES", "Finding", "Rule", "Source", "register", "run_rules",
     "determinism", "lockdiscipline", "dtype_contract", "densealloc",
+    "isa_dispatch",
 ]

@@ -1,0 +1,30 @@
+// Seeded violations for the isa-dispatch rule: raw intrinsics, vector
+// types, and an unescaped immintrin include OUTSIDE the delimited
+// PER-ISA section — each seeded line below must be exactly one finding.
+#include <cstdint>
+#include <immintrin.h>  // SEED: isa-dispatch (include without the audited escape)
+
+// intrinsic call in a plain entry point: executes unconditionally on
+// the baseline build (no target attribute) — SIGILL on pre-AVX2 hosts
+static float bad_entry_sum(const float* x) {
+  return _mm256_cvtss_f32(_mm256_loadu_ps(x));  // SEED: isa-dispatch
+}
+
+// vector TYPE leaking outside the section is the same contract break
+static __m512 bad_state;  // SEED: isa-dispatch
+
+// gcc builtin spelling of the same escape hatch
+static int bad_popcnt(unsigned v) {
+  return __builtin_ia32_popcountsi2(v);  // SEED: isa-dispatch
+}
+
+// ==== BEGIN PER-ISA KERNELS (isa-dispatch) =================================
+__attribute__((target("avx2"))) static float inside_is_fine(const float* x) {
+  return _mm256_cvtss_f32(_mm256_loadu_ps(x));
+}
+// ==== END PER-ISA KERNELS (isa-dispatch) ===================================
+
+// the section does not launder code BELOW it
+static float bad_after_section(const float* x) {
+  return _mm_cvtss_f32(_mm_load_ss(x));  // SEED: isa-dispatch
+}

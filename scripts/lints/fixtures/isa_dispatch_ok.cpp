@@ -1,0 +1,34 @@
+// Clean twin for the isa-dispatch rule: the include carries the audited
+// escape, every intrinsic lives inside the delimited section, forward
+// DECLARATIONS (no intrinsic tokens) are legal outside it, and the one
+// deliberate exemption uses the rule's escape annotation.
+#include <cstdint>
+#include <immintrin.h>  // lint: isa-dispatch-include
+
+// target-attributed forward declaration: no intrinsic tokens, legal
+__attribute__((target("avx2"))) float lane_sum_avx2(const float* x);
+
+// deliberate exemption, escape-annotated (the audit trail): a vector
+// TYPE in a sizeof probe — no instruction executes, so it may stay out
+static const int kLaneBytes = sizeof(__m256);  // lint: isa-dispatch-ok
+
+// ==== BEGIN PER-ISA KERNELS (isa-dispatch) =================================
+__attribute__((target("avx2"))) float lane_sum_avx2(const float* x) {
+  __m256 v = _mm256_loadu_ps(x);
+  float out[8];
+  _mm256_storeu_ps(out, v);
+  float acc = 0.0f;
+  for (int i = 0; i < 8; ++i) acc += out[i];
+  return acc;
+}
+// ==== END PER-ISA KERNELS (isa-dispatch) ===================================
+
+// entry points route through a dispatch seam, never call lanes directly
+typedef float (*sum_fn)(const float*);
+static float scalar_sum(const float* x) {
+  float acc = 0.0f;
+  for (int i = 0; i < 8; ++i) acc += x[i];
+  return acc;
+}
+static const sum_fn kSumOps[2] = {scalar_sum, lane_sum_avx2};
+float entry_sum(const float* x, int isa) { return kSumOps[isa](x); }

@@ -1,0 +1,129 @@
+"""ISA-dispatch rule: intrinsics live ONLY in the per-ISA section.
+
+The native engine's determinism contract (ISSUE 16) hangs on a single
+choke point: every vector instruction is confined to the delimited
+``PER-ISA KERNELS`` section of ``native/assign_engine.cpp`` and reached
+exclusively through the ``kIsaOps`` dispatch table, with the scalar row
+as referee. A raw ``_mm256_*`` call sprinkled into an entry point
+outside that section would (a) execute unconditionally — SIGILL on any
+pre-AVX2 host the baseline ``-march=x86-64-v2`` build is supposed to
+carry, because only section functions wear the ``target`` attributes —
+and (b) fork the float pipeline outside the per-ISA golden contract, so
+plans drift between hosts with no ISA tag naming why.
+
+This rule makes the boundary mechanical, textually (the engine source
+is C++; no AST here):
+
+  * any intrinsic token — ``_mm*_...`` calls, ``__m128/__m256/__m512``
+    vector types, ``__builtin_ia32_*`` — outside the
+    ``==== BEGIN PER-ISA KERNELS (isa-dispatch)`` /
+    ``==== END PER-ISA KERNELS (isa-dispatch)`` delimiters is a finding
+    (one per line; target-attributed forward DECLARATIONS carry no
+    intrinsic tokens and stay legal, so headers can pre-declare the
+    section's kernels),
+  * ``#include <immintrin.h>`` outside the section must carry the
+    audited escape ``// lint: isa-dispatch-include``,
+  * an unbalanced BEGIN/END pair is itself a finding — a truncated
+    section would silently legalize everything below it.
+
+Escape: ``// lint: isa-dispatch-ok`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+from scripts.lints.base import REPO, Finding, Rule, register
+
+_BEGIN = "BEGIN PER-ISA KERNELS (isa-dispatch)"
+_END = "END PER-ISA KERNELS (isa-dispatch)"
+
+_INTRINSIC = re.compile(
+    r"(_mm\d{0,3}_\w+|__m(?:128|256|512)[id]?\b|__builtin_ia32_\w+)"
+)
+_INCLUDE = re.compile(r"#\s*include\s*<x?immintrin\.h>")
+
+
+@register
+class IsaDispatchRule(Rule):
+    name = "isa-dispatch"
+    suppress_token = "isa-dispatch-ok"
+
+    def __init__(self, native_glob: str = "native/*.cpp"):
+        self.native_glob = native_glob
+
+    def applies(self, rel: str) -> bool:
+        # C++-only rule: the python walk never feeds it; everything
+        # happens in the cross-file pass below
+        return False
+
+    def _files(self) -> list[pathlib.Path]:
+        pattern = pathlib.Path(self.native_glob)
+        if pattern.is_absolute():
+            return sorted(pattern.parent.glob(pattern.name))
+        return sorted(REPO.glob(self.native_glob))
+
+    def check_repo(self) -> list[Finding]:
+        out: list[Finding] = []
+        for path in self._files():
+            out.extend(self._check_file(path))
+        return out
+
+    def _check_file(self, path: pathlib.Path) -> list[Finding]:
+        try:
+            rel = str(path.resolve().relative_to(REPO))
+        except ValueError:
+            rel = str(path)
+        lines = path.read_text(errors="replace").splitlines()
+        out: list[Finding] = []
+        inside = False
+        begin_line = 0
+        for lineno, text in enumerate(lines, 1):
+            if _BEGIN in text:
+                if inside:
+                    out.append(Finding(
+                        self.name, rel, lineno,
+                        "nested PER-ISA section BEGIN (previous BEGIN at "
+                        f"line {begin_line} never closed)",
+                    ))
+                inside, begin_line = True, lineno
+                continue
+            if _END in text:
+                if not inside:
+                    out.append(Finding(
+                        self.name, rel, lineno,
+                        "PER-ISA section END without a matching BEGIN",
+                    ))
+                inside = False
+                continue
+            if inside:
+                continue
+            if f"lint: {self.suppress_token}" in text or "lint: ok" in text:
+                continue
+            if _INCLUDE.search(text):
+                if "lint: isa-dispatch-include" in text:
+                    continue
+                out.append(Finding(
+                    self.name, rel, lineno,
+                    "immintrin.h include without the audited "
+                    "'// lint: isa-dispatch-include' escape — the header "
+                    "is legal only as the section's token source",
+                ))
+                continue
+            m = _INTRINSIC.search(text)
+            if m is not None:
+                out.append(Finding(
+                    self.name, rel, lineno,
+                    f"raw intrinsic {m.group(1)!r} outside the PER-ISA "
+                    "KERNELS section — vector code must live in the "
+                    "delimited section and route through the kIsaOps "
+                    "dispatch table (baseline builds SIGILL otherwise)",
+                ))
+        if inside:
+            out.append(Finding(
+                self.name, rel, begin_line,
+                "PER-ISA section BEGIN never closed — everything below "
+                "it is silently exempt from the dispatch boundary",
+            ))
+        return out

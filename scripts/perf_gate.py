@@ -327,15 +327,26 @@ def cand_gate() -> int:
     failures = []
     w = CostWeights()
     n = 16384
+    # measurement basis: bench.synth_providers(rng(2)) x
+    # bench.synth_requirements(rng(3)) — the same population every floor
+    # in perf_floor.json's cand_* family was measured against. The ISA
+    # the run dispatched to is part of the basis too (runtime-selected
+    # per host/env), so record both in the gate output.
     ep = bench.synth_providers(np.random.default_rng(2), n)
     er = bench.synth_requirements(np.random.default_rng(3), n)
+    native.load()
+    print(
+        f"cand gate: population bench.synth_providers(rng(2)) x "
+        f"bench.synth_requirements(rng(3)), n={n}, "
+        f"native_isa={native.current_isa()}"
+    )
 
     # ---- bucketed cold pruner: bit-identical to the full scan, and it
     # genuinely prunes on this (GPU-selective) population. The
-    # reference is the v2 full scan (rev_out requested) — the
-    # persistent-structure family pins one float pipeline on every
-    # build, while the legacy entries keep the vector cost path on
-    # tuned (-march=native AVX-512) local builds
+    # reference is the v2 full scan (rev_out requested) — both paths
+    # dispatch through the same runtime ISA table (scalar/avx2/avx512),
+    # so within one process the float pipeline is pinned and the
+    # bucketed pruner must reproduce the full scan bit-for-bit
     st: dict = {}
     cand_b = native.fused_topk_candidates(
         ep, er, w, k=64, threads=2, bucketed=True, stats=st
@@ -452,6 +463,216 @@ def cand_gate() -> int:
             print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
         return 1
     print("cand perf gate OK")
+    return 0
+
+
+def simd_gate() -> int:
+    """Runtime-ISA dispatch gate (ISSUE 16): on AVX2-capable hosts the
+    vector scoring path must beat the scalar referee by >=
+    ``simd_cold_speedup_floor`` on the 16k bucketed cold candidate
+    generation at threads=1 (pure kernel throughput, no Amdahl mixing),
+    every ISA's plan must be bit-identical across threads {1, 2, 4},
+    the two vector ISAs — which share one fmaf-matched float pipeline —
+    must be bit-identical to EACH OTHER, and the widest vector plan must
+    match the scalar referee row-for-row up to the documented
+    float-pipeline tolerance (``simd_referee_cost_tol_abs`` on
+    provider-agreeing rows; near-tie provider reorders capped at
+    ``simd_referee_row_mismatch_frac_max`` of rows). The warm repair
+    sweep speedup is measured and RECORDED (printed, not floored — at
+    1% churn the sweep wall is tens of ms and host-jitter dominated).
+    On hosts without AVX2 the vector floors are not applicable and the
+    gate passes with an explicit SKIP line."""
+    import dataclasses
+    import time as _time
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import bench
+    from protocol_tpu import native
+    from protocol_tpu.ops.cost import CostWeights
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    failures: list = []
+
+    native.load()
+    if not native.isa_supported("avx2"):
+        print(
+            "simd gate: host CPU lacks AVX2 — scalar-only dispatch, "
+            "vector floors not applicable (SKIP, pass)"
+        )
+        return 0
+
+    w = CostWeights()
+    n = 16384
+    # same measurement basis as the cand gate: every simd_* floor in
+    # perf_floor.json was measured against bench.synth_providers(rng(2))
+    # x bench.synth_requirements(rng(3)) at n=16384
+    ep = bench.synth_providers(np.random.default_rng(2), n)
+    er = bench.synth_requirements(np.random.default_rng(3), n)
+    isas = ["scalar", "avx2"]
+    if native.isa_supported("avx512"):
+        isas.append("avx512")
+    print(
+        f"simd gate: population bench.synth_providers(rng(2)) x "
+        f"bench.synth_requirements(rng(3)), n={n}, isas={isas}"
+    )
+
+    prev_env = os.environ.get("PROTOCOL_TPU_NATIVE_ISA")
+    prev_isa = native.current_isa()
+
+    def cold(threads: int) -> tuple:
+        return native.fused_topk_candidates(
+            ep, er, w, k=64, threads=threads, bucketed=True
+        )
+
+    try:
+        gen_s: dict = {}
+        plans: dict = {}
+        rep_s: dict = {}
+        churn_rng = np.random.default_rng(4)
+        rows = churn_rng.choice(n, n // 100, replace=False).astype(np.int32)
+        price = np.array(ep.price, copy=True)
+        price[rows] = churn_rng.uniform(0.5, 4.0, rows.size).astype(
+            np.float32
+        )
+        ep2 = dataclasses.replace(ep, price=price)
+
+        for isa in isas:
+            eff = native.set_isa(isa)
+            if eff != isa:
+                failures.append(
+                    f"set_isa({isa!r}) clamped to {eff!r} on a host that "
+                    f"reports isa_supported({isa!r})"
+                )
+                continue
+            cold(1)  # warm run: page in the population before timing
+            best = float("inf")
+            for _ in range(2):
+                t0 = _time.perf_counter()
+                plan = cold(1)
+                best = min(best, _time.perf_counter() - t0)
+            gen_s[isa] = best
+            plans[isa] = plan
+
+            # within-ISA determinism: threads {1, 2, 4} bit-identical
+            for th in (2, 4):
+                pth = cold(th)
+                if not (
+                    np.array_equal(plan[0], pth[0])
+                    and np.array_equal(plan[1], pth[1])
+                ):
+                    failures.append(
+                        f"{isa}: bucketed cold plan differs between "
+                        f"threads=1 and threads={th}"
+                    )
+
+            # warm repair sweep (the transposed-pass kernel): build the
+            # persistent structure once, churn 1% of providers, time the
+            # in-place repair (fresh copies per rep — repair mutates)
+            rev = np.zeros((n, 8), np.uint64)
+            slack = (
+                np.zeros((n, 16), np.int32),
+                np.zeros((n, 16), np.float32),
+            )
+            cp, cc = native.fused_topk_candidates(
+                ep, er, w, k=64, threads=1, bucketed=True, rev_out=rev,
+                slack_out=slack,
+            )
+            best_r = float("inf")
+            for _ in range(3):
+                cp_i = np.array(cp, copy=True)
+                cc_i = np.array(cc, copy=True)
+                rev_i = np.array(rev, copy=True)
+                slack_i = (
+                    np.array(slack[0], copy=True),
+                    np.array(slack[1], copy=True),
+                )
+                t0 = _time.perf_counter()
+                native.repair_topk_candidates(
+                    ep2, er, w, cp_i, cc_i, rev_i, rows,
+                    np.zeros(0, np.int32), k=64, threads=1,
+                    slack=slack_i, stats={},
+                )
+                best_r = min(best_r, _time.perf_counter() - t0)
+            rep_s[isa] = best_r
+
+        # ---- throughput floor: widest vector ISA vs the scalar referee
+        if "scalar" in gen_s and "avx2" in gen_s:
+            v = "avx512" if "avx512" in gen_s else "avx2"
+            cold_speedup = gen_s["scalar"] / max(gen_s[v], 1e-9)
+            rep_speedup = rep_s["scalar"] / max(rep_s[v], 1e-9)
+            print(
+                f"simd gate: 16k bucketed cold gen scalar "
+                f"{gen_s['scalar'] * 1e3:.0f}ms vs {v} "
+                f"{gen_s[v] * 1e3:.0f}ms ({cold_speedup:.2f}x, floor "
+                f"{floors['simd_cold_speedup_floor']}x); warm repair "
+                f"sweep scalar {rep_s['scalar'] * 1e3:.1f}ms vs {v} "
+                f"{rep_s[v] * 1e3:.1f}ms ({rep_speedup:.2f}x, recorded)"
+            )
+            if cold_speedup < floors["simd_cold_speedup_floor"]:
+                failures.append(
+                    f"{v} cold generation only {cold_speedup:.2f}x "
+                    f"scalar (floor {floors['simd_cold_speedup_floor']}x)"
+                )
+
+        # ---- cross-vector identity: avx2 and avx512 share one
+        # fmaf-matched pipeline, so their plans must be EXACTLY equal
+        if "avx2" in plans and "avx512" in plans:
+            if not (
+                np.array_equal(plans["avx2"][0], plans["avx512"][0])
+                and np.array_equal(plans["avx2"][1], plans["avx512"][1])
+            ):
+                failures.append(
+                    "avx2 and avx512 bucketed cold plans are not "
+                    "bit-identical (shared-pipeline contract)"
+                )
+            else:
+                print("simd gate: avx2 == avx512 plans bit-identical")
+
+        # ---- scalar-referee equivalence with the documented tolerance
+        if "scalar" in plans and "avx2" in plans:
+            v = "avx512" if "avx512" in plans else "avx2"
+            sp, sc = plans["scalar"]
+            vp, vc = plans[v]
+            same = np.all(sp == vp, axis=1)
+            mism_frac = float(1.0 - same.mean())
+            max_dc = (
+                float(np.abs(sc[same] - vc[same]).max())
+                if bool(same.any()) else 0.0
+            )
+            print(
+                f"simd gate: scalar referee vs {v}: {mism_frac:.4%} rows "
+                f"with provider reorders (cap "
+                f"{floors['simd_referee_row_mismatch_frac_max']:.2%}), "
+                f"max |cost delta| {max_dc:.2e} on agreeing rows (tol "
+                f"{floors['simd_referee_cost_tol_abs']:.0e})"
+            )
+            if mism_frac > floors["simd_referee_row_mismatch_frac_max"]:
+                failures.append(
+                    f"scalar-vs-{v} provider mismatch on {mism_frac:.4%} "
+                    f"of rows (cap "
+                    f"{floors['simd_referee_row_mismatch_frac_max']:.2%})"
+                )
+            if max_dc > floors["simd_referee_cost_tol_abs"]:
+                failures.append(
+                    f"scalar-vs-{v} cost delta {max_dc:.2e} exceeds "
+                    f"documented tolerance "
+                    f"{floors['simd_referee_cost_tol_abs']:.0e}"
+                )
+    finally:
+        if prev_env is None:
+            os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+        else:
+            os.environ["PROTOCOL_TPU_NATIVE_ISA"] = prev_env
+        native._apply_isa(native.load(), prev_isa)
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("simd perf gate OK")
     return 0
 
 
@@ -1519,8 +1740,11 @@ def main() -> int:
     ap.add_argument("--dfleet", action="store_true")
     ap.add_argument("--cand", action="store_true")
     ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--simd", action="store_true")
     args = ap.parse_args()
 
+    if args.simd:
+        return simd_gate()
     if args.stream:
         return stream_gate()
     if args.cand:

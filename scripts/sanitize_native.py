@@ -178,6 +178,20 @@ def _child(args) -> int:
     print(f"[child] engine variant={variant or 'plain'} "
           f"so={os.path.basename(native.so_path(variant))}")
     native.load()
+    # the parent forces one runtime ISA per child (PROTOCOL_TPU_NATIVE_ISA)
+    # so the vector dispatch paths — lane kernels, block-skip survivors,
+    # tiled sweeps — run UNDER the sanitizer, not just the scalar referee.
+    # A clamp here means the parent's support probe and the instrumented
+    # build disagree about the host: fail loudly, don't stress the wrong
+    # pipeline.
+    requested = native.isa_request()
+    effective = native.current_isa()
+    print(f"[child] runtime isa={effective} (requested {requested or 'default'})")
+    if requested not in (None, "auto") and effective != requested:
+        raise SystemExit(
+            f"ISA CLAMPED: requested {requested} but engine runs "
+            f"{effective} — host/build support mismatch"
+        )
     threads = [int(t) for t in args.threads.split(",")]
     P, T, K = args.providers, args.tasks, args.top_k
 
@@ -337,6 +351,16 @@ def _child(args) -> int:
         arena_runs[t] = trace
     _assert_identical(arena_runs, "NativeSolveArena warm churn")
 
+    # cross-ISA evidence for the parent: the two vector ISAs share one
+    # fmaf-matched pipeline, so their plans must be bit-identical — the
+    # parent compares this digest between the avx2 and avx512 children
+    import hashlib
+
+    h = hashlib.sha256()
+    for arr in (cand_p, cand_c, *repair_runs[threads[0]]):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    print(f"[child] PLAN-DIGEST isa={effective} {h.hexdigest()}")
+
     print(f"[child] OK: all kernels thread-invariant over threads={threads}")
     return 0
 
@@ -413,6 +437,10 @@ def main() -> int:
     ap.add_argument("--top-k", type=int, default=24)
     ap.add_argument("--ticks", type=int, default=3,
                     help="churned warm re-solves per thread count")
+    ap.add_argument("--isas", default="auto",
+                    help="comma-separated runtime ISAs to stress "
+                         "(scalar,avx2,avx512), or 'auto' for every ISA "
+                         "the host supports — one sanitized child per ISA")
     ap.add_argument("--artifact", default=None,
                     help="write the run log here (e.g. artifacts/sanitize_tsan.log)")
     ap.add_argument("--skip-clang-tidy", action="store_true")
@@ -462,37 +490,69 @@ def main() -> int:
     runtime = _runtime_so(so, runtime_name)
     log(f"LD_PRELOAD runtime: {runtime}")
 
+    # one sanitized child per runtime ISA: the env var forces the
+    # dispatch, so the vector lane kernels / block-skip survivors / tiled
+    # sweeps execute under the instrumentation, not just the scalar path
+    if args.isas == "auto":
+        isas = ["scalar"]
+        for name in ("avx2", "avx512"):
+            if native.isa_supported(name):
+                isas.append(name)
+    else:
+        isas = [s.strip() for s in args.isas.split(",") if s.strip()]
+        for name in isas:
+            if name not in ("scalar", "avx2", "avx512"):
+                raise SystemExit(f"unknown --isas entry {name!r}")
+    log(f"runtime ISAs under stress: {isas}")
+
     ok = True
-    with tempfile.TemporaryDirectory(prefix="sanitize_native_") as log_dir:
-        prefix = os.path.join(log_dir, "report")
-        env = dict(os.environ)
-        env["PROTOCOL_TPU_NATIVE_SANITIZE"] = args.sanitizer
-        env["LD_PRELOAD"] = runtime
-        common = f"log_path={prefix}:exitcode={_SAN_EXITCODE}"
-        env["TSAN_OPTIONS"] = f"{common}:second_deadlock_stack=1"
-        # detect_leaks=0: CPython "leaks" by design (interned objects,
-        # static allocations); leak noise would bury real engine reports
-        env["ASAN_OPTIONS"] = f"{common}:detect_leaks=0"
-        env["UBSAN_OPTIONS"] = f"{common}:print_stacktrace=1"
-        cmd = [
-            sys.executable, os.path.abspath(__file__), "--child",
-            "--sanitizer", args.sanitizer, "--threads", args.threads,
-            "--providers", str(args.providers), "--tasks", str(args.tasks),
-            "--top-k", str(args.top_k), "--ticks", str(args.ticks),
-        ]
-        proc = subprocess.run(
-            cmd, env=env, cwd=_REPO, capture_output=True, text=True
-        )
-        for stream in (proc.stdout, proc.stderr):
-            if stream.strip():
-                log(stream.rstrip())
-        hits, excerpts = _scan_reports(log_dir)
-        log(f"child rc={proc.returncode}, sanitizer reports={hits}, "
-            f"wall={time.time() - t0:.1f}s")
-        for e in excerpts:
-            log(e)
-        if proc.returncode != 0 or hits:
+    digests: dict[str, str] = {}
+    for isa in isas:
+        with tempfile.TemporaryDirectory(prefix="sanitize_native_") as log_dir:
+            prefix = os.path.join(log_dir, "report")
+            env = dict(os.environ)
+            env["PROTOCOL_TPU_NATIVE_SANITIZE"] = args.sanitizer
+            env["PROTOCOL_TPU_NATIVE_ISA"] = isa
+            env["LD_PRELOAD"] = runtime
+            common = f"log_path={prefix}:exitcode={_SAN_EXITCODE}"
+            env["TSAN_OPTIONS"] = f"{common}:second_deadlock_stack=1"
+            # detect_leaks=0: CPython "leaks" by design (interned objects,
+            # static allocations); leak noise would bury real engine reports
+            env["ASAN_OPTIONS"] = f"{common}:detect_leaks=0"
+            env["UBSAN_OPTIONS"] = f"{common}:print_stacktrace=1"
+            cmd = [
+                sys.executable, os.path.abspath(__file__), "--child",
+                "--sanitizer", args.sanitizer, "--threads", args.threads,
+                "--providers", str(args.providers), "--tasks", str(args.tasks),
+                "--top-k", str(args.top_k), "--ticks", str(args.ticks),
+            ]
+            proc = subprocess.run(
+                cmd, env=env, cwd=_REPO, capture_output=True, text=True
+            )
+            for stream in (proc.stdout, proc.stderr):
+                if stream.strip():
+                    log(stream.rstrip())
+            for line in proc.stdout.splitlines():
+                if "PLAN-DIGEST" in line:
+                    digests[isa] = line.rsplit(" ", 1)[-1]
+            hits, excerpts = _scan_reports(log_dir)
+            log(f"child[isa={isa}] rc={proc.returncode}, sanitizer "
+                f"reports={hits}, wall={time.time() - t0:.1f}s")
+            for e in excerpts:
+                log(e)
+            if proc.returncode != 0 or hits:
+                ok = False
+
+    # shared-pipeline contract: avx2 and avx512 run one fmaf-matched
+    # float pipeline, so their candidate plans must be bit-identical
+    # (the scalar referee is allowed its documented float tolerance)
+    if "avx2" in digests and "avx512" in digests:
+        if digests["avx2"] != digests["avx512"]:
+            log("CROSS-ISA MISMATCH: avx2 and avx512 plan digests differ "
+                "(shared-pipeline contract broken)")
             ok = False
+        else:
+            log("cross-ISA: avx2 == avx512 plan digests bit-identical")
 
     if not args.skip_clang_tidy and not _clang_tidy(log):
         ok = False

@@ -44,13 +44,15 @@ def run_native_chain(args, cand_p, cand_c, P, T, eps_end, emit) -> None:
     jax chain carries across assign_auction_sparse_warm_sharded)."""
     from protocol_tpu import native
 
+    native.load()
+    isa = native.current_isa()  # provenance: rows are ISA-tagged
     t0 = time.time()
     p4t, price, retired = native.auction_sparse_mt(
         cand_p, cand_c, num_providers=P,
         eps_start=4.0, eps_end=eps_end, threads=args.threads,
     )
     emit({
-        "step": 0, "kind": "cold", "engine": "native-mt",
+        "step": 0, "kind": "cold", "engine": "native-mt", "isa": isa,
         "threads": args.threads, "wall_s": round(time.time() - t0, 1),
         "assigned": int((p4t >= 0).sum()),
         "retired": int(retired.sum()),
@@ -73,7 +75,7 @@ def run_native_chain(args, cand_p, cand_c, P, T, eps_end, emit) -> None:
         wall = time.time() - t0
         pos = p4t[p4t >= 0]
         emit({
-            "step": step, "kind": "warm", "engine": "native-mt",
+            "step": step, "kind": "warm", "engine": "native-mt", "isa": isa,
             "threads": args.threads, "wall_s": round(wall, 1),
             "assigned": int((p4t >= 0).sum()),
             "injective": bool(np.unique(pos).size == pos.size),

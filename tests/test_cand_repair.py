@@ -92,10 +92,10 @@ class TestBucketedColdParity:
     @pytest.mark.parametrize("threads", THREADS)
     def test_bucketed_equals_full_scan(self, threads):
         """Bucketed == unbucketed within the v2 (persistent-structure)
-        family, which pins ONE float pipeline on every build. The
-        legacy fused entries share that pipeline on the pinned
-        production ISA (-march=x86-64-v2, no AVX-512) but keep the
-        vector cost path on tuned local builds, so the reference here
+        family: both dispatch through the same runtime ISA table
+        (scalar/avx2/avx512, one fmaf-matched pipeline per ISA), so
+        within a process the float pipeline is pinned and the pruner
+        must reproduce the full scan bit-for-bit. The reference here
         is the v2 full scan (rev_out requested), not the legacy one."""
         ep, er = _pop(0, 384)
         rev_ref = np.zeros((384, 8), np.uint64)

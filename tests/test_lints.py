@@ -17,6 +17,7 @@ from scripts.lints.base import Source, iter_files
 from scripts.lints.densealloc import DenseAllocRule
 from scripts.lints.determinism import SCOPES, DeterminismRule
 from scripts.lints.dtype_contract import DtypeContractRule
+from scripts.lints.isa_dispatch import IsaDispatchRule
 from scripts.lints.lockdiscipline import LockDisciplineRule
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
@@ -172,6 +173,59 @@ class TestDtypeCrossCheck:
         assert all("TRACE_DTYPES" in f.message for f in findings)
 
 
+class TestIsaDispatch:
+    """The vector-code boundary (ISSUE 16): intrinsics live only inside
+    the engine's delimited PER-ISA section and are reached through the
+    kIsaOps dispatch table. Fixture-seeded both ways and
+    mutation-verified against the real engine source."""
+
+    ENGINE = REPO / "native" / "assign_engine.cpp"
+
+    def test_seeds_and_clean_twin(self):
+        bad = FIXTURES / "isa_dispatch_bad.cpp"
+        rule = IsaDispatchRule(native_glob=str(bad))
+        expected = seeded_lines(bad, rule.name)
+        assert expected, "fixture has no SEED markers"
+        findings = rule.check_repo()
+        assert {f.line for f in findings} == expected
+        assert len(findings) == len(expected)
+        assert all(f.rule == rule.name for f in findings)
+        ok_rule = IsaDispatchRule(
+            native_glob=str(FIXTURES / "isa_dispatch_ok.cpp")
+        )
+        assert ok_rule.check_repo() == []
+
+    def test_real_engine_source_is_clean(self):
+        assert IsaDispatchRule().check_repo() == []
+
+    def test_mutated_engine_is_caught(self, tmp_path):
+        """Injecting a raw intrinsic into an entry point OUTSIDE the
+        section must be a finding — the boundary is load-bearing, not
+        decorative."""
+        src = self.ENGINE.read_text()
+        needle = 'extern "C" {\n'
+        assert needle in src
+        mutated = tmp_path / "assign_engine.cpp"
+        mutated.write_text(src.replace(
+            needle,
+            needle + "static float sneak(const float* x) "
+            "{ return _mm256_cvtss_f32(_mm256_loadu_ps(x)); }\n",
+            1,
+        ))
+        findings = IsaDispatchRule(native_glob=str(mutated)).check_repo()
+        assert findings, "intrinsic outside the section not caught"
+        assert all(f.rule == "isa-dispatch" for f in findings)
+
+    def test_unclosed_section_is_a_finding(self, tmp_path):
+        src = self.ENGINE.read_text()
+        mutated = tmp_path / "assign_engine.cpp"
+        mutated.write_text(src.replace(
+            "// ==== END PER-ISA KERNELS (isa-dispatch)", "// ====", 1
+        ))
+        findings = IsaDispatchRule(native_glob=str(mutated)).check_repo()
+        assert any("never closed" in f.message for f in findings)
+
+
 class TestEngine:
     def test_real_tree_is_clean(self):
         """The acceptance bar: `python -m scripts.lints` exits 0 on the
@@ -187,7 +241,8 @@ class TestEngine:
     def test_rule_registry_covers_the_catalog(self):
         names = {r.name for r in RULES}
         assert {
-            "determinism", "lock-discipline", "dtype-contract", "dense-alloc"
+            "determinism", "lock-discipline", "dtype-contract",
+            "dense-alloc", "isa-dispatch",
         } <= names
 
     def test_cli_exit_codes(self):

@@ -1,0 +1,372 @@
+"""Per-ISA dispatch contract for the native engine (ISSUE 16).
+
+The engine carries three float pipelines in ONE baseline .so — scalar
+(the historical referee), AVX2, and AVX-512 — selected at runtime
+through the ``kIsaOps`` dispatch table. The contract under test:
+
+  * forced-ISA env round-trip: ``PROTOCOL_TPU_NATIVE_ISA`` /
+    ``native.set_isa`` pin the pipeline, ``native.current_isa`` reports
+    the EFFECTIVE one, and the tag rides EngineStats / arena
+    ``last_stats`` / checkpoint state,
+  * graceful scalar fallback: unsupported requests clamp (never fail)
+    and the tag names what actually ran,
+  * per-ISA golden plans: committed digests at 2k and 16k — bit-identity
+    within an ISA across runs, builds, and thread counts is the whole
+    determinism story, and avx2 == avx512 exactly (one fmaf-matched
+    pipeline),
+  * vector-vs-scalar referee equivalence on the repair-vs-cold oracle
+    suite (the drift/mutate/join-leave/task-churn scripts from
+    test_cand_repair.py) x threads {1,2,4} x both solve engines: exact
+    plan-set equality within an ISA, documented float tolerance across
+    the scalar/vector pipeline boundary.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.ops.cost import CostWeights
+
+import test_cand_repair as tcr
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no native toolchain"
+)
+
+W = CostWeights()
+THREADS = (1, 2, 4)
+
+# committed per-ISA golden digests: sha256 over the bucketed cold plan
+# (cand_p || cand_c) at threads=1, k=64, population
+# bench.synth_providers(rng(2)) x bench.synth_requirements(rng(3)) —
+# the same basis as perf_floor.json's simd_* family. avx2 and avx512
+# share one fmaf-matched pipeline, hence one digest.
+_VEC_2K = "2f03847bb30ea2ded3058171ada4197342cac0be9e4c04d504f00ebf518f17cd"
+_VEC_16K = "97c3106eeaf425b78c2faafd10f62ace94a98baa2723869a89e3f68c2ba8218a"
+GOLDEN = {
+    2048: {
+        "scalar": "96afb6c6ed4e32ed5e0744620879b1e3c0397e368300b482e71f5c1c3f613b28",
+        "avx2": _VEC_2K,
+        "avx512": _VEC_2K,
+    },
+    16384: {
+        "scalar": "4f0d3f374d00f4ed98c33a1a700ef3fd3fc47ccf4649ac85a1f218ef9ead5e18",
+        "avx2": _VEC_16K,
+        "avx512": _VEC_16K,
+    },
+}
+
+# documented scalar-vs-vector pipeline tolerance (perf_floor.json
+# _basis_simd): same polynomial, different mul+add vs fmaf chains
+REFEREE_COST_TOL = 5e-3
+REFEREE_ROW_MISMATCH_FRAC = 0.01
+
+
+def _isas():
+    return ["scalar"] + [
+        i for i in ("avx2", "avx512") if native.isa_supported(i)
+    ]
+
+
+def _vector_isas():
+    return [i for i in ("avx2", "avx512") if native.isa_supported(i)]
+
+
+@pytest.fixture(autouse=True)
+def _restore_isa():
+    prev_env = os.environ.get("PROTOCOL_TPU_NATIVE_ISA")
+    prev = native.current_isa()
+    yield
+    if prev_env is None:
+        os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+    else:
+        os.environ["PROTOCOL_TPU_NATIVE_ISA"] = prev_env
+    native._apply_isa(native.load(), prev)
+
+
+def _bench_pop(n):
+    import bench
+
+    return (
+        bench.synth_providers(np.random.default_rng(2), n),
+        bench.synth_requirements(np.random.default_rng(3), n),
+    )
+
+
+def _digest(cp, cc) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(cp).tobytes())
+    h.update(np.ascontiguousarray(cc).tobytes())
+    return h.hexdigest()
+
+
+class TestEnvRoundTrip:
+    def test_set_isa_round_trips_through_env_and_load(self):
+        for isa in _isas():
+            eff = native.set_isa(isa)
+            assert eff == isa
+            assert os.environ["PROTOCOL_TPU_NATIVE_ISA"] == isa
+            # a later load() (cached path) must re-apply the env request
+            native.load()
+            assert native.current_isa() == isa
+
+    def test_auto_selects_the_widest_supported(self):
+        assert native.set_isa("auto") == _isas()[-1]
+
+    def test_bad_isa_names_are_rejected(self, monkeypatch):
+        with pytest.raises(native.NativeBuildError):
+            native.set_isa("neon")
+        monkeypatch.setenv("PROTOCOL_TPU_NATIVE_ISA", "sse9")
+        with pytest.raises(native.NativeBuildError):
+            native.isa_request()
+
+    def test_unset_env_keeps_the_running_isa(self):
+        """Env unset means 'no forcing' — the engine keeps whatever it
+        runs (the baked .so default at first load): committed scalar
+        goldens stay valid with no env plumbing anywhere."""
+        target = _isas()[-1]
+        native.set_isa(target)
+        os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+        native.load()
+        assert native.current_isa() == target
+
+    def test_stats_carry_the_effective_isa_tag(self):
+        ep, er = tcr._pop(3, 128)
+        for isa in _isas():
+            native.set_isa(isa)
+            st: dict = {}
+            native.fused_topk_candidates(ep, er, W, k=16, stats=st)
+            assert st["native_isa"] == isa
+
+
+class TestGracefulFallback:
+    def test_engine_clamps_out_of_range_requests(self):
+        lib = native.load()
+        assert lib.engine_isa_supported(99) == 0
+        assert lib.engine_isa_supported(-1) == 0
+        best = native._ISA_CODES[_isas()[-1]]
+        prev = lib.engine_get_isa()
+        try:
+            # an absurd request clamps to the best the host supports —
+            # never an error, and the getter names what actually runs
+            assert lib.engine_set_isa(99) == best
+            assert lib.engine_get_isa() == best
+            assert lib.engine_set_isa(0) == 0
+        finally:
+            lib.engine_set_isa(prev)
+
+    def test_isa_supported_name_surface(self):
+        assert native.isa_supported("scalar")
+        assert native.isa_supported("auto")
+        assert not native.isa_supported("bogus")
+
+    def test_scalar_request_always_lands_scalar(self):
+        assert native.set_isa("scalar") == "scalar"
+        ep, er = tcr._pop(5, 96)
+        st: dict = {}
+        native.fused_topk_candidates(ep, er, W, k=8, stats=st)
+        assert st["native_isa"] == "scalar"
+
+
+class TestPerIsaGoldenPlans:
+    def _check(self, n):
+        ep, er = _bench_pop(n)
+        seen = {}
+        for isa in _isas():
+            assert native.set_isa(isa) == isa
+            cp, cc = native.fused_topk_candidates(
+                ep, er, W, k=64, threads=1, bucketed=True
+            )
+            d = _digest(cp, cc)
+            seen[isa] = d
+            assert d == GOLDEN[n][isa], (
+                f"{isa} plan digest drifted at n={n} — the per-ISA "
+                "bit-identity contract (across runs AND builds) is broken"
+            )
+        if "avx2" in seen and "avx512" in seen:
+            assert seen["avx2"] == seen["avx512"]
+
+    def test_golden_2k(self):
+        self._check(2048)
+
+    @pytest.mark.slow
+    def test_golden_16k(self):
+        self._check(16384)
+
+
+class TestRefereeEquivalence:
+    """The oracle suite from test_cand_repair.py, run per ISA: within an
+    ISA everything is exact (repair == rebuild, thread-invariant); across
+    the scalar/vector boundary the plans agree up to the documented
+    float-pipeline tolerance."""
+
+    def test_oracle_churn_scripts_per_isa(self):
+        for isa in _vector_isas():
+            rng = np.random.default_rng(0)
+            P = T = 256
+            k = 16
+            ep, er = tcr._pop(0, P)
+            # one persistent structure per (isa, threads), plus the
+            # scalar referee structure
+            native.set_isa(isa)
+            structs = {}
+            for thr in THREADS:
+                rev = np.zeros((P, 8), np.uint64)
+                cp, cc = native.fused_topk_candidates(
+                    ep, er, W, k=k, threads=thr, rev_out=rev, bucketed=True
+                )
+                structs[thr] = (cp, cc, rev)
+            native.set_isa("scalar")
+            rev_s = np.zeros((P, 8), np.uint64)
+            cp_s, cc_s = native.fused_topk_candidates(
+                ep, er, W, k=k, threads=1, rev_out=rev_s, bucketed=True
+            )
+            for tick in range(4):
+                ep, er, dp, dt = tcr._churn(rng, ep, er, P, T)
+                native.set_isa(isa)
+                for thr in THREADS:
+                    cp, cc, rev = structs[thr]
+                    native.repair_topk_candidates(
+                        ep, er, W, cp, cc, rev, dp, dt, k=k, threads=thr
+                    )
+                # exact within the ISA: thread-invariant ...
+                for thr in (2, 4):
+                    for a, b in zip(structs[1], structs[thr]):
+                        np.testing.assert_array_equal(
+                            a, b,
+                            err_msg=f"{isa} tick {tick} threads={thr}",
+                        )
+                # ... and repair == same-ISA cold rebuild (plan-set
+                # equality where the oracle demands bit-identity)
+                rev_r = np.zeros((P, 8), np.uint64)
+                rp, rc = native.fused_topk_candidates(
+                    ep, er, W, k=k, reverse_r=8, extra=16, threads=2,
+                    rev_out=rev_r,
+                )
+                cp, cc, rev = structs[1]
+                np.testing.assert_array_equal(cp, rp)
+                np.testing.assert_array_equal(cc, rc)
+                np.testing.assert_array_equal(rev, rev_r)
+                # scalar referee: maintain its structure through the
+                # same script, compare across the pipeline boundary
+                native.set_isa("scalar")
+                native.repair_topk_candidates(
+                    ep, er, W, cp_s, cc_s, rev_s, dp, dt, k=k, threads=1
+                )
+                same = np.all(cp_s == cp, axis=1)
+                assert 1.0 - float(same.mean()) <= REFEREE_ROW_MISMATCH_FRAC, (
+                    f"{isa} tick {tick}: provider sets diverge from the "
+                    "scalar referee beyond near-tie reorders"
+                )
+                if bool(same.any()):
+                    dc = np.abs(cc_s[same] - cc[same])
+                    assert float(dc.max()) <= REFEREE_COST_TOL, (
+                        f"{isa} tick {tick}: cost delta vs scalar referee "
+                        f"{float(dc.max()):.2e} beyond documented tolerance"
+                    )
+
+    @pytest.mark.parametrize("engine", ["auction", "sinkhorn"])
+    def test_arena_chain_vector_vs_scalar_referee(self, engine):
+        """Arena-level, both solve engines: a vector-pinned arena and a
+        scalar-pinned arena tick through the same churn script; each
+        stays exact against its own pipeline's rebuild (structure
+        invariant), their assignments agree up to near-ties, and every
+        last_stats carries the pipeline's tag."""
+        vec = _vector_isas()
+        if not vec:
+            pytest.skip("host has no vector ISA")
+        isa = vec[-1]
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        rng = np.random.default_rng(21)
+        P = T = 256
+        ep, er = tcr._pop(21, P)
+        arena_v = NativeSolveArena(
+            k=16, threads=2, engine=engine, cold_every=1_000_000
+        )
+        arena_s = NativeSolveArena(
+            k=16, threads=2, engine=engine, cold_every=1_000_000
+        )
+        native.set_isa(isa)
+        arena_v.solve(ep, er, W)
+        assert arena_v.last_stats["native_isa"] == isa
+        native.set_isa("scalar")
+        arena_s.solve(ep, er, W)
+        assert arena_s.last_stats["native_isa"] == "scalar"
+        for tick in range(3):
+            ep, er, _dp, _dt = tcr._churn(rng, ep, er, P, T)
+            native.set_isa(isa)
+            p4t_v = arena_v.solve(ep, er, W)
+            assert arena_v.last_stats["cand_cold_passes"] == 0
+            assert arena_v.last_stats["native_isa"] == isa
+            # structure invariant against the SAME pipeline's rebuild
+            rp, rc, rrev = tcr._rebuild(ep, er, 16, P)
+            np.testing.assert_array_equal(arena_v._cand_p, rp)
+            np.testing.assert_array_equal(arena_v._cand_c, rc)
+            np.testing.assert_array_equal(arena_v._rev, rrev)
+            native.set_isa("scalar")
+            p4t_s = arena_s.solve(ep, er, W)
+            assert arena_s.last_stats["native_isa"] == "scalar"
+            n_v = int((p4t_v >= 0).sum())
+            n_s = int((p4t_s >= 0).sum())
+            assert abs(n_v - n_s) <= max(2, T // 100), (
+                f"tick {tick}: assigned counts diverge ({n_v} vs {n_s})"
+            )
+            agree = float((p4t_v == p4t_s).mean())
+            assert agree >= 0.95, (
+                f"tick {tick}: only {agree:.1%} of tasks agree between "
+                "vector and scalar pipelines"
+            )
+
+
+class TestCheckpointIsaProvenance:
+    def test_isa_skewed_restore_cold_regrounds(self):
+        """A structure exported under one pipeline must NOT be repaired
+        under another (repair assumes bit-exact carried floats): the
+        restore degrades to an honest cold re-ground, same as a
+        config-skewed carry."""
+        from protocol_tpu.native.arena import NativeSolveArena
+
+        rng = np.random.default_rng(31)
+        P = T = 192
+        ep, er = tcr._pop(31, P)
+        native.set_isa("scalar")
+        src = NativeSolveArena(k=16, threads=2)
+        src.solve(ep, er, W)
+        state = src.export_state()
+        assert state["native_isa"] == "scalar"
+
+        skew = dict(state)
+        skew["native_isa"] = "avx2"
+        dst = NativeSolveArena(k=16, threads=2)
+        dst.restore_state(ep, er, skew)
+        ep2, er2, _dp, _dt = tcr._churn(rng, ep, er, P, T)
+        dst.solve(ep2, er2, W)
+        assert dst.last_stats["cold"] is True  # honest re-ground
+
+        # matching tag restores warm (the carry contract holds)
+        ok = NativeSolveArena(k=16, threads=2)
+        ok.restore_state(ep, er, state)
+        ok.solve(ep2, er2, W)
+        assert ok.last_stats["cold"] is False
+        assert ok.last_stats["cand_cold_passes"] == 0
+
+
+class TestIsaVariantSo:
+    def test_baked_default_variant_dispatches_without_env(self):
+        """make native-avx2 bakes ENGINE_DEFAULT_ISA=1: selecting the
+        variant .so (PROTOCOL_TPU_NATIVE_ISA_VARIANT) must come up on
+        the vector pipeline with NO runtime-ISA env at all."""
+        if not native.isa_supported("avx2"):
+            pytest.skip("host has no AVX2")
+        if not os.path.exists(native.so_path("avx2")):
+            pytest.skip("variant .so not built (make native-avx2)")
+        os.environ.pop("PROTOCOL_TPU_NATIVE_ISA", None)
+        os.environ["PROTOCOL_TPU_NATIVE_ISA_VARIANT"] = "avx2"
+        try:
+            assert native.current_isa() == "avx2"
+        finally:
+            os.environ.pop("PROTOCOL_TPU_NATIVE_ISA_VARIANT", None)

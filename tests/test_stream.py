@@ -21,7 +21,6 @@ The load-bearing claims pinned here:
     cadence ignored) must flag and count every overdue answer.
 """
 
-import os
 import socket
 
 import numpy as np
