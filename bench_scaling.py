@@ -647,6 +647,41 @@ def main() -> None:
     except Exception as e:
         log(f"  sparse sinkhorn-mt stage failed: {e}")
 
+    # ---------------- stage J: first-class jax arena (engine=jax) ---------
+    # The jax engine behind the native-arena interface: sharded candidate
+    # generation over the FULL visible mesh + adaptive eps-ladder solve
+    # with warm dual carry. Device-count provenance rides in the platform
+    # field (PR 3 convention); the sharded-gen bits are D-invariant by
+    # contract (perf_gate --jax proves it), so this row measures the ICI/
+    # host-mesh scaling of an identical computation, not a different one.
+    try:
+        log(f"stage J: jax arena cold+warm, full {n_dev}-device mesh")
+        res_j = bench.run_jax_arena_bench(n=4096, devices=0)
+        emit(
+            {
+                "stage": "J jax arena cold+warm (engine=jax, measured)",
+                "platform": f"{platform} d{res_j['devices']}"
+                            + ("" if res_j["gen_sharded"] else " unsharded"),
+                "shape": "P=T=4096 k=64",
+                "cold_s": round(res_j["cold_ms"] / 1e3, 3),
+                "cold_gen_s": round(res_j["cold_gen_ms"] / 1e3, 3),
+                "cold_solve_s": round(res_j["cold_solve_ms"] / 1e3, 3),
+                "warm_tick_s": round(res_j["warm_median_ms"] / 1e3, 3),
+                "warm_wall_speedup": res_j["warm_wall_speedup"],
+                "warm_solve_speedup": res_j["warm_solve_speedup"],
+                "assigned_frac": res_j["assigned_frac"],
+            }
+        )
+        log(
+            f"  cold {res_j['cold_ms'] / 1e3:.2f}s -> warm "
+            f"{res_j['warm_median_ms'] / 1e3:.2f}s "
+            f"({res_j['warm_wall_speedup']}x wall, "
+            f"{res_j['warm_solve_speedup']}x solve stage; "
+            f"sharded={res_j['gen_sharded']})"
+        )
+    except Exception as e:
+        log(f"  jax arena stage failed: {e}")
+
     print(json.dumps({"platform": platform, "devices": n_dev, "rows": rows}, indent=1))
 
 

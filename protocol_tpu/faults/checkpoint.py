@@ -389,10 +389,10 @@ class SessionCheckpointer:
 
     def _load(self, path: str, budget):
         from protocol_tpu.fleet import estimate_arena_bytes
-        from protocol_tpu.native.arena import NativeSolveArena
         from protocol_tpu.ops.cost import CostWeights
         from protocol_tpu.services.session_store import (
             SolveSession,
+            make_solve_arena,
             parse_session_kernel,
         )
 
@@ -423,8 +423,8 @@ class SessionCheckpointer:
             )
         engine, _ = parsed
         threads = int(meta["threads"])
-        arena = NativeSolveArena(
-            k=int(meta["top_k"]), threads=threads, engine=engine
+        arena = make_solve_arena(
+            engine, k=int(meta["top_k"]), threads=threads
         )
         p_cols, r_cols = snapshot.p_cols, snapshot.r_cols  # lint: unlocked-ok (parsed trace frame, not a live session)
         if arena_state is not None:

@@ -307,6 +307,22 @@ def merge_reverse_candidates(
     )
 
 
+def pick_tile(n_tasks: int, cap: int = 1024) -> int:
+    """Largest tile <= ``cap`` that divides ``n_tasks`` exactly — the
+    task-tiling contract of :func:`candidates_topk_reverse` (the scan
+    carries fixed-shape tiles, so T % tile must be 0). Callers pad task
+    counts to pow2 buckets, where this returns min(cap, n_tasks); the
+    divisor walk keeps odd counts (tests, unpadded replays) working
+    instead of raising. One home for the loop that used to be duplicated
+    per call site (trace replay, bench, the jax arena)."""
+    if n_tasks <= 0:
+        return 1
+    tile = min(cap, n_tasks)
+    while n_tasks % tile != 0:
+        tile -= 1
+    return tile
+
+
 def candidates_topk_bidir(
     ep: EncodedProviders,
     er: EncodedRequirements,

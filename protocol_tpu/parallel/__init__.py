@@ -10,6 +10,7 @@ of replicated state).
 
 from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
 from protocol_tpu.parallel.auction import assign_auction_sharded
+from protocol_tpu.parallel.jax_arena import JaxSolveArena
 from protocol_tpu.parallel.sinkhorn import sinkhorn_potentials_sharded
 from protocol_tpu.parallel.sparse import (
     assign_auction_sparse_scaled_sharded,
@@ -19,6 +20,7 @@ from protocol_tpu.parallel.sparse import (
 )
 
 __all__ = [
+    "JaxSolveArena",
     "assign_auction_sharded",
     "assign_auction_sparse_scaled_sharded",
     "assign_auction_sparse_sharded",

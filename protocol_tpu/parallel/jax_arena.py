@@ -1,0 +1,769 @@
+"""Persistent warm-solve arena for the JAX engine (engine="jax").
+
+The accelerator-path peer of :class:`~protocol_tpu.native.arena.
+NativeSolveArena` behind the exact same duck-typed surface (solve /
+apply_rows / reconcile / export_state / restore_state / invalidate,
+``.price`` / ``.retired`` / ``.last_stats``), so every consumer of the
+native arena — sessions, the unary servicer, checkpoints, migration,
+the stream engine, trace replay — runs unchanged with ``engine="jax"``.
+Two-stage split, mirroring SCALING.md's ICI cost model:
+
+  - **Sharded candidate generation.** The bucketed top-K + reverse-edge
+    pass as the jit-compiled, task-sharded kernel
+    (:func:`~protocol_tpu.parallel.sparse.candidates_topk_bidir_sharded`
+    over a 1xD mesh: zero per-round collectives, one ``all_gather`` of
+    per-shard top-K, deterministic reverse-edge merge). Device-count
+    INVARIANT: D=1 and D=4 produce the bit-identical candidate
+    structure (asserted in tests/test_parallel_sparse.py and
+    ``perf_gate.py --jax``), which is why the warm carry below stays
+    sound across device-count changes and why the provenance tag
+    excludes D.
+  - **Adaptive-ladder solve.** Cold solves run the eps-annealed auction
+    ladder (:func:`~protocol_tpu.ops.sparse.assign_auction_sparse_scaled`
+    — jitted ``lax.while_loop`` phases on a single chip); warm solves
+    carry the dual state (prices + retirement + matching) into the
+    delta-frontier kernel (:func:`assign_auction_sparse_warm`), clearing
+    retirement for exactly the rows whose candidates or costs changed —
+    the caller contract that kernel documents.
+
+Where the native arena REPAIRS its candidate structure incrementally,
+the jax arena REGENERATES it: generation is one deterministic jitted
+pass (tie jitter is keyed on global task index), so unchanged rows come
+back bit-identical and the regen *is* the repair — exact at every tick,
+never a drifting cache. The trade is explicit: a warm tick pays the
+full gen pass (cheap on accelerator — that is the point of this
+engine) instead of the native O(churn) repair; ``last_stats`` reports
+it honestly as ``cand_cold_passes`` so the obs plane never mistakes a
+regen for a native-style zero-pass repair. Dirty detection, the
+byte-identical short-circuit, ``max_dirty_frac``/``cold_every``/
+``dual_refresh_every`` cadences, the dirty-task re-seat, and the seat
+feasibility guard all mirror the native arena row for row.
+
+Missing accelerators DEGRADE INSIDE the engine, never across engines:
+asking for more devices than the host exposes clamps D to what exists,
+counts the event (``device_degraded_events``), and flags every
+subsequent ``last_stats`` — a jax solve on one CPU device is still a
+jax solve. Silent fallback to the native engine would invalidate every
+cross-backend A/B the trace subsystem runs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from protocol_tpu import obs
+from protocol_tpu.native.arena import _P_SPEC, _R_SPEC, _canon, _dirty_rows
+from protocol_tpu.obs import quality as _quality
+from protocol_tpu.obs.spans import TRACER as _tracer
+from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
+from protocol_tpu.ops.sparse import (
+    assign_auction_sparse_scaled,
+    assign_auction_sparse_warm,
+    candidates_topk_bidir,
+    pick_tile,
+)
+
+# persisted candidate-structure dtypes (same durable on-disk contract as
+# native.arena._CAND_STATE_DTYPES: checkpoint frames and migration
+# handoffs coerce through this table on restore). The jax structure has
+# no reverse keys or slack shadow — regen replaces repair — so only the
+# merged forward+reverse lists persist.
+_JAX_STATE_DTYPES = {
+    "cand_p": np.int32,
+    "cand_c": np.float32,
+}
+
+
+def jax_isa() -> str:
+    """Float-pipeline provenance tag for the jax engine — the XLA
+    backend the candidate costs were scored under (``jax:cpu`` /
+    ``jax:tpu`` / ...). Plays the role ``native.current_isa()`` plays
+    for the native arena: a restore under a different backend cold
+    re-grounds instead of warm-continuing on costs another float
+    pipeline produced. Device COUNT is deliberately excluded — sharded
+    generation is D-invariant (bit-identical candidate structure for
+    any D), so a warm carry across a device-count change is sound."""
+    return f"jax:{jax.devices()[0].platform}"
+
+
+class JaxSolveArena:
+    def __init__(
+        self,
+        k: int = 64,
+        reverse_r: int = 8,
+        extra: int = 16,
+        threads: int = 0,
+        cold_every: int = 256,
+        max_dirty_frac: float = 0.25,
+        eps_start: float = 4.0,
+        eps_end: float = 0.02,
+        dual_refresh_every: int = 16,
+        devices: int = 0,
+        approx_recall: Optional[float] = None,
+    ):
+        self.k = k
+        self.reverse_r = reverse_r
+        self.extra = extra
+        # accepted (and settable — EngineThreadBudget grants write it)
+        # for surface parity with the native arena; the jax engine's
+        # parallelism is the device mesh, so the grant never changes a
+        # result or a schedule here
+        self.threads = threads
+        self.cold_every = cold_every
+        self.max_dirty_frac = max_dirty_frac
+        self.eps_start = eps_start
+        self.eps_end = eps_end
+        self.dual_refresh_every = dual_refresh_every
+        # requested device count for sharded generation (the gRPC
+        # kernel string's ``jax:D`` suffix): 0 = all visible devices
+        # (the accelerator-native default — use the mesh you have, the
+        # same shape as the native engines' "0 = all hardware
+        # threads"), resolved lazily at the first solve so constructing
+        # an arena never forces backend init. Requests beyond the host
+        # clamp with a counted, non-fatal flag (see module docstring).
+        self.devices = int(devices)
+        self.approx_recall = approx_recall
+        self.engine = "jax"
+        self.device_degraded = False
+        self.device_degraded_events = 0
+        self._mesh = None
+        self._devices_effective: Optional[int] = None
+        self.last_stats: dict = {}
+        self.invalidate()
+
+    # ---------------- carried-state surface (native-arena parity) ----
+
+    @property
+    def price(self) -> Optional[np.ndarray]:
+        """Carried auction prices [P] after the last solve (dual state)."""
+        return self._price
+
+    @property
+    def retired(self) -> Optional[np.ndarray]:
+        """Carried retirement mask [T] after the last solve."""
+        return self._retired
+
+    @property
+    def potentials(self) -> tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """Sinkhorn potentials — always (None, None): the jax engine's
+        ladder is the auction; the slot exists for surface parity."""
+        return None, None
+
+    def invalidate(self) -> None:
+        """Drop all carried state: the next solve is cold."""
+        self._p_fields: Optional[dict] = None
+        self._r_fields: Optional[dict] = None
+        self._weights_key: Optional[tuple] = None
+        self._cand_p: Optional[np.ndarray] = None
+        self._cand_c: Optional[np.ndarray] = None
+        self._price: Optional[np.ndarray] = None
+        self._retired: Optional[np.ndarray] = None
+        self._p4t: Optional[np.ndarray] = None
+        self._warm_solves = 0
+        self._dual_age = 0
+        self._starve_age: Optional[np.ndarray] = None
+        self._last_quality: dict = {}
+        self.last_repair_mask: Optional[np.ndarray] = None
+        self._owned_cols: set = set()
+
+    # ---------------- export / restore (checkpoint + migration) ------
+
+    def export_state(self) -> Optional[dict]:
+        """The carried warm state as a flat dict of scalars and arrays —
+        the same key classes as the native arena's export (cand_* +
+        duals + matching + cadence cursors + the arena's OWN baseline
+        columns), so ``faults/checkpoint.py`` journals and migration
+        handoffs carry it unchanged. Returns None before any solve.
+        Arrays are copies — a checkpoint must not alias live state."""
+        if self._cand_p is None:
+            return None
+
+        def _c(a):
+            return None if a is None else np.array(a, copy=True)
+
+        out = {
+            "cand_p": _c(self._cand_p),
+            "cand_c": _c(self._cand_c),
+            "price": _c(self._price),
+            "retired": _c(self._retired),
+            "p4t": _c(self._p4t),
+            "starve_age": _c(self._starve_age),
+            "warm_solves": int(self._warm_solves),
+            "dual_age": int(self._dual_age),
+            "weights_key": tuple(self._weights_key),
+            # same meta key as the native export so the checkpoint
+            # layer's scalar handling is engine-blind; the tag itself
+            # names the XLA backend (see jax_isa)
+            "native_isa": jax_isa(),
+        }
+        for name, _ in _P_SPEC:
+            out[f"pf_{name}"] = _c(self._p_fields[name])
+        for name, _ in _R_SPEC:
+            out[f"rf_{name}"] = _c(self._r_fields[name])
+        return out
+
+    def restore_state(self, ep, er, state: dict) -> None:
+        """Rehydrate the warm chain from :meth:`export_state` output.
+        The next ``solve`` continues it bit-identically; a carry this
+        arena cannot honor — exported under a different XLA backend
+        (the costs came from another float pipeline), by the native
+        engine, or at a different candidate width — degrades to an
+        honest cold re-ground on the first solve, never a hard error
+        mid-tick."""
+        self.invalidate()
+        if "pf_gpu_count" in state:
+            self._p_fields = {
+                name: np.array(state[f"pf_{name}"], copy=True)
+                for name, _ in _P_SPEC
+            }
+            self._r_fields = {
+                name: np.array(state[f"rf_{name}"], copy=True)
+                for name, _ in _R_SPEC
+            }
+        else:
+            self._p_fields = _canon(ep, _P_SPEC)
+            self._r_fields = _canon(er, _R_SPEC)
+        cand_p = np.asarray(state["cand_p"])
+        n_p = self._p_fields["gpu_count"].shape[0]
+        n_t = self._r_fields["cpu_cores"].shape[0]
+        if (
+            state.get("native_isa") != jax_isa()
+            or cand_p.ndim != 2
+            or cand_p.shape != (n_t, min(self.k, n_p) + self.extra)
+        ):
+            self.invalidate()
+            return
+        self._cand_p = np.array(
+            cand_p, _JAX_STATE_DTYPES["cand_p"], copy=True
+        )
+        self._cand_c = np.array(
+            state["cand_c"], _JAX_STATE_DTYPES["cand_c"], copy=True
+        )
+        for name in ("price", "retired", "p4t", "starve_age"):
+            v = state.get(name)
+            setattr(
+                self, f"_{name}",
+                None if v is None else np.array(v, copy=True),
+            )
+        self._warm_solves = int(state["warm_solves"])
+        self._dual_age = int(state["dual_age"])
+        self._weights_key = tuple(state["weights_key"])
+
+    # ---------------- internals ----------------
+
+    @staticmethod
+    def _wkey(weights) -> tuple:
+        return (
+            float(weights.price), float(weights.load),
+            float(weights.proximity), float(weights.priority),
+        )
+
+    def _shapes_compatible(self, pf: dict, rf: dict) -> bool:
+        old_p, old_r = self._p_fields, self._r_fields
+        if old_p is None or old_r is None:
+            return False
+        return all(
+            pf[n].shape == old_p[n].shape for n, _ in _P_SPEC
+        ) and all(rf[n].shape == old_r[n].shape for n, _ in _R_SPEC)
+
+    def _ensure_devices(self) -> int:
+        """Resolve the requested device count against the host, once.
+        Over-asking clamps to what exists — counted and flagged, never
+        fatal, never a cross-engine fallback."""
+        if self._devices_effective is None:
+            avail = jax.local_device_count()
+            want = avail if self.devices <= 0 else self.devices
+            if want > avail:
+                self.device_degraded = True
+                self.device_degraded_events += 1
+                want = max(avail, 1)
+            self._devices_effective = want
+            if want > 1:
+                from protocol_tpu.parallel.mesh import make_mesh
+
+                self._mesh = make_mesh(want)
+        return self._devices_effective
+
+    def _gen(self, pf: dict, rf: dict, weights):
+        """One candidate-generation pass: sharded over the device mesh
+        when D > 1 and the shard/tile shapes divide, single-device
+        otherwise (flagged via ``gen_sharded``). Deterministic for
+        fixed inputs — the warm path diffs its output row-wise against
+        the carried structure to get the exact changed set.
+
+        The tile is a function of T ONLY — never of D. Reverse-edge
+        selection is tile-POOLED (per-tile top-ceil(r/n_tiles), best r
+        of the pool: see candidates_topk_reverse), so the candidate
+        structure is a function of the global tiling; a D-derived tile
+        would silently break the bit-exact D-invariance contract this
+        arena's warm carry (and the provenance tag's D-exclusion)
+        rests on. The cap keeps the tile no larger than T/8 so a mesh
+        of up to 8 devices shards evenly on round task counts; a shape
+        where the per-shard count doesn't divide the tile degrades to
+        single-device generation with the SAME tile — same bits,
+        flagged, never a different structure."""
+        ep = EncodedProviders(**pf)
+        er = EncodedRequirements(**rf)
+        T = rf["cpu_cores"].shape[0]
+        tile = pick_tile(T, cap=min(1024, max(1, T // 8)))
+        D = self._ensure_devices()
+        if (
+            self._mesh is not None
+            and T % D == 0
+            and (T // D) % tile == 0
+        ):
+            from protocol_tpu.parallel.sparse import (
+                candidates_topk_bidir_sharded,
+            )
+
+            cand_p, cand_c = candidates_topk_bidir_sharded(
+                ep, er, weights, mesh=self._mesh, k=self.k,
+                tile=tile, reverse_r=self.reverse_r,
+                extra=self.extra, approx_recall=self.approx_recall,
+            )
+            sharded = True
+        else:
+            cand_p, cand_c = candidates_topk_bidir(
+                ep, er, weights, k=self.k, tile=tile,
+                reverse_r=self.reverse_r, extra=self.extra,
+                approx_recall=self.approx_recall,
+            )
+            sharded = False
+        return (
+            np.asarray(cand_p, np.int32),
+            np.asarray(cand_c, np.float32),
+            sharded,
+        )
+
+    def _ladder(self, P: int, eng: Optional[dict]):
+        """Cold/refresh solve stage: the eps-annealed auction ladder
+        from scratch duals over the CURRENT candidate structure."""
+        res, price, retired = assign_auction_sparse_scaled(
+            jnp.asarray(self._cand_p), jnp.asarray(self._cand_c),
+            num_providers=P, eps_start=self.eps_start,
+            eps_end=self.eps_end, stats_out=eng, with_state=True,
+        )
+        # np.array (not asarray): asarray over a device buffer hands back
+        # a READ-ONLY view, and the arena mutates p4t in place on the
+        # seat-guard and dirty-row paths. Owned copies, always.
+        return (
+            np.array(res.provider_for_task, np.int32),
+            np.array(price, np.float32),
+            np.array(retired, bool),
+        )
+
+    def _warm(
+        self, P: int, p4t0: np.ndarray, changed: np.ndarray,
+        eng: Optional[dict],
+    ):
+        """Warm solve stage: delta-frontier auction from the carried
+        duals. Retirement is cleared for exactly the ``changed`` rows
+        (candidates or costs moved, or the seat was re-opened) — the
+        warm kernel's documented caller contract; the kernel itself
+        applies the uniform price downshift that keeps carried prices
+        sound."""
+        res, price, retired = assign_auction_sparse_warm(
+            jnp.asarray(self._cand_p), jnp.asarray(self._cand_c),
+            num_providers=P,
+            price0=jnp.asarray(self._price),
+            p4t0=jnp.asarray(p4t0),
+            eps=self.eps_end,
+            retired0=jnp.asarray(self._retired & ~changed),
+            stats_out=eng, with_state=True,
+        )
+        # Owned copies for the same reason as _ladder: the carried
+        # structure must stay writable across warm ticks.
+        return (
+            np.array(res.provider_for_task, np.int32),
+            np.array(price, np.float32),
+            np.array(retired, bool),
+        )
+
+    def _quality_pass(
+        self, rf: dict, p4t, price, prev_p4t, eng: Optional[dict] = None
+    ) -> dict:
+        t0 = time.perf_counter()
+        stats, self._starve_age = _quality.tick_quality(
+            self._cand_p, self._cand_c, p4t, price,
+            valid=rf["valid"].astype(bool),
+            prev_p4t=prev_p4t,
+            starve_age=self._starve_age,
+            outcomes=None,
+            eng=eng,
+        )
+        stats["quality_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        self._last_quality = stats
+        return stats
+
+    def _base_stats(self, T: int, gen_sharded: bool) -> dict:
+        return {
+            "native_isa": jax_isa(),
+            "engine": "jax",
+            "jax_devices": int(self._devices_effective or 1),
+            "gen_sharded": gen_sharded,
+            "device_degraded": self.device_degraded,
+            "rows": T,
+        }
+
+    def _cold(self, weights, pf, rf, P, T) -> np.ndarray:
+        eng: Optional[dict] = {} if obs.enabled() else None
+        t0 = time.perf_counter()
+        with _tracer.span("arena.candidates", cold=True, tasks=T):
+            self._cand_p, self._cand_c, sharded = self._gen(pf, rf, weights)
+        t_gen = time.perf_counter()
+        with _tracer.span("arena.engine", engine="jax", cold=True):
+            p4t, price, retired = self._ladder(P, eng)
+        t_solve = time.perf_counter()
+        self._p_fields, self._r_fields = pf, rf
+        self._owned_cols = set()
+        self._weights_key = self._wkey(weights)
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves = 0
+        self._dual_age = 0
+        self._starve_age = None
+        qual = (
+            self._quality_pass(rf, p4t, price, None, eng)
+            if obs.enabled() else {}
+        )
+        self.last_stats = {
+            **self._base_stats(T, sharded),
+            **qual,
+            "cold": True,
+            "cand_cold_passes": 1,
+            "dirty_providers": P,
+            "dirty_tasks": T,
+            "changed_rows": T,
+            "warm_solves_since_cold": 0,
+            "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t0) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t
+
+    # ---------------- streaming entry points ----------------
+
+    def apply_rows(
+        self,
+        provider_rows: Optional[np.ndarray],
+        p_rows: Optional[dict],
+        task_rows: Optional[np.ndarray],
+        r_rows: Optional[dict],
+        weights,
+        event_eps_start: Optional[float] = None,
+    ) -> np.ndarray:
+        """Single-event entry (the stream engine's hot path), same
+        contract as the native arena: explicit churned rows, values
+        equal to the current columns dropped, the arena's baseline
+        updated in place for truly-dirty rows, RuntimeError/ValueError
+        on an unprimed arena or a weights mismatch.
+
+        The jax engine has no incremental repair kernel: a dirty event
+        pays one full (deterministic) gen pass plus a warm solve —
+        reported honestly as ``cand_cold_passes: 1``. ``event_eps_start``
+        is accepted for signature parity; the jax warm kernel runs one
+        fine-eps phase (its own eps-CS repair handles re-seating)."""
+        if self._cand_p is None:
+            raise RuntimeError(
+                "arena not primed for apply_rows: run solve() first "
+                "(the persistent candidate structure must exist)"
+            )
+        if self._weights_key != self._wkey(weights):
+            raise ValueError(
+                "apply_rows under different weights: the carried "
+                "structure was scored under the old weights (re-prime "
+                "with a batch solve)"
+            )
+        t_start = time.perf_counter()
+        P = self._p_fields["gpu_count"].shape[0]
+        T = self._r_fields["cpu_cores"].shape[0]
+
+        def _narrow(rows, vals, fields, spec, n, side):
+            if rows is None or vals is None:
+                return np.zeros(0, np.int32)
+            rows = np.asarray(rows, np.int64).ravel()
+            if rows.size == 0:
+                return np.zeros(0, np.int32)
+            if rows.min() < 0 or rows.max() >= n:
+                raise ValueError(f"event row index out of range [0, {n})")
+            dirty = np.zeros(rows.size, bool)
+            canon = {}
+            for name, dtype in spec:
+                v = np.ascontiguousarray(np.asarray(vals[name]), dtype)
+                if v.shape[0] != rows.size:
+                    raise ValueError(
+                        f"event column {name!r} has {v.shape[0]} rows "
+                        f"for {rows.size} row indices"
+                    )
+                canon[name] = v
+                diff = fields[name][rows] != v
+                dirty |= diff.reshape(rows.size, -1).any(axis=1)
+            keep = np.flatnonzero(dirty)
+            if keep.size:
+                idx = rows[keep]
+                for name, _ in spec:
+                    key = (side, name)
+                    if key not in self._owned_cols:
+                        fields[name] = fields[name].copy()
+                        self._owned_cols.add(key)
+                    fields[name][idx] = canon[name][keep]
+            return rows[keep].astype(np.int32)
+
+        dirty_p = _narrow(
+            provider_rows, p_rows, self._p_fields, _P_SPEC, P, "p"
+        )
+        dirty_t = _narrow(
+            task_rows, r_rows, self._r_fields, _R_SPEC, T, "r"
+        )
+        n_dp, n_dt = int(dirty_p.size), int(dirty_t.size)
+        if n_dp == 0 and n_dt == 0:
+            self.last_repair_mask = None
+            self.last_stats = {
+                **self._base_stats(T, False),
+                "cold": False, "event": True,
+                "cand_cold_passes": 0, "dirty_providers": 0,
+                "dirty_tasks": 0, "changed_rows": 0,
+                "assigned": int((self._p4t >= 0).sum()),
+            }
+            return self._p4t.copy()
+
+        eng: Optional[dict] = {} if obs.enabled() else None
+        cand_p, cand_c, sharded = self._gen(
+            self._p_fields, self._r_fields, weights
+        )
+        changed = (
+            (cand_p != self._cand_p).any(axis=1)
+            | (cand_c != self._cand_c).any(axis=1)
+        )
+        self._cand_p, self._cand_c = cand_p, cand_c
+        if n_dt:
+            self._p4t[dirty_t] = -1
+            changed[dirty_t] = True
+        seat_check = np.flatnonzero(changed & (self._p4t >= 0))
+        if seat_check.size:
+            in_list = (
+                self._cand_p[seat_check] == self._p4t[seat_check, None]
+            ).any(axis=1)
+            lost = seat_check[~in_list]
+            if lost.size:
+                self._p4t[lost] = -1
+        t_gen = time.perf_counter()
+        p4t, price, retired = self._warm(P, self._p4t, changed, eng)
+        t_solve = time.perf_counter()
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self.last_repair_mask = changed
+        self.last_stats = {
+            **self._base_stats(T, sharded),
+            "cold": False,
+            "event": True,
+            "cand_cold_passes": 1,
+            "dirty_providers": n_dp,
+            "dirty_tasks": n_dt,
+            "changed_rows": int(changed.sum()),
+            "repair_rows": int(changed.sum()),
+            "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t_start) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t
+
+    def reconcile(self) -> np.ndarray:
+        """Full batch re-solve over the CURRENT candidate structure from
+        scratch duals — the stream engine's periodic reconciliation.
+        The regen-exactness contract makes the current structure equal
+        to a from-scratch rebuild on the current columns, so this is
+        bit-identical to a cold solve without re-paying the gen pass."""
+        if self._cand_p is None:
+            raise RuntimeError(
+                "arena not primed for reconcile: run solve() first"
+            )
+        t0 = time.perf_counter()
+        P = self._p_fields["gpu_count"].shape[0]
+        T = self._r_fields["cpu_cores"].shape[0]
+        eng: Optional[dict] = {} if obs.enabled() else None
+        prev_p4t = self._p4t.copy() if obs.enabled() else None
+        with _tracer.span("arena.engine", engine="jax", reconcile=True):
+            p4t, price, retired = self._ladder(P, eng)
+        t_solve = time.perf_counter()
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves = 0
+        self._dual_age = 0
+        self._starve_age = None
+        qual = (
+            self._quality_pass(self._r_fields, p4t, price, prev_p4t, eng)
+            if obs.enabled() else {}
+        )
+        self.last_stats = {
+            **self._base_stats(T, False),
+            **qual,
+            "cold": False,
+            "reconcile": True,
+            "cand_cold_passes": 0,
+            "dirty_providers": 0,
+            "dirty_tasks": 0,
+            "changed_rows": 0,
+            "assigned": int((p4t >= 0).sum()),
+            "solve_ms": round((t_solve - t0) * 1e3, 3),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t
+
+    # ---------------- the solve ----------------
+
+    def solve(self, ep, er, weights) -> np.ndarray:
+        """One marketplace solve. ``ep``/``er`` are EncodedProviders /
+        EncodedRequirements (numpy- or jax-backed, or any object with
+        the same field names); returns provider_for_task [T] i32."""
+        with _tracer.span("arena.solve", engine="jax"):
+            return self._solve_impl(ep, er, weights)
+
+    def _solve_impl(self, ep, er, weights) -> np.ndarray:
+        pf = _canon(ep, _P_SPEC)
+        rf = _canon(er, _R_SPEC)
+        P = pf["gpu_count"].shape[0]
+        T = rf["cpu_cores"].shape[0]
+        if P == 0 or T == 0:
+            self.last_stats = {
+                "native_isa": jax_isa(), "engine": "jax",
+                "cold": True, "assigned": 0,
+            }
+            return np.full(T, -1, np.int32)
+
+        if (
+            not self._shapes_compatible(pf, rf)
+            or self._weights_key != self._wkey(weights)
+            or self._warm_solves >= self.cold_every
+        ):
+            return self._cold(weights, pf, rf, P, T)
+
+        dirty_p = _dirty_rows(pf, self._p_fields, _P_SPEC)
+        dirty_t = _dirty_rows(rf, self._r_fields, _R_SPEC)
+        n_dp, n_dt = int(dirty_p.sum()), int(dirty_t.sum())
+        if (n_dp + n_dt) / (P + T) > self.max_dirty_frac:
+            return self._cold(weights, pf, rf, P, T)
+        if n_dp == 0 and n_dt == 0:
+            # byte-identical marketplace: the carried matching IS the
+            # solve — same short-circuit as the native arena, with the
+            # carried quality certificate reused verbatim
+            self._warm_solves += 1
+            qual: dict = {}
+            if obs.enabled():
+                t_q = time.perf_counter()
+                self._starve_age = _quality.starvation_update(
+                    self._starve_age, self._p4t,
+                    rf["valid"].astype(bool),
+                )
+                qual = dict(self._last_quality)
+                qual["churn_rows"] = 0
+                qual["churn_ratio"] = 0.0
+                qual["starve_max"] = (
+                    int(self._starve_age.max())
+                    if self._starve_age.size else 0
+                )
+                qual["starving"] = int((self._starve_age > 0).sum())
+                qual["starve_hist"] = _quality.starvation_hist(
+                    self._starve_age
+                )
+                qual["quality_ms"] = round(
+                    (time.perf_counter() - t_q) * 1e3, 3
+                )
+                self._last_quality = qual
+            self.last_stats = {
+                **self._base_stats(T, False),
+                **qual,
+                "cold": False,
+                "cand_cold_passes": 0,
+                "dirty_providers": 0,
+                "dirty_tasks": 0,
+                "changed_rows": 0,
+                "warm_solves_since_cold": self._warm_solves,
+                "assigned": int((self._p4t >= 0).sum()),
+            }
+            return self._p4t.copy()
+
+        eng: Optional[dict] = {} if obs.enabled() else None
+        prev_p4t = self._p4t.copy() if obs.enabled() else None
+        t_start = time.perf_counter()
+        self._p_fields, self._r_fields = pf, rf
+        self._owned_cols = set()
+
+        # ---- deterministic regen IS the repair: unchanged rows come
+        # back bit-identical, so the row-wise diff against the carried
+        # structure is the exact changed set (membership moved or any
+        # cost moved — a superset of "materially cheaper", so clearing
+        # retirement on it is sound, just occasionally generous)
+        cand_p, cand_c, sharded = self._gen(pf, rf, weights)
+        changed = (
+            (cand_p != self._cand_p).any(axis=1)
+            | (cand_c != self._cand_c).any(axis=1)
+        )
+        self._cand_p, self._cand_c = cand_p, cand_c
+        if n_dt:
+            # a dirty task's seat predates its new requirement: re-seat
+            # from scratch
+            di = np.flatnonzero(dirty_t)
+            self._p4t[di] = -1
+            changed[di] = True
+
+        # ---- feasibility guard: a seat whose provider left the row's
+        # candidate list must be unseated here (only changed rows can
+        # have lost one — unchanged rows kept identical lists)
+        seat_check = np.flatnonzero(changed & (self._p4t >= 0))
+        if seat_check.size:
+            in_list = (
+                self._cand_p[seat_check] == self._p4t[seat_check, None]
+            ).any(axis=1)
+            lost = seat_check[~in_list]
+            if lost.size:
+                self._p4t[lost] = -1
+
+        t_gen = time.perf_counter()
+        _tracer.record_span(
+            "arena.candidates", int(t_start * 1e9),
+            int((t_gen - t_start) * 1e9), cold=False,
+            dirty_providers=n_dp, dirty_tasks=n_dt,
+        )
+        dual_refresh = (
+            self.dual_refresh_every > 0
+            and self._dual_age >= self.dual_refresh_every
+        )
+        if dual_refresh:
+            p4t, price, retired = self._ladder(P, eng)
+            self._dual_age = 0
+        else:
+            p4t, price, retired = self._warm(P, self._p4t, changed, eng)
+            self._dual_age += 1
+        t_solve = time.perf_counter()
+        _tracer.record_span(
+            "arena.engine", int(t_gen * 1e9),
+            int((t_solve - t_gen) * 1e9), engine="jax", cold=False,
+        )
+        self._price, self._retired, self._p4t = price, retired, p4t
+        self._warm_solves += 1
+        qual = (
+            self._quality_pass(rf, p4t, price, prev_p4t, eng)
+            if obs.enabled() else {}
+        )
+        self.last_stats = {
+            **self._base_stats(T, sharded),
+            **qual,
+            "cold": False,
+            "cand_cold_passes": 1,
+            "dual_refresh": dual_refresh,
+            "dirty_providers": n_dp,
+            "dirty_tasks": n_dt,
+            "changed_rows": int(changed.sum()),
+            "warm_solves_since_cold": self._warm_solves,
+            "assigned": int((p4t >= 0).sum()),
+            "gen_ms": round((t_gen - t_start) * 1e3, 3),
+            "solve_ms": round((t_solve - t_gen) * 1e3, 3),
+            **({f"eng_{k}": v for k, v in eng.items()} if eng else {}),
+        }
+        return p4t

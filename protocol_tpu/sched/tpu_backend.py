@@ -256,13 +256,31 @@ class TpuBatchMatcher:
         # but solves with the O(nnz) sparse entropic engine (warm (f, g)
         # potential carry + auction-referee rounding) — the soft/
         # relaxation twin the combinatorial solver is refereed against.
-        # native_threads: 0 = all hardware threads.
-        if native_engine not in ("native", "native-mt", "sinkhorn-mt"):
+        # native_threads: 0 = all hardware threads. "jax[:D]" selects
+        # the accelerator-path warm arena (parallel/jax_arena.py) as a
+        # PEER of the native engines — same persistent-arena semantics,
+        # sharded candidate generation over D devices (0/absent = all
+        # visible). It is not gated on native_fallback: under fallback
+        # the process is pinned to CPU and the jax engine runs there,
+        # single-device — degraded inside the engine, never silently
+        # swapped for a native one.
+        self._jax_devices = 0
+        if native_engine.partition(":")[0] == "jax":
+            suffix = native_engine.partition(":")[2]
+            try:
+                self._jax_devices = int(suffix) if suffix else 0
+            except ValueError:
+                raise ValueError(
+                    f"bad jax device suffix in {native_engine!r} "
+                    "(want jax[:D])"
+                )
+        elif native_engine not in ("native", "native-mt", "sinkhorn-mt"):
             raise ValueError(
-                "native_engine must be native|native-mt|sinkhorn-mt, "
-                f"got {native_engine!r}"
+                "native_engine must be native|native-mt|sinkhorn-mt|"
+                f"jax[:D], got {native_engine!r}"
             )
         self.native_engine = native_engine
+        self._jax_engine = native_engine.partition(":")[0] == "jax"
         self.native_threads = int(native_threads)
         self._native_arena = None
         self._last_arena_stats: dict = {}
@@ -422,6 +440,41 @@ class TpuBatchMatcher:
             return np.asarray(_cost_only(ep, er, self.weights))
 
     def _bounded_t4p(self, ep, er) -> np.ndarray:
+        if self._jax_engine:
+            # the accelerator-path peer of the native arenas: persistent
+            # candidate structure + warm auction duals, sharded gen over
+            # the device mesh — checked BEFORE native_fallback so a
+            # CPU-pinned process still runs the jax engine (on CPU
+            # devices), never a silent native swap
+            n_providers = int(np.asarray(ep.gpu_count).shape[0])
+            if self._native_arena is None:
+                from protocol_tpu.parallel.jax_arena import JaxSolveArena
+
+                self._native_arena = JaxSolveArena(
+                    cold_every=self.cold_every,
+                    devices=self._jax_devices,
+                    approx_recall=self.approx_recall,
+                )
+            p4s = self._native_arena.solve(ep, er, self.weights)
+            self._last_arena_stats = {
+                f"arena_{k}": v
+                for k, v in self._native_arena.last_stats.items()
+            }
+            if self.trace_recorder is not None:
+                from protocol_tpu.trace.recorder import safe as _trace_safe
+
+                _trace_safe(
+                    self.trace_recorder.record_solve, ep, er,
+                    self.weights, self.native_engine,
+                    self._native_arena.k, self._native_arena.eps_end,
+                    0, p4s, self._native_arena.price,
+                    metrics=dict(self._last_arena_stats),
+                )
+            t4p = np.full(n_providers, -1, np.int32)
+            for s_idx, p_idx in enumerate(p4s):
+                if p_idx >= 0:
+                    t4p[p_idx] = s_idx
+            return t4p
         if self.native_fallback:
             from protocol_tpu import native
 
@@ -1236,6 +1289,10 @@ class TpuBatchMatcher:
         s_bucket = _pow2_bucket(len(slot_task)) if slot_task else 0
         use_sparse = bool(slot_task) and (
             not self.native_fallback
+            # the jax engine owns phase 1 through its arena (which IS
+            # the sparse pipeline, warm): the stateless sparse_topk
+            # rung would re-pay cold generation every solve
+            and not self._jax_engine
             and p_bucket * s_bucket > self.dense_cell_budget
         )
         # The candidate cache owns the provider index space on the cached
@@ -1405,7 +1462,9 @@ class TpuBatchMatcher:
                         zip(addrs, np.asarray(price[:P], np.float64).tolist())
                     )
             else:
-                if not self.native_fallback:
+                if self._jax_engine:
+                    kernel_used = "jax_arena"
+                elif not self.native_fallback:
                     kernel_used = "dense_auction"
                 elif self.native_engine == "sinkhorn-mt":
                     kernel_used = "native_cpu_sinkhorn_mt"

@@ -256,9 +256,9 @@ async def serve_orchestrator(args) -> None:
             # wire protocol revision: v2 (tensor frames + delta sessions)
             # falls back to v1 automatically against an old server
             wire=os.environ.get("PROTOCOL_TPU_WIRE", "v2"),
-            # the native-engine knobs ride the wire as the kernel string
-            # ("native-mt[:N]" / "sinkhorn-mt[:N]") when the control
-            # plane is in degraded mode
+            # the engine knobs ride the wire as the kernel string
+            # ("native-mt[:N]" / "sinkhorn-mt[:N]" / "jax[:D]") when the
+            # control plane is in degraded mode
             native_fallback=os.environ.get(
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
@@ -277,10 +277,13 @@ async def serve_orchestrator(args) -> None:
                 "PROTOCOL_TPU_NATIVE_FALLBACK", ""
             ).lower()
             in ("1", "true", "yes"),
-            # native | native-mt | sinkhorn-mt: the multi-threaded
-            # engines + persistent warm arena for degraded-mode
-            # deployments with cores to spare (sinkhorn-mt = the O(nnz)
-            # entropic solver with auction-referee rounding)
+            # native | native-mt | sinkhorn-mt | jax[:D]: native-* are
+            # the multi-threaded host engines + persistent warm arena
+            # for degraded-mode deployments with cores to spare
+            # (sinkhorn-mt = the O(nnz) entropic solver with
+            # auction-referee rounding); jax[:D] is the first-class JAX
+            # engine — sharded candidate gen over D devices + adaptive
+            # eps-ladder solve with warm dual carry
             native_engine=os.environ.get(
                 "PROTOCOL_TPU_NATIVE_ENGINE", "native"
             ),

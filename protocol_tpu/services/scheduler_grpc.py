@@ -66,6 +66,7 @@ from protocol_tpu.proto.wire import (
 from protocol_tpu.sched.tpu_backend import TpuBatchMatcher
 from protocol_tpu.services.session_store import (
     SolveSession,
+    make_solve_arena,
     parse_native_threads,
     parse_session_kernel,
     _pad_cols,
@@ -562,15 +563,16 @@ class SchedulerBackendServicer:
             )
 
         if kernel == "native" or kernel.startswith(
-            ("native-mt", "sinkhorn-mt")
+            ("native-mt", "sinkhorn-mt", "jax")
         ):
-            # the C++ CPU engine behind the seam: "native" is the
+            # the engines behind the seam: "native" is the
             # single-threaded Gauss-Seidel solve, "native-mt[:N]" the
-            # multi-threaded auction engine and "sinkhorn-mt[:N]" the
-            # sparse entropic engine, both through the servicer's
-            # persistent warm arena (N threads; absent/0 = all hardware
-            # threads — the suffix spelling keeps the wire message
-            # unchanged)
+            # multi-threaded auction engine, "sinkhorn-mt[:N]" the
+            # sparse entropic engine, and "jax[:D]" the accelerator-path
+            # arena (D sharded-gen devices), all but "native" through
+            # the servicer's persistent warm arena (N threads; absent/0
+            # = all hardware threads / all visible devices — the suffix
+            # spelling keeps the wire message unchanged)
             from protocol_tpu import native as native_mod
 
             p_padded = int(np.asarray(ep.gpu_count).shape[0])
@@ -608,13 +610,12 @@ class SchedulerBackendServicer:
                         # a changed k or engine changes the whole
                         # carried structure: a fresh arena (cold
                         # solve) is the only honest answer
-                        from protocol_tpu.native.arena import (
-                            NativeSolveArena,
+                        from protocol_tpu.services.session_store import (
+                            make_solve_arena,
                         )
 
-                        self._native_arena = NativeSolveArena(
-                            k=requested_k, threads=threads,
-                            engine=engine,
+                        self._native_arena = make_solve_arena(
+                            engine, k=requested_k, threads=threads,
                         )
                     grant = self._engine_budget.acquire(threads, "unary")
                     try:
@@ -1094,7 +1095,7 @@ class SchedulerBackendServicer:
             return pb.OpenSessionResponse(
                 ok=False,
                 error=f"kernel {kernel!r} is not session-servable "
-                      "(want native-mt[:N] | sinkhorn-mt[:N])",
+                      "(want native-mt[:N] | sinkhorn-mt[:N] | jax[:D])",
             )
         engine, threads = parsed
         try:
@@ -1120,7 +1121,6 @@ class SchedulerBackendServicer:
         n_p = p_cols["gpu_count"].shape[0]
         n_t = r_cols["cpu_cores"].shape[0]
         from protocol_tpu.fleet import estimate_arena_bytes
-        from protocol_tpu.native.arena import NativeSolveArena
 
         padded_p = _pad_cols(p_cols, n_p)
         padded_r = _pad_cols(r_cols, n_t)
@@ -1135,7 +1135,7 @@ class SchedulerBackendServicer:
             r_cols=padded_r,
             n_providers=n_p,
             n_tasks=n_t,
-            arena=NativeSolveArena(k=top_k, threads=threads, engine=engine),
+            arena=make_solve_arena(engine, k=top_k, threads=threads),
             budget=self._engine_budget,
             # fleet arena budget: rows x dtype widths, estimated once
             arena_bytes=estimate_arena_bytes(padded_p, padded_r, top_k),
@@ -2698,6 +2698,15 @@ class RemoteBatchMatcher(TpuBatchMatcher):
             return self.native_engine + (
                 f":{self.native_threads}" if self.native_threads else ""
             )
+        if self.native_engine.partition(":")[0] == "jax":
+            # first-class engine, same suffix convention (jax[:D], D =
+            # sharded-gen devices; a bare "jax" picks the suffix up from
+            # native_threads like the native engines do). NEVER demoted
+            # to "native" — a silent cross-engine swap would invalidate
+            # every replay A/B keyed on the session kernel string.
+            if ":" in self.native_engine or not self.native_threads:
+                return self.native_engine
+            return f"jax:{self.native_threads}"
         return "native"
 
     def _bounded_t4p(self, ep, er) -> np.ndarray:

@@ -39,7 +39,11 @@ from protocol_tpu.proto.wire import P_WIRE_DTYPES, R_WIRE_DTYPES
 from protocol_tpu.utils.lockwitness import make_lock
 
 # session-servable kernel strings -> the arena engine behind them
-_SESSION_ENGINES = {"native-mt": "auction", "sinkhorn-mt": "sinkhorn"}
+_SESSION_ENGINES = {
+    "native-mt": "auction",
+    "sinkhorn-mt": "sinkhorn",
+    "jax": "jax",
+}
 
 
 def _session_lock():
@@ -51,10 +55,11 @@ def _inflight_lock():
 
 
 def parse_session_kernel(kernel: str) -> Optional[tuple[str, int]]:
-    """``native-mt[:N]`` / ``sinkhorn-mt[:N]`` -> (arena engine, thread
-    count; 0 = all hardware threads). Any other kernel -> None (not
-    session-servable: the session protocol's warm state lives in the
-    native arena)."""
+    """``native-mt[:N]`` / ``sinkhorn-mt[:N]`` / ``jax[:D]`` ->
+    (arena engine, thread count; 0 = all hardware threads — for the jax
+    engine the suffix is the DEVICE count instead, 0 = all visible).
+    Any other kernel -> None (not session-servable: the session
+    protocol's warm state lives in a solve arena)."""
     base, _, suffix = kernel.partition(":")
     engine = _SESSION_ENGINES.get(base)
     if engine is None:
@@ -63,6 +68,24 @@ def parse_session_kernel(kernel: str) -> Optional[tuple[str, int]]:
         return engine, (int(suffix) if suffix else 0)
     except ValueError:
         return None
+
+
+def make_solve_arena(engine: str, k: int, threads: int, **kw):
+    """One home for arena construction from a parsed kernel string —
+    the engine seam every server surface routes through (sessions, the
+    unary persistent arena, checkpoint restore). ``engine="jax"``
+    returns the accelerator-path :class:`~protocol_tpu.parallel.
+    jax_arena.JaxSolveArena` (``threads`` becomes its sharded-gen
+    device count; 0 = all visible devices, the mesh analog of "all
+    hardware threads"); anything else is a
+    :class:`~protocol_tpu.native.arena.NativeSolveArena` engine."""
+    if engine == "jax":
+        from protocol_tpu.parallel.jax_arena import JaxSolveArena
+
+        return JaxSolveArena(k=k, devices=threads, **kw)
+    from protocol_tpu.native.arena import NativeSolveArena
+
+    return NativeSolveArena(k=k, threads=threads, engine=engine, **kw)
 
 
 def parse_native_threads(kernel: str) -> Optional[int]:

@@ -16,8 +16,9 @@ must reproduce the recorded ``provider_for_task`` bit-for-bit. When it
 does not, the report names the first divergent tick and the exact row
 set — a solver regression localizes to "tick 12, rows [841, 2207]"
 instead of "the bench got slower". ``engine="jax"`` replays through the
-jitted sparse pipeline (cold per tick — for A/B quality comparisons, not
-bit-identity with a native recording).
+accelerator-path warm arena (parallel/jax_arena.py) on every transport:
+bit-identical against a jax-recorded golden, honest divergence + the
+``compare()`` tolerance table against a native recording.
 
 ``compare()`` replays the same trace under two configs side by side —
 the A/B harness every perf PR can now cite instead of hand-rolled bench
@@ -38,12 +39,16 @@ from protocol_tpu.trace import format as tfmt
 
 _ENGINES = ("native-mt", "sinkhorn-mt", "jax")
 _TRANSPORTS = ("inproc", "wire-v1", "wire-v2")
-_ARENA_ENGINE = {"native-mt": "auction", "sinkhorn-mt": "sinkhorn"}
+_ARENA_ENGINE = {
+    "native-mt": "auction",
+    "sinkhorn-mt": "sinkhorn",
+    "jax": "jax",
+}
 
 
 def parse_engine(kernel: str) -> tuple[str, int]:
-    """``native-mt[:N]`` / ``sinkhorn-mt[:N]`` / ``jax`` ->
-    (engine, threads)."""
+    """``native-mt[:N]`` / ``sinkhorn-mt[:N]`` / ``jax[:D]`` ->
+    (engine, threads — sharded-gen devices for the jax engine)."""
     base, _, suffix = kernel.partition(":")
     if base not in _ENGINES:
         raise ValueError(
@@ -97,16 +102,18 @@ def _free_port() -> int:
 class _InprocArena:
     """Transport "inproc": the session path minus the wire — identical
     pow2 padding (session_store._pad_cols) and arena construction, so
-    in-process and wire-v2 replays are bit-identical by construction."""
+    in-process and wire-v2 replays are bit-identical by construction.
+    ``engine="jax"`` gets the warm accelerator-path arena through the
+    same factory the servicer uses (threads = sharded-gen devices)."""
 
     def __init__(self, snap: tfmt.Snapshot, engine: str, threads: int):
-        from protocol_tpu.native.arena import NativeSolveArena
+        from protocol_tpu.services.session_store import make_solve_arena
 
         self.engine = engine
         self.threads = threads
         self.top_k = max(int(snap.top_k) or 64, 1)
-        self.arena = NativeSolveArena(
-            k=self.top_k, threads=threads, engine=_ARENA_ENGINE[engine]
+        self.arena = make_solve_arena(
+            _ARENA_ENGINE[engine], k=self.top_k, threads=threads
         )
         self.weights = None  # set per solve
 
@@ -126,48 +133,6 @@ class _InprocArena:
         pass
 
 
-class _InprocJax:
-    """Transport "inproc", engine "jax": the jitted sparse pipeline,
-    cold per tick (no warm carry — the stateless quality referee)."""
-
-    def __init__(self, snap: tfmt.Snapshot, threads: int):
-        self.top_k = max(int(snap.top_k) or 64, 1)
-
-    def solve(self, snap, p_cols, r_cols) -> tuple[np.ndarray, dict]:
-        from protocol_tpu.ops.cost import CostWeights
-        from protocol_tpu.ops.encoding import (
-            EncodedProviders,
-            EncodedRequirements,
-        )
-        from protocol_tpu.ops.sparse import (
-            assign_auction_sparse_scaled,
-            candidates_topk_bidir,
-        )
-        from protocol_tpu.services.session_store import _pad_cols
-
-        n_p, n_t = snap.n_providers, snap.n_tasks
-        ep = EncodedProviders(**_pad_cols(p_cols, n_p))
-        er = EncodedRequirements(**_pad_cols(r_cols, n_t))
-        w = CostWeights(*snap.weights)
-        t_pad = int(np.asarray(er.cpu_cores).shape[0])
-        tile = min(1024, t_pad)
-        while t_pad % tile != 0:
-            tile -= 1
-        cand_p, cand_c = candidates_topk_bidir(
-            ep, er, w, k=self.top_k, tile=tile, reverse_r=8, extra=16
-        )
-        res = assign_auction_sparse_scaled(
-            cand_p, cand_c,
-            num_providers=int(np.asarray(ep.gpu_count).shape[0]),
-            eps_end=np.float32(snap.eps).item() or 0.02,
-        )
-        p4t = np.asarray(res.provider_for_task, np.int32)[:n_t]
-        return p4t, {}
-
-    def close(self) -> None:
-        pass
-
-
 class _WireTransport:
     """Loopback gRPC replay: "wire-v1" ships a full v1 snapshot per tick
     (the servicer's warm unary arena solves the churn); "wire-v2" runs
@@ -180,10 +145,6 @@ class _WireTransport:
             serve,
         )
 
-        if engine == "jax":
-            raise ValueError(
-                "engine=jax replays in-process only (use transport=inproc)"
-            )
         self.kernel = _kernel_str(engine, threads)
         self.top_k = max(int(snap.top_k) or 64, 1)
         self.wire_version = wire_version
@@ -353,10 +314,7 @@ def replay(
             pinned_isa = None  # no toolchain: backends will fail honestly
 
     if transport == "inproc":
-        if eng == "jax":
-            backend = _InprocJax(snap, n_threads)
-        else:
-            backend = _InprocArena(snap, eng, n_threads)
+        backend = _InprocArena(snap, eng, n_threads)
     else:
         backend = _WireTransport(
             snap, eng, n_threads, transport.split("-")[1]

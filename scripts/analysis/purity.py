@@ -8,10 +8,13 @@ promotion sneaking in through numpy defaults. On CPU these bugs cost a
 little; on a real TPU every one is either a compile error or a
 device-to-host round-trip that erases the point of the hardware.
 
-The pass finds every jit entry point (``@jax.jit``, ``@partial(jax.jit,
-static_argnames=...)``) under the jax roots (``ops/``, ``parallel/``,
-and the jax engine path in ``sched/tpu_backend.py``), closes over the
-call graph to every reachable helper, and checks the closure:
+The pass finds every jit entry point — decorator form (``@jax.jit``,
+``@partial(jax.jit, static_argnames=...)``) AND call form
+(``gen = jax.jit(fn)`` / ``return jax.jit(shard_map(fn, ...),
+static_argnames=...)``, the lru_cached sharded-builder idiom) — under
+the jax roots (``ops/``, ``parallel/``, and the jax engine path in
+``sched/tpu_backend.py``), closes over the call graph to every
+reachable helper, and checks the closure:
 
   P1 host sync: ``.item()`` / ``.tolist()`` / ``.block_until_ready()``,
      and ``np.asarray``/``np.array`` applied to a traced value — each
@@ -68,6 +71,11 @@ NP_PROMOTING_FNS = {
 }
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "at"}
 
+# transform layers a call-form jit may wrap around the actual kernel:
+# jax.jit(shard_map(fn, mesh=...)) / jax.jit(partial(fn, ...)) — the
+# traced body is the innermost named function
+JIT_WRAPPERS = {"shard_map", "partial", "vmap", "pmap", "checkpoint", "remat"}
+
 
 def _jit_static_argnames(dec: ast.AST) -> Optional[tuple]:
     """If ``dec`` is a jit decorator, return its static_argnames tuple
@@ -104,6 +112,27 @@ def _jit_static_argnames(dec: ast.AST) -> Optional[tuple]:
                 names.append(kw.value.value)
         return tuple(names)
     return None
+
+
+def _callform_target_name(call: ast.Call) -> Optional[str]:
+    """The function NAME a call-form jit wraps: ``jax.jit(fn)`` -> "fn",
+    unwrapping transform layers (``jax.jit(shard_map(fn, mesh=...))``,
+    ``jax.jit(partial(fn, ...))``). None for the decorator-factory shape
+    (``partial(jax.jit, ...)`` / ``jax.jit(static_argnames=...)``) — no
+    wrapped function rides in the positional slot there."""
+    if not call.args:
+        return None
+    inner = call.args[0]
+    while isinstance(inner, ast.Call):
+        f = inner.func
+        name = (
+            f.attr if isinstance(f, ast.Attribute)
+            else f.id if isinstance(f, ast.Name) else None
+        )
+        if name not in JIT_WRAPPERS or not inner.args:
+            return None
+        inner = inner.args[0]
+    return inner.id if isinstance(inner, ast.Name) else None
 
 
 class _Taint:
@@ -215,7 +244,10 @@ class PurityChecker:
     # ---------------- jit closure ----------------
 
     def jit_entries(self) -> dict[str, tuple]:
-        """qname -> static_argnames for every decorated jit entry."""
+        """qname -> static_argnames for every jit entry: decorator form
+        plus call form (``gen = jax.jit(fn, ...)`` assigned or returned
+        anywhere under the roots — the lru_cached sharded-builder idiom
+        the decorator scan cannot see)."""
         out = {}
         for qname, info in self.index.functions.items():
             for dec in getattr(info.node, "decorator_list", ()):
@@ -223,7 +255,42 @@ class PurityChecker:
                 if names is not None:
                     out[qname] = names
                     break
+        for rel, tree in self.index.trees.items():
+            for node in ast.walk(tree):
+                value = None
+                if isinstance(
+                    node, (ast.Assign, ast.AnnAssign, ast.Return)
+                ):
+                    value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                names = _jit_static_argnames(value)
+                if names is None:
+                    continue
+                target = _callform_target_name(value)
+                if target is None:
+                    continue
+                for qname in self._resolve_in_file(rel, target):
+                    out.setdefault(qname, names)
         return out
+
+    def _resolve_in_file(self, rel: str, name: str) -> list:
+        """Resolve the bare function name at a call-form jit site: every
+        same-file definition (top level or nested — builders jit their
+        local closures; multiple hits is the sound MAY direction), else
+        one import edge into another indexed module."""
+        local = [
+            q for q in self.index.by_name.get(name, ())
+            if self.index.functions[q].rel == rel
+        ]
+        if local:
+            return local
+        imp = self.index.imports.get(rel, {}).get(name)
+        if imp is not None:
+            q = self.index.modules.get(imp[0], {}).get(imp[1])
+            if q:
+                return [q]
+        return []
 
     def closure(self, entries) -> set[str]:
         seen = set(entries)

@@ -127,8 +127,16 @@ class TestSeededFixtures:
                 lambda f: purity.run(roots=(str(f),)),
                 "jax-purity", "purity_bad.py", "purity_ok.py",
             ),
+            (
+                lambda f: purity.run(roots=(str(f),)),
+                "jax-purity", "purity_calljit_bad.py",
+                "purity_calljit_ok.py",
+            ),
         ],
-        ids=["lock-reorder", "lock-dropped", "protocol-sm", "jax-purity"],
+        ids=[
+            "lock-reorder", "lock-dropped", "protocol-sm", "jax-purity",
+            "jax-purity-callform",
+        ],
     )
     def test_seeds_and_clean_twin(self, runner, rule, bad, ok):
         expected = seeded_lines(FIXTURES / bad, rule)
@@ -174,6 +182,14 @@ class TestRealTree:
         assert any("ops/assign.py" in r for r in rels)
         assert any("ops/sparse.py" in r for r in rels)
         assert any("sched/tpu_backend.py" in r for r in rels)
+        # the jax engine's sharded builders (nested jitted closures in
+        # parallel/sparse.py) are trace roots the closure must reach —
+        # the mesh kernels the JaxSolveArena solves through
+        assert any(
+            "parallel/sparse.py" in q and ".<locals>." in q
+            for q in entries
+        ), "sharded-builder jit entries went blind"
+        assert any("parallel/sparse.py" in r for r in rels)
 
     def test_cli_clean_and_exit_codes(self):
         ok = subprocess.run(
