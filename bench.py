@@ -530,11 +530,14 @@ def run_jax_arena_bench(
 ) -> dict:
     """``engine=jax[:D]`` bench: the first-class jax arena's cold solve
     (compiled — compile is paid once untimed, like every other row) and
-    a warm dual-carry chain at ``churn`` REQUIREMENT churn. Requirement-
-    side churn is the informative warm case for this engine: provider
-    repricing at k=64 honestly dirties ~half the candidate rows (every
-    row listing a repriced provider), which measures regen, not carry —
-    that case is covered by the ``--cand`` gate's native rows."""
+    a warm chain at ``churn`` REQUIREMENT churn riding the churn-masked
+    structure repair (ISSUE 18 — warm ticks pay O(churn) repair, never
+    a regen: asserted via ``cand_cold_passes``). Requirement-side churn
+    is the informative warm case for this engine: provider repricing at
+    k=64 honestly dirties ~half the candidate rows (every row listing a
+    repriced provider) — that case is covered by the ``--cand`` gate's
+    native rows and the repair-parity tests. Every tick reports its
+    gen/solve wall split (cold and warm) in the artifact JSON."""
     import dataclasses
 
     from protocol_tpu.parallel.jax_arena import JaxSolveArena
@@ -553,7 +556,8 @@ def run_jax_arena_bench(
     cold_gen_ms = arena.last_stats["gen_ms"]
     sharded = bool(arena.last_stats.get("gen_sharded"))
     churn_rng = np.random.default_rng(seed + 1)
-    walls, solves = [], []
+    walls, gens, solves, tick_detail = [], [], [], []
+    cold_passes_warm = 0
     for _ in range(ticks):
         rows = churn_rng.choice(n, max(1, int(n * churn)), replace=False)
         ram = np.array(er.ram_mb, copy=True)
@@ -567,7 +571,20 @@ def run_jax_arena_bench(
         t0 = time.perf_counter()
         p4t = arena.solve(ep, er, w)
         walls.append((time.perf_counter() - t0) * 1e3)
-        solves.append(arena.last_stats["solve_ms"])
+        s = arena.last_stats
+        gens.append(s["gen_ms"])
+        solves.append(s["solve_ms"])
+        cold_passes_warm += int(s.get("cand_cold_passes", 0))
+        tick_detail.append({
+            "wall_ms": round(walls[-1], 3),
+            "gen_ms": s["gen_ms"],
+            "solve_ms": s["solve_ms"],
+            "cand_cold_passes": s.get("cand_cold_passes"),
+            "repair_rows": s.get("repair_rows"),
+            "repair_providers": s.get("repair_providers"),
+            "visited_cells_frac": s.get("visited_cells_frac"),
+            "changed_rows": s.get("changed_rows"),
+        })
     warm_ms = float(np.median(walls))
     return {
         "n": n,
@@ -577,11 +594,17 @@ def run_jax_arena_bench(
         "cold_gen_ms": cold_gen_ms,
         "cold_solve_ms": cold_solve_ms,
         "warm_median_ms": round(warm_ms, 3),
+        "warm_gen_median_ms": round(float(np.median(gens)), 3),
         "warm_solve_median_ms": round(float(np.median(solves)), 3),
         "warm_wall_speedup": round(cold_s * 1e3 / max(warm_ms, 1e-9), 2),
+        "warm_gen_speedup": round(
+            cold_gen_ms / max(float(np.median(gens)), 1e-9), 2
+        ),
         "warm_solve_speedup": round(
             cold_solve_ms / max(float(np.median(solves)), 1e-9), 2
         ),
+        "warm_cand_cold_passes": cold_passes_warm,
+        "warm_ticks": tick_detail,
         "assigned_frac": round(int((p4t >= 0).sum()) / n, 6),
     }
 

@@ -83,6 +83,20 @@ def tie_jitter(
     return (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
 
 
+def tie_jitter_ids(p_ids: jax.Array, t_ids: jax.Array) -> jax.Array:
+    """:func:`tie_jitter` for GATHERED index sets: the same hash(p, t)
+    grid, [len(p_ids), len(t_ids)], keyed on explicit GLOBAL ids instead
+    of offset+arange ranges. The warm-path candidate repair kernels
+    recompute arbitrary (provider, task) subsets and must land on the
+    exact jitter the full generation pass applied at those global
+    coordinates — same constant, same mask, same f32 scale, or repaired
+    cells drift off the regen-exactness contract by up to 1e-4."""
+    p_idx = jnp.asarray(p_ids, jnp.uint32)[:, None]
+    t_idx = jnp.asarray(t_ids, jnp.uint32)[None, :]
+    h = p_idx * jnp.uint32(2654435761) ^ t_idx * jnp.uint32(40503)
+    return (h & jnp.uint32(1023)).astype(jnp.float32) * jnp.float32(1e-7)
+
+
 def with_tie_jitter(cost: jax.Array) -> jax.Array:
     """Apply :func:`tie_jitter` to the feasible cells of a dense [P, T]
     cost matrix — the one-line form every dense auction call site uses.

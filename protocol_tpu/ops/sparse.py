@@ -165,7 +165,10 @@ def _forward_tile_select(
     return provider, cost_k, cost
 
 
-@partial(jax.jit, static_argnames=("k", "tile", "reverse_r", "approx_recall"))
+@partial(
+    jax.jit,
+    static_argnames=("k", "tile", "reverse_r", "approx_recall", "with_pools"),
+)
 def candidates_topk_reverse(
     ep: EncodedProviders,
     er: EncodedRequirements,
@@ -176,7 +179,8 @@ def candidates_topk_reverse(
     provider_offset: jax.Array | None = None,
     task_offset: int | jax.Array = 0,
     approx_recall: float | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    with_pools: bool = False,
+):
     """Bidirectional candidate generation: per-task top-k providers PLUS
     per-provider top-``reverse_r`` tasks, in the same streaming pass.
 
@@ -204,6 +208,15 @@ def candidates_topk_reverse(
     any edges into DISTINCT good tasks, and the single best edge per
     provider is the true global best (every tile's minimum is in the
     pool).
+
+    ``with_pools=True`` additionally returns the raw per-tile
+    contributions (pool_t, pool_c) as [P, n_tiles*rt] in tile order —
+    the pre-fold state of the pooled selection. The warm-path candidate
+    repair persists these: a provider's tile contribution depends only
+    on its own cost row over that tile, so a churn-masked recompute is
+    per-(provider, tile) local, and the folded rev_t/rev_c are
+    re-derived by replaying this exact fold (see
+    parallel/sparse.py::repair_topk_bidir_sharded).
     """
     if weights is None:
         weights = CostWeights()
@@ -239,17 +252,28 @@ def candidates_topk_reverse(
         neg_c, m = lax.top_k(-merged_c, r)
         rev_c1 = -neg_c
         rev_t1 = jnp.take_along_axis(merged_t, m, axis=1)
-        return (rev_c1, rev_t1), (provider, cost_k)
+        ys = (provider, cost_k)
+        if with_pools:
+            ys = ys + (tile_t, tile_c)
+        return (rev_c1, rev_t1), ys
 
     carry0 = (
         jnp.full((P, r), jnp.float32(INFEASIBLE)),
         jnp.full((P, r), -1, jnp.int32),
     )
-    (rev_c, rev_t), (cand_p, cand_c) = lax.scan(
+    (rev_c, rev_t), ys = lax.scan(
         step, carry0, jnp.arange(n_tiles, dtype=jnp.int32) * tile
     )
+    cand_p, cand_c = ys[0], ys[1]
     rev_t = jnp.where(rev_c < INFEASIBLE * 0.5, rev_t, -1)
-    return cand_p.reshape(T, k), cand_c.reshape(T, k), rev_t, rev_c
+    out = (cand_p.reshape(T, k), cand_c.reshape(T, k), rev_t, rev_c)
+    if with_pools:
+        # ys pools are [n_tiles, P, rt]: flatten to [P, n_tiles*rt] in
+        # tile order — the layout the repair refold consumes
+        pool_t = jnp.moveaxis(ys[2], 0, 1).reshape(P, n_tiles * rt)
+        pool_c = jnp.moveaxis(ys[3], 0, 1).reshape(P, n_tiles * rt)
+        out = out + (pool_t, pool_c)
+    return out
 
 
 @partial(jax.jit, static_argnames=("extra",))

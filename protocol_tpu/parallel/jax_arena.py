@@ -26,16 +26,28 @@ Two-stage split, mirroring SCALING.md's ICI cost model:
     retirement for exactly the rows whose candidates or costs changed —
     the caller contract that kernel documents.
 
-Where the native arena REPAIRS its candidate structure incrementally,
-the jax arena REGENERATES it: generation is one deterministic jitted
-pass (tie jitter is keyed on global task index), so unchanged rows come
-back bit-identical and the regen *is* the repair — exact at every tick,
-never a drifting cache. The trade is explicit: a warm tick pays the
-full gen pass (cheap on accelerator — that is the point of this
-engine) instead of the native O(churn) repair; ``last_stats`` reports
-it honestly as ``cand_cold_passes`` so the obs plane never mistakes a
-regen for a native-style zero-pass repair. Dirty detection, the
-byte-identical short-circuit, ``max_dirty_frac``/``cold_every``/
+Like the native arena, the jax arena REPAIRS its candidate structure
+incrementally on warm ticks: the generation PARTS — forward lists
+[T, k] and the raw per-tile reverse contribution pools
+[P, n_tiles*rt] — persist across ticks, and a dirty tick runs the
+churn-masked repair kernels
+(:func:`~protocol_tpu.parallel.sparse.repair_topk_bidir_sharded`)
+that recompute exactly the flagged forward rows and (provider, tile)
+contribution blocks, replay the generation fold over the pools, and
+re-merge. The oracle contract is the same one
+``repair_topk_candidates_mt`` honors in C++: the repaired structure is
+bit-identical to a from-scratch ``candidates_topk_bidir_sharded`` pass
+on the current features, at every device count (tie jitter is keyed on
+global indices, so a recomputed subset lands on the exact cells the
+full pass would produce — see the exactness notes on each repair
+kernel). ``last_stats`` reports the path honestly: warm repair ticks
+carry ``cand_cold_passes: 0`` plus scope counters (``repair_rows``,
+``repair_providers``, ``visited_cells_frac``); only genuinely cold
+ticks — first solve, shape/weights change, ``cold_every``,
+``max_dirty_frac`` overflow, or ``approx_recall`` mode (approx
+selection has no exactness contract, hence no repair twin) — pay a
+full pass and say so. Dirty detection, the byte-identical
+short-circuit, ``max_dirty_frac``/``cold_every``/
 ``dual_refresh_every`` cadences, the dirty-task re-seat, and the seat
 feasibility guard all mirror the native arena row for row.
 
@@ -71,12 +83,23 @@ from protocol_tpu.ops.sparse import (
 
 # persisted candidate-structure dtypes (same durable on-disk contract as
 # native.arena._CAND_STATE_DTYPES: checkpoint frames and migration
-# handoffs coerce through this table on restore). The jax structure has
-# no reverse keys or slack shadow — regen replaces repair — so only the
-# merged forward+reverse lists persist.
+# handoffs coerce through this table on restore). Since the warm path
+# became incremental repair, the generation PARTS persist alongside the
+# merged lists: forward top-k (fwd_*) and the raw per-tile reverse
+# contribution pools (pool_*, [P, n_tiles*rt] in global tile order) are
+# what the repair kernels patch in place — the merged lists alone cannot
+# be repaired (a merge is not invertible), and the FOLDED reverse edges
+# are derivable (fold replay) but not invertible either, so the pre-fold
+# pools are the canonical persisted form. Pool memory grows as
+# P * n_tiles * ceil(r / n_tiles) — between r and 2r-1 entries per
+# provider (~2x the folded form at worst), megabytes through ~131k rows.
 _JAX_STATE_DTYPES = {
     "cand_p": np.int32,
     "cand_c": np.float32,
+    "fwd_p": np.int32,
+    "fwd_c": np.float32,
+    "pool_t": np.int32,
+    "pool_c": np.float32,
 }
 
 
@@ -162,6 +185,10 @@ class JaxSolveArena:
         self._weights_key: Optional[tuple] = None
         self._cand_p: Optional[np.ndarray] = None
         self._cand_c: Optional[np.ndarray] = None
+        self._fwd_p: Optional[np.ndarray] = None
+        self._fwd_c: Optional[np.ndarray] = None
+        self._pool_t: Optional[np.ndarray] = None
+        self._pool_c: Optional[np.ndarray] = None
         self._price: Optional[np.ndarray] = None
         self._retired: Optional[np.ndarray] = None
         self._p4t: Optional[np.ndarray] = None
@@ -190,6 +217,15 @@ class JaxSolveArena:
         out = {
             "cand_p": _c(self._cand_p),
             "cand_c": _c(self._cand_c),
+            # generation parts: what the warm-path repair kernels patch.
+            # None under approx_recall (no repair twin — see _gen).
+            "fwd_p": _c(self._fwd_p),
+            "fwd_c": _c(self._fwd_c),
+            "pool_t": _c(self._pool_t),
+            "pool_c": _c(self._pool_c),
+            # the pool width n_tiles*ceil(r/n_tiles) does not encode r
+            # (rt saturates at 1), so the config rides along explicitly
+            "reverse_r": int(self.reverse_r),
             "price": _c(self._price),
             "retired": _c(self._retired),
             "p4t": _c(self._p4t),
@@ -232,13 +268,46 @@ class JaxSolveArena:
         cand_p = np.asarray(state["cand_p"])
         n_p = self._p_fields["gpu_count"].shape[0]
         n_t = self._r_fields["cpu_cores"].shape[0]
+        k_eff = min(self.k, n_p)
+        r_eff = min(self.reverse_r, n_t)
         if (
             state.get("native_isa") != jax_isa()
             or cand_p.ndim != 2
-            or cand_p.shape != (n_t, min(self.k, n_p) + self.extra)
+            or cand_p.shape != (n_t, k_eff + self.extra)
         ):
             self.invalidate()
             return
+        # repair parts: a pre-repair carry (exported before the parts
+        # existed) or part-shape skew (k/r config changed) degrades to a
+        # cold re-ground exactly like a foreign ISA tag — the merged
+        # lists alone cannot seed the repair path, and warm-continuing
+        # on them while regenerating parts could pair parts and merge
+        # from different feature snapshots. approx_recall arenas carry
+        # no parts by design and stay on the regen path (see _gen).
+        fwd_p = state.get("fwd_p")
+        if self.approx_recall is None:
+            # pool width follows the D-free tile policy (a function of
+            # T only — the same _gen_plan law generation uses), so a
+            # carry from any device count rehydrates here; skew against
+            # the policy (k/r/tile config changed) degrades to cold
+            tile = pick_tile(n_t, cap=min(1024, max(1, n_t // 8)))
+            n_tiles = n_t // tile
+            rt_eff = max(1, -(-r_eff // n_tiles))
+            if (
+                fwd_p is None
+                or np.asarray(fwd_p).shape != (n_t, k_eff)
+                or state.get("pool_t") is None
+                or np.asarray(state["pool_t"]).shape
+                != (n_p, n_tiles * rt_eff)
+                or state.get("reverse_r") != self.reverse_r
+            ):
+                self.invalidate()
+                return
+            for name in ("fwd_p", "fwd_c", "pool_t", "pool_c"):
+                setattr(
+                    self, f"_{name}",
+                    np.array(state[name], _JAX_STATE_DTYPES[name], copy=True),
+                )
         self._cand_p = np.array(
             cand_p, _JAX_STATE_DTYPES["cand_p"], copy=True
         )
@@ -307,39 +376,121 @@ class JaxSolveArena:
         of up to 8 devices shards evenly on round task counts; a shape
         where the per-shard count doesn't divide the tile degrades to
         single-device generation with the SAME tile — same bits,
-        flagged, never a different structure."""
+        flagged, never a different structure.
+
+        Side effect: stores the generation PARTS (forward lists + raw
+        per-tile reverse contribution pools) on the arena — the
+        persistent structure the warm-path repair patches.
+        ``approx_recall`` mode stores None:
+        ``lax.approx_max_k`` carries no exactness guarantee, so there
+        is no repaired==regen contract to honor and those arenas stay
+        on the (honest, counted) full-regen path."""
         ep = EncodedProviders(**pf)
         er = EncodedRequirements(**rf)
         T = rf["cpu_cores"].shape[0]
-        tile = pick_tile(T, cap=min(1024, max(1, T // 8)))
-        D = self._ensure_devices()
-        if (
-            self._mesh is not None
-            and T % D == 0
-            and (T // D) % tile == 0
-        ):
+        tile, use_mesh = self._gen_plan(T)
+        with_parts = self.approx_recall is None
+        fwd = None
+        if use_mesh:
             from protocol_tpu.parallel.sparse import (
                 candidates_topk_bidir_sharded,
             )
 
-            cand_p, cand_c = candidates_topk_bidir_sharded(
+            out = candidates_topk_bidir_sharded(
                 ep, er, weights, mesh=self._mesh, k=self.k,
                 tile=tile, reverse_r=self.reverse_r,
                 extra=self.extra, approx_recall=self.approx_recall,
+                with_parts=with_parts,
             )
+            if with_parts:
+                cand_p, cand_c, *fwd = out
+            else:
+                cand_p, cand_c = out
             sharded = True
         else:
-            cand_p, cand_c = candidates_topk_bidir(
-                ep, er, weights, k=self.k, tile=tile,
-                reverse_r=self.reverse_r, extra=self.extra,
-                approx_recall=self.approx_recall,
-            )
+            if with_parts:
+                from protocol_tpu.ops.sparse import (
+                    candidates_topk_reverse,
+                    merge_reverse_candidates,
+                )
+
+                fwd_p, fwd_c, rev_t, rev_c, pool_t, pool_c = (
+                    candidates_topk_reverse(
+                        ep, er, weights, k=self.k, tile=tile,
+                        reverse_r=self.reverse_r, with_pools=True,
+                    )
+                )
+                cand_p, cand_c = merge_reverse_candidates(
+                    fwd_p, fwd_c, rev_t, rev_c, extra=self.extra
+                )
+                fwd = [fwd_p, fwd_c, pool_t, pool_c]
+            else:
+                cand_p, cand_c = candidates_topk_bidir(
+                    ep, er, weights, k=self.k, tile=tile,
+                    reverse_r=self.reverse_r, extra=self.extra,
+                    approx_recall=self.approx_recall,
+                )
             sharded = False
+        if fwd is not None:
+            self._fwd_p = np.asarray(fwd[0], np.int32)
+            self._fwd_c = np.asarray(fwd[1], np.float32)
+            self._pool_t = np.asarray(fwd[2], np.int32)
+            self._pool_c = np.asarray(fwd[3], np.float32)
+        else:
+            self._fwd_p = self._fwd_c = None
+            self._pool_t = self._pool_c = None
         return (
             np.asarray(cand_p, np.int32),
             np.asarray(cand_c, np.float32),
             sharded,
         )
+
+    def _gen_plan(self, T: int) -> tuple[int, bool]:
+        """(tile, use_mesh) for shape T — ONE decision shared by the
+        cold generation pass and the warm repair kernels, so a repair
+        can never run under a different tiling or mesh choice than the
+        pass that produced the structure it is patching."""
+        tile = pick_tile(T, cap=min(1024, max(1, T // 8)))
+        D = self._ensure_devices()
+        use_mesh = (
+            self._mesh is not None and T % D == 0 and (T // D) % tile == 0
+        )
+        return tile, use_mesh
+
+    def _repair(self, pf: dict, rf: dict, weights, dirty_p, dirty_t):
+        """Churn-masked structure repair: patch the persistent parts for
+        the given dirty global rows and rebuild the merged lists —
+        bit-identical to what :meth:`_gen` would produce on the current
+        columns (the repaired==regen oracle contract), at O(churn
+        scope) instead of O(P*T). Updates the stored structure in place
+        and returns (changed-row mask vs the PREVIOUS merged lists,
+        repair-scope stats). Caller guarantees parts exist
+        (``approx_recall is None`` and the arena is primed)."""
+        from protocol_tpu.parallel.sparse import repair_topk_bidir_sharded
+
+        ep = EncodedProviders(**pf)
+        er = EncodedRequirements(**rf)
+        T = rf["cpu_cores"].shape[0]
+        tile, use_mesh = self._gen_plan(T)
+        cand_p, cand_c, fwd_p, fwd_c, pool_t, pool_c, stats = (
+            repair_topk_bidir_sharded(
+                ep, er, weights,
+                fwd_p=self._fwd_p, fwd_c=self._fwd_c,
+                pool_t=self._pool_t, pool_c=self._pool_c,
+                dirty_p=dirty_p, dirty_t=dirty_t,
+                reverse_r=self.reverse_r,
+                mesh=self._mesh if use_mesh else None,
+                tile=tile, extra=self.extra,
+            )
+        )
+        changed = (
+            (cand_p != self._cand_p).any(axis=1)
+            | (cand_c != self._cand_c).any(axis=1)
+        )
+        self._cand_p, self._cand_c = cand_p, cand_c
+        self._fwd_p, self._fwd_c = fwd_p, fwd_c
+        self._pool_t, self._pool_c = pool_t, pool_c
+        return changed, stats
 
     def _ladder(self, P: int, eng: Optional[dict]):
         """Cold/refresh solve stage: the eps-annealed auction ladder
@@ -464,11 +615,15 @@ class JaxSolveArena:
         updated in place for truly-dirty rows, RuntimeError/ValueError
         on an unprimed arena or a weights mismatch.
 
-        The jax engine has no incremental repair kernel: a dirty event
-        pays one full (deterministic) gen pass plus a warm solve —
-        reported honestly as ``cand_cold_passes: 1``. ``event_eps_start``
-        is accepted for signature parity; the jax warm kernel runs one
-        fine-eps phase (its own eps-CS repair handles re-seating)."""
+        A dirty event pays O(churned rows): the churn-masked repair
+        kernels patch exactly the flagged forward rows and reverse
+        pools of the persistent structure (``cand_cold_passes: 0``,
+        repair-scope counters in ``last_stats``) — same oracle contract
+        as the batch warm path. Only ``approx_recall`` arenas (no
+        repair twin) still pay a full regen, reported honestly as
+        ``cand_cold_passes: 1``. ``event_eps_start`` is accepted for
+        signature parity; the jax warm kernel runs one fine-eps phase
+        (its own eps-CS repair handles re-seating)."""
         if self._cand_p is None:
             raise RuntimeError(
                 "arena not primed for apply_rows: run solve() first "
@@ -534,14 +689,23 @@ class JaxSolveArena:
             return self._p4t.copy()
 
         eng: Optional[dict] = {} if obs.enabled() else None
-        cand_p, cand_c, sharded = self._gen(
-            self._p_fields, self._r_fields, weights
-        )
-        changed = (
-            (cand_p != self._cand_p).any(axis=1)
-            | (cand_c != self._cand_c).any(axis=1)
-        )
-        self._cand_p, self._cand_c = cand_p, cand_c
+        if self._fwd_p is not None:
+            changed, rep = self._repair(
+                self._p_fields, self._r_fields, weights, dirty_p, dirty_t
+            )
+            sharded = self._gen_plan(T)[1]
+            cold_passes = 0
+        else:
+            cand_p, cand_c, sharded = self._gen(
+                self._p_fields, self._r_fields, weights
+            )
+            changed = (
+                (cand_p != self._cand_p).any(axis=1)
+                | (cand_c != self._cand_c).any(axis=1)
+            )
+            self._cand_p, self._cand_c = cand_p, cand_c
+            rep = {}
+            cold_passes = 1
         if n_dt:
             self._p4t[dirty_t] = -1
             changed[dirty_t] = True
@@ -562,7 +726,12 @@ class JaxSolveArena:
             **self._base_stats(T, sharded),
             "cold": False,
             "event": True,
-            "cand_cold_passes": 1,
+            "cand_cold_passes": cold_passes,
+            # scope counters first: the stream-facing "repair_rows"
+            # (rows whose merged lists actually changed — what the
+            # certificate and EventResult count) overrides the repair
+            # kernels' forward-scope counter of the same name
+            **rep,
             "dirty_providers": n_dp,
             "dirty_tasks": n_dt,
             "changed_rows": int(changed.sum()),
@@ -577,9 +746,9 @@ class JaxSolveArena:
     def reconcile(self) -> np.ndarray:
         """Full batch re-solve over the CURRENT candidate structure from
         scratch duals — the stream engine's periodic reconciliation.
-        The regen-exactness contract makes the current structure equal
-        to a from-scratch rebuild on the current columns, so this is
-        bit-identical to a cold solve without re-paying the gen pass."""
+        The repaired==regen oracle contract makes the current structure
+        equal to a from-scratch rebuild on the current columns, so this
+        is bit-identical to a cold solve without re-paying a gen pass."""
         if self._cand_p is None:
             raise RuntimeError(
                 "arena not primed for reconcile: run solve() first"
@@ -694,17 +863,32 @@ class JaxSolveArena:
         self._p_fields, self._r_fields = pf, rf
         self._owned_cols = set()
 
-        # ---- deterministic regen IS the repair: unchanged rows come
-        # back bit-identical, so the row-wise diff against the carried
-        # structure is the exact changed set (membership moved or any
-        # cost moved — a superset of "materially cheaper", so clearing
-        # retirement on it is sound, just occasionally generous)
-        cand_p, cand_c, sharded = self._gen(pf, rf, weights)
-        changed = (
-            (cand_p != self._cand_p).any(axis=1)
-            | (cand_c != self._cand_c).any(axis=1)
-        )
-        self._cand_p, self._cand_c = cand_p, cand_c
+        # ---- churn-masked structure repair: recompute exactly the
+        # flagged forward rows and reverse pools and re-merge —
+        # bit-identical to a full regen on the current columns (the
+        # repaired==regen oracle contract), without the O(P*T) pass.
+        # The changed-row diff against the previous merged lists is
+        # still exact (membership moved or any cost moved — a superset
+        # of "materially cheaper", so clearing retirement on it is
+        # sound, just occasionally generous). approx_recall arenas have
+        # no parts (no exactness contract under approx_max_k) and keep
+        # the honest full-regen path.
+        if self._fwd_p is not None:
+            changed, rep = self._repair(
+                pf, rf, weights,
+                np.flatnonzero(dirty_p), np.flatnonzero(dirty_t),
+            )
+            sharded = self._gen_plan(T)[1]
+            cold_passes = 0
+        else:
+            cand_p, cand_c, sharded = self._gen(pf, rf, weights)
+            changed = (
+                (cand_p != self._cand_p).any(axis=1)
+                | (cand_c != self._cand_c).any(axis=1)
+            )
+            self._cand_p, self._cand_c = cand_p, cand_c
+            rep = {}
+            cold_passes = 1
         if n_dt:
             # a dirty task's seat predates its new requirement: re-seat
             # from scratch
@@ -755,7 +939,8 @@ class JaxSolveArena:
             **self._base_stats(T, sharded),
             **qual,
             "cold": False,
-            "cand_cold_passes": 1,
+            "cand_cold_passes": cold_passes,
+            **rep,
             "dual_refresh": dual_refresh,
             "dirty_providers": n_dp,
             "dirty_tasks": n_dt,

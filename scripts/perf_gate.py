@@ -1287,7 +1287,12 @@ def jax_gate() -> int:
     between devices=1 and devices=4 (cand_p/cand_c/p4t/price), with
     the D=4 path actually taking the shard_map route;
     (d) warm dual carry across a 1%-churn chain beats the compiled
-    cold solve by the committed wall and solve-stage floors;
+    cold solve by the committed wall and solve-stage floors, with ZERO
+    cold candidate passes (the churn-masked repair path, ISSUE 18);
+    (d') the repaired structure — merged lists and persisted parts —
+    is bit-identical to a from-scratch generation pass on the final
+    features at devices=1 AND devices=4 (the repaired==regen oracle
+    contract the native engine's repair_topk_candidates_mt honors);
     (e) the jax assigned fraction stays >= 97% of the native engine's
     on the same population (absolute floor when the native toolchain
     is unavailable)."""
@@ -1462,6 +1467,7 @@ def jax_gate() -> int:
     cold_solve_ms = a1.last_stats["solve_ms"]
     rng = np.random.default_rng(4)
     walls, solves = [], []
+    cold_passes = 0
     for _ in range(3):
         rows = rng.choice(n, n // 100, replace=False)
         ram = np.array(er.ram_mb, copy=True)
@@ -1476,12 +1482,14 @@ def jax_gate() -> int:
         pw = a1.solve(ep, er, w)
         walls.append(time.perf_counter() - t0)
         solves.append(a1.last_stats["solve_ms"])
+        cold_passes += int(a1.last_stats.get("cand_cold_passes", 1))
     wall_x = cold_s / max(float(np.median(walls)), 1e-9)
     solve_x = cold_solve_ms / max(float(np.median(solves)), 1e-9)
     print(
         f"jax gate: warm chain at {n} (1% churn) — wall {wall_x:.2f}x "
         f"(floor {floors['jax_warm_wall_speedup_floor']}x), solve "
-        f"{solve_x:.2f}x (floor {floors['jax_warm_solve_speedup_floor']}x)"
+        f"{solve_x:.2f}x (floor {floors['jax_warm_solve_speedup_floor']}x), "
+        f"cand_cold_passes {cold_passes}"
     )
     if wall_x < floors["jax_warm_wall_speedup_floor"]:
         failures.append(
@@ -1493,6 +1501,56 @@ def jax_gate() -> int:
             f"warm solve speedup {solve_x:.2f}x below "
             f"{floors['jax_warm_solve_speedup_floor']}x"
         )
+    if cold_passes != 0:
+        failures.append(
+            f"warm chain paid {cold_passes} cold candidate passes — the "
+            "churn-masked repair path regressed to regen-is-repair"
+        )
+
+    # ---- (d') repaired==regen oracle at D in {1, 4}: the warm chain
+    # above ran the churn-masked repair; the structure it carries must
+    # be bit-identical — merged lists AND persisted parts — to a
+    # from-scratch pass on the final features, at both device counts.
+    # This is the jax twin of the native gate's repair-vs-rebuild
+    # equality check on repair_topk_candidates_mt.
+    rng4 = np.random.default_rng(4)
+    for _ in range(3):
+        rows = rng4.choice(n, n // 100, replace=False)
+        ram = np.array(er4.ram_mb, copy=True)
+        ram[rows] = np.maximum(
+            256,
+            (ram[rows] * rng4.uniform(0.8, 1.25, rows.size)).astype(
+                ram.dtype
+            ),
+        )
+        er4 = dataclasses.replace(er4, ram_mb=ram)
+        a4.solve(ep4, er4, w)
+        if a4.last_stats.get("cand_cold_passes", 1) != 0:
+            failures.append(
+                "devices=4 warm tick paid a cold candidate pass"
+            )
+            break
+    part_names = (
+        "_cand_p", "_cand_c", "_fwd_p", "_fwd_c", "_pool_t", "_pool_c",
+    )
+    for dcount, arena, epx, erx in ((1, a1, ep, er), (4, a4, ep4, er4)):
+        fresh = JaxSolveArena(devices=dcount)
+        fresh.solve(epx, erx, w)
+        bad = [
+            nm for nm in part_names
+            if not bool(
+                (getattr(arena, nm) == getattr(fresh, nm)).all()
+            )
+        ]
+        print(
+            f"jax gate: repair==regen at {n} devices={dcount} — "
+            f"bit-identical={not bad}"
+        )
+        if bad:
+            failures.append(
+                f"repaired structure diverges from from-scratch regen "
+                f"at devices={dcount}: {', '.join(bad)}"
+            )
 
     # ---- (e) assigned fraction vs native on the same population
     jax_frac = int((pw >= 0).sum()) / n

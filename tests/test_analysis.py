@@ -132,10 +132,15 @@ class TestSeededFixtures:
                 "jax-purity", "purity_calljit_bad.py",
                 "purity_calljit_ok.py",
             ),
+            (
+                lambda f: purity.run(roots=(str(f),)),
+                "jax-purity", "purity_repair_bad.py",
+                "purity_repair_ok.py",
+            ),
         ],
         ids=[
             "lock-reorder", "lock-dropped", "protocol-sm", "jax-purity",
-            "jax-purity-callform",
+            "jax-purity-callform", "jax-purity-repair",
         ],
     )
     def test_seeds_and_clean_twin(self, runner, rule, bad, ok):
@@ -190,6 +195,21 @@ class TestRealTree:
             for q in entries
         ), "sharded-builder jit entries went blind"
         assert any("parallel/sparse.py" in r for r in rels)
+        # the warm-path repair kernels (ISSUE 18) are call-form jit
+        # entries — forward rows, the enter scan (plain + shard_map
+        # twin), the per-tile contribution recompute, and the fold
+        # replay. A scan that stops seeing them stops guarding the warm
+        # hot path.
+        for want in (
+            "_build_repair_enter.<locals>",
+            "_build_repair_enter_sharded.<locals>",
+            "_build_repair_forward.<locals>",
+            "_build_repair_tile.<locals>",
+            "_build_repair_refold.<locals>",
+        ):
+            assert any(
+                "parallel/sparse.py" in q and want in q for q in entries
+            ), f"repair jit entry {want} went blind"
 
     def test_cli_clean_and_exit_codes(self):
         ok = subprocess.run(

@@ -85,10 +85,11 @@ class TestJaxArenaWarmChain:
         assert s["changed_rows"] == 0
         assert s["warm_solves_since_cold"] == 1
 
-    def test_warm_churn_reports_regen_honestly(self):
-        """A dirty provider rides the warm path, and the stats say what
-        the engine actually did: one full (deterministic) gen pass —
-        never a native-style zero-pass repair claim."""
+    def test_warm_churn_repairs_without_cold_pass(self):
+        """A dirty provider rides the warm REPAIR path: zero full gen
+        passes, and the stats carry the honest repair-scope counters
+        (recomputed forward rows / reverse pools, visited-cell
+        fraction) instead of a regen claim."""
         ep, er = _marketplace()
         arena = JaxSolveArena(k=16)
         arena.solve(ep, er, CostWeights())
@@ -96,9 +97,25 @@ class TestJaxArenaWarmChain:
         _unique_seats(p4t)
         s = arena.last_stats
         assert s["cold"] is False
-        assert s["cand_cold_passes"] == 1  # the regen IS the repair
+        assert s["cand_cold_passes"] == 0  # churn-masked repair, not regen
         assert s["dirty_providers"] == 1
         assert s["dirty_tasks"] == 0
+        assert s["repair_rows"] >= 0 and s["repair_providers"] >= 1
+        assert 0.0 < s["visited_cells_frac"] < 1.0
+
+    def test_approx_recall_keeps_honest_regen_path(self):
+        """approx_max_k selection has no exactness contract, so approx
+        arenas carry no repair parts and a dirty warm tick still pays
+        (and reports) one full gen pass."""
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16, approx_recall=0.95)
+        arena.solve(ep, er, CostWeights())
+        assert arena._fwd_p is None
+        arena.solve(_bump_price(ep, [5]), er, CostWeights())
+        s = arena.last_stats
+        assert s["cold"] is False
+        assert s["cand_cold_passes"] == 1
+        assert "visited_cells_frac" not in s
 
     def test_regen_equals_cold_rebuild_bit_for_bit(self):
         """The regen-exactness contract: after a churned warm tick the
@@ -173,14 +190,24 @@ class TestJaxArenaWarmChain:
         assert arena.last_stats["dirty_providers"] == 0
         assert arena.last_stats["cand_cold_passes"] == 0
 
-        # a real reprice: dirty, regen + warm solve, repair mask set
+        # a real reprice: dirty, O(churned rows) structure repair +
+        # warm solve — zero full gen passes, repair mask set
         vals["price"] = np.asarray(vals["price"]) + 0.5
         p4t = arena.apply_rows(rows, vals, None, None, CostWeights())
         _unique_seats(p4t)
         s = arena.last_stats
         assert s["event"] is True and s["dirty_providers"] == 1
-        assert s["cand_cold_passes"] == 1
+        assert s["cand_cold_passes"] == 0
+        assert s["repair_providers"] >= 1
+        assert s["visited_cells_frac"] < 1.0
         assert arena.last_repair_mask is not None
+        # the event's repaired structure equals a fresh cold build on
+        # the updated columns (the repaired==regen oracle contract)
+        ep2 = _bump_price(ep, [4], delta=0.5)
+        fresh = JaxSolveArena(k=16)
+        fresh.solve(ep2, er, CostWeights())
+        np.testing.assert_array_equal(arena._cand_p, fresh._cand_p)
+        np.testing.assert_array_equal(arena._cand_c, fresh._cand_c)
 
 
 class TestDeviceInvarianceAndDegradation:
@@ -202,12 +229,46 @@ class TestDeviceInvarianceAndDegradation:
         np.testing.assert_array_equal(p_ref, p_d)
         np.testing.assert_array_equal(ref.price, sharded.price)
 
-        # the warm tick stays on the invariant too
+        # the warm tick stays on the invariant too — and both sides
+        # ride the repair path, not a regen
         ep2 = _bump_price(ep, [3])
         np.testing.assert_array_equal(
             ref.solve(ep2, er, CostWeights()),
             sharded.solve(ep2, er, CostWeights()),
         )
+        assert ref.last_stats["cand_cold_passes"] == 0
+        assert sharded.last_stats["cand_cold_passes"] == 0
+        np.testing.assert_array_equal(ref._fwd_c, sharded._fwd_c)
+        np.testing.assert_array_equal(ref._pool_t, sharded._pool_t)
+
+    @pytest.mark.parametrize("D", [2, 4])
+    def test_apply_rows_rides_repair_at_many_devices(self, D):
+        """Stream events over the SHARDED repair path: a dirty event on
+        a D-device arena patches the structure with the sharded repair
+        kernels (zero cold passes) and lands exactly the structure a
+        fresh cold build at the same D produces."""
+        from protocol_tpu.native.arena import _P_SPEC, _canon
+
+        ep, er = _marketplace(seed=9, P=128, T=64)
+        arena = JaxSolveArena(k=16, devices=D)
+        arena.solve(ep, er, CostWeights())
+        assert arena.last_stats["gen_sharded"] is True
+
+        pf = _canon(ep, _P_SPEC)
+        rows = np.array([7], np.int32)
+        vals = {n: np.asarray(pf[n][rows]) for n, _ in _P_SPEC}
+        vals["price"] = np.asarray(vals["price"]) + 0.5
+        p4t = arena.apply_rows(rows, vals, None, None, CostWeights())
+        _unique_seats(p4t)
+        s = arena.last_stats
+        assert s["event"] is True and s["cand_cold_passes"] == 0
+        assert s["gen_sharded"] is True and s["repair_providers"] >= 1
+
+        fresh = JaxSolveArena(k=16, devices=D)
+        fresh.solve(_bump_price(ep, [7], delta=0.5), er, CostWeights())
+        np.testing.assert_array_equal(arena._cand_p, fresh._cand_p)
+        np.testing.assert_array_equal(arena._cand_c, fresh._cand_c)
+        np.testing.assert_array_equal(arena._pool_c, fresh._pool_c)
 
     @pytest.mark.slow
     def test_sharded_gen_invariant_at_16k(self):
@@ -325,6 +386,59 @@ class TestExportRestore:
         state = arena.export_state()
 
         other = JaxSolveArena(k=8)  # narrower structure: carry invalid
+        other.restore_state(ep, er, state)
+        other.solve(ep, er, CostWeights())
+        assert other.last_stats["cold"] is True
+
+    def test_restored_carry_continues_on_repair_path(self):
+        """The persistent parts ride export/restore: a restored warm
+        chain's next dirty tick runs the churn-masked repair (zero cold
+        passes), not a regen — and lands the same structure the
+        exporting arena reaches."""
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16)
+        arena.solve(ep, er, CostWeights())
+        state = arena.export_state()
+        for name in ("fwd_p", "fwd_c", "pool_t", "pool_c"):
+            assert state[name] is not None
+
+        other = JaxSolveArena(k=16)
+        other.restore_state(ep, er, state)
+        ep2 = _bump_price(ep, [3])
+        got = other.solve(ep2, er, CostWeights())
+        assert other.last_stats["cand_cold_passes"] == 0
+        assert other.last_stats["repair_providers"] >= 1
+        want = arena.solve(ep2, er, CostWeights())
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(other._fwd_p, arena._fwd_p)
+        np.testing.assert_array_equal(other._pool_c, arena._pool_c)
+
+    def test_pre_repair_carry_regrounds_cold(self):
+        """A carry exported before the repair parts existed (an old
+        checkpoint: merged lists only) degrades to an honest cold
+        re-ground — never a shape error, never a warm continuation
+        that would regenerate parts against a stale merge."""
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16)
+        arena.solve(ep, er, CostWeights())
+        state = arena.export_state()
+        for name in ("fwd_p", "fwd_c", "pool_t", "pool_c"):
+            del state[name]  # what a pre-repair export looks like
+
+        other = JaxSolveArena(k=16)
+        other.restore_state(ep, er, state)
+        other.solve(ep, er, CostWeights())
+        assert other.last_stats["cold"] is True
+
+    def test_part_shape_skew_regrounds_cold(self):
+        """Part-width skew (reverse_r config changed between export and
+        restore) is refused like a foreign tag — cold, not a crash."""
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16, reverse_r=8)
+        arena.solve(ep, er, CostWeights())
+        state = arena.export_state()
+
+        other = JaxSolveArena(k=16, reverse_r=4)
         other.restore_state(ep, er, state)
         other.solve(ep, er, CostWeights())
         assert other.last_stats["cold"] is True
