@@ -84,7 +84,8 @@ scale-tests:
 # fail-the-build lint discipline: the hermetic unused-import gate, the
 # project rule engine (determinism / lock / dtype / dense-alloc
 # contracts — scripts/lints/), and the whole-program analyzer
-# (lock-order / protocol-sm / jax-purity — scripts/analysis/)
+# (lock-order / protocol-sm / jax-purity / jax-retrace / spmd-contract
+# — scripts/analysis/)
 lint:
 	$(PY) scripts/lint.py
 	$(PY) -m scripts.lints

@@ -10,6 +10,12 @@ Modules:
               auction with deterministic tie-breaking.
 """
 
+# the jit-cache witness must wrap jax.jit BEFORE any kernel module's
+# decorators execute (scripts/analysis/staging.py is the static twin)
+from protocol_tpu.utils import jitwitness as _jitwitness
+
+_jitwitness.install()
+
 from protocol_tpu.ops.encoding import (
     EncodedProviders,
     EncodedRequirements,

@@ -8,6 +8,12 @@ rides ICI collectives (all_gather of per-shard top-2 candidates, max-combine
 of replicated state).
 """
 
+# the jit-cache witness must wrap jax.jit BEFORE any kernel module's
+# decorators execute (scripts/analysis/staging.py is the static twin)
+from protocol_tpu.utils import jitwitness as _jitwitness
+
+_jitwitness.install()
+
 from protocol_tpu.parallel.mesh import make_mesh, pad_to_multiple
 from protocol_tpu.parallel.auction import assign_auction_sharded
 from protocol_tpu.parallel.jax_arena import JaxSolveArena

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 
 from protocol_tpu import obs
 from protocol_tpu.native.arena import _P_SPEC, _R_SPEC, _canon, _dirty_rows
+from protocol_tpu.utils import jitwitness as _jitwitness
 from protocol_tpu.obs import quality as _quality
 from protocol_tpu.obs.spans import TRACER as _tracer
 from protocol_tpu.ops.encoding import EncodedProviders, EncodedRequirements
@@ -158,6 +159,7 @@ class JaxSolveArena:
         self._mesh = None
         self._devices_effective: Optional[int] = None
         self.last_stats: dict = {}
+        self._jit_mark = _jitwitness.snapshot()
         self.invalidate()
 
     # ---------------- carried-state surface (native-arena parity) ----
@@ -189,6 +191,11 @@ class JaxSolveArena:
         self._fwd_c: Optional[np.ndarray] = None
         self._pool_t: Optional[np.ndarray] = None
         self._pool_c: Optional[np.ndarray] = None
+        # pad-bucket high-water marks for the repair kernels (the
+        # ratchet state behind repair_topk_bidir_sharded's pad_floors):
+        # carried across warm ticks so repair gathers never shrink into
+        # a fresh, never-compiled bucket and retrace mid-chain
+        self._repair_pads: dict = {}
         self._price: Optional[np.ndarray] = None
         self._retired: Optional[np.ndarray] = None
         self._p4t: Optional[np.ndarray] = None
@@ -481,8 +488,10 @@ class JaxSolveArena:
                 reverse_r=self.reverse_r,
                 mesh=self._mesh if use_mesh else None,
                 tile=tile, extra=self.extra,
+                pad_floors=self._repair_pads,
             )
         )
+        self._repair_pads = dict(stats.get("pad_hw") or {})
         changed = (
             (cand_p != self._cand_p).any(axis=1)
             | (cand_c != self._cand_c).any(axis=1)
@@ -553,7 +562,7 @@ class JaxSolveArena:
         return stats
 
     def _base_stats(self, T: int, gen_sharded: bool) -> dict:
-        return {
+        base = {
             "native_isa": jax_isa(),
             "engine": "jax",
             "jax_devices": int(self._devices_effective or 1),
@@ -561,6 +570,16 @@ class JaxSolveArena:
             "device_degraded": self.device_degraded,
             "rows": T,
         }
+        if _jitwitness.enabled():
+            # compiles observed DURING this solve, per jit entry — the
+            # warm-path contract is an empty dict here (perf_gate --jax
+            # asserts it); plus the process-lifetime total for obs
+            base["jit_compiles"] = _jitwitness.total()
+            base["jit_compiles_delta"] = _jitwitness.delta(
+                self._jit_mark
+            )
+            self._jit_mark = _jitwitness.snapshot()
+        return base
 
     def _cold(self, weights, pf, rf, P, T) -> np.ndarray:
         eng: Optional[dict] = {} if obs.enabled() else None

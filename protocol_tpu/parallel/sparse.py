@@ -892,6 +892,7 @@ def repair_topk_bidir_sharded(
     tile: int = 1024,
     extra: int = 16,
     axis: str = "p",
+    pad_floors: dict | None = None,
 ):
     """Churn-masked repair of the persistent bidirectional candidate
     structure — the JAX twin of the native engine's
@@ -938,7 +939,20 @@ def repair_topk_bidir_sharded(
     (``repair_rows``, ``repair_providers``, ``repair_blocks``,
     ``visited_cells_frac`` — the fraction of the P*T cost grid
     re-evaluated; the refold and final merge are structure ops both
-    paths pay and are excluded)."""
+    paths pay and are excluded).
+
+    ``pad_floors`` is the pad-bucket ratchet: a mapping of kernel
+    family ("enter" / "forward" / "tile") to the largest pow-2 pad that
+    family has already compiled for. Each gather pads to at least that
+    floor, so the jit compile-key set is MONOTONE across a warm chain —
+    a later tick can never fall into a smaller, never-traced bucket and
+    stall on the tracer mid-tick. Exactness is unaffected: every repair
+    kernel is per-row (no cross-row reduction), pad rows are clamp
+    copies, and write-back slices ``[:n]``, so a row's bits do not
+    depend on the batch pad. The new high-water marks come back in
+    ``stats["pad_hw"]`` for the caller to persist alongside the parts;
+    the wasted pad work is bounded by one pow-2 bucket and the floor
+    only rises log-many times over a process lifetime."""
     import numpy as np
 
     from protocol_tpu.ops.cost import CostWeights
@@ -969,6 +983,13 @@ def repair_topk_bidir_sharded(
     ep_treedef = jax.tree.structure(ep)
     er_treedef = jax.tree.structure(er)
 
+    pad_hw = dict(pad_floors) if pad_floors else {}
+
+    def _padq(kind: str, n: int) -> int:
+        p = max(_pow2_pad(n), pad_hw.get(kind, 0))
+        pad_hw[kind] = p
+        return p
+
     use_mesh = (
         mesh is not None and T % mesh.shape[axis] == 0
         and (T // mesh.shape[axis]) % tile == 0
@@ -980,7 +1001,7 @@ def repair_topk_bidir_sharded(
     enter_count = 0
     if dirty_p.size:
         rows |= np.isin(fwd_p, dirty_p).any(axis=1)
-        dp_pad = _pow2_pad(dirty_p.size)
+        dp_pad = _padq("enter", dirty_p.size)
         ep_dirty = _gather_rows(ep, dirty_p, dp_pad)
         p_ids = np.zeros(dp_pad, np.uint32)
         p_ids[: dirty_p.size] = dirty_p
@@ -1030,7 +1051,7 @@ def repair_topk_bidir_sharded(
         chunk_cap = min(1024, tile)
         for lo in range(0, R.size, chunk_cap):
             chunk = R[lo: lo + chunk_cap]
-            c_pad = _pow2_pad(chunk.size, lo=8)
+            c_pad = _padq("forward", chunk.size)
             er_rows = _gather_rows(er, chunk, c_pad)
             t_ids = np.zeros(c_pad, np.uint32)
             t_ids[: chunk.size] = chunk
@@ -1077,7 +1098,7 @@ def repair_topk_bidir_sharded(
             sj = np.flatnonzero(flag[:, j])
             for lo in range(0, sj.size, s_cap):
                 sc = sj[lo: lo + s_cap]
-                s_pad = _pow2_pad(sc.size)
+                s_pad = _padq("tile", sc.size)
                 ep_rows = _gather_rows(ep, sc, s_pad)
                 p_ids = np.zeros(s_pad, np.uint32)
                 p_ids[: sc.size] = sc
@@ -1112,6 +1133,7 @@ def repair_topk_bidir_sharded(
         "repair_blocks": blocks,
         "repair_enter_rows": enter_count,
         "visited_cells_frac": round(visited / max(Pn * T, 1), 6),
+        "pad_hw": pad_hw,
     }
     return (
         np.asarray(cand_p, np.int32),

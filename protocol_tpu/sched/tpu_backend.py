@@ -37,6 +37,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# must precede this module's @jax.jit decorators (the ops import below
+# also installs it; stated here because this file jits directly)
+from protocol_tpu.utils import jitwitness as _jitwitness
+
+_jitwitness.install()
+
 from protocol_tpu.models.node import ComputeRequirements
 from protocol_tpu.models.task import Task
 from protocol_tpu.ops.assign import assign_auction

@@ -1,0 +1,121 @@
+"""Runtime jit-cache witness: the dynamic half of the staging analyzer
+(``scripts/analysis/staging.py``).
+
+The static retrace pass proves the *code* cannot recompile per tick
+(non-array Python args are static, compile keys are padded/bucketed);
+this module counts what XLA actually compiles, per entry, live. The
+mechanism: ``jax.jit`` is wrapped so the function being staged gets one
+extra Python frame that increments a per-entry counter — and that frame
+only ever runs while JAX is TRACING. A cache-hit call dispatches the
+compiled executable without touching Python, so the steady-state cost
+of the witness is exactly zero; the armed/disarmed distinction
+(``PROTOCOL_TPU_JIT_WITNESS=1``, like the lock witness) governs who
+*reads* the counters (arena ``last_stats``, the perf gate's
+zero-recompile assertion), not whether they exist.
+
+The patch must land before any ``@jax.jit`` decorator executes, which
+is why the jit-owning packages (``ops``, ``parallel``, the jax path in
+``sched/tpu_backend.py``) import this module first thing. Call-form
+jits (the lru_cached sharded builders) resolve ``jax.jit`` at call
+time and are covered regardless of import order.
+
+What a "compile" means here: one execution of the staged function's
+Python body — i.e. one trace, which is one cache miss, which is one
+XLA compilation (or AOT lowering). Counts aggregate by qualified name,
+so a B-ladder of builder instances shows up as one entry whose count
+is the ladder depth — and a warm tick at steady state shows up as a
+zero delta, which is precisely the gate contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+_counts: dict = {}
+_counts_lock = threading.Lock()  # meta-lock, never witnessed
+_installed = False
+
+
+def enabled() -> bool:
+    v = os.environ.get("PROTOCOL_TPU_JIT_WITNESS", "")
+    return v not in ("", "0", "off", "false")
+
+
+def _entry_name(fun) -> str:
+    mod = getattr(fun, "__module__", None) or "?"
+    qual = getattr(fun, "__qualname__", None) or repr(fun)
+    return f"{mod}:{qual}"
+
+
+def _bump(entry: str) -> None:
+    with _counts_lock:
+        _counts[entry] = _counts.get(entry, 0) + 1
+
+
+def counts() -> dict:
+    """Per-entry compile counts since process start (or ``reset()``)."""
+    with _counts_lock:
+        return dict(_counts)
+
+
+def total() -> int:
+    with _counts_lock:
+        return sum(_counts.values())
+
+
+def reset() -> None:
+    with _counts_lock:
+        _counts.clear()
+
+
+def snapshot() -> dict:
+    """Alias of :func:`counts` named for its role in delta bracketing:
+    ``snap = snapshot(); ...work...; delta(snap)``."""
+    return counts()
+
+
+def delta(since: dict) -> dict:
+    """Entries whose compile count grew past ``since`` (a
+    :func:`snapshot`), mapped to how many NEW compilations each paid."""
+    now = counts()
+    return {
+        k: v - since.get(k, 0)
+        for k, v in now.items()
+        if v > since.get(k, 0)
+    }
+
+
+def install() -> None:
+    """Idempotently wrap ``jax.jit`` with the trace counter. Safe to
+    call from every jit-owning module; the first caller wins."""
+    global _installed
+    if _installed:
+        return
+    with _counts_lock:
+        if _installed:
+            return
+        _installed = True
+    import jax
+
+    orig_jit = jax.jit
+
+    @functools.wraps(orig_jit)
+    def counting_jit(fun=None, **kwargs):
+        if fun is None:
+            # factory form: jax.jit(static_argnames=...) -> decorator
+            return lambda f: counting_jit(f, **kwargs)
+        entry = _entry_name(fun)
+
+        @functools.wraps(fun)
+        def staged(*args, **kw):
+            # this frame exists only during tracing — compiled-cache
+            # hits never re-enter the Python body
+            _bump(entry)
+            return fun(*args, **kw)
+
+        return orig_jit(staged, **kwargs)
+
+    counting_jit._pt_jitwitness = True  # marker for tests / reentry
+    jax.jit = counting_jit

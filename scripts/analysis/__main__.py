@@ -1,6 +1,6 @@
 """CLI for the whole-program analyzer: ``python -m scripts.analysis``.
 
-Runs all three passes (or a ``--pass`` subset), audits this engine's
+Runs all five passes (or a ``--pass`` subset), audits this engine's
 escape tokens for staleness, prints findings in the lint engine's
 ``path:line: [rule] message`` shape, and exits 1 on any finding — the
 same fail-the-build discipline as ``python -m scripts.lints``.
@@ -12,11 +12,14 @@ import argparse
 import re
 import sys
 
-from scripts.analysis import lockorder, protocolsm, purity
+from scripts.analysis import lockorder, protocolsm, purity, spmd, staging
 from scripts.analysis.spec import load_spec
 from scripts.lints.base import REPO, Finding
 
-_PASSES = ("lock-order", "protocol-sm", "jax-purity")
+_PASSES = (
+    "lock-order", "protocol-sm", "jax-purity", "jax-retrace",
+    "spmd-contract",
+)
 
 _TOKEN_RE = re.compile(r"#\s*lint:\s*([A-Za-z0-9_-]+)")
 
@@ -47,7 +50,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m scripts.analysis",
         description="whole-program concurrency & contract analyzer "
-                    "(lock-order / protocol-sm / jax-purity)",
+                    "(lock-order / protocol-sm / jax-purity / "
+                    "jax-retrace / spmd-contract)",
     )
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=_PASSES, default=None,
@@ -86,12 +90,35 @@ def main(argv=None) -> int:
             ck.consumed,
         ))
 
+    # the three jax passes share one Index over the same roots
+    jax_index = None
+    if {"jax-purity", "jax-retrace", "spmd-contract"} & set(passes):
+        from scripts.analysis.callgraph import Index
+
+        jax_index = Index.build(purity.DEFAULT_ROOTS)
+
     if "jax-purity" in passes:
-        pc = purity.PurityChecker()
+        pc = purity.PurityChecker(index=jax_index)
         findings.extend(pc.run())
         files = {info.rel for info in pc.index.functions.values()}
         findings.extend(_audit_own_escapes(
             files, purity.SUPPRESS, pc.consumed
+        ))
+
+    if "jax-retrace" in passes:
+        st = staging.StagingChecker(index=jax_index)
+        findings.extend(st.run())
+        files = {info.rel for info in st.index.functions.values()}
+        findings.extend(_audit_own_escapes(
+            files, staging.SUPPRESS, st.consumed
+        ))
+
+    if "spmd-contract" in passes:
+        sm = spmd.SpmdChecker(index=jax_index)
+        findings.extend(sm.run())
+        files = {info.rel for info in sm.index.functions.values()}
+        findings.extend(_audit_own_escapes(
+            files, spmd.SUPPRESS, sm.consumed
         ))
 
     for f in findings:
@@ -108,6 +135,12 @@ def main(argv=None) -> int:
                                "wire-v2 session lifecycle model",
                 "jax-purity": "jit-reachable code is not trace-pure "
                               "(host sync / ambient state / promotion)",
+                "jax-retrace": "jit staging hazard: static-argname "
+                               "miss, mutable capture, or polymorphic "
+                               "compile key (recompile per tick)",
+                "spmd-contract": "shard_map site violates the committed "
+                                 "mesh/axis/D-invariance contract "
+                                 "(spmd_spec.toml)",
                 "stale-escape": "escape annotation suppresses nothing",
             },
         )

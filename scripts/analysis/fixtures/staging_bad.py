@@ -1,0 +1,76 @@
+"""Seeded jit staging hazards (the seeded marker lines are the
+oracle): static-argname misses, mutable host-state captures, and
+polymorphic compile keys — the recompile-per-tick mutation class the
+runtime jit-cache witness counts live."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CACHE = {}
+_SEEN = []
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def bad_static_miss(
+    cost,
+    tile: int,
+    k: int,  # SEED: jax-retrace
+):
+    return cost[:k] * tile
+
+
+@jax.jit
+def bad_dict_capture(cost):
+    key = 4
+    if key not in _CACHE:  # SEED: jax-retrace
+        return cost
+    return cost * 2
+
+
+@jax.jit
+def bad_list_capture(cost):
+    _SEEN.append(1)  # SEED: jax-retrace
+    return cost
+
+
+def build_logged(mesh):
+    log = []
+
+    def inner(cost):
+        log.append(2)  # SEED: jax-retrace
+        return cost * 2
+
+    return jax.jit(inner)
+
+
+@partial(jax.jit, static_argnames=("n",))
+def take_n(cost, n: int):
+    return cost[:n]
+
+
+def caller_churny_static(cost, mask):
+    n_open = int(jnp.sum(mask))
+    return take_n(cost, n=n_open)  # SEED: jax-retrace
+
+
+def build_pad(pad):
+    def run(cost):
+        return jnp.pad(cost, (0, pad))
+
+    return jax.jit(run)
+
+
+def caller_churny_builder(cost, mask):
+    rows = np.flatnonzero(mask)
+    run = build_pad(rows.size)  # SEED: jax-retrace
+    return run(cost)
+
+
+def caller_dtype_fork(cost, wide):
+    run = build_pad(  # SEED: jax-retrace
+        jnp.zeros(4, dtype=jnp.float64 if wide else jnp.float32).size,
+    )
+    return run(cost)

@@ -1,0 +1,72 @@
+"""Clean twin of staging_bad.py: the SAME shapes, hazard-free — the
+near-misses the retrace pass must NOT flag. Static argnames cover every
+Python-typed parameter, union-annotated scalars ride traced, captures
+are immutable, and every data-dependent compile key is laundered
+through a committed quantizer or the ``*= 2`` doubling ladder."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SCALE = 1.5
+_DIMS = (4, 8)
+
+
+def _pow2_pad(n, lo=8):
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+@partial(jax.jit, static_argnames=("tile", "k"))
+def ok_static_covered(cost, tile: int, k: int):
+    return cost[:k] * tile
+
+
+@jax.jit
+def ok_union_scalar(cost, eps: float | jax.Array, state: tuple | None):
+    if state is None:
+        return cost + eps
+    return cost + eps + state[0]
+
+
+@jax.jit
+def ok_immutable_capture(cost):
+    out = []
+    out.append(cost * SCALE)
+    return out[0] + _DIMS[0]
+
+
+def ok_shape_static(cost):
+    # shape-derived statics add no recompile: shapes already key the cache
+    return ok_static_covered(cost, tile=cost.shape[0], k=4)
+
+
+def build_pad(pad):
+    def run(cost):
+        return jnp.pad(cost, (0, pad))
+
+    return jax.jit(run)
+
+
+def ok_quantized_builder(cost, mask):
+    rows = np.flatnonzero(mask)
+    pad = _pow2_pad(rows.size)
+    run = build_pad(pad)
+    return run(cost)
+
+
+@partial(jax.jit, static_argnames=("budget",))
+def take_budget(cost, budget: int):
+    return cost[:budget]
+
+
+def ok_doubling_ladder(cost, mask):
+    n_open = int(jnp.sum(mask))
+    budget = 64
+    while budget < n_open:
+        budget *= 2
+    return take_budget(cost, budget=budget)

@@ -1,0 +1,589 @@
+"""shard_map contract pass against the committed SPMD spec
+(``scripts/analysis/spmd_spec.toml``, ISSUE 19 tentpole).
+
+The sharded kernels (parallel/) promise PR 17-18's D-invariance
+contract: one 1xD provider mesh, every collective on the declared axis,
+candidate structure bit-identical at any device count. The end-to-end
+replay gates prove the promise holds for the committed goldens; this
+pass localizes WHY it holds, per call site, and catches the drift the
+replay only reports as "diverged at tick 7":
+
+  S1 contract shape: every ``shard_map`` call/decorator carries
+     ``mesh=``, ``in_specs=`` and ``out_specs=`` (a missing spec is
+     implicit replication that happens to work at D=1 and silently
+     gathers at D>1).
+
+  S2 axis names: every ``P(...)`` axis and every collective axis
+     operand (``psum``/``pmax``/``pmin``/``all_gather``/``axis_index``/
+     ...) must RESOLVE to an axis declared in ``[mesh] axes`` — through
+     a string literal, a module constant, an enclosing parameter
+     default, or a committed ``[axis_aliases]`` name. An operand the
+     pass cannot resolve is itself a finding: the spec stays total,
+     exactly like the lock pass's unclassifiable-lock rule.
+
+  S3 spec arity: ``in_specs`` tuple length must match the wrapped
+     function's parameter count, and ``out_specs`` tuple length its
+     returned tuple length, whenever both sides are statically
+     determinable (MAY analysis — a pytree-valued spec variable counts
+     as one argument slot, matching shard_map's prefix semantics).
+
+  S4 collective placement: a collective reached from code that is NOT
+     under any shard_map body (lexically or through the call graph) has
+     no axis to talk over — it works in tests that never build a mesh
+     and fails on the flag-flip day.
+
+  S5 D-invariance: reading the device count inside a traced region
+     (``jax.device_count``/``local_device_count``/``jax.devices``), or
+     any ``[d_invariance] sources`` flow into a guarded call
+     (``pick_tile``) — the tile policy must be a function of T only,
+     the invariant jax_arena._gen_plan encodes by computing the tile
+     BEFORE asking for D.
+
+Escape: ``# lint: spmd-ok`` on the line (staleness-audited). The
+runtime twin for the recompile half of the staging story is
+``protocol_tpu/utils/jitwitness.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Optional
+
+from scripts.analysis import purity
+from scripts.analysis.callgraph import Index, receiver_pattern
+from scripts.analysis.spec import _load_toml
+from scripts.lints.base import Finding, REPO
+
+RULE = "spmd-contract"
+SUPPRESS = "spmd-ok"
+
+DEFAULT_ROOTS = purity.DEFAULT_ROOTS
+
+SPEC_PATH = os.path.join(os.path.dirname(__file__), "spmd_spec.toml")
+
+# which operand carries the axis name, per collective
+_AXIS_ARG_POS = {"axis_index": 0}
+_DEFAULT_AXIS_POS = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdSpec:
+    axes: tuple
+    rank: int
+    axis_aliases: tuple
+    collectives: tuple
+    d_sources: tuple
+    d_guarded: tuple
+    quantizers: tuple
+
+
+def load_spmd_spec(path: Optional[str] = None) -> SpmdSpec:
+    doc = _load_toml(path or SPEC_PATH)
+    mesh = doc.get("mesh", {})
+    return SpmdSpec(
+        axes=tuple(mesh.get("axes", [])),
+        rank=int(mesh.get("rank", 1)),
+        axis_aliases=tuple(
+            doc.get("axis_aliases", {}).get("names", [])
+        ),
+        collectives=tuple(doc.get("collectives", {}).get("ops", [])),
+        d_sources=tuple(doc.get("d_invariance", {}).get("sources", [])),
+        d_guarded=tuple(doc.get("d_invariance", {}).get("guarded", [])),
+        quantizers=tuple(doc.get("quantizers", {}).get("names", [])),
+    )
+
+
+def _callable_name(fn: ast.AST) -> str:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+class SpmdChecker:
+    def __init__(
+        self, roots=DEFAULT_ROOTS, index: Optional[Index] = None,
+        spec: Optional[SpmdSpec] = None,
+    ):
+        self.index = index if index is not None else Index.build(roots)
+        self.spec = spec if spec is not None else load_spmd_spec()
+        self.purity = purity.PurityChecker(roots, index=self.index)
+        self.findings: list[Finding] = []
+        self.consumed: set = set()
+        self._lines: dict[str, list] = {}
+        self._module_strs: dict[str, dict] = {}
+
+    # ---------------- driver ----------------
+
+    def run(self) -> list[Finding]:
+        sharded = self._sharded_functions()
+        region = self._sharded_region(sharded)
+        entries = self.purity.jit_entries()
+        jit_reach = self.purity.closure(entries)
+        for qname, info in sorted(self.index.functions.items()):
+            self._check_function(info, region, jit_reach)
+        self._check_module_level()
+        return self.findings
+
+    # ---------------- shard_map site discovery ----------------
+
+    def _shard_map_call(self, node: ast.AST) -> Optional[ast.Call]:
+        """The Call carrying shard_map's keywords: the call itself, or
+        the ``partial(shard_map, ...)`` decorator shape."""
+        if not isinstance(node, ast.Call):
+            return None
+        name = _callable_name(node.func)
+        if name == "shard_map":
+            return node
+        if name == "partial" and node.args and _callable_name(
+            node.args[0]
+        ) == "shard_map":
+            return node
+        return None
+
+    def _sharded_functions(self) -> dict:
+        """qname -> shard_map Call for every function whose body runs
+        under shard_map: decorator form plus the call form's wrapped
+        target resolved in-file."""
+        out = {}
+        for qname, info in self.index.functions.items():
+            for dec in getattr(info.node, "decorator_list", ()):
+                call = self._shard_map_call(dec)
+                if call is not None:
+                    call._spmd_parent_def = info
+                    out[qname] = call
+        for rel, tree in self.index.trees.items():
+            for node in ast.walk(tree):
+                call = self._shard_map_call(node)
+                if call is None or call is not node:
+                    continue
+                target = None
+                if node.args and isinstance(
+                    node.args[0] if _callable_name(node.func)
+                    == "shard_map" else None, ast.Name
+                ):
+                    target = node.args[0].id
+                elif _callable_name(node.func) == "partial":
+                    if len(node.args) > 1 and isinstance(
+                        node.args[1], ast.Name
+                    ):
+                        target = node.args[1].id
+                if target is None:
+                    continue
+                local = [
+                    q for q in self.index.by_name.get(target, ())
+                    if self.index.functions[q].rel == rel
+                ]
+                for q in local:
+                    out.setdefault(q, node)
+        return out
+
+    def _sharded_region(self, sharded: dict) -> set:
+        """Call-graph closure of the shard_map bodies (nested defs ride
+        lexically; helpers ride resolve_call edges)."""
+        return self.purity.closure(set(sharded))
+
+    def _in_region(self, qname: str, region: set) -> bool:
+        if qname in region:
+            return True
+        rel, qual = qname.split("::", 1)
+        parts = qual.split(".<locals>.")
+        for depth in range(1, len(parts)):
+            if f"{rel}::" + ".<locals>.".join(parts[:depth]) in region:
+                return True
+        return False
+
+    # ---------------- per-function checks ----------------
+
+    def _check_function(self, info, region: set, jit_reach: set) -> None:
+        tainted: set[str] = set()
+        for node in _ordered_own(info.node):
+            call = self._shard_map_call(node)
+            if call is not None:
+                self._check_shard_map(info, call, node)
+            if isinstance(node, ast.Assign):
+                if any(
+                    self._d_tainted(v, tainted)
+                    for v in ast.walk(node.value)
+                ):
+                    tainted.update(
+                        t.id for tgt in node.targets
+                        for t in ast.walk(tgt)
+                        if isinstance(t, ast.Name)
+                    )
+            if isinstance(node, ast.Call):
+                self._check_collective(info, node, region)
+                self._check_guarded(info, node, tainted)
+                self._check_device_read(info, node, jit_reach)
+        # a nested def's decorators execute in THIS scope and are
+        # yielded by _ordered_own; its body is visited under its own
+        # qname so region membership stays per-innermost-function
+
+    def _check_module_level(self) -> None:
+        """Module-level shard_map/collective sites (outside any def)."""
+        for rel, tree in self.index.trees.items():
+            fn_nodes = {
+                id(i.node) for i in self.index.functions.values()
+                if i.rel == rel
+            }
+
+            def walk(node):
+                for child in ast.iter_child_nodes(node):
+                    if id(child) in fn_nodes:
+                        continue
+                    call = self._shard_map_call(child)
+                    if call is not None:
+                        self._check_shard_map_rel(rel, call, None)
+                    walk(child)
+
+            walk(tree)
+
+    # ---------------- S1-S3: the shard_map contract ----------------
+
+    def _check_shard_map(self, info, call, site) -> None:
+        self._check_shard_map_rel(info.rel, call, info)
+
+    def _check_shard_map_rel(self, rel, call, info) -> None:
+        kws = {kw.arg: kw.value for kw in call.keywords}
+        for required in ("mesh", "in_specs", "out_specs"):
+            if required not in kws:
+                self._find(
+                    rel, call,
+                    f"shard_map without {required}= — implicit "
+                    "replication works at D=1 and silently diverges "
+                    "on a real mesh; state the contract",
+                )
+        for spec_kw in ("in_specs", "out_specs"):
+            if spec_kw in kws:
+                self._check_partition_axes(rel, kws[spec_kw], info)
+        wrapped = self._wrapped_fn(rel, call)
+        if wrapped is None:
+            return
+        in_specs = kws.get("in_specs")
+        if isinstance(in_specs, ast.Tuple):
+            nparams = len(_params(wrapped.node))
+            if len(in_specs.elts) != nparams:
+                self._find(
+                    rel, in_specs,
+                    f"in_specs has {len(in_specs.elts)} entries but "
+                    f"'{wrapped.name}' takes {nparams} arguments — "
+                    "the mismatch shifts every spec one slot",
+                )
+        out_specs = kws.get("out_specs")
+        if isinstance(out_specs, ast.Tuple):
+            sizes = _return_tuple_sizes(wrapped.node)
+            if sizes and all(s != len(out_specs.elts) for s in sizes):
+                self._find(
+                    rel, out_specs,
+                    f"out_specs has {len(out_specs.elts)} entries but "
+                    f"'{wrapped.name}' returns "
+                    f"{'/'.join(str(s) for s in sorted(sizes))} values",
+                )
+
+    def _wrapped_fn(self, rel, call):
+        """FunctionInfo the shard_map wraps, when resolvable."""
+        target = None
+        if _callable_name(call.func) == "shard_map":
+            if call.args and isinstance(call.args[0], ast.Name):
+                target = call.args[0].id
+        elif len(call.args) > 1 and isinstance(call.args[1], ast.Name):
+            target = call.args[1].id
+        if target is None:
+            # decorator form: partial(shard_map, ...) with no target
+            # rides on a def — find it by the decorator backlink
+            parent = getattr(call, "_spmd_parent_def", None)
+            return parent
+        local = [
+            q for q in self.index.by_name.get(target, ())
+            if self.index.functions[q].rel == rel
+        ]
+        if len(local) == 1:
+            return self.index.functions[local[0]]
+        return None
+
+    def _check_partition_axes(self, rel, spec_expr, info) -> None:
+        for sub in ast.walk(spec_expr):
+            if not (
+                isinstance(sub, ast.Call)
+                and _callable_name(sub.func) in ("P", "PartitionSpec")
+            ):
+                continue
+            for a in sub.args:
+                axis = self._resolve_axis(rel, a, info)
+                if axis is _UNRESOLVED:
+                    self._find(
+                        rel, a,
+                        f"cannot resolve P(...) axis operand "
+                        f"{ast.unparse(a)!r} — use a literal, a module "
+                        "constant, or a spec'd [axis_aliases] name",
+                    )
+                elif axis is not None and axis not in self.spec.axes:
+                    self._find(
+                        rel, a,
+                        f"P(...) names axis {axis!r} which is not in "
+                        f"the declared mesh axes {list(self.spec.axes)}",
+                    )
+
+    # ---------------- S2/S4: collectives ----------------
+
+    def _check_collective(self, info, call, region) -> None:
+        fname = _callable_name(call.func)
+        if fname not in self.spec.collectives:
+            return
+        if not isinstance(call.func, ast.Attribute):
+            return
+        root = receiver_pattern(call.func.value).split(".", 1)[0]
+        if root not in ("lax", "jax"):
+            return
+        if not self._in_region(info.qname, region):
+            self._find(
+                info.rel, call,
+                f"collective lax.{fname} outside any shard_map region "
+                "— there is no mesh axis to communicate over here",
+            )
+        pos = _AXIS_ARG_POS.get(fname, _DEFAULT_AXIS_POS)
+        axis_expr = None
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_expr = kw.value
+        if axis_expr is None and len(call.args) > pos:
+            axis_expr = call.args[pos]
+        if axis_expr is None:
+            self._find(
+                info.rel, call,
+                f"collective lax.{fname} without an axis name — it "
+                "must name the spec'd mesh axis",
+            )
+            return
+        axis = self._resolve_axis(info.rel, axis_expr, info)
+        if axis is _UNRESOLVED:
+            self._find(
+                info.rel, call,
+                f"cannot resolve the axis operand of lax.{fname} "
+                f"({ast.unparse(axis_expr)!r}) — use a literal, a "
+                "module constant, or a spec'd [axis_aliases] name",
+            )
+        elif axis is not None and axis not in self.spec.axes:
+            self._find(
+                info.rel, call,
+                f"lax.{fname} names axis {axis!r} which is not in the "
+                f"declared mesh axes {list(self.spec.axes)}",
+            )
+
+    # ---------------- S5: D-invariance ----------------
+
+    def _d_tainted(self, node: ast.AST, tainted: set) -> bool:
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+        if isinstance(node, ast.Call):
+            pat = receiver_pattern(node.func)
+            if pat in self.spec.d_sources:
+                return True
+        if isinstance(node, ast.Attribute):
+            if receiver_pattern(node) in self.spec.d_sources:
+                return True
+        return False
+
+    def _check_guarded(self, info, call, tainted) -> None:
+        if _callable_name(call.func) not in self.spec.d_guarded:
+            return
+        exprs = list(call.args) + [kw.value for kw in call.keywords]
+        for e in exprs:
+            if any(
+                self._d_tainted(sub, tainted) for sub in ast.walk(e)
+            ):
+                self._find(
+                    info.rel, call,
+                    f"'{_callable_name(call.func)}' argument derives "
+                    "from the device count — the tile policy must be "
+                    "a function of T only (candidate structure must "
+                    "be bit-identical at any D)",
+                )
+                return
+
+    def _check_device_read(self, info, call, jit_reach) -> None:
+        pat = receiver_pattern(call.func)
+        if pat not in (
+            "jax.device_count", "jax.local_device_count", "jax.devices"
+        ):
+            return
+        if info.qname in jit_reach or self._in_region(
+            info.qname, jit_reach
+        ):
+            self._find(
+                info.rel, call,
+                f"{pat}() inside a traced region — bakes the device "
+                "count into the executable, breaking the D-invariance "
+                "contract",
+            )
+
+    # ---------------- axis resolution ----------------
+
+    def _resolve_axis(self, rel, expr, info):
+        """The axis STRING an operand resolves to; None when the
+        operand is legitimately axis-free (None / empty P()); the
+        _UNRESOLVED sentinel otherwise."""
+        if isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return None
+            if isinstance(expr.value, str):
+                return expr.value
+            return _UNRESOLVED
+        if isinstance(expr, ast.Tuple):
+            # P(("p",)) multi-axis slot: resolve each element
+            for e in expr.elts:
+                r = self._resolve_axis(rel, e, info)
+                if r is _UNRESOLVED or (
+                    r is not None and r not in self.spec.axes
+                ):
+                    return r
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.spec.axis_aliases:
+                # the conventional carrier of the (single) mesh axis
+                return self.spec.axes[0] if self.spec.axes else None
+            const = self._module_str_consts(rel).get(expr.id)
+            if const is not None:
+                return const
+            if info is not None:
+                d = _param_default_str(info.node, expr.id)
+                if d is not None:
+                    return d
+            return _UNRESOLVED
+        if isinstance(expr, ast.Attribute):
+            # PROVIDER_AXIS-style constant on an imported module
+            const = self._module_str_consts(rel).get(expr.attr)
+            if const is not None:
+                return const
+            if expr.attr in self.spec.axis_aliases:
+                return self.spec.axes[0] if self.spec.axes else None
+            return _UNRESOLVED
+        return _UNRESOLVED
+
+    def _module_str_consts(self, rel) -> dict:
+        got = self._module_strs.get(rel)
+        if got is not None:
+            return got
+        out: dict = {}
+        tree = self.index.trees.get(rel)
+        if tree is not None:
+            for st in tree.body:
+                if isinstance(st, ast.Assign) and isinstance(
+                    st.value, ast.Constant
+                ) and isinstance(st.value.value, str):
+                    for tgt in st.targets:
+                        if isinstance(tgt, ast.Name):
+                            out[tgt.id] = st.value.value
+        # imported constants: from X import PROVIDER_AXIS
+        for name, (mod_rel, orig) in self.index.imports.get(
+            rel, {}
+        ).items():
+            tree = self.index.trees.get(mod_rel)
+            if tree is None:
+                continue
+            for st in tree.body:
+                if isinstance(st, ast.Assign) and isinstance(
+                    st.value, ast.Constant
+                ) and isinstance(st.value.value, str) and any(
+                    isinstance(t, ast.Name) and t.id == orig
+                    for t in st.targets
+                ):
+                    out[name] = st.value.value
+        self._module_strs[rel] = out
+        return out
+
+    # ---------------- reporting ----------------
+
+    def _find(self, rel: str, node, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        lines = self._file_lines(rel)
+        if lines and 1 <= line <= len(lines):
+            if f"lint: {SUPPRESS}" in lines[line - 1]:
+                self.consumed.add((rel, line))
+                return
+        f = Finding(RULE, rel, line, msg)
+        if f not in self.findings:
+            self.findings.append(f)
+
+    def _file_lines(self, rel: str):
+        if rel not in self._lines:
+            try:
+                self._lines[rel] = (REPO / rel).read_text().splitlines()
+            except OSError:
+                self._lines[rel] = []
+        return self._lines[rel]
+
+
+class _Unresolved:
+    pass
+
+
+_UNRESOLVED = _Unresolved()
+
+
+def _params(fn: ast.AST) -> list:
+    a = fn.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _param_default_str(fn: ast.AST, name: str) -> Optional[str]:
+    """The string default of parameter ``name`` anywhere in the lexical
+    chain of ``fn`` (the sharded builders thread ``axis: str = "p"``)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        a = node.args
+        pos = list(a.posonlyargs) + list(a.args)
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if p.arg == name and isinstance(d, ast.Constant) and (
+                isinstance(d.value, str)
+            ):
+                return d.value
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if p.arg == name and isinstance(d, ast.Constant) and (
+                isinstance(d.value, str)
+            ):
+                return d.value
+    return None
+
+
+def _return_tuple_sizes(fn: ast.AST) -> set:
+    """Sizes of tuple-literal returns of ``fn`` itself (nested defs
+    excluded); empty when any return defeats static counting."""
+    sizes: set = set()
+    for node in ast.walk(fn):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and node is not fn:
+            continue
+        if isinstance(node, ast.Return):
+            if isinstance(node.value, ast.Tuple):
+                sizes.add(len(node.value.elts))
+            else:
+                return set()
+    return sizes
+
+
+def _ordered_own(root: ast.AST):
+    """Pre-order, source-order traversal of ``root``'s OWN statements:
+    a nested def is yielded (with its decorator expressions, which run
+    in this scope) but not descended into — its body is checked under
+    its own qname. ast.walk would be wrong twice over: breadth-first
+    order breaks assignment-before-use taint, and descending into
+    nested defs misattributes their call sites to the outer scope."""
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child
+            for dec in child.decorator_list:
+                yield dec
+                yield from _ordered_own(dec)
+            continue
+        yield child
+        yield from _ordered_own(child)
+
+
+def run(roots=DEFAULT_ROOTS, index=None, spec=None) -> list[Finding]:
+    return SpmdChecker(roots, index=index, spec=spec).run()

@@ -1,0 +1,492 @@
+"""Retrace-hazard pass over the jit staging layer (ISSUE 19 tentpole).
+
+The warm-path economics of the jax engine (PRs 17-18) rest on one
+property the purity pass does not check: a warm tick must hit the
+compiled cache, never the tracer. Three hazard classes break that
+property without breaking correctness — which is why they survive
+end-to-end tests and only surface as a 9.5s compile stall per tick on
+real hardware:
+
+  R1 static-miss: a jit entry parameter that is a plain Python value
+     (annotated ``int``/``bool``/``str``) but NOT covered by
+     static_argnames. JAX hashes such a value into the trace as a
+     weakly-typed scalar — booleans and strings fail outright, ints
+     silently retrace wherever they feed shapes or Python branches.
+     Union-annotated parameters (``float | jax.Array``) are the
+     sanctioned traced-scalar idiom and are not flagged.
+
+  R2 mutable-capture: a jit-reachable function closes over module- or
+     builder-level MUTABLE host state (a dict/list/set binding, or a
+     module global rebound via ``global``). The trace freezes the value
+     at compile time; every later mutation is silently invisible to the
+     compiled executable — the staging twin of the purity pass's
+     "traced once, frozen forever" ambient-state rule.
+
+  R3 polymorphic compile key: a call site feeds a compile key — a
+     static argname of a jit entry, or any argument of an lru_cached
+     jit BUILDER — with a data-dependent count (``flatnonzero(...)
+     .size``, ``int(jnp.sum(...))``): a fresh executable per distinct
+     churn count, i.e. a recompile per tick. The sanctioned escape
+     hatches are the committed quantizers (``[quantizers]`` in
+     spmd_spec.toml: _pow2_pad / _pow2_bucket / pick_tile /
+     pad_to_multiple) and the inline ``x *= 2`` doubling ladder
+     (ops/sparse._greedy_cleanup's budget bucket). Shape-derived
+     values (``arr.shape[0]``) are NOT flagged: array shapes are
+     already part of the cache key, so a shape-derived static adds no
+     recompile the shapes did not.
+
+Entry discovery is shared with the purity pass (decorator form plus the
+call-form lru_cached-builder idiom); the quantizer table rides the
+committed ``spmd_spec.toml`` so the retrace pass and the shard_map
+contract pass can never drift apart. The dynamic twin is
+``protocol_tpu/utils/jitwitness.py``: what this pass proves statically,
+the witness counts live and ``perf_gate --jax`` gates on. Escape:
+``# lint: retrace-ok`` on the line, for hazards that are genuinely
+bounded (staleness-audited like every other token).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Optional
+
+from scripts.analysis import purity
+from scripts.analysis.callgraph import Index, receiver_pattern
+from scripts.analysis.spmd import load_spmd_spec
+from scripts.lints.base import Finding, REPO
+
+RULE = "jax-retrace"
+SUPPRESS = "retrace-ok"
+
+DEFAULT_ROOTS = purity.DEFAULT_ROOTS
+
+STATIC_ANNOTATIONS = {"int", "bool", "str"}
+MUTABLE_CTORS = {
+    "list", "dict", "set", "bytearray", "deque", "defaultdict",
+    "OrderedDict", "Counter",
+}
+# calls whose result is an index set sized by DATA, not by shape
+CHURNY_SOURCES = {"flatnonzero", "nonzero", "argwhere", "unique", "union1d",
+                  "setdiff1d", "intersect1d"}
+REDUCTIONS = {"sum", "max", "min", "item", "count_nonzero"}
+# extractors whose result is structural, not sized-by-data: a pytree's
+# treedef is the same for every churn chunk gathered into it
+STRUCTURAL = {"structure", "tree_structure", "treedef"}
+_BUILTINS = frozenset(dir(builtins))
+
+
+def _param_list(fn: ast.AST) -> list:
+    a = fn.args
+    return [
+        p for p in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        )
+        if p.arg not in ("self", "cls")
+    ]
+
+
+def _static_names(fn: ast.AST, raw: tuple) -> set:
+    """static_argnames plus static_argnums translated to names."""
+    params = _param_list(fn)
+    out = set()
+    for s in raw:
+        if isinstance(s, int):
+            if 0 <= s < len(params):
+                out.add(params[s].arg)
+        else:
+            out.add(s)
+    return out
+
+
+class StagingChecker:
+    def __init__(
+        self, roots=DEFAULT_ROOTS, index: Optional[Index] = None,
+        spec=None,
+    ):
+        self.index = index if index is not None else Index.build(roots)
+        self.spec = spec if spec is not None else load_spmd_spec()
+        self.purity = purity.PurityChecker(roots, index=self.index)
+        self.findings: list[Finding] = []
+        self.consumed: set = set()
+        self._lines: dict[str, list] = {}
+
+    # ---------------- driver ----------------
+
+    def run(self) -> list[Finding]:
+        entries = self.purity.jit_entries()
+        reach = self.purity.closure(entries)
+        for qname in sorted(entries):
+            info = self.index.functions[qname]
+            statics = _static_names(info.node, entries[qname])
+            self._check_static_miss(info, statics)
+        for qname in sorted(reach):
+            self._check_mutable_capture(self.index.functions[qname])
+        builders = self._builders(entries)
+        for info in self.index.functions.values():
+            self._check_call_sites(info, entries, builders)
+        return self.findings
+
+    # ---------------- R1: static-miss ----------------
+
+    def _check_static_miss(self, info, statics: set) -> None:
+        for p in _param_list(info.node):
+            ann = p.annotation
+            if not (
+                isinstance(ann, ast.Name)
+                and ann.id in STATIC_ANNOTATIONS
+            ):
+                continue
+            if p.arg in statics:
+                continue
+            self._find(
+                info.rel, p,
+                f"jit entry '{info.name}' takes Python "
+                f"{ann.id} '{p.arg}' outside static_argnames — "
+                "retraces per value (or fails to trace); declare it "
+                "static or make it a traced array",
+            )
+
+    # ---------------- R2: mutable captures ----------------
+
+    def _check_mutable_capture(self, info) -> None:
+        fn = info.node
+        bound = {p.arg for p in _param_list(fn)} | {"self", "cls"}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Name) and isinstance(
+                sub.ctx, (ast.Store, ast.Del)
+            ):
+                bound.add(sub.id)
+            elif isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                bound.add(sub.name)
+                if sub is not fn:
+                    for p in _param_list(sub):
+                        bound.add(p.arg)
+            elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                for alias in sub.names:
+                    bound.add((alias.asname or alias.name).split(".")[0])
+            elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                bound.add(sub.name)
+        seen: set = set()
+        for sub in ast.walk(fn):
+            if not (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+            ):
+                continue
+            name = sub.id
+            if name in bound or name in seen or name in _BUILTINS:
+                continue
+            seen.add(name)
+            how = self._mutable_binding(info, name)
+            if how:
+                self._find(
+                    info.rel, sub,
+                    f"jit-reachable '{info.name}' captures mutable "
+                    f"host state '{name}' ({how}) — frozen at trace "
+                    "time, later mutations are invisible to the "
+                    "compiled executable",
+                )
+
+    def _mutable_binding(self, info, name: str) -> Optional[str]:
+        """How ``name`` resolves to a MUTABLE binding in the enclosing
+        module (or enclosing builder scope for nested entries); None if
+        the binding is immutable/unknown (the MAY-not direction)."""
+        tree = self.index.trees.get(info.rel)
+        if tree is None:
+            return None
+        # enclosing function scopes of a nested entry first
+        qual = info.qname.split("::", 1)[1]
+        parts = qual.split(".<locals>.")
+        for depth in range(len(parts) - 1, 0, -1):
+            anc = f"{info.rel}::" + ".<locals>.".join(parts[:depth])
+            anc_info = self.index.functions.get(anc)
+            if anc_info is None:
+                continue
+            kind = _mutable_assign_in(anc_info.node.body, name)
+            if kind:
+                return f"{kind} in enclosing '{anc_info.name}'"
+            if _assigned_in(anc_info.node.body, name):
+                return None  # bound, immutably, closer than module scope
+        kind = _mutable_assign_in(tree.body, name)
+        if kind:
+            return f"module-level {kind}"
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Global) and name in node.names:
+                return "rebound via 'global'"
+        return None
+
+    # ---------------- R3: polymorphic compile keys ----------------
+
+    def _builders(self, entries) -> dict:
+        """qname -> FunctionInfo for every function that BUILDS a jit
+        object (a call-form entry is nested inside it, the lru_cache
+        idiom): all of its arguments are compile keys."""
+        out = {}
+        for e in entries:
+            if ".<locals>." not in e:
+                continue
+            rel, qual = e.split("::", 1)
+            outer = f"{rel}::{qual.rsplit('.<locals>.', 1)[0]}"
+            info = self.index.functions.get(outer)
+            if info is not None:
+                out[outer] = info
+        return out
+
+    def _check_call_sites(self, info, entries, builders) -> None:
+        churny = _ChurnTaint(self.spec.quantizers)
+        for st in _ordered(info.node):
+            churny.observe(st)
+            if not isinstance(st, ast.Call):
+                continue
+            for callee in self.index.resolve_call(st, info):
+                if callee in builders:
+                    keys = [
+                        (a, None) for a in list(st.args)
+                        + [kw.value for kw in st.keywords]
+                    ]
+                elif callee in entries:
+                    target = self.index.functions[callee]
+                    statics = _static_names(
+                        target.node, entries[callee]
+                    )
+                    keys = _static_args_at_call(
+                        st, target.node, statics
+                    )
+                else:
+                    continue
+                for expr, argname in keys:
+                    if churny.is_churny(expr):
+                        what = (
+                            f"static '{argname}'" if argname
+                            else "builder compile key"
+                        )
+                        self._find(
+                            info.rel, st,
+                            f"{what} of '{self.index.functions[callee].name}' "
+                            "derives from a data-dependent count — a "
+                            "fresh executable per churn set (recompile "
+                            "per tick); pad it through a committed "
+                            "quantizer (_pow2_pad / _pow2_bucket / "
+                            "pick_tile) or a *=2 ladder",
+                        )
+                        break
+                    if _dtype_polymorphic(expr, churny):
+                        self._find(
+                            info.rel, st,
+                            "dtype-polymorphic argument to "
+                            f"'{self.index.functions[callee].name}' — a "
+                            "conditional dtype forks the jit cache per "
+                            "branch; pick one wire dtype",
+                        )
+                        break
+
+    # ---------------- reporting ----------------
+
+    def _find(self, rel: str, node, msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        lines = self._file_lines(rel)
+        if lines and 1 <= line <= len(lines):
+            if f"lint: {SUPPRESS}" in lines[line - 1]:
+                self.consumed.add((rel, line))
+                return
+        f = Finding(RULE, rel, line, msg)
+        if f not in self.findings:
+            self.findings.append(f)
+
+    def _file_lines(self, rel: str):
+        if rel not in self._lines:
+            try:
+                self._lines[rel] = (REPO / rel).read_text().splitlines()
+            except OSError:
+                self._lines[rel] = []
+        return self._lines[rel]
+
+
+def _ordered(root: ast.AST):
+    """Pre-order, source-order traversal (ast.walk is breadth-first,
+    which would observe assignments out of program order)."""
+    for child in ast.iter_child_nodes(root):
+        yield child
+        yield from _ordered(child)
+
+
+def _assigned_in(stmts, name: str) -> bool:
+    for st in stmts:
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                st.targets if isinstance(st, ast.Assign) else [st.target]
+            )
+            for tgt in targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+    return False
+
+
+def _mutable_assign_in(stmts, name: str) -> Optional[str]:
+    """'<kind>' when ``name`` is bound to a mutable container in this
+    statement list (one lexical level — nested defs keep their own
+    scopes), else None."""
+    for st in stmts:
+        if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            st.targets if isinstance(st, ast.Assign) else [st.target]
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id == name
+            for tgt in targets for t in ast.walk(tgt)
+        ):
+            continue
+        v = st.value
+        if isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+            return "a mutable literal"
+        if isinstance(v, ast.Call):
+            fname = (
+                v.func.id if isinstance(v.func, ast.Name)
+                else v.func.attr if isinstance(v.func, ast.Attribute)
+                else ""
+            )
+            if fname in MUTABLE_CTORS:
+                return f"{fname}() container"
+    return None
+
+
+def _static_args_at_call(
+    call: ast.Call, fn: ast.AST, statics: set
+) -> list:
+    """(expr, param name) for every call argument bound to a static
+    argname of the entry."""
+    params = [p.arg for p in _param_list(fn)]
+    out = []
+    for i, a in enumerate(call.args):
+        if i < len(params) and params[i] in statics:
+            out.append((a, params[i]))
+    for kw in call.keywords:
+        if kw.arg in statics:
+            out.append((kw.value, kw.arg))
+    return out
+
+
+def _dtype_polymorphic(expr: ast.AST, churny) -> bool:
+    """``x.astype(a if c else b)`` / ``dtype=<conditional or churny>``
+    forks the compile cache by dtype."""
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Call):
+            continue
+        cand = []
+        if isinstance(sub.func, ast.Attribute) and (
+            sub.func.attr == "astype"
+        ) and sub.args:
+            cand.append(sub.args[0])
+        cand.extend(
+            kw.value for kw in sub.keywords if kw.arg == "dtype"
+        )
+        for c in cand:
+            if isinstance(c, ast.IfExp) or churny.is_churny(c):
+                return True
+    return False
+
+
+class _ChurnTaint:
+    """Per-function value-derived-count taint. Names become churny when
+    assigned from an index-set builder (flatnonzero/unique/...) or an
+    int()-forced reduction; ``.size``/``len()``/``.shape`` of a churny
+    name stays churny; a committed quantizer call launders anything;
+    ``x *= 2`` is the doubling-ladder idiom and keeps x's state."""
+
+    def __init__(self, quantizers):
+        self.quantizers = set(quantizers)
+        self.churny: set[str] = set()
+
+    def observe(self, st: ast.AST) -> None:
+        if isinstance(st, ast.Assign):
+            targets, value = st.targets, st.value
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            targets, value = [st.target], st.value
+        else:
+            return
+        direct: list[str] = []
+        bases: list[str] = []
+        for tgt in targets:
+            _target_names(tgt, direct, bases)
+        if self.is_churny(value):
+            self.churny.update(direct)
+            self.churny.update(bases)
+        else:
+            for n in direct:
+                self.churny.discard(n)
+            # a clean PARTIAL write (x[i] = ...) does not clean x
+
+    def is_churny(self, expr: ast.AST) -> bool:
+        return self._walk(expr)
+
+    def _walk(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            fname = (
+                node.func.id if isinstance(node.func, ast.Name)
+                else node.func.attr
+                if isinstance(node.func, ast.Attribute) else ""
+            )
+            if fname in self.quantizers or fname in STRUCTURAL:
+                return False  # laundered / structural: bounded key set
+            if fname in CHURNY_SOURCES:
+                return True
+            if fname == "int" or fname in REDUCTIONS:
+                # int(jnp.sum(...)) / x.sum() forced to a host scalar
+                if fname in REDUCTIONS and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    return True
+                return any(self._walk(a) for a in node.args) or any(
+                    _has_reduction(a) for a in node.args
+                )
+            if fname == "len":
+                return any(self._walk(a) for a in node.args)
+        if isinstance(node, ast.Name):
+            return node.id in self.churny
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "size", "shape"
+        ):
+            # .size/.shape of a churny index set is the churn COUNT;
+            # of anything else it is shape-derived and sanctioned
+            return self._walk(node.value)
+        return any(
+            self._walk(c) for c in ast.iter_child_nodes(node)
+        )
+
+
+def _target_names(tgt: ast.AST, direct: list, bases: list) -> None:
+    """Names an assignment target BINDS: the name itself, tuple
+    elements, or the base container of a subscript/attribute store —
+    never the index expressions (``x[i * rt] = v`` binds x, not rt)."""
+    if isinstance(tgt, ast.Name):
+        direct.append(tgt.id)
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            _target_names(e, direct, bases)
+    elif isinstance(tgt, ast.Starred):
+        _target_names(tgt.value, direct, bases)
+    elif isinstance(tgt, (ast.Subscript, ast.Attribute)):
+        node = tgt.value
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            node = node.value
+        if isinstance(node, ast.Name):
+            bases.append(node.id)
+
+
+def _has_reduction(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and isinstance(
+            sub.func, ast.Attribute
+        ) and sub.func.attr in REDUCTIONS:
+            return True
+    return False
+
+
+def run(roots=DEFAULT_ROOTS, index=None, spec=None) -> list[Finding]:
+    return StagingChecker(roots, index=index, spec=spec).run()

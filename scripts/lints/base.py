@@ -57,6 +57,14 @@ EXTERNAL_SUPPRESS_SCOPES = {
         "protocol_tpu/ops", "protocol_tpu/parallel",
         "protocol_tpu/sched/tpu_backend.py",
     ),
+    "retrace-ok": (
+        "protocol_tpu/ops", "protocol_tpu/parallel",
+        "protocol_tpu/sched/tpu_backend.py",
+    ),
+    "spmd-ok": (
+        "protocol_tpu/ops", "protocol_tpu/parallel",
+        "protocol_tpu/sched/tpu_backend.py",
+    ),
 }
 EXTERNAL_SUPPRESS_TOKENS = tuple(EXTERNAL_SUPPRESS_SCOPES)
 

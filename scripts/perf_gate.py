@@ -1274,6 +1274,28 @@ def trace_gate() -> int:
     return 0
 
 
+def _warm_recompile_failures(recompiles: dict, budget: int) -> list:
+    """Failure lines for jit compilations observed after the warm-up
+    boundary of the 1%-churn chain (``recompiles`` is a jitwitness
+    delta: entry -> compiles since the mark). ANY recompile past the
+    budget means a warm tick hit the tracer — the exact 9.5s-per-tick
+    stall class the staging pass (jax-retrace) exists to prevent.
+    Factored out so the gate's failure path is testable without paying
+    a deliberately-mistraced 4096 chain in CI."""
+    total = sum(recompiles.values())
+    if total <= budget:
+        return []
+    worst = ", ".join(
+        f"{entry.rsplit(':', 1)[-1]} x{count}"
+        for entry, count in sorted(recompiles.items())
+    )
+    return [
+        f"warm chain hit the tracer {total} time(s) after warm-up "
+        f"(budget {budget}): {worst} — a warm tick must replay the "
+        "compiled cache, never retrace"
+    ]
+
+
 def jax_gate() -> int:
     """First-class jax-engine gate (the ISSUE 17 acceptance bar):
     (a) the committed jax golden replays bit-for-bit under engine=jax
@@ -1297,6 +1319,10 @@ def jax_gate() -> int:
     on the same population (absolute floor when the native toolchain
     is unavailable)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # arm the jit-cache witness: the warm chain in (d) must never hit
+    # the tracer after warm-up (scripts/analysis/staging.py is the
+    # static twin of this runtime assertion)
+    os.environ.setdefault("PROTOCOL_TPU_JIT_WITNESS", "1")
     from protocol_tpu.utils.platform import force_host_cpu
 
     # the full-mesh replay and the D=4 shard check both need a multi-
@@ -1460,6 +1486,8 @@ def jax_gate() -> int:
     # the changed set near the churned rows, which is what the warm
     # kernel is for. Cold here is invalidate+resolve (compile already
     # paid), so the ratio is pure algorithmic carry, not XLA caching.
+    from protocol_tpu.utils import jitwitness
+
     a1.invalidate()
     t0 = time.perf_counter()
     a1.solve(ep, er, w)
@@ -1468,7 +1496,8 @@ def jax_gate() -> int:
     rng = np.random.default_rng(4)
     walls, solves = [], []
     cold_passes = 0
-    for _ in range(3):
+    warm_mark = None
+    for tick in range(3):
         rows = rng.choice(n, n // 100, replace=False)
         ram = np.array(er.ram_mb, copy=True)
         ram[rows] = np.maximum(
@@ -1483,6 +1512,21 @@ def jax_gate() -> int:
         walls.append(time.perf_counter() - t0)
         solves.append(a1.last_stats["solve_ms"])
         cold_passes += int(a1.last_stats.get("cand_cold_passes", 1))
+        if tick == 0:
+            # warm-up boundary: the first warm tick may legitimately
+            # engage lazily-built kernels (the cleanup budget bucket);
+            # every tick after it must run compile-free
+            warm_mark = jitwitness.snapshot()
+    recompiles = jitwitness.delta(warm_mark)
+    print(
+        f"jax gate: warm-tick recompiles after warm-up: "
+        f"{sum(recompiles.values())} "
+        f"(budget {floors['jax_warm_recompiles_max']}, "
+        f"entries traced this process: {len(jitwitness.counts())})"
+    )
+    failures.extend(_warm_recompile_failures(
+        recompiles, floors["jax_warm_recompiles_max"]
+    ))
     wall_x = cold_s / max(float(np.median(walls)), 1e-9)
     solve_x = cold_solve_ms / max(float(np.median(solves)), 1e-9)
     print(

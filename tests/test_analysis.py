@@ -6,7 +6,9 @@ violation in the fixture corpus is caught (the `# SEED: <rule>` lines
 are the oracle), the clean twins come back silent, the REAL tree is
 clean, and each analyzer catches a realistic mutation injected into the
 real modules — a reordered acquisition, a dropped lock, an
-apply-before-deadline handler, and a host-sync-in-jit."""
+apply-before-deadline handler, a host-sync-in-jit, a dropped
+static_argname, a renamed collective axis, and a device-count-derived
+tile policy."""
 
 import json
 import pathlib
@@ -15,7 +17,7 @@ import sys
 
 import pytest
 
-from scripts.analysis import lockorder, protocolsm, purity
+from scripts.analysis import lockorder, protocolsm, purity, spmd, staging
 from scripts.analysis.spec import load_spec, parse_toml_subset
 from scripts.lints.base import (
     EXTERNAL_SUPPRESS_TOKENS,
@@ -87,7 +89,8 @@ class TestSpec:
         from scripts.lints.base import EXTERNAL_SUPPRESS_SCOPES
 
         assert set(EXTERNAL_SUPPRESS_TOKENS) == {
-            lockorder.SUPPRESS, protocolsm.SUPPRESS, purity.SUPPRESS
+            lockorder.SUPPRESS, protocolsm.SUPPRESS, purity.SUPPRESS,
+            staging.SUPPRESS, spmd.SUPPRESS,
         }
         # the lint engine's scope table must mirror each analyzer's
         # actual roots, or the out-of-scope staleness check drifts
@@ -97,8 +100,42 @@ class TestSpec:
         assert EXTERNAL_SUPPRESS_SCOPES[purity.SUPPRESS] == (
             purity.DEFAULT_ROOTS
         )
+        # the jax passes share roots AND scope: one Index, one scan set
+        assert EXTERNAL_SUPPRESS_SCOPES[staging.SUPPRESS] == (
+            staging.DEFAULT_ROOTS
+        )
+        assert EXTERNAL_SUPPRESS_SCOPES[spmd.SUPPRESS] == (
+            spmd.DEFAULT_ROOTS
+        )
+        assert staging.DEFAULT_ROOTS == purity.DEFAULT_ROOTS
+        assert spmd.DEFAULT_ROOTS == purity.DEFAULT_ROOTS
         # the lock pass scans the whole walk: empty scope = everywhere
         assert EXTERNAL_SUPPRESS_SCOPES[lockorder.SUPPRESS] == ()
+
+    def test_spmd_spec_loads_and_is_total(self):
+        spec = spmd.load_spmd_spec()
+        assert spec.axes == ("p",)
+        assert spec.rank == 1
+        # the conventional axis carrier names the builders thread
+        assert "axis" in spec.axis_aliases
+        assert "PROVIDER_AXIS" in spec.axis_aliases
+        # the communication surface the sharded kernels actually use
+        for op in ("psum", "pmax", "pmin", "all_gather", "axis_index"):
+            assert op in spec.collectives, op
+        # the D-invariance contract: tile policy guarded, jitter NOT
+        # (the sharded gen rebuilds global ids from axis_index*Tl)
+        assert "pick_tile" in spec.d_guarded
+        assert "tie_jitter_ids" not in spec.d_guarded
+        assert "jax.device_count" in spec.d_sources
+        # the retrace pass's laundering set matches the real helpers
+        from protocol_tpu.parallel import sparse as psparse
+        from protocol_tpu.parallel.mesh import pad_to_multiple  # noqa: F401
+        from protocol_tpu.ops.sparse import pick_tile  # noqa: F401
+
+        assert hasattr(psparse, "_pow2_pad")
+        assert "_pow2_pad" in spec.quantizers
+        assert "pick_tile" in spec.quantizers
+        assert "pad_to_multiple" in spec.quantizers
 
 
 # --------------------------------------------------------------------
@@ -137,10 +174,19 @@ class TestSeededFixtures:
                 "jax-purity", "purity_repair_bad.py",
                 "purity_repair_ok.py",
             ),
+            (
+                lambda f: staging.run(roots=(str(f),)),
+                "jax-retrace", "staging_bad.py", "staging_ok.py",
+            ),
+            (
+                lambda f: spmd.run(roots=(str(f),)),
+                "spmd-contract", "spmd_bad.py", "spmd_ok.py",
+            ),
         ],
         ids=[
             "lock-reorder", "lock-dropped", "protocol-sm", "jax-purity",
-            "jax-purity-callform", "jax-purity-repair",
+            "jax-purity-callform", "jax-purity-repair", "jax-retrace",
+            "spmd-contract",
         ],
     )
     def test_seeds_and_clean_twin(self, runner, rule, bad, ok):
@@ -210,6 +256,36 @@ class TestRealTree:
             assert any(
                 "parallel/sparse.py" in q and want in q for q in entries
             ), f"repair jit entry {want} went blind"
+
+    def test_retrace_clean_and_sees_the_compile_keys(self):
+        st = staging.StagingChecker()
+        assert st.run() == []
+        # discovery sanity: the pass saw the same entry set purity does
+        entries = st.purity.jit_entries()
+        assert len(entries) >= 10
+        # the lru_cached sharded builders are compile-key surfaces —
+        # an empty builder map would mean R3 went blind
+        builders = st._builders(entries)
+        assert any(
+            "parallel/sparse.py" in q and "_build_sharded" in q
+            for q in builders
+        ), "sharded-builder compile keys went blind"
+
+    def test_spmd_clean_and_sees_the_sharded_kernels(self):
+        sm = spmd.SpmdChecker()
+        assert sm.run() == []
+        sharded = sm._sharded_functions()
+        # every sharded kernel family must be discovered (decorator
+        # form in the builders, call form for the repair enter twin)
+        rels = {sm.index.functions[q].rel for q in sharded}
+        assert "protocol_tpu/parallel/sparse.py" in rels
+        assert "protocol_tpu/parallel/auction.py" in rels
+        assert "protocol_tpu/parallel/sinkhorn.py" in rels
+        assert any("_build_repair_enter_sharded" in q for q in sharded)
+        # and the region closure must reach the collective-bearing
+        # helpers, or the placement rule (S4) stops meaning anything
+        region = sm._sharded_region(sharded)
+        assert len(region) > len(sharded)
 
     def test_cli_clean_and_exit_codes(self):
         ok = subprocess.run(
@@ -330,6 +406,58 @@ class TestRealModuleMutations:
         ))
         findings = purity.run(roots=(str(mutated),))
         assert any(".item()" in f.message for f in findings), findings
+
+    def test_dropped_static_argname_is_caught(self, tmp_path):
+        src = (REPO / "protocol_tpu/ops/sparse.py").read_text()
+        needle = 'static_argnames=("k", "tile", "approx_recall")'
+        assert needle in src  # candidates_topk anchor
+        mutated = tmp_path / "sparse_mutated.py"
+        mutated.write_text(src.replace(
+            needle, 'static_argnames=("tile", "approx_recall")', 1
+        ))
+        findings = staging.run(roots=(str(mutated),))
+        assert any(
+            "'k' outside static_argnames" in f.message
+            for f in findings
+        ), findings
+        # the unmutated module is clean (anchor-drift tripwire)
+        assert staging.run(
+            roots=("protocol_tpu/ops/sparse.py",)
+        ) == []
+
+    def test_renamed_collective_axis_is_caught(self, tmp_path):
+        src = (REPO / "protocol_tpu/parallel/sparse.py").read_text()
+        i = src.index("lax.psum(")
+        j = src.index("axis)", i)
+        assert j > i  # first psum passes the threaded axis carrier
+        mutated = tmp_path / "parallel_sparse_mutated.py"
+        mutated.write_text(src[:j] + '"q")' + src[j + len("axis)"):])
+        findings = spmd.run(roots=(str(mutated),))
+        assert any(
+            "axis 'q'" in f.message and "psum" in f.message
+            for f in findings
+        ), findings
+
+    def test_device_count_in_tile_policy_is_caught(self, tmp_path):
+        src = (REPO / "protocol_tpu/parallel/jax_arena.py").read_text()
+        needle = "tile = pick_tile(T, cap=min(1024, max(1, T // 8)))"
+        assert needle in src  # _gen_plan computes tile before D
+        mutated = tmp_path / "jax_arena_mutated.py"
+        mutated.write_text(src.replace(
+            needle,
+            "D = self._ensure_devices()\n        "
+            "tile = pick_tile(T, cap=min(1024, max(1, T // D)))",
+            1,
+        ))
+        findings = spmd.run(roots=(str(mutated),))
+        assert any(
+            "derives from the device count" in f.message
+            for f in findings
+        ), findings
+        # the unmutated arena is clean
+        assert spmd.run(
+            roots=("protocol_tpu/parallel/jax_arena.py",)
+        ) == []
 
 
 # --------------------------------------------------------------------

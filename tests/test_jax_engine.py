@@ -210,6 +210,95 @@ class TestJaxArenaWarmChain:
         np.testing.assert_array_equal(arena._cand_c, fresh._cand_c)
 
 
+class TestJitCacheWitness:
+    """Runtime twin of the jax-retrace static pass: compilations per
+    jit entry, counted by the ``protocol_tpu.utils.jitwitness`` patch
+    that ``protocol_tpu/ops/__init__.py`` installs before any kernel
+    decorator runs. ``perf_gate --jax`` arms it and fails on ANY
+    recompile after the warm chain's warm-up boundary."""
+
+    def test_shape_churn_counts_a_recompile_cache_hit_does_not(self):
+        import jax.numpy as jnp
+
+        from protocol_tpu.utils import jitwitness
+
+        mark = jitwitness.snapshot()
+
+        @jax.jit
+        def _witness_probe(x):
+            return x * 2
+
+        _witness_probe(jnp.zeros(8, jnp.float32))
+        _witness_probe(jnp.zeros(8, jnp.float32))  # cache hit
+        d = jitwitness.delta(mark)
+        entries = [k for k in d if "_witness_probe" in k]
+        assert len(entries) == 1, d
+        assert d[entries[0]] == 1  # one trace, not two
+        _witness_probe(jnp.zeros(16, jnp.float32))  # forced shape churn
+        assert jitwitness.delta(mark)[entries[0]] == 2
+
+    def test_warm_repair_tick_is_compile_free(self):
+        """The warm-path economics the witness gates: the FIRST warm
+        repair tick may engage lazily-built kernels; a repeat tick with
+        the same churn profile must replay the compiled cache only."""
+        from protocol_tpu.utils import jitwitness
+
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16)
+        arena.solve(ep, er, CostWeights())
+        arena.solve(_bump_price(ep, [5]), er, CostWeights())  # warm-up
+        mark = jitwitness.snapshot()
+        arena.solve(_bump_price(ep, [9]), er, CostWeights())
+        assert arena.last_stats["cand_cold_passes"] == 0
+        assert jitwitness.delta(mark) == {}, (
+            "a settled warm repair tick hit the tracer"
+        )
+
+    def test_gate_fails_under_injected_warm_tick_retrace(self):
+        """The perf_gate assertion, demonstrated without paying a 4096
+        chain: a deliberately shape-churned 'warm tick' is a counted
+        recompile, and the gate's failure predicate trips on it."""
+        import jax.numpy as jnp
+
+        from protocol_tpu.utils import jitwitness
+        from scripts.perf_gate import _warm_recompile_failures
+
+        @jax.jit
+        def _retrace_probe(x):
+            return x + 1
+
+        _retrace_probe(jnp.zeros(8, jnp.float32))  # warm-up compile
+        mark = jitwitness.snapshot()
+        _retrace_probe(jnp.zeros(32, jnp.float32))  # the injected retrace
+        delta = jitwitness.delta(mark)
+        assert delta, "witness missed the injected retrace"
+        failures = _warm_recompile_failures(delta, budget=0)
+        assert failures and "hit the tracer" in failures[0]
+        assert "_retrace_probe" in failures[0]
+        # and the green path: an empty delta produces no failure
+        assert _warm_recompile_failures({}, budget=0) == []
+
+    def test_last_stats_surface_is_env_gated(self, monkeypatch):
+        from protocol_tpu.utils import jitwitness
+
+        monkeypatch.delenv("PROTOCOL_TPU_JIT_WITNESS", raising=False)
+        ep, er = _marketplace()
+        arena = JaxSolveArena(k=16)
+        arena.solve(ep, er, CostWeights())
+        assert "jit_compiles" not in arena.last_stats
+
+        monkeypatch.setenv("PROTOCOL_TPU_JIT_WITNESS", "1")
+        assert jitwitness.enabled()
+        armed = JaxSolveArena(k=16)
+        armed.solve(ep, er, CostWeights())
+        s = armed.last_stats
+        assert s["jit_compiles"] >= 1  # this process traced SOMETHING
+        assert isinstance(s["jit_compiles_delta"], dict)
+        # a byte-identical re-solve short-circuits: no tracing at all
+        armed.solve(ep, er, CostWeights())
+        assert armed.last_stats["jit_compiles_delta"] == {}
+
+
 class TestDeviceInvarianceAndDegradation:
     """Satellite 4: the shard_map shim's D-invariance at arena grain,
     and the degrade-inside-the-engine contract."""
