@@ -312,6 +312,21 @@ class ProcessFleet:
         self.drop_endpoint(p.address)
         return p
 
+    def kill_unannounced(self, index: int) -> ManagedProc:
+        """SIGKILL withOUT the driver-takedown bookkeeping: the process
+        dies but the fleet still believes it is alive — the crash-shaped
+        cousin of the zombie drill's SIGSTOP. Heartbeats stop, the
+        detector promotes suspect -> dead, and the full autonomous
+        ejection (:meth:`_eject`: topology bump, fence supersession,
+        journal re-route) runs on EVIDENCE, not on a driver script. Use
+        with the detector armed; a plain :meth:`kill` removes the corpse
+        from the detector's watch and owns the handoff itself."""
+        p = self.procs[index]
+        if p.popen is not None:
+            p.popen.kill()
+            p.popen.wait(timeout=30)
+        return p
+
     def drain(self, index: int, timeout_s: float = 60.0) -> ManagedProc:
         """SIGTERM — graceful drain (flush journals, exit 0)."""
         p = self.procs[index]
@@ -572,6 +587,19 @@ class ProcessFleet:
             except Exception:
                 out[p.proc_id] = None
         return out
+
+    def stream_rollup(self, scrapes=None) -> dict:
+        """Fleet-wide join of the per-session ``"stream"`` metrics
+        sections the batch scrape join ignores (ISSUE 20 satellite):
+        events / dedup / reconcile / divergence counters summed across
+        processes, latency p99 fleet-max. Pass a saved ``scrape()``
+        result to roll up a point-in-time snapshot (e.g. one taken
+        BEFORE draining the survivors)."""
+        from protocol_tpu.dstream.rollup import stream_rollup
+
+        return stream_rollup(
+            self.scrape() if scrapes is None else scrapes
+        )
 
     def witness_violations(self) -> dict:
         """Per-process lock-witness verdicts dumped at drain/exit

@@ -1,0 +1,52 @@
+"""Distributed event firehose: streaming sessions x the process fleet.
+
+PR 15's :mod:`protocol_tpu.stream` engine does sub-tick online repair
+inside ONE session on ONE process; PR 12/14's :mod:`protocol_tpu.dfleet`
+is batch-mode. This package composes them into the production shape the
+reference's heartbeat architecture implies (PAPER.md §1: every worker
+continuously heartbeats the orchestrator): event sources routed by the
+consistent-hash ring to stream-mode wire-v2 sessions on every servicer
+process, with three fleet-level capabilities:
+
+  * **mass events** (:func:`fanout.mass_leave_events`) — one fleet-level
+    event (a regional blackout, composed with the ``faults/`` blackout
+    site) fans out deterministically to every affected session as
+    per-source leave events at a SENTINEL seq tier, which restores the
+    per-source supersession contract for mass events: convergence is
+    independent of where the fan-out interleaves each session's
+    firehose, so chaos'd delivery still converges bit-identical to
+    fault-free replay;
+  * **ejection storms** (:func:`fanout.ejection_leave_events`) — a
+    detector ejection (PR 14) translates into leave events for every
+    source homed on the dead process (:func:`fanout.source_home`),
+    absorbed online by surviving sessions' stream engines — O(churned
+    rows) per event, GapTracker certificate maintained — instead of
+    waiting for a batch tick;
+  * **live migration of streaming sessions** — the checkpoint journal
+    now carries the FULL stream state (``StreamEngine.export_state``:
+    dedup cursors, reconcile cadence cursor, counters), so the Migrate
+    RPC path re-arms the engine at the target with zero dropped or
+    double-applied events; a retransmit straddling the process boundary
+    dedups at the target exactly as it would have at the origin.
+
+Determinism contract: this package reads no clocks and no RNG state —
+storm membership and source homing are pure sha1 functions of (seed,
+tag, row) / the ring, the faults/plan idiom. Wall-clock scheduling
+lives in the driver (fleet/loadgen), where it belongs.
+"""
+
+from protocol_tpu.dstream.fanout import (  # noqa: F401
+    MASS_SEQ_BASE,
+    PAD_SEQ_BASE,
+    PAD_SOURCE,
+    STORM_SEQ_BASE,
+    affected_rows,
+    blackout_storm_schedule,
+    ejection_leave_events,
+    leave_events,
+    mass_leave_events,
+    pad_event,
+    source_home,
+    storm_rows,
+)
+from protocol_tpu.dstream.rollup import stream_rollup  # noqa: F401

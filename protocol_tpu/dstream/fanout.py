@@ -1,0 +1,173 @@
+"""Deterministic fan-out planning for fleet-level stream events.
+
+The core invention is the **sentinel seq tier**: a fleet-level event
+(mass blackout, ejection storm) is decomposed into per-source leave
+events whose seq sits ABOVE every seq a workload source will ever emit
+(workload seqs are per-source event counters — thousands; the tiers
+start at 2^30). Under the stream engine's per-source latest-wins
+supersession that makes the converged columns independent of WHERE the
+fan-out interleaves each session's firehose:
+
+  * a workload heartbeat from a stormed source arriving AFTER the storm
+    leave carries a lower seq -> superseded -> dropped;
+  * the storm leave arriving late (chaos'd delivery) still wins over
+    every earlier workload event for that source;
+  * two storms hitting the same source are ordered by tier + index
+    (mass index / topology generation, both monotone).
+
+So the final reconciled plan of a chaos'd, storm-injected fleet session
+is bit-identical to a fault-free replay of the same event multiset —
+the phase-A gate of ``perf_gate.py --dstream`` asserts exactly that.
+
+Everything here is a pure function of its arguments (sha1 hashing for
+storm membership, the ring for homing): no clocks, no RNG state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+from protocol_tpu.stream.events import StreamEvent
+
+# seq tiers (workload seqs are per-source event counters, << 2^29)
+PAD_SEQ_BASE = 1 << 29     # cadence-advancing no-op pads
+MASS_SEQ_BASE = 1 << 30    # + mass event index
+STORM_SEQ_BASE = (1 << 30) + (1 << 20)  # + topology generation
+PAD_SOURCE = "~pad"        # never minted by the synth factory
+
+
+def _h(key: str) -> int:
+    return int.from_bytes(
+        hashlib.sha1(key.encode()).digest()[:8], "big"
+    )
+
+
+def source_home(topology, session_id: str, source: str) -> str:
+    """The proc id an event source is homed on: ring-routed by the
+    (session, source) pair, so homes are deterministic given the
+    topology and spread independently of the sessions' own homes (a
+    provider node connects to SOME process; which one is ring luck)."""
+    ep = topology.endpoint_for(f"{session_id}/{source}")
+    return topology.procs.get(ep, ep)
+
+
+def affected_rows(
+    topology, session_id: str, dead_proc_id: str, n_providers: int
+) -> np.ndarray:
+    """Provider rows whose event source was homed on ``dead_proc_id``
+    — the membership of that process's ejection storm for one session.
+    Pure in (topology, session, proc): every driver computes the same
+    set, and a replay recomputes it bit-for-bit."""
+    rows = [
+        r for r in range(int(n_providers))
+        if source_home(topology, session_id, f"p{r}") == dead_proc_id
+    ]
+    return np.asarray(rows, np.int32)
+
+
+def storm_rows(
+    seed: int, tag: str, n_rows: int, frac: float
+) -> np.ndarray:
+    """Seeded deterministic subset of rows a mass event takes down —
+    sha1-ranked choice (the faults/plan idiom), no RNG state. At least
+    one row for any frac > 0 so an armed storm is never a no-op."""
+    n_rows = int(n_rows)
+    k = min(n_rows, max(1, int(round(n_rows * float(frac)))))
+    ranked = sorted(
+        range(n_rows), key=lambda r: _h(f"storm/{seed}/{tag}/{r}")
+    )
+    return np.asarray(sorted(ranked[:k]), np.int32)
+
+
+def leave_events(
+    rows, seq: int, p_cols: dict, kind: str = "leave"
+) -> list:
+    """Mint one per-source leave event per row at sentinel ``seq``.
+
+    The carried column payload is the SNAPSHOT state of the row with
+    ``valid`` forced False — any payload with valid=False yields the
+    same plan (invalid rows are excluded from candidate generation),
+    and pinning the snapshot makes the bytes themselves deterministic,
+    so the baseline replay applies the identical events."""
+    out = []
+    for r in np.asarray(rows).tolist():
+        r = int(r)
+        vals = {
+            name: np.asarray(a)[[r]].copy()
+            for name, a in p_cols.items()
+        }
+        vals["valid"] = np.zeros(1, np.bool_)
+        out.append(StreamEvent(
+            kind=kind, source=f"p{r}", seq=int(seq),
+            provider_rows=np.asarray([r], np.int32), p_cols=vals,
+            task_rows=np.zeros(0, np.int32), r_cols={},
+        ))
+    return out
+
+
+def mass_leave_events(
+    mass_index: int, rows, p_cols: dict
+) -> list:
+    """A fleet-level mass event's per-session decomposition: leave
+    events at the mass tier. ``mass_index`` orders successive mass
+    events (later index -> higher seq -> wins)."""
+    return leave_events(
+        rows, MASS_SEQ_BASE + int(mass_index), p_cols, kind="leave"
+    )
+
+
+def ejection_leave_events(
+    generation: int, rows, p_cols: dict
+) -> list:
+    """A detector ejection's leave storm: one leave per source homed on
+    the dead process, at the storm tier keyed by the post-ejection
+    topology generation (monotone across successive ejections, and
+    above every mass tier seq so 'the process died' beats 'the region
+    blacked out' for a doubly-affected source)."""
+    return leave_events(
+        rows, STORM_SEQ_BASE + int(generation), p_cols, kind="leave"
+    )
+
+
+def pad_event(index: int) -> StreamEvent:
+    """A cadence-advancing no-op event (zero rows): the driver pads the
+    tail of a drilled run to the next reconcile boundary so the final
+    answer is a RECONCILED plan comparable against the baseline's.
+    Distinct seqs per pad keep the dedup ladder honest."""
+    return StreamEvent(
+        kind="heartbeat", source=PAD_SOURCE,
+        seq=PAD_SEQ_BASE + int(index),
+        provider_rows=np.zeros(0, np.int32), p_cols={},
+        task_rows=np.zeros(0, np.int32), r_cols={},
+    )
+
+
+def blackout_storm_schedule(
+    seed: int,
+    shard: int,
+    n_providers: int,
+    frac: float = 0.1,
+    mass_index: int = 0,
+    tag: Optional[str] = None,
+) -> dict:
+    """The seeded leave-storm schedule a ``SessionFabric.blackout``
+    arms (the faults/ composition satellite): which provider rows the
+    regional blackout takes down, at which mass tier. A drill driver
+    consumes this to mint :func:`mass_leave_events` into every
+    session's stream — the blackout exercises the stream path, not
+    just the refusal ladder. JSON-serializable (rides snapshots)."""
+    rows = storm_rows(
+        int(seed), tag or f"blackout-shard{int(shard)}",
+        int(n_providers), float(frac),
+    )
+    return {
+        "kind": "blackout",
+        "seed": int(seed),
+        "shard": int(shard),
+        "mass_index": int(mass_index),
+        "frac": float(frac),
+        "rows": [int(r) for r in rows],
+    }

@@ -1,0 +1,100 @@
+"""Fleet-wide stream observability rollup.
+
+The per-process ``/metrics.json`` snapshot carries a per-session
+``"stream"`` section (event latency histogram + dedup / reconcile /
+divergence counters) that the dfleet scrape join has so far ignored —
+batch drills only read tick counters. :func:`stream_rollup` joins those
+sections across a ``ProcessFleet.scrape()`` result into one fleet-wide
+view for the loadgen report and the ``--dstream`` perf gate.
+
+Pure function of the scrape dict — callable on live scrapes, on saved
+report JSONs, and in tests without a fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def stream_rollup(scrapes: dict) -> dict:
+    """Join the per-session ``"stream"`` sections of per-process
+    ``/metrics.json`` snapshots into one fleet-wide aggregate.
+
+    ``scrapes`` maps proc_id -> snapshot dict (or None for a dead /
+    unscrapable process, as ``ProcessFleet.scrape`` returns). Counters
+    (events, deduped, reconciled, divergence-row and repair-row totals)
+    sum across the fleet; latency percentiles take the fleet max (an
+    upper bound — per-proc histograms can't be re-merged exactly);
+    ``sessions`` counts stream sections seen, ``procs`` lists per-proc
+    breakdowns so a skewed process is visible in the report.
+    """
+    total = {
+        "events": 0,
+        "deduped": 0,
+        "reconciled": 0,
+        "divergence_rows_max": 0,
+        "repair_rows": 0,
+        "p99_us_max": 0.0,
+        "max_us": 0.0,
+        "sessions": 0,
+    }
+    procs = {}
+    dead = []
+    for proc_id, snap in (scrapes or {}).items():
+        if not isinstance(snap, dict):
+            dead.append(str(proc_id))
+            continue
+        agg = {
+            "events": 0,
+            "deduped": 0,
+            "reconciled": 0,
+            "divergence_rows_max": 0,
+            "repair_rows": 0,
+            "p99_us_max": 0.0,
+            "max_us": 0.0,
+            "sessions": 0,
+        }
+        # scraped /metrics.json nests per-session metrics under "obs";
+        # a raw ObsRegistry.snapshot() has them at top level
+        sessions_map = (
+            (snap.get("obs") or {}).get("sessions")
+            or snap.get("sessions") or {}
+        )
+        for s in sessions_map.values():
+            st = (s or {}).get("stream")
+            if not isinstance(st, dict):
+                continue
+            ev = st.get("event") or {}
+            agg["sessions"] += 1
+            agg["events"] += int(ev.get("count", 0))
+            agg["deduped"] += int(st.get("deduped", 0))
+            agg["reconciled"] += int(st.get("reconciled", 0))
+            agg["repair_rows"] += int(st.get("repair_rows", 0))
+            agg["divergence_rows_max"] = max(
+                agg["divergence_rows_max"],
+                int(st.get("divergence_rows_max", 0)),
+            )
+            agg["p99_us_max"] = max(
+                agg["p99_us_max"], float(ev.get("p99_us", 0.0))
+            )
+            agg["max_us"] = max(
+                agg["max_us"], float(ev.get("max_us", 0.0))
+            )
+        procs[str(proc_id)] = agg
+        for k in ("events", "deduped", "reconciled", "repair_rows",
+                  "sessions"):
+            total[k] += agg[k]
+        for k in ("divergence_rows_max", "p99_us_max", "max_us"):
+            total[k] = max(total[k], agg[k])
+    total["procs"] = procs
+    total["dead_procs"] = dead
+    return total
+
+
+def events_per_second(
+    rollup: dict, wall_s: Optional[float]
+) -> float:
+    """Fleet-wide server-observed event throughput for a drill wall."""
+    if not wall_s or wall_s <= 0:
+        return 0.0
+    return float(rollup.get("events", 0)) / float(wall_s)

@@ -266,17 +266,15 @@ class SessionCheckpointer:
             "delta_rows_total": int(session.delta_rows_total),
         }
         if session.stream is not None:
-            # streaming config travels with the journal so a restart /
-            # migration re-arms the stream engine; the per-source dedup
-            # high-water marks are process state and do NOT travel —
-            # the wire tick cursor (which does) already totally orders
-            # the stream, so a resend lands in the CRC dedup, and
-            # cross-crash event reorder is bounded by the lockstep
-            # cursor rather than the seq map
-            meta["stream"] = {
-                "reconcile_every": int(session.stream.reconcile_every),
-                "gap_ceiling": session.stream.gap_ceiling,
-            }
+            # the FULL stream state travels with the journal (ISSUE
+            # 20): config + per-source dedup cursors + the reconcile-
+            # cadence cursor + obs counters. The wire tick/CRC cursor
+            # only dedups a resend of the LAST tick — a chaos'd
+            # retransmit arriving as a FRESH tick after a migration
+            # handoff would double-apply without the seq cursors at
+            # the target. The gap tracker / divergence baseline are
+            # rebased exactly from the restored arena at re-arm.
+            meta["stream"] = session.stream.export_state()
         if state is not None:
             meta["arena"] = {
                 "warm_solves": state.pop("warm_solves"),
@@ -458,19 +456,16 @@ class SessionCheckpointer:
         )
         stream_meta = meta.get("stream")
         if stream_meta and arena._p4t is not None:
-            # re-arm the stream engine over the restored warm arena; a
-            # carry that degraded to cold (no arena state) stays a
-            # batch session — the client's ladder re-opens with
-            # stream_mode, an honest degrade rather than an unprimed
-            # engine
+            # re-arm the stream engine over the restored warm arena
+            # with the FULL exported state (dedup cursors, cadence
+            # cursor, counters — see StreamEngine.from_state); a carry
+            # that degraded to cold (no arena state) stays a batch
+            # session — the client's ladder re-opens with stream_mode,
+            # an honest degrade rather than an unprimed engine
             from protocol_tpu.stream.engine import StreamEngine
 
-            session.stream = StreamEngine(
-                arena, CostWeights(*meta["weights"]),
-                reconcile_every=int(
-                    stream_meta.get("reconcile_every", 256)
-                ),
-                gap_ceiling=stream_meta.get("gap_ceiling"),
+            session.stream = StreamEngine.from_state(
+                arena, CostWeights(*meta["weights"]), stream_meta
             )
         # fresh object, not yet visible to any store: no lock exists yet
         session.delta_rows_total = int(meta.get("delta_rows_total", 0))  # lint: unlocked-ok (fresh object)

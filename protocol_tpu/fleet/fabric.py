@@ -203,6 +203,17 @@ class SessionFabric:
         self._blackout_lock = make_lock("blackout")
         self._blackout: dict[int, int] = {}  # shard index -> refusals left
         self.blackout_refusals_served = 0
+        # ---- blackout x stream composition (ISSUE 20 satellite). The
+        # refusal counter above is the whole story for BATCH sessions,
+        # but a regional blackout should also take providers off the
+        # grid — and the refusal path emits no leave events, so stream
+        # sessions would never hear about it. Arming a blackout can now
+        # carry a seeded leave-storm schedule (dstream.fanout.
+        # blackout_storm_schedule); the drill driver drains it and fans
+        # mass leave events into every session's firehose, so blackout
+        # drills exercise the stream path, not just the retry ladder.
+        self._blackout_storms: list[dict] = []
+        self.blackout_storms_armed = 0
         # optional let-go observer (the servicer's checkpoint GC): fires
         # for EVERY store let-go path with its reason, under the owning
         # shard's lock — leaf work only, same contract as on_evict
@@ -236,12 +247,28 @@ class SessionFabric:
                 )
         return self.shards[idx].get(session_id, fingerprint)
 
-    def blackout(self, shard: int, refusals: int) -> None:
+    def blackout(self, shard: int, refusals: int, storm=None) -> None:
         """Black out one shard for the next ``refusals`` lookups (the
         chaos plane's store-level fault). Deterministic by construction:
-        counted in lookups, not wall-clock."""
+        counted in lookups, not wall-clock.
+
+        ``storm`` optionally attaches a seeded leave-storm schedule
+        (``dstream.fanout.blackout_storm_schedule``): the blackout then
+        also represents providers leaving the grid, and the drill
+        driver drains the schedule (:meth:`drain_storms`) to fan mass
+        leave events into every stream session's firehose."""
         with self._blackout_lock:
             self._blackout[int(shard) % self.n_shards] = int(refusals)
+            if storm is not None:
+                self._blackout_storms.append(dict(storm))
+                self.blackout_storms_armed += 1
+
+    def drain_storms(self) -> list:
+        """Pop every armed leave-storm schedule (drill-driver seam:
+        each schedule is fanned out exactly once)."""
+        with self._blackout_lock:
+            storms, self._blackout_storms = self._blackout_storms, []
+            return storms
 
     def drop(self, session_id: str) -> None:
         self.shard_of(session_id).drop(session_id)
@@ -306,6 +333,7 @@ class SessionFabric:
             "expirations": self.expirations,
             "evictions_by_tenant": by_tenant,
             "blackout_refusals_served": self.blackout_refusals_served,
+            "blackout_storms_armed": self.blackout_storms_armed,
         }
 
     # ---------------- budget accounting ----------------

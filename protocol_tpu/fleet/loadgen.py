@@ -1286,8 +1286,39 @@ def _run_load_processes(
     return report
 
 
+class _EventDrillCtl:
+    """Shared state between the event drill controller and the event
+    drivers: per-driver progress (the drill trigger), the client-side
+    chaos'd delivery schedule, and the storm ledger — fleet-level
+    events (detector ejections, mass blackouts) the controller posts
+    and every driver fans into its own session's firehose as leave
+    events at the sentinel seq tier (``dstream.fanout``)."""
+
+    def __init__(self, schedule=None, topology=None):
+        self.schedule = schedule      # FaultSchedule (client delivery)
+        self.topology = topology      # initial ring (source homing)
+        self._lock = threading.Lock()
+        self._storms: list[dict] = []
+        self.events_done: dict[str, int] = {}
+
+    def post(self, storm: dict) -> None:
+        with self._lock:
+            self._storms.append(dict(storm))
+
+    def storms_from(self, cursor: int) -> list:
+        with self._lock:
+            return list(self._storms[cursor:])
+
+    def progress(self, sid: str, n: int) -> None:
+        self.events_done[sid] = n
+
+    def min_progress(self, sids) -> int:
+        done = [self.events_done.get(s, 0) for s in sids]
+        return min(done) if done else 0
+
+
 def _drive_event_session(
-    address: str,
+    address,
     trace,
     sid: str,
     kernel: str,
@@ -1295,14 +1326,39 @@ def _drive_event_session(
     reconcile_every: int,
     out: dict,
     rpc_timeout_s: float = 600.0,
+    ctl=None,
+    max_retries: int = 20,
+    capture_final: bool = False,
 ) -> None:
     """One OPEN-LOOP event stream over a real wire session: events are
     sent at their trace-scheduled ``at_us`` offsets (never gated on the
     previous answer's completion — lateness is measured, not absorbed),
     through the stream session protocol (stream_mode OpenSession +
-    event-typed AssignDelta ticks)."""
+    event-typed AssignDelta ticks).
+
+    ``address`` may be an ORDERED endpoint list (the dfleet failover
+    ladder): the full ``_drive_session`` refusal ladder applies per
+    event — RESOURCE_EXHAUSTED backoff, ``moved:`` rebind (live stream
+    migration), evicted resend, handoff-wait rotate, reopen as the
+    last rung (the drill bar is ZERO reopens: the checkpointed stream
+    state must make every failover warm).
+
+    ``ctl`` arms the distributed drill plane: its chaos schedule
+    yields a chaos'd client-side DELIVERY order (drops→retransmits,
+    dups, reorders — every re-delivery is a fresh wire tick, so the
+    server's event-seq dedup, not tick CRC, must absorb it), and its
+    storm ledger injects fleet-level leave events (ejection storms,
+    mass blackouts) at the head of the remaining queue. Injected
+    storms and their seqs are recorded in ``out["injected"]`` in
+    first-send order so the fault-free baseline replay can apply the
+    identical event multiset.
+
+    ``capture_final`` pads the tail to the next reconcile boundary
+    (``dstream.pad_event`` no-ops) and records the final RECONCILED
+    plan in ``out["final_p4t"]`` — the bit-identity witness."""
     import grpc as _grpc
 
+    from protocol_tpu.dstream import fanout as _fan
     from protocol_tpu.proto import scheduler_pb2 as pb
     from protocol_tpu.proto import wire
     from protocol_tpu.services.scheduler_grpc import (
@@ -1311,87 +1367,298 @@ def _drive_event_session(
     from protocol_tpu.stream.events import event_from_delta
     from protocol_tpu.trace import format as tfmt
 
+    endpoints = (
+        [str(a) for a in address]
+        if isinstance(address, (list, tuple)) else [str(address)]
+    )
+    ep_i = 0
+    client = SchedulerBackendClient(endpoints[ep_i])
+
+    def rebind(endpoint: Optional[str] = None):
+        nonlocal client, ep_i
+        if endpoint:
+            if endpoint not in endpoints:
+                endpoints.append(endpoint)
+            ep_i = endpoints.index(endpoint)
+        try:
+            client.close()
+        except Exception:
+            pass
+        client = SchedulerBackendClient(endpoints[ep_i])
+
+    def send(call, transport_attempts: int = 60):
+        nonlocal ep_i
+        for attempt in range(transport_attempts):
+            try:
+                return call(client)
+            except _grpc.RpcError:
+                if attempt + 1 >= transport_attempts:
+                    raise
+                out["transport_retries"] = (
+                    out.get("transport_retries", 0) + 1
+                )
+                time.sleep(0.02 * min(attempt + 1, 10))
+                if attempt >= 1 and len(endpoints) > 1:
+                    ep_i = (ep_i + 1) % len(endpoints)
+                    out["failovers"] = out.get("failovers", 0) + 1
+                rebind()
+
     snap = trace.snapshot
     events = [event_from_delta(d) for d in trace.deltas]
-    client = SchedulerBackendClient(address)
-    try:
-        req = _request_v2(snap, snap.p_cols, snap.r_cols, kernel)
+    if any(ev is None for ev in events):
+        out["error"] = "trace is not a stream trace"
+        client.close()
+        return
+    # cumulative column state (events are full-state for their rows):
+    # the reopen rung's authority, and the payload source for storm
+    # leave events (snapshot values, valid=False)
+    p_cum = {k: np.array(v, copy=True) for k, v in snap.p_cols.items()}
+    r_cum = {k: np.array(v, copy=True) for k, v in snap.r_cols.items()}
+    w = tfmt._as_ns(dict(zip(
+        ("price", "load", "proximity", "priority"), snap.weights
+    )))
+
+    def _open_stream(p_cols, r_cols):
+        req = _request_v2(snap, p_cols, r_cols, kernel)
         req.stream_mode = True
         req.reconcile_every = int(reconcile_every)
-        w = tfmt._as_ns(dict(zip(
-            ("price", "load", "proximity", "priority"), snap.weights
-        )))
-        fp = wire.epoch_fingerprint(
-            snap.p_cols, snap.r_cols, w, kernel,
+        new_fp = wire.epoch_fingerprint(
+            p_cols, r_cols, w, kernel,
             max(int(snap.top_k) or 64, 1), snap.eps, snap.max_iters,
         )
-        chunks = list(wire.chunk_snapshot(sid, fp, req))
-        resp = client.open_session(iter(chunks), timeout=rpc_timeout_s)
+        chunks = list(wire.chunk_snapshot(sid, new_fp, req))
+        resp = send(lambda c: c.open_session(
+            iter(chunks), timeout=rpc_timeout_s
+        ))
         if not resp.ok:
-            out["error"] = f"open refused: {resp.error}"
+            return None, resp.error
+        return new_fp, ""
+
+    # client-side chaos'd delivery order: drops become retransmits,
+    # dups second copies, reorders late arrivals — every index is
+    # delivered at least once, and every delivery is a fresh tick
+    if ctl is not None and ctl.schedule is not None:
+        from protocol_tpu.faults.plan import event_delivery_order
+
+        order = event_delivery_order(
+            ctl.schedule, len(events), site=f"events/{sid}"
+        )
+    else:
+        order = list(range(len(events)))
+
+    try:
+        fp, err = _open_stream(snap.p_cols, snap.r_cols)
+        if fp is None:
+            out["error"] = f"open refused: {err}"
             return
         t_start = time.perf_counter()
-        tick = 0
+        server_tick = 0
         walls_us: list = []
+        injected: list = []
         lag_us_max = 0.0
         gap_max = 0.0
         reconciles = deduped = late = 0
         window_max = 0
-        for ev in events:
-            if ev is None:
-                out["error"] = "trace is not a stream trace"
-                return
-            # open-loop: wait for the scheduled arrival, then send —
-            # lateness (the service running behind the schedule) is
-            # recorded, never silently absorbed into service time
-            target = t_start + ev.at_us / 1e6
-            now = time.perf_counter()
-            if now < target:
-                time.sleep(target - now)
+        window_last = 0
+        storm_cursor = 0
+        storm_events = 0
+        pad_i = 0
+        first_sent: set = set()
+        last_recon_p4t = None
+
+        def _mint(storm) -> list:
+            if storm.get("kind") == "ejection":
+                rows = _fan.affected_rows(
+                    ctl.topology, sid, storm["dead_proc"],
+                    len(next(iter(p_cum.values()))),
+                )
+                return _fan.ejection_leave_events(
+                    storm["generation"], rows, snap.p_cols
+                )
+            rows = np.asarray(storm.get("rows", ()), np.int32)
+            return _fan.mass_leave_events(
+                int(storm.get("mass_index", 0)), rows, snap.p_cols
+            )
+
+        def _send_event(ev):
+            """Full refusal ladder for ONE event delivery. Returns the
+            response, or None after an irrecoverable refusal (error is
+            set). Folds applied full-state rows into the cumulative
+            columns (dedup-ACKed deliveries are NOT folded: a reordered
+            stale event would regress the authority)."""
+            nonlocal server_tick, fp
+            nonlocal reconciles, deduped, gap_max
+            nonlocal window_max, window_last, last_recon_p4t
+            evict_retried = False
+            for retry in range(max_retries):
+                dreq = pb.AssignDeltaRequest(
+                    session_id=sid, epoch_fingerprint=fp,
+                    tick=server_tick + 1,
+                    event_source=ev.source, event_seq=int(ev.seq),
+                    event_kind=ev.kind,
+                )
+                if ev.provider_rows.size:
+                    dreq.provider_rows.CopyFrom(
+                        wire.blob(ev.provider_rows, np.int32)
+                    )
+                    dreq.providers.CopyFrom(
+                        wire.encode_providers_v2(tfmt._as_ns(ev.p_cols))
+                    )
+                if ev.task_rows.size:
+                    dreq.task_rows.CopyFrom(
+                        wire.blob(ev.task_rows, np.int32)
+                    )
+                    dreq.requirements.CopyFrom(
+                        wire.encode_requirements_v2(
+                            tfmt._as_ns(ev.r_cols)
+                        )
+                    )
+                r = send(lambda c: c.assign_delta(
+                    dreq, timeout=rpc_timeout_s
+                ))
+                if r.session_ok:
+                    server_tick += 1
+                    if r.replayed:
+                        out["replayed"] = out.get("replayed", 0) + 1
+                    reconciles += int(r.reconciled)
+                    deduped += int(r.event_deduped)
+                    gap_max = max(gap_max, float(r.gap_per_task))
+                    window_last = int(r.events_since_reconcile)
+                    window_max = max(window_max, window_last)
+                    if not r.event_deduped:
+                        if ev.provider_rows.size:
+                            for name, a in ev.p_cols.items():
+                                p_cum[name][ev.provider_rows] = (
+                                    np.asarray(a)
+                                )
+                        if ev.task_rows.size:
+                            for name, a in ev.r_cols.items():
+                                r_cum[name][ev.task_rows] = (
+                                    np.asarray(a)
+                                )
+                    if r.reconciled:
+                        last_recon_p4t = wire.unblob(
+                            r.result.provider_for_task, np.int32
+                        )
+                    out["assigned_last"] = int(r.result.num_assigned)
+                    return r
+                out["refused"] = out.get("refused", 0) + 1
+                if "RESOURCE_EXHAUSTED" in r.error:
+                    time.sleep(0.01 * (retry + 1))
+                    continue
+                if r.error.startswith("moved:"):
+                    # live stream migration: the engine is re-armed
+                    # WARM at the new home (dedup cursors + cadence
+                    # travel in the checkpoint) — rebind and resend
+                    out["moved_redirects"] = (
+                        out.get("moved_redirects", 0) + 1
+                    )
+                    rebind(r.error[len("moved:"):].strip())
+                    continue
+                if "session evicted" in r.error and not evict_retried:
+                    evict_retried = True
+                    continue
+                if (
+                    "unknown session" in r.error
+                    and len(endpoints) > 1
+                    and retry + 1 < max_retries
+                ):
+                    out["handoff_waits"] = (
+                        out.get("handoff_waits", 0) + 1
+                    )
+                    time.sleep(0.02 * (retry + 1))
+                    rebind_idx()
+                    continue
+                # last rung: reopen from the cumulative columns (the
+                # drill bar is zero of these — stream state travels)
+                out["reopens"] = out.get("reopens", 0) + 1
+                out["verify_stopped"] = True
+                fp2, err2 = None, ""
+                for dr in range(max_retries):
+                    fp2, err2 = _open_stream(p_cum, r_cum)
+                    if fp2 is not None or "draining" not in (
+                        err2 or ""
+                    ):
+                        break
+                    time.sleep(0.05 * (dr + 1))
+                if fp2 is None:
+                    out["error"] = f"re-open refused: {err2}"
+                    return None
+                fp = fp2
+                server_tick = 0
+                # fall through: the next retry resends this event as
+                # tick 1 of the re-grounded session
+            out["error"] = (
+                f"event still refused after {max_retries} "
+                f"retries: {r.error}"
+            )
+            return None
+
+        def rebind_idx():
+            nonlocal ep_i
+            ep_i = (ep_i + 1) % len(endpoints)
+            rebind()
+
+        from collections import deque as _deque
+
+        pending: "_deque" = _deque()
+        i = 0
+        sent = 0
+        while i < len(order) or pending:
+            if ctl is not None:
+                storms = ctl.storms_from(storm_cursor)
+                if storms:
+                    storm_cursor += len(storms)
+                    for storm in storms:
+                        leaves = _mint(storm)
+                        pending.extend(leaves)
+                        injected.extend(leaves)
+            if pending:
+                ev = pending.popleft()
+                storm_events += 1
             else:
-                lag_us_max = max(lag_us_max, (now - target) * 1e6)
-                late += 1
-            tick += 1
-            dreq = pb.AssignDeltaRequest(
-                session_id=sid, epoch_fingerprint=fp, tick=tick,
-                event_source=ev.source, event_seq=int(ev.seq),
-                event_kind=ev.kind,
-            )
-            if ev.provider_rows.size:
-                dreq.provider_rows.CopyFrom(
-                    wire.blob(ev.provider_rows, np.int32)
-                )
-                dreq.providers.CopyFrom(
-                    wire.encode_providers_v2(tfmt._as_ns(ev.p_cols))
-                )
-            if ev.task_rows.size:
-                dreq.task_rows.CopyFrom(
-                    wire.blob(ev.task_rows, np.int32)
-                )
-                dreq.requirements.CopyFrom(
-                    wire.encode_requirements_v2(tfmt._as_ns(ev.r_cols))
-                )
+                idx = order[i]
+                i += 1
+                ev = events[idx]
+                if idx not in first_sent:
+                    first_sent.add(idx)
+                    # open-loop: wait for the scheduled arrival —
+                    # lateness is recorded, never absorbed. Chaos
+                    # re-deliveries (dups/retransmits) go immediately.
+                    target = t_start + ev.at_us / 1e6
+                    now = time.perf_counter()
+                    if now < target:
+                        time.sleep(target - now)
+                    else:
+                        lag_us_max = max(
+                            lag_us_max, (now - target) * 1e6
+                        )
+                        late += 1
             t0 = time.perf_counter()
-            try:
-                r = client.assign_delta(dreq, timeout=rpc_timeout_s)
-            except _grpc.RpcError as e:
-                out["error"] = f"delta rpc failed: {e.code()}"
+            r = _send_event(ev)
+            if r is None:
                 return
-            rpc_us = (time.perf_counter() - t0) * 1e6
-            if not r.session_ok:
-                out["error"] = f"delta refused: {r.error}"
-                return
-            reconciles += int(r.reconciled)
-            deduped += int(r.event_deduped)
-            gap_max = max(gap_max, float(r.gap_per_task))
-            window_max = max(
-                window_max, int(r.events_since_reconcile)
-            )
             if not r.reconciled:
-                walls_us.append(rpc_us)
-            out["assigned_last"] = int(r.result.num_assigned)
+                walls_us.append((time.perf_counter() - t0) * 1e6)
+            sent += 1
+            if ctl is not None:
+                ctl.progress(sid, sent)
+        if capture_final:
+            # pad to the next reconcile boundary: the final answer
+            # must be a RECONCILED plan (full solve of the converged
+            # columns) for the bit-identity comparison
+            while window_last > 0 and pad_i <= reconcile_every + 2:
+                r = _send_event(_fan.pad_event(pad_i))
+                if r is None:
+                    return
+                pad_i += 1
+                sent += 1
+            out["final_p4t"] = last_recon_p4t
         out["wall_s"] = time.perf_counter() - t_start
-        out["events"] = tick
+        out["events"] = sent
+        out["storm_events"] = storm_events
+        out["pad_events"] = pad_i
+        out["injected"] = injected
         out["walls_us"] = walls_us
         out["reconciles"] = reconciles
         out["deduped"] = deduped
@@ -1399,82 +1666,22 @@ def _drive_event_session(
         out["window_max"] = window_max
         out["late_events"] = late
         out["lag_us_max"] = round(lag_us_max, 1)
+    except Exception as e:  # surfaced in the report, never swallowed
+        out["error"] = f"{type(e).__name__}: {e}"
     finally:
         client.close()
 
 
-def run_events(
-    sessions: int = 4,
-    tenants: int = 2,
-    providers: int = 512,
-    tasks: int = 512,
-    events: int = 128,
-    rate_hz: float = 200.0,
-    kernel: str = "native-mt:1",
-    reconcile_every: int = 64,
-    shards: int = 4,
-    max_workers: int = 16,
-    seed: int = 0,
-    rpc_timeout_s: float = 600.0,
-) -> dict:
-    """The open-loop EVENT arrival mode (``--events``): H concurrent
-    stream sessions each replaying a seeded synthetic event trace
-    against one real servicer at its deterministic arrival schedule.
-    Reports events/sec, per-event p50/p99 µs (client-observed RPC wall,
-    reconcile answers excluded — they are full solves and reported
-    separately), and the divergence/reconcile counters per tenant."""
-    from protocol_tpu.fleet.fabric import FleetConfig
-    from protocol_tpu.obs.metrics import LatencyHistogram, tenant_of as _t
-    from protocol_tpu.services.scheduler_grpc import serve
-    from protocol_tpu.trace import format as tfmt
-    from protocol_tpu.trace.synth import synth_event_trace
+_EVENT_LADDER_KEYS = (
+    "refused", "transport_retries", "failovers", "moved_redirects",
+    "handoff_waits", "reopens", "replayed",
+)
 
-    sessions = int(sessions)
-    tenants = max(1, min(int(tenants), sessions))
-    tmpdir = tempfile.TemporaryDirectory(prefix="fleet_events_")
-    traces = []
-    try:
-        for i in range(sessions):
-            traces.append(tfmt.read_trace(synth_event_trace(
-                os.path.join(tmpdir.name, f"s{i}.trace"),
-                n_providers=providers, n_tasks=tasks, events=events,
-                seed=seed + i, kernel=kernel, rate_hz=rate_hz,
-                reconcile_every=reconcile_every,
-            )))
-        port = _free_port()
-        address = f"127.0.0.1:{port}"
-        server = serve(
-            address,
-            max_workers=max_workers,
-            max_sessions=max(sessions, 8),
-            fleet=FleetConfig(shards=shards),
-        )
-        outs = [dict() for _ in range(sessions)]
-        sids = [f"t{i % tenants}@es{i}" for i in range(sessions)]
-        t_wall = time.perf_counter()
-        try:
-            threads = [
-                threading.Thread(
-                    target=_drive_event_session,
-                    args=(
-                        address, trace, sid, kernel, rate_hz,
-                        reconcile_every, out,
-                    ),
-                    kwargs=dict(rpc_timeout_s=rpc_timeout_s),
-                    name=f"events-{sid}",
-                )
-                for trace, sid, out in zip(traces, sids, outs)
-            ]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
-            wall_s = time.perf_counter() - t_wall
-            obs_snapshot = server.servicer.obs.snapshot()
-        finally:
-            server.stop(grace=None)
-    finally:
-        tmpdir.cleanup()
+
+def _aggregate_event_outs(sids, outs):
+    """Per-tenant join of the event drivers' ``out`` dicts (shared by
+    the single-process and distributed harnesses)."""
+    from protocol_tpu.obs.metrics import LatencyHistogram, tenant_of as _t
 
     by_tenant: dict[str, dict] = {}
     errors = []
@@ -1488,7 +1695,8 @@ def run_events(
             "hist": LatencyHistogram(lowest_ns=100.0),
             "events": 0, "reconciles": 0, "deduped": 0,
             "gap_max": 0.0, "window_max": 0, "late_events": 0,
-            "assigned_last_min": None,
+            "storm_events": 0, "assigned_last_min": None,
+            **{k: 0 for k in _EVENT_LADDER_KEYS},
         })
         for us in out.get("walls_us", ()):
             agg["hist"].observe_ns(us * 1e3)
@@ -1500,6 +1708,9 @@ def run_events(
             agg["window_max"], out.get("window_max", 0)
         )
         agg["late_events"] += out.get("late_events", 0)
+        agg["storm_events"] += out.get("storm_events", 0)
+        for k in _EVENT_LADDER_KEYS:
+            agg[k] += out.get(k, 0)
         a = out.get("assigned_last")
         if a is not None:
             prev = agg["assigned_last_min"]
@@ -1517,9 +1728,595 @@ def run_events(
             "gap_max": round(agg["gap_max"], 6),
             "events_since_reconcile_max": agg["window_max"],
             "late_events": agg["late_events"],
+            "storm_events": agg["storm_events"],
             "assigned_last_min": agg["assigned_last_min"],
+            **{k: agg[k] for k in _EVENT_LADDER_KEYS},
         }
+    return tenants_out, errors, total_events
+
+
+def _event_baseline_p4t(
+    trace_path, kernel: str, reconcile_every: int, extra_events
+):
+    """Fault-free ground truth for a chaos'd / storm-injected stream
+    session: the in-process replay of the SAME trace with the SAME
+    injected events appended in-order, final full-solve reconcile.
+    Per-source latest-wins plus storms at the sentinel seq tier make
+    the converged columns — and therefore the reconciled plan —
+    independent of where chaos interleaved the deliveries."""
+    from protocol_tpu.stream.replay import stream_replay
+
+    eng, _, th = str(kernel).partition(":")
+    rep = stream_replay(
+        str(trace_path), engine=eng,
+        threads=int(th) if th else None,
+        reconcile_every=int(reconcile_every), verify=False,
+        final_reconcile=True, keep_recon_p4ts=True,
+        extra_events=list(extra_events or ()),
+    )
+    p4ts = rep.get("recon_p4ts") or []
+    return p4ts[-1] if p4ts else None
+
+
+def _event_bit_identity(paths, sids, outs, kernel, reconcile_every):
+    """Compare every driver's final reconciled plan against the
+    fault-free baseline. Baselines are cached by (trace, injected
+    seqs): the injected payloads are pure functions of (trace
+    snapshot, source, seq), so equal keys mean equal baselines."""
+    checked = mismatches = skipped = 0
+    mismatched = []
+    cache: dict = {}
+    for tp, sid, out in zip(paths, sids, outs):
+        if (
+            out.get("error") or out.get("verify_stopped")
+            or out.get("final_p4t") is None
+        ):
+            skipped += 1
+            continue
+        key = (str(tp), tuple(
+            (e.source, int(e.seq)) for e in out.get("injected") or ()
+        ))
+        if key not in cache:
+            cache[key] = _event_baseline_p4t(
+                tp, kernel, reconcile_every, out.get("injected")
+            )
+        base = cache[key]
+        checked += 1
+        if base is None or not np.array_equal(out["final_p4t"], base):
+            mismatches += 1
+            mismatched.append(sid)
     return {
+        "checked": checked,
+        "mismatches": mismatches,
+        "skipped": skipped,
+        "mismatched_sessions": mismatched,
+    }
+
+
+def _trace_sources(trace) -> int:
+    """Distinct event sources in a stream trace (the denominator of
+    the zero-dropped-sources acceptance bar)."""
+    from protocol_tpu.stream.events import event_from_delta
+
+    srcs = set()
+    for d in trace.deltas:
+        ev = event_from_delta(d)
+        if ev is not None:
+            srcs.add(ev.source)
+    return len(srcs)
+
+
+def _run_events_processes(
+    sessions: int,
+    tenants: int,
+    providers: int,
+    tasks: int,
+    events: int,
+    rate_hz: float,
+    kernel: str,
+    reconcile_every: int,
+    shards: int,
+    max_workers: int,
+    seed: int,
+    rpc_timeout_s: float,
+    processes: int,
+    chaos=None,
+    detect: bool = False,
+    detector_period_s: float = 0.25,
+    ckpt_dir=None,
+    ckpt_every: int = 1,
+    max_retries: int = 20,
+    trace_path=None,
+    mass_at_event=None,
+    mass_frac: float = 0.1,
+) -> dict:
+    """The DISTRIBUTED event firehose (``--events --processes N``):
+    every session is a stream-mode wire session homed by the ring on
+    one of N real servicer subprocesses; drivers run the full failover
+    ladder per event. The chaos spec arms three planes at once —
+    client-side chaos'd DELIVERY (drop/dup/reorder of event sends,
+    absorbed by server-side event-seq dedup), the scripted process
+    drill (``kill_proc_at_tick`` = SIGKILL after that many EVENTS per
+    session, ``migrate_at_tick`` = live migration + drain), and each
+    process's own seeded interceptor. A kill translates into an
+    EJECTION STORM: one leave event per source homed on the corpse,
+    injected into every surviving session's firehose at the sentinel
+    seq tier and absorbed online (O(churned rows) per event). A
+    ``mass_at_event`` trigger composes the ``faults/`` blackout shape
+    into a fleet-wide mass leave event. The report carries fleet-wide
+    events/sec, per-event p99 µs, the stream rollup joined from every
+    process's scrape, and the bit-identity verdict of every session's
+    final reconciled plan against its fault-free baseline replay."""
+    from protocol_tpu.dfleet.manager import ProcessFleet
+    from protocol_tpu.dstream import fanout as _fan
+    from protocol_tpu.dstream.rollup import stream_rollup
+    from protocol_tpu.faults.plan import ChaosConfig, FaultSchedule
+    from protocol_tpu.trace import format as tfmt
+    from protocol_tpu.trace.synth import synth_event_trace
+
+    chaos_cfg = (
+        ChaosConfig.from_spec(chaos) if isinstance(chaos, str)
+        else (chaos or ChaosConfig())
+    )
+    if chaos_cfg.kill_proc_at_tick is not None:
+        drill_event, drill_mode = chaos_cfg.kill_proc_at_tick, "crash"
+        drill_proc = chaos_cfg.kill_proc
+    elif chaos_cfg.migrate_at_tick is not None:
+        drill_event, drill_mode = chaos_cfg.migrate_at_tick, "drain"
+        drill_proc = chaos_cfg.migrate_proc
+    else:
+        drill_event, drill_mode = None, None
+        drill_proc = chaos_cfg.kill_proc
+    schedule = FaultSchedule(chaos_cfg) if chaos_cfg.active() else None
+
+    sessions = int(sessions)
+    tenants = max(1, min(int(tenants), sessions))
+    tmpdir = tempfile.TemporaryDirectory(prefix="dstream_loadgen_")
+    try:
+        paths = []
+        for i in range(sessions):
+            if trace_path:
+                # the gate's golden-trace mode: every session replays
+                # the SAME committed trace (identical baselines)
+                paths.append(str(trace_path))
+            else:
+                paths.append(synth_event_trace(
+                    os.path.join(tmpdir.name, f"s{i}.trace"),
+                    n_providers=providers, n_tasks=tasks,
+                    events=events, seed=seed + i, kernel=kernel,
+                    rate_hz=rate_hz, reconcile_every=reconcile_every,
+                ))
+        parsed_cache: dict = {}
+        traces = []
+        for p in paths:
+            if p not in parsed_cache:
+                parsed_cache[p] = tfmt.read_trace(p)
+            traces.append(parsed_cache[p])
+        sids = [f"t{i % tenants}@es{i}" for i in range(sessions)]
+        outs = [dict() for _ in range(sessions)]
+        sources_per_session = [
+            _trace_sources(parsed_cache[p]) for p in paths
+        ]
+
+        env_extra = {}
+        if isinstance(chaos, str) and chaos:
+            env_extra["PROTOCOL_TPU_CHAOS"] = chaos
+        fleet = ProcessFleet(
+            processes=int(processes),
+            journal_root=ckpt_dir,
+            shards=shards,
+            max_sessions=max(sessions, 8),
+            max_workers=max_workers,
+            ckpt_every=ckpt_every,
+            env_extra=env_extra,
+            discovery=True,
+        )
+        drill_report: dict = {}
+        mass_report: dict = {}
+        ctl = _EventDrillCtl(schedule=schedule)
+
+        def _wait_for_event(at, driver_threads) -> bool:
+            while True:
+                live = [
+                    s for s, o in zip(sids, outs) if not o.get("error")
+                ]
+                if not live:
+                    return False
+                if ctl.min_progress(live) >= at:
+                    return True
+                if not any(th.is_alive() for th in driver_threads):
+                    return False
+                time.sleep(0.01)
+
+        def _drill_controller(driver_threads):
+            triggers = []
+            if mass_at_event is not None:
+                triggers.append((int(mass_at_event), "mass"))
+            if drill_event is not None:
+                triggers.append((int(drill_event), drill_mode))
+            for at, mode in sorted(triggers):
+                if not _wait_for_event(at, driver_threads):
+                    return
+                if mode == "mass":
+                    sched = _fan.blackout_storm_schedule(
+                        seed, chaos_cfg.blackout_shard or 1,
+                        providers, mass_frac,
+                    )
+                    ctl.post({
+                        "kind": "mass",
+                        "mass_index": sched["mass_index"],
+                        "rows": sched["rows"],
+                    })
+                    mass_report.update(
+                        at_event=at, rows=len(sched["rows"]),
+                        shard=sched["shard"],
+                    )
+                    continue
+                # retarget to the busiest process if ring luck left
+                # the configured target idle (same rule as batch mode)
+                target = drill_proc
+                topo = fleet.topology
+                by_ep: dict = {}
+                for s in sids:
+                    ep = topo.endpoint_for(s)
+                    by_ep[ep] = by_ep.get(ep, 0) + 1
+                if by_ep and not by_ep.get(
+                    fleet.proc_at(target).address
+                ):
+                    busiest = max(by_ep, key=lambda e: by_ep[e])
+                    target = next(
+                        p.index for p in fleet.procs
+                        if p.address == busiest
+                    )
+                    drill_report["retargeted"] = True
+                pid = fleet.proc_at(target).proc_id
+                drill_report["proc"] = pid
+                if mode == "drain":
+                    # LIVE stream migration: sessions re-arm warm at
+                    # the ring successor (full stream state travels in
+                    # the checkpoint) — no storm, the sources flow on
+                    drill_report["migrated"] = fleet.migrate_all(
+                        target
+                    )
+                    fleet.drain(target)
+                    drill_report["drained"] = True
+                    continue
+                t_kill = time.perf_counter()
+                gen = None
+                if detect:
+                    # SIGKILL withOUT telling the fleet: the DETECTOR
+                    # must notice the silence and run the autonomous
+                    # ejection (topology bump + fence supersession +
+                    # journal re-route) — a scripted fleet.kill would
+                    # be removed from its watch and prove nothing
+                    fleet.kill_unannounced(target)
+                    drill_report["killed"] = True
+                    eject = None
+                    deadline = t_kill + 60.0
+                    while time.perf_counter() < deadline:
+                        eject = next(
+                            (e for e in list(fleet.ejections)
+                             if e["proc"] == pid), None,
+                        )
+                        if eject is not None:
+                            break
+                        time.sleep(0.02)
+                    if eject is not None:
+                        drill_report["ejected_by_detector"] = True
+                        drill_report["time_to_detect_s"] = round(
+                            eject["at"] - t_kill, 3
+                        )
+                        drill_report["journals_rerouted"] = eject[
+                            "journals_rerouted"
+                        ]
+                        gen = eject["generation"]
+                if gen is None:
+                    # no detector (or it never fired): driver-owned
+                    # takedown + journal re-route, the batch-mode shape
+                    fleet.kill(target)
+                    drill_report["killed"] = True
+                    moved = fleet.handoff_dead(target)
+                    drill_report["journals_rerouted"] = len(moved)
+                    gen = fleet.topology.generation
+                drill_report["generation"] = gen
+                # the ejection storm: every source homed on the corpse
+                # leaves, fanned into every session's firehose at the
+                # storm seq tier (generation-keyed, deterministic)
+                ctl.post({
+                    "kind": "ejection", "dead_proc": pid,
+                    "generation": gen,
+                })
+                drill_report["storm_posted"] = True
+
+        t_wall = time.perf_counter()
+        try:
+            fleet.start()
+            if detect:
+                fleet.start_detector(period_s=detector_period_s)
+            ctl.topology = fleet.topology
+            topo = fleet.topology
+            threads = [
+                threading.Thread(
+                    target=_drive_event_session,
+                    args=(
+                        topo.failover_order(sid), trace, sid, kernel,
+                        rate_hz, reconcile_every, out,
+                    ),
+                    kwargs=dict(
+                        rpc_timeout_s=rpc_timeout_s, ctl=ctl,
+                        max_retries=max_retries, capture_final=True,
+                    ),
+                    name=f"dstream-{sid}",
+                )
+                for trace, sid, out in zip(traces, sids, outs)
+            ]
+            if drill_event is not None or mass_at_event is not None:
+                threads.append(threading.Thread(
+                    target=_drill_controller, args=(list(threads),),
+                    name="dstream-drill",
+                ))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall_s = time.perf_counter() - t_wall
+            fleet.stop_detector()
+            detector_snap = (
+                fleet.detector.snapshot() if fleet.detector else None
+            )
+            ejection_events = list(fleet.ejections)
+            scrapes = fleet.scrape()
+            rollup = stream_rollup(scrapes)
+            topology_out = fleet.topology.to_dict()
+            for p in list(fleet.live()):
+                try:
+                    fleet.drain(p.index)
+                except Exception:
+                    pass
+            witness = fleet.witness_violations()
+        finally:
+            fleet.stop()
+
+        # fault-free baselines replay INSIDE the try: synth traces
+        # live in the tmpdir
+        bit = _event_bit_identity(
+            paths, sids, outs, kernel, reconcile_every
+        )
+    finally:
+        tmpdir.cleanup()
+
+    tenants_out, errors, total_events = _aggregate_event_outs(
+        sids, outs
+    )
+    dropped = sum(
+        n for n, o in zip(sources_per_session, outs)
+        if o.get("error")
+    )
+    report = {
+        "mode": "events",
+        "config": {
+            "sessions": sessions, "tenants": tenants,
+            "providers": providers, "tasks": tasks,
+            "events_per_session": events, "rate_hz": rate_hz,
+            "reconcile_every": reconcile_every, "kernel": kernel,
+            "shards": shards, "seed": seed,
+            "processes": int(processes),
+            "chaos": chaos if isinstance(chaos, str) else None,
+            "detect": bool(detect),
+            "trace_path": str(trace_path) if trace_path else None,
+            "mass_at_event": mass_at_event,
+        },
+        "sessions": sessions,
+        "tenants": tenants_out,
+        "wall_s": round(wall_s, 3),
+        "events_total": total_events,
+        "events_per_s": round(total_events / max(wall_s, 1e-9), 1),
+        "storm_events_total": sum(
+            o.get("storm_events", 0) for o in outs
+        ),
+        "pad_events_total": sum(o.get("pad_events", 0) for o in outs),
+        "ladder": {
+            k: sum(o.get(k, 0) for o in outs)
+            for k in _EVENT_LADDER_KEYS
+        },
+        "sources": {
+            "total": sum(sources_per_session),
+            "dropped": dropped,
+        },
+        "bit_identity": bit,
+        "errors": errors,
+        "topology": topology_out,
+        "stream_rollup": rollup,
+        "fleet_events_per_s": round(
+            rollup.get("events", 0) / max(wall_s, 1e-9), 1
+        ),
+        "witness_violations": witness,
+    }
+    if detector_snap is not None:
+        expected = (
+            {drill_report.get("proc")} if drill_report.get("killed")
+            else set()
+        )
+        report["detector"] = {
+            "snapshot": detector_snap,
+            "ejections": ejection_events,
+            "false_positive_ejections": [
+                e for e in ejection_events if e["proc"] not in expected
+            ],
+        }
+    if drill_event is not None or mass_at_event is not None:
+        report["drill"] = {
+            "mode": drill_mode, "at_event": drill_event,
+            **drill_report,
+        }
+    if mass_report:
+        report["mass"] = mass_report
+    return report
+
+
+def run_events(
+    sessions: int = 4,
+    tenants: int = 2,
+    providers: int = 512,
+    tasks: int = 512,
+    events: int = 128,
+    rate_hz: float = 200.0,
+    kernel: str = "native-mt:1",
+    reconcile_every: int = 64,
+    shards: int = 4,
+    max_workers: int = 16,
+    seed: int = 0,
+    rpc_timeout_s: float = 600.0,
+    processes: int = 1,
+    chaos=None,
+    detect: bool = False,
+    ckpt_dir=None,
+    ckpt_every: int = 1,
+    max_retries: int = 20,
+    trace_path=None,
+    mass_at_event=None,
+    mass_frac: float = 0.1,
+    blackout_shard: int = 1,
+    blackout_refusals: int = 2,
+) -> dict:
+    """The open-loop EVENT arrival mode (``--events``): H concurrent
+    stream sessions each replaying a seeded synthetic event trace
+    against real servicer(s) at its deterministic arrival schedule.
+    Reports events/sec, per-event p50/p99 µs (client-observed RPC wall,
+    reconcile answers excluded — they are full solves and reported
+    separately), and the divergence/reconcile counters per tenant.
+
+    ``processes > 1`` switches to the DISTRIBUTED firehose harness
+    (:func:`_run_events_processes`): ring-routed sessions over N real
+    servicer subprocesses, chaos'd delivery, the kill/migrate drills,
+    ejection storms, and per-session bit-identity verdicts.
+
+    ``mass_at_event`` composes the ``faults/`` blackout with the
+    stream plane in-process: once every session has sent that many
+    events, the harness arms ``SessionFabric.blackout`` on
+    ``blackout_shard`` WITH a seeded leave-storm schedule, drains it,
+    and fans the mass leave events into every session's firehose —
+    the blackout drill exercises the stream path, not just the
+    RESOURCE_EXHAUSTED retry ladder."""
+    if int(processes) > 1:
+        return _run_events_processes(
+            sessions, tenants, providers, tasks, events, rate_hz,
+            kernel, reconcile_every, shards, max_workers, seed,
+            rpc_timeout_s, int(processes), chaos=chaos, detect=detect,
+            ckpt_dir=ckpt_dir, ckpt_every=ckpt_every,
+            max_retries=max_retries, trace_path=trace_path,
+            mass_at_event=mass_at_event, mass_frac=mass_frac,
+        )
+    from protocol_tpu.dstream import fanout as _fan
+    from protocol_tpu.fleet.fabric import FleetConfig
+    from protocol_tpu.services.scheduler_grpc import serve
+    from protocol_tpu.trace import format as tfmt
+    from protocol_tpu.trace.synth import synth_event_trace
+
+    sessions = int(sessions)
+    tenants = max(1, min(int(tenants), sessions))
+    tmpdir = tempfile.TemporaryDirectory(prefix="fleet_events_")
+    mass_armed = mass_at_event is not None
+    ctl = _EventDrillCtl() if mass_armed else None
+    mass_report: dict = {}
+    try:
+        paths, traces = [], []
+        for i in range(sessions):
+            p = synth_event_trace(
+                os.path.join(tmpdir.name, f"s{i}.trace"),
+                n_providers=providers, n_tasks=tasks, events=events,
+                seed=seed + i, kernel=kernel, rate_hz=rate_hz,
+                reconcile_every=reconcile_every,
+            ) if not trace_path else str(trace_path)
+            paths.append(p)
+            traces.append(tfmt.read_trace(p))
+        port = _free_port()
+        address = f"127.0.0.1:{port}"
+        server = serve(
+            address,
+            max_workers=max_workers,
+            max_sessions=max(sessions, 8),
+            fleet=FleetConfig(shards=shards),
+        )
+        outs = [dict() for _ in range(sessions)]
+        sids = [f"t{i % tenants}@es{i}" for i in range(sessions)]
+
+        def _mass_controller(driver_threads):
+            while True:
+                live = [
+                    s for s, o in zip(sids, outs) if not o.get("error")
+                ]
+                if not live:
+                    return
+                if ctl.min_progress(live) >= int(mass_at_event):
+                    break
+                if not any(th.is_alive() for th in driver_threads):
+                    return
+                time.sleep(0.005)
+            # arm the blackout WITH its leave-storm schedule, then
+            # drain and fan out — the full satellite composition path
+            sched = _fan.blackout_storm_schedule(
+                seed, blackout_shard, providers, mass_frac
+            )
+            server.servicer.sessions.blackout(
+                blackout_shard, blackout_refusals, storm=sched
+            )
+            for storm in server.servicer.sessions.drain_storms():
+                ctl.post({
+                    "kind": "mass",
+                    "mass_index": storm["mass_index"],
+                    "rows": storm["rows"],
+                })
+            mass_report.update(
+                at_event=int(mass_at_event),
+                rows=len(sched["rows"]), shard=sched["shard"],
+                refusals_armed=blackout_refusals,
+            )
+
+        t_wall = time.perf_counter()
+        try:
+            threads = [
+                threading.Thread(
+                    target=_drive_event_session,
+                    args=(
+                        address, trace, sid, kernel, rate_hz,
+                        reconcile_every, out,
+                    ),
+                    kwargs=dict(
+                        rpc_timeout_s=rpc_timeout_s, ctl=ctl,
+                        max_retries=max_retries,
+                        capture_final=mass_armed,
+                    ),
+                    name=f"events-{sid}",
+                )
+                for trace, sid, out in zip(traces, sids, outs)
+            ]
+            if mass_armed:
+                threads.append(threading.Thread(
+                    target=_mass_controller, args=(list(threads),),
+                    name="events-mass",
+                ))
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wall_s = time.perf_counter() - t_wall
+            obs_snapshot = server.servicer.obs.snapshot()
+            fabric_snapshot = server.servicer.sessions.snapshot()
+        finally:
+            server.stop(grace=None)
+        bit = (
+            _event_bit_identity(
+                paths, sids, outs, kernel, reconcile_every
+            ) if mass_armed else None
+        )
+    finally:
+        tmpdir.cleanup()
+
+    tenants_out, errors, total_events = _aggregate_event_outs(
+        sids, outs
+    )
+    report = {
         "mode": "events",
         "sessions": sessions,
         "tenants": tenants_out,
@@ -1538,7 +2335,15 @@ def run_events(
             for sid, v in obs_snapshot.get("sessions", {}).items()
             if v.get("stream")
         },
+        "fabric": fabric_snapshot,
     }
+    if mass_armed:
+        report["mass"] = mass_report
+        report["bit_identity"] = bit
+        report["storm_events_total"] = sum(
+            o.get("storm_events", 0) for o in outs
+        )
+    return report
 
 
 def _print_report(rep: dict) -> None:
@@ -1732,6 +2537,15 @@ def main(argv=None) -> int:
     ap.add_argument("--reconcile-every", type=int, default=64,
                     help="event mode: full-solve reconciliation "
                          "cadence (events)")
+    ap.add_argument("--mass-at-event", type=int, default=None,
+                    help="event mode: once every session has sent "
+                         "this many events, arm a shard blackout WITH "
+                         "its seeded leave-storm schedule and fan the "
+                         "mass leave events into every session's "
+                         "firehose (faults x stream composition)")
+    ap.add_argument("--mass-frac", type=float, default=0.1,
+                    help="fraction of provider rows a mass event "
+                         "takes down")
     ap.add_argument("--out", default=None, help="write the JSON report")
     ap.add_argument("--smoke", action="store_true",
                     help="exit non-zero unless every session completed "
@@ -1749,6 +2563,12 @@ def main(argv=None) -> int:
             kernel=args.kernel, reconcile_every=args.reconcile_every,
             shards=args.shards, max_workers=args.max_workers,
             seed=args.seed, rpc_timeout_s=args.rpc_timeout,
+            processes=args.processes, chaos=args.chaos,
+            detect=args.detect, ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every, max_retries=args.max_retries,
+            trace_path=(args.trace[0] if args.trace else None),
+            mass_at_event=args.mass_at_event,
+            mass_frac=args.mass_frac,
         )
         print(json.dumps(rep, indent=1, sort_keys=True))
         if args.out:
@@ -1764,12 +2584,56 @@ def main(argv=None) -> int:
                     # small synth populations seat ~90% even COLD
                     # (infeasible tasks); the smoke bar is "the stream
                     # did not bleed assignments", not "the marketplace
-                    # is saturated"
+                    # is saturated". When a bit-identity verdict
+                    # exists the final plan IS the fault-free plan —
+                    # that bar subsumes this one (storms legitimately
+                    # unseat the stormed rows' tasks).
                     a["assigned_last_min"] < 0.85 * args.tasks
+                    and rep.get("bit_identity") is None
+                    and rep.get("storm_events_total", 0) == 0
                 ):
                     bad.append(
                         {"tenant": t, "error": "assigned < 0.85"}
                     )
+            ladder = rep.get("ladder") or {}
+            reopens = ladder.get("reopens", sum(
+                a.get("reopens", 0) for a in rep["tenants"].values()
+            ))
+            if reopens:
+                bad.append({"error": (
+                    f"{reopens} full-snapshot reopens — stream "
+                    "failover was not warm"
+                )})
+            bit = rep.get("bit_identity")
+            if bit and bit["mismatches"]:
+                bad.append({"error": (
+                    f"{bit['mismatches']} final plans diverged from "
+                    "the fault-free baseline: "
+                    f"{bit['mismatched_sessions']}"
+                )})
+            drill = rep.get("drill")
+            if drill and drill.get("mode") and not (
+                drill.get("killed") or drill.get("drained")
+            ):
+                bad.append({"error": "process drill never fired"})
+            src = rep.get("sources")
+            if src and src["dropped"]:
+                bad.append({"error": (
+                    f"{src['dropped']} event sources dropped"
+                )})
+            det = rep.get("detector") or {}
+            if det.get("false_positive_ejections"):
+                bad.append({"error": (
+                    "detector ejected never-faulted process(es): "
+                    f"{det['false_positive_ejections']}"
+                )})
+            for pid, viols in (
+                rep.get("witness_violations") or {}
+            ).items():
+                if viols:
+                    bad.append({"proc": pid, "error": (
+                        f"{len(viols)} lock-order witness violation(s)"
+                    )})
             if bad:
                 print(f"SMOKE FAIL: {bad}")
                 return 1

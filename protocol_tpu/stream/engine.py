@@ -271,6 +271,80 @@ class StreamEngine:
         )
         return plan
 
+    # ---------------- checkpoint travel (ISSUE 20) ----------------
+
+    def export_state(self, max_cursor_sources: int = 1 << 16) -> dict:
+        """The full re-armable stream state for journal travel: config,
+        the per-source dedup cursors, the reconcile-cadence cursor, and
+        the obs counters. JSON-serializable by construction (the
+        checkpoint META frame carries it).
+
+        What does NOT travel: the gap tracker and the divergence
+        baseline. Both are derived EXACTLY from the restored arena at
+        re-arm time (``GapTracker.rebase`` over the restored duals is
+        the same exact certificate; the restored plan becomes the new
+        divergence reference), so serializing them would only add a
+        second source of truth that could disagree with the arrays."""
+        with self._lock:
+            return {
+                "reconcile_every": int(self.reconcile_every),
+                "gap_ceiling": self.gap_ceiling,
+                "max_stale_events": int(self.max_stale_events),
+                "auto_reconcile": bool(self.auto_reconcile),
+                "event_eps_start": self.event_eps_start,
+                "events_since_reconcile": int(
+                    self.events_since_reconcile
+                ),
+                "events_applied": int(self.events_applied),
+                "events_stale": int(self.events_stale),
+                "reconciles": int(self.reconciles),
+                "divergence_max": int(self.divergence_max),
+                "gap_max": float(self.gap_max),
+                "gap_served_max": float(self.gap_served_max),
+                "dedup": self.dedup.export_cursors(
+                    limit=max_cursor_sources
+                ),
+            }
+
+    @classmethod
+    def from_state(cls, arena, weights, state: dict) -> "StreamEngine":
+        """Re-arm over a restored PRIMED arena (migration / restart).
+        The dedup cursors make a retransmitted (source, seq) that
+        straddles the process boundary dedup at the target exactly as
+        it would have at the origin — the wire tick/CRC cursor only
+        covers the LAST tick, so without these a chaos'd retransmit
+        arriving as a fresh tick after the handoff would double-apply.
+        The cadence cursor keeps the migrated stream's reconcile
+        boundaries aligned with its fault-free replay."""
+        eng = cls(
+            arena, weights,
+            reconcile_every=int(state.get("reconcile_every", 256)),
+            gap_ceiling=state.get("gap_ceiling"),
+            max_stale_events=int(state.get("max_stale_events", 4096)),
+            auto_reconcile=bool(state.get("auto_reconcile", True)),
+            event_eps_start=state.get("event_eps_start"),
+        )
+        dd = state.get("dedup")
+        if dd:
+            eng.dedup.restore_cursors(dd)
+        eng.events_since_reconcile = int(
+            state.get("events_since_reconcile", 0)
+        )
+        eng.events_applied = int(state.get("events_applied", 0))
+        eng.events_stale = int(state.get("events_stale", 0))
+        eng.reconciles = int(state.get("reconciles", 0))
+        eng.divergence_max = int(state.get("divergence_max", 0))
+        eng.gap_max = max(eng.gap_max, float(state.get("gap_max", 0.0)))
+        eng.gap_served_max = max(
+            eng.gap_served_max, float(state.get("gap_served_max", 0.0))
+        )
+        # a flush can land between the cadence trigger and the (driver-
+        # owned) reconcile when auto_reconcile is off — re-raise the due
+        # flag instead of silently restarting the window
+        if eng.events_since_reconcile >= eng.reconcile_every:
+            eng.reconcile_due, eng.due_reason = True, "cadence"
+        return eng
+
     # ---------------- observability ----------------
 
     def snapshot(self) -> dict:

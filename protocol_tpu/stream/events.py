@@ -134,6 +134,42 @@ class SourceDedup:
             self._seq.popitem(last=False)
         return True
 
+    # ---------------- checkpoint travel (ISSUE 20) ----------------
+
+    def export_cursors(self, limit: Optional[int] = None) -> dict:
+        """Serialize the high-water marks (LRU order, newest last) for
+        journal travel. ``limit`` caps the export at the NEWEST entries
+        — the same argument as the LRU bound itself: a cursor old
+        enough to fall off the cap protects against retransmits no
+        client ladder still sends. ``truncated`` counts what was
+        dropped so the cap is visible, never silent."""
+        items = list(self._seq.items())
+        truncated = 0
+        if limit is not None and len(items) > int(limit):
+            truncated = len(items) - int(limit)
+            items = items[-int(limit):]
+        return {
+            "sources": [s for s, _ in items],
+            "seqs": [int(q) for _, q in items],
+            "deduped": int(self.deduped),
+            "truncated": truncated,
+        }
+
+    def restore_cursors(self, state: dict) -> None:
+        """Re-seed the marks from an exported dict (migration re-arm).
+        Existing entries merge by max — restoring over a live map can
+        only tighten, never regress, a high-water mark."""
+        for s, q in zip(
+            state.get("sources") or (), state.get("seqs") or ()
+        ):
+            s, q = str(s), int(q)
+            prev = self._seq.get(s)
+            self._seq[s] = q if prev is None else max(prev, q)
+            self._seq.move_to_end(s)
+        while len(self._seq) > self.max_sources:
+            self._seq.popitem(last=False)
+        self.deduped = int(state.get("deduped", self.deduped))
+
 
 def coalesce(events: list) -> Optional[StreamEvent]:
     """Merge a burst of pending events into ONE synthetic event — the

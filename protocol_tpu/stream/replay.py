@@ -125,6 +125,7 @@ def stream_replay(
     chaos=None,
     final_reconcile: bool = True,
     keep_recon_p4ts: bool = False,
+    extra_events: Optional[list] = None,
 ) -> dict:
     """Replay a stream trace event by event. Returns the report dict;
     ``report["divergence"]`` is None when every verified event
@@ -133,13 +134,18 @@ def stream_replay(
     ``chaos`` is a ``faults.plan.ChaosConfig`` (or None): events are
     delivered in the chaos'd order with duplicates injected; recorded-
     outcome verification is skipped (intermediate plans legitimately
-    differ) and the caller compares final reconciled plans instead."""
+    differ) and the caller compares final reconciled plans instead.
+
+    ``extra_events`` are :class:`StreamEvent`s applied IN ORDER after
+    the trace's events (never chaos'd) — the distributed firehose
+    driver's storm/pad injections, so its fault-free baseline replays
+    the exact event multiset a drilled fleet session absorbed."""
     trace = tfmt.read_trace(trace_path)
     with _pin_recorded_isa(trace.meta) as effective_isa:
         return _stream_replay(
             trace, trace_path, engine, threads, reconcile_every,
             gap_ceiling, verify, record_path, chaos, final_reconcile,
-            keep_recon_p4ts, effective_isa,
+            keep_recon_p4ts, effective_isa, extra_events,
         )
 
 
@@ -156,6 +162,7 @@ def _stream_replay(
     final_reconcile: bool,
     keep_recon_p4ts: bool,
     effective_isa: Optional[str],
+    extra_events: Optional[list] = None,
 ) -> dict:
     from protocol_tpu.trace.replay import parse_engine
 
@@ -190,6 +197,15 @@ def _stream_replay(
         )
 
         order = event_delivery_order(FaultSchedule(chaos), len(events))
+    if extra_events:
+        # injected (storm/pad) events are appended AFTER the trace's
+        # delivery order, always in-order: their sentinel seq tiers
+        # make the converged columns order-independent anyway (see
+        # dstream.fanout), but recorded-outcome verification only
+        # covers the trace prefix either way
+        base = len(events)
+        events = events + list(extra_events)
+        order = order + list(range(base, len(events)))
 
     writer = None
     if record_path is not None:
@@ -226,6 +242,7 @@ def _stream_replay(
         "providers": snap.n_providers,
         "tasks": n_t,
         "events": 0,
+        "extra_events": len(extra_events or ()),
         "verified_events": 0,
         "divergence": None,
         "deduped": 0,

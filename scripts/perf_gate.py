@@ -2093,6 +2093,184 @@ def dfleet_gate() -> int:
     return 0
 
 
+def dstream_gate() -> int:
+    """Distributed event-firehose gate (ISSUE 20). Three phases over
+    THREE real servicer processes behind the consistent-hash ring:
+
+    A — every session replays the committed golden distributed stream
+        trace (artifacts/golden_dstream_256x256.trace) under seeded
+        drop/dup/reorder DELIVERY chaos (every re-delivery is a fresh
+        wire tick, so the server's event-seq dedup — not the tick CRC —
+        must absorb it), with a mass blackout event fanned into every
+        session's firehose mid-run at the sentinel seq tier. Bar: every
+        session's final reconciled plan BIT-IDENTICAL to the fault-free
+        in-process replay of the same trace + storm, zero reopens, zero
+        dropped sources, zero session errors, zero lock-witness
+        violations.
+    B — SIGKILL one process mid-run with the failure detector armed
+        (kill_unannounced: the driver does NOT take the corpse off the
+        detector's watch). The detector must eject it autonomously
+        (zero false positives), re-route its journals along the ring,
+        and the generation-keyed ejection leave storm — one leave per
+        event source homed on the corpse — must be absorbed ONLINE by
+        the surviving sessions' stream engines (O(churned rows) per
+        event; the storm shows up as applied storm events, never as
+        reopens). Same bit-identity bar, plus per-tenant assigned
+        fraction >= ``dstream_min_assigned_frac`` at the final
+        reconcile (providers sized with failover headroom).
+    C — clean 3-process throughput floor: fleet-wide server-observed
+        events/sec >= ``dstream_fleet_events_per_s_floor`` and
+        per-tenant p99 event RPC <= ``dstream_event_p99_us_max``
+        (floors committed conservatively below measured, per this
+        file's convention)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PROTOCOL_TPU_LOCK_WITNESS", "1")
+    from protocol_tpu.fleet.loadgen import run_events
+
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+    frac_floor = floors["dstream_min_assigned_frac"]
+    eps_floor = floors["dstream_fleet_events_per_s_floor"]
+    p99_max = floors["dstream_event_p99_us_max"]
+    failures = []
+    golden = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts", "golden_dstream_256x256.trace",
+    )
+
+    def _check(phase: str, rep: dict, assigned_floor=None) -> None:
+        bit = rep.get("bit_identity") or {}
+        lad = rep.get("ladder") or {}
+        print(
+            f"dstream gate {phase}: events={rep['events_total']} "
+            f"storms={rep['storm_events_total']} "
+            f"fleet_events_per_s={rep['fleet_events_per_s']} | "
+            f"bit={bit.get('checked')}/{rep['sessions']} "
+            f"mismatches={bit.get('mismatches')} "
+            f"skipped={bit.get('skipped')} | ladder={lad} | "
+            f"sources={rep['sources']} | drill={rep.get('drill')}"
+        )
+        for err in rep["errors"]:
+            failures.append(f"phase {phase}: session error: {err}")
+        if lad.get("reopens", 0) != 0:
+            failures.append(
+                f"phase {phase}: {lad['reopens']} full-snapshot "
+                "reopens — stream failover was not warm"
+            )
+        if rep["sources"]["dropped"] != 0:
+            failures.append(
+                f"phase {phase}: {rep['sources']['dropped']} event "
+                "sources dropped mid-drill"
+            )
+        if bit.get("checked", 0) < 1 or bit.get("skipped", 0) != 0:
+            failures.append(
+                f"phase {phase}: bit-identity covered "
+                f"{bit.get('checked', 0)} sessions with "
+                f"{bit.get('skipped', 0)} skipped — the witness is "
+                "not total"
+            )
+        if bit.get("mismatches", 0) != 0:
+            failures.append(
+                f"phase {phase}: {bit['mismatches']} session(s) NOT "
+                "bit-identical to the fault-free replay: "
+                f"{bit.get('mismatched_sessions')}"
+            )
+        if assigned_floor is not None:
+            for t, agg in rep["tenants"].items():
+                a = agg.get("assigned_last_min")
+                if a is None or a < assigned_floor:
+                    failures.append(
+                        f"phase {phase}: tenant {t} final assigned "
+                        f"{a} below {assigned_floor}"
+                    )
+        for pid, viols in (rep.get("witness_violations") or {}).items():
+            if viols:
+                failures.append(
+                    f"phase {phase}: {len(viols)} lock-witness "
+                    f"violation(s) in {pid}: {viols[:2]}"
+                )
+
+    # ---- phase A: golden trace, delivery chaos, mass blackout fan-out
+    rep = run_events(
+        sessions=6, tenants=2, providers=256, tasks=256,
+        kernel="native-mt:1", reconcile_every=16, shards=2, seed=1,
+        processes=3, trace_path=golden,
+        chaos="seed=5,drop=0.05,dup=0.05,reorder=0.05",
+        mass_at_event=24, mass_frac=0.1,
+    )
+    _check("A (chaos'd mass fan-out)", rep)
+    if rep["storm_events_total"] <= 0:
+        failures.append(
+            "phase A: the mass blackout fanned out zero storm events"
+        )
+    mass = rep.get("mass") or {}
+    if not mass.get("rows"):
+        failures.append(
+            f"phase A: the mass event was never armed ({mass})"
+        )
+
+    # ---- phase B: SIGKILL + detector ejection -> online leave storm
+    rep_b = run_events(
+        sessions=6, tenants=2, providers=512, tasks=256, events=48,
+        rate_hz=400.0, kernel="native-mt:1", reconcile_every=16,
+        shards=2, seed=2, processes=3, detect=True,
+        chaos="seed=7,drop=0.02,dup=0.02,kill_proc_at_tick=16,"
+              "kill_proc=1",
+    )
+    _check("B (SIGKILL + ejection storm)", rep_b,
+           assigned_floor=frac_floor)
+    drill = rep_b.get("drill") or {}
+    if not drill.get("killed"):
+        failures.append("phase B: the SIGKILL drill never fired")
+    if not drill.get("ejected_by_detector"):
+        failures.append(
+            "phase B: the failure detector never ejected the corpse "
+            f"(drill={drill})"
+        )
+    if not drill.get("storm_posted"):
+        failures.append(
+            "phase B: the ejection leave storm was never posted"
+        )
+    if rep_b["storm_events_total"] <= 0:
+        failures.append(
+            "phase B: the ejection storm fanned out zero leave events"
+        )
+    fp = (rep_b.get("detector") or {}).get(
+        "false_positive_ejections"
+    )
+    if fp:
+        failures.append(
+            f"phase B: {len(fp)} false-positive ejection(s): {fp}"
+        )
+
+    # ---- phase C: clean 3-process throughput + latency floors
+    rep_c = run_events(
+        sessions=6, tenants=2, providers=256, tasks=256, events=64,
+        rate_hz=2000.0, kernel="native-mt:1", reconcile_every=16,
+        shards=2, seed=3, processes=3,
+    )
+    _check("C (clean throughput)", rep_c, assigned_floor=frac_floor)
+    if rep_c["fleet_events_per_s"] < eps_floor:
+        failures.append(
+            f"phase C: fleet events/sec {rep_c['fleet_events_per_s']} "
+            f"below the {eps_floor} floor"
+        )
+    for t, agg in rep_c["tenants"].items():
+        p99 = (agg.get("event_rpc") or {}).get("p99_us")
+        if p99 is None or p99 > p99_max:
+            failures.append(
+                f"phase C: tenant {t} event p99 {p99}us above the "
+                f"{p99_max}us cap"
+            )
+
+    if failures:
+        for fmsg in failures:
+            print(f"PERF GATE FAIL: {fmsg}", file=sys.stderr)
+        return 1
+    print("dstream perf gate OK")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--update-floor", action="store_true")
@@ -2104,6 +2282,7 @@ def main() -> int:
     ap.add_argument("--quality", action="store_true")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--dfleet", action="store_true")
+    ap.add_argument("--dstream", action="store_true")
     ap.add_argument("--cand", action="store_true")
     ap.add_argument("--stream", action="store_true")
     ap.add_argument("--simd", action="store_true")
@@ -2135,6 +2314,8 @@ def main() -> int:
         return chaos_gate()
     if args.dfleet:
         return dfleet_gate()
+    if args.dstream:
+        return dstream_gate()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import numpy as np

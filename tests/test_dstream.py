@@ -1,0 +1,558 @@
+"""Distributed event firehose (ISSUE 20).
+
+Unit/in-process coverage for the ``dstream`` subsystem the
+``perf_gate.py --dstream`` CI bar rests on:
+
+  * **Sentinel-seq fan-out determinism.** A fleet-level event (mass
+    blackout, ejection storm) decomposes into per-source leave events
+    whose seq sits ABOVE every workload seq — under the stream engine's
+    per-source latest-wins supersession the converged columns (and so
+    the final reconciled plan) are independent of where the fan-out
+    interleaves each session's firehose. Asserted here by applying the
+    same event multiset in two hostile interleavings and comparing the
+    reconciled plans bit-for-bit.
+  * **Stream state travel.** ``StreamEngine.export_state`` /
+    ``from_state`` round-trips the dedup cursors (a retransmit that
+    straddles a process boundary must dedup at the target exactly as it
+    would have at the origin), the reconcile-cadence cursor (migrated
+    boundaries stay aligned with the fault-free replay), and the obs
+    counters — all JSON-serializable for the checkpoint META frame.
+  * **Cross-process live migration.** A stream session mid-firehose is
+    Migrate'd between two servicers: the client follows the ``moved:``
+    redirect with ZERO reopens, the target re-arms warm, a
+    byte-identical retransmitted tick replays (CRC twin), an old
+    (source, seq) at a fresh tick dedup-ACKs (cursor twin), and the
+    reconciled plans across the boundary stay bit-identical to a
+    fault-free single-process replay.
+  * **Blackout composition + scrape rollup.** ``SessionFabric.blackout``
+    arms a seeded leave-storm schedule drained exactly once by the
+    drill driver; ``stream_rollup`` joins per-process ``/metrics.json``
+    stream sections fleet-wide (dead procs listed, never dropped).
+
+The real 3-subprocess SIGKILL/ejection-storm drill lives in
+``perf_gate.py --dstream`` phase B.
+"""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from protocol_tpu import native
+from protocol_tpu.dfleet.topology import FleetTopology
+from protocol_tpu.dstream.fanout import (
+    MASS_SEQ_BASE,
+    PAD_SEQ_BASE,
+    PAD_SOURCE,
+    STORM_SEQ_BASE,
+    affected_rows,
+    blackout_storm_schedule,
+    ejection_leave_events,
+    leave_events,
+    mass_leave_events,
+    pad_event,
+    source_home,
+    storm_rows,
+)
+from protocol_tpu.dstream.rollup import events_per_second, stream_rollup
+from protocol_tpu.fleet.fabric import FleetConfig, SessionFabric
+from protocol_tpu.proto import scheduler_pb2 as pb
+from protocol_tpu.proto import wire
+from protocol_tpu.stream.engine import StreamEngine
+from protocol_tpu.stream.replay import _events_of, _open_arena, stream_replay
+from protocol_tpu.trace import format as tfmt
+from protocol_tpu.trace.synth import synth_event_trace
+
+NATIVE = native.available()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------- fan-out planning (pure) ----------------
+
+
+class TestFanout:
+    def test_sentinel_tiers_dominate_workload_and_each_other(self):
+        """Workload seqs are per-source counters (thousands at most);
+        the pad tier sits above them, mass above pads, storm above mass
+        — so 'the process died' beats 'the region blacked out' for a
+        doubly-affected source, and both beat every workload event."""
+        workload_seq_max = 1 << 20
+        assert workload_seq_max < PAD_SEQ_BASE < MASS_SEQ_BASE
+        # tiers stay ordered across any plausible index/generation
+        for k in (0, 1, 1000):
+            for g in (0, 1, 1000):
+                assert MASS_SEQ_BASE + k < STORM_SEQ_BASE + g
+
+    def test_storm_rows_deterministic_and_bounded(self):
+        a = storm_rows(7, "blackout-shard1", 256, 0.1)
+        b = storm_rows(7, "blackout-shard1", 256, 0.1)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.int32
+        assert len(a) == round(256 * 0.1)
+        assert sorted(a.tolist()) == a.tolist()
+        assert a.min() >= 0 and a.max() < 256
+        # different seed/tag pick different membership
+        c = storm_rows(8, "blackout-shard1", 256, 0.1)
+        assert a.tolist() != c.tolist()
+        # never a no-op, never out of range
+        assert len(storm_rows(1, "t", 64, 0.0001)) == 1
+        assert len(storm_rows(1, "t", 64, 5.0)) == 64
+
+    def test_leave_events_pin_snapshot_payload_invalid(self):
+        rng = np.random.default_rng(3)
+        p_cols = {
+            "price": rng.random(16).astype(np.float32),
+            "valid": np.ones(16, np.bool_),
+        }
+        evs = leave_events([2, 5], 1234, p_cols)
+        assert [e.source for e in evs] == ["p2", "p5"]
+        assert all(e.seq == 1234 and e.kind == "leave" for e in evs)
+        for e, r in zip(evs, (2, 5)):
+            np.testing.assert_array_equal(
+                e.provider_rows, np.asarray([r], np.int32)
+            )
+            np.testing.assert_array_equal(
+                e.p_cols["price"], p_cols["price"][[r]]
+            )
+            assert not e.p_cols["valid"][0]
+            assert e.task_rows.size == 0 and e.r_cols == {}
+
+    def test_mass_and_ejection_tiers(self):
+        p_cols = {"valid": np.ones(4, np.bool_)}
+        assert mass_leave_events(3, [0], p_cols)[0].seq == (
+            MASS_SEQ_BASE + 3
+        )
+        assert ejection_leave_events(5, [0], p_cols)[0].seq == (
+            STORM_SEQ_BASE + 5
+        )
+
+    def test_pad_event_is_a_distinct_seq_noop(self):
+        p0, p1 = pad_event(0), pad_event(1)
+        assert p0.source == PAD_SOURCE and p0.kind == "heartbeat"
+        assert p0.seq == PAD_SEQ_BASE and p1.seq == PAD_SEQ_BASE + 1
+        assert p0.provider_rows.size == 0 and p0.task_rows.size == 0
+
+    def test_blackout_schedule_json_roundtrip(self):
+        sched = blackout_storm_schedule(7, 1, 256, frac=0.1, mass_index=2)
+        rt = json.loads(json.dumps(sched))
+        assert rt == sched
+        assert rt["kind"] == "blackout" and rt["mass_index"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(rt["rows"], np.int32),
+            storm_rows(7, "blackout-shard1", 256, 0.1),
+        )
+
+    def test_ejection_rows_partition_by_home(self):
+        """Every source is homed on exactly one process, so the
+        per-process affected sets partition the row space: each
+        driver's storm membership is disjoint and complete — two
+        processes can never both claim a source, and none is orphaned."""
+        topo = FleetTopology(
+            ["a:1", "b:2", "c:3"],
+            procs={"a:1": "p0", "b:2": "p1", "c:3": "p2"},
+        )
+        sid, n = "t0@es1", 128
+        sets = [
+            affected_rows(topo, sid, pid, n).tolist()
+            for pid in ("p0", "p1", "p2")
+        ]
+        assert all(len(s) > 0 for s in sets)  # ring spreads at n=128
+        flat = sorted(r for s in sets for r in s)
+        assert flat == list(range(n))
+        for r in sets[0]:
+            assert source_home(topo, sid, f"p{r}") == "p0"
+        # membership is session-keyed: a second session storms its own set
+        other = affected_rows(topo, "t1@es2", "p0", n).tolist()
+        assert other != sets[0]
+
+
+# ---------------- scrape rollup (pure) ----------------
+
+
+class TestRollup:
+    def _snap(self, nested: bool, streams: dict) -> dict:
+        sessions = {
+            sid: {"tick": {"count": 1}, "stream": st}
+            for sid, st in streams.items()
+        }
+        if nested:  # scraped /metrics.json shape
+            return {"seam": {}, "obs": {"sessions": sessions}}
+        return {"sessions": sessions}  # raw ObsRegistry.snapshot()
+
+    def test_rollup_joins_both_shapes_and_lists_dead(self):
+        st_a = {
+            "event": {"count": 10, "p99_us": 50.0, "max_us": 80.0},
+            "deduped": 2, "reconciled": 3,
+            "divergence_rows_max": 4, "repair_rows": 7,
+        }
+        st_b = {
+            "event": {"count": 5, "p99_us": 90.0, "max_us": 95.0},
+            "deduped": 0, "reconciled": 1,
+            "divergence_rows_max": 9, "repair_rows": 2,
+        }
+        scrapes = {
+            "p0": self._snap(True, {"t0@a": st_a}),
+            "p1": self._snap(False, {"t1@b": st_b}),
+            "p2": None,  # SIGKILL'd mid-drill
+        }
+        r = stream_rollup(scrapes)
+        assert r["events"] == 15 and r["sessions"] == 2
+        assert r["deduped"] == 2 and r["reconciled"] == 4
+        assert r["repair_rows"] == 9
+        assert r["divergence_rows_max"] == 9
+        assert r["p99_us_max"] == 90.0 and r["max_us"] == 95.0
+        assert r["dead_procs"] == ["p2"]
+        assert r["procs"]["p0"]["events"] == 10
+        assert r["procs"]["p1"]["events"] == 5
+
+    def test_sessions_without_stream_sections_ignored(self):
+        scrapes = {"p0": self._snap(True, {})}
+        scrapes["p0"]["obs"]["sessions"]["t0@batch"] = {"tick": {}}
+        r = stream_rollup(scrapes)
+        assert r["events"] == 0 and r["sessions"] == 0
+        assert r["dead_procs"] == []
+
+    def test_events_per_second(self):
+        assert events_per_second({"events": 100}, 4.0) == 25.0
+        assert events_per_second({"events": 100}, 0.0) == 0.0
+        assert events_per_second({}, None) == 0.0
+
+
+# ---------------- blackout x stream composition ----------------
+
+
+class TestBlackoutStorm:
+    def test_armed_schedule_drains_exactly_once(self):
+        fab = SessionFabric(shards=2, max_sessions=4)
+        sched = blackout_storm_schedule(5, 1, 64, frac=0.2)
+        fab.blackout(1, 2, storm=sched)
+        assert fab.snapshot()["blackout_storms_armed"] == 1
+        drained = fab.drain_storms()
+        assert drained == [sched]
+        assert fab.drain_storms() == []  # fanned out exactly once
+        # counter is cumulative (obs plane), not a queue depth
+        assert fab.snapshot()["blackout_storms_armed"] == 1
+
+    def test_blackout_without_storm_stays_refusal_only(self):
+        fab = SessionFabric(shards=2, max_sessions=4)
+        fab.blackout(0, 1)
+        assert fab.snapshot()["blackout_storms_armed"] == 0
+        assert fab.drain_storms() == []
+
+
+# ---------------- stream state travel ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestStreamStateTravel:
+    @pytest.fixture(scope="class")
+    def travel_trace(self, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp("travel") / "ev.trace")
+        synth_event_trace(
+            path, n_providers=96, n_tasks=96, events=12, seed=5,
+            reconcile_every=6,
+        )
+        return tfmt.read_trace(path)
+
+    def test_state_roundtrips_through_json(self, travel_trace):
+        snap = travel_trace.snapshot
+        events = _events_of(travel_trace)
+        arena, w, _, _ = _open_arena(snap, "native-mt", 1)
+        # gap_ceiling far above any real gap: config travel is under
+        # test, not inline breach reconciles (those reset the cadence)
+        eng = StreamEngine(arena, w, reconcile_every=6, gap_ceiling=1e9)
+        for ev in events[:5]:
+            assert not eng.apply(ev).deduped
+        assert eng.events_since_reconcile == 5
+        state = json.loads(json.dumps(eng.export_state()))
+
+        arena2, w2, _, _ = _open_arena(snap, "native-mt", 1)
+        eng2 = StreamEngine.from_state(arena2, w2, state)
+        assert eng2.reconcile_every == 6 and eng2.gap_ceiling == 1e9
+        assert eng2.events_since_reconcile == 5
+        assert eng2.events_applied == 5
+        # the traveled cursors enforce staleness: a retransmit of an
+        # already-committed (source, seq) dedups at the re-armed engine
+        assert eng2.apply(events[0]).deduped
+        # ...and a genuinely fresh event still applies
+        assert not eng2.apply(events[5]).deduped
+
+    def test_cadence_cursor_rearms_the_due_flag(self, travel_trace):
+        snap = travel_trace.snapshot
+        arena, w, _, _ = _open_arena(snap, "native-mt", 1)
+        eng = StreamEngine(arena, w, reconcile_every=4)
+        state = eng.export_state()
+        state["events_since_reconcile"] = 4  # flush raced the reconcile
+        arena2, w2, _, _ = _open_arena(snap, "native-mt", 1)
+        eng2 = StreamEngine.from_state(arena2, w2, state)
+        assert eng2.reconcile_due and eng2.due_reason == "cadence"
+
+    def test_cursor_export_cap_is_newest_and_counted(self, travel_trace):
+        snap = travel_trace.snapshot
+        arena, w, _, _ = _open_arena(snap, "native-mt", 1)
+        eng = StreamEngine(arena, w, reconcile_every=1000)
+        for i, ev in enumerate(_events_of(travel_trace)[:6]):
+            eng.apply(ev)
+        full = eng.export_state()["dedup"]
+        capped = eng.export_state(max_cursor_sources=2)["dedup"]
+        assert capped["truncated"] == len(full["sources"]) - 2
+        assert capped["sources"] == full["sources"][-2:]
+
+
+# ---------------- mass fan-out determinism ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestMassFanoutDeterminism:
+    def test_hostile_interleavings_converge_bit_identical(
+        self, tmp_path
+    ):
+        """The phase-A contract at unit grain: the same workload-event
+        multiset plus the same mass storm, applied in two hostile
+        interleavings (storm last vs storm FIRST, so every later
+        workload event for a stormed source arrives superseded), must
+        reconcile to bit-identical plans."""
+        path = str(tmp_path / "mass.trace")
+        synth_event_trace(
+            path, n_providers=128, n_tasks=128, events=24, seed=11,
+            reconcile_every=1000,
+        )
+        trace = tfmt.read_trace(path)
+        snap = trace.snapshot
+        events = _events_of(trace)
+        rows = storm_rows(7, "blackout-shard1", snap.n_providers, 0.15)
+        storm = mass_leave_events(0, rows, snap.p_cols)
+
+        arena_a, w_a, _, _ = _open_arena(snap, "native-mt", 1)
+        eng_a = StreamEngine(arena_a, w_a, reconcile_every=1000)
+        for ev in events + storm:
+            eng_a.apply(ev)
+        plan_a = eng_a.reconcile().plan
+
+        arena_b, w_b, _, _ = _open_arena(snap, "native-mt", 1)
+        eng_b = StreamEngine(arena_b, w_b, reconcile_every=1000)
+        deduped = 0
+        for ev in storm + events:  # storm first: reordered delivery
+            deduped += int(eng_b.apply(ev).deduped)
+        plan_b = eng_b.reconcile().plan
+        # stormed sources' workload events arrived superseded...
+        stormed = {f"p{r}" for r in rows.tolist()}
+        assert deduped == sum(
+            1 for ev in events if ev.source in stormed
+        )
+        assert deduped > 0  # the interleaving was actually hostile
+        # ...and the reconciled plans are bit-identical anyway
+        np.testing.assert_array_equal(plan_a, plan_b)
+
+    def test_storm_matches_extra_events_baseline(self, tmp_path):
+        """The driver-side baseline (stream_replay extra_events) and a
+        live engine fed the chaos'd order agree — the exact comparison
+        the loadgen bit-identity gate performs."""
+        path = str(tmp_path / "base.trace")
+        synth_event_trace(
+            path, n_providers=96, n_tasks=96, events=16, seed=3,
+            reconcile_every=8,
+        )
+        trace = tfmt.read_trace(path)
+        snap = trace.snapshot
+        events = _events_of(trace)
+        rows = storm_rows(2, "blackout-shard1", snap.n_providers, 0.1)
+        storm = mass_leave_events(0, rows, snap.p_cols)
+        rep = stream_replay(
+            path, engine="native-mt", threads=1, reconcile_every=8,
+            verify=False, final_reconcile=True, keep_recon_p4ts=True,
+            extra_events=storm,
+        )
+        baseline = rep["recon_p4ts"][-1]
+
+        arena, w, _, _ = _open_arena(snap, "native-mt", 1)
+        eng = StreamEngine(arena, w, reconcile_every=8)
+        # hostile: storm injected mid-stream, duplicates sprinkled in
+        order = events[:5] + storm + events[3:] + storm[:1]
+        for ev in order:
+            eng.apply(ev)
+        # the live arena answers the padded pow2 plan; the replay
+        # reports real-row slices — compare on the real rows
+        np.testing.assert_array_equal(
+            eng.reconcile().plan[: snap.n_tasks], np.asarray(baseline)
+        )
+
+
+# ---------------- cross-process live migration ----------------
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native toolchain")
+class TestCrossProcessMigration:
+    def _serve_pair(self, root):
+        from protocol_tpu.services.scheduler_grpc import serve
+
+        addr_a = f"127.0.0.1:{_free_port()}"
+        addr_b = f"127.0.0.1:{_free_port()}"
+        a = serve(addr_a, fleet=FleetConfig(
+            shards=2, ckpt_dir=root, proc_id="p0", endpoint=addr_a))
+        b = serve(addr_b, fleet=FleetConfig(
+            shards=2, ckpt_dir=root, proc_id="p1", endpoint=addr_b))
+        return (addr_a, a), (addr_b, b)
+
+    def _open_stream(self, client, snap, sid, reconcile_every):
+        req = snap.request_v2()
+        req.stream_mode = True
+        req.reconcile_every = reconcile_every
+        w = tfmt._as_ns(dict(zip(
+            ("price", "load", "proximity", "priority"), snap.weights
+        )))
+        fp = wire.epoch_fingerprint(
+            snap.p_cols, snap.r_cols, w, snap.kernel, snap.top_k,
+            snap.eps, snap.max_iters,
+        )
+        resp = client.open_session(
+            iter(wire.chunk_snapshot(sid, fp, req)), timeout=60
+        )
+        assert resp.ok, resp.error
+        return fp
+
+    def _event_req(self, sid, fp, tick, ev):
+        req = pb.AssignDeltaRequest(
+            session_id=sid, epoch_fingerprint=fp, tick=tick,
+            event_source=ev.source, event_seq=int(ev.seq),
+            event_kind=ev.kind,
+        )
+        if ev.provider_rows.size:
+            req.provider_rows.CopyFrom(
+                wire.blob(ev.provider_rows, np.int32)
+            )
+            req.providers.CopyFrom(
+                wire.encode_providers_v2(tfmt._as_ns(ev.p_cols))
+            )
+        if ev.task_rows.size:
+            req.task_rows.CopyFrom(wire.blob(ev.task_rows, np.int32))
+            req.requirements.CopyFrom(
+                wire.encode_requirements_v2(tfmt._as_ns(ev.r_cols))
+            )
+        return req
+
+    def test_stream_session_migrates_warm_with_dedup_twins(
+        self, tmp_path
+    ):
+        """The satellite contract end to end on a real wire: Migrate
+        mid-firehose, moved: redirect, warm re-arm at the target (zero
+        reopens — the snapshot is never resent), a byte-identical
+        retransmitted tick replays (CRC twin), an OLD (source, seq) at
+        a fresh tick dedup-ACKs (traveled-cursor twin), and the
+        reconcile boundaries land bit-identical to the fault-free
+        single-process replay — including the boundary that fires AT
+        THE TARGET, which is only aligned because the cadence cursor
+        traveled."""
+        from protocol_tpu.services.scheduler_grpc import (
+            SchedulerBackendClient,
+        )
+
+        root = str(tmp_path / "journal")
+        path = str(tmp_path / "mig.trace")
+        synth_event_trace(
+            path, n_providers=96, n_tasks=96, events=12, seed=8,
+            reconcile_every=4,
+        )
+        trace = tfmt.read_trace(path)
+        snap = trace.snapshot
+        events = _events_of(trace)
+        baseline = stream_replay(
+            path, engine="native-mt", threads=1, reconcile_every=4,
+            verify=False, final_reconcile=False, keep_recon_p4ts=True,
+        )["recon_p4ts"]
+        sid = "tenM@mig1"
+        (addr_a, a), (addr_b, b) = self._serve_pair(root)
+        ca = SchedulerBackendClient(addr_a)
+        cb = SchedulerBackendClient(addr_b)
+        try:
+            fp = self._open_stream(ca, snap, sid, reconcile_every=4)
+            recon_plans = []
+            tick = 0
+            for ev in events[:5]:
+                tick += 1
+                r = ca.assign_delta(
+                    self._event_req(sid, fp, tick, ev), timeout=60
+                )
+                assert r.session_ok, r.error
+                if r.reconciled:
+                    recon_plans.append(np.frombuffer(
+                        r.result.provider_for_task.data, np.int32
+                    ))
+            last_req = self._event_req(sid, fp, tick, events[4])
+
+            # live migration mid-stream
+            mig = ca.migrate(pb.MigrateRequest(
+                target_endpoint=addr_b, target_proc_id="p1",
+            ))
+            assert mig.ok and mig.moved == 1
+            # the origin answers moved:, never unknown
+            r = ca.assign_delta(
+                self._event_req(sid, fp, tick + 1, events[5]),
+                timeout=60,
+            )
+            assert not r.session_ok
+            assert r.error.startswith("moved:")
+            assert addr_b in r.error
+
+            # CRC twin: the byte-identical LAST tick resent at the
+            # target replays from the rehydrated journal cursor
+            r = cb.assign_delta(last_req, timeout=60)
+            assert r.session_ok, r.error
+            assert r.replayed
+
+            # cursor twin: an OLD (source, seq) arriving as a FRESH
+            # tick dedup-ACKs — only possible because the dedup
+            # cursors traveled in the checkpoint META frame
+            tick += 1
+            r = cb.assign_delta(
+                self._event_req(sid, fp, tick, events[1]), timeout=60
+            )
+            assert r.session_ok, r.error
+            assert r.event_deduped
+
+            # the target re-armed WARM: stream config + counters are
+            # the origin's, not a fresh engine's
+            session, _ = b.servicer.sessions.get(sid, fp)
+            assert session is not None
+            assert session.stream is not None
+            assert session.stream.reconcile_every == 4
+            assert session.stream.events_applied == 5
+
+            # the rest of the firehose applies at the target; the
+            # event-8 reconcile boundary fires HERE, aligned with the
+            # fault-free replay by the traveled cadence cursor
+            for ev in events[5:]:
+                tick += 1
+                r = cb.assign_delta(
+                    self._event_req(sid, fp, tick, ev), timeout=60
+                )
+                assert r.session_ok, r.error
+                assert not r.event_deduped
+                if r.reconciled:
+                    recon_plans.append(np.frombuffer(
+                        r.result.provider_for_task.data, np.int32
+                    ))
+
+            assert len(recon_plans) == len(baseline) == 3
+            for got, want in zip(recon_plans, baseline):
+                np.testing.assert_array_equal(got, np.asarray(want))
+            # zero reopens: the only open_session was the first one
+            assert b.servicer.seam.snapshot().get(
+                "session_session_migrated_out", 0
+            ) == 0
+            assert a.servicer.seam.snapshot().get(
+                "session_session_migrated_out", 0
+            ) == 1
+        finally:
+            ca.close()
+            cb.close()
+            a.stop(grace=None)
+            b.stop(grace=None)
